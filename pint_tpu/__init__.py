@@ -1,0 +1,24 @@
+"""pint_tpu — a TPU-native pulsar-timing framework.
+
+Re-implements the capabilities of PINT (reference: mhvk/PINT, surveyed in
+``SURVEY.md``) as a unit-free, pure-functional JAX core: timing-model
+components compile to ``(params, toa_bundle) -> phase`` kernels that jit,
+vmap over pulsars, and shard over the TOA axis of a ``jax.sharding.Mesh``;
+fitters run XLA Cholesky / SVD on device; absolute time is carried as
+two-part values (double-double) so pulse phase is tracked to sub-ns over
+decades without float128 (which TPUs do not have).
+
+Layering (cf. SURVEY.md §1): ops (numerics kernels) → timebase (host exact
+time) → io / observatories / ephemeris → toas → models → residuals →
+fitting → parallel.
+"""
+
+from pint_tpu._version import __version__
+
+# x64 must be on before any jnp array is created: absolute-time arithmetic
+# relies on f64 pairs (see pint_tpu.ops.dd).
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__all__ = ["__version__"]

@@ -1,0 +1,70 @@
+"""Physical and astronomical constants (SI), IAU 2015 / CODATA values.
+
+The reference keeps units in astropy Quantities everywhere
+(SURVEY.md §2a "Utils"); our core is unit-free SI — seconds, meters,
+radians, Hz — with conversions only at the API boundary
+(``pint_tpu.utils.units``).
+"""
+
+import math
+
+# -- time -----------------------------------------------------------------
+SECS_PER_DAY = 86400.0
+DAYS_PER_JULIAN_YEAR = 365.25
+SECS_PER_JULIAN_YEAR = SECS_PER_DAY * DAYS_PER_JULIAN_YEAR
+MJD_J2000 = 51544.5  # J2000.0 epoch as MJD (TT)
+JD_MINUS_MJD = 2400000.5
+# TT = TAI + 32.184 s (exact, by definition)
+TT_MINUS_TAI = 32.184
+# TDB ~ TT at epoch 1977 Jan 1.0003725 TAI (defining relation)
+# L_B and TDB0 from IAU 2006 Resolution B3 (TCB<->TDB)
+L_B = 1.550519768e-8
+TDB0 = -6.55e-5  # seconds
+L_C = 1.48082686741e-8  # <dTCG/dTCB> - 1
+L_G = 6.969290134e-10  # TCG vs TT rate (IAU 2000 Res B1.9, exact)
+
+# -- lengths / light ------------------------------------------------------
+C = 299792458.0  # m/s, exact
+AU = 149597870700.0  # m, IAU 2012 exact
+AU_LIGHT_SEC = AU / C  # ~499.004783836 s
+PC = 3.0856775814913673e16  # m (parsec, derived from AU / arcsec)
+KPC = 1e3 * PC
+
+# -- angles ---------------------------------------------------------------
+ARCSEC_TO_RAD = math.pi / (180.0 * 3600.0)
+MAS_TO_RAD = ARCSEC_TO_RAD * 1e-3
+DEG_TO_RAD = math.pi / 180.0
+HOUR_TO_RAD = math.pi / 12.0
+
+# -- gravity (GM values, m^3/s^2; DE440 / IAU best estimates) -------------
+GM_SUN = 1.32712440041279419e20
+GM_MERCURY = 2.2031868551e13
+GM_VENUS = 3.24858592000e14
+GM_EARTH = 3.98600435507e14
+GM_MOON = 4.902800118e12
+GM_MARS = 4.2828375816e13  # Mars system
+GM_JUPITER = 1.26712764100000e17  # Jupiter system
+GM_SATURN = 3.79405852000000e16  # Saturn system
+GM_URANUS = 5.794556400000e15  # Uranus system
+GM_NEPTUNE = 6.836527100580e15  # Neptune system
+
+# Shapiro-delay coefficient 2*GM/c^3 for the Sun, seconds
+T_SUN = 2.0 * GM_SUN / C**3  # ~9.8509e-6 s
+# Solar mass in seconds (GM/c^3), the unit used by binary models
+TSUN = GM_SUN / C**3  # ~4.92549e-6 s
+
+# -- dispersion -----------------------------------------------------------
+# DM constant: delay = DM * DM_CONST / freq_MHz^2 seconds, DM in pc/cm^3.
+# The reference fixes 1/(2.41e-4) MHz^2 pc^-1 cm^3 s (Tempo convention)
+# rather than the physical e^2/(2 pi m_e c); we follow for parity.
+DM_CONST = 1.0 / 2.41e-4  # s MHz^2 / (pc cm^-3)
+
+# -- solar wind -----------------------------------------------------------
+# Conversion used by solar-wind dispersion: electron column in AU * cm^-3
+# expressed in pc cm^-3.
+AU_PC = AU / PC
+
+# -- Earth ----------------------------------------------------------------
+EARTH_EQUATORIAL_RADIUS = 6378136.6  # m (IERS 2010)
+EARTH_FLATTENING = 1.0 / 298.25642
+OBL_J2000 = 84381.406 * ARCSEC_TO_RAD  # IAU 2006 obliquity at J2000

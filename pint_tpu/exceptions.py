@@ -1,0 +1,82 @@
+"""Central exception types (reference parity: src/pint/exceptions.py)."""
+
+
+class PintTpuError(Exception):
+    """Base class for all pint_tpu errors."""
+
+
+class MissingParameter(PintTpuError):
+    """A required timing-model parameter is absent or unset."""
+
+    def __init__(self, module="", param="", msg=None):
+        self.module = module
+        self.param = param
+        super().__init__(msg or f"{module} is missing parameter {param}")
+
+
+class MissingTOAs(PintTpuError):
+    """A mask parameter selects no TOAs."""
+
+    def __init__(self, parameter_names=()):
+        if isinstance(parameter_names, str):
+            parameter_names = [parameter_names]
+        self.parameter_names = list(parameter_names)
+        super().__init__(f"Parameters {self.parameter_names} select no TOAs")
+
+
+class MissingClockCorrection(PintTpuError):
+    """No clock correction available for an observatory/epoch."""
+
+
+class ClockCorrectionOutOfRange(PintTpuError):
+    """A TOA falls outside the span of the observatory clock file."""
+
+
+class UnknownObservatory(PintTpuError):
+    """Observatory name not found in the registry."""
+
+
+class UnknownParameter(PintTpuError):
+    """Par-file line not understood by any component."""
+
+
+class TimingModelError(PintTpuError):
+    """Ill-formed timing model (validation failure)."""
+
+
+class PrefixError(PintTpuError):
+    """Malformed prefix-parameter name."""
+
+
+class ConvergenceFailure(PintTpuError):
+    """A fitter failed to converge."""
+
+
+class MaxiterReached(ConvergenceFailure):
+    """Downhill fitter hit the iteration limit without meeting tolerance."""
+
+
+class StepProblem(ConvergenceFailure):
+    """Downhill fitter could not find a chi2-decreasing step."""
+
+
+class InvalidModelParameters(PintTpuError):
+    """A proposed step produced non-finite / unphysical parameters."""
+
+
+class CorrelatedErrors(PintTpuError):
+    """Model has correlated noise but the fitter cannot handle it."""
+
+    def __init__(self, model):
+        trouble = [c.__class__.__name__ for c in model.noise_components if c.introduces_correlated_errors]
+        super().__init__(
+            f"Model has correlated errors ({trouble}); use a GLS fitter"
+        )
+
+
+class DegeneracyWarning(UserWarning):
+    """Design matrix is degenerate; some parameters are unconstrained."""
+
+
+class PropertyAttributeError(PintTpuError):
+    """Error raised inside a property getter (reference parity)."""

@@ -1,0 +1,10 @@
+"""Numerics kernels: the unit-free JAX substrate every layer builds on.
+
+Reference parity: replaces longdouble NumPy + pyerfa C with TPU-friendly
+double-double arithmetic (``dd``), two-part pulse phase (``phase``),
+Taylor-series spin phase (``taylor``), Kepler solvers (``kepler``),
+Chebyshev ephemeris evaluation (``chebyshev``), Earth rotation (``earth``)
+and TT->TDB (``tdb``).
+"""
+
+from pint_tpu.ops.dd import DD  # noqa: F401

@@ -1,0 +1,252 @@
+"""Double-double (DD) arithmetic as a JAX pytree.
+
+Why: TPUs have no float128.  The reference holds absolute time in NumPy
+longdouble (80-bit) — e.g. the ``tdbld`` TOA column and the spin-phase
+computation (SURVEY.md §2a "TOA ingest", §3.2) — because pulse phase over
+decades needs ~1e-19 relative precision (1e9 s span, ns target).  A DD
+value represents x = hi + lo with |lo| <= ulp(hi)/2, giving ~32 significant
+digits from pairs of f64, and every operation below compiles to a handful
+of XLA f64 ops that jit/vmap/shard like any other array math.
+
+Algorithms are the classical error-free transforms (Dekker 1971, Knuth
+TAOCP v2, Hida-Li-Bailey QD): two_sum, split/two_prod, renormalization.
+They require IEEE-754 round-to-nearest f64 semantics, which XLA provides
+on CPU and via f64 software emulation on TPU; ``tests/test_dd.py``
+verifies both against mpmath oracles.
+
+No FMA is assumed (XLA exposes none portably at the jnp level); two_prod
+uses Dekker splitting.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Arrayish = Union[jnp.ndarray, np.ndarray, float, int]
+
+_SPLITTER = 134217729.0  # 2**27 + 1
+
+
+def _two_sum(a, b):
+    """s + err == a + b exactly, s = fl(a+b)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _quick_two_sum(a, b):
+    """Like two_sum but requires |a| >= |b|."""
+    s = a + b
+    err = b - (s - a)
+    return s, err
+
+
+def _split(a):
+    """Dekker split: a = hi + lo with hi, lo having <= 27 significant bits."""
+    t = _SPLITTER * a
+    hi = t - (t - a)
+    lo = a - hi
+    return hi, lo
+
+
+def _two_prod(a, b):
+    """p + err == a * b exactly, p = fl(a*b)."""
+    p = a * b
+    ahi, alo = _split(a)
+    bhi, blo = _split(b)
+    err = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+    return p, err
+
+
+class DD(NamedTuple):
+    """A double-double number (or array): value = hi + lo.
+
+    A NamedTuple so it is automatically a JAX pytree: DD values pass
+    through jit/vmap/grad/shard_map transparently, and stacking /
+    sharding acts on the hi/lo leaves.
+    """
+
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def from_float(x: Arrayish) -> "DD":
+        x = jnp.asarray(x, dtype=jnp.float64)
+        return DD(x, jnp.zeros_like(x))
+
+    @staticmethod
+    def from_sum(a: Arrayish, b: Arrayish) -> "DD":
+        """DD representing a + b exactly (a, b floats)."""
+        a = jnp.asarray(a, dtype=jnp.float64)
+        b = jnp.asarray(b, dtype=jnp.float64)
+        return DD(*_two_sum(a, b))
+
+    @staticmethod
+    def from_prod(a: Arrayish, b: Arrayish) -> "DD":
+        """DD representing a * b exactly (a, b floats)."""
+        a = jnp.asarray(a, dtype=jnp.float64)
+        b = jnp.asarray(b, dtype=jnp.float64)
+        return DD(*_two_prod(a, b))
+
+    @staticmethod
+    def from_string(s: str) -> "DD":
+        """Parse a decimal string to DD exactly (host-side, via mpmath-free
+        integer arithmetic)."""
+        from decimal import Decimal, localcontext
+
+        with localcontext() as ctx:
+            ctx.prec = 50
+            d = Decimal(s)
+            hi = float(d)
+            lo = float(d - Decimal(hi))
+        return DD(jnp.float64(hi), jnp.float64(lo))
+
+    @staticmethod
+    def zeros(shape, ) -> "DD":
+        z = jnp.zeros(shape, dtype=jnp.float64)
+        return DD(z, z)
+
+    # -- norm ------------------------------------------------------------
+    def normalize(self) -> "DD":
+        return DD(*_quick_two_sum(self.hi, self.lo))
+
+    # -- arithmetic ------------------------------------------------------
+    def __add__(self, other) -> "DD":
+        if not isinstance(other, DD):
+            other = DD.from_float(other)
+        s, e = _two_sum(self.hi, other.hi)
+        e = e + (self.lo + other.lo)
+        return DD(*_quick_two_sum(s, e))
+
+    __radd__ = __add__
+
+    def __neg__(self) -> "DD":
+        return DD(-self.hi, -self.lo)
+
+    def __sub__(self, other) -> "DD":
+        if not isinstance(other, DD):
+            other = DD.from_float(other)
+        return self + (-other)
+
+    def __rsub__(self, other) -> "DD":
+        return (-self) + other
+
+    def __mul__(self, other) -> "DD":
+        if not isinstance(other, DD):
+            other = DD.from_float(other)
+        p, e = _two_prod(self.hi, other.hi)
+        e = e + (self.hi * other.lo + self.lo * other.hi)
+        return DD(*_quick_two_sum(p, e))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other) -> "DD":
+        if not isinstance(other, DD):
+            other = DD.from_float(other)
+        q1 = self.hi / other.hi
+        r = self - other * q1
+        q2 = r.hi / other.hi
+        r = r - other * q2
+        q3 = r.hi / other.hi
+        s, e = _quick_two_sum(q1, q2)
+        return DD(*_quick_two_sum(s, e + q3))
+
+    def __rtruediv__(self, other) -> "DD":
+        return DD.from_float(other) / self
+
+    # -- comparisons (exact: computed on the normalized difference) -------
+    def __lt__(self, other):
+        d = (self - other).normalize()
+        return (d.hi < 0) | ((d.hi == 0) & (d.lo < 0))
+
+    def __gt__(self, other):
+        d = (self - other).normalize()
+        return (d.hi > 0) | ((d.hi == 0) & (d.lo > 0))
+
+    def __le__(self, other):
+        d = (self - other).normalize()
+        return (d.hi < 0) | ((d.hi == 0) & (d.lo <= 0))
+
+    def __ge__(self, other):
+        d = (self - other).normalize()
+        return (d.hi > 0) | ((d.hi == 0) & (d.lo >= 0))
+
+    def __eq__(self, other):  # elementwise, like jnp arrays
+        d = (self - other).normalize()
+        return (d.hi == 0) & (d.lo == 0)
+
+    def __ne__(self, other):
+        return ~(self == other)
+
+    __hash__ = None
+
+    # -- conversions -----------------------------------------------------
+    def to_float(self) -> jnp.ndarray:
+        return self.hi + self.lo
+
+    def split_int_frac(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Split into (integer_part, fractional_part in [-0.5, 0.5)).
+
+        The integer part is returned as f64 (exact up to 2**53, ample for
+        ~1e12 pulse cycles; cf. reference Phase in src/pint/phase.py).
+        """
+        # Carries use floor(x + 0.5), not round-half-even: ties must map to
+        # frac == -0.5 regardless of integer-part parity so the half-cycle
+        # convention is deterministic (frac strictly in [-0.5, 0.5)).
+        ihi = jnp.floor(self.hi + 0.5)
+        rem = DD(self.hi - ihi, self.lo).normalize()  # exact: hi-ihi is exact
+        ilo = jnp.floor(rem.hi + 0.5)
+        frac = DD(rem.hi - ilo, rem.lo).normalize()
+        carry = jnp.floor(frac.hi + frac.lo + 0.5)
+        return ihi + ilo + carry, (frac - carry).to_float()
+
+    # -- shape utilities (pytree-leaf-wise) ------------------------------
+    @property
+    def shape(self):
+        return self.hi.shape
+
+    def __getitem__(self, idx) -> "DD":
+        return DD(self.hi[idx], self.lo[idx])
+
+    def reshape(self, *shape) -> "DD":
+        return DD(self.hi.reshape(*shape), self.lo.reshape(*shape))
+
+    def sum(self, axis=None) -> "DD":
+        """Compensated (error-tracking) sum along an axis."""
+        hi, lo = self.hi, self.lo
+        if axis is None:
+            hi, lo, axis = hi.reshape(-1), lo.reshape(-1), 0
+        hi = jnp.moveaxis(hi, axis, 0)
+        lo = jnp.moveaxis(lo, axis, 0)
+        init = DD(jnp.zeros(hi.shape[1:]), jnp.zeros(lo.shape[1:]))
+        out, _ = jax.lax.scan(
+            lambda c, x: (c + DD(x[0], x[1]), None), init, (hi, lo)
+        )
+        return out
+
+
+def dd_sqrt(x: DD) -> DD:
+    """DD square root via one Newton step on the f64 estimate."""
+    r = jnp.sqrt(x.hi)
+    safe_r = jnp.where(r == 0, 1.0, r)  # avoid 0/0 -> NaN for x == 0
+    # Newton: r' = r + (x - r^2) / (2r), carried in DD
+    r_dd = DD.from_float(r)
+    diff = x - r_dd * r_dd
+    corr = DD(diff.hi / (2.0 * safe_r), diff.lo / (2.0 * safe_r))
+    corr = DD(jnp.where(r == 0, 0.0, corr.hi), jnp.where(r == 0, 0.0, corr.lo))
+    return (r_dd + corr).normalize()
+
+
+def dd_abs(x: DD) -> DD:
+    neg = x.hi < 0
+    return DD(jnp.where(neg, -x.hi, x.hi), jnp.where(neg, -x.lo, x.lo))
+
+
+def dd_where(cond, a: DD, b: DD) -> DD:
+    return DD(jnp.where(cond, a.hi, b.hi), jnp.where(cond, a.lo, b.lo))

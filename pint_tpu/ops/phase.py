@@ -1,0 +1,66 @@
+"""Two-part pulse phase: (integer cycles, fractional cycles).
+
+Reference parity: ``src/pint/phase.py::Phase`` — a (quad-precision-ish)
+pair so that ~1e12 absolute cycles never eat the sub-ns fractional part.
+Here ``int_`` is f64 carrying an exact integer (|n| < 2**53) and ``frac``
+is f64 in [-0.5, 0.5); both are jnp arrays, so Phase is a pytree that
+jit/vmap/shards.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from pint_tpu.ops.dd import DD
+
+
+class Phase(NamedTuple):
+    int_: jnp.ndarray  # exact integer stored as f64
+    frac: jnp.ndarray  # [-0.5, 0.5)
+
+    @staticmethod
+    def from_dd(x: DD) -> "Phase":
+        i, f = x.split_int_frac()
+        return Phase(i, f)
+
+    @staticmethod
+    def from_float(x) -> "Phase":
+        x = jnp.asarray(x, dtype=jnp.float64)
+        i = jnp.floor(x + 0.5)  # ties -> frac == -0.5, parity-independent
+        return Phase(i, x - i)
+
+    @staticmethod
+    def zeros(shape) -> "Phase":
+        z = jnp.zeros(shape, dtype=jnp.float64)
+        return Phase(z, z)
+
+    def __add__(self, other) -> "Phase":
+        if not isinstance(other, Phase):
+            other = Phase.from_float(other)
+        f = self.frac + other.frac
+        carry = jnp.floor(f + 0.5)
+        return Phase(self.int_ + other.int_ + carry, f - carry)
+
+    def __sub__(self, other) -> "Phase":
+        if not isinstance(other, Phase):
+            other = Phase.from_float(other)
+        return self + Phase(-other.int_, -other.frac)
+
+    def __neg__(self) -> "Phase":
+        return Phase(-self.int_, -self.frac)
+
+    def to_float(self) -> jnp.ndarray:
+        """Total phase as f64 (loses sub-cycle precision at large N)."""
+        return self.int_ + self.frac
+
+    def to_dd(self) -> DD:
+        return DD.from_sum(self.int_, self.frac)
+
+    @property
+    def shape(self):
+        return self.int_.shape
+
+    def __getitem__(self, idx) -> "Phase":
+        return Phase(self.int_[idx], self.frac[idx])

@@ -1,0 +1,73 @@
+"""Taylor-series (Horner) evaluation — the spin-phase kernel.
+
+Reference parity: ``src/pint/utils.py::taylor_horner`` /
+``taylor_horner_deriv`` evaluate sum_i coeffs[i] * x^i / i! by Horner's
+rule; ``Spindown.phase`` feeds it dt (longdouble seconds) and [0, F0, F1,
+...].  Here dt arrives as a DD (pair of f64) and the accumulation is DD,
+so F0*dt keeps cycle-level exactness at 1e12 cycles.  Coefficients are
+ordinary f64 scalars (they are fitted parameters; their uncertainties
+dwarf f64 ulp).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from pint_tpu.ops.dd import DD
+
+
+def taylor_horner_dd(dt: DD, coeffs: Sequence) -> DD:
+    """sum_i coeffs[i] * dt^i / i! with DD accumulation.
+
+    coeffs is a static-length Python sequence of scalars (jnp 0-d arrays
+    or floats) — the number of spin terms is a compile-time property of
+    the model, so the Python loop unrolls into straight-line XLA.
+    """
+    if len(coeffs) == 0:
+        return DD.zeros(dt.hi.shape)
+    acc = DD.from_float(jnp.zeros_like(dt.hi))
+    for i in reversed(range(len(coeffs))):
+        c = DD.from_float(coeffs[i])
+        if i >= 2:
+            c = c / float(math.factorial(i))  # DD-exact division
+        acc = acc * dt + c
+    return acc
+
+
+def taylor_horner_deriv_dd(dt: DD, coeffs: Sequence, deriv_order: int = 1) -> DD:
+    """d^n/dt^n of taylor_horner_dd at dt."""
+    n = deriv_order
+    if len(coeffs) <= n:
+        return DD.zeros(dt.hi.shape)
+    acc = DD.from_float(jnp.zeros_like(dt.hi))
+    for i in reversed(range(len(coeffs) - n)):
+        c = DD.from_float(coeffs[i + n])
+        if i >= 2:
+            c = c / float(math.factorial(i))
+        acc = acc * dt + c
+    return acc
+
+
+def taylor_horner(dt, coeffs: Sequence):
+    """Plain-f64 variant for small-magnitude uses (delay derivatives,
+    DM(t) polynomials) where DD is overkill."""
+    acc = jnp.zeros_like(jnp.asarray(dt, dtype=jnp.float64))
+    for i in reversed(range(len(coeffs))):
+        acc = acc * dt + coeffs[i] / float(math.factorial(i))
+    return acc
+
+
+def taylor_horner_deriv(dt, coeffs: Sequence, deriv_order: int = 1):
+    n = deriv_order
+    if len(coeffs) <= n:
+        return jnp.zeros_like(jnp.asarray(dt, dtype=jnp.float64))
+    shifted = [
+        coeffs[i + n] / float(math.factorial(i)) for i in range(len(coeffs) - n)
+    ]
+    acc = jnp.zeros_like(jnp.asarray(dt, dtype=jnp.float64))
+    for c in reversed(shifted):
+        acc = acc * dt + c
+    return acc

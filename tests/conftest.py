@@ -1,0 +1,28 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+The reference framework (mhvk/PINT) is single-process CPU; our framework is
+designed for TPU slices.  Tests exercise the multi-device code paths on a
+virtual CPU mesh (``xla_force_host_platform_device_count=8``) exactly as the
+driver's ``dryrun_multichip`` does, so sharding bugs surface without TPU
+hardware.  Set ``PINT_TPU_TEST_BACKEND=tpu`` to run on the real chip instead.
+"""
+
+import os
+
+_BACKEND = os.environ.get("PINT_TPU_TEST_BACKEND", "cpu")
+if _BACKEND == "cpu":
+    # NOTE: the env var JAX_PLATFORMS is overridden by the axon PJRT
+    # plugin's sitecustomize on TPU hosts; jax.config.update below is the
+    # reliable way to force CPU.  XLA_FLAGS must still be set before the
+    # backend initializes to get the 8-device virtual mesh.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import jax  # noqa: E402
+
+if _BACKEND == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

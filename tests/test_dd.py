@@ -1,0 +1,209 @@
+"""Double-double arithmetic vs mpmath oracles (hypothesis-driven).
+
+The reference leans on longdouble (80-bit) for absolute time; our DD pairs
+must beat it (~32 digits).  These tests are the foundation of the <1 ns
+residual claim, per SURVEY.md §7 step 1.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import mpmath as mp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from pint_tpu.ops.dd import DD, dd_abs, dd_sqrt, dd_where
+from pint_tpu.ops.phase import Phase
+from pint_tpu.ops.taylor import (
+    taylor_horner,
+    taylor_horner_dd,
+    taylor_horner_deriv,
+    taylor_horner_deriv_dd,
+)
+
+mp.mp.dps = 50
+
+# Magnitudes bounded away from the subnormal range: XLA flushes f64
+# subnormals to zero (FTZ), which breaks EFT exactness at ~1e-308 — far
+# below any quantity in pulsar timing (seconds, radians, Hz, cycles).
+finite = st.one_of(
+    st.just(0.0),
+    st.floats(min_value=1e-140, max_value=1e15),
+    st.floats(min_value=-1e15, max_value=-1e-140),
+)
+
+
+def to_mp(x: DD) -> mp.mpf:
+    return mp.mpf(float(x.hi)) + mp.mpf(float(x.lo))
+
+
+def assert_dd_close(x: DD, ref: mp.mpf, rel=1e-29, abs_tol=1e-300):
+    got = to_mp(x)
+    err = abs(got - ref)
+    assert err <= abs_tol + rel * abs(ref), f"dd={got} ref={ref} err={err}"
+
+
+@given(finite, finite)
+@settings(max_examples=200, deadline=None)
+def test_add(a, b):
+    assert_dd_close(DD.from_float(a) + DD.from_float(b), mp.mpf(a) + mp.mpf(b))
+
+
+@given(finite, finite)
+@settings(max_examples=200, deadline=None)
+def test_sub_catastrophic(a, b):
+    # exercise cancellation: (a+b) - a == b exactly in DD when representable
+    s = DD.from_sum(a, b)
+    d = s - DD.from_float(a)
+    assert_dd_close(d, mp.mpf(b), rel=1e-29, abs_tol=abs(a) * 1e-32 + 1e-300)
+
+
+@given(finite, finite)
+@settings(max_examples=200, deadline=None)
+def test_mul(a, b):
+    assert_dd_close(DD.from_float(a) * DD.from_float(b), mp.mpf(a) * mp.mpf(b))
+
+
+@given(finite, st.floats(min_value=1e-10, max_value=1e10))
+@settings(max_examples=200, deadline=None)
+def test_div(a, b):
+    assert_dd_close(DD.from_float(a) / DD.from_float(b), mp.mpf(a) / mp.mpf(b))
+
+
+@given(st.floats(min_value=1e-15, max_value=1e15))
+@settings(max_examples=100, deadline=None)
+def test_sqrt(a):
+    assert_dd_close(dd_sqrt(DD.from_float(a)), mp.sqrt(mp.mpf(a)), rel=1e-28)
+
+
+def test_time_precision_over_decades():
+    """An absolute TDB spanning 30 years, carried in DD seconds, must hold
+    sub-ns — in fact sub-fs — structure."""
+    t0 = DD.from_string("1577836800.123456789123456789")  # ~50 yr in sec
+    dt = DD.from_string("0.000000001")  # 1 ns
+    t1 = t0 + dt
+    diff = t1 - t0
+    # DD carries ~32 significant digits; at 1.6e9 s that is ~1e-22 s
+    assert abs(float(diff.to_float()) - 1e-9) < 1e-21
+
+
+def test_split_int_frac_exact():
+    x = DD.from_sum(1e12, 0.25)
+    i, f = x.split_int_frac()
+    np.testing.assert_allclose(float(i), 1e12)
+    np.testing.assert_allclose(float(f), 0.25, atol=1e-20)
+    # negative frac folding
+    x = DD.from_sum(7.0, 0.75)
+    i, f = x.split_int_frac()
+    assert float(i) == 8.0 and abs(float(f) + 0.25) < 1e-16
+
+
+def test_dd_under_jit_and_vmap():
+    @jax.jit
+    def f(x: DD, y: DD):
+        return (x * y + x / y).normalize()
+
+    a = DD(jnp.linspace(1.0, 2.0, 8), jnp.zeros(8))
+    b = DD.from_float(jnp.full(8, 3.0))
+    out = f(a, b)
+    ref = [mp.mpf(float(h)) * 3 + mp.mpf(float(h)) / 3 for h in a.hi]
+    for i in range(8):
+        assert_dd_close(out[i], ref[i])
+    # vmap over the leading axis
+    g = jax.vmap(lambda x, y: x * y)
+    out2 = g(a, b)
+    assert out2.hi.shape == (8,)
+
+
+def test_dd_sum_compensated():
+    # sum of 1e6 copies of 0.1 — naive f64 drifts, DD must not
+    n = 10000
+    x = DD.from_float(jnp.full(n, 0.1))
+    s = x.sum()
+    ref = mp.mpf("0.1") * n
+    # 0.1 isn't exact in f64; the DD sum must equal n * fl(0.1) exactly
+    ref_fl = mp.mpf(float(np.float64(0.1))) * n
+    assert abs(to_mp(s) - ref_fl) < 1e-20
+    assert abs(to_mp(s) - ref) < 1e-10  # and still close to the decimal value
+
+
+def test_taylor_horner_matches_mpmath():
+    coeffs = [0.0, 339.31568728824463, -1.6148e-13, 1.9e-23]
+    dts = [0.0, 1.0, 86400.0, 1e8, -3e8]
+    for dtv in dts:
+        dt = DD.from_float(dtv)
+        got = taylor_horner_dd(dt, coeffs)
+        ref = sum(
+            mp.mpf(c) * mp.mpf(dtv) ** i / mp.factorial(i)
+            for i, c in enumerate(coeffs)
+        )
+        assert_dd_close(got, ref, rel=1e-28, abs_tol=1e-18)
+
+
+def test_taylor_horner_deriv():
+    coeffs = [0.0, 300.0, -1e-13, 2e-23]
+    dt = 1e7
+    got = taylor_horner_deriv_dd(DD.from_float(dt), coeffs, 1)
+    ref = sum(
+        mp.mpf(coeffs[i]) * mp.mpf(dt) ** (i - 1) / mp.factorial(i - 1)
+        for i in range(1, len(coeffs))
+    )
+    assert_dd_close(got, ref, rel=1e-25)
+    # f64 variants agree with dd at f64 level
+    np.testing.assert_allclose(
+        float(taylor_horner(dt, coeffs)),
+        float(taylor_horner_dd(DD.from_float(dt), coeffs).to_float()),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        float(taylor_horner_deriv(dt, coeffs, 2)),
+        float(taylor_horner_deriv_dd(DD.from_float(dt), coeffs, 2).to_float()),
+        rtol=1e-12,
+    )
+
+
+def test_spin_phase_ns_precision():
+    """North-star precision check: phase of a 339 Hz pulsar 20 years from
+    PEPOCH must carry sub-ns time structure.  1 ns of time = F0*1e-9 ~
+    3.4e-7 cycles; DD phase error must be far below that."""
+    F0, F1 = 339.31568728824463, -1.6148e-13
+    dt_s = 20 * 365.25 * 86400.0
+    dt = DD.from_sum(dt_s, 1e-9)  # add exactly 1 ns
+    dt0 = DD.from_float(dt_s)
+    p1 = Phase.from_dd(taylor_horner_dd(dt, [0.0, F0, F1]))
+    p0 = Phase.from_dd(taylor_horner_dd(dt0, [0.0, F0, F1]))
+    dphi = (p1 - p0).to_float()
+    f_at = F0 + F1 * dt_s
+    np.testing.assert_allclose(float(dphi) / f_at, 1e-9, rtol=1e-9)
+
+
+def test_phase_arithmetic():
+    a = Phase.from_float(jnp.array([1.25, -2.75]))
+    b = Phase.from_float(jnp.array([0.5, 0.5]))
+    c = a + b
+    np.testing.assert_allclose(np.asarray(c.to_float()), [1.75, -2.25])
+    d = a - b
+    np.testing.assert_allclose(np.asarray(d.to_float()), [0.75, -3.25])
+    assert np.all(np.abs(np.asarray(c.frac)) <= 0.5)
+
+
+def test_dd_where_abs():
+    a = DD.from_float(jnp.array([-1.5, 2.5]))
+    assert np.all(np.asarray(dd_abs(a).hi) == [1.5, 2.5])
+    w = dd_where(jnp.array([True, False]), a, -a)
+    np.testing.assert_allclose(np.asarray(w.hi), [-1.5, -2.5])
+
+
+def test_dd_grad_flows():
+    """jax.grad must flow through DD ops (design matrix via jacfwd relies
+    on differentiating the DD phase kernel)."""
+
+    def f(x):
+        dt = DD.from_float(x)
+        return taylor_horner_dd(dt, [0.0, 300.0, -1e-13]).to_float()
+
+    g = jax.grad(f)(1e7)
+    ref = 300.0 + -1e-13 * 1e7
+    np.testing.assert_allclose(float(g), ref, rtol=1e-9)
