@@ -1,0 +1,4 @@
+"""Host IO: par files, tim files, clock files, EOP, SPK ephemerides."""
+
+from pint_tpu.io.par import parse_parfile  # noqa: F401
+from pint_tpu.io.tim import read_tim_file  # noqa: F401

@@ -1,0 +1,60 @@
+"""Par-file (pulsar ephemeris) parsing.
+
+Reference parity: src/pint/models/model_builder.py::parse_parfile — a par
+file is ``NAME value [fit] [uncertainty]`` lines; repeated names are legal
+(JUMP families); '#' and 'C '-style comments; Fortran 'D' exponents.
+Component selection from the parsed dict happens in
+pint_tpu.models.builder, mirroring ModelBuilder.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from collections import OrderedDict
+from typing import Union
+
+__all__ = ["parse_parfile"]
+
+
+def parse_parfile(path_or_str: Union[str, os.PathLike]) -> "OrderedDict[str, list[list[str]]]":
+    """Parse a par file into {UPPER_NAME: [token-list, ...]}.
+
+    Accepts a filesystem path or the par-file text itself (any string
+    containing a newline is treated as content — matching the reference's
+    get_model(StringIO) convenience).
+    """
+    if hasattr(path_or_str, "read"):
+        text = path_or_str.read()
+    else:
+        s = os.fspath(path_or_str)
+        if "\n" in s:
+            text = s
+        else:
+            with open(s) as f:
+                text = f.read()
+    out: OrderedDict[str, list[list[str]]] = OrderedDict()
+    for raw in io.StringIO(text):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.upper().startswith("C ") or line.upper().startswith("CC "):
+            continue
+        # strip trailing comments
+        for mark in ("#",):
+            if mark in line:
+                line = line.split(mark, 1)[0].strip()
+        tokens = line.split()
+        if not tokens:
+            continue
+        name = tokens[0].upper()
+        out.setdefault(name, []).append(tokens[1:])
+    return out
+
+
+def parfile_dict_to_text(d) -> str:
+    lines = []
+    for name, entries in d.items():
+        for tokens in entries:
+            lines.append(" ".join([name, *tokens]))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,266 @@
+"""Tim-file (TOA) parsing and writing.
+
+Reference parity: src/pint/toa.py tim parsing — Tempo2 ("FORMAT 1") and
+Princeton formats, tim commands (FORMAT, MODE, INCLUDE, TIME, EFAC,
+EQUAD, EMIN, SKIP/NOSKIP, END, PHASE, JUMP), per-TOA flags (-key value).
+
+Princeton fixed columns (tempo convention):
+  col 0     observatory one-character code
+  col 1-:   free text name
+  cols 15+  freq (MHz), MJD (cols 24-44), uncertainty (us)
+We parse Princeton leniently by whitespace after extracting the site code,
+which covers the files produced by tempo/PINT writers; ITOA/Parkes formats
+raise a clear error (rare in modern datasets).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+
+__all__ = ["read_tim_file", "write_tim_file"]
+
+_COMMANDS = {
+    "FORMAT", "MODE", "INCLUDE", "TIME", "EFAC", "EQUAD", "EMIN", "EMAX",
+    "SKIP", "NOSKIP", "END", "PHASE", "JUMP", "TRACK", "INFO", "FMIN",
+    "FMAX", "SIGMA",
+}
+
+
+def _is_flag_key(tok: str) -> bool:
+    """'-f', '-be' are flag keys; '-1', '-0.5' are (negative) values."""
+    return len(tok) >= 2 and tok[0] == "-" and not tok[1].isdigit() \
+        and tok[1] != "."
+
+
+class _ParseState:
+    def __init__(self):
+        self.fmt = "Princeton"
+        self.time_offset_s = 0.0
+        self.efac = 1.0
+        self.equad_us = 0.0
+        self.phase = 0.0
+        self.skip = False
+        self.jump_counter = 0
+        self.in_jump = False
+        self.ended = False
+
+
+def read_tim_file(path, include_depth: int = 0):
+    """-> (mjd_strings, freq, err_us, obs, flags) raw lists (pre-TOAs)."""
+    if include_depth > 10:
+        raise PintTpuError("INCLUDE nesting too deep")
+    path = Path(path)
+    rows = {"mjd": [], "freq": [], "err": [], "obs": [], "flags": []}
+    state = _ParseState()
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            _parse_line(raw, state, rows, path, lineno, include_depth)
+            if state.ended:
+                break
+    return rows
+
+
+def build_toas_from_rows(rows) -> TOAs:
+    t = TimeArray.from_mjd_strings(rows["mjd"], scale="utc")
+    # Apply TIME-command offsets to the arrival times now (design note:
+    # the reference defers them to the clock-correction stage via a 'to'
+    # flag; baking them in at parse time is equivalent — the shifted time
+    # IS the arrival time — and keeps ingest stateless).  The 'to' flag is
+    # retained for provenance only.
+    offsets = np.array(
+        [float(f.get("to", 0.0)) for f in rows["flags"]], dtype=np.float64
+    )
+    if np.any(offsets != 0.0):
+        t = t.add_seconds(offsets)
+    toas = TOAs(
+        t,
+        np.array(rows["freq"], dtype=np.float64),
+        np.array(rows["err"], dtype=np.float64),
+        rows["obs"],
+        rows["flags"],
+    )
+    return toas
+
+
+def _parse_line(raw, state, rows, path, lineno, depth):
+    line = raw.strip()
+    if not line:
+        return
+    if line.startswith(("#", "C ", "c ", "%")):
+        return
+    tokens = line.split()
+    head = tokens[0].upper()
+
+    if head in _COMMANDS:
+        _apply_command(head, tokens, state, rows, path, depth)
+        return
+    if state.skip:
+        return
+    if state.fmt == "Tempo2":
+        _parse_tempo2_toa(tokens, state, rows, path, lineno)
+    else:
+        _parse_princeton_toa(raw.rstrip("\n"), tokens, state, rows, path, lineno)
+
+
+def _apply_command(head, tokens, state, rows, path, depth):
+    if head == "FORMAT":
+        state.fmt = "Tempo2" if tokens[1] == "1" else "Princeton"
+    elif head == "MODE":
+        pass  # fit-mode hint, ignored (reference logs and ignores too)
+    elif head == "INCLUDE":
+        inc = Path(path).parent / tokens[1]
+        sub = read_tim_file(inc, depth + 1)
+        for k in rows:
+            rows[k].extend(sub[k])
+    elif head == "TIME":
+        state.time_offset_s += float(tokens[1])
+    elif head == "EFAC":
+        state.efac = float(tokens[1])
+    elif head == "EQUAD":
+        state.equad_us = float(tokens[1])
+    elif head == "PHASE":
+        state.phase += float(tokens[1])
+    elif head == "SKIP":
+        state.skip = True
+    elif head == "NOSKIP":
+        state.skip = False
+    elif head == "END":
+        state.ended = True
+    elif head == "JUMP":
+        # toggle; tag subsequent TOAs with -tim_jump N (reference: JUMP
+        # blocks become maskParameter selections via flags)
+        if state.in_jump:
+            state.in_jump = False
+        else:
+            state.jump_counter += 1
+            state.in_jump = True
+
+
+def _common_flags(state, extra):
+    flags = dict(extra)
+    if state.time_offset_s != 0.0:
+        flags["to"] = repr(state.time_offset_s)
+    if state.in_jump:
+        flags["tim_jump"] = str(state.jump_counter)
+    if state.phase != 0.0:
+        flags["padd"] = repr(state.phase)
+    return flags
+
+
+def _apply_err_model(err_us, state):
+    return state.efac * np.hypot(err_us, state.equad_us)
+
+
+def _parse_tempo2_toa(tokens, state, rows, path, lineno):
+    # name freq sat err site [-flag value ...]
+    if len(tokens) < 5:
+        raise PintTpuError(f"{path}:{lineno}: bad Tempo2 TOA line")
+    name, freq, sat, err, site = tokens[:5]
+    flags = {}
+    rest = tokens[5:]
+    i = 0
+    while i < len(rest):
+        if not _is_flag_key(rest[i]):
+            raise PintTpuError(
+                f"{path}:{lineno}: expected -flag, got {rest[i]!r}"
+            )
+        key = rest[i][1:]
+        # next token is this flag's value unless it is itself a flag key
+        # (valueless/boolean flags; note values like '-1' are NOT keys)
+        if i + 1 < len(rest) and not _is_flag_key(rest[i + 1]):
+            flags[key] = rest[i + 1]
+            i += 2
+        else:
+            flags[key] = ""
+            i += 1
+    flags.setdefault("name", name)
+    _append_toa(rows, sat, freq, err, site, flags, state)
+
+
+def _parse_princeton_toa(raw, tokens, state, rows, path, lineno):
+    # Site code is column 0; remaining fields whitespace-separated:
+    # name... freq mjd err [dm-correction]
+    site = raw[0]
+    if site.isspace():
+        raise PintTpuError(
+            f"{path}:{lineno}: bad Princeton TOA line (no site code)"
+        )
+    # find numeric fields from the right: err, mjd, freq
+    if len(tokens) < 3:
+        raise PintTpuError(f"{path}:{lineno}: bad Princeton TOA line")
+    # tokens[0] starts with the site char; strip it
+    toks = list(tokens)
+    toks[0] = toks[0][1:]
+    if toks[0] == "":
+        toks = toks[1:]
+    numeric = []
+    for j, t in enumerate(toks):
+        try:
+            float(t)
+            numeric.append(j)
+        except ValueError:
+            pass
+    # Heuristic: the last three (or four, with DM corr) numeric tokens are
+    # freq, mjd, err(, ddm).  MJD is the token containing '.', > 20000.
+    mjd_idx = None
+    for j in numeric:
+        try:
+            v = float(toks[j])
+        except ValueError:
+            continue
+        if 20000 < v < 1000000 and "." in toks[j]:
+            mjd_idx = j
+    if mjd_idx is None or mjd_idx == 0 or mjd_idx + 1 >= len(toks):
+        raise PintTpuError(f"{path}:{lineno}: cannot locate MJD field")
+    freq = toks[mjd_idx - 1]
+    sat = toks[mjd_idx]
+    err = toks[mjd_idx + 1]
+    flags = {}
+    if toks[:mjd_idx - 1]:
+        flags["name"] = toks[0]
+    _append_toa(rows, sat, freq, err, site, flags, state)
+
+
+def _append_toa(rows, sat, freq, err, site, flags, state):
+    err_us = _apply_err_model(float(err), state)
+    rows["mjd"].append(sat)
+    rows["freq"].append(float(freq) if float(freq) != 0.0 else np.inf)
+    rows["err"].append(err_us)
+    rows["obs"].append(site)
+    rows["flags"].append(_common_flags(state, flags))
+
+
+def get_TOAs_from_tim(path) -> TOAs:
+    """Parse a tim file into a TOAs container (no ingest computations)."""
+    rows = read_tim_file(path)
+    toas = build_toas_from_rows(rows)
+    return toas
+
+
+def write_tim_file(path, toas: TOAs, name: str = "pint_tpu"):
+    """Write Tempo2-format tim file (reference: TOAs.write_TOA_file)."""
+    with open(path, "w") as f:
+        f.write("FORMAT 1\n")
+        mjds = toas.t.to_mjd_strings(ndigits=16)
+        for i in range(len(toas)):
+            flags = dict(toas.flags[i])
+            nm = flags.pop("name", name)
+            freq = toas.freq[i]
+            freq_s = "0.000000" if not np.isfinite(freq) else f"{freq:.6f}"
+            line = (
+                f"{nm} {freq_s} {mjds[i]} "
+                f"{toas.error_us[i]:.3f} {toas.obs[i]}"
+            )
+            for k, v in flags.items():
+                if k == "to":
+                    # TIME offsets were baked into the written MJD already
+                    continue
+                line += f" -{k} {v}" if v != "" else f" -{k}"
+            f.write(line + "\n")

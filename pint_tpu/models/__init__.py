@@ -1,0 +1,4 @@
+"""Timing-model layer: parameters, components, composition, builder.
+
+Reference parity: src/pint/models/ (SURVEY.md §2b).
+"""

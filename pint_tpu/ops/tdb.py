@@ -1,0 +1,63 @@
+"""TT -> TDB conversion (geocentric, analytic series).
+
+Reference parity: the reference gets TDB from astropy/ERFA (``dtdb``),
+which implements the full 787-term Fairhead & Bretagnon (1990) series;
+``toa.py::TOAs.compute_TDBs`` applies it per TOA.
+
+Here we implement the standard truncated series (USNO Circular 179 §2.3 /
+Explanatory Supplement form), accurate to a few microseconds over
+1600-2200.  That is ample for *internal consistency* (simulation and
+fitting share the same conversion, so residual round-trips hold to sub-ns)
+and for most timing applications; for sub-µs absolute parity with
+ephemeris time arguments, supply a DE440t-style TT-TDB ephemeris segment
+(see pint_tpu.ephemeris) which then overrides this series.
+
+The periodic terms are functions of TT Julian centuries from J2000.
+A topocentric correction (observer velocity dot geocentric position /
+c^2, <2.1 µs annual + <2 ns diurnal) is applied separately in the ingest
+pipeline where observatory geometry is known.
+
+Written against the array module ``xp`` (numpy or jax.numpy) so the same
+series serves host ingest (numpy, IEEE f64) and device kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# (amplitude_seconds, rate_rad_per_century, phase_rad, t_power)
+_TDB_TERMS = [
+    (0.001657, 628.3076, 6.2401, 0),
+    (0.000022, 575.3385, 4.2970, 0),
+    (0.000014, 1256.6152, 6.1969, 0),
+    (0.000005, 606.9777, 4.0212, 0),
+    (0.000005, 52.9691, 0.4444, 0),
+    (0.000002, 21.3299, 5.5431, 0),
+    (0.000010, 628.3076, 4.2490, 1),
+]
+
+
+def tdb_minus_tt(tt_centuries_j2000, xp=np):
+    """TDB - TT in seconds, given TT as Julian centuries from J2000.0.
+
+    Accuracy: few µs (truncated FB90). ``xp`` selects numpy or jax.numpy.
+    """
+    T = tt_centuries_j2000
+    out = None
+    for amp, rate, phase, power in _TDB_TERMS:
+        term = amp * xp.sin(rate * T + phase)
+        if power == 1:
+            term = term * T
+        out = term if out is None else out + term
+    return out
+
+
+def tdb_minus_tt_mjd(mjd_tt_int, sec_tt, xp=np):
+    """Same, from (integer MJD(TT), seconds-of-day float)."""
+    from pint_tpu.constants import MJD_J2000, SECS_PER_DAY
+
+    T = (
+        (xp.asarray(mjd_tt_int, dtype=xp.float64) - MJD_J2000)
+        + xp.asarray(sec_tt, dtype=xp.float64) / SECS_PER_DAY
+    ) / 36525.0
+    return tdb_minus_tt(T, xp=xp)

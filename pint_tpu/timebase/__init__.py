@@ -1,0 +1,12 @@
+"""Host-side exact time substrate.
+
+Reference parity: ``src/pint/pulsar_mjd.py`` (the "pulsar_mjd" Astropy Time
+format) and the astropy/ERFA time-scale machinery PINT leans on.  Here the
+host representation is ``TimeArray``: integer MJD + double-double
+seconds-of-day, in a tagged time scale, backed by numpy (host numpy is
+always IEEE f64, unlike the axon TPU device — see docs/precision.md).
+"""
+
+from pint_tpu.timebase.hostdd import HostDD  # noqa: F401
+from pint_tpu.timebase.times import TimeArray  # noqa: F401
+from pint_tpu.timebase.leapseconds import tai_minus_utc  # noqa: F401

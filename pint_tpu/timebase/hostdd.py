@@ -1,0 +1,192 @@
+"""Host (numpy) double-double arithmetic.
+
+Mirror of ``pint_tpu.ops.dd`` for load-time host code (TOA ingest, clock
+chains, TDB conversion).  Kept separate because host numpy guarantees IEEE
+f64 semantics on every machine, whereas the device path may run on TPUs
+whose f64 is emulated (non-IEEE) — ingest must not silently lose precision
+by being traced onto such a device.  The two implementations share
+algorithms and are cross-checked in tests/test_timebase.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SPLITTER = 134217729.0  # 2**27 + 1
+
+
+def _two_sum(a, b):
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _quick_two_sum(a, b):
+    s = a + b
+    err = b - (s - a)
+    return s, err
+
+
+def _two_prod(a, b):
+    p = a * b
+    t = _SPLITTER * a
+    ahi = t - (t - a)
+    alo = a - ahi
+    t = _SPLITTER * b
+    bhi = t - (t - b)
+    blo = b - bhi
+    err = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+    return p, err
+
+
+class HostDD:
+    """value = hi + lo, numpy arrays (or scalars)."""
+
+    __slots__ = ("hi", "lo")
+    __array_priority__ = 100  # beat ndarray in mixed binary ops
+
+    def __init__(self, hi, lo=None):
+        self.hi = np.asarray(hi, dtype=np.float64)
+        self.lo = (
+            np.zeros_like(self.hi)
+            if lo is None
+            else np.asarray(lo, dtype=np.float64)
+        )
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def from_sum(a, b) -> "HostDD":
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        return HostDD(*_two_sum(a, b))
+
+    @staticmethod
+    def from_prod(a, b) -> "HostDD":
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        return HostDD(*_two_prod(a, b))
+
+    @staticmethod
+    def from_string(s) -> "HostDD":
+        """Exact decimal-string parse; s may be a str or sequence of str."""
+        from decimal import Decimal, localcontext
+
+        def one(x):
+            with localcontext() as ctx:
+                ctx.prec = 50
+                d = Decimal(x)
+                hi = float(d)
+                lo = float(d - Decimal(hi))
+            return hi, lo
+
+        if isinstance(s, str):
+            hi, lo = one(s)
+            return HostDD(hi, lo)
+        pairs = [one(x) for x in s]
+        return HostDD(
+            np.array([p[0] for p in pairs]), np.array([p[1] for p in pairs])
+        )
+
+    def normalize(self) -> "HostDD":
+        return HostDD(*_quick_two_sum(self.hi, self.lo))
+
+    # -- arithmetic ------------------------------------------------------
+    def _coerce(self, other) -> "HostDD":
+        return other if isinstance(other, HostDD) else HostDD(other)
+
+    def __add__(self, other):
+        other = self._coerce(other)
+        s, e = _two_sum(self.hi, other.hi)
+        e = e + (self.lo + other.lo)
+        return HostDD(*_quick_two_sum(s, e))
+
+    __radd__ = __add__
+
+    def __neg__(self):
+        return HostDD(-self.hi, -self.lo)
+
+    def __sub__(self, other):
+        return self + (-self._coerce(other))
+
+    def __rsub__(self, other):
+        return (-self) + other
+
+    def __mul__(self, other):
+        other = self._coerce(other)
+        p, e = _two_prod(self.hi, other.hi)
+        e = e + (self.hi * other.lo + self.lo * other.hi)
+        return HostDD(*_quick_two_sum(p, e))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        other = self._coerce(other)
+        q1 = self.hi / other.hi
+        r = self - other * q1
+        q2 = r.hi / other.hi
+        r = r - other * q2
+        q3 = r.hi / other.hi
+        s, e = _quick_two_sum(q1, q2)
+        return HostDD(*_quick_two_sum(s, e + q3))
+
+    def __rtruediv__(self, other):
+        return self._coerce(other) / self
+
+    # -- comparisons -----------------------------------------------------
+    def __lt__(self, other):
+        d = (self - other).normalize()
+        return (d.hi < 0) | ((d.hi == 0) & (d.lo < 0))
+
+    def __gt__(self, other):
+        d = (self - other).normalize()
+        return (d.hi > 0) | ((d.hi == 0) & (d.lo > 0))
+
+    def __le__(self, other):
+        return ~(self > other)
+
+    def __ge__(self, other):
+        return ~(self < other)
+
+    def __eq__(self, other):
+        d = (self - other).normalize()
+        return (d.hi == 0) & (d.lo == 0)
+
+    def __ne__(self, other):
+        return ~(self == other)
+
+    __hash__ = None
+
+    # -- conversions -----------------------------------------------------
+    def to_float(self):
+        return self.hi + self.lo
+
+    def split_int_frac(self):
+        ihi = np.floor(self.hi + 0.5)
+        rem = HostDD(self.hi - ihi, self.lo).normalize()
+        ilo = np.floor(rem.hi + 0.5)
+        frac = HostDD(rem.hi - ilo, rem.lo).normalize()
+        carry = np.floor(frac.hi + frac.lo + 0.5)
+        return ihi + ilo + carry, (frac - carry).to_float()
+
+    # -- shape utilities -------------------------------------------------
+    @property
+    def shape(self):
+        return self.hi.shape
+
+    def __len__(self):
+        return len(self.hi)
+
+    def __getitem__(self, idx):
+        return HostDD(self.hi[idx], self.lo[idx])
+
+    def __repr__(self):
+        return f"HostDD(hi={self.hi!r}, lo={self.lo!r})"
+
+    def to_device(self):
+        """Convert to the JAX-side DD pytree (pint_tpu.ops.dd.DD)."""
+        import jax.numpy as jnp
+
+        from pint_tpu.ops.dd import DD
+
+        return DD(jnp.asarray(self.hi), jnp.asarray(self.lo))

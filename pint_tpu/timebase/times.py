@@ -1,0 +1,299 @@
+"""TimeArray: integer MJD + double-double seconds-of-day, scale-tagged.
+
+Reference parity: replaces astropy ``Time`` + the custom "pulsar_mjd"
+format (src/pint/pulsar_mjd.py) and the longdouble ``tdbld`` TOA column.
+Design: the day number is exact (int64); time-of-day is HostDD seconds
+(~1e-28 s resolution); conversions between UTC/TAI/TT/TDB/TCB/TCG keep
+everything in exact + DD arithmetic, so round-trips hold to ~1e-20 s.
+
+MJD string parsing supports both conventions:
+- ``format="pulsar_mjd"`` (Tempo/Princeton convention, the reference's
+  default for tim files): fractional day * 86400 s even on leap-second
+  days — i.e. the label is interpreted as if every UTC day had 86400 s.
+- ``format="mjd"``: true elapsed-seconds interpretation (a leap-second
+  day has 86401 s, so frac .99999 can land inside the leap second).
+Both agree except during/after a leap second within a day.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+import numpy as np
+
+from pint_tpu.constants import (
+    L_B,
+    L_G,
+    MJD_J2000,
+    SECS_PER_DAY,
+    TDB0,
+    TT_MINUS_TAI,
+)
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.ops.tdb import tdb_minus_tt
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.timebase.leapseconds import is_leap_second_day, tai_minus_utc
+
+SCALES = ("utc", "tai", "tt", "tdb", "tcg", "tcb", "ut1")
+
+# MJD(TT) of 1977-01-01T00:00:32.184 TT == JD 2443144.5003725, the shared
+# origin epoch of TT/TCG/TCB rate transforms (IAU 1991/2000/2006).
+_T77_MJD = 43144.0
+_T77_SEC = 32.184
+
+
+# The conversion graph is a chain (tcg hangs off tt):
+#   utc -- tai -- tt -- tdb -- tcb        tt -- tcg
+_CHAIN = ["utc", "tai", "tt", "tdb", "tcb"]
+
+
+def _route(src: str, dst: str) -> list[str]:
+    """Sequence of intermediate scales (excluding src) from src to dst."""
+    def chain_pos(s):
+        return _CHAIN.index(s if s != "tcg" else "tt")
+
+    route = []
+    if src == "tcg":
+        route.append("tt")
+        src = "tt"
+    i, j = chain_pos(src), _CHAIN.index(dst if dst != "tcg" else "tt")
+    if i != j:
+        step = 1 if j > i else -1
+        stop = j + step if j + step >= 0 else None
+        route += _CHAIN[i + step : stop : step]
+    if dst == "tcg":
+        route.append("tcg")
+    return route
+
+
+def _norm(mjd_int: np.ndarray, sec: HostDD, day_len=SECS_PER_DAY):
+    """Carry seconds into days so 0 <= sec < day_len (uniform-day scales)."""
+    carry = np.floor(sec.hi / day_len)
+    sec = sec - carry * day_len
+    # fix boundary cases from the f64 floor
+    neg = (sec.hi < 0)
+    sec = HostDD(
+        np.where(neg, sec.hi + day_len, sec.hi), sec.lo
+    ).normalize()
+    carry = carry - neg
+    over = sec.hi >= day_len
+    sec = HostDD(np.where(over, sec.hi - day_len, sec.hi), sec.lo).normalize()
+    carry = carry + over
+    return mjd_int + carry.astype(np.int64), sec
+
+
+class TimeArray:
+    """An array of epochs: ``mjd_int`` (int64 days) + ``sec`` (HostDD
+    seconds-of-day) in time scale ``scale``."""
+
+    __slots__ = ("mjd_int", "sec", "scale")
+
+    def __init__(self, mjd_int, sec: HostDD, scale: str = "utc"):
+        if scale not in SCALES:
+            raise PintTpuError(f"unknown time scale {scale!r}")
+        self.mjd_int = np.atleast_1d(np.asarray(mjd_int, dtype=np.int64))
+        sec = sec if isinstance(sec, HostDD) else HostDD(sec)
+        self.sec = HostDD(
+            np.broadcast_to(np.atleast_1d(sec.hi), self.mjd_int.shape).copy(),
+            np.broadcast_to(np.atleast_1d(sec.lo), self.mjd_int.shape).copy(),
+        )
+        self.scale = scale
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def from_mjd_strings(
+        strings: Union[str, Iterable[str]],
+        scale: str = "utc",
+        format: str = "pulsar_mjd",
+    ) -> "TimeArray":
+        """Exact parse of decimal MJD strings (tim-file convention)."""
+        if isinstance(strings, str):
+            strings = [strings]
+        ints, fracs = [], []
+        for s in strings:
+            s = s.strip()
+            neg = s.startswith("-")
+            if neg:
+                raise PintTpuError(f"negative MJD not supported: {s}")
+            ipart, _, fpart = s.partition(".")
+            ints.append(int(ipart))
+            fracs.append("0." + (fpart or "0"))
+        mjd_int = np.array(ints, dtype=np.int64)
+        frac = HostDD.from_string(fracs)
+        if format not in ("pulsar_mjd", "mjd"):
+            raise PintTpuError(f"unknown MJD format {format!r}")
+        if format == "mjd" and scale == "utc":
+            day_len = np.where(
+                is_leap_second_day(mjd_int), SECS_PER_DAY + 1, SECS_PER_DAY
+            )
+            sec = frac * day_len
+        else:  # pulsar_mjd convention, or uniform-day (non-UTC) scales
+            sec = frac * SECS_PER_DAY
+        return TimeArray(mjd_int, sec, scale)
+
+    @staticmethod
+    def from_mjd_float(mjd, scale: str = "tdb") -> "TimeArray":
+        """From float64 MJD (sub-µs resolution only — for tests/sim)."""
+        mjd = np.atleast_1d(np.asarray(mjd, dtype=np.float64))
+        mjd_int = np.floor(mjd).astype(np.int64)
+        sec = HostDD(mjd - mjd_int) * SECS_PER_DAY
+        return TimeArray(mjd_int, sec, scale)
+
+    @staticmethod
+    def from_mjd_two_part(day: int, sec_of_day, scale: str = "tdb"):
+        return TimeArray(day, HostDD(sec_of_day), scale)
+
+    # ------------------------------------------------------------------ #
+    def to_mjd_strings(self, ndigits: int = 19) -> list[str]:
+        """Decimal MJD strings (pulsar_mjd convention), round-trip safe."""
+        from decimal import Decimal, localcontext
+
+        out = []
+        for i in range(len(self.mjd_int)):
+            with localcontext() as ctx:
+                ctx.prec = 40
+                frac = (
+                    Decimal(float(self.sec.hi[i])) + Decimal(float(self.sec.lo[i]))
+                ) / Decimal(86400)
+                total = Decimal(int(self.mjd_int[i])) + frac
+                out.append(f"{total:.{ndigits}f}")
+        return out
+
+    def mjd_float(self) -> np.ndarray:
+        """Approximate float64 MJD (for plotting/selection, ~µs)."""
+        return self.mjd_int + self.sec.to_float() / SECS_PER_DAY
+
+    def mjd_dd(self) -> HostDD:
+        """MJD as HostDD days."""
+        return HostDD(self.mjd_int.astype(np.float64)) + self.sec / SECS_PER_DAY
+
+    def seconds_since(self, epoch_mjd_int, epoch_sec=0.0) -> HostDD:
+        """(self - epoch) in DD seconds; exact day-difference arithmetic."""
+        ddays = (self.mjd_int - np.int64(epoch_mjd_int)).astype(np.float64)
+        return HostDD.from_prod(ddays, SECS_PER_DAY) + (self.sec - epoch_sec)
+
+    # ------------------------------------------------------------------ #
+    # scale conversions
+    def to_scale(self, target: str) -> "TimeArray":
+        if target == self.scale:
+            return self
+        t = self
+        for hop in _route(self.scale, target):
+            t = t._one_hop(hop)
+        return t
+
+    def _one_hop(self, target: str) -> "TimeArray":
+        key = (self.scale, target)
+        if key == ("utc", "tai"):
+            return self._utc_to_tai()
+        if key == ("tai", "utc"):
+            return self._tai_to_utc()
+        if key == ("tai", "tt"):
+            return self._shift_const(TT_MINUS_TAI, "tt")
+        if key == ("tt", "tai"):
+            return self._shift_const(-TT_MINUS_TAI, "tai")
+        if key == ("tt", "tdb"):
+            return self._tt_to_tdb()
+        if key == ("tdb", "tt"):
+            return self._tdb_to_tt()
+        if key == ("tt", "tcg"):
+            return self._tt_to_tcg()
+        if key == ("tcg", "tt"):
+            return self._tcg_to_tt()
+        if key == ("tdb", "tcb"):
+            return self._tdb_to_tcb()
+        if key == ("tcb", "tdb"):
+            return self._tcb_to_tdb()
+        raise PintTpuError(f"no conversion {key}")
+
+    def _shift_const(self, dt_sec: float, scale: str) -> "TimeArray":
+        mjd, sec = _norm(self.mjd_int, self.sec + dt_sec)
+        return TimeArray(mjd, sec, scale)
+
+    def _utc_to_tai(self) -> "TimeArray":
+        off = tai_minus_utc(self.mjd_int).astype(np.float64)
+        mjd, sec = _norm(self.mjd_int, self.sec + off)
+        return TimeArray(mjd, sec, "tai")
+
+    def _tai_to_utc(self) -> "TimeArray":
+        # iterate: offset depends on the UTC day
+        guess = self.mjd_int
+        for _ in range(2):
+            off = tai_minus_utc(guess).astype(np.float64)
+            mjd, sec = _norm(self.mjd_int, self.sec - off)
+            guess = mjd
+        # note: instants inside a leap second map onto sec in [86400,86401)
+        # of the previous day; we renormalize to day boundaries, accepting
+        # the standard ambiguity (cf. pulsar_mjd convention).
+        return TimeArray(mjd, sec, "utc")
+
+    def _tt_centuries(self) -> np.ndarray:
+        return (
+            (self.mjd_int - MJD_J2000) + self.sec.to_float() / SECS_PER_DAY
+        ) / 36525.0
+
+    def _tt_to_tdb(self) -> "TimeArray":
+        d = tdb_minus_tt(self._tt_centuries(), xp=np)
+        mjd, sec = _norm(self.mjd_int, self.sec + d)
+        return TimeArray(mjd, sec, "tdb")
+
+    def _tdb_to_tt(self) -> "TimeArray":
+        # TDB-TT argument uses TT; one fixed-point pass is plenty (the
+        # series slope is ~2e-8 s/s)
+        d = tdb_minus_tt(self._tt_centuries(), xp=np)
+        mjd, sec = _norm(self.mjd_int, self.sec - d)
+        t1 = TimeArray(mjd, sec, "tt")
+        d2 = tdb_minus_tt(t1._tt_centuries(), xp=np)
+        mjd, sec = _norm(self.mjd_int, self.sec - d2)
+        return TimeArray(mjd, sec, "tt")
+
+    def _elapsed_since_t77(self) -> HostDD:
+        return self.seconds_since(int(_T77_MJD), _T77_SEC)
+
+    def _tt_to_tcg(self) -> "TimeArray":
+        # TCG - TT = LG/(1-LG) * (TT - T77)
+        rate = L_G / (1.0 - L_G)
+        d = self._elapsed_since_t77() * rate
+        mjd, sec = _norm(self.mjd_int, self.sec + d)
+        return TimeArray(mjd, sec, "tcg")
+
+    def _tcg_to_tt(self) -> "TimeArray":
+        d = self._elapsed_since_t77() * L_G
+        mjd, sec = _norm(self.mjd_int, self.sec - d)
+        return TimeArray(mjd, sec, "tt")
+
+    def _tdb_to_tcb(self) -> "TimeArray":
+        # TDB = TCB - LB*(TCB - T77) + TDB0  =>  invert
+        d = (self._elapsed_since_t77() - TDB0) * (L_B / (1.0 - L_B))
+        mjd, sec = _norm(self.mjd_int, self.sec + d - TDB0)
+        return TimeArray(mjd, sec, "tcb")
+
+    def _tcb_to_tdb(self) -> "TimeArray":
+        d = self._elapsed_since_t77() * L_B
+        mjd, sec = _norm(self.mjd_int, self.sec - d + TDB0)
+        return TimeArray(mjd, sec, "tdb")
+
+    # ------------------------------------------------------------------ #
+    @property
+    def shape(self):
+        return self.mjd_int.shape
+
+    def __len__(self):
+        return len(self.mjd_int)
+
+    def __getitem__(self, idx) -> "TimeArray":
+        return TimeArray(self.mjd_int[idx], self.sec[idx], self.scale)
+
+    def __repr__(self):
+        n = len(self.mjd_int)
+        head = ", ".join(self.to_mjd_strings(10)[: min(n, 3)])
+        return f"TimeArray<{self.scale}>[{n}]({head}{'...' if n > 3 else ''})"
+
+    def add_seconds(self, s) -> "TimeArray":
+        """Shift by s seconds (float/array/HostDD), carrying days."""
+        mjd, sec = _norm(self.mjd_int, self.sec + s)
+        return TimeArray(mjd, sec, self.scale)
+
+    def sort_index(self) -> np.ndarray:
+        # lexsort: primary key last; exact ordering even at sub-ns spacing
+        return np.lexsort((self.sec.lo, self.sec.hi, self.mjd_int))

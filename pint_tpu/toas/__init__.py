@@ -1,0 +1,3 @@
+"""TOA layer: container, ingest pipeline, selection."""
+
+from pint_tpu.toas.toas import TOAs  # noqa: F401

@@ -1,0 +1,128 @@
+"""TOAs container: the host-side table of arrival times + metadata.
+
+Reference parity: src/pint/toa.py::TOAs (astropy-Table-backed; columns
+mjd, mjd_float, error, freq, obs, flags, clkcorr, tdb, tdbld,
+ssb_obs_pos/vel, obs_sun_pos...).  Here: plain numpy arrays + a
+``TimeArray`` for arrival times, with the ingest pipeline
+(pint_tpu.toas.ingest) filling the computed columns; ``to_bundle()``
+exports the device-resident array bundle consumed by compiled kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.timebase.times import TimeArray
+
+
+class TOAs:
+    """Table of TOAs.
+
+    Core columns (always present):
+      t         TimeArray (UTC at observatory, unless site '@'/bary)
+      freq      observing frequency, MHz (np.inf for infinite-frequency)
+      error_us  TOA uncertainty in microseconds
+      obs       observatory codes (list[str])
+      flags     list[dict] per-TOA tim flags
+    Computed columns (after ingest):
+      clock_corr_s   applied clock correction (seconds)
+      t_tdb          TimeArray in TDB at the observatory (time scale only)
+      ssb_obs_pos/vel   m, m/s GCRS->SSB observatory state (n,3)
+      obs_sun_pos       m, obs->Sun vector (n,3)
+      obs_planet_pos    dict body -> (n,3) m
+    """
+
+    def __init__(self, t: TimeArray, freq, error_us, obs, flags=None):
+        n = len(t)
+        self.t = t
+        self.freq = np.asarray(freq, dtype=np.float64)
+        self.error_us = np.asarray(error_us, dtype=np.float64)
+        self.obs = list(obs)
+        self.flags = flags if flags is not None else [dict() for _ in range(n)]
+        assert len(self.freq) == n and len(self.error_us) == n
+        assert len(self.obs) == n and len(self.flags) == n
+        # computed columns
+        self.clock_corr_s: Optional[np.ndarray] = None
+        self.t_tdb: Optional[TimeArray] = None
+        self.ssb_obs_pos: Optional[np.ndarray] = None
+        self.ssb_obs_vel: Optional[np.ndarray] = None
+        self.obs_sun_pos: Optional[np.ndarray] = None
+        self.obs_planet_pos: dict = {}
+        self.ephem: Optional[str] = None
+        self.clock_info: dict = {}
+
+    # ------------------------------------------------------------------ #
+    def __len__(self):
+        return len(self.t)
+
+    def __getitem__(self, idx) -> "TOAs":
+        if isinstance(idx, (int, np.integer)):
+            idx = slice(idx, idx + 1)
+        sel = np.arange(len(self))[idx]
+        out = TOAs(
+            self.t[sel],
+            self.freq[sel],
+            self.error_us[sel],
+            [self.obs[i] for i in sel],
+            [self.flags[i] for i in sel],
+        )
+        for col in ("clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+            v = getattr(self, col)
+            if v is not None:
+                setattr(out, col, v[sel])
+        if self.t_tdb is not None:
+            out.t_tdb = self.t_tdb[sel]
+        out.obs_planet_pos = {k: v[sel] for k, v in self.obs_planet_pos.items()}
+        out.ephem = self.ephem
+        return out
+
+    def mjd_float(self) -> np.ndarray:
+        return self.t.mjd_float()
+
+    def sort(self) -> np.ndarray:
+        """Sort in place by time; returns the permutation applied."""
+        order = self.t.sort_index()
+        self.t = self.t[order]
+        self.freq = self.freq[order]
+        self.error_us = self.error_us[order]
+        self.obs = [self.obs[i] for i in order]
+        self.flags = [self.flags[i] for i in order]
+        for col in ("clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+            v = getattr(self, col)
+            if v is not None:
+                setattr(self, col, v[order])
+        if self.t_tdb is not None:
+            self.t_tdb = self.t_tdb[order]
+        self.obs_planet_pos = {
+            k: v[order] for k, v in self.obs_planet_pos.items()
+        }
+        return order
+
+    def get_flag_value(self, flag: str, default="") -> list:
+        return [f.get(flag, default) for f in self.flags]
+
+    def get_pulse_numbers(self) -> Optional[np.ndarray]:
+        """Per-TOA pulse numbers from -pn flags, if all present."""
+        pns = self.get_flag_value("pn", None)
+        if any(p is None for p in pns):
+            return None
+        return np.array([float(p) for p in pns])
+
+    @property
+    def ntoas(self):
+        return len(self)
+
+    def first_mjd(self) -> float:
+        return float(np.min(self.mjd_float()))
+
+    def last_mjd(self) -> float:
+        return float(np.max(self.mjd_float()))
+
+    def __repr__(self):
+        return (
+            f"TOAs(n={len(self)}, mjd {self.first_mjd():.1f}-"
+            f"{self.last_mjd():.1f}, obs {sorted(set(self.obs))})"
+        )

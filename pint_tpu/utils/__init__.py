@@ -1,0 +1,8 @@
+"""Host-side utilities (unit policy, angles, misc helpers)."""
+
+from pint_tpu.utils.angles import (  # noqa: F401
+    parse_angle_hms,
+    parse_angle_dms,
+    format_angle_hms,
+    format_angle_dms,
+)

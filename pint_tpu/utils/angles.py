@@ -1,0 +1,73 @@
+"""Sexagesimal angle parsing/formatting (par-file RAJ/DECJ convention).
+
+Reference parity: astropy Angle parsing used by AngleParameter
+(src/pint/models/parameter.py::AngleParameter).  RAJ is hours:min:sec,
+DECJ is deg:min:sec; internal representation is radians (f64 — 1e-16 rad
+~ 0.6 m projected, far below timing noise; sub-ulp sky positions are not
+physically meaningful).
+"""
+
+from __future__ import annotations
+
+import math
+
+from pint_tpu.constants import DEG_TO_RAD, HOUR_TO_RAD
+from pint_tpu.exceptions import PintTpuError
+
+
+def _parse_sexagesimal(s: str) -> tuple[float, int]:
+    """-> (value in leading units, sign)."""
+    s = s.strip()
+    sign = 1
+    if s.startswith("-"):
+        sign, s = -1, s[1:]
+    elif s.startswith("+"):
+        s = s[1:]
+    parts = s.split(":")
+    if len(parts) > 3:
+        raise PintTpuError(f"bad sexagesimal angle {s!r}")
+    val = 0.0
+    for i, p in enumerate(parts):
+        if p == "":
+            raise PintTpuError(f"bad sexagesimal angle {s!r}")
+        val += float(p) / 60.0**i
+    return val, sign
+
+
+def parse_angle_hms(s: str) -> float:
+    """'HH:MM:SS.sss' (or decimal hours) -> radians."""
+    val, sign = _parse_sexagesimal(s)
+    return sign * val * HOUR_TO_RAD
+
+
+def parse_angle_dms(s: str) -> float:
+    """'+DD:MM:SS.sss' (or decimal degrees) -> radians."""
+    val, sign = _parse_sexagesimal(s)
+    return sign * val * DEG_TO_RAD
+
+
+def _format_sexagesimal(val: float, ndp: int) -> str:
+    sign = "-" if val < 0 else ""
+    val = abs(val)
+    d = int(val)
+    rem = (val - d) * 60.0
+    m = int(rem)
+    s = (rem - m) * 60.0
+    # guard against 59.99999 -> 60 rollover
+    s_str = f"{s:0{3 + ndp}.{ndp}f}"
+    if float(s_str) >= 60.0:
+        s_str = f"{0.0:0{3 + ndp}.{ndp}f}"
+        m += 1
+    if m >= 60:
+        m -= 60
+        d += 1
+    return f"{sign}{d:02d}:{m:02d}:{s_str}"
+
+
+def format_angle_hms(rad: float, ndp: int = 11) -> str:
+    return _format_sexagesimal(rad / HOUR_TO_RAD, ndp)
+
+
+def format_angle_dms(rad: float, ndp: int = 10) -> str:
+    out = _format_sexagesimal(rad / DEG_TO_RAD, ndp)
+    return out if out.startswith("-") else "+" + out

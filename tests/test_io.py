@@ -1,0 +1,199 @@
+"""IO-layer tests: par parsing, tim parsing (Tempo2 + commands), clock
+files, parameter zoo round-trips.  Reference test models:
+test_parfile_writing.py, test_toa*.py, test_clockcorr.py."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import ClockCorrectionOutOfRange, PintTpuError
+from pint_tpu.io.clock import ClockFile
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.io.tim import get_TOAs_from_tim, write_tim_file
+from pint_tpu.models.parameter import (
+    AngleParameter,
+    MJDParameter,
+    boolParameter,
+    floatParameter,
+    maskParameter,
+    split_prefixed_name,
+)
+
+PAR = """
+PSR              J1744-1134
+RAJ      17:44:29.403209  1  0.00000085
+DECJ    -11:34:54.68067   1  0.00007
+F0       245.4261196898081  1  5e-13
+F1      -5.38156D-16      1  2e-21
+PEPOCH   55000
+DM       3.1380  1  0.0002
+# a comment
+C an old-style comment
+JUMP -f L-wide 0.000052 1
+JUMP mjd 55000 56000 0.0001
+UNITS TDB
+"""
+
+TIM = """FORMAT 1
+# comment
+unk 1400.000000 55000.123456789012345 1.500 gbt -f L-wide -pn 0
+unk 1440.000000 55100.223456789012345 2.000 gbt -f L-wide
+TIME 0.5
+unk 428.000000 55200.323456789012345 3.000 ao -f 430
+SKIP
+unk 999.0 55999.9 9.9 gbt
+NOSKIP
+unk 0.0 55300.423456789012345 1.000 @
+END
+ignored after end
+"""
+
+
+def test_parse_parfile(tmp_path):
+    d = parse_parfile(PAR)
+    assert d["PSR"] == [["J1744-1134"]]
+    assert d["F0"][0][0] == "245.4261196898081"
+    assert len(d["JUMP"]) == 2
+    assert "C" not in d and "#" not in d
+    # file path input
+    p = tmp_path / "test.par"
+    p.write_text(PAR)
+    d2 = parse_parfile(str(p))
+    assert d2 == d
+
+
+def test_float_parameter_dd_precision():
+    p = floatParameter("F0", units="Hz", long_double=True)
+    p.set_from_tokens(["245.4261196898081", "1", "5e-13"])
+    assert not p.frozen
+    assert p.uncertainty == 5e-13
+    # value parsed exactly: re-format must round-trip all digits
+    s = p._format_value()
+    assert s.startswith("245.4261196898081")
+    # Fortran exponent
+    p2 = floatParameter("F1", units="Hz/s")
+    p2.set_from_tokens(["-5.38156D-16"])
+    assert p2.value == -5.38156e-16
+
+
+def test_mjd_parameter():
+    p = MJDParameter("PEPOCH")
+    p.set_from_tokens(["55000.000000123456789"])
+    day, sec = p.internal()
+    assert day == 55000
+    np.testing.assert_allclose(
+        float(sec.to_float()), 0.000000123456789 * 86400, rtol=1e-12
+    )
+
+
+def test_angle_parameter_roundtrip():
+    raj = AngleParameter("RAJ", units="H:M:S")
+    raj.set_from_tokens(["17:44:29.403209", "1", "0.00000085"])
+    # 17h44m29.4s in radians
+    expect = (17 + 44 / 60 + 29.403209 / 3600) * np.pi / 12
+    np.testing.assert_allclose(raj.value, expect, rtol=1e-15)
+    assert raj._format_value().startswith("17:44:29.403209")
+    decj = AngleParameter("DECJ", units="D:M:S")
+    decj.set_from_tokens(["-11:34:54.68067"])
+    assert decj.value < 0
+    assert decj._format_value().startswith("-11:34:54.68067")
+    # uncertainty conversion: H:M:S uncertainties are seconds of time
+    np.testing.assert_allclose(
+        raj.internal_uncertainty(), 0.00000085 * np.pi / (12 * 3600), rtol=1e-12
+    )
+
+
+def test_mask_parameter():
+    j = maskParameter("JUMP1")
+    j.set_from_tokens(["-f", "L-wide", "0.000052", "1"])
+    assert j.key == "-f" and j.key_value == ["L-wide"]
+    assert j.value == 0.000052 and not j.frozen
+    j2 = maskParameter("JUMP2")
+    j2.set_from_tokens(["mjd", "55000", "56000", "0.0001"])
+    assert j2.key == "mjd"
+
+    class FakeTOAs:
+        def __init__(self):
+            self.flags = [{"f": "L-wide"}, {"f": "430"}, {"f": "L-wide"}]
+            self.freq = np.array([1400.0, 428.0, 1440.0])
+
+        def __len__(self):
+            return 3
+
+        def mjd_float(self):
+            return np.array([54000.0, 55500.0, 57000.0])
+
+    ft = FakeTOAs()
+    np.testing.assert_array_equal(j.select(ft), [True, False, True])
+    np.testing.assert_array_equal(j2.select(ft), [False, True, False])
+
+
+def test_split_prefixed_name():
+    assert split_prefixed_name("DMX_0017") == ("DMX_", "0017", 17)
+    assert split_prefixed_name("F12") == ("F", "12", 12)
+    assert split_prefixed_name("GLF0_2") == ("GLF0_", "2", 2)
+    with pytest.raises(Exception):
+        split_prefixed_name("RAJ")
+
+
+def test_bool_parameter():
+    b = boolParameter("PLANET_SHAPIRO")
+    for s, v in [("Y", True), ("N", False), ("1", True), ("0", False)]:
+        b.set_from_tokens([s])
+        assert b.value is v
+
+
+def test_tim_parsing(tmp_path):
+    p = tmp_path / "test.tim"
+    p.write_text(TIM)
+    toas = get_TOAs_from_tim(p)
+    assert len(toas) == 4  # SKIP block and after-END excluded
+    assert toas.obs == ["gbt", "gbt", "ao", "@"]
+    np.testing.assert_allclose(toas.error_us, [1.5, 2.0, 3.0, 1.0])
+    assert toas.flags[0]["f"] == "L-wide"
+    assert toas.flags[0]["pn"] == "0"
+    # TIME command recorded on subsequent TOAs
+    assert "to" not in toas.flags[0]
+    assert toas.flags[2]["to"] == repr(0.5)
+    # infinite frequency for 0.0
+    assert np.isinf(toas.freq[3])
+    # exact sub-ns MJD parse: .123456789012345 day
+    sec = toas.t.sec.to_float()[0]
+    np.testing.assert_allclose(sec, 0.123456789012345 * 86400, rtol=1e-15)
+
+
+def test_tim_roundtrip(tmp_path):
+    p = tmp_path / "a.tim"
+    p.write_text(TIM)
+    toas = get_TOAs_from_tim(p)
+    out = tmp_path / "b.tim"
+    write_tim_file(out, toas)
+    toas2 = get_TOAs_from_tim(out)
+    assert len(toas2) == len(toas)
+    assert toas2.obs == toas.obs
+    d = (toas2.t.sec - toas.t.sec).to_float()
+    np.testing.assert_allclose(d, 0.0, atol=1e-9)  # 16-digit write
+    np.testing.assert_array_equal(toas2.t.mjd_int, toas.t.mjd_int)
+    assert toas2.flags[0]["f"] == "L-wide"
+
+
+def test_clock_file(tmp_path):
+    clk = tmp_path / "gbt.clk"
+    clk.write_text(
+        "# UTC(gbt) UTC\n50000.0 1.0e-6\n51000.0 3.0e-6\n52000.0 2.0e-6\n"
+    )
+    cf = ClockFile.from_tempo2(clk, name="gbt")
+    np.testing.assert_allclose(cf.evaluate([50500.0]), 2.0e-6)
+    np.testing.assert_allclose(cf.evaluate([51500.0]), 2.5e-6)
+    with pytest.raises(ClockCorrectionOutOfRange):
+        cf.evaluate([49000.0], limits="error")
+    with pytest.warns(UserWarning):
+        cf.evaluate([53000.0], limits="warn")
+    # composition
+    cf2 = ClockFile(np.array([50000.0, 52000.0]), np.array([1e-6, 1e-6]))
+    tot = cf + cf2
+    np.testing.assert_allclose(tot.evaluate([51000.0]), 4.0e-6)
+    # tempo format (microseconds)
+    tclk = tmp_path / "time_gbt.dat"
+    tclk.write_text("  50000.0  1.5\n  51000.0  2.5\n")
+    cft = ClockFile.from_tempo(tclk)
+    np.testing.assert_allclose(cft.evaluate([50500.0]), 2.0e-6)

@@ -1,0 +1,139 @@
+"""Host time substrate tests: exact MJD round-trips, leap seconds, scale
+chains.  Reference parity target: src/pint/pulsar_mjd.py + astropy Time
+behavior (tests/test_precision.py-style hypothesis round-trips)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.timebase import HostDD, TimeArray, tai_minus_utc
+from pint_tpu.timebase.leapseconds import (
+    calendar_to_mjd,
+    is_leap_second_day,
+    leap_second_table,
+)
+
+
+def test_calendar_to_mjd_anchors():
+    # independent public anchors
+    assert calendar_to_mjd(1858, 11, 17) == 0
+    assert calendar_to_mjd(1970, 1, 1) == 40587
+    assert calendar_to_mjd(2000, 1, 1) == 51544
+    assert calendar_to_mjd(1972, 1, 1) == 41317
+    assert calendar_to_mjd(2017, 1, 1) == 57754
+
+
+def test_leap_second_table():
+    mjds, offs = leap_second_table()
+    assert len(mjds) == 28
+    assert offs[0] == 10 and offs[-1] == 37
+    assert np.all(np.diff(offs) == 1)
+    assert tai_minus_utc(41317) == 10
+    assert tai_minus_utc(57754) == 37
+    assert tai_minus_utc(60000) == 37
+    # day before 2017-01-01 step had 86401 s
+    assert is_leap_second_day(57753)
+    assert not is_leap_second_day(57752)
+    with pytest.raises(PintTpuError):
+        tai_minus_utc(41000)
+
+
+def test_hostdd_matches_device_dd():
+    """Host numpy DD and device JAX DD must agree bit-for-bit on CPU."""
+    from pint_tpu.ops.dd import DD
+
+    rng = np.random.default_rng(0)
+    a = rng.uniform(-1e9, 1e9, 50)
+    b = rng.uniform(-1e3, 1e3, 50)
+    h = (HostDD(a) / HostDD(b) + HostDD(b) * 3.7) - 1.25
+    d = (DD.from_float(a) / DD.from_float(b) + DD.from_float(b) * 3.7) - 1.25
+    np.testing.assert_array_equal(h.hi, np.asarray(d.hi))
+    np.testing.assert_array_equal(h.lo, np.asarray(d.lo))
+
+
+mjd_int_st = st.integers(min_value=41317, max_value=69000)
+frac_digits_st = st.text(alphabet="0123456789", min_size=1, max_size=18)
+
+
+@given(mjd_int_st, frac_digits_st)
+@settings(max_examples=100, deadline=None)
+def test_mjd_string_roundtrip(day, frac):
+    s = f"{day}.{frac}"
+    t = TimeArray.from_mjd_strings([s], scale="utc")
+    back = t.to_mjd_strings(ndigits=19)[0]
+    # compare as decimals (trailing zeros allowed)
+    from decimal import Decimal
+
+    assert abs(Decimal(back) - Decimal(s)) < Decimal("1e-19") * 86400
+
+
+@given(mjd_int_st, st.floats(min_value=0.0, max_value=86399.999))
+@settings(max_examples=80, deadline=None)
+def test_scale_chain_roundtrip(day, sec):
+    t = TimeArray(np.array([day]), HostDD(np.array([sec])), "utc")
+    for target in ["tai", "tt", "tdb", "tcb", "tcg"]:
+        back = t.to_scale(target).to_scale("utc")
+        assert back.scale == "utc"
+        d_day = back.mjd_int - t.mjd_int
+        d_sec = (back.sec - t.sec).to_float() + d_day * 86400.0
+        assert abs(float(d_sec[0])) < 1e-13, (target, float(d_sec[0]))
+
+
+def test_known_offsets_2020():
+    """TT-UTC = 69.184 s after 2017; TDB within 2 ms of TT."""
+    t = TimeArray.from_mjd_strings(["59000.0"], scale="utc")
+    tt = t.to_scale("tt")
+    dt = tt.seconds_since(59000) - t.seconds_since(59000)
+    np.testing.assert_allclose(dt.to_float(), 69.184, atol=1e-12)
+    tdb = t.to_scale("tdb")
+    d_tdb = (tdb.seconds_since(59000) - tt.seconds_since(59000)).to_float()
+    assert abs(float(d_tdb[0])) < 2e-3
+
+
+def test_utc_day_crossing():
+    """Conversions that push sec past midnight must carry the day."""
+    t = TimeArray(np.array([57754]), HostDD(np.array([86399.0])), "utc")
+    tai = t.to_scale("tai")
+    assert tai.mjd_int[0] == 57755
+    np.testing.assert_allclose(tai.sec.to_float()[0], 36.0, atol=1e-12)
+
+
+def test_leap_day_formats_differ():
+    # 57753.999999 in "mjd" format scales by 86401; pulsar_mjd by 86400
+    s = "57753.99999"
+    a = TimeArray.from_mjd_strings([s], scale="utc", format="pulsar_mjd")
+    b = TimeArray.from_mjd_strings([s], scale="utc", format="mjd")
+    diff = (b.sec - a.sec).to_float()[0]
+    np.testing.assert_allclose(diff, 0.99999, atol=1e-9)
+    # on a normal day they agree
+    s = "57000.25"
+    a = TimeArray.from_mjd_strings([s], format="pulsar_mjd")
+    b = TimeArray.from_mjd_strings([s], format="mjd")
+    assert float((b.sec - a.sec).to_float()[0]) == 0.0
+
+
+def test_seconds_since_precision():
+    """dt over 20 years carries ns structure exactly."""
+    t = TimeArray.from_mjd_strings(
+        ["51544.000000000000000001", "58849.000000000000000002"], scale="tdb"
+    )
+    dt = t.seconds_since(51544)
+    span_days = 58849 - 51544
+    expect = span_days * 86400.0
+    got = dt[1] - HostDD(expect)
+    # TOA[1]'s 2e-18-day fractional offset survives: 2e-18 MJD ~ 1.7e-13 s
+    np.testing.assert_allclose(got.to_float(), 2e-18 * 86400, rtol=1e-6)
+    np.testing.assert_allclose(dt.to_float()[0], 1e-18 * 86400, rtol=1e-6)
+
+
+def test_tdb_tcb_rates():
+    """TCB drifts vs TDB at L_B ~ 1.55e-8 s/s."""
+    t0 = TimeArray(np.array([43144]), HostDD(np.array([32.184])), "tdb")
+    t1 = TimeArray(np.array([43144 + 36525]), HostDD(np.array([32.184])), "tdb")
+    d0 = (t0.to_scale("tcb").seconds_since(43144) - t0.seconds_since(43144)).to_float()
+    d1 = (t1.to_scale("tcb").seconds_since(43144) - t1.seconds_since(43144)).to_float()
+    # at T77 the offset is -TDB0 ~ +6.55e-5 s
+    np.testing.assert_allclose(d0, 6.55e-5, rtol=1e-6)
+    rate = (d1 - d0) / (36525 * 86400.0)
+    np.testing.assert_allclose(rate, 1.550519768e-8, rtol=1e-6)
