@@ -1,0 +1,17 @@
+"""Fitters: WLS / GLS / downhill / wideband over compiled kernels.
+
+Reference parity: src/pint/fitter.py class hierarchy (SURVEY.md §3.3).
+"""
+
+from pint_tpu.fitting.wls import WLSFitter  # noqa: F401
+
+
+def auto_fitter(toas, model, **kw):
+    """Pick a fitter by model content (reference: Fitter.auto)."""
+    if any(
+        c.introduces_correlated_errors for c in model.noise_components
+    ):
+        from pint_tpu.fitting.gls import GLSFitter
+
+        return GLSFitter(toas, model, **kw)
+    return WLSFitter(toas, model, **kw)

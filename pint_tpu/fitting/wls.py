@@ -1,0 +1,133 @@
+"""Weighted-least-squares fitter (uncorrelated errors).
+
+Reference parity: src/pint/fitter.py::WLSFitter.fit_toas — iterate:
+residuals, design matrix (plus implicit offset column), column-normalized
+SVD solve, step, chi2.  Differences by design:
+- the kernel is exact in the delta vector x, so iterations never
+  recompile and 'downhill' step-halving operates on the same kernels;
+- the SVD runs on device (jnp.linalg), sharded when the TOA axis is
+  sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import Residuals
+from pint_tpu.toas.toas import TOAs
+
+
+def _wls_step(r, M, w, threshold=None):
+    """One WLS normal-equation solve via column-scaled SVD.
+
+    r (n,), M (n,p) = d resid/d x, w (n,) weights -> (delta_x (p,),
+    covariance (p,p)).  Mirrors the reference's conditioning trick:
+    scale columns to unit norm before SVD (fitter.py::WLSFitter).
+    """
+    sw = jnp.sqrt(w)
+    A = M * sw[:, None]
+    b = -r * sw
+    norm = jnp.sqrt(jnp.sum(A * A, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    A = A / norm[None, :]
+    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+    if threshold is None:
+        threshold = jnp.finfo(jnp.float64).eps * max(A.shape)
+    bad = s < threshold * s[0]
+    s_inv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, s))
+    dx = (Vt.T * s_inv[None, :]) @ (U.T @ b) / norm
+    cov = (Vt.T * s_inv[None, :] ** 2) @ Vt / jnp.outer(norm, norm)
+    return dx, cov, jnp.sum(bad)
+
+
+class WLSFitter:
+    def __init__(self, toas: TOAs, model: TimingModel):
+        self.toas = toas
+        self.model = model
+        self.cm = model.compile(toas)
+        self.resids_init = Residuals(toas, model, compiled=self.cm)
+        self.resids: Residuals = self.resids_init
+        self.converged = False
+        self.parameter_covariance_matrix: np.ndarray | None = None
+
+    # residuals WITHOUT mean subtraction; the offset column absorbs the
+    # mean exactly as the reference's "Offset" design-matrix column does.
+    def _r(self, x):
+        return self.cm.time_residuals(x, subtract_mean=False)
+
+    def _design_with_offset(self, x):
+        M = self.cm.design_matrix(x)
+        ones = jnp.ones((self.cm.bundle.ntoa, 1))
+        return jnp.concatenate([ones, M], axis=1)
+
+    def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
+        w = jnp.asarray(1.0 / (self.toas.error_us * 1e-6) ** 2)
+
+        @jax.jit
+        def step(x):
+            r = self._r(x)
+            M = self._design_with_offset(x)
+            dx, cov, nbad = _wls_step(r, M, w)
+            return dx, cov, nbad
+
+        @jax.jit
+        def chi2_of(x):
+            return self.cm.chi2(x)
+
+        x = self.cm.x0()
+        chi2 = float(chi2_of(x))
+        cov = None
+        for it in range(maxiter):
+            dx, cov, nbad = step(x)
+            if int(nbad):
+                import warnings
+
+                warnings.warn(
+                    f"{int(nbad)} degenerate design-matrix directions "
+                    "zeroed in SVD solve",
+                    DegeneracyWarning,
+                )
+            x_new = x + dx[1:]  # dx[0] is the offset column
+            chi2_new = float(chi2_of(x_new))
+            if not np.isfinite(chi2_new):
+                raise ConvergenceFailure("non-finite chi2 during WLS fit")
+            x, last_chi2, chi2 = x_new, chi2, chi2_new
+            if abs(last_chi2 - chi2) < tol_chi2 * max(chi2, 1.0):
+                self.converged = True
+                break
+
+        # parameter covariance (offset row/col dropped, matching the
+        # reference's parameter_covariance_matrix without Offset)
+        cov = np.asarray(cov)
+        sigmas = np.sqrt(np.diag(cov))[1:]
+        self.parameter_covariance_matrix = cov
+        self.cm.commit(np.asarray(x), uncertainties=sigmas)
+        self.resids = Residuals(
+            self.toas, self.model, compiled=self.cm
+        )
+        self.model.top_params["CHI2"].value = chi2
+        return chi2
+
+    def print_summary(self) -> str:
+        lines = [
+            f"Fitted model using WLS with {len(self.cm.free_names)} free "
+            f"parameters, {len(self.toas)} TOAs",
+            f"chi2 = {self.resids.chi2:.4f}  dof = {self.resids.dof}  "
+            f"reduced chi2 = {self.resids.reduced_chi2:.4f}",
+            f"weighted RMS = {self.resids.rms_weighted() * 1e6:.4f} us",
+            "",
+            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
+        ]
+        for n in self.cm.free_names:
+            p = self.model.params[n]
+            lines.append(
+                f"{n:<12}{p._format_value():>25}"
+                f"{p.uncertainty if p.uncertainty is not None else float('nan'):>15.3e}"
+            )
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -100,7 +100,11 @@ class ClockFile:
                 raise ClockCorrectionOutOfRange(msg)
             if limits == "warn":
                 warnings.warn(msg)
-        return np.interp(mjd, self.mjd, self.corr_s)
+        out = np.interp(mjd, self.mjd, self.corr_s)
+        if not self.valid_beyond_ends:
+            # extrapolate-zero beyond the tabulated span (module policy)
+            out = np.where(out_of_range, 0.0, out)
+        return out
 
     @property
     def first_mjd(self):

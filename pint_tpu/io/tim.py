@@ -45,6 +45,10 @@ class _ParseState:
         self.time_offset_s = 0.0
         self.efac = 1.0
         self.equad_us = 0.0
+        self.emin_us = None
+        self.emax_us = None
+        self.fmin_mhz = None
+        self.fmax_mhz = None
         self.phase = 0.0
         self.skip = False
         self.jump_counter = 0
@@ -52,13 +56,18 @@ class _ParseState:
         self.ended = False
 
 
-def read_tim_file(path, include_depth: int = 0):
-    """-> (mjd_strings, freq, err_us, obs, flags) raw lists (pre-TOAs)."""
+def read_tim_file(path, include_depth: int = 0, state: "_ParseState" = None):
+    """-> raw row dict (pre-TOAs).  ``state`` is shared across INCLUDE
+    (tempo2 semantics: FORMAT/EFAC/TIME... in force carry into included
+    files and mutations inside them persist after return)."""
     if include_depth > 10:
         raise PintTpuError("INCLUDE nesting too deep")
     path = Path(path)
-    rows = {"mjd": [], "freq": [], "err": [], "obs": [], "flags": []}
-    state = _ParseState()
+    rows = {
+        "mjd": [], "freq": [], "err": [], "obs": [], "flags": [],
+        "time_offset": [],
+    }
+    state = state or _ParseState()
     with open(path) as f:
         for lineno, raw in enumerate(f, 1):
             _parse_line(raw, state, rows, path, lineno, include_depth)
@@ -72,11 +81,10 @@ def build_toas_from_rows(rows) -> TOAs:
     # Apply TIME-command offsets to the arrival times now (design note:
     # the reference defers them to the clock-correction stage via a 'to'
     # flag; baking them in at parse time is equivalent — the shifted time
-    # IS the arrival time — and keeps ingest stateless).  The 'to' flag is
-    # retained for provenance only.
-    offsets = np.array(
-        [float(f.get("to", 0.0)) for f in rows["flags"]], dtype=np.float64
-    )
+    # IS the arrival time — and keeps ingest stateless).  Offsets travel
+    # in a dedicated row array so a user's ordinary '-to' flag cannot
+    # shift times.
+    offsets = np.asarray(rows["time_offset"], dtype=np.float64)
     if np.any(offsets != 0.0):
         t = t.add_seconds(offsets)
     toas = TOAs(
@@ -116,7 +124,7 @@ def _apply_command(head, tokens, state, rows, path, depth):
         pass  # fit-mode hint, ignored (reference logs and ignores too)
     elif head == "INCLUDE":
         inc = Path(path).parent / tokens[1]
-        sub = read_tim_file(inc, depth + 1)
+        sub = read_tim_file(inc, depth + 1, state=state)
         for k in rows:
             rows[k].extend(sub[k])
     elif head == "TIME":
@@ -125,6 +133,18 @@ def _apply_command(head, tokens, state, rows, path, depth):
         state.efac = float(tokens[1])
     elif head == "EQUAD":
         state.equad_us = float(tokens[1])
+    elif head == "EMIN":
+        state.emin_us = float(tokens[1])
+    elif head == "EMAX":
+        state.emax_us = float(tokens[1])
+    elif head == "FMIN":
+        state.fmin_mhz = float(tokens[1])
+    elif head == "FMAX":
+        state.fmax_mhz = float(tokens[1])
+    elif head in ("SIGMA", "TRACK", "INFO"):
+        import warnings
+
+        warnings.warn(f"tim command {head} not supported; ignored")
     elif head == "PHASE":
         state.phase += float(tokens[1])
     elif head == "SKIP":
@@ -145,8 +165,6 @@ def _apply_command(head, tokens, state, rows, path, depth):
 
 def _common_flags(state, extra):
     flags = dict(extra)
-    if state.time_offset_s != 0.0:
-        flags["to"] = repr(state.time_offset_s)
     if state.in_jump:
         flags["tim_jump"] = str(state.jump_counter)
     if state.phase != 0.0:
@@ -230,11 +248,23 @@ def _parse_princeton_toa(raw, tokens, state, rows, path, lineno):
 
 def _append_toa(rows, sat, freq, err, site, flags, state):
     err_us = _apply_err_model(float(err), state)
+    freq_mhz = float(freq) if float(freq) != 0.0 else np.inf
+    # EMIN/EMAX/FMIN/FMAX selection commands (tempo semantics: exclude
+    # TOAs outside the accepted ranges)
+    if state.emin_us is not None and err_us < state.emin_us:
+        return
+    if state.emax_us is not None and err_us > state.emax_us:
+        return
+    if state.fmin_mhz is not None and freq_mhz < state.fmin_mhz:
+        return
+    if state.fmax_mhz is not None and freq_mhz > state.fmax_mhz:
+        return
     rows["mjd"].append(sat)
-    rows["freq"].append(float(freq) if float(freq) != 0.0 else np.inf)
+    rows["freq"].append(freq_mhz)
     rows["err"].append(err_us)
     rows["obs"].append(site)
     rows["flags"].append(_common_flags(state, flags))
+    rows["time_offset"].append(state.time_offset_s)
 
 
 def get_TOAs_from_tim(path) -> TOAs:
@@ -259,8 +289,5 @@ def write_tim_file(path, toas: TOAs, name: str = "pint_tpu"):
                 f"{toas.error_us[i]:.3f} {toas.obs[i]}"
             )
             for k, v in flags.items():
-                if k == "to":
-                    # TIME offsets were baked into the written MJD already
-                    continue
                 line += f" -{k} {v}" if v != "" else f" -{k}"
             f.write(line + "\n")

@@ -1,0 +1,165 @@
+"""Astrometry: Roemer + parallax delays from sky position & proper motion.
+
+Reference parity: src/pint/models/astrometry.py::AstrometryEquatorial /
+AstrometryEcliptic — SSB->pulsar unit vector vs epoch (linear proper
+motion), Roemer delay -r_obs.n/c, parallax delay px*(|r|^2-(r.n)^2)/2.
+
+Internal units: angles rad, proper motions rad/s, PX rad (parallax
+angle); positions arrive in the bundle in light-seconds, so delays are
+plain f64 dot products (sub-ps precision at AU scales).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import (
+    AU_LIGHT_SEC,
+    MAS_TO_RAD,
+    OBL_J2000,
+    SECS_PER_JULIAN_YEAR,
+)
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import (
+    AngleParameter,
+    MJDParameter,
+    floatParameter,
+)
+
+_MAS_YR = MAS_TO_RAD / SECS_PER_JULIAN_YEAR
+
+
+class Astrometry(DelayComponent):
+    category = "astrometry"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("POSEPOCH", time_scale="tdb"))
+        self.add_param(
+            floatParameter(
+                "PX", units="mas", scale_to_internal=MAS_TO_RAD,
+                description="parallax",
+            )
+        )
+
+    def _dt_pos(self, pdict, bundle):
+        """Seconds from POSEPOCH (f64 is ample for PM terms)."""
+        if self.params["POSEPOCH"].value is not None:
+            day, sec = pdict["POSEPOCH"]
+        elif self.params.get("PEPOCH_FALLBACK") is not None:  # pragma: no cover
+            day, sec = pdict["PEPOCH"]
+        else:
+            day, sec = float(np.asarray(bundle.tdb_day)[0]), 0.0
+        return bundle.dt_seconds(day, sec).to_float()
+
+    def ssb_to_psr_xyz(self, pdict, bundle):
+        """Unit vector(s) SSB->pulsar at each TOA, (n,3)."""
+        raise NotImplementedError
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        n = self.ssb_to_psr_xyz(pdict, bundle)
+        r = bundle.ssb_obs_pos_ls  # light-seconds
+        rn = jnp.sum(r * n, axis=-1)
+        roemer = -rn
+        px = pdict.get("PX")
+        if px is None or self.params["PX"].value is None:
+            return roemer
+        r2 = jnp.sum(r * r, axis=-1)
+        # parallax delay: px/(2 AU) * (|r|^2 - (r.n)^2)  [px in rad]
+        plx = px / (2.0 * AU_LIGHT_SEC) * (r2 - rn * rn)
+        return roemer + plx
+
+
+class AstrometryEquatorial(Astrometry):
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            AngleParameter("RAJ", units="H:M:S", aliases=("RA",), frozen=False)
+        )
+        self.add_param(
+            AngleParameter("DECJ", units="D:M:S", aliases=("DEC",), frozen=False)
+        )
+        self.add_param(
+            floatParameter(
+                "PMRA", units="mas/yr", scale_to_internal=_MAS_YR,
+                description="proper motion in RA (mu_alpha*cos(dec))",
+            )
+        )
+        self.add_param(
+            floatParameter(
+                "PMDEC", units="mas/yr", scale_to_internal=_MAS_YR,
+            )
+        )
+
+    def validate(self, model):
+        self.require("RAJ", "DECJ")
+
+    def ssb_to_psr_xyz(self, pdict, bundle):
+        dt = self._dt_pos(pdict, bundle)
+        ra0, dec0 = pdict["RAJ"], pdict["DECJ"]
+        pmra = pdict.get("PMRA")
+        pmdec = pdict.get("PMDEC")
+        dec = dec0 if pmdec is None else dec0 + pmdec * dt
+        cosd = jnp.cos(dec)
+        ra = ra0 if pmra is None else ra0 + pmra * dt / jnp.cos(dec0)
+        return jnp.stack(
+            [jnp.cos(ra) * cosd, jnp.sin(ra) * cosd, jnp.sin(dec)], axis=-1
+        )
+
+
+class AstrometryEcliptic(Astrometry):
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            AngleParameter("ELONG", units="deg", aliases=("LAMBDA",), frozen=False)
+        )
+        self.add_param(
+            AngleParameter("ELAT", units="deg", aliases=("BETA",), frozen=False)
+        )
+        self.add_param(
+            floatParameter(
+                "PMELONG", units="mas/yr", scale_to_internal=_MAS_YR,
+                aliases=("PMLAMBDA",),
+            )
+        )
+        self.add_param(
+            floatParameter(
+                "PMELAT", units="mas/yr", scale_to_internal=_MAS_YR,
+                aliases=("PMBETA",),
+            )
+        )
+        from pint_tpu.models.parameter import strParameter
+
+        self.add_param(strParameter("ECL", value="IERS2010"))
+
+    def validate(self, model):
+        self.require("ELONG", "ELAT")
+
+    def _obliquity(self):
+        # IERS2010 mean obliquity at J2000 (constants.OBL_J2000);
+        # reference reads data/runtime ecliptic.dat keyed by ECL
+        return OBL_J2000
+
+    def ssb_to_psr_xyz(self, pdict, bundle):
+        dt = self._dt_pos(pdict, bundle)
+        lam0, bet0 = pdict["ELONG"], pdict["ELAT"]
+        pml = pdict.get("PMELONG")
+        pmb = pdict.get("PMELAT")
+        bet = bet0 if pmb is None else bet0 + pmb * dt
+        lam = lam0 if pml is None else lam0 + pml * dt / jnp.cos(bet0)
+        cb = jnp.cos(bet)
+        x_ecl = jnp.stack(
+            [jnp.cos(lam) * cb, jnp.sin(lam) * cb, jnp.sin(bet)], axis=-1
+        )
+        eps = self._obliquity()
+        ce, se = jnp.cos(eps), jnp.sin(eps)
+        # rotate ecliptic -> equatorial (x axis shared)
+        x = x_ecl[..., 0]
+        y = ce * x_ecl[..., 1] - se * x_ecl[..., 2]
+        z = se * x_ecl[..., 1] + ce * x_ecl[..., 2]
+        return jnp.stack([x, y, z], axis=-1)

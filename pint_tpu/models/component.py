@@ -1,0 +1,168 @@
+"""Component base classes + registry.
+
+Reference parity: src/pint/models/timing_model.py::Component (metaclass
+registry ``component_types``), DelayComponent, PhaseComponent, and the
+NoiseComponent split in src/pint/models/noise_model.py.
+
+Design: a Component instance is a *host-side* bag of Parameters plus pure
+kernel functions.  Kernel methods receive
+  pdict   dict param-name -> jnp scalar (f64) or DD scalar
+  bundle  TOABundle (device arrays)
+and return arrays; they must be trace-safe (no Python control flow on
+traced values).  Mask parameters become static 0/1 arrays in the bundle,
+computed host-side at compile time (SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pint_tpu.exceptions import MissingParameter, TimingModelError
+from pint_tpu.models.parameter import Parameter, maskParameter
+
+# category evaluation order for delays/phases; mirrors the reference's
+# DEFAULT_ORDER (timing_model.py::DEFAULT_ORDER)
+DEFAULT_ORDER = [
+    "astrometry",
+    "jump_delay",
+    "troposphere",
+    "solar_system_shapiro",
+    "solar_wind",
+    "dispersion_constant",
+    "dispersion_dmx",
+    "chromatic",
+    "frequency_dependent",
+    "pulsar_system",
+    "spindown",
+    "phase_jump",
+    "wave",
+    "ifunc",
+    "glitch",
+    "piecewise_spindown",
+    "absolute_phase",
+    "phase_offset",
+]
+
+
+class Component:
+    """Base: ordered parameter container with a class registry."""
+
+    register = False
+    category: Optional[str] = None
+    component_types: dict = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("register", cls.register):
+            Component.component_types[cls.__name__] = cls
+
+    def __init__(self):
+        self.params: dict[str, Parameter] = {}
+
+    # -- parameter plumbing ---------------------------------------------
+    def add_param(self, par: Parameter) -> Parameter:
+        self.params[par.name] = par
+        return par
+
+    def remove_param(self, name: str):
+        self.params.pop(name, None)
+
+    def __getattr__(self, name):
+        params = object.__getattribute__(self, "__dict__").get("params", {})
+        if name in params:
+            return params[name]
+        raise AttributeError(
+            f"{type(self).__name__} has no attribute/parameter {name!r}"
+        )
+
+    def has_param(self, name: str) -> bool:
+        return name in self.params
+
+    def match_param_alias(self, name: str) -> Optional[str]:
+        """Resolve an alias to this component's canonical param name."""
+        for p in self.params.values():
+            if p.name_matches(name):
+                return p.name
+        return None
+
+    @property
+    def free_params(self) -> list[str]:
+        return [
+            n for n, p in self.params.items()
+            if not p.frozen and p.value is not None
+        ]
+
+    @property
+    def mask_params(self) -> list[str]:
+        return [
+            n for n, p in self.params.items() if isinstance(p, maskParameter)
+        ]
+
+    # -- lifecycle -------------------------------------------------------
+    def setup(self, model):
+        """Called once after all parameters are set (derive indexed
+        families, caches)."""
+
+    def validate(self, model):
+        """Raise TimingModelError / MissingParameter on ill-formed input."""
+
+    def require(self, *names):
+        for n in names:
+            p = self.params.get(n)
+            if p is None or p.value is None:
+                raise MissingParameter(type(self).__name__, n)
+
+    # -- builder support -------------------------------------------------
+    @classmethod
+    def accepted_param_names(cls) -> set[str]:
+        """All par-file names (incl. aliases, excl. prefix indices) this
+        component understands; used by the model builder's reverse map."""
+        proto = cls()
+        names = set()
+        for p in proto.params.values():
+            names.add(p.name.upper())
+            names.update(a.upper() for a in p.aliases)
+        for pref in getattr(proto, "prefix_patterns", []):
+            names.add(pref.upper() + "#")
+        return names
+
+    def __repr__(self):
+        ps = ", ".join(
+            f"{n}={p.value}" for n, p in self.params.items()
+            if p.value is not None
+        )
+        return f"{type(self).__name__}({ps})"
+
+
+class DelayComponent(Component):
+    """Contributes seconds of delay; evaluated in category order, each
+    seeing the delay accumulated so far (progressive barycentering)."""
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        """-> f64 seconds (n,); acc_delay is the sum of earlier terms."""
+        raise NotImplementedError
+
+
+class PhaseComponent(Component):
+    """Contributes pulse phase (DD cycles), evaluated at t - total_delay."""
+
+    def phase_term(self, pdict, bundle, delay):
+        """-> DD cycles (n,); delay is the total delay in seconds."""
+        raise NotImplementedError
+
+
+class NoiseComponent(Component):
+    """Modifies TOA uncertainties / contributes covariance bases.
+
+    Two interfaces, mirroring the reference (noise_model.py):
+      scaled_sigma(pdict, bundle, sigma_us) -> rescaled white sigma
+      basis_weight(pdict, bundle) -> (basis (n,k), weight (k,)) or None
+    """
+
+    introduces_correlated_errors = False
+
+    def scaled_sigma(self, pdict, bundle, sigma_s):
+        return sigma_s
+
+    def basis_weight(self, pdict, bundle):
+        return None

@@ -1,0 +1,160 @@
+"""Dispersion delay components: DM polynomial + DMX piecewise.
+
+Reference parity: src/pint/models/dispersion_model.py::DispersionDM,
+DispersionDMX, DMJump — delay = K * DM(t) / f^2 with K the Tempo
+dispersion constant (constants.DM_CONST), DM(t) a Taylor series in
+(t - DMEPOCH), DMX piecewise offsets over MJD ranges via mask arrays.
+
+Wideband DM-measurement interfaces (dm_value/dm_designmatrix) live here
+too, consumed by WidebandTOAFitter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import DM_CONST, SECS_PER_JULIAN_YEAR
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    maskParameter,
+)
+from pint_tpu.ops.taylor import taylor_horner
+
+
+class DispersionDM(DelayComponent):
+    register = True
+    category = "dispersion_constant"
+
+    def __init__(self, max_terms: int = 10):
+        super().__init__()
+        self.add_param(
+            floatParameter("DM", units="pc/cm^3", frozen=False)
+        )
+        for k in range(1, max_terms + 1):
+            # DMk in pc cm^-3 / yr^k -> internal per-second^k
+            self.add_param(
+                floatParameter(
+                    f"DM{k}",
+                    units=f"pc/cm^3/yr^{k}",
+                    scale_to_internal=SECS_PER_JULIAN_YEAR ** (-k),
+                )
+            )
+        self.add_param(MJDParameter("DMEPOCH", time_scale="tdb"))
+        self.prefix_patterns = ["DM"]
+
+    def validate(self, model):
+        if (
+            self.params["DM1"].value is not None
+            and self.params["DMEPOCH"].value is None
+        ):
+            from pint_tpu.exceptions import TimingModelError
+
+            raise TimingModelError("DMEPOCH required when DM1 is set")
+
+    def _coeffs(self, pdict):
+        out = [pdict["DM"]]
+        k = 1
+        while f"DM{k}" in pdict and self.params[f"DM{k}"].value is not None:
+            out.append(pdict[f"DM{k}"])
+            k += 1
+        return out
+
+    def dm_value(self, pdict, bundle):
+        """DM at each TOA (pc/cm^3)."""
+        coeffs = self._coeffs(pdict)
+        if len(coeffs) == 1:
+            return coeffs[0] * jnp.ones(bundle.ntoa)
+        day, sec = pdict["DMEPOCH"]
+        dt = bundle.dt_seconds(day, sec).to_float()
+        # note: reference uses plain Taylor (not /k!) for DM derivatives?
+        # No: PINT uses taylor_horner with factorial convention; we match.
+        return taylor_horner(dt, coeffs)
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        dm = self.dm_value(pdict, bundle)
+        return DM_CONST * dm / jnp.square(bundle.freq_mhz)
+
+
+class DispersionDMX(DelayComponent):
+    """Piecewise-constant DM offsets over MJD ranges (DMX_####)."""
+
+    register = True
+    category = "dispersion_dmx"
+
+    def __init__(self, n_ranges: int = 0):
+        super().__init__()
+        self.dmx_indices: list[int] = []
+        for i in range(1, n_ranges + 1):
+            self.add_dmx_range(i)
+        self.prefix_patterns = ["DMX_", "DMXR1_", "DMXR2_"]
+
+    def add_dmx_range(self, idx: int):
+        self.add_param(
+            floatParameter(f"DMX_{idx:04d}", units="pc/cm^3", value=0.0)
+        )
+        self.add_param(floatParameter(f"DMXR1_{idx:04d}", units="MJD"))
+        self.add_param(floatParameter(f"DMXR2_{idx:04d}", units="MJD"))
+        self.dmx_indices.append(idx)
+
+    def setup(self, model):
+        self.dmx_indices = sorted(
+            int(n[4:]) for n in self.params
+            if n.startswith("DMX_") and self.params[n].value is not None
+        )
+
+    def dmx_masks(self, toas) -> dict[str, np.ndarray]:
+        """Host-side: per-range 0/1 masks from DMXR1/DMXR2."""
+        mjd = toas.mjd_float()
+        out = {}
+        for i in self.dmx_indices:
+            r1 = self.params[f"DMXR1_{i:04d}"].value
+            r2 = self.params[f"DMXR2_{i:04d}"].value
+            out[f"DMX_{i:04d}"] = (
+                (mjd >= r1) & (mjd <= r2)
+            ).astype(np.float64)
+        return out
+
+    def dm_value(self, pdict, bundle):
+        dm = jnp.zeros(bundle.ntoa)
+        for i in self.dmx_indices:
+            name = f"DMX_{i:04d}"
+            dm = dm + pdict[name] * bundle.masks[name]
+        return dm
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        return DM_CONST * self.dm_value(pdict, bundle) / jnp.square(
+            bundle.freq_mhz
+        )
+
+
+class DMJump(DelayComponent):
+    """Wideband DM jumps: shift DM *measurements*, not the delay.
+
+    Reference: dispersion_model.py::DMJump — the delay term is zero; the
+    jump applies to wideband DM residuals (fitting/wideband.py).
+    """
+
+    register = True
+    category = "dispersion_jump"
+
+    def __init__(self):
+        super().__init__()
+        self.dmjump_params: list[str] = []
+
+    def add_dmjump(self, idx: int) -> maskParameter:
+        name = f"DMJUMP{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units="pc/cm^3"))
+        self.dmjump_params.append(name)
+        return p
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        return jnp.zeros(bundle.ntoa)
+
+    def dm_offset(self, pdict, bundle):
+        off = jnp.zeros(bundle.ntoa)
+        for n in self.dmjump_params:
+            off = off - pdict[n] * bundle.masks[n]
+        return off

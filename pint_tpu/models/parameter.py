@@ -111,12 +111,13 @@ class Parameter:
         """tokens: [value] or [value fit] or [value fit unc]."""
         self.value = self._parse_value_str(tokens[0])
         if len(tokens) >= 2:
-            try:
-                self.frozen = not bool(int(tokens[1]))
+            # fit flags are exactly '0'/'1' (tempo convention); any other
+            # numeric second token is a tempo2-style bare uncertainty
+            if tokens[1] in ("0", "1"):
+                self.frozen = tokens[1] == "0"
                 if len(tokens) >= 3:
                     self.uncertainty = _parse_float_str(tokens[2])
-            except ValueError:
-                # token 2 may be an uncertainty directly (tempo2 style)
+            else:
                 self.uncertainty = _parse_float_str(tokens[1])
 
     def _parse_value_str(self, s: str):

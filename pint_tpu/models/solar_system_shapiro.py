@@ -1,0 +1,88 @@
+"""Solar-system Shapiro delay (Sun + optionally planets).
+
+Reference parity: src/pint/models/solar_system_shapiro.py — delay
+-(2 GM_b / c^3) ln(r - r.n) summed over bodies; the log's constant
+offset is degenerate with overall phase and irrelevant to fitting.
+PLANET_SHAPIRO enables Jupiter..Neptune terms (requires planet position
+columns from ingest with planets=True).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.constants import (
+    AU_LIGHT_SEC,
+    C,
+    GM_JUPITER,
+    GM_NEPTUNE,
+    GM_SATURN,
+    GM_SUN,
+    GM_URANUS,
+    GM_VENUS,
+)
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import boolParameter
+
+_T2 = 2.0 / C**3  # 2/c^3; times GM gives seconds
+
+_PLANET_GM = {
+    "venus": GM_VENUS,
+    "jupiter": GM_JUPITER,
+    "saturn": GM_SATURN,
+    "uranus": GM_URANUS,
+    "neptune": GM_NEPTUNE,
+}
+
+
+class SolarSystemShapiro(DelayComponent):
+    register = True
+    category = "solar_system_shapiro"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            boolParameter("PLANET_SHAPIRO", value=False)
+        )
+
+    @staticmethod
+    def _body_delay(gm, obs_body_pos_ls, psr_dir):
+        """-(2GM/c^3) * ln((r - r.n)/AU_ls); r = obs->body light-sec."""
+        r = jnp.sqrt(jnp.sum(obs_body_pos_ls**2, axis=-1))
+        rn = jnp.sum(obs_body_pos_ls * psr_dir, axis=-1)
+        # guard: at r==0 (barycentric fake data) the term is 0
+        arg = jnp.maximum((r - rn) / AU_LIGHT_SEC, 1e-30)
+        return jnp.where(
+            r > 0, -(gm * _T2) * jnp.log(arg), 0.0
+        )
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        # pulsar direction from the astrometry component via bundle cache:
+        # the TimingModel guarantees astrometry runs first (DEFAULT_ORDER);
+        # we recompute the unit vector here to stay functional.
+        psr_dir = self._psr_dir(pdict, bundle)
+        d = self._body_delay(GM_SUN, bundle.obs_sun_pos_ls, psr_dir)
+        if self.params["PLANET_SHAPIRO"].value:
+            for body, gm in _PLANET_GM.items():
+                if body in bundle.obs_planet_pos_ls:
+                    d = d + self._body_delay(
+                        gm, bundle.obs_planet_pos_ls[body], psr_dir
+                    )
+        return d
+
+    def _psr_dir(self, pdict, bundle):
+        self._astrometry_ref = getattr(self, "_astrometry_ref", None)
+        if self._astrometry_ref is None:
+            raise RuntimeError(
+                "SolarSystemShapiro needs an astrometry component "
+                "(set by TimingModel.setup)"
+            )
+        return self._astrometry_ref.ssb_to_psr_xyz(pdict, bundle)
+
+    def setup(self, model):
+        from pint_tpu.models.astrometry import Astrometry
+
+        self._astrometry_ref = None
+        for c in model.components.values():
+            if isinstance(c, Astrometry):
+                self._astrometry_ref = c

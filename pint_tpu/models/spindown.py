@@ -1,0 +1,82 @@
+"""Spindown: rotational phase as a Taylor series in F0..Fn.
+
+Reference parity: src/pint/models/spindown.py::Spindown — phase =
+taylor_horner(dt, [0, F0, F1, ...]) with dt = TDB - PEPOCH - delay in
+(long double) seconds.  Here dt is DD and F0 is a DD parameter (an f64 F0
+alone would alias ~100 ns of phase over 20 yr; see models/parameter.py).
+"""
+
+from __future__ import annotations
+
+from pint_tpu.exceptions import TimingModelError
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import MJDParameter, floatParameter
+from pint_tpu.ops.taylor import taylor_horner_dd, taylor_horner_deriv_dd
+
+
+class Spindown(PhaseComponent):
+    register = True
+    category = "spindown"
+
+    def __init__(self, max_fterms: int = 12):
+        super().__init__()
+        self.add_param(
+            floatParameter(
+                "F0", units="Hz", long_double=True,
+                description="spin frequency", frozen=False,
+            )
+        )
+        self.add_param(
+            floatParameter("F1", units="Hz/s", description="spin-down rate")
+        )
+        for k in range(2, max_fterms + 1):
+            self.add_param(
+                floatParameter(f"F{k}", units=f"Hz/s^{k}")
+            )
+        self.add_param(MJDParameter("PEPOCH", time_scale="tdb"))
+        self.prefix_patterns = ["F"]
+
+    def validate(self, model):
+        self.require("F0")
+        if any(
+            self.params[f"F{k}"].value is not None
+            for k in range(1, self._max_k() + 1)
+        ) and self.params["PEPOCH"].value is None:
+            raise TimingModelError("PEPOCH required when F1.. are set")
+
+    def _max_k(self):
+        ks = [
+            int(n[1:]) for n in self.params
+            if n.startswith("F") and n[1:].isdigit()
+        ]
+        return max(ks)
+
+    def _coeff_names(self):
+        """Contiguous F-terms F0..Fn actually set."""
+        names = []
+        for k in range(0, self._max_k() + 1):
+            n = f"F{k}"
+            if n in self.params and self.params[n].value is not None:
+                names.append(n)
+            else:
+                break
+        return names
+
+    def _dt(self, pdict, bundle, delay):
+        if self.params["PEPOCH"].value is not None:
+            day, sec = pdict["PEPOCH"]
+        else:
+            day, sec = float(bundle.tdb_day[0]), 0.0
+        return bundle.dt_seconds(day, sec) - delay
+
+    def phase_term(self, pdict, bundle, delay):
+        dt = self._dt(pdict, bundle, delay)
+        coeffs = [0.0] + [pdict[n] for n in self._coeff_names()]
+        return taylor_horner_dd(dt, coeffs)
+
+    def spin_frequency(self, pdict, bundle):
+        """f(t) at each TOA (no delay correction; matches reference use of
+        per-TOA barycentric frequency for time residuals)."""
+        dt = self._dt(pdict, bundle, 0.0)
+        coeffs = [0.0] + [pdict[n] for n in self._coeff_names()]
+        return taylor_horner_deriv_dd(dt, coeffs, 1).to_float()

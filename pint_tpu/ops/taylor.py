@@ -30,7 +30,7 @@ def taylor_horner_dd(dt: DD, coeffs: Sequence) -> DD:
         return DD.zeros(dt.hi.shape)
     acc = DD.from_float(jnp.zeros_like(dt.hi))
     for i in reversed(range(len(coeffs))):
-        c = DD.from_float(coeffs[i])
+        c = coeffs[i] if isinstance(coeffs[i], DD) else DD.from_float(coeffs[i])
         if i >= 2:
             c = c / float(math.factorial(i))  # DD-exact division
         acc = acc * dt + c
@@ -44,7 +44,8 @@ def taylor_horner_deriv_dd(dt: DD, coeffs: Sequence, deriv_order: int = 1) -> DD
         return DD.zeros(dt.hi.shape)
     acc = DD.from_float(jnp.zeros_like(dt.hi))
     for i in reversed(range(len(coeffs) - n)):
-        c = DD.from_float(coeffs[i + n])
+        ci = coeffs[i + n]
+        c = ci if isinstance(ci, DD) else DD.from_float(ci)
         if i >= 2:
             c = c / float(math.factorial(i))
         acc = acc * dt + c

@@ -1,0 +1,58 @@
+"""Residuals: the host-facing wrapper over compiled residual kernels.
+
+Reference parity: src/pint/residuals.py::Residuals (calc_phase_resids,
+calc_time_resids, chi2, track_mode, weighted-mean subtraction).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.models.timing_model import CompiledModel, TimingModel
+from pint_tpu.toas.toas import TOAs
+
+
+class Residuals:
+    def __init__(
+        self,
+        toas: TOAs,
+        model: TimingModel,
+        subtract_mean: bool = True,
+        track_mode: Optional[str] = None,
+        compiled: Optional[CompiledModel] = None,
+    ):
+        self.toas = toas
+        self.model = model
+        self.cm = compiled or model.compile(toas, subtract_mean=subtract_mean)
+        if track_mode is not None:
+            self.cm.track_mode = track_mode
+        self._x = self.cm.x0()
+
+    @property
+    def phase_resids(self) -> np.ndarray:
+        return np.asarray(self.cm.phase_residuals(self._x))
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        """Seconds (weighted-mean-subtracted if subtract_mean)."""
+        return np.asarray(self.cm.time_residuals_jit(self._x))
+
+    @property
+    def chi2(self) -> float:
+        return float(self.cm.chi2_jit(self._x))
+
+    @property
+    def dof(self) -> int:
+        return len(self.toas) - len(self.cm.free_names) - 1  # -1: offset
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    def rms_weighted(self) -> float:
+        """Weighted RMS of time residuals, seconds."""
+        r = self.time_resids
+        w = 1.0 / (self.toas.error_us * 1e-6) ** 2
+        return float(np.sqrt(np.sum(w * r * r) / np.sum(w)))

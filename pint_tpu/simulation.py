@@ -1,0 +1,91 @@
+"""Fake-TOA simulation (zima backend).
+
+Reference parity: src/pint/simulation.py::make_fake_toas_uniform /
+make_fake_toas_fromtim — choose arrival times so the model phase is an
+integer (iterative inversion), then optionally add white noise draws.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.ingest import ingest_barycentric
+from pint_tpu.toas.toas import TOAs
+
+
+def make_fake_toas_uniform(
+    start_mjd: float,
+    end_mjd: float,
+    ntoa: int,
+    model: TimingModel,
+    error_us: float = 1.0,
+    freq_mhz=1400.0,
+    obs: str = "@",
+    add_noise: bool = False,
+    rng: Optional[np.random.Generator] = None,
+    iterations: int = 3,
+) -> TOAs:
+    """Uniformly spaced TOAs whose model phase is (near-)integer.
+
+    For obs='@' the times are barycentric TDB (no ingest chain).  The
+    inversion iterates: evaluate phase residual, shift each TOA by
+    -resid/f; three passes land at machine-level integer phase.
+    """
+    mjds = np.linspace(start_mjd, end_mjd, ntoa)
+    freq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoa,))
+    t = TimeArray.from_mjd_float(mjds, scale="utc")
+    toas = TOAs(
+        t,
+        freq,
+        np.full(ntoa, error_us),
+        [obs] * ntoa,
+        [dict() for _ in range(ntoa)],
+    )
+    _ingest(toas, model)
+
+    for _ in range(iterations):
+        cm = model.compile(toas, subtract_mean=False)
+        cm.track_mode = "nearest"
+        resid = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
+        toas.t = toas.t.add_seconds(-resid)
+        _ingest(toas, model)
+
+    if add_noise:
+        rng = rng or np.random.default_rng()
+        noise = rng.normal(0.0, error_us * 1e-6, ntoa)
+        toas.t = toas.t.add_seconds(noise)
+        _ingest(toas, model)
+    return toas
+
+
+def _ingest(toas: TOAs, model: TimingModel):
+    if all(o.lower() in ("@", "bat", "ssb", "barycenter") for o in toas.obs):
+        ingest_barycentric(toas)
+    else:
+        from pint_tpu.toas.ingest import ingest
+
+        ingest(toas, ephem=model.top_params["EPHEM"].value or "builtin")
+
+
+def calculate_random_models(
+    fitter, n_models: int = 100, rng: Optional[np.random.Generator] = None
+):
+    """Draw parameter vectors from the fit covariance and return per-draw
+    residual curves (reference: simulation.calculate_random_models)."""
+    rng = rng or np.random.default_rng()
+    cov = fitter.parameter_covariance_matrix
+    if cov is None:
+        raise ValueError("fit first")
+    cov = cov[1:, 1:]  # drop offset
+    L = np.linalg.cholesky(cov + 1e-30 * np.eye(len(cov)))
+    draws = rng.normal(size=(n_models, len(cov))) @ L.T
+    out = []
+    for d in draws:
+        out.append(
+            np.asarray(fitter.cm.time_residuals(np.asarray(d)))
+        )
+    return np.stack(out)

@@ -46,9 +46,19 @@ _T77_SEC = 32.184
 #   utc -- tai -- tt -- tdb -- tcb        tt -- tcg
 _CHAIN = ["utc", "tai", "tt", "tdb", "tcb"]
 
+# first MJD of the leap-second era (1972-01-01, TAI-UTC = 10 s)
+_LEAP_GUARD = 41317
+
 
 def _route(src: str, dst: str) -> list[str]:
     """Sequence of intermediate scales (excluding src) from src to dst."""
+    for s in (src, dst):
+        if s == "ut1":
+            raise PintTpuError(
+                "ut1 conversions require an EOP table "
+                "(pint_tpu.io.eop); not available on this TimeArray"
+            )
+
     def chain_pos(s):
         return _CHAIN.index(s if s != "tcg" else "tt")
 
@@ -216,16 +226,27 @@ class TimeArray:
         return TimeArray(mjd, sec, "tai")
 
     def _tai_to_utc(self) -> "TimeArray":
-        # iterate: offset depends on the UTC day
-        guess = self.mjd_int
-        for _ in range(2):
-            off = tai_minus_utc(guess).astype(np.float64)
-            mjd, sec = _norm(self.mjd_int, self.sec - off)
-            guess = mjd
-        # note: instants inside a leap second map onto sec in [86400,86401)
-        # of the previous day; we renormalize to day boundaries, accepting
-        # the standard ambiguity (cf. pulsar_mjd convention).
-        return TimeArray(mjd, sec, "utc")
+        # UTC day D starts at TAI-elapsed T_start(D) = (D-E)*86400+off(D)
+        # (E = 41317, where TAI-UTC = 10 s).  Find the largest D with
+        # T_start(D) <= T; then sec = T - T_start(D), which lands in
+        # [86400, 86401) during a leap second — round-tripping exactly
+        # through _utc_to_tai.
+        E = _LEAP_GUARD
+        T = self.seconds_since(E)  # DD TAI-elapsed
+        q = np.floor(T.hi / SECS_PER_DAY).astype(np.int64)
+        d0 = E + q
+        off0 = tai_minus_utc(d0).astype(np.float64)
+        # sec-of-day candidate for D = d0
+        s0 = T - HostDD.from_prod(q.astype(np.float64), SECS_PER_DAY)
+        in_prev = s0.hi < off0  # T before d0's start: belongs to d0-1
+        d = np.where(in_prev, d0 - 1, d0)
+        off = tai_minus_utc(d).astype(np.float64)
+        sec = (
+            s0
+            + np.where(in_prev, SECS_PER_DAY, 0.0)
+            - off
+        ).normalize()
+        return TimeArray(d, sec, "utc")
 
     def _tt_centuries(self) -> np.ndarray:
         return (
@@ -286,7 +307,7 @@ class TimeArray:
 
     def __repr__(self):
         n = len(self.mjd_int)
-        head = ", ".join(self.to_mjd_strings(10)[: min(n, 3)])
+        head = ", ".join(self[: min(n, 3)].to_mjd_strings(10))
         return f"TimeArray<{self.scale}>[{n}]({head}{'...' if n > 3 else ''})"
 
     def add_seconds(self, s) -> "TimeArray":

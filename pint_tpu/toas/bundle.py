@@ -1,0 +1,98 @@
+"""TOABundle: the device-resident array view of a TOAs table.
+
+This is the boundary between host ingest (numpy/HostDD, IEEE f64) and
+device kernels (jnp).  Everything a compiled timing-model kernel needs is
+here as jnp arrays; nothing else crosses into jit.
+
+Precision layout (see docs/precision.md and SURVEY.md §7 step 1):
+- absolute TDB epochs: exact integer day (f64) + DD seconds-of-day —
+  kernels form dt against model epochs in DD, which is exact on IEEE
+  backends and still ~1e-10 s on f32-pair-emulated TPU f64 (the
+  delta-from-reference parameterization keeps device magnitudes small);
+- geometry in light-seconds (positions) and v/c (velocities): delay
+  contributions are then plain f64 dot products.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import C
+from pint_tpu.ops.dd import DD
+
+
+class TOABundle(NamedTuple):
+    tdb_day: jnp.ndarray  # (n,) f64 exact integer MJD(TDB)
+    tdb_sec: DD  # (n,) seconds of TDB day
+    freq_mhz: jnp.ndarray  # (n,) observing frequency, inf allowed
+    error_us: jnp.ndarray  # (n,) raw TOA uncertainties
+    ssb_obs_pos_ls: jnp.ndarray  # (n,3) SSB->obs, light-seconds
+    ssb_obs_vel_c: jnp.ndarray  # (n,3) obs velocity / c
+    obs_sun_pos_ls: jnp.ndarray  # (n,3) obs->Sun, light-seconds
+    obs_planet_pos_ls: dict  # body -> (n,3) obs->planet, light-seconds
+    pulse_number: jnp.ndarray  # (n,) f64; NaN where untracked
+    masks: dict  # mask-param name -> (n,) f64 0/1
+
+    @property
+    def ntoa(self):
+        return self.tdb_day.shape[-1]
+
+    def dt_seconds(self, epoch_day, epoch_sec) -> DD:
+        """(t_tdb - epoch) in DD seconds.
+
+        epoch_day: static int/float (exact day number); epoch_sec: static
+        float or DD scalar seconds-of-day.  The day-difference product is
+        exact in f64 (|ddays*86400| < 2^53 for any realistic span).
+        """
+        ddays = self.tdb_day - float(epoch_day)
+        big = DD.from_prod(ddays, 86400.0)
+        return big + (self.tdb_sec - epoch_sec)
+
+
+def make_bundle(
+    toas,
+    masks: Optional[dict] = None,
+) -> TOABundle:
+    """Host -> device: build the bundle from an ingested TOAs table.
+
+    Requires toas.t_tdb (from pint_tpu.toas.ingest); position columns
+    default to zeros (barycentric data, site '@').
+    """
+    n = len(toas)
+    if toas.t_tdb is None:
+        raise ValueError(
+            "TOAs not ingested: run pint_tpu.toas.ingest first "
+            "(or use ingest_barycentric for site '@' data)"
+        )
+    zeros3 = np.zeros((n, 3))
+    pos = (
+        toas.ssb_obs_pos if toas.ssb_obs_pos is not None else zeros3
+    )
+    vel = (
+        toas.ssb_obs_vel if toas.ssb_obs_vel is not None else zeros3
+    )
+    sun = (
+        toas.obs_sun_pos if toas.obs_sun_pos is not None else zeros3
+    )
+    pn = toas.get_pulse_numbers()
+    if pn is None:
+        pn = np.full(n, np.nan)
+    return TOABundle(
+        tdb_day=jnp.asarray(toas.t_tdb.mjd_int, dtype=jnp.float64),
+        tdb_sec=DD(
+            jnp.asarray(toas.t_tdb.sec.hi), jnp.asarray(toas.t_tdb.sec.lo)
+        ),
+        freq_mhz=jnp.asarray(toas.freq),
+        error_us=jnp.asarray(toas.error_us),
+        ssb_obs_pos_ls=jnp.asarray(pos / C),
+        ssb_obs_vel_c=jnp.asarray(vel / C),
+        obs_sun_pos_ls=jnp.asarray(sun / C),
+        obs_planet_pos_ls={
+            k: jnp.asarray(v / C) for k, v in toas.obs_planet_pos.items()
+        },
+        pulse_number=jnp.asarray(pn),
+        masks={k: jnp.asarray(v, dtype=jnp.float64) for k, v in (masks or {}).items()},
+    )

@@ -1,0 +1,54 @@
+"""TOA ingest pipeline: clock chain -> TDB -> solar-system geometry.
+
+Reference parity: the load-time stack of §3.1 (SURVEY.md) —
+TOAs.apply_clock_corrections, compute_TDBs, compute_posvels.  All host-
+side (numpy/HostDD); outputs are the computed columns consumed by
+``make_bundle``.
+
+Currently implemented:
+- barycentric ingest (site '@' / 'bat'): arrival times are already TDB
+  at the SSB (tempo2 BAT convention); geometry columns are zero.
+- observatory ingest: clock chain (site clock files + GPS->UTC + BIPM),
+  UTC->TDB, and observatory positions — lands with the observatory
+  registry + ephemeris layers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+
+BARY_SITES = {"@", "bat", "barycenter", "ssb"}
+
+
+def ingest_barycentric(toas: TOAs) -> TOAs:
+    """Site-'@' ingest: times are TDB at the barycenter; zero geometry."""
+    bad = [o for o in toas.obs if o.lower() not in BARY_SITES]
+    if bad:
+        raise PintTpuError(
+            f"ingest_barycentric: non-barycentric sites {sorted(set(bad))}"
+        )
+    toas.t_tdb = TimeArray(toas.t.mjd_int, toas.t.sec, "tdb")
+    n = len(toas)
+    toas.clock_corr_s = np.zeros(n)
+    toas.ssb_obs_pos = np.zeros((n, 3))
+    toas.ssb_obs_vel = np.zeros((n, 3))
+    toas.obs_sun_pos = np.zeros((n, 3))
+    return toas
+
+
+def ingest(toas: TOAs, ephem: str = "builtin", planets: bool = False,
+           include_bipm: bool = True, bipm_version: str = "BIPM2021",
+           limits: str = "warn") -> TOAs:
+    """Full observatory ingest (clock chain -> TDB -> posvels)."""
+    if all(o.lower() in BARY_SITES for o in toas.obs):
+        return ingest_barycentric(toas)
+    from pint_tpu.toas.ingest_topo import ingest_topocentric
+
+    return ingest_topocentric(
+        toas, ephem=ephem, planets=planets, include_bipm=include_bipm,
+        bipm_version=bipm_version, limits=limits,
+    )

@@ -151,9 +151,12 @@ def test_tim_parsing(tmp_path):
     np.testing.assert_allclose(toas.error_us, [1.5, 2.0, 3.0, 1.0])
     assert toas.flags[0]["f"] == "L-wide"
     assert toas.flags[0]["pn"] == "0"
-    # TIME command recorded on subsequent TOAs
-    assert "to" not in toas.flags[0]
-    assert toas.flags[2]["to"] == repr(0.5)
+    # TIME command applied to subsequent TOAs (baked into arrival time)
+    sec2 = toas.t.sec.to_float()[2]
+    np.testing.assert_allclose(
+        sec2, 0.323456789012345 * 86400 + 0.5, rtol=1e-15
+    )
+    assert "to" not in toas.flags[2]
     # infinite frequency for 0.0
     assert np.isinf(toas.freq[3])
     # exact sub-ns MJD parse: .123456789012345 day

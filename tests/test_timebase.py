@@ -127,6 +127,23 @@ def test_seconds_since_precision():
     np.testing.assert_allclose(dt.to_float()[0], 1e-18 * 86400, rtol=1e-6)
 
 
+def test_leap_second_instant_roundtrip():
+    """An instant *inside* a leap second (UTC sec 86400.5 of the leap
+    day) must survive UTC->TAI->UTC exactly."""
+    t = TimeArray(np.array([57753]), HostDD(np.array([86400.5])), "utc")
+    tai = t.to_scale("tai")
+    assert tai.mjd_int[0] == 57754
+    np.testing.assert_allclose(tai.sec.to_float()[0], 36.5, atol=1e-12)
+    back = tai.to_scale("utc")
+    assert back.mjd_int[0] == 57753
+    np.testing.assert_allclose(back.sec.to_float()[0], 86400.5, atol=1e-12)
+    # and a plain second-of-day right after the leap second
+    t2 = TimeArray(np.array([57754]), HostDD(np.array([0.25])), "utc")
+    b2 = t2.to_scale("tai").to_scale("utc")
+    assert b2.mjd_int[0] == 57754
+    np.testing.assert_allclose(b2.sec.to_float()[0], 0.25, atol=1e-12)
+
+
 def test_tdb_tcb_rates():
     """TCB drifts vs TDB at L_B ~ 1.55e-8 s/s."""
     t0 = TimeArray(np.array([43144]), HostDD(np.array([32.184])), "tdb")
