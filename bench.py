@@ -1,0 +1,93 @@
+"""Benchmark: timing-fit throughput on the flagship model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Current benchmark (round 1): full WLS fit step (residuals + jacfwd
+design matrix + column-normalized SVD solve) on 1e5 simulated TOAs of
+the spindown+dispersion+astrometry MSP model, on the default JAX backend
+(TPU under the driver).  value = TOAs/sec for one fit step; vs_baseline
+= speedup of the accelerator step over the identical computation pinned
+to host CPU (the reference implementation class is single-process CPU
+NumPy — SURVEY.md §6 records no published throughput, so the measured
+CPU denominator stands in per BASELINE.md protocol).
+
+This will graduate to the north-star GLS red-noise benchmark (1e5 TOAs,
+Woodbury covariance) when the GLS fitter lands.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _fit_step_fn(cm, w):
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.wls import _wls_step
+
+    def fit_step(x):
+        r = cm.time_residuals(x, subtract_mean=False)
+        M = cm.design_matrix(x)
+        ones = jnp.ones((cm.bundle.ntoa, 1))
+        M2 = jnp.concatenate([ones, M], axis=1)
+        dx, _, _ = _wls_step(r, M2, w)
+        return x + dx[1:], jnp.sum(w * r * r)
+
+    return jax.jit(fit_step)
+
+
+def _time_step(step, x0, nrep=5):
+    # warmup/compile
+    x, c = step(x0)
+    x.block_until_ready()
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        x, c = step(x0)
+        x.block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _build
+
+    ntoa = 100_000
+    _, toas, cm = _build(ntoa)
+    w = jnp.asarray(1.0 / (toas.error_us * 1e-6) ** 2)
+
+    # accelerator (default backend) timing
+    step = _fit_step_fn(cm, w)
+    t_dev = _time_step(step, cm.x0())
+
+    # CPU baseline: identical computation pinned to host
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        cpu_bundle = jax.device_put(cm.bundle, cpu)
+        cm_cpu = type(cm)(cm.model, cpu_bundle, subtract_mean=True)
+        step_cpu = _fit_step_fn(cm_cpu, jax.device_put(w, cpu))
+        t_cpu = _time_step(step_cpu, jax.device_put(cm.x0(), cpu), nrep=3)
+
+    toas_per_sec = ntoa / t_dev
+    print(
+        json.dumps(
+            {
+                "metric": "WLS fit-step throughput (1e5 TOAs, "
+                "spindown+DM+astrometry, jacfwd design + SVD solve)",
+                "value": round(toas_per_sec, 1),
+                "unit": "TOAs/sec",
+                "vs_baseline": round(t_cpu / t_dev, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

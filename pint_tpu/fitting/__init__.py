@@ -11,7 +11,11 @@ def auto_fitter(toas, model, **kw):
     if any(
         c.introduces_correlated_errors for c in model.noise_components
     ):
-        from pint_tpu.fitting.gls import GLSFitter
+        try:
+            from pint_tpu.fitting.gls import GLSFitter
+        except ImportError as e:
+            from pint_tpu.exceptions import CorrelatedErrors
 
+            raise CorrelatedErrors(model) from e
         return GLSFitter(toas, model, **kw)
     return WLSFitter(toas, model, **kw)

@@ -100,10 +100,11 @@ class WLSFitter:
                 self.converged = True
                 break
 
-        # parameter covariance (offset row/col dropped, matching the
-        # reference's parameter_covariance_matrix without Offset)
-        cov = np.asarray(cov)
-        sigmas = np.sqrt(np.diag(cov))[1:]
+        # parameter covariance in free_names order (offset row/col
+        # dropped, matching the reference's parameter_covariance_matrix
+        # without Offset)
+        cov = np.asarray(cov)[1:, 1:]
+        sigmas = np.sqrt(np.diag(cov))
         self.parameter_covariance_matrix = cov
         self.cm.commit(np.asarray(x), uncertainties=sigmas)
         self.resids = Residuals(

@@ -46,13 +46,22 @@ class DispersionDM(DelayComponent):
         self.prefix_patterns = ["DM"]
 
     def validate(self, model):
+        from pint_tpu.exceptions import TimingModelError
+
         if (
             self.params["DM1"].value is not None
             and self.params["DMEPOCH"].value is None
         ):
-            from pint_tpu.exceptions import TimingModelError
-
             raise TimingModelError("DMEPOCH required when DM1 is set")
+        set_ks = [
+            int(n[2:]) for n in self.params
+            if n.startswith("DM") and n[2:].isdigit()
+            and self.params[n].value is not None
+        ]
+        if set_ks and sorted(set_ks) != list(range(1, max(set_ks) + 1)):
+            raise TimingModelError(
+                f"non-contiguous DM derivatives: DM{sorted(set_ks)}"
+            )
 
     def _coeffs(self, pdict):
         out = [pdict["DM"]]
@@ -104,6 +113,9 @@ class DispersionDMX(DelayComponent):
             int(n[4:]) for n in self.params
             if n.startswith("DMX_") and self.params[n].value is not None
         )
+
+    def extra_masks(self, toas) -> dict[str, np.ndarray]:
+        return self.dmx_masks(toas)
 
     def dmx_masks(self, toas) -> dict[str, np.ndarray]:
         """Host-side: per-range 0/1 masks from DMXR1/DMXR2."""

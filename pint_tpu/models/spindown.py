@@ -38,10 +38,16 @@ class Spindown(PhaseComponent):
 
     def validate(self, model):
         self.require("F0")
-        if any(
-            self.params[f"F{k}"].value is not None
-            for k in range(1, self._max_k() + 1)
-        ) and self.params["PEPOCH"].value is None:
+        set_ks = sorted(
+            int(n[1:]) for n in self.params
+            if n.startswith("F") and n[1:].isdigit()
+            and self.params[n].value is not None
+        )
+        if set_ks and set_ks != list(range(0, set_ks[-1] + 1)):
+            raise TimingModelError(
+                f"non-contiguous spin terms: F{set_ks} (gaps not allowed)"
+            )
+        if len(set_ks) > 1 and self.params["PEPOCH"].value is None:
             raise TimingModelError("PEPOCH required when F1.. are set")
 
     def _max_k(self):

@@ -183,6 +183,9 @@ class TimingModel:
         for c in self._ordered_components():
             for n in c.mask_params:
                 masks[n] = c.params[n].select(toas).astype(np.float64)
+            # component-specific static selections (DMX ranges, SWX, ...)
+            if hasattr(c, "extra_masks"):
+                masks.update(c.extra_masks(toas))
         bundle = make_bundle(toas, masks)
         return CompiledModel(self, bundle, subtract_mean=subtract_mean)
 
@@ -316,11 +319,17 @@ class CompiledModel:
         raise TimingModelError("no spindown component in model")
 
     def phase_residuals(self, x):
-        """Phase residuals in cycles (f64), no mean subtraction."""
+        """Phase residuals in cycles (f64), no mean subtraction.
+
+        -padd flags / tim PHASE commands add (integer) turns to the
+        model phase before pulse-number subtraction (reference:
+        Residuals.calc_phase_resids); with 'nearest' tracking integer
+        adds cancel by construction.
+        """
         ph = self.phase(x)
         if self.track_mode == "use_pulse_numbers":
             pn = self.bundle.pulse_number
-            return (ph.int_ - pn) + ph.frac
+            return (ph.int_ - pn + self.bundle.padd) + ph.frac
         return ph.frac
 
     def _weights(self):

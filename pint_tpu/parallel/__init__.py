@@ -1,0 +1,18 @@
+"""Distributed layer: mesh definition, sharding specs, collectives.
+
+Reference parity: the reference has NO distributed backend (SURVEY.md
+§2 parallelism checklist) — its axes of scale are NumPy vectorization
+over TOAs and BLAS threads.  Here the same axes become first-class mesh
+axes:
+  'toa'    — data parallelism over the TOA axis (residual/design kernels)
+  'pulsar' — batch parallelism over pulsars (PTA-scale vmap)
+  'model'  — model parallelism for dense covariance factorizations
+XLA collectives (psum for normal-equation reduction, collective-permute
+inside sharded Cholesky) ride ICI within a slice / DCN across slices.
+"""
+
+from pint_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_bundle,
+    replicate,
+)

@@ -1,0 +1,92 @@
+"""Mesh construction + bundle sharding.
+
+The TOA axis is the framework's data axis: every per-TOA kernel
+(residuals, design matrix, noise scaling) is embarrassingly parallel
+over it, and the GLS normal equations reduce over it (psum inserted by
+XLA).  ``shard_bundle`` places a TOABundle's leading axis across the
+'toa' mesh axis; everything else (parameters, bases) is replicated or
+model-sharded by the fitters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pint_tpu.ops.dd import DD
+from pint_tpu.toas.bundle import TOABundle
+
+
+def make_mesh(
+    n_toa_shards: Optional[int] = None,
+    n_pulsar_shards: int = 1,
+    devices=None,
+) -> Mesh:
+    """Mesh with axes ('pulsar', 'toa').
+
+    Defaults to all local devices on the toa axis — the right layout for
+    single-pulsar fits; PTA batches trade devices onto the pulsar axis.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n_toa_shards is None:
+        n_toa_shards = n // n_pulsar_shards
+    if n_toa_shards * n_pulsar_shards != n:
+        raise ValueError(
+            f"{n_pulsar_shards} x {n_toa_shards} != {n} devices"
+        )
+    dev = np.asarray(devices).reshape(n_pulsar_shards, n_toa_shards)
+    return Mesh(dev, axis_names=("pulsar", "toa"))
+
+
+def _pad_to(n: int, k: int) -> int:
+    return (n + k - 1) // k * k
+
+
+def pad_bundle(bundle: TOABundle, multiple: int) -> tuple[TOABundle, np.ndarray]:
+    """Pad the TOA axis to a multiple of the shard count.
+
+    Padded entries get zero weight via the returned validity mask (f64
+    0/1); zero-weight TOAs contribute nothing to fits (weights multiply
+    every reduction).  Padding duplicates the last TOA so kernels stay
+    NaN-free.
+    """
+    n = bundle.ntoa
+    m = _pad_to(n, multiple)
+    if m == n:
+        return bundle, np.ones(n)
+    pad = m - n
+
+    def padleaf(x):
+        if isinstance(x, jnp.ndarray) and x.ndim >= 1 and x.shape[0] == n:
+            return jnp.concatenate(
+                [x, jnp.repeat(x[-1:], pad, axis=0)], axis=0
+            )
+        return x
+
+    new = jax.tree_util.tree_map(padleaf, bundle)
+    valid = np.concatenate([np.ones(n), np.zeros(pad)])
+    return new, valid
+
+
+def shard_bundle(bundle: TOABundle, mesh: Mesh) -> TOABundle:
+    """Place every per-TOA leaf across the 'toa' mesh axis."""
+    n = bundle.ntoa
+    sharding = NamedSharding(mesh, P("toa"))
+
+    def place(x):
+        if isinstance(x, jnp.ndarray) and x.ndim >= 1 and x.shape[0] == n:
+            spec = ("toa",) + (None,) * (x.ndim - 1)
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return x
+
+    del sharding
+    return jax.tree_util.tree_map(place, bundle)
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -77,10 +77,9 @@ def calculate_random_models(
     """Draw parameter vectors from the fit covariance and return per-draw
     residual curves (reference: simulation.calculate_random_models)."""
     rng = rng or np.random.default_rng()
-    cov = fitter.parameter_covariance_matrix
+    cov = fitter.parameter_covariance_matrix  # free_names order
     if cov is None:
         raise ValueError("fit first")
-    cov = cov[1:, 1:]  # drop offset
     L = np.linalg.cholesky(cov + 1e-30 * np.eye(len(cov)))
     draws = rng.normal(size=(n_models, len(cov))) @ L.T
     out = []

@@ -155,9 +155,20 @@ class TimeArray:
 
     # ------------------------------------------------------------------ #
     def to_mjd_strings(self, ndigits: int = 19) -> list[str]:
-        """Decimal MJD strings (pulsar_mjd convention), round-trip safe."""
+        """Decimal MJD strings (pulsar_mjd convention), round-trip safe.
+
+        Limitation inherited from the pulsar_mjd format itself: an
+        instant *inside* a leap second (sec-of-day >= 86400) has no
+        representation; such values raise rather than silently shifting
+        into the next day.
+        """
         from decimal import Decimal, localcontext
 
+        if self.scale == "utc" and np.any(self.sec.hi >= SECS_PER_DAY):
+            raise PintTpuError(
+                "cannot serialize an instant inside a leap second in "
+                "pulsar_mjd format; convert to a uniform scale first"
+            )
         out = []
         for i in range(len(self.mjd_int)):
             with localcontext() as ctx:

@@ -34,6 +34,7 @@ class TOABundle(NamedTuple):
     obs_sun_pos_ls: jnp.ndarray  # (n,3) obs->Sun, light-seconds
     obs_planet_pos_ls: dict  # body -> (n,3) obs->planet, light-seconds
     pulse_number: jnp.ndarray  # (n,) f64; NaN where untracked
+    padd: jnp.ndarray  # (n,) f64 phase adds from -padd flags / PHASE cmds
     masks: dict  # mask-param name -> (n,) f64 0/1
 
     @property
@@ -80,6 +81,9 @@ def make_bundle(
     pn = toas.get_pulse_numbers()
     if pn is None:
         pn = np.full(n, np.nan)
+    padd = np.array(
+        [float(f.get("padd", 0.0)) for f in toas.flags], dtype=np.float64
+    )
     return TOABundle(
         tdb_day=jnp.asarray(toas.t_tdb.mjd_int, dtype=jnp.float64),
         tdb_sec=DD(
@@ -94,5 +98,6 @@ def make_bundle(
             k: jnp.asarray(v / C) for k, v in toas.obs_planet_pos.items()
         },
         pulse_number=jnp.asarray(pn),
+        padd=jnp.asarray(padd),
         masks={k: jnp.asarray(v, dtype=jnp.float64) for k, v in (masks or {}).items()},
     )

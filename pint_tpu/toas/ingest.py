@@ -46,8 +46,14 @@ def ingest(toas: TOAs, ephem: str = "builtin", planets: bool = False,
     """Full observatory ingest (clock chain -> TDB -> posvels)."""
     if all(o.lower() in BARY_SITES for o in toas.obs):
         return ingest_barycentric(toas)
-    from pint_tpu.toas.ingest_topo import ingest_topocentric
-
+    try:
+        from pint_tpu.toas.ingest_topo import ingest_topocentric
+    except ImportError as e:
+        raise PintTpuError(
+            "topocentric ingest (clock chain + Earth rotation + ephemeris)"
+            " is not available in this build yet; only barycentric "
+            "(site '@') data is supported"
+        ) from e
     return ingest_topocentric(
         toas, ephem=ephem, planets=planets, include_bipm=include_bipm,
         bipm_version=bipm_version, limits=limits,
