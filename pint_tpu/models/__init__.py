@@ -2,3 +2,30 @@
 
 Reference parity: src/pint/models/ (SURVEY.md §2b).
 """
+
+from pint_tpu.models.astrometry import (  # noqa: F401
+    AstrometryEcliptic,
+    AstrometryEquatorial,
+)
+from pint_tpu.models.builder import get_model, get_model_and_toas  # noqa: F401
+from pint_tpu.models.component import (  # noqa: F401
+    Component,
+    DelayComponent,
+    NoiseComponent,
+    PhaseComponent,
+)
+from pint_tpu.models.dispersion import (  # noqa: F401
+    DispersionDM,
+    DispersionDMX,
+    DMJump,
+)
+from pint_tpu.models.jump import DelayJump, PhaseJump  # noqa: F401
+from pint_tpu.models.pulsar_binary import (  # noqa: F401
+    BinaryELL1,
+    BinaryELL1H,
+    BinaryELL1k,
+    PulsarBinary,
+)
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
+from pint_tpu.models.spindown import Spindown  # noqa: F401
+from pint_tpu.models.timing_model import CompiledModel, TimingModel  # noqa: F401

@@ -1,0 +1,8 @@
+"""Binary orbital-delay kernels (the stand-alone-model analogue).
+
+Reference parity: src/pint/models/stand_alone_psr_binaries/ — the
+unit-free orbital math, separated from the parameter-marshalling wrapper
+components in pint_tpu.models.pulsar_binary.  Everything here is pure
+jnp/DD kernel code: trace-safe, vmap-safe, differentiable (the design
+matrix is jax.jacfwd of these kernels; no hand-written d_X_d_par chain).
+"""

@@ -1,0 +1,82 @@
+"""ELL1-family binary delays (small-eccentricity expansion).
+
+Reference parity: src/pint/models/stand_alone_psr_binaries/ELL1_model.py
+(ELL1model, ELL1Hmodel) and ELL1k_model.py — Lange et al. 2001 expansion
+of the Roemer delay to first order in eccentricity, the tempo2-style
+emission-time (inverse-timing) correction, and Shapiro delay in either
+(M2, SINI) or orthometric (H3, H4/STIG; Freire & Wex 2010)
+parameterization.
+
+All functions are pure f64 jnp kernels of the orbital longitude
+``phi`` (already DD-extracted, see binaries/orbits.py) and scalar
+parameters in internal units (seconds, radians, dimensionless).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def roemer_terms(phi, a1, eps1, eps2):
+    """ELL1 Roemer delay and its first two phi-derivatives.
+
+    Dre  = a1 [ sin(phi) + (eps2/2) sin(2 phi) - (eps1/2) cos(2 phi) ]
+    (first order in e; constant -3/2 eps1 term absorbed into TASC,
+    matching tempo2/reference convention).
+    """
+    s, c = jnp.sin(phi), jnp.cos(phi)
+    s2, c2 = jnp.sin(2.0 * phi), jnp.cos(2.0 * phi)
+    dre = a1 * (s + 0.5 * (eps2 * s2 - eps1 * c2))
+    drep = a1 * (c + eps2 * c2 + eps1 * s2)
+    drepp = a1 * (-s + 2.0 * (eps1 * c2 - eps2 * s2))
+    return dre, drep, drepp
+
+
+def inverse_timing(dre, drep, drepp, nb):
+    """Emission-time correction: the delay must be evaluated at
+    t_em = t - Delta; expanding Delta(t - Delta) to second order
+    (reference: ELL1model.delayR / tempo2 ELL1model.C):
+
+      Dre' = Dre (1 - nb Drep + (nb Drep)^2 + 1/2 nb^2 Dre Drepp)
+    """
+    nbdrep = nb * drep
+    return dre * (1.0 - nbdrep + nbdrep * nbdrep + 0.5 * nb * nb * dre * drepp)
+
+
+def shapiro_ms(phi, m2_tsun, sini):
+    """Shapiro delay -2 r ln(1 - s sin phi); r = TSUN*M2 passed in
+    seconds (m2_tsun)."""
+    arg = 1.0 - sini * jnp.sin(phi)
+    return -2.0 * m2_tsun * jnp.log(jnp.maximum(arg, 1e-30))
+
+
+def shapiro_h3_stig(phi, h3, stig):
+    """Orthometric Shapiro (Freire & Wex 2010): exact resummation with
+    r = h3/stig^3, s = 2 stig/(1+stig^2)."""
+    r = h3 / (stig * stig * stig)
+    s = 2.0 * stig / (1.0 + stig * stig)
+    return shapiro_ms(phi, r, s)
+
+
+def shapiro_h3_only(phi, h3):
+    """H3-only approximation: keep just the third harmonic,
+    Delta_S ~= -(4/3) h3 sin(3 phi)  (Freire & Wex 2010 eq. 19)."""
+    return -(4.0 / 3.0) * h3 * jnp.sin(3.0 * phi)
+
+
+def eps_at_t(dt_f, eps1, eps2, eps1dot=0.0, eps2dot=0.0):
+    """Linear-in-time Laplace-Lagrange parameters (ELL1)."""
+    return eps1 + eps1dot * dt_f, eps2 + eps2dot * dt_f
+
+
+def eps_at_t_k(dt_f, eps1_0, eps2_0, omdot=0.0, lnedot=0.0):
+    """ELL1k variant (Susobhanan et al. 2018): explicit periastron
+    advance OMDOT (rad/s) and fractional eccentricity-rate LNEDOT (1/s):
+
+      e(t) = e0 (1 + lnedot dt);  omega(t) = omega0 + omdot dt
+    """
+    om0 = jnp.arctan2(eps1_0, eps2_0)
+    e0 = jnp.sqrt(eps1_0 * eps1_0 + eps2_0 * eps2_0)
+    e = e0 * (1.0 + lnedot * dt_f)
+    om = om0 + omdot * dt_f
+    return e * jnp.sin(om), e * jnp.cos(om)

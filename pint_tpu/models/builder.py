@@ -1,0 +1,181 @@
+"""Model builder: par file -> instantiated, validated TimingModel.
+
+Reference parity: src/pint/models/model_builder.py::ModelBuilder,
+get_model, get_model_and_toas — component selection from the parameter
+-> component reverse map, BINARY-line binary-wrapper choice, alias and
+prefix/mask-parameter routing, UNITS check.
+
+Selection rule: a registered component is included iff the par file
+contains a parameter name that *only* that component accepts (its
+"trigger" params); shared names (PX, POSEPOCH, ...) never trigger but
+route fine once a component is in.  Binary wrappers are chosen solely by
+the BINARY line.  SolarSystemShapiro is a default component whenever an
+astrometry component is present (matching the reference's default list).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Union
+
+# import the component zoo so the registry is populated
+import pint_tpu.models.astrometry  # noqa: F401
+import pint_tpu.models.dispersion  # noqa: F401
+import pint_tpu.models.jump  # noqa: F401
+import pint_tpu.models.pulsar_binary  # noqa: F401
+import pint_tpu.models.solar_system_shapiro  # noqa: F401
+import pint_tpu.models.spindown  # noqa: F401
+from pint_tpu.exceptions import TimingModelError, UnknownParameter
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.astrometry import Astrometry
+from pint_tpu.models.component import Component
+from pint_tpu.models.pulsar_binary import PulsarBinary
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+from pint_tpu.models.timing_model import TimingModel
+
+# par-file lines that are not parameters
+_IGNORE = {"MODE", "EPHVER", "END", "NITS", "IBOOT"}
+
+
+class ModelBuilder:
+    def __init__(self):
+        self.registry = dict(Component.component_types)
+
+    # -- selection --------------------------------------------------------
+    def _binary_class(self, name: str):
+        for cls in self.registry.values():
+            if (
+                issubclass(cls, PulsarBinary)
+                and cls.binary_model_name.upper() == name.upper()
+            ):
+                return cls
+        raise TimingModelError(f"unknown binary model {name!r}")
+
+    def choose_components(self, pardict) -> list[Component]:
+        nonbinary = {
+            n: cls for n, cls in self.registry.items()
+            if not issubclass(cls, PulsarBinary)
+        }
+        self._protos: dict = {}
+
+        def acceptors(par_name):
+            out = []
+            for n, cls in nonbinary.items():
+                proto = self._protos.setdefault(n, cls())
+                if (
+                    par_name in proto.mask_families()
+                    or proto.ensure_param(par_name) is not None
+                ):
+                    out.append(n)
+            return out
+
+        chosen: set[str] = set()
+        for par_name in pardict:
+            if par_name in _IGNORE:
+                continue
+            hits = acceptors(par_name)
+            if len(hits) == 1:
+                chosen.add(hits[0])
+        comps = [self.registry[n]() for n in sorted(chosen)]
+        n_astrom = sum(isinstance(c, Astrometry) for c in comps)
+        if n_astrom > 1:
+            raise TimingModelError(
+                "par file mixes equatorial (RAJ/DECJ) and ecliptic "
+                "(ELONG/ELAT) astrometry"
+            )
+        if "BINARY" in pardict:
+            comps.append(self._binary_class(pardict["BINARY"][0][0])())
+        if n_astrom and not any(
+            isinstance(c, SolarSystemShapiro) for c in comps
+        ):
+            comps.append(SolarSystemShapiro())
+        return comps
+
+    # -- routing ----------------------------------------------------------
+    def __call__(self, par) -> TimingModel:
+        pardict = parse_parfile(par)
+        units = pardict.get("UNITS", [["TDB"]])[0][0].upper()
+        if units == "TCB":
+            warnings.warn(
+                "UNITS TCB: TCB->TDB parameter conversion is not applied "
+                "yet; parameters are interpreted as TDB",
+                UserWarning,
+            )
+        comps = self.choose_components(pardict)
+        model = TimingModel(components=comps)
+        mask_counters: dict[tuple[int, str], int] = {}
+        unknown = {}
+        for name, entries in pardict.items():
+            if name in _IGNORE:
+                continue
+            if self._route_top(model, name, entries):
+                continue
+            routed = False
+            for c in model.components.values():
+                fams = c.mask_families()
+                if name in fams:
+                    key = (id(c), name)
+                    for tokens in entries:
+                        mask_counters[key] = mask_counters.get(key, 0) + 1
+                        p = fams[name](mask_counters[key])
+                        p.set_from_tokens(tokens)
+                    routed = True
+                    break
+                p = c.ensure_param(name)
+                if p is not None:
+                    if len(entries) > 1:
+                        warnings.warn(
+                            f"repeated par-file line {name}; using the first",
+                            UserWarning,
+                        )
+                    p.set_from_tokens(entries[0])
+                    routed = True
+                    break
+            if not routed:
+                unknown[name] = entries
+        if unknown:
+            warnings.warn(
+                f"unrecognized par-file parameters {sorted(unknown)}",
+                UnknownParameterWarning,
+            )
+        model.unrecognized = unknown
+        model.name = model.top_params["PSR"].value or ""
+        model.setup()
+        model.validate()
+        return model
+
+    @staticmethod
+    def _route_top(model, name, entries) -> bool:
+        for p in model.top_params.values():
+            if p.name_matches(name):
+                p.set_from_tokens(entries[0])
+                return True
+        return False
+
+
+class UnknownParameterWarning(UserWarning):
+    """Par-file lines no component understands (reference raises/warns via
+    UnknownParameter; here the model still builds)."""
+
+
+def get_model(par) -> TimingModel:
+    """par file (path, text, or file object) -> TimingModel."""
+    return ModelBuilder()(par)
+
+
+def get_model_and_toas(
+    par, tim, ephem: str = None, planets: bool = None, **ingest_kw
+):
+    """Load a par/tim pair and run the full ingest pipeline (§3.1)."""
+    from pint_tpu.io.tim import get_TOAs_from_tim
+    from pint_tpu.toas.ingest import ingest
+
+    model = get_model(par)
+    toas = get_TOAs_from_tim(tim)
+    if ephem is None:
+        ephem = (model.top_params["EPHEM"].value or "builtin").lower()
+    if planets is None:
+        ps = model.params.get("PLANET_SHAPIRO")
+        planets = bool(ps.value) if ps is not None else False
+    ingest(toas, ephem=ephem, planets=planets, **ingest_kw)
+    return model, toas

@@ -113,6 +113,24 @@ class Component:
                 raise MissingParameter(type(self).__name__, n)
 
     # -- builder support -------------------------------------------------
+    def mask_families(self) -> dict:
+        """prefix -> factory(index)->maskParameter for repeated par lines
+        (JUMP, EFAC, ...); overridden by components with mask families."""
+        return {}
+
+    def new_prefix_param(self, name: str):
+        """Create a Parameter for an indexed-family name not yet
+        instantiated (F13, DMX_0017, ...); None if unrecognized."""
+        return None
+
+    def ensure_param(self, name: str):
+        """Existing/alias/freshly-created Parameter for ``name``; None if
+        this component does not understand it (builder routing hook)."""
+        canon = self.match_param_alias(name)
+        if canon is not None:
+            return self.params[canon]
+        return self.new_prefix_param(name)
+
     @classmethod
     def accepted_param_names(cls) -> set[str]:
         """All par-file names (incl. aliases, excl. prefix indices) this
@@ -124,6 +142,7 @@ class Component:
             names.update(a.upper() for a in p.aliases)
         for pref in getattr(proto, "prefix_patterns", []):
             names.add(pref.upper() + "#")
+        names.update(k.upper() for k in proto.mask_families())
         return names
 
     def __repr__(self):

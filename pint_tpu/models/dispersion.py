@@ -45,6 +45,20 @@ class DispersionDM(DelayComponent):
         self.add_param(MJDParameter("DMEPOCH", time_scale="tdb"))
         self.prefix_patterns = ["DM"]
 
+    def new_prefix_param(self, name):
+        from pint_tpu.models.parameter import prefix_index
+
+        k = prefix_index(name, "DM")
+        if k is None or k < 1:  # DM0 is not a valid derivative
+            return None
+        return self.add_param(
+            floatParameter(
+                f"DM{k}",
+                units=f"pc/cm^3/yr^{k}",
+                scale_to_internal=SECS_PER_JULIAN_YEAR ** (-k),
+            )
+        )
+
     def validate(self, model):
         from pint_tpu.exceptions import TimingModelError
 
@@ -108,6 +122,30 @@ class DispersionDMX(DelayComponent):
         self.add_param(floatParameter(f"DMXR2_{idx:04d}", units="MJD"))
         self.dmx_indices.append(idx)
 
+    def new_prefix_param(self, name):
+        from pint_tpu.models.parameter import prefix_index
+
+        for pref in ("DMX_", "DMXR1_", "DMXR2_"):
+            idx = prefix_index(name, pref)
+            if idx is not None:
+                if f"DMX_{idx:04d}" not in self.params:
+                    self.add_dmx_range(idx)
+                return self.params[f"{pref}{idx:04d}"]
+        return None
+
+    def validate(self, model):
+        for i in self.dmx_indices:
+            if (
+                self.params[f"DMXR1_{i:04d}"].value is None
+                or self.params[f"DMXR2_{i:04d}"].value is None
+            ):
+                from pint_tpu.exceptions import MissingParameter
+
+                raise MissingParameter(
+                    "DispersionDMX", f"DMXR1_{i:04d}/DMXR2_{i:04d}",
+                    f"DMX_{i:04d} is set but its MJD range bounds are not",
+                )
+
     def setup(self, model):
         self.dmx_indices = sorted(
             int(n[4:]) for n in self.params
@@ -161,6 +199,9 @@ class DMJump(DelayComponent):
         p = self.add_param(maskParameter(name, index=idx, units="pc/cm^3"))
         self.dmjump_params.append(name)
         return p
+
+    def mask_families(self):
+        return {"DMJUMP": self.add_dmjump}
 
     def delay_term(self, pdict, bundle, acc_delay):
         return jnp.zeros(bundle.ntoa)

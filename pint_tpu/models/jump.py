@@ -1,0 +1,72 @@
+"""Phase and delay jumps over TOA subsets.
+
+Reference parity: src/pint/models/jump.py::PhaseJump (JUMP maskParameter
+family; a JUMP of J seconds advances the emission time, i.e. subtracts
+J * F0 cycles of phase for selected TOAs) and DelayJump (JUMP applied as
+seconds of delay; tempo1 heritage, rarely used).  Selections become
+static 0/1 mask arrays at compile time (SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.component import DelayComponent, PhaseComponent
+from pint_tpu.models.parameter import maskParameter
+from pint_tpu.ops.dd import DD
+
+
+class PhaseJump(PhaseComponent):
+    register = True
+    category = "phase_jump"
+
+    def __init__(self):
+        super().__init__()
+        self.jump_params: list[str] = []
+
+    def add_jump(self, idx: int) -> maskParameter:
+        name = f"JUMP{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units="s"))
+        self.jump_params.append(name)
+        return p
+
+    def mask_families(self):
+        return {"JUMP": self.add_jump}
+
+    def phase_term(self, pdict, bundle, delay):
+        f0 = pdict["F0"]
+        f0 = f0.to_float() if isinstance(f0, DD) else f0
+        jump_s = jnp.zeros(bundle.ntoa)
+        for n in self.jump_params:
+            jump_s = jump_s + pdict[n] * bundle.masks[n]
+        # J seconds of jump = -J*F0 cycles (delay-equivalent convention)
+        return DD.from_float(-jump_s * f0)
+
+
+class DelayJump(DelayComponent):
+    """JUMP applied as seconds of delay (tempo1 MODE 1 convention).
+
+    Not selected by the builder (PhaseJump takes JUMP lines, matching the
+    reference default); available for explicit construction.
+    """
+
+    category = "jump_delay"
+
+    def __init__(self):
+        super().__init__()
+        self.jump_params: list[str] = []
+
+    def add_jump(self, idx: int) -> maskParameter:
+        name = f"JUMP{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units="s"))
+        self.jump_params.append(name)
+        return p
+
+    def mask_families(self):
+        return {"JUMP": self.add_jump}
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        d = jnp.zeros(bundle.ntoa)
+        for n in self.jump_params:
+            d = d + pdict[n] * bundle.masks[n]
+        return d

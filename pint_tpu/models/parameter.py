@@ -152,9 +152,25 @@ class Parameter:
 class floatParameter(Parameter):
     param_type = "float"
 
-    def __init__(self, name, value=None, long_double=False, **kw):
+    def __init__(
+        self,
+        name,
+        value=None,
+        long_double=False,
+        unit_scale=False,
+        scale_factor=1e-12,
+        scale_threshold=1e-7,
+        **kw,
+    ):
         # long_double (reference naming) => DD precision here
         self.precision = "dd" if long_double else "f64"
+        # tempo convention: PBDOT/XDOT/EDOT values larger than threshold
+        # are taken to be in units of scale_factor (reference:
+        # parameter.py::floatParameter unit_scale)
+        self.unit_scale = unit_scale
+        self.scale_factor = scale_factor
+        self.scale_threshold = scale_threshold
+        self._applied_scale = False
         super().__init__(name, value=value, **kw)
 
     def _coerce(self, v):
@@ -168,8 +184,27 @@ class floatParameter(Parameter):
 
     def _parse_value_str(self, s):
         if self.precision == "dd":
-            return HostDD.from_string(_fortran_to_e(s))
-        return _parse_float_str(s)
+            v = HostDD.from_string(_fortran_to_e(s))
+            if self.unit_scale and abs(float(v.to_float())) > self.scale_threshold:
+                self._applied_scale = True
+                return v * self.scale_factor
+            return v
+        v = _parse_float_str(s)
+        if self.unit_scale and abs(v) > self.scale_threshold:
+            self._applied_scale = True
+            return v * self.scale_factor
+        return v
+
+    def set_from_tokens(self, tokens):
+        self._applied_scale = False
+        super().set_from_tokens(tokens)
+        # tempo scaling applies to an uncertainty parsed from THESE tokens
+        # only (never to a pre-existing uncertainty)
+        has_unc_token = len(tokens) >= 3 or (
+            len(tokens) == 2 and tokens[1] not in ("0", "1")
+        )
+        if self._applied_scale and has_unc_token and self.uncertainty is not None:
+            self.uncertainty *= self.scale_factor
 
     def set_internal(self, v):
         if self.precision == "dd" and not isinstance(v, HostDD):
@@ -263,8 +298,24 @@ class MJDParameter(Parameter):
             return None
         return (int(self._value.mjd_int[0]), self._value.sec[0])
 
+    def add_internal_delta(self, dsec: float):
+        """Shift the epoch by dsec seconds (fitting epochs operates on a
+        seconds-delta from the reference value)."""
+        self._value = self._value.add_seconds(dsec)
+
     def set_internal(self, v):
-        raise PintTpuError("epoch parameters are not fittable directly")
+        raise PintTpuError(
+            "epoch parameters update via add_internal_delta, not set_internal"
+        )
+
+    def internal_uncertainty(self):
+        """Uncertainty in seconds (par-file convention is days)."""
+        if self.uncertainty is None:
+            return None
+        return self.uncertainty * 86400.0
+
+    def set_internal_uncertainty(self, u):
+        self.uncertainty = u / 86400.0
 
 
 class AngleParameter(Parameter):
@@ -367,6 +418,18 @@ class prefixParameter:
         par = self.template(name)
         par.index = index
         return par
+
+
+def prefix_index(name: str, prefix: str) -> Optional[int]:
+    """Index of a prefixed-family name: ('F12','F')->12; None if ``name``
+    is not ``prefix`` + digits.  Shared by component new_prefix_param
+    hooks so naming edge cases live in one place."""
+    name = name.upper()
+    p = prefix.upper()
+    if not name.startswith(p):
+        return None
+    tail = name[len(p):]
+    return int(tail) if tail.isdigit() else None
 
 
 def split_prefixed_name(name: str) -> tuple[str, str, int]:

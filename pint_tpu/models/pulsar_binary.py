@@ -1,0 +1,270 @@
+"""Binary wrapper components: parameters -> stand-alone delay kernels.
+
+Reference parity: src/pint/models/pulsar_binary.py::PulsarBinary plus the
+per-model wrappers (binary_ell1.py, binary_bt.py, binary_dd.py, ...).
+The wrapper owns the Parameter zoo (units, aliases, tempo scaling
+conventions) and marshals internal-unit scalars into the pure kernels in
+pint_tpu.models.binaries; derivatives come from jax.jacfwd of the whole
+phase kernel, so no per-parameter derivative plumbing exists here.
+
+Internal units: seconds (PB, GAMMA, H3/H4), light-seconds (A1),
+radians (OM), rad/s (OMDOT), dimensionless (ECC, EPS1/2, SINI, PBDOT).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from pint_tpu.constants import SECS_PER_DAY, SECS_PER_JULIAN_YEAR, TSUN
+from pint_tpu.exceptions import TimingModelError
+from pint_tpu.models.binaries import ell1 as _ell1
+from pint_tpu.models.binaries.orbits import (
+    nb_fb,
+    nb_pb,
+    orbits_fb,
+    orbits_pb,
+    phase_from_orbits,
+)
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+)
+from pint_tpu.ops.dd import DD
+
+_DEG = math.pi / 180.0
+_DEG_PER_YEAR = _DEG / SECS_PER_JULIAN_YEAR
+
+
+class PulsarBinary(DelayComponent):
+    """Base class: Keplerian + common post-Keplerian parameters."""
+
+    category = "pulsar_system"
+    binary_model_name: str = ""
+    epoch_param = "T0"
+
+    def __init__(self, max_fb: int = 12):
+        super().__init__()
+        self.add_param(
+            floatParameter(
+                "PB", units="d", long_double=True,
+                scale_to_internal=SECS_PER_DAY,
+                description="orbital period",
+            )
+        )
+        self.add_param(
+            floatParameter("PBDOT", units="s/s", unit_scale=True)
+        )
+        self.add_param(
+            floatParameter("XPBDOT", units="s/s", unit_scale=True)
+        )
+        self.add_param(
+            floatParameter(
+                "A1", units="ls", aliases=("X",),
+                description="projected semi-major axis",
+            )
+        )
+        self.add_param(
+            floatParameter(
+                "A1DOT", units="ls/s", aliases=("XDOT",), unit_scale=True
+            )
+        )
+        self.add_param(MJDParameter("T0", time_scale="tdb"))
+        self.add_param(floatParameter("ECC", units="", aliases=("E",)))
+        self.add_param(floatParameter("EDOT", units="1/s", unit_scale=True))
+        self.add_param(
+            floatParameter("OM", units="deg", scale_to_internal=_DEG)
+        )
+        self.add_param(
+            floatParameter(
+                "OMDOT", units="deg/yr", scale_to_internal=_DEG_PER_YEAR
+            )
+        )
+        self.add_param(floatParameter("M2", units="Msun"))
+        self.add_param(floatParameter("SINI", units=""))
+        self.add_param(floatParameter("GAMMA", units="s"))
+        # FBn: orbital-frequency Taylor series alternative to PB
+        self.add_param(
+            floatParameter("FB0", units="1/s", long_double=True,
+                           aliases=("FB",))
+        )
+        for k in range(1, max_fb + 1):
+            self.add_param(floatParameter(f"FB{k}", units=f"1/s^{k + 1}"))
+        self.prefix_patterns = ["FB"]
+
+    def new_prefix_param(self, name):
+        from pint_tpu.models.parameter import prefix_index
+
+        k = prefix_index(name, "FB")
+        if k is None:
+            return None
+        return self.add_param(floatParameter(f"FB{k}", units=f"1/s^{k + 1}"))
+
+    # -- shared marshalling ----------------------------------------------
+    def val(self, pdict, name, default=0.0):
+        v = pdict.get(name)
+        if v is None:
+            return default
+        return v.to_float() if isinstance(v, DD) else v
+
+    def _use_fb(self):
+        return self.params["FB0"].value is not None
+
+    def _fb_list(self, pdict):
+        out = [pdict["FB0"]]
+        k = 1
+        while self.params.get(f"FB{k}") is not None and \
+                self.params[f"FB{k}"].value is not None:
+            out.append(pdict[f"FB{k}"])
+            k += 1
+        return out
+
+    def _dt(self, pdict, bundle, acc_delay) -> DD:
+        day, sec = pdict[self.epoch_param]
+        return bundle.dt_seconds(day, sec) - acc_delay
+
+    def _orbits(self, pdict, dt: DD) -> DD:
+        if self._use_fb():
+            return orbits_fb(dt, self._fb_list(pdict))
+        return orbits_pb(
+            dt, pdict["PB"], self.val(pdict, "PBDOT"),
+            self.val(pdict, "XPBDOT"),
+        )
+
+    def _nb(self, pdict, dt_f):
+        if self._use_fb():
+            return nb_fb(dt_f, self._fb_list(pdict))
+        return nb_pb(
+            dt_f, pdict["PB"], self.val(pdict, "PBDOT"),
+            self.val(pdict, "XPBDOT"),
+        )
+
+    def _a1(self, pdict, dt_f):
+        return self.val(pdict, "A1") + self.val(pdict, "A1DOT") * dt_f
+
+    def validate(self, model):
+        if not self._use_fb():
+            self.require("PB")
+        self.require("A1", self.epoch_param)
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        dt = self._dt(pdict, bundle, acc_delay)
+        return self._binary_delay(pdict, dt)
+
+    def _binary_delay(self, pdict, dt: DD):
+        raise NotImplementedError
+
+
+class BinaryELL1(PulsarBinary):
+    """Lange et al. 2001 small-eccentricity model.
+
+    Reference: models/binary_ell1.py::BinaryELL1 +
+    stand_alone_psr_binaries/ELL1_model.py.
+    """
+
+    register = True
+    binary_model_name = "ELL1"
+    epoch_param = "TASC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("TASC", time_scale="tdb"))
+        self.add_param(floatParameter("EPS1", units="", value=None))
+        self.add_param(floatParameter("EPS2", units="", value=None))
+        self.add_param(floatParameter("EPS1DOT", units="1/s", unit_scale=True))
+        self.add_param(floatParameter("EPS2DOT", units="1/s", unit_scale=True))
+        # ELL1 does not use T0/ECC/OM directly
+        for n in ("T0", "ECC", "EDOT", "OM", "OMDOT", "GAMMA"):
+            self.remove_param(n)
+
+    def validate(self, model):
+        super().validate(model)
+        self.require("EPS1", "EPS2")
+
+    def _eps(self, pdict, dt_f):
+        return _ell1.eps_at_t(
+            dt_f, self.val(pdict, "EPS1"), self.val(pdict, "EPS2"),
+            self.val(pdict, "EPS1DOT"), self.val(pdict, "EPS2DOT"),
+        )
+
+    def _shapiro(self, pdict, phi):
+        if (
+            self.params["M2"].value is not None
+            and self.params["SINI"].value is not None
+        ):
+            return _ell1.shapiro_ms(
+                phi, TSUN * self.val(pdict, "M2"), self.val(pdict, "SINI")
+            )
+        return 0.0
+
+    def _binary_delay(self, pdict, dt: DD):
+        dt_f = dt.to_float()
+        phi, _ = phase_from_orbits(self._orbits(pdict, dt))
+        nb = self._nb(pdict, dt_f)
+        eps1, eps2 = self._eps(pdict, dt_f)
+        a1 = self._a1(pdict, dt_f)
+        dre, drep, drepp = _ell1.roemer_terms(phi, a1, eps1, eps2)
+        d = _ell1.inverse_timing(dre, drep, drepp, nb)
+        return d + self._shapiro(pdict, phi)
+
+
+class BinaryELL1H(BinaryELL1):
+    """ELL1 with orthometric Shapiro parameters (Freire & Wex 2010).
+
+    Reference: models/binary_ell1.py::BinaryELL1H /
+    ELL1H_model.ELL1Hmodel.
+    """
+
+    register = True
+    binary_model_name = "ELL1H"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("H3", units="s"))
+        self.add_param(floatParameter("H4", units="s"))
+        self.add_param(floatParameter("STIGMA", units="", aliases=("STIG", "VARSIGMA")))
+        self.add_param(floatParameter("NHARM", units=""))
+        for n in ("M2", "SINI"):
+            self.remove_param(n)
+
+    def validate(self, model):
+        super().validate(model)
+        self.require("H3")
+
+    def _shapiro(self, pdict, phi):
+        h3 = self.val(pdict, "H3")
+        if self.params["STIGMA"].value is not None:
+            return _ell1.shapiro_h3_stig(phi, h3, self.val(pdict, "STIGMA"))
+        if self.params["H4"].value is not None:
+            stig = self.val(pdict, "H4") / h3
+            return _ell1.shapiro_h3_stig(phi, h3, stig)
+        return _ell1.shapiro_h3_only(phi, h3)
+
+
+class BinaryELL1k(BinaryELL1):
+    """ELL1 variant with explicit OMDOT/LNEDOT (Susobhanan et al. 2018).
+
+    Reference: models/binary_ell1.py::BinaryELL1k / ELL1k_model.py.
+    """
+
+    register = True
+    binary_model_name = "ELL1K"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter(
+                "OMDOT", units="deg/yr", scale_to_internal=_DEG_PER_YEAR
+            )
+        )
+        self.add_param(floatParameter("LNEDOT", units="1/s", unit_scale=True))
+        for n in ("EPS1DOT", "EPS2DOT"):
+            self.remove_param(n)
+
+    def _eps(self, pdict, dt_f):
+        return _ell1.eps_at_t_k(
+            dt_f, self.val(pdict, "EPS1"), self.val(pdict, "EPS2"),
+            self.val(pdict, "OMDOT"), self.val(pdict, "LNEDOT"),
+        )

@@ -36,6 +36,14 @@ class Spindown(PhaseComponent):
         self.add_param(MJDParameter("PEPOCH", time_scale="tdb"))
         self.prefix_patterns = ["F"]
 
+    def new_prefix_param(self, name):
+        from pint_tpu.models.parameter import prefix_index
+
+        k = prefix_index(name, "F")
+        if k is None:
+            return None
+        return self.add_param(floatParameter(f"F{k}", units=f"Hz/s^{k}"))
+
     def validate(self, model):
         self.require("F0")
         set_ks = sorted(

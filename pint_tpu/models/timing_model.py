@@ -162,12 +162,12 @@ class TimingModel:
 
     @property
     def fittable_params(self) -> list[str]:
+        """Continuous set parameters (epochs included: they fit via a
+        seconds-delta, see CompiledModel._pdict / commit)."""
         out = []
         for c in self._ordered_components():
             for n, p in c.params.items():
-                if p.continuous and p.value is not None and not isinstance(
-                    p, MJDParameter
-                ):
+                if p.continuous and p.value is not None:
                     out.append(n)
         return out
 
@@ -277,11 +277,14 @@ class CompiledModel:
                 else:
                     pd[n] = const
             elif isinstance(v, tuple):
-                # epoch (day, HostDD sec): static — not fittable
+                # epoch (day, HostDD sec); if free, x[i] is a seconds delta
                 day, sec = v
-                pd[n] = (float(day), DD(
+                sec_dd = DD(
                     jnp.float64(float(sec.hi)), jnp.float64(float(sec.lo))
-                ))
+                )
+                if n in self._index:
+                    sec_dd = (sec_dd + x[self._index[n]]).normalize()
+                pd[n] = (float(day), sec_dd)
             elif isinstance(v, (float, int)):
                 if n in self._index:
                     pd[n] = jnp.float64(v) + x[self._index[n]]
@@ -384,7 +387,9 @@ class CompiledModel:
         for n, i in self._index.items():
             p = params[n]
             ref = self.ref[n]
-            if isinstance(ref, HostDD):
+            if isinstance(ref, tuple):
+                p.add_internal_delta(float(x[i]))
+            elif isinstance(ref, HostDD):
                 p.set_internal(ref + float(x[i]))
             else:
                 p.set_internal(float(ref) + float(x[i]))

@@ -1,0 +1,129 @@
+"""Model-builder tests: parfile -> component selection -> routing.
+
+Reference parity checks for model_builder.py::ModelBuilder behavior
+(component choice from params, BINARY line, aliases, prefix and mask
+families, round-trip through as_parfile).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import TimingModelError
+from pint_tpu.models.builder import UnknownParameterWarning, get_model
+
+PAR = """
+PSRJ            J1857+0943
+RAJ             18:57:36.3932884
+DECJ            +09:43:17.29196
+PMRA            -2.899
+PMDEC           -5.41
+PX              0.2629
+POSEPOCH        55637
+F0              186.49408156698235146  1  0.0000000000000698912
+F1              -6.2049e-16            1
+PEPOCH          55637
+DM              13.299393
+DM1             0.0001
+DMEPOCH         55637
+BINARY          ELL1
+PB              12.32717119132762      1
+A1              9.2307805              1
+TASC            55631.710921           1
+EPS1            -2.15e-05              1
+EPS2            1.2e-05                1
+SINI            0.9990
+M2              0.246
+JUMP            -fe L-wide 0.00032    1
+JUMP            mjd 55000 56000 1.5e-5
+EPHEM           DE440
+CLOCK           TT(BIPM2021)
+UNITS           TDB
+"""
+
+
+def test_component_selection():
+    m = get_model(PAR)
+    names = set(m.components)
+    assert {
+        "AstrometryEquatorial", "Spindown", "DispersionDM",
+        "BinaryELL1", "PhaseJump", "SolarSystemShapiro",
+    } <= names
+    assert "AstrometryEcliptic" not in names
+    assert "DispersionDMX" not in names
+
+
+def test_param_routing_and_values():
+    m = get_model(PAR)
+    assert m.params["PSR"].value == "J1857+0943"
+    assert not m.params["F0"].frozen
+    assert m.params["F0"].uncertainty == pytest.approx(6.98912e-14)
+    assert m.params["PMRA"].value == pytest.approx(-2.899)
+    # mask params: two JUMPs with distinct selections
+    assert m.params["JUMP1"].key == "-fe"
+    assert m.params["JUMP1"].key_value == ["L-wide"]
+    assert not m.params["JUMP1"].frozen
+    assert m.params["JUMP2"].key == "mjd"
+    assert m.params["JUMP2"].value == pytest.approx(1.5e-5)
+    assert m.params["M2"].value == pytest.approx(0.246)
+    assert m.top_params["EPHEM"].value == "DE440"
+
+
+def test_alias_routing():
+    par = PAR.replace("A1 ", "X  ").replace("ECC", "E")
+    m = get_model(par)
+    assert m.params["A1"].value == pytest.approx(9.2307805)
+
+
+def test_binary_required_for_binary_params():
+    with pytest.raises(TimingModelError):
+        get_model("PSR J0\nF0 10 1\nBINARY FOO\nPB 1\nA1 1\nTASC 55000\n")
+
+
+def test_mixed_astrometry_rejected():
+    with pytest.raises(TimingModelError):
+        get_model(
+            "PSR J0\nF0 10\nPEPOCH 55000\nRAJ 1:2:3\nDECJ 1:2:3\n"
+            "ELONG 12.3\nELAT 45.6\n"
+        )
+
+
+def test_unknown_params_warn():
+    with pytest.warns(UnknownParameterWarning):
+        m = get_model("PSR J0\nF0 10\nPEPOCH 55000\nNOTAPARAM 12\n")
+    assert "NOTAPARAM" in m.unrecognized
+
+
+def test_parfile_round_trip():
+    m = get_model(PAR)
+    text = m.as_parfile()
+    m2 = get_model(text)
+    for n in ("F0", "PB", "A1", "EPS1", "PMRA", "M2"):
+        v1, v2 = m.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-12), n
+    assert m2.params["JUMP1"].key == "-fe"
+    assert set(m.components) == set(m2.components)
+
+
+def test_prefix_param_beyond_preallocated():
+    par = "PSR J0\nF0 10 1\nPEPOCH 55000\n" + "\n".join(
+        f"F{k} 1e-{20 + k}" for k in range(1, 15)
+    )
+    m = get_model(par)
+    assert m.params["F14"].value == pytest.approx(1e-34)
+
+
+def test_dmx_routing():
+    par = (
+        "PSR J0\nF0 10 1\nPEPOCH 55000\nDM 10\n"
+        "DMX_0001 0.001 1\nDMXR1_0001 54000\nDMXR2_0001 54500\n"
+        "DMX_0002 -0.002 1\nDMXR1_0002 54500\nDMXR2_0002 55000\n"
+    )
+    m = get_model(par)
+    assert "DispersionDMX" in m.components
+    c = m.components["DispersionDMX"]
+    assert c.dmx_indices == [1, 2]
+    assert m.params["DMX_0002"].value == pytest.approx(-0.002)
