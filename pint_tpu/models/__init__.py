@@ -21,6 +21,11 @@ from pint_tpu.models.dispersion import (  # noqa: F401
 )
 from pint_tpu.models.jump import DelayJump, PhaseJump  # noqa: F401
 from pint_tpu.models.pulsar_binary import (  # noqa: F401
+    BinaryBT,
+    BinaryDD,
+    BinaryDDGR,
+    BinaryDDK,
+    BinaryDDS,
     BinaryELL1,
     BinaryELL1H,
     BinaryELL1k,

@@ -57,6 +57,22 @@ class Astrometry(DelayComponent):
         """Unit vector(s) SSB->pulsar at each TOA, (n,3)."""
         raise NotImplementedError
 
+    def sky_basis(self, pdict):
+        """(east, north) unit vectors on the sky at the reference position
+        in ICRS xyz — the (I0, J0) basis of Kopeikin 1995 used by DDK."""
+        raise NotImplementedError
+
+    def proper_motion(self, pdict):
+        """(pm_long, pm_lat) in rad/s in this component's frame
+        (PMRA/PMDEC or PMELONG/PMELAT)."""
+        raise NotImplementedError
+
+    def px_rad(self, pdict):
+        """Parallax in radians (0.0 if unset)."""
+        if self.params["PX"].value is None:
+            return 0.0
+        return pdict["PX"]
+
     def delay_term(self, pdict, bundle, acc_delay):
         n = self.ssb_to_psr_xyz(pdict, bundle)
         r = bundle.ssb_obs_pos_ls  # light-seconds
@@ -109,6 +125,29 @@ class AstrometryEquatorial(Astrometry):
             [jnp.cos(ra) * cosd, jnp.sin(ra) * cosd, jnp.sin(dec)], axis=-1
         )
 
+    def sky_basis(self, pdict):
+        ra, dec = pdict["RAJ"], pdict["DECJ"]
+        east = jnp.stack(
+            [-jnp.sin(ra), jnp.cos(ra), jnp.zeros_like(ra)], axis=-1
+        )
+        north = jnp.stack(
+            [
+                -jnp.cos(ra) * jnp.sin(dec),
+                -jnp.sin(ra) * jnp.sin(dec),
+                jnp.cos(dec),
+            ],
+            axis=-1,
+        )
+        return east, north
+
+    def proper_motion(self, pdict):
+        pml = pdict.get("PMRA")
+        pmb = pdict.get("PMDEC")
+        return (
+            0.0 if pml is None else pml,
+            0.0 if pmb is None else pmb,
+        )
+
 
 class AstrometryEcliptic(Astrometry):
     register = True
@@ -145,6 +184,15 @@ class AstrometryEcliptic(Astrometry):
         # reference reads data/runtime ecliptic.dat keyed by ECL
         return OBL_J2000
 
+    def _ecl_to_equ(self, v):
+        eps = self._obliquity()
+        ce, se = jnp.cos(eps), jnp.sin(eps)
+        # rotate ecliptic -> equatorial (x axis shared)
+        x = v[..., 0]
+        y = ce * v[..., 1] - se * v[..., 2]
+        z = se * v[..., 1] + ce * v[..., 2]
+        return jnp.stack([x, y, z], axis=-1)
+
     def ssb_to_psr_xyz(self, pdict, bundle):
         dt = self._dt_pos(pdict, bundle)
         lam0, bet0 = pdict["ELONG"], pdict["ELAT"]
@@ -156,10 +204,27 @@ class AstrometryEcliptic(Astrometry):
         x_ecl = jnp.stack(
             [jnp.cos(lam) * cb, jnp.sin(lam) * cb, jnp.sin(bet)], axis=-1
         )
-        eps = self._obliquity()
-        ce, se = jnp.cos(eps), jnp.sin(eps)
-        # rotate ecliptic -> equatorial (x axis shared)
-        x = x_ecl[..., 0]
-        y = ce * x_ecl[..., 1] - se * x_ecl[..., 2]
-        z = se * x_ecl[..., 1] + ce * x_ecl[..., 2]
-        return jnp.stack([x, y, z], axis=-1)
+        return self._ecl_to_equ(x_ecl)
+
+    def sky_basis(self, pdict):
+        lam, bet = pdict["ELONG"], pdict["ELAT"]
+        east = jnp.stack(
+            [-jnp.sin(lam), jnp.cos(lam), jnp.zeros_like(lam)], axis=-1
+        )
+        north = jnp.stack(
+            [
+                -jnp.cos(lam) * jnp.sin(bet),
+                -jnp.sin(lam) * jnp.sin(bet),
+                jnp.cos(bet),
+            ],
+            axis=-1,
+        )
+        return self._ecl_to_equ(east), self._ecl_to_equ(north)
+
+    def proper_motion(self, pdict):
+        pml = pdict.get("PMELONG")
+        pmb = pdict.get("PMELAT")
+        return (
+            0.0 if pml is None else pml,
+            0.0 if pmb is None else pmb,
+        )

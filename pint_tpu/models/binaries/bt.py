@@ -1,0 +1,30 @@
+"""Blandford & Teukolsky (1976) binary delay.
+
+Reference parity: src/pint/models/stand_alone_psr_binaries/BT_model.py
+(BTmodel) / tempo bnrybt.f — Keplerian Roemer + Einstein delay with the
+first-order emission-time correction Delta(t-Delta) ~= Delta (1 - dDelta/dt).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.binaries.kepler import kepler_solve
+
+
+def bt_delay(M, nb, a1, ecc, omega, gamma):
+    """BT timing delay (seconds).
+
+    M: mean anomaly in [-pi, pi) (from DD orbit counting); nb: angular
+    orbital frequency (rad/s); omega: longitude of periastron (rad);
+    all inputs per-TOA f64 arrays or scalars.
+    """
+    u = kepler_solve(M, ecc)
+    su, cu = jnp.sin(u), jnp.cos(u)
+    sw, cw = jnp.sin(omega), jnp.cos(omega)
+    alpha = a1 * sw
+    beta = a1 * jnp.sqrt(jnp.maximum(1.0 - ecc * ecc, 0.0)) * cw
+    d = alpha * (cu - ecc) + (beta + gamma) * su
+    # dDelta/dt = nb (-alpha sin u + (beta+gamma) cos u)/(1 - e cos u)
+    ddot = nb * (-alpha * su + (beta + gamma) * cu) / (1.0 - ecc * cu)
+    return d * (1.0 - ddot)

@@ -1,0 +1,30 @@
+"""Kepler-equation solve and anomaly conversions — jit/vmap-safe.
+
+Reference parity: the Newton iteration in
+src/pint/models/stand_alone_psr_binaries/BT_model.py / DD_model.py
+(compute_eccentric_anomaly).  Here the iteration count is FIXED
+(SURVEY.md §7 hard-part #5): Newton converges quadratically from
+E0 = M + e sin M, so 8 iterations reach f64 machine precision for any
+e < 0.97 — no data-dependent control flow, so XLA unrolls straight-line
+code that fuses and vmaps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def kepler_solve(M, ecc, iters: int = 8):
+    """Eccentric anomaly u solving u - e sin(u) = M (M in [-pi, pi))."""
+    u = M + ecc * jnp.sin(M)
+    for _ in range(iters):
+        u = u - (u - ecc * jnp.sin(u) - M) / (1.0 - ecc * jnp.cos(u))
+    return u
+
+
+def true_anomaly(u, ecc):
+    """True anomaly nu from eccentric anomaly u (same branch as u)."""
+    return 2.0 * jnp.arctan2(
+        jnp.sqrt(1.0 + ecc) * jnp.sin(0.5 * u),
+        jnp.sqrt(1.0 - ecc) * jnp.cos(0.5 * u),
+    )

@@ -151,10 +151,282 @@ class PulsarBinary(DelayComponent):
 
     def delay_term(self, pdict, bundle, acc_delay):
         dt = self._dt(pdict, bundle, acc_delay)
-        return self._binary_delay(pdict, dt)
+        return self._binary_delay(pdict, bundle, dt)
 
-    def _binary_delay(self, pdict, dt: DD):
+    def _binary_delay(self, pdict, bundle, dt: DD):
         raise NotImplementedError
+
+
+class BinaryBT(PulsarBinary):
+    """Blandford & Teukolsky (1976) model.
+
+    Reference: models/binary_bt.py::BinaryBT / BT_model.py.
+    """
+
+    register = True
+    binary_model_name = "BT"
+
+    def _ecc(self, pdict, dt_f):
+        return self.val(pdict, "ECC") + self.val(pdict, "EDOT") * dt_f
+
+    def _om(self, pdict, dt_f):
+        # BT: linear-in-time periastron advance
+        return self.val(pdict, "OM") + self.val(pdict, "OMDOT") * dt_f
+
+    def _binary_delay(self, pdict, bundle, dt: DD):
+        from pint_tpu.models.binaries.bt import bt_delay
+
+        dt_f = dt.to_float()
+        M, _ = phase_from_orbits(self._orbits(pdict, dt))
+        nb = self._nb(pdict, dt_f)
+        return bt_delay(
+            M, nb, self._a1(pdict, dt_f), self._ecc(pdict, dt_f),
+            self._om(pdict, dt_f), self.val(pdict, "GAMMA"),
+        )
+
+
+class BinaryDD(PulsarBinary):
+    """Damour & Deruelle (1986) quasi-relativistic model.
+
+    Reference: models/binary_dd.py::BinaryDD / DD_model.py.
+    """
+
+    register = True
+    binary_model_name = "DD"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("DR", units=""))
+        self.add_param(floatParameter("DTH", units="", aliases=("DTHETA",)))
+        self.add_param(floatParameter("A0", units="s"))
+        self.add_param(floatParameter("B0", units="s"))
+
+    def _nb0(self, pdict):
+        """Reference orbital angular frequency n (rad/s) for k=OMDOT/n."""
+        if self._use_fb():
+            fb0 = pdict["FB0"]
+            return 2.0 * math.pi * (
+                fb0.to_float() if isinstance(fb0, DD) else fb0
+            )
+        pb = pdict["PB"]
+        return 2.0 * math.pi / (pb.to_float() if isinstance(pb, DD) else pb)
+
+    def _ecc(self, pdict, dt_f):
+        return self.val(pdict, "ECC") + self.val(pdict, "EDOT") * dt_f
+
+    def _pk(self, pdict, dt_f):
+        """Post-Keplerian ingredients (overridden by DDS/DDGR)."""
+        return {
+            "k": self.val(pdict, "OMDOT") / self._nb0(pdict),
+            "gamma": self.val(pdict, "GAMMA"),
+            "m2r": TSUN * self.val(pdict, "M2"),
+            "sini": self.val(pdict, "SINI"),
+            "dr": self.val(pdict, "DR"),
+            "dth": self.val(pdict, "DTH"),
+        }
+
+    def _binary_delay(self, pdict, bundle, dt: DD):
+        from pint_tpu.models.binaries.dd import dd_delay
+
+        dt_f = dt.to_float()
+        M, norb = phase_from_orbits(self._orbits(pdict, dt))
+        nb = self._nb(pdict, dt_f)
+        pk = self._pk(pdict, dt_f)
+        return dd_delay(
+            M, norb, nb, self._a1(pdict, dt_f), self._ecc(pdict, dt_f),
+            self.val(pdict, "OM"), pk["k"], gamma=pk["gamma"],
+            m2r=pk["m2r"], sini=pk["sini"], dr=pk["dr"], dth=pk["dth"],
+            a0=self.val(pdict, "A0"), b0=self.val(pdict, "B0"),
+        )
+
+
+class BinaryDDS(BinaryDD):
+    """DD with SHAPMAX parameterization of the Shapiro shape,
+    s = 1 - exp(-SHAPMAX) (high-inclination systems).
+
+    Reference: models/binary_dd.py::BinaryDDS / DDS_model.py.
+    """
+
+    register = True
+    binary_model_name = "DDS"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("SHAPMAX", units=""))
+        self.remove_param("SINI")
+
+    def _pk(self, pdict, dt_f):
+        pk = super()._pk(pdict, dt_f)
+        pk["sini"] = 1.0 - jnp.exp(-self.val(pdict, "SHAPMAX"))
+        return pk
+
+
+class BinaryDDGR(BinaryDD):
+    """DD with all post-Keplerian parameters fixed by GR from
+    (MTOT, M2) — reference: models/binary_dd.py::BinaryDDGR /
+    DDGR_model.py.  XOMDOT/XPBDOT are excess terms beyond GR.
+    """
+
+    register = True
+    binary_model_name = "DDGR"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("MTOT", units="Msun"))
+        self.add_param(
+            floatParameter(
+                "XOMDOT", units="deg/yr", scale_to_internal=_DEG_PER_YEAR
+            )
+        )
+        for n in ("SINI", "GAMMA", "OMDOT", "PBDOT", "DR", "DTH"):
+            self.remove_param(n)
+
+    def validate(self, model):
+        super().validate(model)
+        self.require("MTOT", "M2")
+
+    def _gr(self, pdict, dt_f):
+        from pint_tpu.models.binaries.dd import gr_pk_params
+
+        pb = pdict.get("PB")
+        if pb is None:
+            fb0 = pdict["FB0"]
+            pb_s = 1.0 / (fb0.to_float() if isinstance(fb0, DD) else fb0)
+        else:
+            pb_s = pb.to_float() if isinstance(pb, DD) else pb
+        return gr_pk_params(
+            pb_s, self._ecc(pdict, dt_f), self.val(pdict, "A1"),
+            TSUN * self.val(pdict, "MTOT"), TSUN * self.val(pdict, "M2"),
+        )
+
+    def _orbits(self, pdict, dt: DD):
+        # PBDOT is the GR value (plus any XPBDOT excess)
+        gr = self._gr(pdict, 0.0)
+        if self._use_fb():
+            return orbits_fb(dt, self._fb_list(pdict))
+        return orbits_pb(
+            dt, pdict["PB"], gr["pbdot"], self.val(pdict, "XPBDOT")
+        )
+
+    def _nb(self, pdict, dt_f):
+        gr = self._gr(pdict, 0.0)
+        if self._use_fb():
+            return nb_fb(dt_f, self._fb_list(pdict))
+        return nb_pb(
+            dt_f, pdict["PB"], gr["pbdot"], self.val(pdict, "XPBDOT")
+        )
+
+    def _pk(self, pdict, dt_f):
+        gr = self._gr(pdict, dt_f)
+        return {
+            "k": gr["k"] + self.val(pdict, "XOMDOT") / self._nb0(pdict),
+            "gamma": gr["gamma"],
+            "m2r": TSUN * self.val(pdict, "M2"),
+            "sini": gr["sini"],
+            "dr": gr["dr"],
+            "dth": gr["dth"],
+        }
+
+
+class BinaryDDK(BinaryDD):
+    """DD with Kopeikin (1995, 1996) annual-orbital-parallax and
+    proper-motion coupling to astrometry.
+
+    Reference: models/binary_ddk.py::BinaryDDK / DDK_model.py.  KIN/KOM
+    orient the orbit on the sky (KOM from celestial North through East);
+    proper motion secularly drifts the apparent inclination and
+    periastron longitude, and the observer's SSB offset adds annual
+    terms scaled by 1/distance (needs PX).  Sign conventions follow
+    Kopeikin 1996 eqs. (10)-(11) and Kopeikin 1995 eq. (18)
+    [verify against reference mount when available].
+    """
+
+    register = True
+    binary_model_name = "DDK"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter("KIN", units="deg", scale_to_internal=_DEG)
+        )
+        self.add_param(
+            floatParameter("KOM", units="deg", scale_to_internal=_DEG)
+        )
+        from pint_tpu.models.parameter import boolParameter
+
+        self.add_param(boolParameter("K96", value=True))
+        self.remove_param("SINI")
+
+    def setup(self, model):
+        from pint_tpu.models.astrometry import Astrometry
+
+        self._astrometry_ref = None
+        for c in model.components.values():
+            if isinstance(c, Astrometry):
+                self._astrometry_ref = c
+
+    def validate(self, model):
+        super().validate(model)
+        self.require("KIN", "KOM")
+        if self._astrometry_ref is None:
+            raise TimingModelError(
+                "DDK requires an astrometry component (KIN/KOM couple the "
+                "orbit orientation to sky position)"
+            )
+
+    def _kopeikin(self, pdict, bundle, dt_f):
+        """-> (a1_eff, om_eff, kin) per TOA."""
+        ast = self._astrometry_ref
+        kin0 = pdict["KIN"]
+        kom = pdict["KOM"]
+        sk, ck = jnp.sin(kom), jnp.cos(kom)
+        sin_kin0 = jnp.sin(kin0)
+        cot_kin0 = jnp.cos(kin0) / sin_kin0
+        pml, pmb = ast.proper_motion(pdict)
+        # Kopeikin 1996: secular drift from proper motion
+        dkin_pm = (-pml * sk + pmb * ck) * dt_f
+        dom_pm = (pml * ck + pmb * sk) / sin_kin0 * dt_f
+        a1 = self._a1(pdict, dt_f)
+        a1_eff = a1 * (1.0 + cot_kin0 * dkin_pm)
+        om_eff = self.val(pdict, "OM") + dom_pm
+        kin = kin0 + dkin_pm
+        # Kopeikin 1995: annual orbital parallax (K96)
+        px = ast.px_rad(pdict)
+        if self.params["K96"].value and ast.params["PX"].value is not None:
+            from pint_tpu.constants import AU_LIGHT_SEC
+
+            d_ls = AU_LIGHT_SEC / px  # distance in light-seconds
+            east, north = ast.sky_basis(pdict)
+            r = bundle.ssb_obs_pos_ls
+            delta_i0 = jnp.sum(r * east, axis=-1)
+            delta_j0 = jnp.sum(r * north, axis=-1)
+            a1_eff = a1_eff + a1 / d_ls * cot_kin0 * (
+                delta_i0 * sk - delta_j0 * ck
+            )
+            om_eff = om_eff - (delta_i0 * ck + delta_j0 * sk) / (
+                d_ls * sin_kin0
+            )
+        return a1_eff, om_eff, kin
+
+    def _binary_delay(self, pdict, bundle, dt: DD):
+        from pint_tpu.models.binaries.dd import dd_delay
+
+        dt_f = dt.to_float()
+        M, norb = phase_from_orbits(self._orbits(pdict, dt))
+        nb = self._nb(pdict, dt_f)
+        a1_eff, om_eff, kin = self._kopeikin(pdict, bundle, dt_f)
+        pk = self._pk(pdict, dt_f)
+        return dd_delay(
+            M, norb, nb, a1_eff, self._ecc(pdict, dt_f),
+            om_eff, pk["k"], gamma=pk["gamma"],
+            m2r=pk["m2r"], sini=jnp.sin(kin), dr=pk["dr"], dth=pk["dth"],
+            a0=self.val(pdict, "A0"), b0=self.val(pdict, "B0"),
+        )
+
+    def _pk(self, pdict, dt_f):
+        pk = super()._pk(pdict, dt_f)
+        pk["sini"] = None  # replaced by sin(KIN) in _binary_delay
+        return pk
 
 
 class BinaryELL1(PulsarBinary):
@@ -199,7 +471,7 @@ class BinaryELL1(PulsarBinary):
             )
         return 0.0
 
-    def _binary_delay(self, pdict, dt: DD):
+    def _binary_delay(self, pdict, bundle, dt: DD):
         dt_f = dt.to_float()
         phi, _ = phase_from_orbits(self._orbits(pdict, dt))
         nb = self._nb(pdict, dt_f)
