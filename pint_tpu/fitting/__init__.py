@@ -3,6 +3,7 @@
 Reference parity: src/pint/fitter.py class hierarchy (SURVEY.md §3.3).
 """
 
+from pint_tpu.fitting.gls import GLSFitter  # noqa: F401
 from pint_tpu.fitting.wls import WLSFitter  # noqa: F401
 
 
@@ -11,11 +12,5 @@ def auto_fitter(toas, model, **kw):
     if any(
         c.introduces_correlated_errors for c in model.noise_components
     ):
-        try:
-            from pint_tpu.fitting.gls import GLSFitter
-        except ImportError as e:
-            from pint_tpu.exceptions import CorrelatedErrors
-
-            raise CorrelatedErrors(model) from e
         return GLSFitter(toas, model, **kw)
     return WLSFitter(toas, model, **kw)

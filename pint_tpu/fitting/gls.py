@@ -1,0 +1,181 @@
+"""Generalized-least-squares fitter (correlated noise).
+
+Reference parity: src/pint/fitter.py::GLSFitter.fit_toas — the hot fit
+loop of SURVEY.md §3.3.  The noise covariance is C = N + T phi T^T with
+diagonal N (scaled white errors) and a reduced-rank basis T (n,k),
+k << n (ECORR epochs + red-noise harmonics).  Normal equations solve via
+the Woodbury identity:
+
+  C^-1 = N^-1 - N^-1 T (phi^-1 + T^T N^-1 T)^-1 T^T N^-1
+
+so only k x k and p x p Cholesky factorizations run — all on device
+(XLA Cholesky / triangular solves on the MXU).  full_cov=True takes the
+explicit n x n dense path (the O(n^3) wall the TPU build attacks; used
+for cross-validation and benchmarking).
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import ConvergenceFailure
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import Residuals
+from pint_tpu.toas.toas import TOAs
+
+
+def _chol_solve(A, B, jitter: float = 0.0):
+    """Solve A X = B with A symmetric positive-definite via Cholesky."""
+    if jitter:
+        A = A + jitter * jnp.eye(A.shape[0])
+    L = jnp.linalg.cholesky(A)
+    Y = jax.scipy.linalg.solve_triangular(L, B, lower=True)
+    return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
+
+
+def gls_step_woodbury(r, M, Ndiag, T, phi):
+    """One GLS normal-equation solve, reduced-rank path.
+
+    r (n,), M (n,p), Ndiag (n,), T (n,k), phi (k,) ->
+    (dx (p,), cov (p,p), chi2 (scalar whitened r^T C^-1 r)).
+    """
+    Ninv = 1.0 / Ndiag
+    # Sigma = phi^-1 + T^T N^-1 T  (k x k)
+    TN = T * Ninv[:, None]  # N^-1 T  (n,k)
+    Sigma = jnp.diag(1.0 / phi) + T.T @ TN
+
+    def cinv_mult(X):
+        """C^-1 X for X (n,m) via Woodbury."""
+        NX = X * Ninv[:, None]
+        return NX - TN @ _chol_solve(Sigma, TN.T @ X)
+
+    # column normalization for conditioning (reference trick)
+    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    CiM = cinv_mult(Mn)
+    Cir = cinv_mult(r[:, None])[:, 0]
+    A = Mn.T @ CiM
+    b = -(Mn.T @ Cir)
+    dxn = _chol_solve(A, b[:, None])[:, 0]
+    covn = _chol_solve(A, jnp.eye(A.shape[0]))
+    # post-solve chi2: r^T C^-1 r minus the fitted decrement dx^T A dx
+    # (removes the offset-column power; matches the reference's convention)
+    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
+    return dxn / norm, covn / jnp.outer(norm, norm), chi2
+
+
+def gls_step_full_cov(r, M, Ndiag, T, phi):
+    """Dense-covariance path: C = diag(N) + T phi T^T, explicit n x n
+    Cholesky (reference full_cov=True)."""
+    C = jnp.diag(Ndiag)
+    if T is not None:
+        C = C + (T * phi[None, :]) @ T.T
+    L = jnp.linalg.cholesky(C)
+
+    def cinv_mult(X):
+        Y = jax.scipy.linalg.solve_triangular(L, X, lower=True)
+        return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
+
+    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    CiM = cinv_mult(Mn)
+    Cir = cinv_mult(r[:, None])[:, 0]
+    A = Mn.T @ CiM
+    b = -(Mn.T @ Cir)
+    dxn = _chol_solve(A, b[:, None])[:, 0]
+    covn = _chol_solve(A, jnp.eye(A.shape[0]))
+    # post-solve chi2: r^T C^-1 r minus the fitted decrement dx^T A dx
+    # (removes the offset-column power; matches the reference's convention)
+    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
+    return dxn / norm, covn / jnp.outer(norm, norm), chi2
+
+
+class GLSFitter:
+    """Iterated GLS fit; also correct (equals WLS) with no correlated
+    noise in the model."""
+
+    def __init__(self, toas: TOAs, model: TimingModel, full_cov: bool = False):
+        self.toas = toas
+        self.model = model
+        self.full_cov = full_cov
+        self.cm = model.compile(toas)
+        self.resids_init = Residuals(toas, model, compiled=self.cm)
+        self.resids: Residuals = self.resids_init
+        self.converged = False
+        self.parameter_covariance_matrix: np.ndarray | None = None
+
+    def _design_with_offset(self, x):
+        M = self.cm.design_matrix(x)
+        ones = jnp.ones((self.cm.bundle.ntoa, 1))
+        return jnp.concatenate([ones, M], axis=1)
+
+    def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
+        full_cov = self.full_cov
+
+        @jax.jit
+        def step(x):
+            r = self.cm.time_residuals(x, subtract_mean=False)
+            M = self._design_with_offset(x)
+            Ndiag = jnp.square(self.cm.scaled_sigma(x))
+            bw = self.cm.noise_basis(x)
+            if bw is None:
+                # pure white: Woodbury with an empty basis degenerates to
+                # WLS normal equations
+                T = jnp.zeros((self.cm.bundle.ntoa, 1))
+                phi = jnp.ones(1) * 1e-40
+                if full_cov:
+                    return gls_step_full_cov(r, M, Ndiag, None, None)
+                return gls_step_woodbury(r, M, Ndiag, T, phi)
+            T, phi = bw
+            if full_cov:
+                return gls_step_full_cov(r, M, Ndiag, T, phi)
+            return gls_step_woodbury(r, M, Ndiag, T, phi)
+
+        x = self.cm.x0()
+        chi2 = None
+        cov = None
+        for it in range(maxiter):
+            dx, cov, chi2_new = step(x)
+            chi2_new = float(chi2_new)
+            if not np.isfinite(chi2_new):
+                raise ConvergenceFailure("non-finite chi2 during GLS fit")
+            x = x + dx[1:]  # dx[0] is the offset column
+            if chi2 is not None and abs(chi2 - chi2_new) < tol_chi2 * max(
+                chi2_new, 1.0
+            ):
+                chi2 = chi2_new
+                self.converged = True
+                break
+            chi2 = chi2_new
+
+        cov = np.asarray(cov)[1:, 1:]
+        sigmas = np.sqrt(np.diag(cov))
+        self.parameter_covariance_matrix = cov
+        self.cm.commit(np.asarray(x), uncertainties=sigmas)
+        self.resids = Residuals(self.toas, self.model, compiled=self.cm)
+        self.model.top_params["CHI2"].value = float(chi2)
+        self.chi2 = float(chi2)
+        return float(chi2)
+
+    def print_summary(self) -> str:
+        lines = [
+            f"Fitted model using GLS ({'full-cov' if self.full_cov else 'Woodbury'}) "
+            f"with {len(self.cm.free_names)} free parameters, "
+            f"{len(self.toas)} TOAs",
+            f"chi2 = {self.chi2:.4f}",
+            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
+        ]
+        for n in self.cm.free_names:
+            p = self.model.params[n]
+            lines.append(
+                f"{n:<12}{p._format_value():>25}"
+                f"{p.uncertainty if p.uncertainty is not None else float('nan'):>15.3e}"
+            )
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -65,12 +65,16 @@ class WLSFitter:
         return jnp.concatenate([ones, M], axis=1)
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
-        w = jnp.asarray(1.0 / (self.toas.error_us * 1e-6) ** 2)
+        if self.cm.has_correlated_errors:
+            from pint_tpu.exceptions import CorrelatedErrors
+
+            raise CorrelatedErrors(self.model)
 
         @jax.jit
         def step(x):
             r = self._r(x)
             M = self._design_with_offset(x)
+            w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
             dx, cov, nbad = _wls_step(r, M, w)
             return dx, cov, nbad
 

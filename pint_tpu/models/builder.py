@@ -22,6 +22,7 @@ from typing import Union
 import pint_tpu.models.astrometry  # noqa: F401
 import pint_tpu.models.dispersion  # noqa: F401
 import pint_tpu.models.jump  # noqa: F401
+import pint_tpu.models.noise  # noqa: F401
 import pint_tpu.models.pulsar_binary  # noqa: F401
 import pint_tpu.models.solar_system_shapiro  # noqa: F401
 import pint_tpu.models.spindown  # noqa: F401

@@ -1,0 +1,306 @@
+"""Noise-model components: white rescaling + reduced-rank correlated bases.
+
+Reference parity: src/pint/models/noise_model.py — ScaleToaError
+(EFAC/EQUAD/TNEQ), ScaleDmError (DMEFAC/DMEQUAD), EcorrNoise (ECORR),
+PLRedNoise (TNRED*), PLDMNoise (TNDM*).  Two consumer interfaces,
+matching the reference's scaled_toa_sigma and
+noise_model_designmatrix/basis_weight pair:
+
+  scaled_sigma(pdict, bundle, sigma_s) -> per-TOA white sigma (seconds)
+  basis_weight(pdict, bundle) -> (basis (n,k), weight (k,)) or None
+
+The covariance never materializes as N x N unless a fitter explicitly
+asks (full_cov): correlated noise enters as C = N + T phi T^T with
+k << n (SURVEY.md §5 long-context strategy — the Woodbury/reduced-rank
+trick is the blockwise-attention analogue and we keep it).
+
+Epoch quantization for ECORR and the selection masks are computed
+host-side at compile time and shipped as static arrays in the bundle
+(SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import SECS_PER_JULIAN_YEAR
+from pint_tpu.models.component import NoiseComponent
+from pint_tpu.models.parameter import floatParameter, maskParameter
+
+F_YR = 1.0 / SECS_PER_JULIAN_YEAR
+
+# TOAs closer than this are one observing epoch for ECORR quantization
+ECORR_EPOCH_GAP_S = 10.0
+
+
+class ScaleToaError(NoiseComponent):
+    """sigma' = EFAC * sqrt(sigma^2 + EQUAD^2) over mask selections
+    (tempo2 convention, matching the reference's ScaleToaError)."""
+
+    register = True
+    category = "scale_toa_error"
+
+    def __init__(self):
+        super().__init__()
+        self.efac_params: list[str] = []
+        self.equad_params: list[str] = []
+        self.tneq_params: list[str] = []
+
+    def add_efac(self, idx: int):
+        name = f"EFAC{idx}"
+        p = self.add_param(
+            maskParameter(name, index=idx, units="", aliases=("T2EFAC",))
+        )
+        self.efac_params.append(name)
+        return p
+
+    def add_equad(self, idx: int):
+        name = f"EQUAD{idx}"
+        p = self.add_param(
+            maskParameter(
+                name, index=idx, units="us", aliases=("T2EQUAD",),
+                scale_to_internal=1e-6,
+            )
+        )
+        self.equad_params.append(name)
+        return p
+
+    def add_tneq(self, idx: int):
+        """TNEQ: log10(EQUAD/s)."""
+        name = f"TNEQ{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units="log10(s)"))
+        self.tneq_params.append(name)
+        return p
+
+    def mask_families(self):
+        return {
+            "EFAC": self.add_efac,
+            "T2EFAC": self.add_efac,
+            "EQUAD": self.add_equad,
+            "T2EQUAD": self.add_equad,
+            "TNEQ": self.add_tneq,
+        }
+
+    def scaled_sigma(self, pdict, bundle, sigma_s):
+        equad2 = jnp.zeros_like(sigma_s)
+        for n in self.equad_params:
+            equad2 = equad2 + jnp.square(pdict[n]) * bundle.masks[n]
+        for n in self.tneq_params:
+            equad2 = equad2 + jnp.square(10.0 ** pdict[n]) * bundle.masks[n]
+        efac = jnp.ones_like(sigma_s)
+        for n in self.efac_params:
+            # masked multiplicative: efac where selected, 1 elsewhere
+            efac = efac * (1.0 + (pdict[n] - 1.0) * bundle.masks[n])
+        return efac * jnp.sqrt(jnp.square(sigma_s) + equad2)
+
+
+class ScaleDmError(NoiseComponent):
+    """DMEFAC/DMEQUAD: rescale wideband DM-measurement errors (consumed
+    by the wideband fitter, not the TOA sigma chain)."""
+
+    register = True
+    category = "scale_dm_error"
+
+    def __init__(self):
+        super().__init__()
+        self.dmefac_params: list[str] = []
+        self.dmequad_params: list[str] = []
+
+    def add_dmefac(self, idx: int):
+        name = f"DMEFAC{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units=""))
+        self.dmefac_params.append(name)
+        return p
+
+    def add_dmequad(self, idx: int):
+        name = f"DMEQUAD{idx}"
+        p = self.add_param(maskParameter(name, index=idx, units="pc/cm^3"))
+        self.dmequad_params.append(name)
+        return p
+
+    def mask_families(self):
+        return {"DMEFAC": self.add_dmefac, "DMEQUAD": self.add_dmequad}
+
+    def scaled_dm_sigma(self, pdict, bundle, sigma_dm):
+        equad2 = jnp.zeros_like(sigma_dm)
+        for n in self.dmequad_params:
+            equad2 = equad2 + jnp.square(pdict[n]) * bundle.masks[n]
+        efac = jnp.ones_like(sigma_dm)
+        for n in self.dmefac_params:
+            efac = efac * (1.0 + (pdict[n] - 1.0) * bundle.masks[n])
+        return efac * jnp.sqrt(jnp.square(sigma_dm) + equad2)
+
+
+def quantize_epochs(mjd: np.ndarray, select: np.ndarray,
+                    gap_s: float = ECORR_EPOCH_GAP_S) -> np.ndarray:
+    """Host-side: (n, n_epoch) 0/1 quantization matrix U grouping
+    selected TOAs into observing epochs (gap-based, like the
+    reference/enterprise create_quantization_matrix)."""
+    n = len(mjd)
+    idx = np.flatnonzero(select)
+    if idx.size == 0:
+        return np.zeros((n, 0))
+    order = idx[np.argsort(mjd[idx])]
+    cols = []
+    current = [order[0]]
+    for i in order[1:]:
+        if (mjd[i] - mjd[current[-1]]) * 86400.0 > gap_s:
+            cols.append(current)
+            current = [i]
+        else:
+            current.append(i)
+    cols.append(current)
+    U = np.zeros((n, len(cols)))
+    for j, members in enumerate(cols):
+        U[members, j] = 1.0
+    return U
+
+
+class EcorrNoise(NoiseComponent):
+    """Per-epoch fully-correlated white noise (ECORR): basis = epoch
+    quantization matrix U, weight = ECORR^2 per epoch."""
+
+    register = True
+    category = "ecorr_noise"
+    introduces_correlated_errors = True
+
+    def __init__(self):
+        super().__init__()
+        self.ecorr_params: list[str] = []
+
+    def add_ecorr(self, idx: int):
+        name = f"ECORR{idx}"
+        p = self.add_param(
+            maskParameter(
+                name, index=idx, units="us", aliases=("T2ECORR",),
+                scale_to_internal=1e-6,
+            )
+        )
+        self.ecorr_params.append(name)
+        return p
+
+    def mask_families(self):
+        return {"ECORR": self.add_ecorr, "T2ECORR": self.add_ecorr}
+
+    def extra_masks(self, toas) -> dict:
+        """Quantization matrices, computed once at compile time."""
+        out = {}
+        mjd = toas.mjd_float()
+        for n in self.ecorr_params:
+            sel = self.params[n].select(toas)
+            out[f"{n}:U"] = quantize_epochs(mjd, sel)
+        return out
+
+    def basis_weight(self, pdict, bundle):
+        bases, weights = [], []
+        for n in self.ecorr_params:
+            U = bundle.masks[f"{n}:U"]
+            if U.shape[1] == 0:
+                continue
+            bases.append(U)
+            weights.append(
+                jnp.square(pdict[n]) * jnp.ones(U.shape[1])
+            )
+        if not bases:
+            return None
+        return jnp.concatenate(bases, axis=1), jnp.concatenate(weights)
+
+
+def _toa_seconds(bundle) -> jnp.ndarray:
+    """Per-TOA time in seconds relative to the first TOA's day (f64;
+    harmonic phases need only ~1e-9 relative precision)."""
+    day0 = bundle.tdb_day[0]
+    return (bundle.tdb_day - day0) * 86400.0 + bundle.tdb_sec.to_float()
+
+
+def fourier_basis(bundle, nharm: int):
+    """(n, 2*nharm) sin/cos design matrix and the frequencies (Hz)."""
+    t = _toa_seconds(bundle)
+    tspan = jnp.max(t) - jnp.min(t)
+    j = jnp.arange(1, nharm + 1, dtype=jnp.float64)
+    f = j / tspan
+    arg = 2.0 * math.pi * t[:, None] * f[None, :]
+    F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=1)
+    return F, jnp.concatenate([f, f]), tspan
+
+
+def powerlaw_phi(f, tspan, log10_amp, gamma):
+    """Power-law PSD weights phi_j (s^2), enterprise convention:
+    phi_j = A^2/(12 pi^2) f_yr^(gamma-3) f_j^(-gamma) / Tspan."""
+    amp = 10.0 ** log10_amp
+    return (
+        amp * amp / (12.0 * math.pi * math.pi)
+        * F_YR ** (gamma - 3.0)
+        * f ** (-gamma)
+        / tspan
+    )
+
+
+class PLRedNoise(NoiseComponent):
+    """Power-law achromatic red noise (TNREDAMP/TNREDGAM/TNREDC)."""
+
+    register = True
+    category = "pl_red_noise"
+    introduces_correlated_errors = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter(
+                "TNREDAMP", units="log10(strain)", aliases=("TNRedAmp",)
+            )
+        )
+        self.add_param(
+            floatParameter("TNREDGAM", units="", aliases=("TNRedGam",))
+        )
+        self.add_param(
+            floatParameter("TNREDC", units="", aliases=("TNRedC",), value=None)
+        )
+
+    def validate(self, model):
+        self.require("TNREDAMP", "TNREDGAM")
+
+    def _nharm(self):
+        v = self.params["TNREDC"].value
+        return int(v) if v is not None else 30
+
+    def basis_weight(self, pdict, bundle):
+        F, f, tspan = fourier_basis(bundle, self._nharm())
+        phi = powerlaw_phi(
+            f, tspan, pdict["TNREDAMP"], pdict["TNREDGAM"]
+        )
+        return F, phi
+
+
+class PLDMNoise(NoiseComponent):
+    """Power-law DM (chromatic nu^-2) noise; basis columns scaled by
+    (1400 MHz / f)^2 so amplitudes share the red-noise convention."""
+
+    register = True
+    category = "pl_dm_noise"
+    introduces_correlated_errors = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter("TNDMAMP", units="log10", aliases=("TNDMAmp",))
+        )
+        self.add_param(floatParameter("TNDMGAM", units="", aliases=("TNDMGam",)))
+        self.add_param(floatParameter("TNDMC", units="", value=None))
+
+    def validate(self, model):
+        self.require("TNDMAMP", "TNDMGAM")
+
+    def _nharm(self):
+        v = self.params["TNDMC"].value
+        return int(v) if v is not None else 30
+
+    def basis_weight(self, pdict, bundle):
+        F, f, tspan = fourier_basis(bundle, self._nharm())
+        chrom = jnp.square(1400.0 / bundle.freq_mhz)
+        F = F * chrom[:, None]
+        phi = powerlaw_phi(f, tspan, pdict["TNDMAMP"], pdict["TNDMGAM"])
+        return F, phi

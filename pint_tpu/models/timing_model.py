@@ -335,9 +335,40 @@ class CompiledModel:
             return (ph.int_ - pn + self.bundle.padd) + ph.frac
         return ph.frac
 
-    def _weights(self):
-        w = 1.0 / jnp.square(self.bundle.error_us * 1e-6)
-        return w
+    def scaled_sigma(self, x):
+        """Per-TOA white uncertainty in seconds after noise-model
+        rescaling (reference: TimingModel.scaled_toa_sigma)."""
+        pd = self._pdict(x)
+        sig = self.bundle.error_us * 1e-6
+        for c in self.model.noise_components:
+            sig = c.scaled_sigma(pd, self.bundle, sig)
+        return sig
+
+    def noise_basis(self, x):
+        """Stacked correlated-noise basis/weights: (T (n,k), phi (k,)) or
+        None (reference: noise_model_designmatrix/basis_weight)."""
+        pd = self._pdict(x)
+        bases, weights = [], []
+        for c in self.model.noise_components:
+            bw = c.basis_weight(pd, self.bundle)
+            if bw is not None:
+                bases.append(bw[0])
+                weights.append(bw[1])
+        if not bases:
+            return None
+        return (
+            jnp.concatenate(bases, axis=1), jnp.concatenate(weights)
+        )
+
+    @property
+    def has_correlated_errors(self):
+        return any(
+            c.introduces_correlated_errors
+            for c in self.model.noise_components
+        )
+
+    def _weights(self, x):
+        return 1.0 / jnp.square(self.scaled_sigma(x))
 
     def time_residuals(self, x, subtract_mean: Optional[bool] = None):
         """Time residuals in seconds; weighted-mean-subtracted by default
@@ -347,13 +378,13 @@ class CompiledModel:
         f = self.spin_frequency(x)
         r = pr / f
         if sm:
-            w = self._weights()
+            w = self._weights(x)
             r = r - jnp.sum(w * r) / jnp.sum(w)
         return r
 
     def chi2(self, x):
         r = self.time_residuals(x)
-        w = self._weights()
+        w = self._weights(x)
         return jnp.sum(w * r * r)
 
     def design_matrix(self, x):

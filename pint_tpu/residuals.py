@@ -52,7 +52,7 @@ class Residuals:
         return self.chi2 / self.dof
 
     def rms_weighted(self) -> float:
-        """Weighted RMS of time residuals, seconds."""
+        """Weighted RMS of time residuals (scaled errors), seconds."""
         r = self.time_resids
-        w = 1.0 / (self.toas.error_us * 1e-6) ** 2
+        w = 1.0 / np.asarray(self.cm.scaled_sigma(self._x)) ** 2
         return float(np.sqrt(np.sum(w * r * r) / np.sum(w)))

@@ -1,0 +1,173 @@
+"""Noise models + GLS fitter tests.
+
+Cross-validation strategy (no external oracle needed):
+- white-only GLS must equal WLS (same fit, same uncertainties);
+- Woodbury path must equal the dense full-covariance path exactly;
+- injected correlated noise must be absorbed by the matching basis
+  (chi2 drops to ~white level) and inflate parameter uncertainties.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import CorrelatedErrors
+from pint_tpu.fitting.gls import GLSFitter
+from pint_tpu.fitting.wls import WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.models.noise import quantize_epochs
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR              J1744-1134
+F0               245.4261196898081  1
+F1               -5.38e-16          1
+PEPOCH           55000
+DM               3.1380             1
+"""
+
+PAR_EFAC = PAR + """
+EFAC             -f L-wide 1.5
+EQUAD            -f L-wide 2.0
+EFAC             -f S-wide 0.8
+"""
+
+
+def _toas_with_flags(model, n=150, seed=1):
+    toas = make_fake_toas_uniform(
+        54000, 56000, n, model, error_us=1.0,
+        freq_mhz=np.where(np.arange(n) % 2, 1400.0, 2300.0),
+        add_noise=False,
+    )
+    for i, f in enumerate(toas.flags):
+        f["f"] = "L-wide" if i % 2 else "S-wide"
+    return toas
+
+
+def test_scaled_sigma_efac_equad():
+    m = get_model(PAR_EFAC)
+    toas = _toas_with_flags(m)
+    cm = m.compile(toas)
+    sig = np.asarray(cm.scaled_sigma(cm.x0()))
+    lwide = np.array([f["f"] == "L-wide" for f in toas.flags])
+    # L-wide: 1.5*sqrt(1^2 + 2^2) us; S-wide: 0.8*1 us
+    np.testing.assert_allclose(
+        sig[lwide], 1.5 * np.sqrt(1 + 4) * 1e-6, rtol=1e-12
+    )
+    np.testing.assert_allclose(sig[~lwide], 0.8e-6, rtol=1e-12)
+
+
+def test_quantize_epochs():
+    mjd = np.array([100.0, 100.00001, 100.5, 100.50002, 101.0])
+    U = quantize_epochs(mjd, np.ones(5, bool), gap_s=10.0)
+    assert U.shape == (5, 3)
+    np.testing.assert_allclose(U.sum(axis=1), 1.0)
+    assert (U[:2, 0] == 1).all() and (U[2:4, 1] == 1).all() and U[4, 2] == 1
+
+
+def test_wls_refuses_correlated_model():
+    m = get_model(PAR + "ECORR -f L-wide 0.5\n")
+    toas = _toas_with_flags(m)
+    with pytest.raises(CorrelatedErrors):
+        WLSFitter(toas, m).fit_toas()
+
+
+def test_gls_white_equals_wls():
+    rng = np.random.default_rng(42)
+    m_true = get_model(PAR_EFAC)
+    toas = _toas_with_flags(m_true)
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, len(toas)))
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+
+    m_wls = get_model(PAR_EFAC)
+    m_gls = get_model(PAR_EFAC)
+    f_wls = WLSFitter(toas, m_wls)
+    f_wls.fit_toas(maxiter=4)
+    f_gls = GLSFitter(toas, m_gls)
+    f_gls.fit_toas(maxiter=4)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m_wls.params[n].value, m_gls.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-12, abs=1e-30), n
+        assert m_wls.params[n].uncertainty == pytest.approx(
+            m_gls.params[n].uncertainty, rel=1e-6
+        ), n
+
+
+def test_gls_woodbury_equals_full_cov():
+    rng = np.random.default_rng(7)
+    par = PAR + "ECORR -f L-wide 0.8\nTNREDAMP -13.2\nTNREDGAM 3.1\nTNREDC 15\n"
+    m_true = get_model(par)
+    toas = _toas_with_flags(m_true, n=120)
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, len(toas)))
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+
+    m1, m2 = get_model(par), get_model(par)
+    f1 = GLSFitter(toas, m1, full_cov=False)
+    c1 = f1.fit_toas(maxiter=3)
+    f2 = GLSFitter(toas, m2, full_cov=True)
+    c2 = f2.fit_toas(maxiter=3)
+    assert c1 == pytest.approx(c2, rel=1e-8)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-10, abs=1e-30), n
+        assert m1.params[n].uncertainty == pytest.approx(
+            m2.params[n].uncertainty, rel=1e-6
+        ), n
+
+
+def test_gls_absorbs_injected_red_noise():
+    """Inject a sinusoid-rich red signal drawn from the PL basis; the GLS
+    whitened chi2 must be ~white-level while WLS-style chi2 explodes."""
+    rng = np.random.default_rng(3)
+    par_white = PAR
+    par_red = PAR + "TNREDAMP -12.5\nTNREDGAM 4.0\nTNREDC 20\n"
+    m_true = get_model(par_white)
+    toas = _toas_with_flags(m_true, n=200)
+
+    # draw red realization from the model's own basis/weights
+    m_red = get_model(par_red)
+    cm = m_red.compile(toas)
+    T, phi = cm.noise_basis(cm.x0())
+    T, phi = np.asarray(T), np.asarray(phi)
+    coeffs = rng.normal(0, np.sqrt(phi))
+    red = T @ coeffs
+    white = rng.normal(0, 1e-6, len(toas))
+    toas.t = toas.t.add_seconds(red + white)
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+
+    m_fit = get_model(par_red)
+    f = GLSFitter(toas, m_fit)
+    chi2 = f.fit_toas(maxiter=3)
+    n = len(toas)
+    # whitened chi2 ~ n (the basis absorbs the red power)
+    assert chi2 < 2.0 * n
+    # and the naive white chi2 of the post-fit residuals is huge
+    assert f.resids.chi2 > 10.0 * n
+
+
+def test_gls_red_noise_inflates_f1_uncertainty():
+    rng = np.random.default_rng(5)
+    m_true = get_model(PAR)
+    toas = _toas_with_flags(m_true, n=150)
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, len(toas)))
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+
+    m_white = get_model(PAR)
+    GLSFitter(toas, m_white).fit_toas()
+    m_red = get_model(PAR + "TNREDAMP -12.8\nTNREDGAM 4.5\nTNREDC 10\n")
+    GLSFitter(toas, m_red).fit_toas()
+    # low-frequency basis functions covary with F1 -> bigger error bar
+    assert (
+        m_red.params["F1"].uncertainty > 2.0 * m_white.params["F1"].uncertainty
+    )
