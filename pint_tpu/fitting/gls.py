@@ -36,11 +36,42 @@ def _chol_solve(A, B, jitter: float = 0.0):
     return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
 
 
+def _solve_spd_threshold(A, B, threshold=None):
+    """Solve A X = B (A symmetric PSD) zeroing near-degenerate
+    eigendirections, mirroring the WLS SVD-threshold behavior so that
+    degenerate models (e.g. a JUMP selecting all TOAs) produce a
+    min-norm answer + DegeneracyWarning count instead of NaNs."""
+    w, V = jnp.linalg.eigh(A)
+    if threshold is None:
+        threshold = jnp.finfo(jnp.float64).eps * A.shape[0]
+    bad = w < threshold * jnp.max(w)
+    winv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, w))
+    return (V * winv[None, :]) @ (V.T @ B), jnp.sum(bad)
+
+
+def _solve_normal_eqs(cinv_mult, r, M):
+    """Shared GLS tail: column-normalize, form/solve normal equations,
+    post-solve chi2 (r^T C^-1 r minus the fitted decrement dx^T A dx —
+    removes the offset-column power, matching the reference)."""
+    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    CiM = cinv_mult(Mn)
+    Cir = cinv_mult(r[:, None])[:, 0]
+    A = Mn.T @ CiM
+    b = -(Mn.T @ Cir)
+    dxn, nbad = _solve_spd_threshold(A, b[:, None])
+    dxn = dxn[:, 0]
+    covn, _ = _solve_spd_threshold(A, jnp.eye(A.shape[0]))
+    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
+    return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
+
+
 def gls_step_woodbury(r, M, Ndiag, T, phi):
     """One GLS normal-equation solve, reduced-rank path.
 
     r (n,), M (n,p), Ndiag (n,), T (n,k), phi (k,) ->
-    (dx (p,), cov (p,p), chi2 (scalar whitened r^T C^-1 r)).
+    (dx (p,), cov (p,p), chi2, n_degenerate).
     """
     Ninv = 1.0 / Ndiag
     # Sigma = phi^-1 + T^T N^-1 T  (k x k)
@@ -52,20 +83,7 @@ def gls_step_woodbury(r, M, Ndiag, T, phi):
         NX = X * Ninv[:, None]
         return NX - TN @ _chol_solve(Sigma, TN.T @ X)
 
-    # column normalization for conditioning (reference trick)
-    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
-    Mn = M / norm[None, :]
-    CiM = cinv_mult(Mn)
-    Cir = cinv_mult(r[:, None])[:, 0]
-    A = Mn.T @ CiM
-    b = -(Mn.T @ Cir)
-    dxn = _chol_solve(A, b[:, None])[:, 0]
-    covn = _chol_solve(A, jnp.eye(A.shape[0]))
-    # post-solve chi2: r^T C^-1 r minus the fitted decrement dx^T A dx
-    # (removes the offset-column power; matches the reference's convention)
-    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
-    return dxn / norm, covn / jnp.outer(norm, norm), chi2
+    return _solve_normal_eqs(cinv_mult, r, M)
 
 
 def gls_step_full_cov(r, M, Ndiag, T, phi):
@@ -80,19 +98,7 @@ def gls_step_full_cov(r, M, Ndiag, T, phi):
         Y = jax.scipy.linalg.solve_triangular(L, X, lower=True)
         return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
 
-    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
-    Mn = M / norm[None, :]
-    CiM = cinv_mult(Mn)
-    Cir = cinv_mult(r[:, None])[:, 0]
-    A = Mn.T @ CiM
-    b = -(Mn.T @ Cir)
-    dxn = _chol_solve(A, b[:, None])[:, 0]
-    covn = _chol_solve(A, jnp.eye(A.shape[0]))
-    # post-solve chi2: r^T C^-1 r minus the fitted decrement dx^T A dx
-    # (removes the offset-column power; matches the reference's convention)
-    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
-    return dxn / norm, covn / jnp.outer(norm, norm), chi2
+    return _solve_normal_eqs(cinv_mult, r, M)
 
 
 class GLSFitter:
@@ -109,8 +115,14 @@ class GLSFitter:
         self.converged = False
         self.parameter_covariance_matrix: np.ndarray | None = None
 
+    @property
+    def _noffset(self):
+        return 0 if "PHOFF" in self.cm.free_names else 1
+
     def _design_with_offset(self, x):
         M = self.cm.design_matrix(x)
+        if not self._noffset:
+            return M
         ones = jnp.ones((self.cm.bundle.ntoa, 1))
         return jnp.concatenate([ones, M], axis=1)
 
@@ -140,11 +152,19 @@ class GLSFitter:
         chi2 = None
         cov = None
         for it in range(maxiter):
-            dx, cov, chi2_new = step(x)
+            dx, cov, chi2_new, nbad = step(x)
+            if int(nbad):
+                from pint_tpu.exceptions import DegeneracyWarning
+
+                warnings.warn(
+                    f"{int(nbad)} degenerate normal-equation directions "
+                    "zeroed in GLS solve",
+                    DegeneracyWarning,
+                )
             chi2_new = float(chi2_new)
             if not np.isfinite(chi2_new):
                 raise ConvergenceFailure("non-finite chi2 during GLS fit")
-            x = x + dx[1:]  # dx[0] is the offset column
+            x = x + dx[self._noffset:]  # dx[0] is the offset column
             if chi2 is not None and abs(chi2 - chi2_new) < tol_chi2 * max(
                 chi2_new, 1.0
             ):
@@ -153,7 +173,8 @@ class GLSFitter:
                 break
             chi2 = chi2_new
 
-        cov = np.asarray(cov)[1:, 1:]
+        no = self._noffset
+        cov = np.asarray(cov)[no:, no:]
         sigmas = np.sqrt(np.diag(cov))
         self.parameter_covariance_matrix = cov
         self.cm.commit(np.asarray(x), uncertainties=sigmas)

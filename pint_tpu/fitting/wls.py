@@ -59,8 +59,16 @@ class WLSFitter:
     def _r(self, x):
         return self.cm.time_residuals(x, subtract_mean=False)
 
+    @property
+    def _noffset(self):
+        # PHOFF (explicit fitted phase offset) replaces the implicit
+        # offset column; both together are exactly degenerate
+        return 0 if "PHOFF" in self.cm.free_names else 1
+
     def _design_with_offset(self, x):
         M = self.cm.design_matrix(x)
+        if not self._noffset:
+            return M
         ones = jnp.ones((self.cm.bundle.ntoa, 1))
         return jnp.concatenate([ones, M], axis=1)
 
@@ -95,7 +103,7 @@ class WLSFitter:
                     "zeroed in SVD solve",
                     DegeneracyWarning,
                 )
-            x_new = x + dx[1:]  # dx[0] is the offset column
+            x_new = x + dx[self._noffset:]  # dx[0] is the offset column
             chi2_new = float(chi2_of(x_new))
             if not np.isfinite(chi2_new):
                 raise ConvergenceFailure("non-finite chi2 during WLS fit")
@@ -107,7 +115,8 @@ class WLSFitter:
         # parameter covariance in free_names order (offset row/col
         # dropped, matching the reference's parameter_covariance_matrix
         # without Offset)
-        cov = np.asarray(cov)[1:, 1:]
+        no = self._noffset
+        cov = np.asarray(cov)[no:, no:]
         sigmas = np.sqrt(np.diag(cov))
         self.parameter_covariance_matrix = cov
         self.cm.commit(np.asarray(x), uncertainties=sigmas)

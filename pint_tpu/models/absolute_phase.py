@@ -1,0 +1,50 @@
+"""Absolute phase anchor (TZR reference TOA).
+
+Reference parity: src/pint/models/absolute_phase.py::AbsPhase — TZRMJD/
+TZRSITE/TZRFRQ define a fiducial arrival at which the model phase is
+zero; photon-folding (photonphase) and polycos need this.  The TZR
+"TOA" goes through the same ingest pipeline as data TOAs, then the
+compiled kernel subtracts phase(TZR) from every TOA's phase.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.models.component import Component
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    strParameter,
+)
+
+
+class AbsPhase(Component):
+    register = True
+    category = "absolute_phase"
+
+    def __init__(self):
+        super().__init__()
+        # TZRMJD is in the timescale of the site clock (TDB for '@')
+        self.add_param(MJDParameter("TZRMJD", time_scale="utc"))
+        self.add_param(strParameter("TZRSITE", value="@"))
+        self.add_param(floatParameter("TZRFRQ", units="MHz"))
+
+    def validate(self, model):
+        self.require("TZRMJD")
+
+    def make_tzr_toas(self):
+        """Single-TOA TOAs object for the TZR arrival (host-side)."""
+        from pint_tpu.timebase.times import TimeArray
+        from pint_tpu.toas.toas import TOAs
+
+        site = (self.params["TZRSITE"].value or "@").lower()
+        frq = self.params["TZRFRQ"].value
+        if frq is None:
+            frq = np.inf
+        t = self.params["TZRMJD"].value
+        scale = "tdb" if site in ("@", "bat", "ssb", "barycenter") else "utc"
+        t = TimeArray(t.mjd_int, t.sec, scale)
+        return TOAs(
+            t, np.array([float(frq)]), np.array([1.0]), [site], [dict()]
+        )

@@ -19,10 +19,18 @@ import warnings
 from typing import Union
 
 # import the component zoo so the registry is populated
+import pint_tpu.models.absolute_phase  # noqa: F401
 import pint_tpu.models.astrometry  # noqa: F401
+import pint_tpu.models.chromatic  # noqa: F401
 import pint_tpu.models.dispersion  # noqa: F401
+import pint_tpu.models.frequency_dependent  # noqa: F401
+import pint_tpu.models.glitch  # noqa: F401
+import pint_tpu.models.ifunc  # noqa: F401
 import pint_tpu.models.jump  # noqa: F401
 import pint_tpu.models.noise  # noqa: F401
+import pint_tpu.models.phase_offset  # noqa: F401
+import pint_tpu.models.solar_wind  # noqa: F401
+import pint_tpu.models.wave  # noqa: F401
 import pint_tpu.models.pulsar_binary  # noqa: F401
 import pint_tpu.models.solar_system_shapiro  # noqa: F401
 import pint_tpu.models.spindown  # noqa: F401

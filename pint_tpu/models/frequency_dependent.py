@@ -1,0 +1,85 @@
+"""Frequency-dependent profile-evolution delays: FD and FDJUMP.
+
+Reference parity: src/pint/models/frequency_dependent.py::FD — delay =
+sum_i FDi * log(nu/1 GHz)^i; src/pint/models/fdjump.py::FDJump —
+per-selection FD-like terms (FD1JUMP.. mask families).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import (
+    floatParameter,
+    maskParameter,
+    prefix_index,
+)
+
+
+class FD(DelayComponent):
+    register = True
+    category = "frequency_dependent"
+
+    def __init__(self, max_terms: int = 9):
+        super().__init__()
+        for k in range(1, max_terms + 1):
+            self.add_param(floatParameter(f"FD{k}", units="s"))
+        self.prefix_patterns = ["FD"]
+
+    def new_prefix_param(self, name):
+        k = prefix_index(name, "FD")
+        if k is None or k < 1:
+            return None
+        if f"FD{k}" not in self.params:
+            self.add_param(floatParameter(f"FD{k}", units="s"))
+        return self.params[f"FD{k}"]
+
+    def _terms(self):
+        return sorted(
+            int(n[2:]) for n in self.params
+            if n[2:].isdigit() and self.params[n].value is not None
+        )
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        lf = jnp.log(bundle.freq_mhz / 1000.0)
+        d = jnp.zeros(bundle.ntoa)
+        for k in self._terms():
+            d = d + pdict[f"FD{k}"] * lf**k
+        return d
+
+
+class FDJump(DelayComponent):
+    """FDnJUMP mask families: FD-like log-frequency terms applied to TOA
+    subsets (per receiver)."""
+
+    register = True
+    category = "frequency_dependent"
+
+    MAX_ORDER = 4
+
+    def __init__(self):
+        super().__init__()
+        self.fdjump_params: list[tuple[str, int]] = []
+
+    def _add_fdjump_order(self, order):
+        def add(idx: int):
+            name = f"FD{order}JUMP{idx}"
+            p = self.add_param(maskParameter(name, index=idx, units="s"))
+            self.fdjump_params.append((name, order))
+            return p
+
+        return add
+
+    def mask_families(self):
+        return {
+            f"FD{k}JUMP": self._add_fdjump_order(k)
+            for k in range(1, self.MAX_ORDER + 1)
+        }
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        lf = jnp.log(bundle.freq_mhz / 1000.0)
+        d = jnp.zeros(bundle.ntoa)
+        for name, order in self.fdjump_params:
+            d = d + pdict[name] * lf**order * bundle.masks[name]
+        return d

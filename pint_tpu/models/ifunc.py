@@ -1,0 +1,69 @@
+"""IFunc: tabulated interpolated phase corrections.
+
+Reference parity: src/pint/models/ifunc.py::IFunc — SIFUNC selects the
+interpolation mode (0: constant/sinc [approximated as nearest], 1:
+nearest, 2: linear — the common case), IFUNC1..n are (MJD, seconds)
+pairs; the tabulated seconds are applied as phase via F0 like Wave.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import (
+    floatParameter,
+    pairParameter,
+    prefix_index,
+)
+from pint_tpu.ops.dd import DD
+
+
+class IFunc(PhaseComponent):
+    register = True
+    category = "ifunc"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("SIFUNC", value=2.0))
+        self.prefix_patterns = ["IFUNC"]
+        self.ifunc_indices: list[int] = []
+
+    def new_prefix_param(self, name):
+        k = prefix_index(name, "IFUNC")
+        if k is None or k < 1:
+            return None
+        p = self.add_param(pairParameter(f"IFUNC{k}", units="MJD,s"))
+        return p
+
+    def setup(self, model):
+        self.ifunc_indices = sorted(
+            int(n[5:]) for n in self.params
+            if n.startswith("IFUNC") and n[5:].isdigit()
+            and self.params[n].value is not None
+        )
+
+    def phase_term(self, pdict, bundle, delay):
+        if not self.ifunc_indices:
+            return DD.zeros((bundle.ntoa,))
+        nodes = np.array(
+            [self.params[f"IFUNC{i}"].value for i in self.ifunc_indices]
+        )
+        order = np.argsort(nodes[:, 0])
+        xs = jnp.asarray(nodes[order, 0])
+        ys = jnp.asarray(nodes[order, 1])
+        t = bundle.tdb_day + bundle.tdb_sec.to_float() / 86400.0
+        mode = int(self.params["SIFUNC"].value)
+        if mode == 2:
+            val = jnp.interp(t, xs, ys)
+        else:  # nearest (modes 0/1)
+            idx = jnp.clip(
+                jnp.searchsorted(xs, t), 0, xs.shape[0] - 1
+            )
+            left = jnp.clip(idx - 1, 0, xs.shape[0] - 1)
+            use_left = jnp.abs(t - xs[left]) < jnp.abs(t - xs[idx])
+            val = jnp.where(use_left, ys[left], ys[idx])
+        f0 = pdict["F0"]
+        f0 = f0.to_float() if isinstance(f0, DD) else f0
+        return DD.from_float(-val * f0)

@@ -244,6 +244,9 @@ class boolParameter(Parameter):
     def _parse_value_str(self, s):
         return s_to_bool(s)
 
+    def internal(self):
+        return self._value
+
     def _format_value(self):
         return "Y" if self._value else "N"
 
@@ -265,6 +268,9 @@ class strParameter(Parameter):
 
     def _coerce(self, v):
         return str(v)
+
+    def internal(self):
+        return self._value
 
 
 class MJDParameter(Parameter):

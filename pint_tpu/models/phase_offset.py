@@ -1,0 +1,30 @@
+"""Explicit overall phase offset.
+
+Reference parity: src/pint/models/phase_offset.py::PhaseOffset — PHOFF
+in pulse cycles, subtracted from the model phase; the fittable
+alternative to implicit weighted-mean subtraction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import floatParameter
+from pint_tpu.ops.dd import DD
+
+
+class PhaseOffset(PhaseComponent):
+    register = True
+    category = "phase_offset"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter("PHOFF", units="cycles", description="phase offset")
+        )
+
+    def phase_term(self, pdict, bundle, delay):
+        return DD.from_float(
+            -pdict["PHOFF"] * jnp.ones(bundle.ntoa)
+        )

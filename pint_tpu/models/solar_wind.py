@@ -1,0 +1,129 @@
+"""Solar-wind dispersion delay.
+
+Reference parity: src/pint/models/solar_wind_dispersion.py::
+SolarWindDispersion — spherically-symmetric 1/r^2 electron density
+n(r) = NE_SW (AU/r)^2; the column density along the line of sight from
+the observer through the heliosphere is
+
+  DM_sw = NE_SW * AU^2 * (pi - theta) / (d sin(theta))
+
+with d = |obs->Sun| and theta the Sun-observer-pulsar elongation angle
+(Edwards et al. 2006 eq. 20).  Delay = DM_CONST * DM_sw / f^2.
+NE_SW1.. Taylor terms in time mirror the reference's SWM extension.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.constants import AU, DM_CONST, PC, SECS_PER_JULIAN_YEAR, C
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    prefix_index,
+)
+from pint_tpu.ops.taylor import taylor_horner
+
+# AU^2/pc in light-seconds: geometry arrives in light-seconds, so
+# column = n0[cm^-3] * (AU_ls^2/d_ls) * angle_factor, converted to pc cm^-3
+_AU_LS = AU / C
+_PC_LS = PC / C
+
+
+class SolarWindDispersion(DelayComponent):
+    register = True
+    category = "solar_wind"
+
+    def __init__(self, max_terms: int = 5):
+        super().__init__()
+        self.add_param(floatParameter("NE_SW", units="cm^-3", aliases=("NE1AU", "SOLARN0")))
+        for k in range(1, max_terms + 1):
+            self.add_param(
+                floatParameter(
+                    f"NE_SW{k}", units=f"cm^-3/yr^{k}",
+                    scale_to_internal=SECS_PER_JULIAN_YEAR ** (-k),
+                )
+            )
+        self.add_param(MJDParameter("SWEPOCH", time_scale="tdb"))
+        self.prefix_patterns = ["NE_SW"]
+
+    def new_prefix_param(self, name):
+        k = prefix_index(name, "NE_SW")
+        if k is None or k < 1:
+            return None
+        if f"NE_SW{k}" not in self.params:
+            self.add_param(
+                floatParameter(
+                    f"NE_SW{k}", units=f"cm^-3/yr^{k}",
+                    scale_to_internal=SECS_PER_JULIAN_YEAR ** (-k),
+                )
+            )
+        return self.params[f"NE_SW{k}"]
+
+    def setup(self, model):
+        from pint_tpu.models.astrometry import Astrometry
+
+        self._astrometry_ref = None
+        for c in model.components.values():
+            if isinstance(c, Astrometry):
+                self._astrometry_ref = c
+
+    def _deriv_ks(self):
+        ks = sorted(
+            int(n[5:]) for n in self.params
+            if n.startswith("NE_SW") and n[5:].isdigit()
+            and self.params[n].value is not None
+        )
+        return ks
+
+    def validate(self, model):
+        from pint_tpu.exceptions import TimingModelError
+
+        if self.params["NE_SW"].value is not None and self._astrometry_ref is None:
+            raise TimingModelError(
+                "SolarWindDispersion needs an astrometry component"
+            )
+        ks = self._deriv_ks()
+        if ks:
+            if ks != list(range(1, ks[-1] + 1)):
+                raise TimingModelError(
+                    f"non-contiguous solar-wind derivatives NE_SW{ks}"
+                )
+            if self.params["SWEPOCH"].value is None:
+                from pint_tpu.exceptions import MissingParameter
+
+                raise MissingParameter("SolarWindDispersion", "SWEPOCH")
+
+    def _ne_sw(self, pdict, bundle):
+        coeffs = [pdict["NE_SW"]] + [
+            pdict[f"NE_SW{k}"] for k in self._deriv_ks()
+        ]
+        if len(coeffs) == 1:
+            return coeffs[0]
+        day, sec = pdict["SWEPOCH"]
+        dt = bundle.dt_seconds(day, sec).to_float()
+        return taylor_horner(dt, coeffs)
+
+    def solar_wind_dm(self, pdict, bundle):
+        """DM_sw at each TOA (pc/cm^3)."""
+        psr_dir = self._astrometry_ref.ssb_to_psr_xyz(pdict, bundle)
+        r = bundle.obs_sun_pos_ls  # obs -> Sun, light-seconds
+        d = jnp.sqrt(jnp.sum(r * r, axis=-1))
+        safe_d = jnp.maximum(d, 1e-30)
+        # elongation: angle between Sun direction and pulsar direction
+        cos_e = jnp.sum(r * psr_dir, axis=-1) / safe_d
+        theta = jnp.arccos(jnp.clip(cos_e, -1.0, 1.0))
+        sin_t = jnp.maximum(jnp.sin(theta), 1e-9)
+        n0 = self._ne_sw(pdict, bundle)
+        # column in cm^-3 * ls -> pc cm^-3 via /PC_ls
+        col = n0 * _AU_LS * _AU_LS * (np.pi - theta) / (safe_d * sin_t)
+        dm = col / _PC_LS
+        return jnp.where(d > 0, dm, 0.0)
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        if self.params["NE_SW"].value is None:
+            return jnp.zeros(bundle.ntoa)
+        dm = self.solar_wind_dm(pdict, bundle)
+        return DM_CONST * dm / jnp.square(bundle.freq_mhz)

@@ -178,7 +178,7 @@ class TimingModel:
         return out
 
     # -- compile ----------------------------------------------------------
-    def compile(self, toas, subtract_mean: bool = True) -> "CompiledModel":
+    def _build_masks(self, toas) -> dict:
         masks = {}
         for c in self._ordered_components():
             for n in c.mask_params:
@@ -186,8 +186,21 @@ class TimingModel:
             # component-specific static selections (DMX ranges, SWX, ...)
             if hasattr(c, "extra_masks"):
                 masks.update(c.extra_masks(toas))
-        bundle = make_bundle(toas, masks)
-        return CompiledModel(self, bundle, subtract_mean=subtract_mean)
+        return masks
+
+    def compile(self, toas, subtract_mean: bool = True) -> "CompiledModel":
+        bundle = make_bundle(toas, self._build_masks(toas))
+        tzr_bundle = None
+        absph = self.components.get("AbsPhase")
+        if absph is not None and absph.params["TZRMJD"].value is not None:
+            from pint_tpu.toas.ingest import ingest
+
+            tzr_toas = absph.make_tzr_toas()
+            ingest(tzr_toas)
+            tzr_bundle = make_bundle(tzr_toas, self._build_masks(tzr_toas))
+        return CompiledModel(
+            self, bundle, subtract_mean=subtract_mean, tzr_bundle=tzr_bundle
+        )
 
     # -- parfile ----------------------------------------------------------
     def as_parfile(self) -> str:
@@ -235,9 +248,16 @@ class CompiledModel:
     order, holding the *delta* from the reference value in internal units.
     """
 
-    def __init__(self, model: TimingModel, bundle: TOABundle, subtract_mean=True):
+    def __init__(
+        self,
+        model: TimingModel,
+        bundle: TOABundle,
+        subtract_mean=True,
+        tzr_bundle: Optional[TOABundle] = None,
+    ):
         self.model = model
         self.bundle = bundle
+        self.tzr_bundle = tzr_bundle
         self.subtract_mean = subtract_mean
         self.free_names = model.free_params
         self._index = {n: i for i, n in enumerate(self.free_names)}
@@ -276,7 +296,9 @@ class CompiledModel:
                     pd[n] = (const + x[self._index[n]]).normalize()
                 else:
                     pd[n] = const
-            elif isinstance(v, tuple):
+            elif isinstance(v, tuple) and len(v) == 2 and isinstance(
+                v[1], HostDD
+            ):
                 # epoch (day, HostDD sec); if free, x[i] is a seconds delta
                 day, sec = v
                 sec_dd = DD(
@@ -285,6 +307,9 @@ class CompiledModel:
                 if n in self._index:
                     sec_dd = (sec_dd + x[self._index[n]]).normalize()
                 pd[n] = (float(day), sec_dd)
+            elif isinstance(v, tuple):
+                # pairParameter (sin, cos amplitudes): static floats
+                pd[n] = v
             elif isinstance(v, (float, int)):
                 if n in self._index:
                     pd[n] = jnp.float64(v) + x[self._index[n]]
@@ -303,14 +328,15 @@ class CompiledModel:
             d = d + c.delay_term(pd, self.bundle, d)
         return d
 
-    def phase(self, x) -> Phase:
+    def phase(self, x, bundle: Optional[TOABundle] = None) -> Phase:
+        bundle = self.bundle if bundle is None else bundle
         pd = self._pdict(x)
-        d = jnp.zeros(self.bundle.ntoa)
+        d = jnp.zeros(bundle.ntoa)
         for c in self.model.delay_components:
-            d = d + c.delay_term(pd, self.bundle, d)
-        total = DD.zeros(self.bundle.ntoa)
+            d = d + c.delay_term(pd, bundle, d)
+        total = DD.zeros(bundle.ntoa)
         for c in self.model.phase_components:
-            total = total + c.phase_term(pd, self.bundle, d)
+            total = total + c.phase_term(pd, bundle, d)
         return Phase.from_dd(total)
 
     def spin_frequency(self, x):
@@ -330,6 +356,9 @@ class CompiledModel:
         adds cancel by construction.
         """
         ph = self.phase(x)
+        if self.tzr_bundle is not None:
+            tz = self.phase(x, bundle=self.tzr_bundle)
+            ph = ph - tz[0]  # Phase carry-normalized subtraction
         if self.track_mode == "use_pulse_numbers":
             pn = self.bundle.pulse_number
             return (ph.int_ - pn + self.bundle.padd) + ph.frac

@@ -1,0 +1,173 @@
+"""Sinusoidal whitening terms: Wave (tempo heritage) and the modern
+WaveX / DMWaveX / CMWaveX families.
+
+Reference parity: src/pint/models/wave.py::Wave (WAVE_OM + WAVEn
+sin/cos pairs, applied as a time delay folded into phase via F0),
+src/pint/models/wavex.py::WaveX (WXFREQ_/WXSIN_/WXCOS_ explicit-
+frequency delay sinusoids), dmwavex.py::DMWaveX (DM-unit amplitudes,
+nu^-2 chromatic), cmwavex.py::CMWaveX (nu^-CMIDX chromatic).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.constants import DM_CONST
+from pint_tpu.models.component import DelayComponent, PhaseComponent
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    pairParameter,
+    prefix_index,
+)
+from pint_tpu.ops.dd import DD
+
+TWOPI = 2.0 * jnp.pi
+
+
+def _days_since(bundle, epoch_pair):
+    day, sec = epoch_pair
+    return (bundle.tdb_day - day) + (bundle.tdb_sec - sec).to_float() / 86400.0
+
+
+class Wave(PhaseComponent):
+    """Fundamental WAVE_OM (rad/day) + harmonics WAVEn = (sin, cos)
+    amplitudes in seconds; positive amplitude = extra delay."""
+
+    register = True
+    category = "wave"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("WAVE_OM", units="rad/d"))
+        self.add_param(MJDParameter("WAVEEPOCH", time_scale="tdb"))
+        self.prefix_patterns = ["WAVE"]
+        self.wave_indices: list[int] = []
+
+    def new_prefix_param(self, name):
+        k = prefix_index(name, "WAVE")
+        if k is None or k < 1:
+            return None
+        p = self.add_param(pairParameter(f"WAVE{k}", units="s"))
+        return p
+
+    def setup(self, model):
+        self.wave_indices = sorted(
+            int(n[4:]) for n in self.params
+            if n.startswith("WAVE") and n[4:].isdigit()
+            and self.params[n].value is not None
+        )
+        if self.params["WAVEEPOCH"].value is None and "Spindown" in getattr(
+            model, "components", {}
+        ):
+            pep = model.components["Spindown"].params["PEPOCH"].value
+            if pep is not None:
+                self.params["WAVEEPOCH"].value = pep
+
+    def validate(self, model):
+        if self.wave_indices:
+            self.require("WAVE_OM", "WAVEEPOCH")
+
+    def phase_term(self, pdict, bundle, delay):
+        if not self.wave_indices:
+            return DD.zeros((bundle.ntoa,))
+        td = _days_since(bundle, pdict["WAVEEPOCH"])
+        om = pdict["WAVE_OM"]
+        f0 = pdict["F0"]
+        f0 = f0.to_float() if isinstance(f0, DD) else f0
+        wave = jnp.zeros(bundle.ntoa)
+        for k in self.wave_indices:
+            a, b = pdict[f"WAVE{k}"]
+            arg = k * om * td
+            wave = wave + a * jnp.sin(arg) + b * jnp.cos(arg)
+        # positive wave seconds = delay => phase decreases
+        return DD.from_float(-wave * f0)
+
+
+class WaveXBase(DelayComponent):
+    """Shared machinery for explicit-frequency sinusoid delays."""
+
+    prefixes = ("WXFREQ_", "WXSIN_", "WXCOS_")
+    epoch_name = "WXEPOCH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter(self.epoch_name, time_scale="tdb"))
+        self.prefix_patterns = list(self.prefixes)
+        self.indices: list[int] = []
+
+    def _add_index(self, idx: int):
+        fr, sn, cs = self.prefixes
+        self.add_param(floatParameter(f"{fr}{idx:04d}", units="1/d"))
+        self.add_param(floatParameter(f"{sn}{idx:04d}", units="s", value=0.0))
+        self.add_param(floatParameter(f"{cs}{idx:04d}", units="s", value=0.0))
+        self.indices.append(idx)
+
+    def new_prefix_param(self, name):
+        for pref in self.prefixes:
+            idx = prefix_index(name, pref)
+            if idx is not None:
+                if f"{self.prefixes[0]}{idx:04d}" not in self.params:
+                    self._add_index(idx)
+                return self.params[f"{pref}{idx:04d}"]
+        return None
+
+    def setup(self, model):
+        fr = self.prefixes[0]
+        self.indices = sorted(
+            int(n[len(fr):]) for n in self.params
+            if n.startswith(fr) and self.params[n].value is not None
+        )
+        if self.params[self.epoch_name].value is None and hasattr(
+            model, "components"
+        ) and "Spindown" in model.components:
+            pep = model.components["Spindown"].params["PEPOCH"].value
+            if pep is not None:
+                self.params[self.epoch_name].value = pep
+
+    def _chromatic_factor(self, pdict, bundle):
+        return 1.0
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        if not self.indices:
+            return jnp.zeros(bundle.ntoa)
+        td = _days_since(bundle, pdict[self.epoch_name])
+        fr, sn, cs = self.prefixes
+        d = jnp.zeros(bundle.ntoa)
+        for i in self.indices:
+            arg = TWOPI * pdict[f"{fr}{i:04d}"] * td
+            d = d + pdict[f"{sn}{i:04d}"] * jnp.sin(arg) + pdict[
+                f"{cs}{i:04d}"
+            ] * jnp.cos(arg)
+        return d * self._chromatic_factor(pdict, bundle)
+
+
+class WaveX(WaveXBase):
+    register = True
+    category = "wave"
+
+
+class DMWaveX(WaveXBase):
+    """Amplitudes in pc/cm^3; delay scales as DM_CONST/f^2."""
+
+    register = True
+    category = "dispersion_dmx"
+    prefixes = ("DMWXFREQ_", "DMWXSIN_", "DMWXCOS_")
+    epoch_name = "DMWXEPOCH"
+
+    def _chromatic_factor(self, pdict, bundle):
+        return DM_CONST / jnp.square(bundle.freq_mhz)
+
+
+class CMWaveX(WaveXBase):
+    """Chromatic (nu^-CMIDX) sinusoids; CMIDX is owned by ChromaticCM
+    when present (default 4, scattering-like)."""
+
+    register = True
+    category = "chromatic"
+    prefixes = ("CMWXFREQ_", "CMWXSIN_", "CMWXCOS_")
+    epoch_name = "CMWXEPOCH"
+
+    def _chromatic_factor(self, pdict, bundle):
+        alpha = pdict.get("CMIDX", 4.0)
+        return DM_CONST / bundle.freq_mhz**alpha

@@ -45,7 +45,10 @@ class Residuals:
 
     @property
     def dof(self) -> int:
-        return len(self.toas) - len(self.cm.free_names) - 1  # -1: offset
+        # the implicit offset costs a dof unless PHOFF (already counted
+        # among free params) replaces it
+        offset = 0 if "PHOFF" in self.cm.free_names else 1
+        return len(self.toas) - len(self.cm.free_names) - offset
 
     @property
     def reduced_chi2(self) -> float:

@@ -1,0 +1,185 @@
+"""Tests for the component long tail: glitch, waves, FD, solar wind,
+chromatic, phase offset, absolute phase.
+
+Strategy: exercise everything through the public par-file path
+(get_model -> simulate -> residuals), with analytic expectations for
+each effect's signature in the residuals.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.fitting.wls import WLSFitter
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSR              J0000+0000
+F0               100.0    1
+F1               -1e-15   1
+PEPOCH           55000
+"""
+
+
+def _resid_diff(par_a, par_b, n=400, start=54000, end=56000, freqs=1400.0):
+    """Unweighted residual difference: simulate from par_a, evaluate
+    par_b; both with mean subtraction off."""
+    m_a = get_model(par_a)
+    toas = make_fake_toas_uniform(
+        start, end, n, m_a, error_us=1.0, freq_mhz=freqs
+    )
+    m_b = get_model(par_b)
+    r = Residuals(toas, m_b, subtract_mean=False)
+    return toas, r.time_resids
+
+
+def test_glitch_step_signature():
+    par_g = BASE + """
+GLEP_1           55000
+GLPH_1           0.1
+GLF0_1           1e-7
+GLTD_1           100
+GLF0D_1          2e-8
+"""
+    toas, r = _resid_diff(par_g, BASE)
+    mjd = toas.mjd_float()
+    pre, post = mjd < 55000, mjd > 55001
+    # before the glitch the models agree
+    assert np.max(np.abs(r[pre])) < 1e-9
+    # after: phase step GLPH + growing GLF0 term (sign: extra model
+    # phase -> negative time residual of the glitchless model), wrapped
+    # to [-0.5, 0.5) cycles by 'nearest' pulse-number tracking
+    def expect_at(m):
+        cyc = -(
+            0.1 + 1e-7 * (m - 55000) * 86400.0
+            + 2e-8 * 100 * 86400 * (1 - np.exp(-(m - 55000) / 100.0))
+        )
+        cyc = cyc - np.floor(cyc + 0.5)
+        return cyc / 100.0
+
+    np.testing.assert_allclose(
+        r[post], expect_at(mjd[post]), rtol=1e-5, atol=2e-9
+    )
+
+
+def test_wave_and_wavex_equivalence():
+    om = 0.02  # rad/day
+    a1, b1, a2, b2 = 3e-6, -1e-6, 5e-7, 2e-6
+    par_wave = BASE + f"""
+WAVEEPOCH        55000
+WAVE_OM          {om}
+WAVE1            {a1} {b1}
+WAVE2            {a2} {b2}
+"""
+    f1 = om / (2 * np.pi)
+    f2 = 2 * f1
+    par_wavex = BASE + f"""
+WXEPOCH          55000
+WXFREQ_0001      {f1}
+WXSIN_0001       {a1}
+WXCOS_0001       {b1}
+WXFREQ_0002      {f2}
+WXSIN_0002       {a2}
+WXCOS_0002       {b2}
+"""
+    m_w = get_model(par_wave)
+    assert "Wave" in m_w.components
+    toas, r = _resid_diff(par_wave, par_wavex)
+    # phase-applied Wave vs delay-applied WaveX agree to second order
+    assert np.max(np.abs(r)) < 1e-9
+
+
+def test_fd_delay_formula():
+    par_fd = BASE + "FD1 1e-5\nFD2 -3e-6\n"
+    n = 300
+    freqs = np.linspace(400.0, 3000.0, n)
+    toas, r = _resid_diff(BASE, par_fd, n=n, freqs=freqs)
+    lf = np.log(freqs / 1000.0)
+    expect = 1e-5 * lf - 3e-6 * lf**2  # extra model delay -> + residual
+    # sign: delay in the evaluating model shifts its prediction; the
+    # simulated (FD-free) TOAs then show the negated FD curve
+    diff = r - r.mean() - (expect - expect.mean())
+    alt = r - r.mean() + (expect - expect.mean())
+    assert min(np.max(np.abs(diff)), np.max(np.abs(alt))) < 1e-9
+
+
+def test_phase_offset_fit():
+    par = BASE + "PHOFF 0.0 1\n"
+    m_true = get_model(BASE)
+    toas = make_fake_toas_uniform(54000, 56000, 100, m_true, error_us=1.0)
+    # shift all TOAs by 0.3 cycles = 3 ms
+    toas.t = toas.t.add_seconds(np.full(100, 0.3 / 100.0))
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+    m_fit = get_model(par)
+    m_fit.params["F0"].frozen = True
+    m_fit.params["F1"].frozen = True
+    f = WLSFitter(toas, m_fit)
+    f.fit_toas(maxiter=4)
+    assert m_fit.params["PHOFF"].value == pytest.approx(0.3, abs=1e-6)
+
+
+def test_solar_wind_column_formula():
+    import jax.numpy as jnp
+
+    par_sw = BASE + "RAJ 06:00:00\nDECJ 00:00:00\nNE_SW 8.0\n"
+    m = get_model(par_sw)
+    toas = make_fake_toas_uniform(55000, 55010, 5, m, error_us=1.0)
+    cm = m.compile(toas)
+    sw = m.components["SolarWindDispersion"]
+    # synthetic geometry: Sun at 1 AU along +x, pulsar at RA=6h => +y
+    from pint_tpu.constants import AU, C, PC
+
+    n = len(toas)
+    b = cm.bundle._replace(
+        obs_sun_pos_ls=jnp.tile(jnp.array([[AU / C, 0.0, 0.0]]), (n, 1))
+    )
+    dm = np.asarray(sw.solar_wind_dm(cm._pdict(cm.x0()), b))
+    # elongation 90 deg: col = n0 AU^2 (pi/2)/(1AU * 1) / pc
+    expect = 8.0 * (AU / C) * (np.pi / 2) / (PC / C)
+    np.testing.assert_allclose(dm, expect, rtol=1e-10)
+
+
+def test_chromatic_cmidx2_equals_dm():
+    par_cm = BASE + "CM 1.5\nCMIDX 2.0\nCMEPOCH 55000\n"
+    par_dm = BASE + "DM 1.5\n"
+    n = 200
+    freqs = np.linspace(400.0, 3000.0, n)
+    toas, r = _resid_diff(par_cm, par_dm, n=n, freqs=freqs)
+    assert np.max(np.abs(r)) < 1e-9
+
+
+def test_absolute_phase_tzr():
+    par = BASE + "TZRMJD 55123.456\nTZRSITE @\nTZRFRQ 1400\n"
+    m = get_model(par)
+    assert "AbsPhase" in m.components
+    toas = make_fake_toas_uniform(55000, 55200, 50, m, error_us=1.0)
+    cm = m.compile(toas, subtract_mean=False)
+    # the anchored phase at the TZR epoch itself must be ~integer:
+    # evaluate phase on the tzr bundle minus itself == 0 by construction;
+    # instead check residuals are consistent between anchored/unanchored
+    # up to a constant
+    r_anchored = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
+    m2 = get_model(BASE)
+    cm2 = m2.compile(toas, subtract_mean=False)
+    r_plain = np.asarray(cm2.time_residuals(cm2.x0(), subtract_mean=False))
+    d = r_anchored - r_plain
+    assert np.max(np.abs(d - d[0])) < 1e-12
+
+
+def test_builder_selects_new_components():
+    par = BASE + (
+        "GLEP_1 55000\nGLF0_1 1e-8\n"
+        "WAVE_OM 0.02\nWAVEEPOCH 55000\nWAVE1 1e-6 2e-6\n"
+        "FD1 1e-5\nPHOFF 0.1\nTZRMJD 55000\n"
+        "CM 0.1\nCMIDX 4\nCMEPOCH 55000\n"
+        "RAJ 06:00:00\nDECJ 00:00:00\nNE_SW 5.0\n"
+    )
+    m = get_model(par)
+    for name in (
+        "Glitch", "Wave", "FD", "PhaseOffset", "AbsPhase", "ChromaticCM",
+        "SolarWindDispersion",
+    ):
+        assert name in m.components, name
