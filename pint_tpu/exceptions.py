@@ -78,5 +78,9 @@ class DegeneracyWarning(UserWarning):
     """Design matrix is degenerate; some parameters are unconstrained."""
 
 
+class ConvergenceWarning(UserWarning):
+    """A fitter stopped without meeting its convergence tolerance."""
+
+
 class PropertyAttributeError(PintTpuError):
     """Error raised inside a property getter (reference parity)."""

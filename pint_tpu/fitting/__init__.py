@@ -3,14 +3,25 @@
 Reference parity: src/pint/fitter.py class hierarchy (SURVEY.md §3.3).
 """
 
+from pint_tpu.fitting.downhill import (  # noqa: F401
+    DownhillFitter,
+    DownhillGLSFitter,
+    DownhillWLSFitter,
+)
 from pint_tpu.fitting.gls import GLSFitter  # noqa: F401
+from pint_tpu.fitting.utils import ftest  # noqa: F401
 from pint_tpu.fitting.wls import WLSFitter  # noqa: F401
 
 
-def auto_fitter(toas, model, **kw):
-    """Pick a fitter by model content (reference: Fitter.auto)."""
-    if any(
+def auto_fitter(toas, model, downhill: bool = True, **kw):
+    """Pick a fitter by model content (reference: Fitter.auto):
+    wideband data -> Wideband fitter; correlated noise -> GLS; else WLS;
+    downhill variants by default."""
+    correlated = any(
         c.introduces_correlated_errors for c in model.noise_components
-    ):
-        return GLSFitter(toas, model, **kw)
-    return WLSFitter(toas, model, **kw)
+    )
+    if correlated:
+        cls = DownhillGLSFitter if downhill else GLSFitter
+        return cls(toas, model, **kw)
+    cls = DownhillWLSFitter if downhill else WLSFitter
+    return cls(toas, model, **kw)

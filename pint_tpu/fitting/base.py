@@ -1,0 +1,71 @@
+"""Shared fitter machinery.
+
+Reference parity: src/pint/fitter.py::Fitter (the common state held by
+WLS/GLS/downhill variants: compiled model, residuals, covariance,
+offset-column handling, post-fit commit, summary printing).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import Residuals
+from pint_tpu.toas.toas import TOAs
+
+
+class Fitter:
+    """Common base: compiled kernels + offset column + post-fit commit."""
+
+    def __init__(self, toas: TOAs, model: TimingModel):
+        self.toas = toas
+        self.model = model
+        self.cm = model.compile(toas)
+        self.resids_init = Residuals(toas, model, compiled=self.cm)
+        self.resids: Residuals = self.resids_init
+        self.converged = False
+        self.parameter_covariance_matrix: np.ndarray | None = None
+        self.chi2: float | None = None
+
+    @property
+    def _noffset(self):
+        # PHOFF (explicit fitted phase offset) replaces the implicit
+        # offset column; both together are exactly degenerate
+        return 0 if "PHOFF" in self.cm.free_names else 1
+
+    def _design_with_offset(self, x):
+        M = self.cm.design_matrix(x)
+        if not self._noffset:
+            return M
+        ones = jnp.ones((self.cm.bundle.ntoa, 1))
+        return jnp.concatenate([ones, M], axis=1)
+
+    def _finalize(self, x, cov, chi2: float):
+        """Drop the offset row/col, commit fitted deltas + uncertainties
+        back into host Parameters, refresh residuals."""
+        no = self._noffset
+        cov = np.asarray(cov)[no:, no:]
+        sigmas = np.sqrt(np.diag(cov))
+        self.parameter_covariance_matrix = cov
+        self.cm.commit(np.asarray(x), uncertainties=sigmas)
+        self.resids = Residuals(self.toas, self.model, compiled=self.cm)
+        self.model.top_params["CHI2"].value = float(chi2)
+        self.chi2 = float(chi2)
+        return float(chi2)
+
+    def print_summary(self) -> str:
+        lines = [
+            f"Fitted model using {type(self).__name__} with "
+            f"{len(self.cm.free_names)} free parameters, "
+            f"{len(self.toas)} TOAs; converged={self.converged}",
+            f"chi2 = {self.chi2:.4f}",
+            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
+        ]
+        for n in self.cm.free_names:
+            p = self.model.params[n]
+            unc = p.uncertainty if p.uncertainty is not None else float("nan")
+            lines.append(f"{n:<12}{p._format_value():>25}{unc:>15.3e}")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -1,0 +1,187 @@
+"""Downhill (step-halving) fitters.
+
+Reference parity: src/pint/fitter.py::DownhillFitter / DownhillWLSFitter /
+DownhillGLSFitter — propose a full Gauss-Newton step, evaluate chi2, and
+halve the step length (lambda) until chi2 stops increasing; raise
+StepProblem when no acceptable step exists and InvalidModelParameters on
+non-finite proposals.
+
+TPU-first differences: the proposal and the chi2 evaluation are the same
+compiled kernels the plain fitters use (pure functions of the delta
+vector x), so the lambda line-search costs one kernel call per trial —
+no model rebuilds, no recompiles.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import (
+    ConvergenceWarning,
+    DegeneracyWarning,
+    InvalidModelParameters,
+    StepProblem,
+)
+from pint_tpu.fitting.base import Fitter
+from pint_tpu.fitting.gls import (
+    gls_step_full_cov,
+    gls_step_woodbury,
+    make_cinv_mult,
+)
+from pint_tpu.fitting.wls import _wls_step
+
+
+class DownhillFitter(Fitter):
+    """Base downhill fitter: subclasses provide _proposal (dx, cov, nbad)
+    and _chi2 (offset-profiled objective) kernels."""
+
+    method = "downhill"
+
+    # subclasses override ------------------------------------------------
+    def _make_proposal(self):
+        raise NotImplementedError
+
+    def _make_chi2(self):
+        raise NotImplementedError
+
+    # --------------------------------------------------------------------
+    def fit_toas(
+        self,
+        maxiter: int = 20,
+        required_chi2_decrease: float = 1e-2,
+        max_chi2_increase: float = 1e-2,
+        min_lambda: float = 1e-3,
+    ) -> float:
+        proposal = self._make_proposal()
+        chi2_of = self._make_chi2()
+
+        x = self.cm.x0()
+        chi2 = float(chi2_of(x))
+        if not np.isfinite(chi2):
+            raise InvalidModelParameters(
+                "initial model produces non-finite chi2"
+            )
+        cov = None
+        self.converged = False
+        for it in range(maxiter):
+            dx, cov, nbad = proposal(x)
+            if int(nbad):
+                warnings.warn(
+                    f"{int(nbad)} degenerate directions zeroed in downhill "
+                    "proposal",
+                    DegeneracyWarning,
+                )
+            lam = 1.0
+            accepted = None
+            while lam >= min_lambda:
+                x_try = x + lam * dx
+                c_try = float(chi2_of(x_try))
+                if np.isfinite(c_try) and c_try < chi2 + max_chi2_increase:
+                    accepted = (x_try, c_try)
+                    break
+                lam *= 0.5
+            if accepted is None:
+                if it == 0:
+                    raise StepProblem(
+                        "downhill fit: no step length decreased chi2 "
+                        f"(chi2={chi2:.6g})"
+                    )
+                break  # keep the best x found so far
+            x_new, chi2_new = accepted
+            decrease = chi2 - chi2_new
+            x, chi2 = x_new, chi2_new
+            if abs(decrease) < required_chi2_decrease:
+                self.converged = True
+                break
+        if not self.converged:
+            warnings.warn(
+                f"downhill fit did not meet tolerance in {maxiter} "
+                "iterations",
+                ConvergenceWarning,
+            )
+
+        return self._finalize(x, cov, float(chi2))
+
+
+class DownhillWLSFitter(DownhillFitter):
+    """Downhill WLS (reference: DownhillWLSFitter)."""
+
+    def __init__(self, toas, model):
+        super().__init__(toas, model)
+        if self.cm.has_correlated_errors:
+            from pint_tpu.exceptions import CorrelatedErrors
+
+            raise CorrelatedErrors(model)
+
+    def _make_proposal(self):
+        cm, noffset = self.cm, self._noffset
+
+        @jax.jit
+        def proposal(x):
+            r = cm.time_residuals(x, subtract_mean=False)
+            M = self._design_with_offset(x)
+            w = 1.0 / jnp.square(cm.scaled_sigma(x))
+            dx, cov, nbad = _wls_step(r, M, w)
+            return dx[noffset:], cov, nbad
+
+        return proposal
+
+    def _make_chi2(self):
+        # cm.chi2 profiles the offset exactly via weighted-mean subtraction
+        return jax.jit(self.cm.chi2)
+
+
+class DownhillGLSFitter(DownhillFitter):
+    """Downhill GLS (reference: DownhillGLSFitter).  The acceptance
+    objective is the GLS chi2 r^T C^-1 r with the implicit offset
+    profiled out analytically: chi2 - (1^T C^-1 r)^2 / (1^T C^-1 1)."""
+
+    def __init__(self, toas, model, full_cov: bool = False):
+        super().__init__(toas, model)
+        self.full_cov = full_cov
+
+    def _noise(self, x):
+        Ndiag = jnp.square(self.cm.scaled_sigma(x))
+        bw = self.cm.noise_basis(x)
+        if bw is None:
+            T = jnp.zeros((self.cm.bundle.ntoa, 1))
+            phi = jnp.ones(1) * 1e-40
+        else:
+            T, phi = bw
+        return Ndiag, T, phi
+
+    def _make_proposal(self):
+        cm, noffset, full_cov = self.cm, self._noffset, self.full_cov
+
+        @jax.jit
+        def proposal(x):
+            r = cm.time_residuals(x, subtract_mean=False)
+            M = self._design_with_offset(x)
+            Ndiag, T, phi = self._noise(x)
+            step = gls_step_full_cov if full_cov else gls_step_woodbury
+            dx, cov, _, nbad = step(r, M, Ndiag, T, phi)
+            return dx[noffset:], cov, nbad
+
+        return proposal
+
+    def _make_chi2(self):
+        cm = self.cm
+
+        @jax.jit
+        def chi2(x):
+            r = cm.time_residuals(x, subtract_mean=False)
+            Ndiag, T, phi = self._noise(x)
+            cinv_mult = make_cinv_mult(Ndiag, T, phi)
+            u = jnp.ones_like(r)
+            Cir = cinv_mult(r[:, None])[:, 0]
+            Ciu = cinv_mult(u[:, None])[:, 0]
+            c2 = jnp.dot(r, Cir)
+            if self._noffset:
+                c2 = c2 - jnp.dot(u, Cir) ** 2 / jnp.dot(u, Ciu)
+            return c2
+
+        return chi2

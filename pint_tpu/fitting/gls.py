@@ -16,14 +16,15 @@ for cross-validation and benchmarking).
 
 from __future__ import annotations
 
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.exceptions import ConvergenceFailure
+from pint_tpu.fitting.base import Fitter
 from pint_tpu.models.timing_model import TimingModel
-from pint_tpu.residuals import Residuals
 from pint_tpu.toas.toas import TOAs
 
 
@@ -67,23 +68,30 @@ def _solve_normal_eqs(cinv_mult, r, M):
     return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
 
 
-def gls_step_woodbury(r, M, Ndiag, T, phi):
-    """One GLS normal-equation solve, reduced-rank path.
-
-    r (n,), M (n,p), Ndiag (n,), T (n,k), phi (k,) ->
-    (dx (p,), cov (p,p), chi2, n_degenerate).
-    """
+def make_cinv_mult(Ndiag, T, phi):
+    """Build X -> C^-1 X for C = diag(Ndiag) + T diag(phi) T^T via the
+    Woodbury identity.  The single shared implementation: the GLS
+    proposal, the downhill acceptance objective, and wideband all use
+    this builder so the factorization can never diverge between them."""
     Ninv = 1.0 / Ndiag
     # Sigma = phi^-1 + T^T N^-1 T  (k x k)
     TN = T * Ninv[:, None]  # N^-1 T  (n,k)
     Sigma = jnp.diag(1.0 / phi) + T.T @ TN
 
     def cinv_mult(X):
-        """C^-1 X for X (n,m) via Woodbury."""
         NX = X * Ninv[:, None]
         return NX - TN @ _chol_solve(Sigma, TN.T @ X)
 
-    return _solve_normal_eqs(cinv_mult, r, M)
+    return cinv_mult
+
+
+def gls_step_woodbury(r, M, Ndiag, T, phi):
+    """One GLS normal-equation solve, reduced-rank path.
+
+    r (n,), M (n,p), Ndiag (n,), T (n,k), phi (k,) ->
+    (dx (p,), cov (p,p), chi2, n_degenerate).
+    """
+    return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M)
 
 
 def gls_step_full_cov(r, M, Ndiag, T, phi):
@@ -101,30 +109,13 @@ def gls_step_full_cov(r, M, Ndiag, T, phi):
     return _solve_normal_eqs(cinv_mult, r, M)
 
 
-class GLSFitter:
+class GLSFitter(Fitter):
     """Iterated GLS fit; also correct (equals WLS) with no correlated
     noise in the model."""
 
     def __init__(self, toas: TOAs, model: TimingModel, full_cov: bool = False):
-        self.toas = toas
-        self.model = model
+        super().__init__(toas, model)
         self.full_cov = full_cov
-        self.cm = model.compile(toas)
-        self.resids_init = Residuals(toas, model, compiled=self.cm)
-        self.resids: Residuals = self.resids_init
-        self.converged = False
-        self.parameter_covariance_matrix: np.ndarray | None = None
-
-    @property
-    def _noffset(self):
-        return 0 if "PHOFF" in self.cm.free_names else 1
-
-    def _design_with_offset(self, x):
-        M = self.cm.design_matrix(x)
-        if not self._noffset:
-            return M
-        ones = jnp.ones((self.cm.bundle.ntoa, 1))
-        return jnp.concatenate([ones, M], axis=1)
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
         full_cov = self.full_cov
@@ -173,30 +164,4 @@ class GLSFitter:
                 break
             chi2 = chi2_new
 
-        no = self._noffset
-        cov = np.asarray(cov)[no:, no:]
-        sigmas = np.sqrt(np.diag(cov))
-        self.parameter_covariance_matrix = cov
-        self.cm.commit(np.asarray(x), uncertainties=sigmas)
-        self.resids = Residuals(self.toas, self.model, compiled=self.cm)
-        self.model.top_params["CHI2"].value = float(chi2)
-        self.chi2 = float(chi2)
-        return float(chi2)
-
-    def print_summary(self) -> str:
-        lines = [
-            f"Fitted model using GLS ({'full-cov' if self.full_cov else 'Woodbury'}) "
-            f"with {len(self.cm.free_names)} free parameters, "
-            f"{len(self.toas)} TOAs",
-            f"chi2 = {self.chi2:.4f}",
-            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
-        ]
-        for n in self.cm.free_names:
-            p = self.model.params[n]
-            lines.append(
-                f"{n:<12}{p._format_value():>25}"
-                f"{p.uncertainty if p.uncertainty is not None else float('nan'):>15.3e}"
-            )
-        out = "\n".join(lines)
-        print(out)
-        return out
+        return self._finalize(x, cov, float(chi2))

@@ -16,8 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
+from pint_tpu.fitting.base import Fitter
 from pint_tpu.models.timing_model import TimingModel
-from pint_tpu.residuals import Residuals
 from pint_tpu.toas.toas import TOAs
 
 
@@ -44,33 +44,11 @@ def _wls_step(r, M, w, threshold=None):
     return dx, cov, jnp.sum(bad)
 
 
-class WLSFitter:
-    def __init__(self, toas: TOAs, model: TimingModel):
-        self.toas = toas
-        self.model = model
-        self.cm = model.compile(toas)
-        self.resids_init = Residuals(toas, model, compiled=self.cm)
-        self.resids: Residuals = self.resids_init
-        self.converged = False
-        self.parameter_covariance_matrix: np.ndarray | None = None
-
+class WLSFitter(Fitter):
     # residuals WITHOUT mean subtraction; the offset column absorbs the
     # mean exactly as the reference's "Offset" design-matrix column does.
     def _r(self, x):
         return self.cm.time_residuals(x, subtract_mean=False)
-
-    @property
-    def _noffset(self):
-        # PHOFF (explicit fitted phase offset) replaces the implicit
-        # offset column; both together are exactly degenerate
-        return 0 if "PHOFF" in self.cm.free_names else 1
-
-    def _design_with_offset(self, x):
-        M = self.cm.design_matrix(x)
-        if not self._noffset:
-            return M
-        ones = jnp.ones((self.cm.bundle.ntoa, 1))
-        return jnp.concatenate([ones, M], axis=1)
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
         if self.cm.has_correlated_errors:
@@ -115,33 +93,4 @@ class WLSFitter:
         # parameter covariance in free_names order (offset row/col
         # dropped, matching the reference's parameter_covariance_matrix
         # without Offset)
-        no = self._noffset
-        cov = np.asarray(cov)[no:, no:]
-        sigmas = np.sqrt(np.diag(cov))
-        self.parameter_covariance_matrix = cov
-        self.cm.commit(np.asarray(x), uncertainties=sigmas)
-        self.resids = Residuals(
-            self.toas, self.model, compiled=self.cm
-        )
-        self.model.top_params["CHI2"].value = chi2
-        return chi2
-
-    def print_summary(self) -> str:
-        lines = [
-            f"Fitted model using WLS with {len(self.cm.free_names)} free "
-            f"parameters, {len(self.toas)} TOAs",
-            f"chi2 = {self.resids.chi2:.4f}  dof = {self.resids.dof}  "
-            f"reduced chi2 = {self.resids.reduced_chi2:.4f}",
-            f"weighted RMS = {self.resids.rms_weighted() * 1e6:.4f} us",
-            "",
-            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
-        ]
-        for n in self.cm.free_names:
-            p = self.model.params[n]
-            lines.append(
-                f"{n:<12}{p._format_value():>25}"
-                f"{p.uncertainty if p.uncertainty is not None else float('nan'):>15.3e}"
-            )
-        out = "\n".join(lines)
-        print(out)
-        return out
+        return self._finalize(x, cov, chi2)

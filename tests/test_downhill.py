@@ -1,0 +1,123 @@
+"""Downhill fitter tests.
+
+Strategy: downhill fitters must land on the same answer as their plain
+counterparts on well-conditioned problems, and must converge (via step
+halving) on problems seeded far from the optimum where one full
+Gauss-Newton step could overshoot.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import CorrelatedErrors
+from pint_tpu.fitting import (
+    DownhillGLSFitter,
+    DownhillWLSFitter,
+    GLSFitter,
+    WLSFitter,
+    auto_fitter,
+    ftest,
+)
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas.ingest import ingest_barycentric
+
+PAR = """
+PSR              J1744-1134
+F0               245.4261196898081  1
+F1               -5.38e-16          1
+PEPOCH           55000
+DM               3.1380             1
+"""
+
+
+def _toas(model, n=150, seed=1, sigma=1e-6):
+    rng = np.random.default_rng(seed)
+    toas = make_fake_toas_uniform(
+        54000, 56000, n, model, error_us=1.0,
+        freq_mhz=np.where(np.arange(n) % 2, 1400.0, 2300.0),
+        add_noise=False,
+    )
+    toas.t = toas.t.add_seconds(rng.normal(0, sigma, n))
+    ingest_barycentric(toas)
+    return toas
+
+
+def test_downhill_wls_matches_wls():
+    m_true = get_model(PAR)
+    toas = _toas(m_true)
+    m1, m2 = get_model(PAR), get_model(PAR)
+    WLSFitter(toas, m1).fit_toas(maxiter=4)
+    f2 = DownhillWLSFitter(toas, m2)
+    f2.fit_toas()
+    assert f2.converged
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-12, abs=1e-30), n
+        assert m1.params[n].uncertainty == pytest.approx(
+            m2.params[n].uncertainty, rel=1e-6
+        ), n
+
+
+def test_downhill_wls_converges_from_offset_start():
+    """Perturb F0 by many sigma: the downhill fitter must still converge
+    to the true solution (phase wrapping keeps it within a cycle here)."""
+    m_true = get_model(PAR)
+    toas = _toas(m_true, n=200)
+    m = get_model(PAR)
+    # ~5e-10 Hz offset over a 2000-day span is ~0.1 cycles of drift
+    m.params["F0"].value = str(float(m.params["F0"].value.to_float()) + 5e-10)
+    f = DownhillWLSFitter(toas, m)
+    f.fit_toas()
+    assert f.converged
+    f0 = float(m.params["F0"].value.to_float())
+    assert f0 == pytest.approx(245.4261196898081, abs=5e-12)
+
+
+def test_downhill_gls_matches_gls():
+    par = PAR + "ECORR -f L-wide 0.5\nTNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 10\n"
+    m_true = get_model(PAR)
+    toas = _toas(m_true, n=120)
+    for i, f in enumerate(toas.flags):
+        f["f"] = "L-wide" if i % 2 else "S-wide"
+    m1, m2 = get_model(par), get_model(par)
+    c1 = GLSFitter(toas, m1).fit_toas(maxiter=4)
+    f2 = DownhillGLSFitter(toas, m2)
+    c2 = f2.fit_toas()
+    assert f2.converged
+    assert c1 == pytest.approx(c2, rel=1e-6)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-10, abs=1e-30), n
+
+
+def test_downhill_wls_refuses_correlated():
+    m = get_model(PAR + "ECORR -f L-wide 0.5\n")
+    toas = _toas(m)
+    for f in toas.flags:
+        f["f"] = "L-wide"
+    with pytest.raises(CorrelatedErrors):
+        DownhillWLSFitter(toas, m)
+
+
+def test_auto_fitter_selection():
+    m_white = get_model(PAR)
+    toas = _toas(m_white)
+    assert isinstance(auto_fitter(toas, m_white), DownhillWLSFitter)
+    assert isinstance(
+        auto_fitter(toas, m_white, downhill=False), WLSFitter
+    )
+    m_red = get_model(PAR + "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 10\n")
+    assert isinstance(auto_fitter(toas, m_red), DownhillGLSFitter)
+    assert isinstance(auto_fitter(toas, m_red, downhill=False), GLSFitter)
+
+
+def test_ftest():
+    # adding 2 useless params: p ~ uniform; adding 2 that wipe chi2: p ~ 0
+    assert ftest(100.0, 98, 99.0, 96) > 0.3
+    assert ftest(1000.0, 98, 96.0, 96) < 1e-10
+    assert np.isnan(ftest(100.0, 96, 99.0, 98))
