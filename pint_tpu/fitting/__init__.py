@@ -10,6 +10,11 @@ from pint_tpu.fitting.downhill import (  # noqa: F401
 )
 from pint_tpu.fitting.gls import GLSFitter  # noqa: F401
 from pint_tpu.fitting.utils import ftest  # noqa: F401
+from pint_tpu.fitting.wideband import (  # noqa: F401
+    WidebandDownhillFitter,
+    WidebandResiduals,
+    WidebandTOAFitter,
+)
 from pint_tpu.fitting.wls import WLSFitter  # noqa: F401
 
 
@@ -17,6 +22,9 @@ def auto_fitter(toas, model, downhill: bool = True, **kw):
     """Pick a fitter by model content (reference: Fitter.auto):
     wideband data -> Wideband fitter; correlated noise -> GLS; else WLS;
     downhill variants by default."""
+    if toas.is_wideband():
+        cls = WidebandDownhillFitter if downhill else WidebandTOAFitter
+        return cls(toas, model, **kw)
     correlated = any(
         c.introduces_correlated_errors for c in model.noise_components
     )

@@ -41,6 +41,11 @@ class Fitter:
         ones = jnp.ones((self.cm.bundle.ntoa, 1))
         return jnp.concatenate([ones, M], axis=1)
 
+    def _make_resids(self):
+        """Residuals object for the current compiled state; wideband
+        fitters override to return WidebandResiduals."""
+        return Residuals(self.toas, self.model, compiled=self.cm)
+
     def _finalize(self, x, cov, chi2: float):
         """Drop the offset row/col, commit fitted deltas + uncertainties
         back into host Parameters, refresh residuals."""
@@ -49,19 +54,31 @@ class Fitter:
         sigmas = np.sqrt(np.diag(cov))
         self.parameter_covariance_matrix = cov
         self.cm.commit(np.asarray(x), uncertainties=sigmas)
-        self.resids = Residuals(self.toas, self.model, compiled=self.cm)
+        self.resids = self._make_resids()
         self.model.top_params["CHI2"].value = float(chi2)
         self.chi2 = float(chi2)
         return float(chi2)
 
     def print_summary(self) -> str:
+        chi2 = self.chi2 if self.chi2 is not None else self.resids.chi2
         lines = [
             f"Fitted model using {type(self).__name__} with "
             f"{len(self.cm.free_names)} free parameters, "
             f"{len(self.toas)} TOAs; converged={self.converged}",
-            f"chi2 = {self.chi2:.4f}",
-            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}",
+            f"chi2 = {chi2:.4f}",
         ]
+        dof = getattr(self.resids, "dof", None)
+        if dof is not None:
+            lines.append(
+                f"dof = {dof}  reduced chi2 = {chi2 / dof:.4f}"
+            )
+        if hasattr(self.resids, "rms_weighted"):
+            lines.append(
+                f"weighted RMS = {self.resids.rms_weighted() * 1e6:.4f} us"
+            )
+        lines.append(
+            f"{'PARAM':<12}{'VALUE':>25}{'UNCERTAINTY':>15}"
+        )
         for n in self.cm.free_names:
             p = self.model.params[n]
             unc = p.uncertainty if p.uncertainty is not None else float("nan")

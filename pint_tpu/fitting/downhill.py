@@ -104,6 +104,9 @@ class DownhillFitter(Fitter):
                 ConvergenceWarning,
             )
 
+        # covariance at the FINAL accepted state (the loop's cov is one
+        # Gauss-Newton step stale for x-dependent sigmas/designs)
+        _, cov, _ = proposal(x)
         return self._finalize(x, cov, float(chi2))
 
 
@@ -146,12 +149,7 @@ class DownhillGLSFitter(DownhillFitter):
 
     def _noise(self, x):
         Ndiag = jnp.square(self.cm.scaled_sigma(x))
-        bw = self.cm.noise_basis(x)
-        if bw is None:
-            T = jnp.zeros((self.cm.bundle.ntoa, 1))
-            phi = jnp.ones(1) * 1e-40
-        else:
-            T, phi = bw
+        T, phi = self.cm.noise_basis_or_empty(x)
         return Ndiag, T, phi
 
     def _make_proposal(self):

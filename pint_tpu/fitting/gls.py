@@ -125,16 +125,9 @@ class GLSFitter(Fitter):
             r = self.cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
             Ndiag = jnp.square(self.cm.scaled_sigma(x))
-            bw = self.cm.noise_basis(x)
-            if bw is None:
-                # pure white: Woodbury with an empty basis degenerates to
-                # WLS normal equations
-                T = jnp.zeros((self.cm.bundle.ntoa, 1))
-                phi = jnp.ones(1) * 1e-40
-                if full_cov:
-                    return gls_step_full_cov(r, M, Ndiag, None, None)
-                return gls_step_woodbury(r, M, Ndiag, T, phi)
-            T, phi = bw
+            # pure white: Woodbury with the empty basis degenerates to
+            # WLS normal equations
+            T, phi = self.cm.noise_basis_or_empty(x)
             if full_cov:
                 return gls_step_full_cov(r, M, Ndiag, T, phi)
             return gls_step_woodbury(r, M, Ndiag, T, phi)

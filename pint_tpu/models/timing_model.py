@@ -364,6 +364,38 @@ class CompiledModel:
             return (ph.int_ - pn + self.bundle.padd) + ph.frac
         return ph.frac
 
+    # -- wideband DM interfaces (reference: dispersion components'
+    # dm_value/d_dm_d_param consumed by WidebandTOAResiduals) ------------
+    def dm_model(self, x):
+        """Model DM at each TOA in pc/cm^3, including DMJUMP offsets to
+        the measurement scale."""
+        pd = self._pdict(x)
+        dm = jnp.zeros(self.bundle.ntoa)
+        for c in self.model.delay_components:
+            if hasattr(c, "dm_value"):
+                dm = dm + c.dm_value(pd, self.bundle)
+            if hasattr(c, "dm_offset"):
+                dm = dm + c.dm_offset(pd, self.bundle)
+        return dm
+
+    def dm_residuals(self, x):
+        """Wideband DM residuals: measured - model (pc/cm^3)."""
+        if self.bundle.dm_meas is None:
+            raise TimingModelError(
+                "no wideband DM measurements (-pp_dm flags) in these TOAs"
+            )
+        return self.bundle.dm_meas - self.dm_model(x)
+
+    def scaled_dm_sigma(self, x):
+        """Per-TOA wideband DM uncertainty (pc/cm^3) after DMEFAC/DMEQUAD
+        rescaling (reference: TimingModel.scaled_dm_sigma)."""
+        pd = self._pdict(x)
+        sig = self.bundle.dm_err
+        for c in self.model.noise_components:
+            if hasattr(c, "scaled_dm_sigma"):
+                sig = c.scaled_dm_sigma(pd, self.bundle, sig)
+        return sig
+
     def scaled_sigma(self, x):
         """Per-TOA white uncertainty in seconds after noise-model
         rescaling (reference: TimingModel.scaled_toa_sigma)."""
@@ -387,6 +419,19 @@ class CompiledModel:
             return None
         return (
             jnp.concatenate(bases, axis=1), jnp.concatenate(weights)
+        )
+
+    def noise_basis_or_empty(self, x):
+        """Like noise_basis but never None: models without correlated
+        noise get a zero basis column with ~zero weight, so GLS /
+        downhill / wideband consumers share one degenerate-basis
+        convention."""
+        bw = self.noise_basis(x)
+        if bw is not None:
+            return bw
+        return (
+            jnp.zeros((self.bundle.ntoa, 1)),
+            jnp.ones(1) * 1e-40,
         )
 
     @property

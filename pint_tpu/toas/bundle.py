@@ -36,6 +36,9 @@ class TOABundle(NamedTuple):
     pulse_number: jnp.ndarray  # (n,) f64; NaN where untracked
     padd: jnp.ndarray  # (n,) f64 phase adds from -padd flags / PHASE cmds
     masks: dict  # mask-param name -> (n,) f64 0/1
+    # wideband DM measurements (pc/cm^3); None for narrowband data
+    dm_meas: Optional[jnp.ndarray] = None
+    dm_err: Optional[jnp.ndarray] = None
 
     @property
     def ntoa(self):
@@ -84,6 +87,8 @@ def make_bundle(
     padd = np.array(
         [float(f.get("padd", 0.0)) for f in toas.flags], dtype=np.float64
     )
+    wb = toas.is_wideband()
+    dm_meas, dm_err = toas.get_dm_measurements() if wb else (None, None)
     return TOABundle(
         tdb_day=jnp.asarray(toas.t_tdb.mjd_int, dtype=jnp.float64),
         tdb_sec=DD(
@@ -99,5 +104,7 @@ def make_bundle(
         },
         pulse_number=jnp.asarray(pn),
         padd=jnp.asarray(padd),
+        dm_meas=None if dm_meas is None else jnp.asarray(dm_meas),
+        dm_err=None if dm_err is None else jnp.asarray(dm_err),
         masks={k: jnp.asarray(v, dtype=jnp.float64) for k, v in (masks or {}).items()},
     )

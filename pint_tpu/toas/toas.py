@@ -104,6 +104,22 @@ class TOAs:
     def get_flag_value(self, flag: str, default="") -> list:
         return [f.get(flag, default) for f in self.flags]
 
+    def is_wideband(self) -> bool:
+        """True when every TOA carries a wideband DM measurement
+        (-pp_dm flag; reference: toa.py::TOAs.is_wideband)."""
+        return len(self) > 0 and all("pp_dm" in f for f in self.flags)
+
+    def get_dm_measurements(self) -> tuple[np.ndarray, np.ndarray]:
+        """Wideband DM measurements + errors (pc/cm^3) from -pp_dm /
+        -pp_dme flags; NaN where absent."""
+        dm = np.array(
+            [float(f.get("pp_dm", np.nan)) for f in self.flags]
+        )
+        dme = np.array(
+            [float(f.get("pp_dme", np.nan)) for f in self.flags]
+        )
+        return dm, dme
+
     def get_pulse_numbers(self) -> Optional[np.ndarray]:
         """Per-TOA pulse numbers from -pn flags, if all present."""
         pns = self.get_flag_value("pn", None)

@@ -1,0 +1,173 @@
+"""Wideband (joint TOA + DM-measurement) fitting tests.
+
+Strategy: simulate narrowband-perfect TOAs, attach -pp_dm/-pp_dme DM
+measurements drawn from the true model, then check that (a) the joint
+fit recovers perturbed parameters, (b) DM information flows from the DM
+block (a DM offset invisible at a single frequency is still recovered),
+(c) DMJUMP absorbs per-receiver DM-measurement offsets, (d) DMEFAC
+scales the DM block chi2.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.fitting import (
+    WidebandDownhillFitter,
+    WidebandResiduals,
+    WidebandTOAFitter,
+    auto_fitter,
+)
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas.ingest import ingest_barycentric
+
+PAR = """
+PSR              J1234+5678
+F0               315.4               1
+F1               -6.2e-16            1
+PEPOCH           55000
+DM               21.7                1
+"""
+
+
+def _wb_toas(model, n=120, seed=2, dm_sigma=2e-4, dm_offsets=None):
+    rng = np.random.default_rng(seed)
+    toas = make_fake_toas_uniform(
+        54500, 56500, n, model, error_us=1.0,
+        freq_mhz=np.where(np.arange(n) % 2, 1400.0, 800.0),
+        add_noise=False,
+    )
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, n))
+    dm_true = 21.7
+    dm_meas = dm_true + rng.normal(0, dm_sigma, n)
+    if dm_offsets is not None:
+        dm_meas = dm_meas + dm_offsets
+    for i, f in enumerate(toas.flags):
+        f["pp_dm"] = f"{dm_meas[i]:.10f}"
+        f["pp_dme"] = f"{dm_sigma:.2e}"
+        f["fe"] = "RCVR_L" if i % 2 else "RCVR_800"
+    ingest_barycentric(toas)
+    return toas
+
+
+def test_is_wideband_and_auto_selection():
+    m = get_model(PAR)
+    toas = _wb_toas(m)
+    assert toas.is_wideband()
+    assert isinstance(auto_fitter(toas, m), WidebandDownhillFitter)
+    assert isinstance(
+        auto_fitter(toas, m, downhill=False), WidebandTOAFitter
+    )
+
+
+def test_wideband_requires_dm_flags():
+    m = get_model(PAR)
+    toas = make_fake_toas_uniform(54500, 56500, 50, m, error_us=1.0)
+    ingest_barycentric(toas)
+    with pytest.raises(PintTpuError):
+        WidebandTOAFitter(toas, m)
+
+
+def test_wideband_missing_dme_raises():
+    m = get_model(PAR)
+    toas = _wb_toas(m, n=40)
+    del toas.flags[7]["pp_dme"]
+    with pytest.raises(PintTpuError, match="pp_dme"):
+        WidebandTOAFitter(toas, m)
+
+
+def test_print_summary_prefit_and_postfit():
+    m = get_model(PAR)
+    toas = _wb_toas(m, n=40)
+    f = WidebandTOAFitter(toas, m)
+    assert "chi2" in f.print_summary()  # pre-fit: must not crash
+    f.fit_toas(maxiter=2)
+    assert "PARAM" in f.print_summary()
+
+
+def test_wideband_fit_recovers_parameters():
+    m_true = get_model(PAR)
+    toas = _wb_toas(m_true)
+    m = get_model(PAR)
+    m.params["DM"].value = 21.7005  # ~25 sigma_dm off
+    m.params["F0"].value = "315.40000000002"
+    f = WidebandTOAFitter(toas, m)
+    f.fit_toas(maxiter=5)
+    dm = float(m.params["DM"].value)
+    f0 = float(m.params["F0"].value.to_float())
+    assert dm == pytest.approx(21.7, abs=1e-4)
+    assert f0 == pytest.approx(315.4, abs=5e-12)
+    # joint chi2 ~ 2n for a consistent model
+    assert f.chi2 < 2.5 * 2 * len(toas)
+    assert isinstance(f.resids, WidebandResiduals)
+    assert f.resids.dm_chi2 < 2.5 * len(toas)
+
+
+def test_wideband_downhill_matches_plain():
+    m_true = get_model(PAR)
+    toas = _wb_toas(m_true)
+    m1, m2 = get_model(PAR), get_model(PAR)
+    c1 = WidebandTOAFitter(toas, m1).fit_toas(maxiter=4)
+    f2 = WidebandDownhillFitter(toas, m2)
+    c2 = f2.fit_toas()
+    assert f2.converged
+    assert c1 == pytest.approx(c2, rel=1e-6)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        assert v1 == pytest.approx(v2, rel=1e-10, abs=1e-30), n
+
+
+def test_dm_block_constrains_dm_beyond_timing():
+    """With a single observing frequency, the timing block can trade DM
+    against F0/offset freely on short spans; the DM block pins it."""
+    m_true = get_model(PAR)
+    rng = np.random.default_rng(5)
+    n = 80
+    toas = make_fake_toas_uniform(
+        55300, 55500, n, m_true, error_us=1.0, freq_mhz=1400.0,
+        add_noise=False,
+    )
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, n))
+    dm_sigma = 1e-4
+    for i, f in enumerate(toas.flags):
+        f["pp_dm"] = f"{21.7 + rng.normal(0, dm_sigma):.10f}"
+        f["pp_dme"] = f"{dm_sigma:.2e}"
+    ingest_barycentric(toas)
+    m = get_model(PAR)
+    m.params["F1"].frozen = True
+    WidebandTOAFitter(toas, m).fit_toas(maxiter=5)
+    assert float(m.params["DM"].value) == pytest.approx(
+        21.7, abs=5e-5
+    )
+    assert m.params["DM"].uncertainty < 5e-5
+
+
+def test_dmjump_absorbs_receiver_offset():
+    m_true = get_model(PAR)
+    n = 120
+    offsets = np.where(np.arange(n) % 2, 3e-3, 0.0)  # RCVR_L shifted
+    toas = _wb_toas(m_true, n=n, dm_offsets=offsets)
+    par = PAR + "DMJUMP -fe RCVR_L 0 1\n"
+    m = get_model(par)
+    f = WidebandTOAFitter(toas, m)
+    f.fit_toas(maxiter=5)
+    # model dm_offset = -DMJUMP*mask must absorb the +3e-3 shift
+    dmj = [p for p in m.params if p.startswith("DMJUMP")]
+    assert len(dmj) == 1
+    val = float(m.params[dmj[0]].value)
+    assert abs(abs(val) - 3e-3) < 2e-4
+    # and DM itself stays at truth
+    assert float(m.params["DM"].value) == pytest.approx(21.7, abs=2e-4)
+
+
+def test_dmefac_scales_dm_chi2():
+    m_true = get_model(PAR)
+    toas = _wb_toas(m_true, seed=9)
+    m_plain = get_model(PAR)
+    r_plain = WidebandResiduals(toas, m_plain)
+    m_scaled = get_model(PAR + "DMEFAC -fe RCVR_L 2.0\nDMEFAC -fe RCVR_800 2.0\n")
+    r_scaled = WidebandResiduals(toas, m_scaled)
+    assert r_scaled.dm_chi2 == pytest.approx(r_plain.dm_chi2 / 4.0, rel=1e-9)
