@@ -20,10 +20,13 @@ from pint_tpu.models.dispersion import (  # noqa: F401
     DMJump,
 )
 from pint_tpu.models.jump import DelayJump, PhaseJump  # noqa: F401
+from pint_tpu.models.piecewise import PiecewiseSpindown  # noqa: F401
 from pint_tpu.models.pulsar_binary import (  # noqa: F401
     BinaryBT,
+    BinaryBTPiecewise,
     BinaryDD,
     BinaryDDGR,
+    BinaryDDH,
     BinaryDDK,
     BinaryDDS,
     BinaryELL1,
@@ -31,6 +34,7 @@ from pint_tpu.models.pulsar_binary import (  # noqa: F401
     BinaryELL1k,
     PulsarBinary,
 )
+from pint_tpu.models.troposphere import TroposphereDelay  # noqa: F401
 from pint_tpu.models.absolute_phase import AbsPhase  # noqa: F401
 from pint_tpu.models.chromatic import ChromaticCM  # noqa: F401
 from pint_tpu.models.frequency_dependent import FD, FDJump  # noqa: F401
@@ -38,6 +42,7 @@ from pint_tpu.models.glitch import Glitch  # noqa: F401
 from pint_tpu.models.ifunc import IFunc  # noqa: F401
 from pint_tpu.models.noise import (  # noqa: F401
     EcorrNoise,
+    PLChromNoise,
     PLDMNoise,
     PLRedNoise,
     ScaleDmError,
@@ -45,7 +50,10 @@ from pint_tpu.models.noise import (  # noqa: F401
 )
 from pint_tpu.models.phase_offset import PhaseOffset  # noqa: F401
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
-from pint_tpu.models.solar_wind import SolarWindDispersion  # noqa: F401
+from pint_tpu.models.solar_wind import (  # noqa: F401
+    SolarWindDispersion,
+    SolarWindDispersionX,
+)
 from pint_tpu.models.wave import CMWaveX, DMWaveX, Wave, WaveX  # noqa: F401
 from pint_tpu.models.spindown import Spindown  # noqa: F401
 from pint_tpu.models.timing_model import CompiledModel, TimingModel  # noqa: F401

@@ -29,7 +29,9 @@ import pint_tpu.models.ifunc  # noqa: F401
 import pint_tpu.models.jump  # noqa: F401
 import pint_tpu.models.noise  # noqa: F401
 import pint_tpu.models.phase_offset  # noqa: F401
+import pint_tpu.models.piecewise  # noqa: F401
 import pint_tpu.models.solar_wind  # noqa: F401
+import pint_tpu.models.troposphere  # noqa: F401
 import pint_tpu.models.wave  # noqa: F401
 import pint_tpu.models.pulsar_binary  # noqa: F401
 import pint_tpu.models.solar_system_shapiro  # noqa: F401

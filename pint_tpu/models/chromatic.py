@@ -27,7 +27,13 @@ class ChromaticCM(DelayComponent):
     def __init__(self, max_terms: int = 6):
         super().__init__()
         self.add_param(floatParameter("CM", units="pc/cm^3 MHz^(a-2)"))
-        self.add_param(floatParameter("CMIDX", units="", value=4.0))
+        # the chromatic index: the reference spells it TNCHROMIDX in par
+        # files (chromatic_model.py); the noise component PLChromNoise
+        # reads it from here
+        self.add_param(
+            floatParameter("CMIDX", units="", value=4.0,
+                           aliases=("TNCHROMIDX", "TNChromIdx"))
+        )
         for k in range(1, max_terms + 1):
             self.add_param(
                 floatParameter(

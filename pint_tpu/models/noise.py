@@ -275,6 +275,47 @@ class PLRedNoise(NoiseComponent):
         return F, phi
 
 
+class PLChromNoise(NoiseComponent):
+    """Power-law chromatic noise (reference: noise_model.py::
+    PLChromNoise) — basis columns scaled by (1400 MHz / f)^index.  The
+    chromatic index is the ChromaticCM component's CMIDX/TNCHROMIDX (the
+    reference reads it from the CM model too); 4.0 when no ChromaticCM
+    is in the model."""
+
+    register = True
+    category = "pl_chrom_noise"
+    introduces_correlated_errors = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(
+            floatParameter("TNCHROMAMP", units="log10", aliases=("TNChromAmp",))
+        )
+        self.add_param(
+            floatParameter("TNCHROMGAM", units="", aliases=("TNChromGam",))
+        )
+        self.add_param(floatParameter("TNCHROMC", units="", value=None))
+
+    def validate(self, model):
+        self.require("TNCHROMAMP", "TNCHROMGAM")
+
+    def _nharm(self):
+        v = self.params["TNCHROMC"].value
+        return int(v) if v is not None else 30
+
+    def basis_weight(self, pdict, bundle):
+        F, f, tspan = fourier_basis(bundle, self._nharm())
+        idx = pdict.get("CMIDX")
+        if idx is None:
+            idx = 4.0
+        chrom = (1400.0 / bundle.freq_mhz) ** idx
+        F = F * chrom[:, None]
+        phi = powerlaw_phi(
+            f, tspan, pdict["TNCHROMAMP"], pdict["TNCHROMGAM"]
+        )
+        return F, phi
+
+
 class PLDMNoise(NoiseComponent):
     """Power-law DM (chromatic nu^-2) noise; basis columns scaled by
     (1400 MHz / f)^2 so amplitudes share the red-noise convention."""

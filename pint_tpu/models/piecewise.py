@@ -1,0 +1,102 @@
+"""Piecewise spindown: independent spin solutions over MJD intervals.
+
+Reference parity: src/pint/models/piecewise.py::PiecewiseSpindown — per
+piece i, for TOAs with PWSTART_i <= MJD < PWSTOP_i, add
+
+  phase_i = PWPH_i + PWF0_i dt + PWF1_i dt^2/2 + PWF2_i dt^3/6,
+  dt = t - PWEP_i (seconds, delay-corrected)
+
+on top of the global Spindown phase.  Range membership is static per
+TOA -> 0/1 masks at compile time; the piece terms are small (offsets
+from the global solution), so f64 accumulation into DD phase is exact.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import MissingParameter, TimingModelError
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    prefix_index,
+)
+from pint_tpu.ops.dd import DD
+
+_FAMS = ("PWEP_", "PWPH_", "PWF0_", "PWF1_", "PWF2_", "PWSTART_", "PWSTOP_")
+
+
+class PiecewiseSpindown(PhaseComponent):
+    register = True
+    category = "piecewise_spindown"
+
+    def __init__(self):
+        super().__init__()
+        self.prefix_patterns = list(_FAMS)
+        self.piece_indices: list[int] = []
+
+    def add_piece(self, idx: int):
+        self.add_param(MJDParameter(f"PWEP_{idx}", time_scale="tdb"))
+        self.add_param(
+            floatParameter(f"PWPH_{idx}", units="cycles", value=0.0)
+        )
+        self.add_param(floatParameter(f"PWF0_{idx}", units="Hz", value=0.0))
+        self.add_param(floatParameter(f"PWF1_{idx}", units="Hz/s", value=0.0))
+        self.add_param(
+            floatParameter(f"PWF2_{idx}", units="Hz/s^2", value=0.0)
+        )
+        self.add_param(floatParameter(f"PWSTART_{idx}", units="MJD"))
+        self.add_param(floatParameter(f"PWSTOP_{idx}", units="MJD"))
+        self.piece_indices.append(idx)
+
+    def new_prefix_param(self, name):
+        for pref in _FAMS:
+            idx = prefix_index(name, pref)
+            if idx is not None:
+                if f"PWEP_{idx}" not in self.params:
+                    self.add_piece(idx)
+                return self.params[f"{pref}{idx}"]
+        return None
+
+    def setup(self, model):
+        self.piece_indices = sorted(
+            int(n[5:]) for n in self.params
+            if n.startswith("PWEP_") and self.params[n].value is not None
+        )
+
+    def validate(self, model):
+        for i in self.piece_indices:
+            if self.params[f"PWEP_{i}"].value is None:
+                raise MissingParameter("PiecewiseSpindown", f"PWEP_{i}")
+            if (
+                self.params[f"PWSTART_{i}"].value is None
+                or self.params[f"PWSTOP_{i}"].value is None
+            ):
+                raise TimingModelError(
+                    f"piecewise-spindown piece {i} missing PWSTART/PWSTOP"
+                )
+
+    def extra_masks(self, toas) -> dict:
+        mjd = toas.mjd_float()
+        out = {}
+        for i in self.piece_indices:
+            r1 = self.params[f"PWSTART_{i}"].value
+            r2 = self.params[f"PWSTOP_{i}"].value
+            out[f"PW_{i}"] = ((mjd >= r1) & (mjd < r2)).astype(np.float64)
+        return out
+
+    def phase_term(self, pdict, bundle, delay):
+        total = jnp.zeros(bundle.ntoa)
+        for i in self.piece_indices:
+            day, sec = pdict[f"PWEP_{i}"]
+            dt = bundle.dt_seconds(day, sec).to_float() - delay
+            ph = (
+                pdict[f"PWPH_{i}"]
+                + pdict[f"PWF0_{i}"] * dt
+                + pdict[f"PWF1_{i}"] * dt * dt / 2.0
+                + pdict[f"PWF2_{i}"] * dt**3 / 6.0
+            )
+            total = total + bundle.masks[f"PW_{i}"] * ph
+        return DD.from_float(total)

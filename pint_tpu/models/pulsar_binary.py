@@ -240,6 +240,160 @@ class BinaryDD(PulsarBinary):
         )
 
 
+class BinaryDDH(BinaryDD):
+    """DD with orthometric Shapiro parameters (H3, STIGMA) per
+    Freire & Wex 2010 — for systems where M2/SINI are strongly
+    covariant.
+
+    Reference: models/binary_dd.py::BinaryDDH / DDH_model.py:
+    r = H3/STIGMA^3, s = 2 STIGMA/(1 + STIGMA^2).
+    """
+
+    register = True
+    binary_model_name = "DDH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("H3", units="s"))
+        self.add_param(
+            floatParameter("STIGMA", units="", aliases=("STIG", "VARSIGMA"))
+        )
+        for n in ("M2", "SINI"):
+            self.remove_param(n)
+
+    def validate(self, model):
+        super().validate(model)
+        self.require("H3", "STIGMA")
+        stig = float(self.params["STIGMA"].value)
+        if not 0.0 < stig < 1.0:
+            raise TimingModelError(
+                f"DDH needs 0 < STIGMA < 1 (got {stig}): "
+                "stigma = sini/(1+cosi) and m2r = H3/STIGMA^3"
+            )
+
+    def _pk(self, pdict, dt_f):
+        pk = super()._pk(pdict, dt_f)
+        h3 = self.val(pdict, "H3")
+        stig = self.val(pdict, "STIGMA")
+        pk["m2r"] = h3 / (stig * stig * stig)
+        pk["sini"] = 2.0 * stig / (1.0 + stig * stig)
+        return pk
+
+
+class BinaryBTPiecewise(BinaryBT):
+    """BT with piecewise-constant T0 / A1 over MJD ranges.
+
+    Reference: models/binary_bt_piecewise.py::BinaryBTPiecewise /
+    BT_piecewise.py — per range i, T0X_#### and/or A1X_#### replace the
+    global T0/A1 for TOAs with XR1_#### <= MJD < XR2_####.  Range
+    membership is static per TOA (depends only on TOA epochs), so the
+    pieces become 0/1 mask arrays at compile time.
+    """
+
+    register = True
+    binary_model_name = "BT_PIECEWISE"
+
+    def __init__(self):
+        super().__init__()
+        self.piece_indices: list[int] = []
+        self.prefix_patterns = list(self.prefix_patterns) + [
+            "T0X_", "A1X_", "XR1_", "XR2_"
+        ]
+
+    def add_piece(self, idx: int):
+        self.add_param(MJDParameter(f"T0X_{idx:04d}", time_scale="tdb"))
+        self.add_param(floatParameter(f"A1X_{idx:04d}", units="ls"))
+        self.add_param(floatParameter(f"XR1_{idx:04d}", units="MJD"))
+        self.add_param(floatParameter(f"XR2_{idx:04d}", units="MJD"))
+        self.piece_indices.append(idx)
+
+    def new_prefix_param(self, name):
+        from pint_tpu.models.parameter import prefix_index
+
+        for pref in ("T0X_", "A1X_", "XR1_", "XR2_"):
+            idx = prefix_index(name, pref)
+            if idx is not None:
+                if f"XR1_{idx:04d}" not in self.params:
+                    self.add_piece(idx)
+                return self.params[f"{pref}{idx:04d}"]
+        return super().new_prefix_param(name)
+
+    def setup(self, model):
+        super().setup(model)
+        # a piece exists if ANY of its parameters is set, so validate can
+        # catch missing range bounds instead of silently dropping pieces
+        idx = set()
+        for n, p in self.params.items():
+            if p.value is None:
+                continue
+            for pref in ("T0X_", "A1X_", "XR1_", "XR2_"):
+                if n.startswith(pref) and n[len(pref):].isdigit():
+                    idx.add(int(n[len(pref):]))
+        self.piece_indices = sorted(idx)
+
+    def validate(self, model):
+        super().validate(model)
+        spans = []
+        for i in self.piece_indices:
+            r1 = self.params[f"XR1_{i:04d}"].value
+            r2 = self.params[f"XR2_{i:04d}"].value
+            if r1 is None or r2 is None:
+                raise TimingModelError(
+                    f"BT piecewise range {i} missing XR1/XR2 bounds"
+                )
+            spans.append((r1, r2, i))
+        spans.sort()
+        for (a1, a2, i), (b1, b2, j) in zip(spans, spans[1:]):
+            if b1 < a2:
+                raise TimingModelError(
+                    f"BT piecewise ranges {i} and {j} overlap "
+                    f"([{a1}, {a2}) vs [{b1}, {b2}))"
+                )
+
+    def extra_masks(self, toas) -> dict:
+        import numpy as np
+
+        mjd = toas.mjd_float()
+        out = {}
+        for i in self.piece_indices:
+            r1 = self.params[f"XR1_{i:04d}"].value
+            r2 = self.params[f"XR2_{i:04d}"].value
+            out[f"BTX_{i:04d}"] = ((mjd >= r1) & (mjd < r2)).astype(
+                np.float64
+            )
+        return out
+
+    def _binary_delay(self, pdict, bundle, dt: DD):
+        from pint_tpu.models.binaries.bt import bt_delay
+
+        # piecewise T0: subtract (T0X - T0) seconds inside each range
+        t0_day, t0_sec = pdict["T0"]
+        shift = jnp.zeros(bundle.ntoa)
+        a1_extra = jnp.zeros(bundle.ntoa)
+        for i in self.piece_indices:
+            m = bundle.masks[f"BTX_{i:04d}"]
+            t0x = pdict.get(f"T0X_{i:04d}")
+            if t0x is not None:
+                xd, xs = t0x
+                dsec = (xd - t0_day) * 86400.0 + (
+                    (xs - t0_sec).to_float()
+                    if isinstance(xs, DD) else xs - t0_sec
+                )
+                shift = shift + m * dsec
+            a1x = pdict.get(f"A1X_{i:04d}")
+            if a1x is not None:
+                a1_extra = a1_extra + m * (a1x - self.val(pdict, "A1"))
+        dt = dt - shift
+        dt_f = dt.to_float()
+        M, _ = phase_from_orbits(self._orbits(pdict, dt))
+        nb = self._nb(pdict, dt_f)
+        a1 = self._a1(pdict, dt_f) + a1_extra
+        return bt_delay(
+            M, nb, a1, self._ecc(pdict, dt_f),
+            self._om(pdict, dt_f), self.val(pdict, "GAMMA"),
+        )
+
+
 class BinaryDDS(BinaryDD):
     """DD with SHAPMAX parameterization of the Shapiro shape,
     s = 1 - exp(-SHAPMAX) (high-inclination systems).

@@ -122,8 +122,125 @@ class SolarWindDispersion(DelayComponent):
         dm = col / _PC_LS
         return jnp.where(d > 0, dm, 0.0)
 
+    def dm_value(self, pdict, bundle):
+        """Wideband interface: solar-wind DM counts toward the model DM
+        at each TOA (reference: SolarWindDispersion is a 'dispersion
+        type' component in the wideband DM model)."""
+        if self.params["NE_SW"].value is None:
+            return jnp.zeros(bundle.ntoa)
+        return self.solar_wind_dm(pdict, bundle)
+
     def delay_term(self, pdict, bundle, acc_delay):
         if self.params["NE_SW"].value is None:
             return jnp.zeros(bundle.ntoa)
         dm = self.solar_wind_dm(pdict, bundle)
         return DM_CONST * dm / jnp.square(bundle.freq_mhz)
+
+
+class SolarWindDispersionX(DelayComponent):
+    """Piecewise solar-wind DM amplitudes over MJD ranges (SWX).
+
+    Reference: src/pint/models/solar_wind_dispersion.py::
+    SolarWindDispersionX — per segment i with SWXR1_/SWXR2_ bounds,
+    SWXDM_#### scales the normalized spherical solar-wind geometry
+    profile; the fitted quantity is the segment's DM amplitude.  Here
+    the profile is the n0=1 column normalized at 90-degree elongation /
+    1 AU, so SWXDM is the DM the segment would produce at quadrature
+    [verify normalization convention against the reference mount].
+    """
+
+    register = True
+    category = "solar_wind"
+
+    def __init__(self):
+        super().__init__()
+        self.swx_indices: list[int] = []
+        self.prefix_patterns = ["SWXDM_", "SWXR1_", "SWXR2_"]
+
+    def add_swx_range(self, idx: int):
+        self.add_param(
+            floatParameter(f"SWXDM_{idx:04d}", units="pc/cm^3", value=0.0)
+        )
+        self.add_param(floatParameter(f"SWXR1_{idx:04d}", units="MJD"))
+        self.add_param(floatParameter(f"SWXR2_{idx:04d}", units="MJD"))
+        self.swx_indices.append(idx)
+
+    def new_prefix_param(self, name):
+        for pref in ("SWXDM_", "SWXR1_", "SWXR2_"):
+            idx = prefix_index(name, pref)
+            if idx is not None:
+                if f"SWXDM_{idx:04d}" not in self.params:
+                    self.add_swx_range(idx)
+                return self.params[f"{pref}{idx:04d}"]
+        return None
+
+    def setup(self, model):
+        from pint_tpu.models.astrometry import Astrometry
+
+        self._astrometry_ref = None
+        for c in model.components.values():
+            if isinstance(c, Astrometry):
+                self._astrometry_ref = c
+        self.swx_indices = sorted(
+            int(n[6:]) for n in self.params
+            if n.startswith("SWXDM_") and self.params[n].value is not None
+        )
+
+    def validate(self, model):
+        from pint_tpu.exceptions import MissingParameter, TimingModelError
+
+        if self.swx_indices and self._astrometry_ref is None:
+            raise TimingModelError("SWX needs an astrometry component")
+        for i in self.swx_indices:
+            if (
+                self.params[f"SWXR1_{i:04d}"].value is None
+                or self.params[f"SWXR2_{i:04d}"].value is None
+            ):
+                raise MissingParameter(
+                    "SolarWindDispersionX", f"SWXR1_{i:04d}/SWXR2_{i:04d}"
+                )
+
+    def extra_masks(self, toas) -> dict:
+        mjd = toas.mjd_float()
+        out = {}
+        for i in self.swx_indices:
+            r1 = self.params[f"SWXR1_{i:04d}"].value
+            r2 = self.params[f"SWXR2_{i:04d}"].value
+            out[f"SWX_{i:04d}"] = ((mjd >= r1) & (mjd < r2)).astype(
+                np.float64
+            )
+        return out
+
+    def _profile(self, pdict, bundle):
+        """Normalized geometry: 1 at 90-deg elongation, 1 AU."""
+        psr_dir = self._astrometry_ref.ssb_to_psr_xyz(pdict, bundle)
+        r = bundle.obs_sun_pos_ls
+        d = jnp.sqrt(jnp.sum(r * r, axis=-1))
+        safe_d = jnp.maximum(d, 1e-30)
+        cos_e = jnp.sum(r * psr_dir, axis=-1) / safe_d
+        theta = jnp.arccos(jnp.clip(cos_e, -1.0, 1.0))
+        sin_t = jnp.maximum(jnp.sin(theta), 1e-9)
+        prof = (
+            _AU_LS * (np.pi - theta) / (safe_d * sin_t)
+        ) / (np.pi / 2.0)
+        return jnp.where(d > 0, prof, 0.0)
+
+    def dm_value(self, pdict, bundle):
+        if not self.swx_indices:
+            return jnp.zeros(bundle.ntoa)
+        prof = self._profile(pdict, bundle)
+        dm = jnp.zeros(bundle.ntoa)
+        for i in self.swx_indices:
+            dm = dm + (
+                pdict[f"SWXDM_{i:04d}"]
+                * bundle.masks[f"SWX_{i:04d}"]
+                * prof
+            )
+        return dm
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        if not self.swx_indices:
+            return jnp.zeros(bundle.ntoa)
+        return DM_CONST * self.dm_value(pdict, bundle) / jnp.square(
+            bundle.freq_mhz
+        )

@@ -1,0 +1,138 @@
+"""Tropospheric propagation delay (hydrostatic + wet, Niell mapping).
+
+Reference parity: src/pint/models/troposphere_delay.py::TroposphereDelay
+— zenith hydrostatic delay from standard pressure at the observatory
+altitude (Davis et al. 1985), a nominal zenith wet delay, both mapped to
+the line-of-sight elevation with the Niell (1996) mapping functions
+(seasonally-varying hydrostatic coefficients, latitude-interpolated).
+
+Geometry inputs (per-TOA source elevation, observatory latitude /
+altitude) are static host-side products of topocentric ingest; they ride
+in ``bundle.masks`` like the other compile-time selections:
+
+  TROPO:sin_elev  (n,)  sine of source elevation
+  TROPO:lat       (n,)  observatory geodetic latitude (rad)
+  TROPO:alt       (n,)  observatory altitude (m)
+  TROPO:doy       (n,)  day-of-year (for the seasonal term)
+
+For data without topocentric geometry (site '@'), the delay is zero.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import boolParameter
+
+# Niell 1996 hydrostatic mapping coefficients at |lat| = 15,30,45,60,75 deg
+_LAT_GRID = np.array([15.0, 30.0, 45.0, 60.0, 75.0]) * np.pi / 180.0
+_HYD_AVG = np.array([
+    [1.2769934e-3, 2.9153695e-3, 62.610505e-3],
+    [1.2683230e-3, 2.9152299e-3, 62.837393e-3],
+    [1.2465397e-3, 2.9288445e-3, 63.721774e-3],
+    [1.2196049e-3, 2.9022565e-3, 63.824265e-3],
+    [1.2045996e-3, 2.9024912e-3, 64.258455e-3],
+])
+_HYD_AMP = np.array([
+    [0.0, 0.0, 0.0],
+    [1.2709626e-5, 2.1414979e-5, 9.0128400e-5],
+    [2.6523662e-5, 3.0160779e-5, 4.3497037e-5],
+    [3.4000452e-5, 7.2562722e-5, 84.795348e-5],
+    [4.1202191e-5, 11.723375e-5, 170.37206e-5],
+])
+_WET = np.array([
+    [5.8021897e-4, 1.4275268e-3, 4.3472961e-2],
+    [5.6794847e-4, 1.5138625e-3, 4.6729510e-2],
+    [5.8118019e-4, 1.4572752e-3, 4.3908931e-2],
+    [5.9727542e-4, 1.5007428e-3, 4.4626982e-2],
+    [6.1641693e-4, 1.7599082e-3, 5.4736038e-2],
+])
+# height-correction coefficients (Niell 1996)
+_A_HT, _B_HT, _C_HT = 2.53e-5, 5.49e-3, 1.14e-3
+
+_C_M_S = 299792458.0
+# nominal zenith wet delay, metres (the reference uses a fixed estimate;
+# real wet delays are 0.05-0.3 m and unmodelable without weather data)
+_ZWD_M = 0.1
+
+
+def _herring(sin_e, a, b, c):
+    """Herring continued-fraction mapping function."""
+    top = 1.0 + a / (1.0 + b / (1.0 + c))
+    bot = sin_e + a / (sin_e + b / (sin_e + c))
+    return top / bot
+
+
+def _interp_coeffs(table, lat):
+    """Piecewise-linear latitude interpolation of (5,3) Niell tables."""
+    out = []
+    for j in range(3):
+        out.append(jnp.interp(jnp.abs(lat), _LAT_GRID, table[:, j]))
+    return out
+
+
+class TroposphereDelay(DelayComponent):
+    register = True
+    category = "troposphere"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(boolParameter("CORRECT_TROPOSPHERE", value=True))
+
+    def extra_masks(self, toas) -> dict:
+        n = len(toas)
+        elev = getattr(toas, "obs_elevation_rad", None)
+        if elev is None:
+            z = np.zeros(n)
+            return {
+                "TROPO:sin_elev": z, "TROPO:lat": z,
+                "TROPO:alt": z, "TROPO:doy": z,
+            }
+        return {
+            "TROPO:sin_elev": np.sin(elev),
+            "TROPO:lat": np.asarray(toas.obs_lat_rad),
+            "TROPO:alt": np.asarray(toas.obs_alt_m),
+            # MJD 51544 = 2000-01-01; day-of-year mod 365.25 is plenty
+            # for the ~1e-5 seasonal term
+            "TROPO:doy": np.mod(toas.mjd_float() - 51544.0, 365.25),
+        }
+
+    def zenith_hydrostatic_m(self, lat, alt_m):
+        """Davis et al. 1985 ZHD from standard-atmosphere pressure."""
+        p_hpa = 1013.25 * (1.0 - 2.2557e-5 * alt_m) ** 5.2568
+        return (
+            0.0022768 * p_hpa
+            / (1.0 - 0.00266 * jnp.cos(2.0 * lat) - 2.8e-7 * alt_m)
+        )
+
+    def delay_term(self, pdict, bundle, acc_delay):
+        if not self.params["CORRECT_TROPOSPHERE"].value:
+            return jnp.zeros(bundle.ntoa)
+        sin_e = bundle.masks["TROPO:sin_elev"]
+        lat = bundle.masks["TROPO:lat"]
+        alt = bundle.masks["TROPO:alt"]
+        doy = bundle.masks["TROPO:doy"]
+        valid = sin_e > 0.0
+        s = jnp.where(valid, sin_e, 1.0)
+
+        # hydrostatic: seasonally-varying coefficients
+        a0, b0, c0 = _interp_coeffs(_HYD_AVG, lat)
+        a1, b1, c1 = _interp_coeffs(_HYD_AMP, lat)
+        # Niell phase convention: DOY 28 (northern); southern shifted 1/2 yr
+        season = jnp.cos(
+            2.0 * jnp.pi * (doy - 28.0) / 365.25
+            + jnp.where(lat < 0, jnp.pi, 0.0)
+        )
+        mh = _herring(s, a0 - a1 * season, b0 - b1 * season, c0 - c1 * season)
+        # height correction
+        mh = mh + (1.0 / s - _herring(s, _A_HT, _B_HT, _C_HT)) * (
+            alt / 1000.0
+        )
+
+        aw, bw, cw = _interp_coeffs(_WET, lat)
+        mw = _herring(s, aw, bw, cw)
+
+        path_m = self.zenith_hydrostatic_m(lat, alt) * mh + _ZWD_M * mw
+        return jnp.where(valid, path_m / _C_M_S, 0.0)

@@ -1,0 +1,296 @@
+"""Tests for DDH, BT_PIECEWISE, PiecewiseSpindown, TroposphereDelay,
+SWX, PLChromNoise.
+
+Cross-validation strategy: each new variant must reduce to its parent in
+the matching limit (DDH->DD with the orthometric<->physical mapping,
+BT_PIECEWISE->BT with pieces equal to the globals, PLChromNoise with
+index 2 -> PLDMNoise), and piecewise/range components must act only
+inside their ranges.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.constants import DM_CONST, TSUN
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas.ingest import ingest_barycentric
+
+BASE = """
+PSR              J0000+0000
+F0               300.0              1
+F1               -1e-15
+PEPOCH           55000
+DM               10.0
+"""
+
+DD_PART = """
+BINARY           DD
+PB               0.3
+A1               2.0
+ECC              0.12
+OM               70.0
+T0               55000.1
+M2               {m2}
+SINI             {sini}
+"""
+
+
+def _toas(model, n=80, start=54900, stop=55100, **kw):
+    toas = make_fake_toas_uniform(start, stop, n, model, error_us=1.0, **kw)
+    ingest_barycentric(toas)
+    return toas
+
+
+def _delays(par, toas):
+    m = get_model(par)
+    cm = m.compile(toas)
+    return np.asarray(cm.delay(cm.x0()))
+
+
+def test_ddh_matches_dd():
+    sini, m2 = 0.95, 0.4
+    cosi = np.sqrt(1.0 - sini**2)
+    stig = sini / (1.0 + cosi)
+    h3 = TSUN * m2 * stig**3
+    par_dd = BASE + DD_PART.format(m2=m2, sini=sini)
+    m_dd = get_model(par_dd)
+    toas = _toas(m_dd)
+    d_dd = _delays(par_dd, toas)
+    par_ddh = (
+        BASE
+        + DD_PART.format(m2=0, sini=0)
+        .replace("BINARY           DD", "BINARY           DDH")
+        .replace("M2               0\n", "")
+        .replace("SINI             0\n", "")
+        + f"H3 {h3:.16e}\nSTIGMA {stig:.16f}\n"
+    )
+    d_ddh = _delays(par_ddh, toas)
+    np.testing.assert_allclose(d_ddh, d_dd, atol=1e-12)
+
+
+def test_bt_piecewise_reduces_to_bt():
+    par_bt = BASE + """
+BINARY           BT
+PB               0.5
+A1               3.0
+ECC              0.05
+OM               10.0
+T0               55000.2
+"""
+    m = get_model(par_bt)
+    toas = _toas(m)
+    d_bt = _delays(par_bt, toas)
+    # pieces equal to the globals -> identical delays
+    par_pw = par_bt.replace("BINARY           BT", "BINARY           BT_PIECEWISE") + """
+T0X_0001         55000.2
+A1X_0001         3.0
+XR1_0001         54900
+XR2_0001         55000
+"""
+    d_pw = _delays(par_pw, toas)
+    np.testing.assert_allclose(d_pw, d_bt, atol=1e-14)
+
+
+def test_bt_piecewise_shifts_inside_range_only():
+    par_bt = BASE + """
+BINARY           BT
+PB               0.5
+A1               3.0
+ECC              0.05
+OM               10.0
+T0               55000.2
+"""
+    m = get_model(par_bt)
+    toas = _toas(m)
+    d_bt = _delays(par_bt, toas)
+    par_pw = par_bt.replace("BINARY           BT", "BINARY           BT_PIECEWISE") + """
+A1X_0001         3.5
+XR1_0001         54900
+XR2_0001         55000
+"""
+    d_pw = _delays(par_pw, toas)
+    mjd = toas.mjd_float()
+    inside = (mjd >= 54900) & (mjd < 55000)
+    assert np.max(np.abs(d_pw[inside] - d_bt[inside])) > 1e-3
+    np.testing.assert_allclose(d_pw[~inside], d_bt[~inside], atol=1e-14)
+
+
+def test_piecewise_spindown_phase():
+    par = BASE + """
+PWEP_1           55050
+PWPH_1           0.25
+PWF0_1           1e-7
+PWSTART_1        55040
+PWSTOP_1         55080
+"""
+    m_base = get_model(BASE)
+    m_pw = get_model(par)
+    assert "PiecewiseSpindown" in m_pw.components
+    toas = _toas(m_base, n=100)
+    cm0 = m_base.compile(toas)
+    cm1 = m_pw.compile(toas)
+    r0 = np.asarray(cm0.phase_residuals(cm0.x0()))
+    r1 = np.asarray(cm1.phase_residuals(cm1.x0()))
+    mjd = toas.mjd_float()
+    inside = (mjd >= 55040) & (mjd < 55080)
+    # phase wraps to [-0.5, 0.5): 0.25 + 1e-7*dt, dt in +-~17 days
+    # kernel dt is delay-corrected (here: the DM=10 dispersion delay)
+    dt = (mjd - 55050) * 86400.0 - DM_CONST * 10.0 / 1400.0**2
+    expect = 0.25 + 1e-7 * dt
+    diff = r1 - r0
+    # compare modulo 1 cycle
+    wrapped = (diff - expect + 0.5) % 1.0 - 0.5
+    assert np.max(np.abs(wrapped[inside])) < 1e-9
+    assert np.max(np.abs(((diff + 0.5) % 1.0 - 0.5)[~inside])) < 1e-12
+
+
+def test_troposphere_zenith_and_mapping():
+    par = BASE + "CORRECT_TROPOSPHERE Y\n"
+    m = get_model(par)
+    assert "TroposphereDelay" in m.components
+    toas = _toas(m, n=10)
+    d_dm_only = _delays(BASE, toas)  # the DM delay common to all cases
+    # barycentric data: no geometry -> troposphere contributes zero
+    cm = m.compile(toas)
+    np.testing.assert_allclose(
+        np.asarray(cm.delay(cm.x0())), d_dm_only, atol=1e-15
+    )
+    # attach synthetic geometry: zenith at sea level, 45N
+    toas.obs_elevation_rad = np.full(10, np.pi / 2)
+    toas.obs_lat_rad = np.full(10, np.pi / 4)
+    toas.obs_alt_m = np.zeros(10)
+    cm = m.compile(toas)
+    d_zenith = np.asarray(cm.delay(cm.x0())) - d_dm_only
+    # ZHD ~2.28 m + ZWD 0.1 m -> ~7.9 ns
+    assert 7.0e-9 < d_zenith[0] < 9.0e-9
+    # 30 deg elevation: ~2x zenith path
+    toas.obs_elevation_rad = np.full(10, np.pi / 6)
+    cm = m.compile(toas)
+    d_30 = np.asarray(cm.delay(cm.x0())) - d_dm_only
+    assert 1.9 < d_30[0] / d_zenith[0] < 2.1
+
+
+def test_swx_acts_in_range():
+    par = BASE + """
+RAJ              06:00:00
+DECJ             10:00:00
+SWXDM_0001       3.0e-4
+SWXR1_0001       54900
+SWXR2_0001       55000
+"""
+    m = get_model(par)
+    assert "SolarWindDispersionX" in m.components
+    toas = _toas(m, n=60, freq_mhz=1400.0)
+    # synthetic Sun geometry: obs->Sun = 1 AU along +x, pulsar off-axis
+    from pint_tpu.constants import AU, C
+
+    n = len(toas)
+    toas.obs_sun_pos = np.tile([AU, 0.0, 0.0], (n, 1))
+    toas.ssb_obs_pos = np.zeros((n, 3))
+    cm = m.compile(toas)
+    x = cm.x0()
+    dm_sw = np.asarray(cm.dm_model(x)) - 10.0  # minus the constant DM
+    mjd = toas.mjd_float()
+    inside = (mjd >= 54900) & (mjd < 55000)
+    assert np.all(dm_sw[inside] > 0)
+    np.testing.assert_allclose(dm_sw[~inside], 0.0, atol=1e-12)
+    # delay consistent with DM_CONST * dm / f^2
+    d = np.asarray(cm.delay(x))
+    np.testing.assert_allclose(
+        d, DM_CONST * (10.0 + dm_sw) / 1400.0**2, rtol=1e-9
+    )
+
+
+def test_bt_piecewise_missing_bounds_raises():
+    from pint_tpu.exceptions import TimingModelError
+
+    par = BASE + """
+BINARY           BT_PIECEWISE
+PB               0.5
+A1               3.0
+ECC              0.05
+OM               10.0
+T0               55000.2
+T0X_0001         55000.3
+XR2_0001         55000
+"""
+    with pytest.raises(TimingModelError, match="XR1/XR2"):
+        get_model(par)
+
+
+def test_bt_piecewise_overlap_raises():
+    from pint_tpu.exceptions import TimingModelError
+
+    par = BASE + """
+BINARY           BT_PIECEWISE
+PB               0.5
+A1               3.0
+ECC              0.05
+OM               10.0
+T0               55000.2
+A1X_0001         3.1
+XR1_0001         54900
+XR2_0001         55000
+A1X_0002         3.2
+XR1_0002         54950
+XR2_0002         55050
+"""
+    with pytest.raises(TimingModelError, match="overlap"):
+        get_model(par)
+
+
+def test_ddh_stigma_zero_raises():
+    from pint_tpu.exceptions import TimingModelError
+
+    par = BASE + DD_PART.format(m2=0, sini=0).replace(
+        "BINARY           DD", "BINARY           DDH"
+    ).replace("M2               0\n", "").replace(
+        "SINI             0\n", ""
+    ) + "H3 1e-7\nSTIGMA 0\n"
+    with pytest.raises(TimingModelError, match="STIGMA"):
+        get_model(par)
+
+
+def test_tnchromidx_routes_to_chromatic_cm():
+    """TNCHROMIDX is the CM model's index (reference convention): a par
+    with a CM model + TNCHROMIDX must load, set CMIDX, and feed both the
+    chromatic delay and the PLChromNoise basis."""
+    par = BASE + (
+        "CM 0.01\nTNCHROMIDX 3.0\n"
+        "TNCHROMAMP -13.0\nTNCHROMGAM 3.5\nTNCHROMC 8\n"
+    )
+    m = get_model(par)
+    assert float(m.params["CMIDX"].value) == 3.0
+    toas = _toas(
+        m, n=30, freq_mhz=np.where(np.arange(30) % 2, 1400.0, 700.0),
+    )
+    cm = m.compile(toas)
+    T, phi = cm.noise_basis(cm.x0())
+    T = np.asarray(T)
+    # chromatic scaling (1400/f)^3: the 700 MHz rows (even indices here)
+    # carry 8x the basis amplitude of the 1400 MHz rows
+    norm_700 = np.linalg.norm(T[::2], axis=1)
+    norm_1400 = np.linalg.norm(T[1::2], axis=1)
+    assert np.median(norm_700) / np.median(norm_1400) == pytest.approx(
+        8.0, rel=0.2
+    )
+
+
+def test_plchrom_index2_equals_pldm():
+    par_dm = BASE + "TNDMAMP -13.0\nTNDMGAM 3.5\nTNDMC 12\n"
+    par_ch = BASE + (
+        "TNCHROMAMP -13.0\nTNCHROMGAM 3.5\nTNCHROMC 12\nTNCHROMIDX 2.0\n"
+    )
+    m_dm, m_ch = get_model(par_dm), get_model(par_ch)
+    assert "PLChromNoise" in m_ch.components
+    toas = _toas(
+        m_dm, n=50,
+        freq_mhz=np.where(np.arange(50) % 2, 1400.0, 700.0),
+    )
+    cm_dm = m_dm.compile(toas)
+    cm_ch = m_ch.compile(toas)
+    T1, p1 = cm_dm.noise_basis(cm_dm.x0())
+    T2, p2 = cm_ch.noise_basis(cm_ch.x0())
+    np.testing.assert_allclose(np.asarray(T2), np.asarray(T1), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p1), rtol=1e-12)
