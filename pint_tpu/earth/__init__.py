@@ -1,0 +1,10 @@
+"""Earth orientation: precession, nutation, rotation, polar motion, EOP.
+
+TPU-native replacement for the pyerfa (C) capabilities the reference
+consumes via astropy (SURVEY.md §2 native-capability table, row 1).
+"""
+
+from pint_tpu.earth.rotation import (  # noqa: F401
+    gcrs_posvel_from_itrf,
+    itrf_to_gcrs_matrix,
+)

@@ -1,0 +1,53 @@
+"""Solar-system ephemerides: SPK kernels + analytic builtin.
+
+Reference parity: src/pint/solar_system_ephemerides.py (get_ephemeris /
+objPosVel_wrt_SSB) — there backed by jplephem + astropy download cache;
+here by a native SPK reader with an explicit search path
+($PINT_TPU_EPHEM_DIR, then CWD) and an offline analytic fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+from pint_tpu.ephemeris.builtin import BuiltinEphemeris
+from pint_tpu.ephemeris.spk import SPK, jd_to_et, mjd_tdb_to_et  # noqa: F401
+
+_cache: dict = {}
+
+
+def get_ephemeris(name: str = "builtin"):
+    """Resolve an ephemeris by name ('builtin', 'de440', ...) or path.
+
+    DExxx names search $PINT_TPU_EPHEM_DIR then the CWD for
+    '<name>.bsp'; a missing kernel falls back to the builtin analytic
+    ephemeris with a warning (documented accuracy in builtin.py).
+    """
+    key = str(name).lower()
+    if key in _cache:
+        return _cache[key]
+    if key in ("builtin", "", "none"):
+        eph = BuiltinEphemeris()
+    elif os.path.exists(str(name)):
+        eph = SPK.open(str(name))
+    else:
+        candidates = []
+        envdir = os.environ.get("PINT_TPU_EPHEM_DIR")
+        if envdir:
+            candidates.append(os.path.join(envdir, f"{key}.bsp"))
+        candidates.append(f"{key}.bsp")
+        for c in candidates:
+            if os.path.exists(c):
+                eph = SPK.open(c)
+                break
+        else:
+            warnings.warn(
+                f"ephemeris kernel {name!r} not found (searched "
+                f"{candidates}); using the builtin analytic ephemeris "
+                "(~10 arcsec planetary accuracy - fine for simulation, "
+                "not for absolute timing parity)"
+            )
+            eph = BuiltinEphemeris()
+    _cache[key] = eph
+    return eph

@@ -188,5 +188,5 @@ def get_model_and_toas(
     if planets is None:
         ps = model.params.get("PLANET_SHAPIRO")
         planets = bool(ps.value) if ps is not None else False
-    ingest(toas, ephem=ephem, planets=planets, **ingest_kw)
+    ingest(toas, ephem=ephem, planets=planets, model=model, **ingest_kw)
     return model, toas

@@ -61,10 +61,17 @@ class PiecewiseSpindown(PhaseComponent):
         return None
 
     def setup(self, model):
-        self.piece_indices = sorted(
-            int(n[5:]) for n in self.params
-            if n.startswith("PWEP_") and self.params[n].value is not None
-        )
+        # a piece exists if ANY of its params is set, so validate can
+        # report a missing PWEP/PWSTART/PWSTOP instead of silently
+        # dropping the piece
+        idx = set()
+        for n, p in self.params.items():
+            if p.value is None:
+                continue
+            for pref in _FAMS:
+                if n.startswith(pref) and n[len(pref):].isdigit():
+                    idx.add(int(n[len(pref):]))
+        self.piece_indices = sorted(idx)
 
     def validate(self, model):
         for i in self.piece_indices:

@@ -32,6 +32,28 @@ _AU_LS = AU / C
 _PC_LS = PC / C
 
 
+def _find_astrometry(model):
+    from pint_tpu.models.astrometry import Astrometry
+
+    for c in model.components.values():
+        if isinstance(c, Astrometry):
+            return c
+    return None
+
+
+def elongation_geometry(astrometry, pdict, bundle):
+    """Sun-observer-pulsar geometry shared by NE_SW and SWX:
+    -> (d obs-Sun distance (ls), theta elongation (rad), sin(theta))."""
+    psr_dir = astrometry.ssb_to_psr_xyz(pdict, bundle)
+    r = bundle.obs_sun_pos_ls  # obs -> Sun, light-seconds
+    d = jnp.sqrt(jnp.sum(r * r, axis=-1))
+    safe_d = jnp.maximum(d, 1e-30)
+    cos_e = jnp.sum(r * psr_dir, axis=-1) / safe_d
+    theta = jnp.arccos(jnp.clip(cos_e, -1.0, 1.0))
+    sin_t = jnp.maximum(jnp.sin(theta), 1e-9)
+    return d, safe_d, theta, sin_t
+
+
 class SolarWindDispersion(DelayComponent):
     register = True
     category = "solar_wind"
@@ -63,12 +85,7 @@ class SolarWindDispersion(DelayComponent):
         return self.params[f"NE_SW{k}"]
 
     def setup(self, model):
-        from pint_tpu.models.astrometry import Astrometry
-
-        self._astrometry_ref = None
-        for c in model.components.values():
-            if isinstance(c, Astrometry):
-                self._astrometry_ref = c
+        self._astrometry_ref = _find_astrometry(model)
 
     def _deriv_ks(self):
         ks = sorted(
@@ -108,14 +125,9 @@ class SolarWindDispersion(DelayComponent):
 
     def solar_wind_dm(self, pdict, bundle):
         """DM_sw at each TOA (pc/cm^3)."""
-        psr_dir = self._astrometry_ref.ssb_to_psr_xyz(pdict, bundle)
-        r = bundle.obs_sun_pos_ls  # obs -> Sun, light-seconds
-        d = jnp.sqrt(jnp.sum(r * r, axis=-1))
-        safe_d = jnp.maximum(d, 1e-30)
-        # elongation: angle between Sun direction and pulsar direction
-        cos_e = jnp.sum(r * psr_dir, axis=-1) / safe_d
-        theta = jnp.arccos(jnp.clip(cos_e, -1.0, 1.0))
-        sin_t = jnp.maximum(jnp.sin(theta), 1e-9)
+        d, safe_d, theta, sin_t = elongation_geometry(
+            self._astrometry_ref, pdict, bundle
+        )
         n0 = self._ne_sw(pdict, bundle)
         # column in cm^-3 * ls -> pc cm^-3 via /PC_ls
         col = n0 * _AU_LS * _AU_LS * (np.pi - theta) / (safe_d * sin_t)
@@ -175,12 +187,7 @@ class SolarWindDispersionX(DelayComponent):
         return None
 
     def setup(self, model):
-        from pint_tpu.models.astrometry import Astrometry
-
-        self._astrometry_ref = None
-        for c in model.components.values():
-            if isinstance(c, Astrometry):
-                self._astrometry_ref = c
+        self._astrometry_ref = _find_astrometry(model)
         self.swx_indices = sorted(
             int(n[6:]) for n in self.params
             if n.startswith("SWXDM_") and self.params[n].value is not None
@@ -213,13 +220,9 @@ class SolarWindDispersionX(DelayComponent):
 
     def _profile(self, pdict, bundle):
         """Normalized geometry: 1 at 90-deg elongation, 1 AU."""
-        psr_dir = self._astrometry_ref.ssb_to_psr_xyz(pdict, bundle)
-        r = bundle.obs_sun_pos_ls
-        d = jnp.sqrt(jnp.sum(r * r, axis=-1))
-        safe_d = jnp.maximum(d, 1e-30)
-        cos_e = jnp.sum(r * psr_dir, axis=-1) / safe_d
-        theta = jnp.arccos(jnp.clip(cos_e, -1.0, 1.0))
-        sin_t = jnp.maximum(jnp.sin(theta), 1e-9)
+        d, safe_d, theta, sin_t = elongation_geometry(
+            self._astrometry_ref, pdict, bundle
+        )
         prof = (
             _AU_LS * (np.pi - theta) / (safe_d * sin_t)
         ) / (np.pi / 2.0)

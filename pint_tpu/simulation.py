@@ -68,7 +68,11 @@ def _ingest(toas: TOAs, model: TimingModel):
     else:
         from pint_tpu.toas.ingest import ingest
 
-        ingest(toas, ephem=model.top_params["EPHEM"].value or "builtin")
+        ingest(
+            toas,
+            ephem=model.top_params["EPHEM"].value or "builtin",
+            model=model,
+        )
 
 
 def calculate_random_models(

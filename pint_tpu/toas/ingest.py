@@ -42,19 +42,13 @@ def ingest_barycentric(toas: TOAs) -> TOAs:
 
 def ingest(toas: TOAs, ephem: str = "builtin", planets: bool = False,
            include_bipm: bool = True, bipm_version: str = "BIPM2021",
-           limits: str = "warn") -> TOAs:
+           limits: str = "warn", model=None) -> TOAs:
     """Full observatory ingest (clock chain -> TDB -> posvels)."""
     if all(o.lower() in BARY_SITES for o in toas.obs):
         return ingest_barycentric(toas)
-    try:
-        from pint_tpu.toas.ingest_topo import ingest_topocentric
-    except ImportError as e:
-        raise PintTpuError(
-            "topocentric ingest (clock chain + Earth rotation + ephemeris)"
-            " is not available in this build yet; only barycentric "
-            "(site '@') data is supported"
-        ) from e
+    from pint_tpu.toas.ingest_topo import ingest_topocentric
+
     return ingest_topocentric(
         toas, ephem=ephem, planets=planets, include_bipm=include_bipm,
-        bipm_version=bipm_version, limits=limits,
+        bipm_version=bipm_version, limits=limits, model=model,
     )

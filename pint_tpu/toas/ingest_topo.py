@@ -1,0 +1,180 @@
+"""Topocentric TOA ingest: clock chain -> TDB -> solar-system geometry.
+
+Reference parity: the §3.1 load-time stack (SURVEY.md) —
+TOAs.apply_clock_corrections (observatory/__init__.py clock chains),
+TOAs.compute_TDBs (astropy/ERFA time scales), TOAs.compute_posvels
+(solar_system_ephemerides + erfautils.gcrs_posvel_from_itrf) — all
+host-side numpy/HostDD; the products become TOABundle device columns.
+
+Chain per TOA:
+  1. site clock (+ GPS->UTC)            [observatory registry + .clk files]
+  2. UTC -> TAI -> TT(TAI) [+ TT(BIPM)] [timebase.TimeArray + leap seconds]
+  3. TT -> TDB (geocentric series) + topocentric (v_earth . r_obs)/c^2
+  4. observatory ITRF -> GCRS posvel    [earth.rotation, EOP table]
+  5. Earth/Sun/planet SSB posvels       [ephemeris: SPK or builtin]
+  6. source elevation (troposphere), when the model's astrometry is known
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.constants import C
+from pint_tpu.earth.eop import get_eop
+from pint_tpu.earth.rotation import (
+    OMEGA_EARTH,
+    itrf_to_gcrs_matrix,
+    itrf_to_geodetic,
+)
+from pint_tpu.ephemeris import get_ephemeris, mjd_tdb_to_et
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.observatory import bipm_correction, get_observatory
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+
+# NAIF ids for the PLANET_SHAPIRO bodies
+_PLANETS = {
+    "jupiter": 5, "saturn": 6, "venus": 2, "uranus": 7, "neptune": 8,
+}
+
+
+def ingest_topocentric(
+    toas: TOAs,
+    ephem: str = "builtin",
+    planets: bool = False,
+    include_bipm: bool = True,
+    bipm_version: str = "BIPM2021",
+    include_gps: bool = True,
+    limits: str = "warn",
+    model=None,
+) -> TOAs:
+    n = len(toas)
+    sites = [get_observatory(o) for o in toas.obs]
+    if any(s.is_barycenter for s in sites):
+        if all(s.is_barycenter for s in sites):
+            from pint_tpu.toas.ingest import ingest_barycentric
+
+            return ingest_barycentric(toas)
+        raise PintTpuError(
+            "mixed barycentric + topocentric TOAs in one set are not "
+            "supported; split the tim file"
+        )
+    if toas.t.scale != "utc":
+        raise PintTpuError(
+            f"topocentric ingest expects UTC arrival times, got "
+            f"{toas.t.scale!r}"
+        )
+
+    # -- 1. clock chain ---------------------------------------------------
+    mjd_utc = toas.t.mjd_float()
+    clock = np.zeros(n)
+    itrf = np.zeros((n, 3))
+    for code in sorted(set(toas.obs)):
+        idx = np.array([o == code for o in toas.obs])
+        site = sites[int(np.flatnonzero(idx)[0])]
+        clock[idx] = site.clock_corrections(
+            mjd_utc[idx], include_gps=include_gps, limits=limits
+        )
+        loc = site.earth_location_itrf()
+        itrf[idx] = 0.0 if loc is None else loc
+    toas.clock_corr_s = clock
+    t_utc = toas.t.add_seconds(clock)
+
+    # -- 2. UTC -> TT -----------------------------------------------------
+    t_tt = t_utc.to_scale("tt")
+    if include_bipm:
+        t_tt = t_tt.add_seconds(bipm_correction(mjd_utc, bipm_version))
+
+    # -- 4. Earth rotation (needed for the TDB topocentric term) ----------
+    dut1, xp, yp = get_eop(mjd_utc)
+    mjd_ut1 = t_utc.mjd_float() + dut1 / 86400.0
+    tt_cent = (
+        (t_tt.mjd_int - 51544.5) + t_tt.sec.to_float() / 86400.0
+    ) / 36525.0
+    # one rotation-matrix build serves position, velocity, and the
+    # troposphere's local-vertical below (the nutation series dominates
+    # the per-TOA geometry cost)
+    M = itrf_to_gcrs_matrix(mjd_ut1, tt_cent, xp, yp)
+    obs_pos = (M @ itrf[..., None])[..., 0]
+    omega = np.array([0.0, 0.0, OMEGA_EARTH])
+    obs_vel = (
+        M @ np.cross(np.broadcast_to(omega, itrf.shape), itrf)[..., None]
+    )[..., 0]
+
+    # -- 3. TT -> TDB (geocentric series + topocentric term) --------------
+    t_tdb = t_tt.to_scale("tdb")
+    eph = get_ephemeris(ephem)
+    et = mjd_tdb_to_et(t_tdb.mjd_int, t_tdb.sec.to_float())
+    epos_km, evel_km = eph.ssb_posvel(399, et)
+    topo_s = np.sum(evel_km * 1000.0 * obs_pos, axis=-1) / (C * C)
+    t_tdb = t_tdb.add_seconds(topo_s)
+    toas.t_tdb = t_tdb
+
+    # -- 5. geometry columns (meters, m/s) --------------------------------
+    # re-evaluate at the corrected TDB (the ~us shift moves Earth by ~cm)
+    et = mjd_tdb_to_et(t_tdb.mjd_int, t_tdb.sec.to_float())
+    epos_km, evel_km = eph.ssb_posvel(399, et)
+    toas.ssb_obs_pos = epos_km * 1000.0 + obs_pos
+    toas.ssb_obs_vel = evel_km * 1000.0 + obs_vel
+    spos_km, _ = eph.ssb_posvel(10, et)
+    toas.obs_sun_pos = spos_km * 1000.0 - toas.ssb_obs_pos
+    toas.obs_planet_pos = {}
+    if planets:
+        for name, naif in _PLANETS.items():
+            ppos_km, _ = eph.ssb_posvel(naif, et)
+            toas.obs_planet_pos[name] = (
+                ppos_km * 1000.0 - toas.ssb_obs_pos
+            )
+    toas.ephem = getattr(eph, "name", str(ephem))
+
+    # -- 6. troposphere geometry ------------------------------------------
+    lat, lon, height = itrf_to_geodetic(itrf)
+    toas.obs_lat_rad = lat
+    toas.obs_alt_m = height
+    src = _source_unit_vector(model)
+    if src is not None:
+        # geodetic normal in ITRF, rotated to GCRS with the same matrix
+        # chain used for the position
+        normal_itrf = np.stack(
+            [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon),
+             np.sin(lat)], axis=-1
+        )
+        normal_gcrs = (M @ normal_itrf[..., None])[..., 0]
+        toas.obs_elevation_rad = np.arcsin(
+            np.clip(np.sum(normal_gcrs * src, axis=-1), -1.0, 1.0)
+        )
+    return toas
+
+
+def _source_unit_vector(model):
+    """Host-side source direction (ICRS unit vector) from the model's
+    astrometry component, or None."""
+    if model is None:
+        return None
+    comp = None
+    for name in ("AstrometryEquatorial", "AstrometryEcliptic"):
+        comp = model.components.get(name) or comp
+    if comp is None:
+        return None
+    def _f(p):
+        v = p.internal()
+        return float(v.to_float()) if hasattr(v, "to_float") else float(v)
+
+    if "RAJ" in comp.params and comp.params["RAJ"].value is not None:
+        ra = _f(comp.params["RAJ"])
+        dec = _f(comp.params["DECJ"])
+    elif (
+        "ELONG" in comp.params and comp.params["ELONG"].value is not None
+    ):
+        lam = _f(comp.params["ELONG"])
+        bet = _f(comp.params["ELAT"])
+        eps = np.deg2rad(84381.406 / 3600.0)
+        x = np.cos(bet) * np.cos(lam)
+        y = np.cos(eps) * np.cos(bet) * np.sin(lam) - np.sin(eps) * np.sin(bet)
+        z = np.sin(eps) * np.cos(bet) * np.sin(lam) + np.cos(eps) * np.sin(bet)
+        return np.array([x, y, z])
+    else:
+        return None
+    return np.array([
+        np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra), np.sin(dec)
+    ])

@@ -51,8 +51,17 @@ class TOAs:
         self.ssb_obs_vel: Optional[np.ndarray] = None
         self.obs_sun_pos: Optional[np.ndarray] = None
         self.obs_planet_pos: dict = {}
+        self.obs_lat_rad: Optional[np.ndarray] = None
+        self.obs_alt_m: Optional[np.ndarray] = None
+        self.obs_elevation_rad: Optional[np.ndarray] = None
         self.ephem: Optional[str] = None
         self.clock_info: dict = {}
+
+    # per-TOA computed columns that slice/sort alongside the core ones
+    _COMPUTED_COLS = (
+        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
+        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
+    )
 
     # ------------------------------------------------------------------ #
     def __len__(self):
@@ -69,7 +78,7 @@ class TOAs:
             [self.obs[i] for i in sel],
             [self.flags[i] for i in sel],
         )
-        for col in ("clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+        for col in self._COMPUTED_COLS:
             v = getattr(self, col)
             if v is not None:
                 setattr(out, col, v[sel])
@@ -90,7 +99,7 @@ class TOAs:
         self.error_us = self.error_us[order]
         self.obs = [self.obs[i] for i in order]
         self.flags = [self.flags[i] for i in order]
-        for col in ("clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+        for col in self._COMPUTED_COLS:
             v = getattr(self, col)
             if v is not None:
                 setattr(self, col, v[order])

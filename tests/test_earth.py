@@ -1,0 +1,93 @@
+"""Earth-rotation tests: internal consistency + published anchor values.
+
+Oracles used (public, hand-checkable): GMST/ERA at J2000.0, the IAU1980
+mean obliquity at J2000, the 17.2" amplitude of the principal nutation
+term, Earth surface rotation speed, and WGS84 geodesy for a known site.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.earth.rotation import (
+    era,
+    gcrs_posvel_from_itrf,
+    gmst82,
+    itrf_to_gcrs_matrix,
+    itrf_to_geodetic,
+    mean_obliquity,
+    nutation_angles,
+)
+
+GBT = np.array([882589.65, -4924872.32, 3943729.348])
+
+
+def test_obliquity_j2000():
+    assert mean_obliquity(0.0) == pytest.approx(
+        np.deg2rad(84381.448 / 3600.0), rel=1e-12
+    )
+
+
+def test_gmst_and_era_at_j2000():
+    # GMST at 2000-01-01 12:00 UT1 = 18h 41m 50.548s = 280.4606 deg
+    g = gmst82(51544.5)
+    assert np.rad2deg(g) == pytest.approx(280.4606, abs=2e-3)
+    # ERA/2pi at J2000 = 0.7790572732640
+    assert era(51544.5) == pytest.approx(
+        2 * np.pi * 0.7790572732640, abs=1e-9
+    )
+    # both advance ~360.9856 deg/day
+    assert np.rad2deg(
+        np.mod(gmst82(51545.5) - g, 2 * np.pi)
+    ) == pytest.approx(0.9856, abs=1e-3)
+
+
+def test_nutation_principal_term():
+    # near a node epoch the series is dominated by the 17.2" Om term;
+    # check amplitude bound and that values move with time
+    T = np.linspace(-0.5, 0.5, 200)  # 1900-2100
+    dpsi, deps = nutation_angles(T)
+    arcsec = np.rad2deg(dpsi) * 3600
+    assert np.max(np.abs(arcsec)) < 19.0
+    assert np.max(np.abs(arcsec)) > 15.0  # the Om term must appear
+    deps_as = np.rad2deg(deps) * 3600
+    assert 8.0 < np.max(np.abs(deps_as)) < 10.5
+
+
+def test_rotation_matrix_orthonormal():
+    M = itrf_to_gcrs_matrix(
+        np.array([50000.0, 55000.0, 60000.0]),
+        np.array([-0.1, 0.1, 0.2]),
+    )
+    eye = M @ np.swapaxes(M, -1, -2)
+    np.testing.assert_allclose(eye, np.broadcast_to(np.eye(3), eye.shape),
+                               atol=1e-13)
+    np.testing.assert_allclose(np.linalg.det(M), 1.0, atol=1e-13)
+
+
+def test_site_posvel_physics():
+    mjd = np.linspace(55000.0, 55001.0, 97)  # one day, 15-min steps
+    tt_cent = (mjd - 51544.5) / 36525.0
+    pos, vel = gcrs_posvel_from_itrf(GBT, mjd, tt_cent)
+    r = np.linalg.norm(pos, axis=-1)
+    # radius preserved by rotation
+    np.testing.assert_allclose(r, np.linalg.norm(GBT), rtol=1e-12)
+    # speed = omega * r_perp; GBT latitude ~38.4 deg
+    speed = np.linalg.norm(vel, axis=-1)
+    expected = 7.2921e-5 * np.hypot(GBT[0], GBT[1])
+    np.testing.assert_allclose(speed, expected, rtol=1e-3)
+    # velocity perpendicular to position (pure rotation)
+    dots = np.abs(np.sum(pos * vel, axis=-1) / (r * speed))
+    assert np.max(dots) < 1e-5
+    # sidereal periodicity: after 23h56m04.09s the position nearly repeats
+    sidereal_day = 86164.0905 / 86400.0
+    p2, _ = gcrs_posvel_from_itrf(
+        GBT, 55000.0 + sidereal_day, (55000.0 + sidereal_day - 51544.5) / 36525.0
+    )
+    assert np.linalg.norm(p2 - pos[0]) < 50.0  # meters
+
+
+def test_itrf_to_geodetic_gbt():
+    lat, lon, h = itrf_to_geodetic(GBT[None, :])
+    assert np.rad2deg(lat[0]) == pytest.approx(38.433, abs=0.01)
+    assert np.rad2deg(lon[0]) == pytest.approx(-79.84, abs=0.01)
+    assert h[0] == pytest.approx(820.0, abs=40.0)

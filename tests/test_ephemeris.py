@@ -1,0 +1,114 @@
+"""Ephemeris tests: builtin analytic physics sanity + SPK round-trip.
+
+The SPK reader/writer round-trip is the real oracle here: a kernel
+written by our writer from known Chebyshev pieces must evaluate back to
+the generating function, and segment chaining (399<-3<-0) must compose.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.ephemeris import get_ephemeris, mjd_tdb_to_et
+from pint_tpu.ephemeris.builtin import AU_KM, BuiltinEphemeris
+from pint_tpu.ephemeris.spk import (
+    SPK,
+    chebyshev_fit_records,
+    write_spk_type2,
+)
+
+YEAR_S = 365.25 * 86400.0
+
+
+def test_builtin_earth_orbit_physics():
+    eph = BuiltinEphemeris()
+    et = np.linspace(0, YEAR_S, 365)
+    epos, evel = eph.ssb_posvel(399, et)
+    spos, _ = eph.ssb_posvel(10, et)
+    r = np.linalg.norm(epos - spos, axis=-1) / AU_KM
+    # heliocentric distance 0.983 - 1.017 AU
+    assert 0.975 < r.min() < 0.99
+    assert 1.01 < r.max() < 1.025
+    # orbital speed ~29.8 km/s
+    v = np.linalg.norm(evel, axis=-1)
+    assert 28.5 < v.min() and v.max() < 31.0
+    # period: after one anomalistic year the heliocentric position repeats
+    p0, _ = eph.ssb_posvel(399, 0.0)
+    p1, _ = eph.ssb_posvel(399, YEAR_S)
+    s0, _ = eph.ssb_posvel(10, 0.0)
+    s1, _ = eph.ssb_posvel(10, YEAR_S)
+    ang = np.arccos(
+        np.dot(p1 - s1, p0 - s0)
+        / np.linalg.norm(p1 - s1) / np.linalg.norm(p0 - s0)
+    )
+    assert np.rad2deg(ang) < 1.5
+
+
+def test_builtin_sun_ssb_offset():
+    eph = BuiltinEphemeris()
+    et = np.linspace(0, 30 * YEAR_S, 100)
+    spos, _ = eph.ssb_posvel(10, et)
+    r = np.linalg.norm(spos, axis=-1) / AU_KM
+    # Sun wanders within ~2 solar radii (0.01 AU) of the SSB
+    assert r.max() < 0.012
+    assert r.max() > 0.002
+
+
+def test_builtin_moon_earth_offset():
+    eph = BuiltinEphemeris()
+    epos, _ = eph.ssb_posvel(399, 0.0)
+    mpos, _ = eph.ssb_posvel(301, 0.0)
+    d = np.linalg.norm(mpos - epos)
+    assert 356000.0 < d < 407000.0  # km, perigee..apogee
+
+
+def test_mjd_tdb_to_et():
+    assert mjd_tdb_to_et(51544, 43200.0) == 0.0
+    assert mjd_tdb_to_et(51545, 43200.0) == 86400.0
+
+
+def test_spk_write_read_roundtrip(tmp_path):
+    """Write a 2-segment kernel (EMB<-SSB, Earth<-EMB) fit from the
+    builtin ephemeris; read it back; evaluation must match the builtin
+    to Chebyshev-fit precision, including the chained SSB composition."""
+    eph = BuiltinEphemeris()
+    t0, t1 = -YEAR_S, YEAR_S
+    n_rec, deg = 64, 12
+
+    def emb_km(et):
+        return eph.ssb_posvel(3, et)[0]
+
+    def earth_minus_emb(et):
+        return eph.ssb_posvel(399, et)[0] - eph.ssb_posvel(3, et)[0]
+
+    segs = [
+        dict(target=3, center=0, init=t0, intlen=(t1 - t0) / n_rec,
+             coeffs=chebyshev_fit_records(emb_km, t0, t1, n_rec, deg)),
+        dict(target=399, center=3, init=t0, intlen=(t1 - t0) / n_rec,
+             coeffs=chebyshev_fit_records(
+                 earth_minus_emb, t0, t1, n_rec, deg)),
+    ]
+    path = tmp_path / "test.bsp"
+    write_spk_type2(str(path), segs)
+
+    spk = SPK.open(str(path))
+    assert spk.bodies == [3, 399]
+    et = np.linspace(t0 + 1e5, t1 - 1e5, 500)
+    pos_spk, vel_spk = spk.ssb_posvel(399, et)
+    pos_ref, vel_ref = eph.ssb_posvel(399, et)
+    # positions to cm over the fit span; velocity to fit precision
+    assert np.max(np.abs(pos_spk - pos_ref)) < 1e-4  # km = 10 cm
+    assert np.max(np.abs(vel_spk - vel_ref)) < 1e-6  # km/s
+    # pair evaluation too
+    p3, _ = spk.pair_posvel(3, 0, 0.0)
+    np.testing.assert_allclose(p3, eph.ssb_posvel(3, 0.0)[0], atol=1e-4)
+
+
+def test_get_ephemeris_fallback_and_path(tmp_path):
+    eph = get_ephemeris("builtin")
+    assert isinstance(eph, BuiltinEphemeris)
+    with pytest.warns(UserWarning, match="not found"):
+        from pint_tpu import ephemeris as ephmod
+
+        ephmod._cache.pop("de999", None)
+        eph2 = get_ephemeris("de999")
+    assert isinstance(eph2, BuiltinEphemeris)

@@ -1,0 +1,175 @@
+"""Topocentric ingest pipeline tests.
+
+Physics sanity (TAI/TT offsets, annual TDB term, 1-AU geometry, Earth
+orbital velocity), clock-chain file integration, and the end-to-end
+round trip: TOAs simulated at a ground observatory through the full
+chain must fit back to sub-ns residuals with the same pipeline.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.constants import AU, C
+from pint_tpu.exceptions import PintTpuError, UnknownObservatory
+from pint_tpu.models.builder import get_model
+from pint_tpu.observatory import get_observatory, list_observatories
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.ingest import ingest
+from pint_tpu.toas.toas import TOAs
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:no site clock file", "ignore:no Earth-orientation table"
+)
+
+PAR = """
+PSR              J0613-0200
+RAJ              06:13:43.97
+DECJ             -02:00:47.2
+F0               326.6005670874
+F1               -1.023e-15
+PEPOCH           55000
+DM               38.78
+"""
+
+
+def _gbt_toas(n=40, start=55000.0, stop=55365.0):
+    t = TimeArray.from_mjd_float(np.linspace(start, stop, n), scale="utc")
+    return TOAs(
+        t, np.full(n, 1400.0), np.ones(n), ["gbt"] * n,
+        [dict() for _ in range(n)],
+    )
+
+
+def test_registry_lookup_and_aliases():
+    gbt = get_observatory("gbt")
+    assert get_observatory("GBT") is gbt
+    assert get_observatory("1") is gbt
+    assert get_observatory("gb") is gbt
+    assert get_observatory("@").is_barycenter
+    with pytest.raises(UnknownObservatory):
+        get_observatory("atlantis")
+    assert "meerkat" in list_observatories()
+
+
+def test_ingest_time_chain_offsets():
+    toas = _gbt_toas()
+    ingest(toas)
+    # TDB - UTC ~ (TAI-UTC at epoch: 34 s in 2009) + 32.184 +- few ms
+    from pint_tpu.timebase.leapseconds import tai_minus_utc
+
+    dt = (
+        (toas.t_tdb.mjd_int - toas.t.mjd_int) * 86400.0
+        + (toas.t_tdb.sec - toas.t.sec).to_float()
+    )
+    expect = tai_minus_utc(toas.t.mjd_int) + 32.184
+    assert np.all(np.abs(dt - expect) < 0.01)
+
+
+def test_ingest_annual_tdb_term():
+    toas = _gbt_toas(n=200, start=55000.0, stop=55365.0)
+    ingest(toas)
+    t_tt = toas.t.to_scale("tt")
+    dt = (
+        (toas.t_tdb.mjd_int - t_tt.mjd_int) * 86400.0
+        + (toas.t_tdb.sec - t_tt.sec).to_float()
+    )
+    # annual sinusoid, ~1.66 ms amplitude
+    assert 1.2e-3 < np.max(dt) < 1.8e-3
+    assert -1.8e-3 < np.min(dt) < -1.2e-3
+
+
+def test_ingest_geometry():
+    toas = _gbt_toas(n=120)
+    ingest(toas, planets=True)
+    r = np.linalg.norm(toas.ssb_obs_pos, axis=-1)
+    assert np.all((0.96 * AU < r) & (r < 1.04 * AU))
+    v = np.linalg.norm(toas.ssb_obs_vel, axis=-1)
+    assert np.all((28e3 < v) & (v < 31.5e3))
+    rs = np.linalg.norm(toas.obs_sun_pos, axis=-1)
+    assert np.all((0.96 * AU < rs) & (rs < 1.05 * AU))
+    rj = np.linalg.norm(toas.obs_planet_pos["jupiter"], axis=-1)
+    assert np.all((3.9 * AU < rj) & (rj < 6.5 * AU))
+    # diurnal signature: topocentric radius modulates by Earth radius
+    assert 1e6 < np.ptp(r) < AU * 0.05
+
+
+def test_clock_chain_files(tmp_path, monkeypatch):
+    (tmp_path / "gbt2gps.clk").write_text(
+        "# UTC(gbt) UTC(gps)\n50000.0 1.5e-6\n60000.0 1.5e-6\n"
+    )
+    (tmp_path / "gps2utc.clk").write_text(
+        "# UTC(gps) UTC\n50000.0 2.5e-7\n60000.0 2.5e-7\n"
+    )
+    (tmp_path / "tai2tt_bipm2021.clk").write_text(
+        "# TT(TAI) TT(BIPM2021)\n50000.0 27.7e-6\n60000.0 27.7e-6\n"
+    )
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+    import pint_tpu.observatory as obsmod
+
+    obsmod._registry.clear()
+    obsmod._gps_clock.clear()
+    try:
+        toas = _gbt_toas(n=5)
+        ingest(toas)
+        np.testing.assert_allclose(toas.clock_corr_s, 1.75e-6, rtol=1e-9)
+        # BIPM correction shifts TDB by the same constant
+        toas2 = _gbt_toas(n=5)
+        ingest(toas2, include_bipm=False)
+        dt = (toas.t_tdb.sec - toas2.t_tdb.sec).to_float() - (
+            toas.clock_corr_s - toas2.clock_corr_s
+        )
+        np.testing.assert_allclose(dt, 27.7e-6, atol=2e-9)
+    finally:
+        obsmod._registry.clear()
+        obsmod._gps_clock.clear()
+
+
+def test_mixed_sites_raise():
+    t = TimeArray.from_mjd_float([55000.0, 55001.0], scale="utc")
+    toas = TOAs(t, [1400.0] * 2, [1.0] * 2, ["gbt", "@"], None)
+    with pytest.raises(PintTpuError, match="mixed"):
+        ingest(toas)
+
+
+def test_elevation_with_model():
+    m = get_model(PAR)
+    toas = _gbt_toas(n=50, start=55000.0, stop=55002.0)
+    ingest(toas, model=m)
+    elev = toas.obs_elevation_rad
+    assert elev.shape == (50,)
+    assert np.all(np.abs(elev) <= np.pi / 2)
+    # over 2 days the source rises and sets at a mid-latitude site
+    assert np.max(elev) > 0.3
+    assert np.min(elev) < 0.0
+
+
+def test_end_to_end_topocentric_roundtrip():
+    """Simulate at GBT through the full chain; residuals of the
+    generating model must be sub-ns (internal consistency), and a
+    perturbed model must fit back to truth."""
+    m = get_model(PAR)
+    toas = make_fake_toas_uniform(
+        55000, 55300, 120, m, error_us=1.0, obs="gbt",
+        freq_mhz=np.where(np.arange(120) % 2, 1400.0, 800.0),
+    )
+    cm = m.compile(toas)
+    r = np.asarray(cm.time_residuals(cm.x0()))
+    assert np.max(np.abs(r)) < 1e-9
+
+    from pint_tpu.fitting import DownhillWLSFitter
+
+    rng = np.random.default_rng(8)
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, len(toas)))
+    ingest(toas, model=m)
+    m2 = get_model(PAR)
+    m2.params["F0"].frozen = False
+    m2.params["F1"].frozen = False
+    m2.params["DM"].frozen = False
+    m2.params["F0"].value = "326.60056708745"
+    f = DownhillWLSFitter(toas, m2)
+    f.fit_toas()
+    assert f.converged
+    f0 = float(m2.params["F0"].value.to_float())
+    assert f0 == pytest.approx(326.6005670874, abs=1e-11)
+    assert f.resids.rms_weighted() < 2e-6
