@@ -108,38 +108,63 @@ class SPK:
         return cls(segments, name=str(path))
 
     # -- evaluation -------------------------------------------------------
-    def _segment(self, target: int, center: int) -> Segment:
+    def _eval_pair(self, segs: list[Segment], et: np.ndarray):
+        """Evaluate a (target, center) pair: kernels like de441 split
+        coverage into several time segments, so epochs are routed to the
+        segment that covers them."""
+        if len(segs) == 1:
+            return _eval_type23(segs[0], et)
+        et1 = np.atleast_1d(et)
+        pos = np.empty((*et1.shape, 3))
+        vel = np.empty_like(pos)
+        done = np.zeros(et1.shape, dtype=bool)
+        for seg in segs:
+            sel = (
+                ~done & (et1 >= seg.start_et - 1.0)
+                & (et1 <= seg.stop_et + 1.0)
+            )
+            if np.any(sel):
+                pos[sel], vel[sel] = _eval_type23(seg, et1[sel])
+                done |= sel
+        if not done.all():
+            spans = [(s.start_et, s.stop_et) for s in segs]
+            raise ValueError(
+                f"{int((~done).sum())} epochs outside all SPK segments "
+                f"for target {segs[0].target}: spans {spans}"
+            )
+        if np.ndim(et) == 0:
+            return pos[0], vel[0]
+        return pos, vel
+
+    def pair_posvel(self, target, center, et):
+        """Position (km) and velocity (km/s) of target wrt center at ET
+        seconds past J2000 (TDB).  et: scalar or (n,)."""
         segs = self.pairs.get((target, center))
         if not segs:
             raise KeyError(
                 f"no segment {target}<-{center} in {self.name}; "
                 f"available: {sorted(self.pairs)}"
             )
-        return segs[0]
-
-    def pair_posvel(self, target, center, et):
-        """Position (km) and velocity (km/s) of target wrt center at ET
-        seconds past J2000 (TDB).  et: scalar or (n,)."""
-        seg = self._segment(target, center)
-        return _eval_type23(seg, np.asarray(et, dtype=np.float64))
+        return self._eval_pair(segs, np.asarray(et, dtype=np.float64))
 
     def ssb_posvel(self, target: int, et):
         """Chain segments to the SSB (center 0): km, km/s."""
+        et = np.asarray(et, dtype=np.float64)
         pos, vel = None, None
         body = target
         hops = 0
         while body != 0:
-            seg = None
-            for (t, c), segs in self.pairs.items():
-                if t == body:
-                    seg = segs[0]
-                    break
-            if seg is None:
+            # prefer the pair whose center leads toward the SSB directly
+            centers = sorted(
+                c for (t, c) in self.pairs if t == body
+            )
+            if not centers:
                 raise KeyError(f"no segment path {target} -> SSB")
-            p, v = _eval_type23(seg, np.asarray(et, dtype=np.float64))
+            center = centers[0]  # 0 first, then inner barycenters
+            p, v = self._eval_pair(self.pairs[(body, center)], et)
             pos = p if pos is None else pos + p
             vel = v if vel is None else vel + v
-            body = seg.center
+            body = center
             hops += 1
             if hops > 10:
                 raise ValueError("segment chain does not reach SSB")

@@ -1,0 +1,116 @@
+"""Chi-squared grids over parameter subspaces.
+
+Reference parity: src/pint/gridutils.py::grid_chisq / grid_chisq_derived
+— the reference refits at every grid point with a concurrent.futures
+process pool (its ONLY multiprocess parallelism; SURVEY.md §2).
+TPU-first redesign: every grid point is the same pure fit kernel at a
+different x, so the whole grid is one vmapped, jitted batch — refits of
+the non-gridded parameters run as masked Gauss-Newton steps inside the
+vmap.  A 10^4-point grid is one device dispatch, not 10^4 subprocesses.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.wls import _wls_step
+
+
+def _internal_value(param, value):
+    """Convert a par-file-unit value to internal units via a scratch
+    copy of the Parameter (handles DD/epoch/angle coercions)."""
+    pc = copy.deepcopy(param)
+    pc.value = value
+    iv = pc.internal()
+    if isinstance(iv, tuple):
+        raise ValueError(
+            f"cannot grid epoch parameter {param.name} (grid the delta "
+            "in seconds instead)"
+        )
+    return float(iv.to_float()) if hasattr(iv, "to_float") else float(iv)
+
+
+def grid_chisq(
+    toas,
+    model,
+    grid: dict,
+    refit: bool = True,
+    n_refit_iter: int = 2,
+):
+    """chi2 over the outer product of `grid` (param name -> values in
+    the parameter's par-file units).
+
+    Gridded parameters must be free in the model (they are held fixed
+    per point; the remaining free parameters are refit when `refit`).
+    Returns (chi2 ndarray with one axis per grid param, in dict order).
+    """
+    cm = model.compile(toas)
+    names = list(grid)
+    for n in names:
+        if n not in cm.free_names:
+            raise ValueError(
+                f"grid parameter {n} must be free in the model"
+            )
+    gidx = jnp.asarray([cm._index[n] for n in names])
+    ref = {
+        n: (
+            float(cm.ref[n].to_float())
+            if hasattr(cm.ref[n], "to_float") else float(cm.ref[n])
+        )
+        for n in names
+    }
+    axes = [
+        np.asarray(
+            [_internal_value(model.params[n], v) - ref[n] for v in vals],
+            dtype=np.float64,
+        )
+        for n, vals in grid.items()
+    ]
+    mesh = np.meshgrid(*axes, indexing="ij")
+    pts = np.stack([m.ravel() for m in mesh], axis=-1)  # (npts, k)
+
+    free_mask = np.ones(cm.nfree)
+    free_mask[np.asarray(gidx)] = 0.0
+    free_mask_j = jnp.asarray(free_mask)
+    noffset = 0 if "PHOFF" in cm.free_names else 1
+
+    def chi2_at(deltas):
+        x = cm.x0().at[gidx].set(deltas)
+        if refit:
+            for _ in range(n_refit_iter):
+                r = cm.time_residuals(x, subtract_mean=False)
+                M = cm.design_matrix(x)
+                if noffset:
+                    ones = jnp.ones((cm.bundle.ntoa, 1))
+                    M = jnp.concatenate([ones, M], axis=1)
+                w = 1.0 / jnp.square(cm.scaled_sigma(x))
+                dx, _, _ = _wls_step(r, M, w)
+                x = x + free_mask_j * dx[noffset:]
+        return cm.chi2(x)
+
+    chi2 = jax.jit(jax.vmap(chi2_at))(jnp.asarray(pts))
+    return np.asarray(chi2).reshape([len(a) for a in axes])
+
+
+def grid_chisq_derived(
+    toas, model, param_names, derived_fn, grids, **kw
+):
+    """Grid over derived coordinates: derived_fn maps grid coordinates
+    -> dict of model-parameter values (reference: grid_chisq_derived).
+    grids: list of 1-D arrays, one per derived coordinate."""
+    mesh = np.meshgrid(*grids, indexing="ij")
+    shape = mesh[0].shape
+    flat = [m.ravel() for m in mesh]
+    out = np.empty(flat[0].shape)
+    for i in range(len(flat[0])):
+        coords = [f[i] for f in flat]
+        values = derived_fn(*coords)
+        sub = {n: [values[n]] for n in param_names}
+        out[i] = grid_chisq(toas, model, sub, **kw)[
+            tuple([0] * len(param_names))
+        ]
+    return out.reshape(shape)

@@ -43,7 +43,9 @@ def _find_astrometry(model):
 
 def elongation_geometry(astrometry, pdict, bundle):
     """Sun-observer-pulsar geometry shared by NE_SW and SWX:
-    -> (d obs-Sun distance (ls), theta elongation (rad), sin(theta))."""
+    -> (d, safe_d, theta, sin_t): obs-Sun distance (light-seconds; d is
+    the raw value for zero-geometry guards, safe_d is clamped for
+    division), elongation angle (rad), and its clamped sine."""
     psr_dir = astrometry.ssb_to_psr_xyz(pdict, bundle)
     r = bundle.obs_sun_pos_ls  # obs -> Sun, light-seconds
     d = jnp.sqrt(jnp.sum(r * r, axis=-1))

@@ -196,7 +196,15 @@ class TimingModel:
             from pint_tpu.toas.ingest import ingest
 
             tzr_toas = absph.make_tzr_toas()
-            ingest(tzr_toas)
+            # the TZR TOA must go through the SAME ephemeris/options as
+            # the data TOAs or the absolute phase reference drifts
+            ps = self.params.get("PLANET_SHAPIRO")
+            ingest(
+                tzr_toas,
+                ephem=self.top_params["EPHEM"].value or "builtin",
+                planets=bool(ps.value) if ps is not None else False,
+                model=self,
+            )
             tzr_bundle = make_bundle(tzr_toas, self._build_masks(tzr_toas))
         return CompiledModel(
             self, bundle, subtract_mean=subtract_mean, tzr_bundle=tzr_bundle

@@ -1,0 +1,220 @@
+"""Polycos: piecewise-polynomial phase predictors (tempo format).
+
+Reference parity: src/pint/polycos.py::Polycos / PolycoEntry — generate
+per-segment polynomial fits of model phase for online folding, evaluate
+absolute phase / spin frequency, read and write the tempo polyco.dat
+format:
+
+  phase(t) = RPHASE + 60 DT F0 + C1 + C2 DT + C3 DT^2 + ...
+  f(t)     = F0 + (1/60) (C2 + 2 C3 DT + ...)         [Hz]
+  DT       = (t - TMID) minutes
+
+Generation evaluates the compiled model's absolute phase on Chebyshev
+nodes per segment and least-squares fits the coefficients — one jitted
+phase evaluation for all segments' nodes at once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+
+
+@dataclass
+class PolycoEntry:
+    tmid_mjd: float  # midpoint, UTC MJD
+    mjd_span_minutes: float
+    rphase_int: float  # integer part of reference phase
+    rphase_frac: float
+    f0: float  # reference spin frequency (Hz)
+    obs: str
+    obsfreq_mhz: float
+    coeffs: np.ndarray = field(default_factory=lambda: np.zeros(12))
+    psrname: str = ""
+
+    def dt_minutes(self, mjd):
+        return (np.asarray(mjd, dtype=np.float64) - self.tmid_mjd) * 1440.0
+
+    def abs_phase(self, mjd):
+        """(int, frac) absolute phase at UTC mjd (float array)."""
+        dt = self.dt_minutes(mjd)
+        poly = np.polynomial.polynomial.polyval(dt, self.coeffs)
+        spin = 60.0 * dt * self.f0
+        total_frac = self.rphase_frac + poly + spin
+        carry = np.floor(total_frac)
+        return self.rphase_int + carry, total_frac - carry
+
+    def spin_freq(self, mjd):
+        dt = self.dt_minutes(mjd)
+        dcoef = np.polynomial.polynomial.polyder(self.coeffs)
+        return self.f0 + np.polynomial.polynomial.polyval(dt, dcoef) / 60.0
+
+
+class Polycos:
+    def __init__(self, entries: list[PolycoEntry]):
+        self.entries = entries
+
+    # -- generation -------------------------------------------------------
+    @classmethod
+    def generate(
+        cls,
+        model,
+        start_mjd: float,
+        end_mjd: float,
+        obs: str = "@",
+        segment_minutes: float = 60.0,
+        ncoeff: int = 12,
+        obsfreq_mhz: float = 1400.0,
+    ) -> "Polycos":
+        from pint_tpu.toas.ingest import ingest
+
+        span_days = segment_minutes / 1440.0
+        nseg = max(1, int(np.ceil((end_mjd - start_mjd) / span_days)))
+        nodes_per_seg = 2 * ncoeff + 1
+        # Chebyshev nodes in each segment, all evaluated in one pass
+        u = np.cos(np.pi * (np.arange(nodes_per_seg) + 0.5) / nodes_per_seg)
+        mjds = []
+        tmids = []
+        for s in range(nseg):
+            t0 = start_mjd + s * span_days
+            tmid = t0 + span_days / 2.0
+            tmids.append(tmid)
+            mjds.append(tmid + u * span_days / 2.0)
+        mjds = np.concatenate(mjds)
+        n = len(mjds)
+        toas = TOAs(
+            TimeArray.from_mjd_float(mjds, scale="utc"),
+            np.full(n, obsfreq_mhz), np.ones(n), [obs] * n,
+            [dict() for _ in range(n)],
+        )
+        ingest(
+            toas,
+            ephem=model.top_params["EPHEM"].value or "builtin",
+            model=model,
+        )
+        cm = model.compile(toas, subtract_mean=False)
+        ph = cm.phase(cm.x0())
+        ph_int = np.asarray(ph.int_)
+        ph_frac = np.asarray(ph.frac)
+        f0 = float(
+            np.asarray(cm.spin_frequency(cm.x0()))[n // 2]
+        )
+        psr = model.top_params["PSR"].value or ""
+
+        entries = []
+        for s in range(nseg):
+            sl = slice(s * nodes_per_seg, (s + 1) * nodes_per_seg)
+            tmid = tmids[s]
+            dt_min = (mjds[sl] - tmid) * 1440.0
+            # reference phase = phase at the node closest to tmid
+            iref = np.argmin(np.abs(dt_min))
+            rint = ph_int[sl][iref]
+            rfrac = ph_frac[sl][iref]
+            resid = (
+                (ph_int[sl] - rint) + (ph_frac[sl] - rfrac)
+                - 60.0 * dt_min * f0
+            )
+            V = np.vander(dt_min, ncoeff, increasing=True)
+            coeffs, *_ = np.linalg.lstsq(V, resid, rcond=None)
+            entries.append(PolycoEntry(
+                tmid_mjd=tmid, mjd_span_minutes=segment_minutes,
+                rphase_int=float(rint), rphase_frac=float(rfrac),
+                f0=f0, obs=obs, obsfreq_mhz=obsfreq_mhz,
+                coeffs=coeffs, psrname=psr,
+            ))
+        return cls(entries)
+
+    # -- evaluation -------------------------------------------------------
+    def _entry_for(self, mjd):
+        for e in self.entries:
+            if abs(mjd - e.tmid_mjd) * 1440.0 <= e.mjd_span_minutes / 2 + 1e-9:
+                return e
+        raise PintTpuError(f"MJD {mjd} outside polyco span")
+
+    def eval_abs_phase(self, mjds):
+        mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
+        ints = np.empty_like(mjds)
+        fracs = np.empty_like(mjds)
+        for i, m in enumerate(mjds):
+            e = self._entry_for(m)
+            ints[i], fracs[i] = e.abs_phase(m)
+        return ints, fracs
+
+    def eval_spin_freq(self, mjds):
+        mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
+        return np.array([
+            self._entry_for(m).spin_freq(m) for m in mjds
+        ])
+
+    # -- tempo polyco.dat format ------------------------------------------
+    def write(self, path):
+        with open(path, "w") as f:
+            for e in self.entries:
+                rphase = f"{e.rphase_int + e.rphase_frac:.6f}"
+                f.write(
+                    f"{e.psrname:<10s} {'':9s}{0.0:11.2f}"
+                    f"{e.tmid_mjd:20.11f}{0.0:21.6f} {0.0:6.3f}"
+                    f" {0.0:7.3f}\n"
+                )
+                f.write(
+                    f"{rphase:>20s}{e.f0:18.12f}"
+                    f"{_obs_code(e.obs):>5s}{e.mjd_span_minutes:5.0f}"
+                    f"{len(e.coeffs):5d}{e.obsfreq_mhz:10.3f}\n"
+                )
+                for i in range(0, len(e.coeffs), 3):
+                    row = e.coeffs[i:i + 3]
+                    f.write(
+                        "".join(f"{c:25.17e}" for c in row) + "\n"
+                    )
+
+    @classmethod
+    def read(cls, path) -> "Polycos":
+        entries = []
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        i = 0
+        while i < len(lines):
+            h1 = lines[i].split()
+            h2 = lines[i + 1].split()
+            psr = h1[0]
+            tmid = float(h1[2])
+            rphase = float(h2[0])
+            f0 = float(h2[1])
+            obs = h2[2]
+            span = float(h2[3])
+            ncoeff = int(h2[4])
+            obsfreq = float(h2[5])
+            nrows = (ncoeff + 2) // 3
+            coeffs = []
+            for r in range(nrows):
+                coeffs.extend(
+                    float(v) for v in lines[i + 2 + r].split()
+                )
+            i += 2 + nrows
+            rint = np.floor(rphase)
+            entries.append(PolycoEntry(
+                tmid_mjd=tmid, mjd_span_minutes=span,
+                rphase_int=rint, rphase_frac=rphase - rint, f0=f0,
+                obs=obs, obsfreq_mhz=obsfreq,
+                coeffs=np.asarray(coeffs[:ncoeff]), psrname=psr,
+            ))
+        return cls(entries)
+
+
+def _obs_code(obs: str) -> str:
+    """Tempo site code for the polyco header (single char where known)."""
+    from pint_tpu.observatory import get_observatory
+
+    try:
+        o = get_observatory(obs)
+        for a in o.aliases:
+            if len(a) == 1:
+                return a
+        return o.name[:4]
+    except Exception:
+        return str(obs)[:4]

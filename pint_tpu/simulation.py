@@ -68,9 +68,11 @@ def _ingest(toas: TOAs, model: TimingModel):
     else:
         from pint_tpu.toas.ingest import ingest
 
+        ps = model.params.get("PLANET_SHAPIRO")
         ingest(
             toas,
             ephem=model.top_params["EPHEM"].value or "builtin",
+            planets=bool(ps.value) if ps is not None else False,
             model=model,
         )
 

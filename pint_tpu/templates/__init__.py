@@ -1,0 +1,16 @@
+"""Pulse-profile templates + photon-phase ML fitting.
+
+Reference parity: src/pint/templates/ (lctemplate.py, lcprimitives.py,
+lcfitters.py — heritage Fermi pointlike): analytic profile templates as
+weighted sums of periodic primitives plus an unpulsed background,
+fitted to photon phases by maximum likelihood.  The log-likelihood is a
+pure jax function of the parameter vector; the fitter uses scipy
+L-BFGS-B with jax gradients (host driver, device math).
+"""
+
+from pint_tpu.templates.lcprimitives import (  # noqa: F401
+    LCGaussian,
+    LCVonMises,
+)
+from pint_tpu.templates.lctemplate import LCTemplate  # noqa: F401
+from pint_tpu.templates.lcfitters import LCFitter  # noqa: F401

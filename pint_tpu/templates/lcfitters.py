@@ -1,0 +1,66 @@
+"""Maximum-likelihood template fitting of photon phases.
+
+Reference parity: src/pint/templates/lcfitters.py::LCFitter — unbinned
+Poisson/weighted log-likelihood, here as a jitted jax objective with
+analytic gradients fed to scipy L-BFGS-B.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.optimize import minimize
+
+from pint_tpu.templates.lctemplate import LCTemplate
+
+
+class LCFitter:
+    def __init__(self, template: LCTemplate, phases, weights=None):
+        self.template = template
+        self.phases = jnp.asarray(np.asarray(phases, dtype=np.float64))
+        self.weights = (
+            None if weights is None
+            else jnp.asarray(np.asarray(weights, dtype=np.float64))
+        )
+
+    def loglikelihood(self, params=None):
+        """Unbinned log-likelihood (weighted form: Kerr 2011 eq. 2)."""
+        f = self.template(self.phases, params=params)
+        if self.weights is None:
+            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+        return jnp.sum(
+            jnp.log(jnp.maximum(self.weights * f + (1.0 - self.weights),
+                                1e-300))
+        )
+
+    def fit(self, maxiter: int = 200):
+        """L-BFGS-B with jax gradients; bounds keep weights in [0,1]
+        and widths positive.  Returns the optimized log-likelihood."""
+        x0 = self.template.get_parameters()
+        n = len(self.template.primitives)
+
+        obj = jax.jit(lambda v: -self.loglikelihood(params=v))
+        grad = jax.jit(jax.grad(lambda v: -self.loglikelihood(params=v)))
+
+        bounds = [(1e-6, 1.0)] * n
+        for p in self.template.primitives:
+            bounds += [(1e-4, 0.5), (None, None)]
+
+        res = minimize(
+            lambda v: float(obj(jnp.asarray(v))),
+            x0,
+            jac=lambda v: np.asarray(grad(jnp.asarray(v))),
+            method="L-BFGS-B",
+            bounds=bounds,
+            options={"maxiter": maxiter},
+        )
+        self.template.set_parameters(res.x)
+        # wrap fitted locations into [0, 1)
+        for p in self.template.primitives:
+            p.params[1] = p.params[1] % 1.0
+        self.result = res
+        return -float(res.fun)
+
+    def __repr__(self):
+        return f"LCFitter({self.template!r}, n={len(self.phases)})"

@@ -1,0 +1,72 @@
+"""Light-curve primitives: periodic unit-normalized peak shapes.
+
+Reference parity: src/pint/templates/lcprimitives.py::LCGaussian,
+LCVonMises — each primitive is a density on phase [0, 1) with
+parameters (width, location); jax-traceable __call__.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class LCPrimitive:
+    """Base: params [width, loc]; density integrates to 1 over a cycle."""
+
+    n_params = 2
+
+    def __init__(self, width: float = 0.03, loc: float = 0.5):
+        self.params = np.array([width, loc], dtype=np.float64)
+
+    def __call__(self, phases, params=None):
+        raise NotImplementedError
+
+    @property
+    def loc(self):
+        return self.params[1]
+
+    @property
+    def width(self):
+        return self.params[0]
+
+    def __repr__(self):
+        return (
+            f"{type(self).__name__}(width={self.params[0]:.4f}, "
+            f"loc={self.params[1]:.4f})"
+        )
+
+
+class LCGaussian(LCPrimitive):
+    """Wrapped Gaussian peak (summed over +-2 neighbor cycles — ample
+    for widths < 0.2 cycles)."""
+
+    def __call__(self, phases, params=None):
+        w, loc = (
+            (self.params[0], self.params[1]) if params is None
+            else (params[0], params[1])
+        )
+        dphi = phases - loc
+        out = 0.0
+        for k in (-2, -1, 0, 1, 2):
+            z = (dphi + k) / w
+            out = out + jnp.exp(-0.5 * z * z)
+        return out / (w * jnp.sqrt(2.0 * jnp.pi))
+
+
+class LCVonMises(LCPrimitive):
+    """Von Mises peak; width parameter = 1/sqrt(kappa) (sigma-like)."""
+
+    def __call__(self, phases, params=None):
+        w, loc = (
+            (self.params[0], self.params[1]) if params is None
+            else (params[0], params[1])
+        )
+        kappa = 1.0 / (w * w)
+        from jax.scipy.special import i0e
+
+        z = 2.0 * jnp.pi * (phases - loc)
+        # exp(kappa cos z)/(2 pi I0(kappa)), computed overflow-safe
+        return jnp.exp(kappa * (jnp.cos(z) - 1.0)) / (
+            2.0 * jnp.pi * i0e(kappa)
+        )

@@ -1,0 +1,78 @@
+"""LCTemplate: weighted sum of primitives + unpulsed background.
+
+Reference parity: src/pint/templates/lctemplate.py::LCTemplate —
+f(phi) = sum_i w_i g_i(phi) + (1 - sum_i w_i), with g_i normalized
+primitives; parameter vector layout [w_1..w_n, p_1..: per-primitive
+(width, loc)].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class LCTemplate:
+    def __init__(self, primitives, weights=None):
+        self.primitives = list(primitives)
+        n = len(self.primitives)
+        if weights is None:
+            weights = np.full(n, 0.5 / n)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if self.weights.sum() > 1.0 + 1e-9:
+            raise ValueError("primitive weights must sum to <= 1")
+
+    # -- parameter vector -------------------------------------------------
+    def get_parameters(self) -> np.ndarray:
+        parts = [self.weights]
+        for p in self.primitives:
+            parts.append(p.params)
+        return np.concatenate(parts)
+
+    def set_parameters(self, vec):
+        vec = np.asarray(vec, dtype=np.float64)
+        n = len(self.primitives)
+        self.weights = vec[:n].copy()
+        off = n
+        for p in self.primitives:
+            p.params = vec[off:off + p.n_params].copy()
+            off += p.n_params
+
+    def __call__(self, phases, params=None):
+        """Density at phases; jax-traceable when params is a jnp vector
+        in get_parameters() layout."""
+        n = len(self.primitives)
+        if params is None:
+            params = self.get_parameters()
+        w = params[:n]
+        out = 1.0 - jnp.sum(w)
+        off = n
+        for i, p in enumerate(self.primitives):
+            out = out + w[i] * p(
+                phases, params=params[off:off + p.n_params]
+            )
+            off += p.n_params
+        return out
+
+    def random(self, n, rng=None):
+        """Draw photon phases from the template (for tests/simulation)."""
+        rng = rng or np.random.default_rng()
+        phases = rng.uniform(size=n)
+        # rejection sample against the density
+        params = self.get_parameters()
+        fmax = float(
+            np.max(np.asarray(self(np.linspace(0, 1, 2048), params)))
+        )
+        out = []
+        while len(out) < n:
+            cand = rng.uniform(size=2 * n)
+            f = np.asarray(self(cand, params))
+            keep = rng.uniform(size=2 * n) * fmax < f
+            out.extend(cand[keep].tolist())
+        return np.asarray(out[:n])
+
+    def __repr__(self):
+        inner = ", ".join(
+            f"{w:.3f}*{p!r}" for w, p in zip(self.weights, self.primitives)
+        )
+        return f"LCTemplate({inner})"

@@ -128,7 +128,12 @@ def ingest_topocentric(
     toas.ephem = getattr(eph, "name", str(ephem))
 
     # -- 6. troposphere geometry ------------------------------------------
-    lat, lon, height = itrf_to_geodetic(itrf)
+    on_ground = np.linalg.norm(itrf, axis=-1) > 1e6  # geocenter: no air
+    lat, lon, height = itrf_to_geodetic(
+        np.where(on_ground[:, None], itrf, [6378137.0, 0.0, 0.0])
+    )
+    lat = np.where(on_ground, lat, 0.0)
+    height = np.where(on_ground, height, 0.0)
     toas.obs_lat_rad = lat
     toas.obs_alt_m = height
     src = _source_unit_vector(model)
@@ -140,9 +145,12 @@ def ingest_topocentric(
              np.sin(lat)], axis=-1
         )
         normal_gcrs = (M @ normal_itrf[..., None])[..., 0]
-        toas.obs_elevation_rad = np.arcsin(
+        elev = np.arcsin(
             np.clip(np.sum(normal_gcrs * src, axis=-1), -1.0, 1.0)
         )
+        # no troposphere for geocentric/space sites: elevation <= 0
+        # makes TroposphereDelay's validity mask false
+        toas.obs_elevation_rad = np.where(on_ground, elev, -np.pi / 2)
     return toas
 
 

@@ -1,0 +1,293 @@
+"""L6 layer tests: derived quantities, event stats, binary conversion,
+chi2 grids, polycos, Bayesian/MCMC, templates.
+
+Oracles: published values for PSR B1913+16 (GR post-Keplerian), known
+statistics distributions, and internal consistency (grid minimum at the
+fitted solution, polyco phase vs direct model phase, MCMC posterior vs
+WLS covariance, template recovery of injected profile).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.constants import SECS_PER_DAY
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas.ingest import ingest_barycentric
+
+PAR = """
+PSR              J1744-1134
+F0               245.4261196898081  1
+F1               -5.38e-16          1
+PEPOCH           55000
+DM               3.1380             1
+"""
+
+
+def _toas(model, n=120, seed=1):
+    rng = np.random.default_rng(seed)
+    toas = make_fake_toas_uniform(
+        54000, 56000, n, model, error_us=1.0,
+        freq_mhz=np.where(np.arange(n) % 2, 1400.0, 2300.0),
+        add_noise=False,
+    )
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, n))
+    ingest_barycentric(toas)
+    return toas
+
+
+# -- derived quantities ---------------------------------------------------
+def test_derived_b1913_gr():
+    """B1913+16: Pb=0.322997448918 d, e=0.6171340, mp=1.438, mc=1.390
+    -> omdot ~ 4.2266 deg/yr, gamma ~ 4.30 ms, pbdot ~ -2.40e-12."""
+    from pint_tpu import derived_quantities as dq
+
+    pb = 0.322997448918 * SECS_PER_DAY
+    e = 0.6171340
+    mp, mc = 1.438, 1.390
+    assert dq.omdot(mp, mc, pb, e) == pytest.approx(4.2266, rel=2e-3)
+    assert dq.gamma(mp, mc, pb, e) == pytest.approx(4.30e-3, rel=2e-2)
+    assert dq.pbdot(mp, mc, pb, e) == pytest.approx(-2.40e-12, rel=2e-2)
+
+
+def test_derived_mass_functions():
+    from pint_tpu import derived_quantities as dq
+
+    # J1909-3744-like: Pb=1.533449 d, x=1.89799 ls -> f ~ 0.00312 Msun
+    pb = 1.533449 * SECS_PER_DAY
+    mf = dq.mass_funct(pb, 1.89799)
+    assert mf == pytest.approx(3.12e-3, rel=1e-2)
+    # invert for companion mass and check round trip
+    mc = dq.companion_mass(pb, 1.89799, inc_rad=np.deg2rad(86.4), mp=1.45)
+    assert dq.mass_funct2(1.45, mc, np.deg2rad(86.4)) == pytest.approx(
+        mf, rel=1e-10
+    )
+
+
+def test_derived_p_f_roundtrip():
+    from pint_tpu import derived_quantities as dq
+
+    f, fd = dq.p_to_f(0.1, 1e-18)
+    p, pd = dq.p_to_f(f, fd)  # involution
+    assert p == pytest.approx(0.1, rel=1e-14)
+    assert pd == pytest.approx(1e-18, rel=1e-12)
+    assert dq.pulsar_age(10.0, -1e-15) == pytest.approx(
+        10.0 / (2 * 1e-15) / 3.15576e7, rel=1e-3
+    )
+
+
+# -- event statistics -----------------------------------------------------
+def test_eventstats_uniform_and_pulsed():
+    from pint_tpu.eventstats import hm, sf_hm, sf_z2m, z2m
+
+    rng = np.random.default_rng(0)
+    uni = rng.uniform(size=2000)
+    h_uni = hm(uni)
+    assert h_uni < 25.0  # no significant detection
+    assert 0.0 < sf_hm(h_uni) <= 1.0
+    # strongly pulsed: narrow Gaussian peak
+    pulsed = np.mod(0.3 + 0.02 * rng.normal(size=2000), 1.0)
+    h_pul = hm(pulsed)
+    assert h_pul > 500.0
+    z = z2m(pulsed, m=4)
+    assert z.shape == (4,) and np.all(np.diff(z) >= 0)
+    assert sf_z2m(z[-1], 4) < 1e-10
+
+
+def test_eventstats_weighted():
+    from pint_tpu.eventstats import hm
+
+    rng = np.random.default_rng(1)
+    sig = np.mod(0.5 + 0.03 * rng.normal(size=500), 1.0)
+    bkg = rng.uniform(size=2000)
+    ph = np.concatenate([sig, bkg])
+    w = np.concatenate([np.full(500, 0.9), np.full(2000, 0.1)])
+    assert hm(ph, weights=w) > hm(ph)  # weights sharpen the detection
+
+
+# -- binary conversion ----------------------------------------------------
+def test_binaryconvert_ell1_dd_roundtrip():
+    from pint_tpu.binaryconvert import convert_binary
+
+    par = PAR + """
+BINARY           ELL1
+PB               1.5
+A1               3.2
+TASC             55000.1
+EPS1             1.2e-5
+EPS2             -0.7e-5
+"""
+    m = get_model(par)
+    toas = _toas(m, n=60)
+
+    def centered(model):
+        cm = model.compile(toas)
+        d = np.asarray(cm.delay(cm.x0()))
+        return d - d.mean()  # ELL1 absorbs the constant -3/2 a1 eps1
+        # Roemer term into TASC; constants are unobservable anyway
+
+    d0 = centered(m)
+    m_dd = convert_binary(m, "DD")
+    assert m_dd.components["BinaryDD"]
+    d1 = centered(m_dd)
+    # ELL1 truncation: x e^2 and x e (nb x) cross terms ~ 1e-8 here
+    assert np.max(np.abs(d1 - d0)) < 3e-8
+    m_back = convert_binary(m_dd, "ELL1")
+    d2 = centered(m_back)
+    np.testing.assert_allclose(d2, d0, atol=1e-10)
+
+
+# -- chi2 grids -----------------------------------------------------------
+def test_grid_chisq_minimum_at_truth():
+    from pint_tpu.gridutils import grid_chisq
+
+    m = get_model(PAR)
+    toas = _toas(m)
+    from pint_tpu.fitting import WLSFitter
+
+    f = WLSFitter(toas, m)
+    chi2_fit = f.fit_toas()
+    f0_fit = float(m.params["F0"].value.to_float())
+    f0_grid = [
+        f"{f0_fit + d:.20f}" for d in np.linspace(-3e-11, 3e-11, 7)
+    ]
+    chi2 = grid_chisq(toas, m, {"F0": f0_grid})
+    assert chi2.shape == (7,)
+    assert np.argmin(chi2) == 3  # center = fitted value
+    assert chi2[3] == pytest.approx(chi2_fit, rel=1e-4)
+    # 2-D grid
+    f1_fit = float(m.params["F1"].value)
+    chi2_2d = grid_chisq(
+        toas, m,
+        {
+            "F0": [f"{f0_fit + d:.20f}" for d in (-2e-11, 0, 2e-11)],
+            "F1": [f1_fit - 2e-19, f1_fit, f1_fit + 2e-19],
+        },
+    )
+    assert chi2_2d.shape == (3, 3)
+    assert np.unravel_index(np.argmin(chi2_2d), (3, 3)) == (1, 1)
+
+
+# -- polycos --------------------------------------------------------------
+def test_polycos_phase_matches_model():
+    from pint_tpu.polycos import Polycos
+
+    m = get_model(PAR)
+    pcs = Polycos.generate(
+        m, 55000.0, 55000.5, obs="@", segment_minutes=60.0, ncoeff=12
+    )
+    assert len(pcs.entries) == 12
+    # compare against direct model phase at fresh epochs
+    rng = np.random.default_rng(3)
+    mjds = 55000.0 + np.sort(rng.uniform(0.01, 0.49, 20))
+    from pint_tpu.timebase.times import TimeArray
+    from pint_tpu.toas.toas import TOAs
+
+    toas = TOAs(
+        TimeArray.from_mjd_float(mjds, scale="utc"),
+        np.full(20, 1400.0), np.ones(20), ["@"] * 20,
+        [dict() for _ in range(20)],
+    )
+    ingest_barycentric(toas)
+    cm = m.compile(toas, subtract_mean=False)
+    ph = cm.phase(cm.x0())
+    ints, fracs = pcs.eval_abs_phase(mjds)
+    model_total = np.asarray(ph.int_) + np.asarray(ph.frac)
+    poly_total = ints + fracs
+    # sub-cycle agreement at the 1e-7 level (poly truncation)
+    assert np.max(np.abs(poly_total - model_total)) < 1e-6
+    f = pcs.eval_spin_freq(mjds)
+    np.testing.assert_allclose(f, 245.4261196898081, rtol=1e-9)
+
+
+def test_polycos_write_read_roundtrip(tmp_path):
+    from pint_tpu.polycos import Polycos
+
+    m = get_model(PAR)
+    pcs = Polycos.generate(m, 55000.0, 55000.25, obs="@", ncoeff=9)
+    path = tmp_path / "polyco.dat"
+    pcs.write(path)
+    pcs2 = Polycos.read(path)
+    assert len(pcs2.entries) == len(pcs.entries)
+    mjds = np.array([55000.05, 55000.2])
+    i1, f1 = pcs.eval_abs_phase(mjds)
+    i2, f2 = pcs2.eval_abs_phase(mjds)
+    np.testing.assert_allclose(
+        (i1 - i2) + (f1 - f2), 0.0, atol=1e-6
+    )
+
+
+# -- Bayesian / MCMC ------------------------------------------------------
+def test_bayesian_lnpost_and_mcmc_matches_wls():
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.sampler import MCMCFitter
+
+    m_true = get_model(PAR)
+    toas = _toas(m_true, n=80)
+    m_wls = get_model(PAR)
+    WLSFitter(toas, m_wls).fit_toas()
+    sigma_f0 = m_wls.params["F0"].uncertainty
+
+    m = get_model(PAR)
+    mf = MCMCFitter(toas, m)
+    mf.fit_toas(nsteps=400, nwalkers=32, seed=2)
+    assert 0.05 < mf.acceptance < 0.95
+    samples = mf.get_posterior_samples()
+    i_f0 = mf.bt.param_names.index("F0")
+    # posterior std ~ WLS uncertainty (white noise, linear regime)
+    assert np.std(samples[:, i_f0]) == pytest.approx(sigma_f0, rel=0.5)
+    # committed value near the WLS solution
+    v_mcmc = float(m.params["F0"].value.to_float())
+    v_wls = float(m_wls.params["F0"].value.to_float())
+    assert abs(v_mcmc - v_wls) < 4 * sigma_f0
+
+
+def test_prior_transform_and_bounds():
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.models.priors import NormalRV, UniformBoundedRV
+
+    m = get_model(PAR)
+    toas = _toas(m, n=40)
+    bt = BayesianTiming(
+        m, toas,
+        priors={
+            "F0": UniformBoundedRV(-1e-9, 1e-9),
+            "F1": NormalRV(0.0, 1e-18),
+            "DM": UniformBoundedRV(-1e-3, 1e-3),
+        },
+    )
+    i_f0 = bt.param_names.index("F0")
+    i_dm = bt.param_names.index("DM")
+    cube = np.full(3, 0.5)
+    cube[i_dm] = 0.25
+    x = bt.prior_transform(cube)
+    assert x[i_f0] == pytest.approx(0.0, abs=1e-12)
+    assert x[i_dm] == pytest.approx(-5e-4, rel=1e-9)
+    assert np.isfinite(float(bt.lnposterior(np.zeros(3))))
+    bad = np.zeros(3)
+    bad[i_f0] = 2e-9  # outside the F0 bounds
+    assert float(bt.lnprior(bad)) == -np.inf
+
+
+# -- templates ------------------------------------------------------------
+def test_template_fit_recovers_profile():
+    from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate
+
+    rng = np.random.default_rng(5)
+    true = LCTemplate(
+        [LCGaussian(width=0.03, loc=0.3), LCGaussian(width=0.08, loc=0.7)],
+        weights=[0.35, 0.25],
+    )
+    phases = true.random(4000, rng=rng)
+    fit_t = LCTemplate(
+        [LCGaussian(width=0.05, loc=0.28), LCGaussian(width=0.05, loc=0.72)],
+        weights=[0.3, 0.3],
+    )
+    f = LCFitter(fit_t, phases)
+    ll = f.fit()
+    assert np.isfinite(ll)
+    locs = sorted(p.loc for p in fit_t.primitives)
+    assert locs[0] == pytest.approx(0.3, abs=0.01)
+    assert locs[1] == pytest.approx(0.7, abs=0.02)
+    w = np.sort(fit_t.weights)
+    assert w[1] == pytest.approx(0.35, abs=0.05)
