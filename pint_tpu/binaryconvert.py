@@ -18,7 +18,7 @@ import math
 
 import numpy as np
 
-from pint_tpu.constants import SECS_PER_DAY, TSUN
+from pint_tpu.constants import SECS_PER_DAY, SECS_PER_JULIAN_YEAR, TSUN
 from pint_tpu.exceptions import TimingModelError
 from pint_tpu.models.timing_model import TimingModel
 
@@ -60,6 +60,7 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
 
     par_lines = []
     skip = set()
+    _DEG_YR_TO_RAD_S = math.pi / 180.0 / SECS_PER_JULIAN_YEAR
     if cur_name.startswith("ELL1") and target in ("DD", "BT", "DDS", "DDH"):
         eps1 = _get(model, "EPS1", 0.0)
         eps2 = _get(model, "EPS2", 0.0)
@@ -75,6 +76,21 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
             f"ECC {ecc:.15e}", f"OM {math.degrees(om):.15f}",
             f"T0 {t0:.15f}",
         ]
+        # EPS1 = e sin w, EPS2 = e cos w  =>  invert the rates:
+        # edot = E1D sin w + E2D cos w; wdot = (E1D cos w - E2D sin w)/e
+        e1d = _get(model, "EPS1DOT", 0.0)
+        e2d = _get(model, "EPS2DOT", 0.0)
+        if e1d or e2d:
+            if ecc == 0.0:
+                raise TimingModelError(
+                    "cannot convert EPS1DOT/EPS2DOT with zero eccentricity"
+                )
+            edot = e1d * math.sin(om) + e2d * math.cos(om)
+            omdot_rad_s = (e1d * math.cos(om) - e2d * math.sin(om)) / ecc
+            par_lines += [
+                f"EDOT {edot:.15e}",
+                f"OMDOT {omdot_rad_s / _DEG_YR_TO_RAD_S:.15e}",
+            ]
         skip |= {"EPS1", "EPS2", "TASC", "EPS1DOT", "EPS2DOT"}
     elif cur_name in ("DD", "BT", "DDS", "DDGR", "DDK", "BT_PIECEWISE") \
             and target.startswith("ELL1"):
@@ -82,6 +98,11 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
         if ecc > 0.05:
             raise TimingModelError(
                 f"ECC={ecc}: the ELL1 expansion needs e << 1"
+            )
+        if _get(model, "GAMMA", 0.0):
+            raise TimingModelError(
+                "ELL1 cannot represent GAMMA (Einstein delay); remove it "
+                "or keep a DD-family model"
             )
         om = math.radians(_get(model, "OM", 0.0))
         pb_d = _get(model, "PB")
@@ -91,6 +112,13 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
             f"EPS2 {ecc * math.cos(om):.15e}",
             f"TASC {t0 - om / _TWO_PI * pb_d:.15f}",
         ]
+        edot = _get(model, "EDOT", 0.0)
+        omdot_rad_s = _get(model, "OMDOT", 0.0) * _DEG_YR_TO_RAD_S
+        if edot or omdot_rad_s:
+            par_lines += [
+                f"EPS1DOT {edot * math.sin(om) + ecc * omdot_rad_s * math.cos(om):.15e}",
+                f"EPS2DOT {edot * math.cos(om) - ecc * omdot_rad_s * math.sin(om):.15e}",
+            ]
         skip |= {"ECC", "OM", "T0", "EDOT", "OMDOT", "GAMMA"}
     elif cur_name == "DDS" and target == "DD":
         par_lines.append(

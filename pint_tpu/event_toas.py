@@ -1,0 +1,109 @@
+"""Photon-event TOA loading from mission FITS files.
+
+Reference parity: src/pint/event_toas.py / fermi_toas.py
+(load_event_TOAs, load_Fermi_TOAs, mission lookup tables) — read an
+event table's TIME column, convert mission elapsed time (MET) to MJD
+via MJDREFI/MJDREFF/TIMEZERO, and build a TOAs object.
+
+Supported event frames:
+- barycentered events (TIMESYS='TDB', e.g. barycorr/axBary output):
+  site '@' — the full precision path;
+- geocentered or spacecraft events in UTC/TT at the geocenter (site
+  '0'): spacecraft orbit-file interpolation (the reference's FT2/orbit
+  readers) can refine this when an orbit product is supplied
+  [verify: orbit-file support lands with satellite_obs].
+
+Event TOAs get zero measurement uncertainty by convention (the
+reference uses error=0 for photons) and a -photon flag.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.io.fits import get_bintable
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+
+# mission defaults (reference: event_toas mission lookup tables)
+MISSIONS = {
+    "nicer": dict(extname="EVENTS", timecol="TIME"),
+    "nustar": dict(extname="EVENTS", timecol="TIME"),
+    "xmm": dict(extname="EVENTS", timecol="TIME"),
+    "rxte": dict(extname="EVENTS", timecol="TIME"),
+    "swift": dict(extname="EVENTS", timecol="TIME"),
+    "fermi": dict(extname="EVENTS", timecol="TIME"),
+    "generic": dict(extname=None, timecol="TIME"),
+}
+
+
+def _mjdref(hdr) -> float:
+    if "MJDREFI" in hdr:
+        return float(hdr["MJDREFI"]) + float(hdr.get("MJDREFF", 0.0))
+    if "MJDREF" in hdr:
+        return float(hdr["MJDREF"])
+    raise PintTpuError("event file has no MJDREF/MJDREFI keyword")
+
+
+def load_event_TOAs(
+    path,
+    mission: str = "generic",
+    energy_range=None,
+    errors_us: float = 0.0,
+) -> TOAs:
+    """Event FITS -> TOAs (one per photon)."""
+    cfg = MISSIONS.get(mission.lower())
+    if cfg is None:
+        raise PintTpuError(
+            f"unknown mission {mission!r}; known {sorted(MISSIONS)}"
+        )
+    hdu = get_bintable(path, cfg["extname"])
+    hdr = hdu.header
+    met = np.asarray(hdu.column(cfg["timecol"]), dtype=np.float64)
+    if energy_range is not None and "PI" in [
+        c.upper() for c in hdu.columns()
+    ]:
+        pi = np.asarray(hdu.column("PI"), dtype=np.float64)
+        lo, hi = energy_range
+        keep = (pi >= lo) & (pi <= hi)
+        met = met[keep]
+    mjdref = _mjdref(hdr)
+    timezero = float(hdr.get("TIMEZERO", 0.0))
+    timesys = str(hdr.get("TIMESYS", "TT")).upper()
+    # exact split: integer reference day + (fractional day + MET) seconds
+    ref_day = int(np.floor(mjdref))
+    ref_sec = (mjdref - ref_day) * 86400.0
+    sec = ref_sec + met + timezero
+
+    if timesys == "TDB":
+        site = "@"
+        scale = "tdb"
+    elif timesys in ("TT", "UTC"):
+        site = "0"  # geocenter
+        scale = timesys.lower()
+    else:
+        raise PintTpuError(f"unsupported event TIMESYS {timesys!r}")
+    t = TimeArray(np.full(len(sec), ref_day, dtype=np.int64), 0.0, scale)
+    t = t.add_seconds(sec)
+    if scale == "tt":
+        # TOAs store UTC for topocentric sites; convert once here
+        t = t.to_scale("utc")
+    n = len(sec)
+    toas = TOAs(
+        t,
+        np.full(n, np.inf),  # photons: infinite frequency (no DM)
+        np.full(n, errors_us),
+        [site] * n,
+        [
+            {"photon": "1", "mission": mission}
+            for _ in range(n)
+        ],
+    )
+    toas.sort()
+    return toas
+
+
+def load_fermi_TOAs(path, **kw) -> TOAs:
+    """Fermi photon events (reference: fermi_toas.load_Fermi_TOAs)."""
+    return load_event_TOAs(path, mission="fermi", **kw)

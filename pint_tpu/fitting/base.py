@@ -15,6 +15,22 @@ from pint_tpu.residuals import Residuals
 from pint_tpu.toas.toas import TOAs
 
 
+def noffset(cm) -> int:
+    """1 when the implicit offset column is in use; 0 when a free PHOFF
+    replaces it (both together are exactly degenerate)."""
+    return 0 if "PHOFF" in cm.free_names else 1
+
+
+def design_with_offset(cm, x):
+    """Design matrix with the implicit offset column prepended (when
+    applicable) — shared by fitters, gridutils, and the MCMC seeder."""
+    M = cm.design_matrix(x)
+    if not noffset(cm):
+        return M
+    ones = jnp.ones((cm.bundle.ntoa, 1))
+    return jnp.concatenate([ones, M], axis=1)
+
+
 class Fitter:
     """Common base: compiled kernels + offset column + post-fit commit."""
 
@@ -30,16 +46,10 @@ class Fitter:
 
     @property
     def _noffset(self):
-        # PHOFF (explicit fitted phase offset) replaces the implicit
-        # offset column; both together are exactly degenerate
-        return 0 if "PHOFF" in self.cm.free_names else 1
+        return noffset(self.cm)
 
     def _design_with_offset(self, x):
-        M = self.cm.design_matrix(x)
-        if not self._noffset:
-            return M
-        ones = jnp.ones((self.cm.bundle.ntoa, 1))
-        return jnp.concatenate([ones, M], axis=1)
+        return design_with_offset(self.cm, x)
 
     def _make_resids(self):
         """Residuals object for the current compiled state; wideband

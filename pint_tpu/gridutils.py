@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.fitting.base import design_with_offset, noffset
 from pint_tpu.fitting.wls import _wls_step
 
 
@@ -72,45 +73,64 @@ def grid_chisq(
     ]
     mesh = np.meshgrid(*axes, indexing="ij")
     pts = np.stack([m.ravel() for m in mesh], axis=-1)  # (npts, k)
+    chi2 = _chi2_points(cm, gidx, pts, refit, n_refit_iter)
+    return chi2.reshape([len(a) for a in axes])
 
+
+def _chi2_points(cm, gidx, pts, refit, n_refit_iter):
+    """One vmapped dispatch: chi2 at each (npts, k) delta point, with
+    masked Gauss-Newton refits of the non-gridded free parameters."""
     free_mask = np.ones(cm.nfree)
     free_mask[np.asarray(gidx)] = 0.0
     free_mask_j = jnp.asarray(free_mask)
-    noffset = 0 if "PHOFF" in cm.free_names else 1
+    no = noffset(cm)
 
     def chi2_at(deltas):
         x = cm.x0().at[gidx].set(deltas)
         if refit:
             for _ in range(n_refit_iter):
                 r = cm.time_residuals(x, subtract_mean=False)
-                M = cm.design_matrix(x)
-                if noffset:
-                    ones = jnp.ones((cm.bundle.ntoa, 1))
-                    M = jnp.concatenate([ones, M], axis=1)
+                M = design_with_offset(cm, x)
                 w = 1.0 / jnp.square(cm.scaled_sigma(x))
                 dx, _, _ = _wls_step(r, M, w)
-                x = x + free_mask_j * dx[noffset:]
+                x = x + free_mask_j * dx[no:]
         return cm.chi2(x)
 
-    chi2 = jax.jit(jax.vmap(chi2_at))(jnp.asarray(pts))
-    return np.asarray(chi2).reshape([len(a) for a in axes])
+    return np.asarray(jax.jit(jax.vmap(chi2_at))(jnp.asarray(pts)))
 
 
 def grid_chisq_derived(
-    toas, model, param_names, derived_fn, grids, **kw
+    toas, model, param_names, derived_fn, grids,
+    refit: bool = True, n_refit_iter: int = 2,
 ):
     """Grid over derived coordinates: derived_fn maps grid coordinates
     -> dict of model-parameter values (reference: grid_chisq_derived).
-    grids: list of 1-D arrays, one per derived coordinate."""
+    grids: list of 1-D arrays, one per derived coordinate.  All points
+    map to internal deltas on the host, then evaluate as ONE vmapped
+    batch (same single dispatch as grid_chisq)."""
+    cm = model.compile(toas)
+    for n in param_names:
+        if n not in cm.free_names:
+            raise ValueError(
+                f"grid parameter {n} must be free in the model"
+            )
+    gidx = jnp.asarray([cm._index[n] for n in param_names])
+    ref = {
+        n: (
+            float(cm.ref[n].to_float())
+            if hasattr(cm.ref[n], "to_float") else float(cm.ref[n])
+        )
+        for n in param_names
+    }
     mesh = np.meshgrid(*grids, indexing="ij")
     shape = mesh[0].shape
     flat = [m.ravel() for m in mesh]
-    out = np.empty(flat[0].shape)
+    pts = np.empty((len(flat[0]), len(param_names)))
     for i in range(len(flat[0])):
-        coords = [f[i] for f in flat]
-        values = derived_fn(*coords)
-        sub = {n: [values[n]] for n in param_names}
-        out[i] = grid_chisq(toas, model, sub, **kw)[
-            tuple([0] * len(param_names))
+        values = derived_fn(*(f[i] for f in flat))
+        pts[i] = [
+            _internal_value(model.params[n], values[n]) - ref[n]
+            for n in param_names
         ]
-    return out.reshape(shape)
+    chi2 = _chi2_points(cm, gidx, pts, refit, n_refit_iter)
+    return chi2.reshape(shape)

@@ -1,0 +1,56 @@
+"""Logging setup with repeated-message dedup.
+
+Reference parity: src/pint/logging.py — there a loguru sink with dedup
+filters so repeated per-TOA warnings print once; here stdlib logging
+(loguru is not a dependency) with the same surface: ``setup(level)``,
+level control for scripts, and a dedup filter keyed on (logger,
+message-prefix).
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+import sys
+
+_LOGGER_NAME = "pint_tpu"
+
+
+class DedupFilter(_logging.Filter):
+    """Pass each distinct message prefix only once (reference parity:
+    the loguru dedup filters for clock/ephemeris warnings)."""
+
+    def __init__(self, prefix_len: int = 60):
+        super().__init__()
+        self.prefix_len = prefix_len
+        self._seen: set = set()
+
+    def filter(self, record):
+        key = (record.name, record.levelno,
+               record.getMessage()[: self.prefix_len])
+        if key in self._seen:
+            return False
+        self._seen.add(key)
+        return True
+
+
+def setup(level: str = "INFO", dedup: bool = True, stream=None):
+    """Configure the pint_tpu logger (idempotent); returns it."""
+    logger = _logging.getLogger(_LOGGER_NAME)
+    logger.setLevel(getattr(_logging, str(level).upper(), _logging.INFO))
+    logger.handlers.clear()
+    h = _logging.StreamHandler(stream or sys.stderr)
+    h.setFormatter(_logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+        datefmt="%H:%M:%S",
+    ))
+    if dedup:
+        h.addFilter(DedupFilter())
+    logger.addHandler(h)
+    logger.propagate = False
+    return logger
+
+
+def get_logger(name: str = ""):
+    return _logging.getLogger(
+        f"{_LOGGER_NAME}.{name}" if name else _LOGGER_NAME
+    )

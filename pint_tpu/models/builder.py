@@ -106,12 +106,6 @@ class ModelBuilder:
     def __call__(self, par) -> TimingModel:
         pardict = parse_parfile(par)
         units = pardict.get("UNITS", [["TDB"]])[0][0].upper()
-        if units == "TCB":
-            warnings.warn(
-                "UNITS TCB: TCB->TDB parameter conversion is not applied "
-                "yet; parameters are interpreted as TDB",
-                UserWarning,
-            )
         comps = self.choose_components(pardict)
         model = TimingModel(components=comps)
         mask_counters: dict[tuple[int, str], int] = {}
@@ -151,6 +145,15 @@ class ModelBuilder:
             )
         model.unrecognized = unknown
         model.name = model.top_params["PSR"].value or ""
+        if units == "TCB":
+            from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+            warnings.warn(
+                "UNITS TCB parfile: converting parameters to TDB "
+                "(reference: tcb_conversion.convert_tcb_tdb)",
+                UserWarning,
+            )
+            convert_tcb_tdb(model)
         model.setup()
         model.validate()
         return model
@@ -179,14 +182,13 @@ def get_model_and_toas(
 ):
     """Load a par/tim pair and run the full ingest pipeline (§3.1)."""
     from pint_tpu.io.tim import get_TOAs_from_tim
-    from pint_tpu.toas.ingest import ingest
+    from pint_tpu.toas.ingest import ingest_for_model
 
     model = get_model(par)
     toas = get_TOAs_from_tim(tim)
-    if ephem is None:
-        ephem = (model.top_params["EPHEM"].value or "builtin").lower()
-    if planets is None:
-        ps = model.params.get("PLANET_SHAPIRO")
-        planets = bool(ps.value) if ps is not None else False
-    ingest(toas, ephem=ephem, planets=planets, model=model, **ingest_kw)
+    if ephem is not None:
+        ingest_kw["ephem"] = ephem
+    if planets is not None:
+        ingest_kw["planets"] = planets
+    ingest_for_model(toas, model, **ingest_kw)
     return model, toas

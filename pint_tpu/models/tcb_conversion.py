@@ -1,0 +1,83 @@
+"""TCB <-> TDB parameter conversion.
+
+Reference parity: src/pint/models/tcb_conversion.py::convert_tcb_tdb —
+tempo2-style parfiles can be in TCB units (UNITS TCB).  IAU 2006 B3:
+dTDB/dTCB = 1 - L_B, i.e. a fixed physical interval spans FEWER TDB
+seconds, so a parameter with effective time dimensionality d (value ~
+s^d) scales as
+
+    value_tdb = value_tcb * (1 - L_B)^d
+
+F0 [s^-1, d=-1] becomes LARGER: F0_tdb = F0_tcb / (1-L_B) = F0_tcb * K
+with tempo2's IFTE_K = 1/(1-L_B); PB [s, d=+1] becomes smaller.  DM has
+effective d = -1 (the dispersion constant is held fixed while delay
+scales with d=+1 and freq^2 with d=-2).  Epochs transform through the
+full TCB->TDB time-scale conversion.
+"""
+
+from __future__ import annotations
+
+from pint_tpu.constants import L_B
+
+# parameter name -> effective time dimensionality d (value ~ s^d).
+# Generated families handled by prefix below.
+_DIMENSIONS = {
+    "F0": -1, "F1": -2, "F2": -3, "F3": -4, "F4": -5, "F5": -6,
+    "PB": 1, "A1": 1, "FB0": -1, "FB1": -2, "FB2": -3,
+    "GAMMA": 1, "M2": 0, "MTOT": 0,
+    "DM": -1, "NE_SW": -1,
+    "PX": 0, "OM": 0, "ECC": 0, "SINI": 0,
+    "OMDOT": -1, "PBDOT": 0, "EDOT": -1, "A1DOT": 0,
+}
+
+_PREFIX_DIMS = [
+    ("F", lambda k: -(k + 1)),  # F0..Fn
+    ("DMX_", lambda k: -1),
+    ("GLF0_", lambda k: -1),
+    ("GLF1_", lambda k: -2),
+    ("GLF2_", lambda k: -3),
+]
+
+
+def _dimension(name: str):
+    if name in _DIMENSIONS:
+        return _DIMENSIONS[name]
+    for pref, fn in _PREFIX_DIMS:
+        rest = name[len(pref):]
+        if name.startswith(pref) and rest.isdigit():
+            return fn(int(rest))
+    return None
+
+
+def convert_tcb_tdb(model, backwards: bool = False):
+    """Convert a model's parameters in place TCB->TDB (or TDB->TCB when
+    backwards).  Epoch parameters route through TimeArray scale
+    conversion; dimensioned parameters scale by (1-L_B)^(-d)."""
+    from pint_tpu.models.parameter import MJDParameter
+
+    factor = 1.0 - L_B
+    for name, p in model.params.items():
+        if p.value is None:
+            continue
+        if isinstance(p, MJDParameter):
+            t = p.value  # TimeArray in tdb scale tag
+            # reinterpret the stored epoch in the source scale and convert
+            from pint_tpu.timebase.times import TimeArray
+
+            src = "tcb" if not backwards else "tdb"
+            dst = "tdb" if not backwards else "tcb"
+            t2 = TimeArray(t.mjd_int, t.sec, src).to_scale(dst)
+            p.value = TimeArray(t2.mjd_int, t2.sec, "tdb")
+            continue
+        d = _dimension(name)
+        if not d:
+            continue
+        scale = factor ** d if not backwards else factor ** (-d)
+        iv = p.internal()
+        if hasattr(iv, "to_float"):
+            p.set_internal(iv * scale)
+        else:
+            p.set_internal(float(iv) * scale)
+    units = model.top_params["UNITS"]
+    units.value = "TDB" if not backwards else "TCB"
+    return model

@@ -193,18 +193,12 @@ class TimingModel:
         tzr_bundle = None
         absph = self.components.get("AbsPhase")
         if absph is not None and absph.params["TZRMJD"].value is not None:
-            from pint_tpu.toas.ingest import ingest
+            from pint_tpu.toas.ingest import ingest_for_model
 
             tzr_toas = absph.make_tzr_toas()
             # the TZR TOA must go through the SAME ephemeris/options as
             # the data TOAs or the absolute phase reference drifts
-            ps = self.params.get("PLANET_SHAPIRO")
-            ingest(
-                tzr_toas,
-                ephem=self.top_params["EPHEM"].value or "builtin",
-                planets=bool(ps.value) if ps is not None else False,
-                model=self,
-            )
+            ingest_for_model(tzr_toas, self)
             tzr_bundle = make_bundle(tzr_toas, self._build_masks(tzr_toas))
         return CompiledModel(
             self, bundle, subtract_mean=subtract_mean, tzr_bundle=tzr_bundle

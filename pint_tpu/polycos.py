@@ -36,6 +36,7 @@ class PolycoEntry:
     obsfreq_mhz: float
     coeffs: np.ndarray = field(default_factory=lambda: np.zeros(12))
     psrname: str = ""
+    dm: float = 0.0
 
     def dt_minutes(self, mjd):
         return (np.asarray(mjd, dtype=np.float64) - self.tmid_mjd) * 1440.0
@@ -71,7 +72,7 @@ class Polycos:
         ncoeff: int = 12,
         obsfreq_mhz: float = 1400.0,
     ) -> "Polycos":
-        from pint_tpu.toas.ingest import ingest
+        from pint_tpu.toas.ingest import ingest_for_model
 
         span_days = segment_minutes / 1440.0
         nseg = max(1, int(np.ceil((end_mjd - start_mjd) / span_days)))
@@ -92,11 +93,7 @@ class Polycos:
             np.full(n, obsfreq_mhz), np.ones(n), [obs] * n,
             [dict() for _ in range(n)],
         )
-        ingest(
-            toas,
-            ephem=model.top_params["EPHEM"].value or "builtin",
-            model=model,
-        )
+        ingest_for_model(toas, model)
         cm = model.compile(toas, subtract_mean=False)
         ph = cm.phase(cm.x0())
         ph_int = np.asarray(ph.int_)
@@ -105,6 +102,8 @@ class Polycos:
             np.asarray(cm.spin_frequency(cm.x0()))[n // 2]
         )
         psr = model.top_params["PSR"].value or ""
+        dm_p = model.params.get("DM")
+        dm = float(dm_p.value) if dm_p is not None and dm_p.value else 0.0
 
         entries = []
         for s in range(nseg):
@@ -125,7 +124,7 @@ class Polycos:
                 tmid_mjd=tmid, mjd_span_minutes=segment_minutes,
                 rphase_int=float(rint), rphase_frac=float(rfrac),
                 f0=f0, obs=obs, obsfreq_mhz=obsfreq_mhz,
-                coeffs=coeffs, psrname=psr,
+                coeffs=coeffs, psrname=psr, dm=dm,
             ))
         return cls(entries)
 
@@ -158,7 +157,7 @@ class Polycos:
                 rphase = f"{e.rphase_int + e.rphase_frac:.6f}"
                 f.write(
                     f"{e.psrname:<10s} {'':9s}{0.0:11.2f}"
-                    f"{e.tmid_mjd:20.11f}{0.0:21.6f} {0.0:6.3f}"
+                    f"{e.tmid_mjd:20.11f}{e.dm:21.6f} {0.0:6.3f}"
                     f" {0.0:7.3f}\n"
                 )
                 f.write(
@@ -183,6 +182,7 @@ class Polycos:
             h2 = lines[i + 1].split()
             psr = h1[0]
             tmid = float(h1[2])
+            dm = float(h1[3]) if len(h1) > 3 else 0.0
             rphase = float(h2[0])
             f0 = float(h2[1])
             obs = h2[2]
@@ -201,7 +201,7 @@ class Polycos:
                 tmid_mjd=tmid, mjd_span_minutes=span,
                 rphase_int=rint, rphase_frac=rphase - rint, f0=f0,
                 obs=obs, obsfreq_mhz=obsfreq,
-                coeffs=np.asarray(coeffs[:ncoeff]), psrname=psr,
+                coeffs=np.asarray(coeffs[:ncoeff]), psrname=psr, dm=dm,
             ))
         return cls(entries)
 

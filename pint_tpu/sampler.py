@@ -122,19 +122,21 @@ class MCMCFitter:
     def _init_cov(self):
         """Gauss-Newton covariance at x=0 shapes the initial ensemble
         (parameter scales span ~15 decades; an isotropic ball would
-        take the sampler thousands of steps to burn in)."""
+        take the sampler thousands of steps to burn in).  Offset-column
+        handling is the fitters' shared logic (a second ones column
+        next to a free PHOFF would make the design singular)."""
         import jax.numpy as jnp
+
+        from pint_tpu.fitting.base import design_with_offset, noffset
+        from pint_tpu.fitting.wls import _wls_step
 
         cm = self.bt.cm
         x = cm.x0()
-        M = cm.design_matrix(x)
+        M = design_with_offset(cm, x)
         w = 1.0 / jnp.square(cm.scaled_sigma(x))
-        ones = jnp.ones((cm.bundle.ntoa, 1))
-        M = jnp.concatenate([ones, M], axis=1)
-        from pint_tpu.fitting.wls import _wls_step
-
         _, cov, _ = _wls_step(jnp.zeros(cm.bundle.ntoa), M, w)
-        return np.asarray(cov)[1:, 1:]
+        no = noffset(cm)
+        return np.asarray(cov)[no:, no:]
 
     def fit_toas(
         self, nsteps: int = 1000, nwalkers: int = 64, burn: float = 0.25,

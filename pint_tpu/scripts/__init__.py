@@ -1,0 +1,16 @@
+"""Console scripts (reference parity: src/pint/scripts/).
+
+Each module exposes main(argv=None); entry points are declared in
+pyproject.toml.  All scripts force x64 and accept --log-level.
+"""
+
+import contextlib as _contextlib
+import signal as _signal
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# die quietly when stdout is a closed pipe (e.g. `pintempo ... | head`)
+with _contextlib.suppress(AttributeError, ValueError):
+    _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)

@@ -1,0 +1,55 @@
+"""pintbary: quick barycentering of times.
+
+Reference parity: src/pint/scripts/pintbary.py — convert topocentric
+UTC MJDs to barycentric arrival times (TDB at SSB) for a sky position.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import pint_tpu.logging as plog
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Barycenter times (pintbary)")
+    ap.add_argument("mjds", nargs="+", type=float, help="UTC MJD(s)")
+    ap.add_argument("--obs", default="geocenter")
+    ap.add_argument("--ra", required=True, help="RAJ (hh:mm:ss.s)")
+    ap.add_argument("--dec", required=True, help="DECJ (dd:mm:ss.s)")
+    ap.add_argument("--ephem", default="builtin")
+    ap.add_argument("--freq", type=float, default=np.inf)
+    ap.add_argument("--dm", type=float, default=0.0)
+    ap.add_argument("--log-level", default="WARNING")
+    args = ap.parse_args(argv)
+    plog.setup(args.log_level)
+
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.timebase.times import TimeArray
+    from pint_tpu.toas.ingest import ingest
+    from pint_tpu.toas.toas import TOAs
+
+    par = (
+        f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\nF0 1.0\n"
+        f"PEPOCH {args.mjds[0]}\nDM {args.dm}\n"
+    )
+    model = get_model(par)
+    n = len(args.mjds)
+    toas = TOAs(
+        TimeArray.from_mjd_float(np.asarray(args.mjds), scale="utc"),
+        np.full(n, args.freq), np.ones(n), [args.obs] * n,
+        [dict() for _ in range(n)],
+    )
+    ingest(toas, ephem=args.ephem, model=model)
+    cm = model.compile(toas)
+    delay = np.asarray(cm.delay(cm.x0()))
+    t_bary = toas.t_tdb.add_seconds(-delay)
+    for s in t_bary.to_mjd_strings(15):
+        print(s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,34 @@
+"""tcb2tdb: convert a TCB par file to TDB.
+
+Reference parity: src/pint/scripts/tcb2tdb.py (wraps
+models/tcb_conversion.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import pint_tpu.logging as plog
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Convert TCB par to TDB")
+    ap.add_argument("input_par")
+    ap.add_argument("output_par")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+    log = plog.setup(args.log_level)
+
+    # get_model applies the TCB->TDB conversion when UNITS is TCB
+    from pint_tpu.models.builder import get_model
+
+    model = get_model(args.input_par)
+    with open(args.output_par, "w") as f:
+        f.write(model.as_parfile())
+    log.info("wrote %s (UNITS %s)", args.output_par,
+             model.top_params["UNITS"].value)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,15 +66,9 @@ def _ingest(toas: TOAs, model: TimingModel):
     if all(o.lower() in ("@", "bat", "ssb", "barycenter") for o in toas.obs):
         ingest_barycentric(toas)
     else:
-        from pint_tpu.toas.ingest import ingest
+        from pint_tpu.toas.ingest import ingest_for_model
 
-        ps = model.params.get("PLANET_SHAPIRO")
-        ingest(
-            toas,
-            ephem=model.top_params["EPHEM"].value or "builtin",
-            planets=bool(ps.value) if ps is not None else False,
-            model=model,
-        )
+        ingest_for_model(toas, model)
 
 
 def calculate_random_models(

@@ -52,3 +52,18 @@ def ingest(toas: TOAs, ephem: str = "builtin", planets: bool = False,
         toas, ephem=ephem, planets=planets, include_bipm=include_bipm,
         bipm_version=bipm_version, limits=limits, model=model,
     )
+
+
+def ingest_for_model(toas: TOAs, model, **kw) -> TOAs:
+    """Ingest with the model's own EPHEM / PLANET_SHAPIRO options — the
+    single helper every caller (builder, simulation, TZR, photonphase,
+    polycos) uses so data TOAs and derived TOAs always go through
+    identical chains."""
+    kw.setdefault(
+        "ephem", model.top_params["EPHEM"].value or "builtin"
+    )
+    ps = model.params.get("PLANET_SHAPIRO")
+    kw.setdefault(
+        "planets", bool(ps.value) if ps is not None else False
+    )
+    return ingest(toas, model=model, **kw)

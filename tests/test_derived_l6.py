@@ -137,6 +137,47 @@ EPS2             -0.7e-5
     np.testing.assert_allclose(d2, d0, atol=1e-10)
 
 
+def test_binaryconvert_rate_parameters():
+    """EPS1DOT/EPS2DOT <-> OMDOT/EDOT round trip preserves the rates."""
+    from pint_tpu.binaryconvert import convert_binary
+
+    par = PAR + """
+BINARY           ELL1
+PB               1.5
+A1               3.2
+TASC             55000.1
+EPS1             1.2e-5
+EPS2             -0.7e-5
+EPS1DOT          3.0e-16
+EPS2DOT          -1.0e-16
+"""
+    m = get_model(par)
+    m_dd = convert_binary(m, "DD")
+    assert m_dd.params["OMDOT"].value is not None
+    assert m_dd.params["EDOT"].value is not None
+    m_back = convert_binary(m_dd, "ELL1")
+    assert float(m_back.params["EPS1DOT"].value) == pytest.approx(
+        3.0e-16, rel=1e-9
+    )
+    assert float(m_back.params["EPS2DOT"].value) == pytest.approx(
+        -1.0e-16, rel=1e-9
+    )
+    # GAMMA cannot be represented in ELL1 -> must raise, not drop
+    par_g = PAR + """
+BINARY           DD
+PB               1.5
+A1               3.2
+T0               55000.1
+ECC              1e-5
+OM               30.0
+GAMMA            1e-6
+"""
+    from pint_tpu.exceptions import TimingModelError
+
+    with pytest.raises(TimingModelError, match="GAMMA"):
+        convert_binary(get_model(par_g), "ELL1")
+
+
 # -- chi2 grids -----------------------------------------------------------
 def test_grid_chisq_minimum_at_truth():
     from pint_tpu.gridutils import grid_chisq

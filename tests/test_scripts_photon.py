@@ -1,0 +1,194 @@
+"""CLI scripts + FITS/photon path + TCB conversion + logging tests.
+
+Script tests invoke main() with tmp files (the reference's
+tests/test_scripts pattern).  The photon path is validated end-to-end:
+events synthesized from the model's own phase must yield a huge H-test
+through photonphase, and uniform events must not.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.constants import L_B
+from pint_tpu.models.builder import get_model
+
+PAR = """PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+"""
+
+
+@pytest.fixture
+def parfile(tmp_path):
+    p = tmp_path / "test.par"
+    p.write_text(PAR)
+    return str(p)
+
+
+def test_zima_pintempo_roundtrip(tmp_path, parfile, capsys):
+    from pint_tpu.scripts.pintempo import main as pintempo
+    from pint_tpu.scripts.zima import main as zima
+
+    tim = str(tmp_path / "fake.tim")
+    out = str(tmp_path / "fit.par")
+    assert zima([parfile, tim, "--ntoa", "40", "--startMJD", "55000",
+                 "--duration", "500", "--addnoise", "--seed", "42",
+                 "--log-level", "ERROR"]) == 0
+    assert pintempo([parfile, tim, "--outfile", out,
+                     "--log-level", "ERROR"]) == 0
+    cap = capsys.readouterr()
+    assert "chi2" in cap.out
+    fitted = get_model(out)
+    assert float(fitted.params["F0"].value.to_float()) == pytest.approx(
+        245.4261196898081, abs=1e-9
+    )
+
+
+def test_compare_parfiles(tmp_path, parfile, capsys):
+    from pint_tpu.scripts.compare_parfiles import main
+
+    p2 = tmp_path / "other.par"
+    p2.write_text(PAR.replace("3.1380", "3.2000"))
+    assert main([parfile, str(p2), "--log-level", "ERROR"]) == 0
+    out = capsys.readouterr().out
+    assert "DM" in out and "*" in out
+
+
+def test_tcb2tdb_scaling(tmp_path, capsys):
+    from pint_tpu.scripts.tcb2tdb import main
+
+    par_tcb = tmp_path / "tcb.par"
+    par_tcb.write_text(PAR + "UNITS TCB\n")
+    out = tmp_path / "tdb.par"
+    with pytest.warns(UserWarning, match="TCB"):
+        assert main([str(par_tcb), str(out), "--log-level", "ERROR"]) == 0
+    m = get_model(str(out))
+    assert (m.top_params["UNITS"].value or "TDB").upper() == "TDB"
+    f0_tdb = float(m.params["F0"].value.to_float())
+    # IAU/tempo2: F0_TDB = F0_TCB / (1-L_B) = F0_TCB * IFTE_K (larger)
+    assert f0_tdb == pytest.approx(
+        245.4261196898081 / (1.0 - L_B), rel=1e-12
+    )
+    assert f0_tdb > 245.4261196898081
+
+
+def test_pintbary_runs(capsys):
+    from pint_tpu.scripts.pintbary import main
+
+    assert main([
+        "55000.0", "55100.5", "--ra", "06:13:43.97",
+        "--dec=-02:00:47.2", "--obs", "geocenter",
+        "--log-level", "ERROR",
+    ]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    # barycentric time within +-600 s (Roemer + clock) of the input
+    assert abs(float(lines[0]) - 55000.0) * 86400 < 700
+
+
+def test_fits_roundtrip(tmp_path):
+    from pint_tpu.io.fits import add_column, get_bintable, write_event_fits
+
+    path = str(tmp_path / "ev.fits")
+    time = np.linspace(0.0, 1000.0, 50)
+    pi = np.arange(50, dtype=np.int32)
+    write_event_fits(
+        path, {"TIME": time, "PI": pi},
+        header_extra={"MJDREFI": 56000, "MJDREFF": 0.25,
+                      "TIMEZERO": 0.0, "TIMESYS": "TDB",
+                      "TELESCOP": "NICER"},
+    )
+    hdu = get_bintable(path)
+    assert hdu.name == "EVENTS"
+    np.testing.assert_allclose(hdu.column("TIME"), time, rtol=1e-15)
+    np.testing.assert_array_equal(hdu.column("PI"), pi)
+    assert hdu.header["MJDREFI"] == 56000
+    assert hdu.header["TIMESYS"] == "TDB"
+    out = str(tmp_path / "ev2.fits")
+    add_column(path, out, "PULSE_PHASE", np.linspace(0, 1, 50))
+    h2 = get_bintable(out)
+    assert "PULSE_PHASE" in h2.columns()
+    np.testing.assert_allclose(
+        h2.column("TIME"), time, rtol=1e-15
+    )
+
+
+def test_event_toas_and_photonphase(tmp_path, parfile, capsys):
+    from pint_tpu.event_toas import load_event_TOAs
+    from pint_tpu.io.fits import get_bintable, write_event_fits
+    from pint_tpu.scripts.photonphase import main as photonphase
+
+    # synthesize pulsed barycentric events from the model itself:
+    # uniform times, keep photons near model phase 0.3
+    m = get_model(parfile)
+    rng = np.random.default_rng(7)
+    met = np.sort(rng.uniform(0, 2000.0, 6000))
+    mjdref = 55000.0
+    path = str(tmp_path / "events.fits")
+    write_event_fits(
+        path, {"TIME": met},
+        header_extra={"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+                      "TIMESYS": "TDB", "TELESCOP": "TEST"},
+    )
+    toas = load_event_TOAs(path)
+    assert len(toas) == 6000
+    assert toas.obs[0] == "@"
+    np.testing.assert_allclose(
+        toas.mjd_float(), mjdref + np.sort(met) / 86400.0, rtol=1e-12
+    )
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+    cm = m.compile(toas, subtract_mean=False)
+    phases = np.mod(np.asarray(cm.phase(cm.x0()).frac), 1.0)
+    keep = (
+        rng.uniform(size=len(phases))
+        < 0.15 + np.exp(-0.5 * ((phases - 0.3) / 0.04) ** 2)
+    )
+    write_event_fits(
+        path, {"TIME": met[keep]},
+        header_extra={"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+                      "TIMESYS": "TDB", "TELESCOP": "TEST"},
+    )
+    out = str(tmp_path / "events_phase.fits")
+    assert photonphase([path, parfile, "--outfile", out,
+                        "--log-level", "ERROR"]) == 0
+    cap = capsys.readouterr().out
+    h = float(cap.split("Htest :")[1].split()[0])
+    assert h > 200.0
+    hdu = get_bintable(out)
+    ph_out = hdu.column("PULSE_PHASE")
+    # the written phases must peak near 0.3
+    hist, edges = np.histogram(ph_out, bins=20, range=(0, 1))
+    assert 0.25 < edges[np.argmax(hist)] < 0.35
+
+
+def test_photonphase_uniform_low_h(tmp_path, parfile, capsys):
+    from pint_tpu.io.fits import write_event_fits
+    from pint_tpu.scripts.photonphase import main as photonphase
+
+    rng = np.random.default_rng(1)
+    met = np.sort(rng.uniform(0, 2000.0, 3000))
+    path = str(tmp_path / "uniform.fits")
+    write_event_fits(
+        path, {"TIME": met},
+        header_extra={"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+                      "TIMESYS": "TDB"},
+    )
+    assert photonphase([path, parfile, "--log-level", "ERROR"]) == 0
+    h = float(capsys.readouterr().out.split("Htest :")[1].split()[0])
+    assert h < 30.0
+
+
+def test_logging_dedup(capsys):
+    import pint_tpu.logging as plog
+
+    log = plog.setup("INFO")
+    for _ in range(5):
+        log.warning("repeated clock warning about site xyz")
+    log.warning("a different message")
+    err = capsys.readouterr().err
+    assert err.count("repeated clock warning") == 1
+    assert "a different message" in err
