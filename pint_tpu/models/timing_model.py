@@ -292,7 +292,22 @@ class CompiledModel:
     def _pdict(self, x):
         pd = {}
         for n, v in self.ref.items():
-            if isinstance(v, HostDD):
+            if isinstance(v, DD):
+                # device-typed reference (PTA batching swaps per-pulsar
+                # refs in as traced values)
+                if n in self._index:
+                    pd[n] = (v + x[self._index[n]]).normalize()
+                else:
+                    pd[n] = v
+            elif (
+                isinstance(v, tuple) and len(v) == 2
+                and isinstance(v[1], DD)
+            ):
+                day, sec = v  # device-typed epoch (day, DD seconds)
+                if n in self._index:
+                    sec = (sec + x[self._index[n]]).normalize()
+                pd[n] = (day, sec)
+            elif isinstance(v, HostDD):
                 const = DD(jnp.float64(float(v.hi)), jnp.float64(float(v.lo)))
                 if n in self._index:
                     pd[n] = (const + x[self._index[n]]).normalize()
@@ -312,7 +327,10 @@ class CompiledModel:
             elif isinstance(v, tuple):
                 # pairParameter (sin, cos amplitudes): static floats
                 pd[n] = v
-            elif isinstance(v, (float, int)):
+            elif isinstance(v, (float, int)) or (
+                hasattr(v, "dtype") and getattr(v, "ndim", None) == 0
+            ):
+                # host float OR a traced/device f64 scalar (PTA batch)
                 if n in self._index:
                     pd[n] = jnp.float64(v) + x[self._index[n]]
                 else:

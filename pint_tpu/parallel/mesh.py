@@ -47,6 +47,20 @@ def _pad_to(n: int, k: int) -> int:
     return (n + k - 1) // k * k
 
 
+def pad_axis0(tree, cur: int, pad: int):
+    """Pad every leaf whose leading axis is `cur` by repeating its last
+    row `pad` times (shared by TOA-axis sharding and PTA batching)."""
+
+    def padleaf(x):
+        if isinstance(x, jnp.ndarray) and x.ndim >= 1 and x.shape[0] == cur:
+            return jnp.concatenate(
+                [x, jnp.repeat(x[-1:], pad, axis=0)], axis=0
+            )
+        return x
+
+    return jax.tree_util.tree_map(padleaf, tree)
+
+
 def pad_bundle(bundle: TOABundle, multiple: int) -> tuple[TOABundle, np.ndarray]:
     """Pad the TOA axis to a multiple of the shard count.
 
@@ -60,15 +74,7 @@ def pad_bundle(bundle: TOABundle, multiple: int) -> tuple[TOABundle, np.ndarray]
     if m == n:
         return bundle, np.ones(n)
     pad = m - n
-
-    def padleaf(x):
-        if isinstance(x, jnp.ndarray) and x.ndim >= 1 and x.shape[0] == n:
-            return jnp.concatenate(
-                [x, jnp.repeat(x[-1:], pad, axis=0)], axis=0
-            )
-        return x
-
-    new = jax.tree_util.tree_map(padleaf, bundle)
+    new = pad_axis0(bundle, n, pad)
     valid = np.concatenate([np.ones(n), np.zeros(pad)])
     return new, valid
 

@@ -1,0 +1,222 @@
+"""PTA-scale batching: vmap/shard the pulsar axis.
+
+SURVEY.md §7 step 8 / BASELINE.json config 5: fit tens of pulsars as one
+batched device computation.  The reference has no batch axis at all
+(one Python process per pulsar); here the pulsar axis is a leading vmap
+axis over the same compiled kernels, sharded across the mesh's
+'pulsar' axis while each pulsar's TOA axis rides 'toa'
+(parallel.mesh.make_mesh).
+
+Requirements for stacking: the pulsars must share a model composition
+(same free-parameter layout, same mask keys, same noise-basis column
+count — the common case for survey-uniform PTA data); TOA counts may
+differ (padding with ~infinite-error TOAs that carry zero weight).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.fitting.base import design_with_offset, noffset
+from pint_tpu.fitting.gls import gls_step_woodbury
+from pint_tpu.ops.dd import DD
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.toas.bundle import TOABundle
+
+# padded TOAs get this uncertainty (us): weight ~ 1e-48 of a real TOA
+PAD_ERROR_US = 1e18
+
+
+def pad_bundle_to(bundle: TOABundle, n: int) -> TOABundle:
+    """Pad the TOA axis to length n by repeating the last TOA with
+    ~infinite error (zero statistical weight)."""
+    from pint_tpu.parallel.mesh import pad_axis0
+
+    cur = bundle.ntoa
+    if cur == n:
+        return bundle
+    if cur > n:
+        raise PintTpuError(f"cannot pad {cur} TOAs down to {n}")
+    pad = n - cur
+    out = pad_axis0(bundle, cur, pad)
+    return out._replace(
+        error_us=jnp.concatenate(
+            [bundle.error_us, jnp.full(pad, PAD_ERROR_US)]
+        )
+    )
+
+
+def _device_ref(cm):
+    """Split a CompiledModel's host reference values into (numeric
+    device pytree, static host dict).  The numeric part is what differs
+    per pulsar and gets stacked/vmapped; strings/bools stay static."""
+    num, static = {}, {}
+    for n, v in cm.ref.items():
+        if isinstance(v, HostDD):
+            num[n] = DD(jnp.float64(float(v.hi)), jnp.float64(float(v.lo)))
+        elif (
+            isinstance(v, tuple) and len(v) == 2
+            and isinstance(v[1], HostDD)
+        ):
+            day, sec = v
+            num[n] = (
+                jnp.float64(float(day)),
+                DD(jnp.float64(float(sec.hi)), jnp.float64(float(sec.lo))),
+            )
+        elif isinstance(v, tuple):
+            num[n] = tuple(jnp.float64(float(e)) for e in v)
+        elif isinstance(v, (float, int)) and not isinstance(v, bool):
+            num[n] = jnp.float64(v)
+        else:
+            static[n] = v
+    return num, static
+
+
+class PTABatch:
+    """A pulsar-axis batch over per-pulsar CompiledModels."""
+
+    def __init__(self, cms: list):
+        if not cms:
+            raise PintTpuError("empty PTA batch")
+        names = cms[0].free_names
+        for cm in cms[1:]:
+            if cm.free_names != names:
+                raise PintTpuError(
+                    "PTA batch needs identical free-parameter layouts: "
+                    f"{names} vs {cm.free_names}"
+                )
+            if set(cm.bundle.masks) != set(cms[0].bundle.masks):
+                raise PintTpuError("PTA batch needs identical mask keys")
+        self.cms = cms
+        self.free_names = names
+        self.npulsars = len(cms)
+        nmax = max(cm.bundle.ntoa for cm in cms)
+        padded = [pad_bundle_to(cm.bundle, nmax) for cm in cms]
+        self.bundle = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *padded
+        )
+        self.ntoa = nmax
+        self._proto = cms[0]
+        # per-pulsar reference parameter values become batched data
+        # (each pulsar's x is a delta from ITS OWN par-file values)
+        refs = [_device_ref(cm) for cm in cms]
+        num_keys = set(refs[0][0])
+        for num, static in refs[1:]:
+            if set(num) != num_keys:
+                raise PintTpuError(
+                    "PTA batch needs identical numeric parameter sets"
+                )
+            if static != refs[0][1]:
+                raise PintTpuError(
+                    "PTA batch needs identical static (string/bool) "
+                    f"parameters: {static} vs {refs[0][1]}"
+                )
+        self._static_ref = refs[0][1]
+        self.ref = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[num for num, _ in refs]
+        )
+        # kernels read structural knobs (harmonic counts, epoch
+        # quantization) off the HOST model at trace time; the basis
+        # COLUMN structure must therefore agree across pulsars or the
+        # prototype's structure would silently replace each pulsar's
+        # own (the TOA axis differs pre-padding and is fine)
+        def basis_cols(cm):
+            T, phi = jax.eval_shape(
+                cm.noise_basis_or_empty, jnp.zeros(len(names))
+            )
+            return T.shape[1:], phi.shape
+        k0 = basis_cols(cms[0])
+        for i, cm in enumerate(cms[1:], start=1):
+            ki = basis_cols(cm)
+            if ki != k0:
+                raise PintTpuError(
+                    "PTA batch needs identical noise-basis structure "
+                    f"(pulsar 0: {k0}, pulsar {i}: {ki}) — match TNREDC"
+                    " / ECORR epoch structures across pulsars"
+                )
+
+    # -- batched kernels --------------------------------------------------
+    def _with_state(self, fn):
+        """Run a CompiledModel method with a per-pulsar bundle + ref
+        swapped into the prototype (the kernels read both off the
+        instance; the swap happens at trace time under vmap)."""
+        proto = self._proto
+
+        def call(bundle, ref, *args):
+            saved_b, saved_r = proto.bundle, proto.ref
+            proto.bundle = bundle
+            proto.ref = {**self._static_ref, **ref}
+            try:
+                return fn(proto, *args)
+            finally:
+                proto.bundle = saved_b
+                proto.ref = saved_r
+
+        return call
+
+    def x0(self):
+        return jnp.zeros(
+            (self.npulsars, len(self.free_names)), dtype=jnp.float64
+        )
+
+    def residuals(self, xs):
+        """(P, n) time residuals."""
+        call = self._with_state(
+            lambda cm, x: cm.time_residuals(x, subtract_mean=False)
+        )
+        return jax.vmap(call)(self.bundle, self.ref, xs)
+
+    def chi2(self, xs):
+        call = self._with_state(lambda cm, x: cm.chi2(x))
+        return jax.vmap(call)(self.bundle, self.ref, xs)
+
+    def fit_step(self, xs):
+        """One batched GLS Gauss-Newton step for every pulsar:
+        -> (new xs (P, p), chi2 (P,), cov (P, p, p))."""
+        no = noffset(self._proto)
+
+        def single(cm, x):
+            r = cm.time_residuals(x, subtract_mean=False)
+            M = design_with_offset(cm, x)
+            Ndiag = jnp.square(cm.scaled_sigma(x))
+            T, phi = cm.noise_basis_or_empty(x)
+            dx, cov, chi2, _ = gls_step_woodbury(r, M, Ndiag, T, phi)
+            return x + dx[no:], chi2, cov[no:, no:]
+
+        call = self._with_state(single)
+        return jax.vmap(call)(self.bundle, self.ref, xs)
+
+    def fit(self, maxiter: int = 3):
+        """Iterated batched fit; returns (xs, chi2 (P,))."""
+        if maxiter < 1:
+            raise PintTpuError("PTABatch.fit needs maxiter >= 1")
+        step = jax.jit(self.fit_step)
+        xs = self.x0()
+        chi2 = None
+        for _ in range(maxiter):
+            xs, chi2, cov = step(xs)
+        self.cov = cov
+        return xs, chi2
+
+    def commit(self, xs):
+        """Fold fitted deltas back into each pulsar's host model."""
+        for cm, x in zip(self.cms, np.asarray(xs)):
+            cm.commit(x)
+
+    def shard(self, mesh):
+        """Place the batch across the mesh: pulsar axis on 'pulsar',
+        TOA axis on 'toa'."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(x):
+            if isinstance(x, jnp.ndarray) and x.ndim >= 2 and \
+                    x.shape[0] == self.npulsars:
+                spec = ("pulsar", "toa") + (None,) * (x.ndim - 2)
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+            return x
+
+        self.bundle = jax.tree_util.tree_map(place, self.bundle)
+        return self

@@ -71,6 +71,42 @@ def _ingest(toas: TOAs, model: TimingModel):
         ingest_for_model(toas, model)
 
 
+def make_test_pulsar(
+    par: str,
+    ntoa: int = 64,
+    start_mjd: float = 54000.0,
+    end_mjd: float = 56000.0,
+    seed: int = 0,
+    jitter_us: float = 1.0,
+    freqs=(1400.0, 800.0),
+    flags=("L-wide", "S-wide"),
+    obs: str = "@",
+    error_us: float = 1.0,
+    iterations: int = 3,
+):
+    """Simulated pulsar scaffold shared by benches, smoke runs, and
+    tests: build the model, simulate TOAs cycling over observing
+    frequencies, tag alternating receiver flags (for mask params), add
+    white jitter, ingest.  Returns (model, toas)."""
+    from pint_tpu.models.builder import get_model
+
+    rng = np.random.default_rng(seed)
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        start_mjd, end_mjd, ntoa, model, error_us=error_us,
+        freq_mhz=np.resize(np.asarray(freqs, dtype=np.float64), ntoa),
+        obs=obs, iterations=iterations,
+    )
+    for i, f in enumerate(toas.flags):
+        f["f"] = flags[i % len(flags)]
+    if jitter_us:
+        toas.t = toas.t.add_seconds(
+            rng.normal(0.0, jitter_us * 1e-6, ntoa)
+        )
+    _ingest(toas, model)
+    return model, toas
+
+
 def calculate_random_models(
     fitter, n_models: int = 100, rng: Optional[np.random.Generator] = None
 ):

@@ -47,11 +47,15 @@ class TOABundle(NamedTuple):
     def dt_seconds(self, epoch_day, epoch_sec) -> DD:
         """(t_tdb - epoch) in DD seconds.
 
-        epoch_day: static int/float (exact day number); epoch_sec: static
-        float or DD scalar seconds-of-day.  The day-difference product is
-        exact in f64 (|ddays*86400| < 2^53 for any realistic span).
+        epoch_day: exact day number — static int/float, or a traced f64
+        scalar (PTA batching); epoch_sec: static float or DD scalar
+        seconds-of-day.  The day-difference product is exact in f64
+        (|ddays*86400| < 2^53 for any realistic span).
         """
-        ddays = self.tdb_day - float(epoch_day)
+        ddays = self.tdb_day - (
+            float(epoch_day)
+            if isinstance(epoch_day, (int, float)) else epoch_day
+        )
         big = DD.from_prod(ddays, 86400.0)
         return big + (self.tdb_sec - epoch_sec)
 
