@@ -1,0 +1,114 @@
+"""Native (C++) host kernels: build-on-first-use + ctypes bindings.
+
+The shared library is compiled from pint_native.cpp with the system g++
+on first import (cached next to the source, keyed on source mtime) and
+loaded via ctypes — no pybind11/build-isolation dependency.  Every
+entry point has a pure-Python fallback; ``available()`` reports whether
+the native path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("pint_native.cpp")
+_LIB = Path(__file__).with_name("_pint_native.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [
+        cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        warnings.warn(
+            f"building pint_native failed ({e}); using the pure-Python "
+            "ingest paths"
+        )
+        return False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("PINT_TPU_NO_NATIVE"):
+        return None
+    try:
+        if (
+            not _LIB.exists()
+            or _LIB.stat().st_mtime < _SRC.stat().st_mtime
+        ):
+            if not _build():
+                return None
+        lib = ctypes.CDLL(str(_LIB))
+    except OSError as e:
+        warnings.warn(f"loading pint_native failed ({e})")
+        return None
+    lib.parse_mjd_strings.restype = ctypes.c_int64
+    lib.parse_mjd_strings.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.float64, flags="C"),
+        np.ctypeslib.ndpointer(np.float64, flags="C"),
+    ]
+    lib.native_self_test.restype = ctypes.c_int64
+    lib.native_self_test.argtypes = []
+    if lib.native_self_test() != 0:
+        warnings.warn(
+            "pint_native self-test failed; using pure-Python paths"
+        )
+        return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_mjd_strings(strings):
+    """Batched exact decimal MJD parse (pulsar_mjd convention):
+    -> (day int64 (n,), sec_hi (n,), sec_lo (n,)) or None when the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        enc = [s.strip().encode("ascii") for s in strings]
+    except UnicodeEncodeError as e:
+        raise ValueError(f"non-ASCII character in MJD string: {e}") from e
+    n = len(enc)
+    buf = b"".join(enc)
+    lengths = np.array([len(e) for e in enc], dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]]).astype(
+        np.int64
+    )
+    day = np.empty(n, dtype=np.int64)
+    hi = np.empty(n, dtype=np.float64)
+    lo = np.empty(n, dtype=np.float64)
+    rc = lib.parse_mjd_strings(buf, offsets, lengths, n, day, hi, lo)
+    if rc != 0:
+        raise ValueError(
+            f"bad MJD string at index {rc - 1}: {strings[rc - 1]!r}"
+        )
+    return day, hi, lo

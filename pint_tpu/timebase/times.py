@@ -119,6 +119,23 @@ class TimeArray:
         """Exact parse of decimal MJD strings (tim-file convention)."""
         if isinstance(strings, str):
             strings = [strings]
+        strings = list(strings)
+        if format not in ("pulsar_mjd", "mjd"):
+            raise PintTpuError(f"unknown MJD format {format!r}")
+        if format == "pulsar_mjd" or scale != "utc":
+            # native batched parse (C++ double-double); identical
+            # convention: fraction of an 86400 s day.  Errors are
+            # normalized to PintTpuError so callers see the same
+            # exception type with or without the native library.
+            from pint_tpu import native
+
+            try:
+                parsed = native.parse_mjd_strings(strings)
+            except ValueError as e:
+                raise PintTpuError(str(e)) from e
+            if parsed is not None:
+                day, hi, lo = parsed
+                return TimeArray(day, HostDD(hi, lo), scale)
         ints, fracs = [], []
         for s in strings:
             s = s.strip()
@@ -130,8 +147,6 @@ class TimeArray:
             fracs.append("0." + (fpart or "0"))
         mjd_int = np.array(ints, dtype=np.int64)
         frac = HostDD.from_string(fracs)
-        if format not in ("pulsar_mjd", "mjd"):
-            raise PintTpuError(f"unknown MJD format {format!r}")
         if format == "mjd" and scale == "utc":
             day_len = np.where(
                 is_leap_second_day(mjd_int), SECS_PER_DAY + 1, SECS_PER_DAY

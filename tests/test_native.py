@@ -1,0 +1,89 @@
+"""Native (C++) kernel tests: build, exactness vs the Python Decimal
+path, error handling, and the end-to-end tim-load equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu import native
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.timebase.times import TimeArray
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def test_native_parse_matches_decimal_path():
+    rng = np.random.default_rng(0)
+    strings = ["51544", "55000.5", "55000.", "  58000.123  "]
+    for _ in range(200):
+        day = rng.integers(40000, 60000)
+        ndig = rng.integers(1, 20)
+        frac = "".join(rng.choice(list("0123456789"), ndig))
+        strings.append(f"{day}.{frac}")
+    day, hi, lo = native.parse_mjd_strings(strings)
+    for i, s in enumerate(strings):
+        s = s.strip()
+        ip, _, fp = s.partition(".")
+        assert day[i] == int(ip)
+        ref = HostDD.from_string("0." + (fp or "0")) * 86400.0
+        got = HostDD(hi[i], lo[i])
+        # agreement far below the ns level (~1e-27 s)
+        diff = abs(
+            (float(got.hi) - float(ref.hi)) + (float(got.lo) - float(ref.lo))
+        )
+        assert diff < 1e-24, (s, diff)
+
+
+def test_native_parse_bit_exact_hi():
+    """The hi word must be the correctly-rounded double for every
+    input (the lo word may differ by ~1e-32 relative)."""
+    strings = [f"{55000 + i}.{'0123456789' * 1}" for i in range(50)]
+    day, hi, lo = native.parse_mjd_strings(strings)
+    for i, s in enumerate(strings):
+        _, _, fp = s.partition(".")
+        ref = HostDD.from_string("0." + fp) * 86400.0
+        assert hi[i] == float(ref.hi), s
+
+
+def test_native_parse_rejects_bad_strings():
+    with pytest.raises(ValueError, match="index 1"):
+        native.parse_mjd_strings(["55000.5", "-100.2"])
+    with pytest.raises(ValueError):
+        native.parse_mjd_strings(["55000.5x"])
+    with pytest.raises(ValueError):
+        native.parse_mjd_strings([""])
+    with pytest.raises(ValueError):  # int64-overflow guard
+        native.parse_mjd_strings(["9999999999999999999.5"])
+    with pytest.raises(ValueError, match="ASCII"):
+        native.parse_mjd_strings(["−55000.5"])
+
+
+def test_from_mjd_strings_error_types_match_python():
+    """Error surface must be environment-independent: PintTpuError for
+    bad input and unknown formats, native lib or not."""
+    from pint_tpu.exceptions import PintTpuError
+
+    with pytest.raises(PintTpuError):
+        TimeArray.from_mjd_strings(["-100.2"])
+    with pytest.raises(PintTpuError, match="format"):
+        TimeArray.from_mjd_strings(["55000.5"], scale="tdb", format="mdj")
+
+
+def test_from_mjd_strings_uses_native_and_matches(monkeypatch):
+    strings = ["55000.0000116", "56123.999999999999"]
+    t_native = TimeArray.from_mjd_strings(strings)
+    monkeypatch.setenv("PINT_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    t_python = TimeArray.from_mjd_strings(strings)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    np.testing.assert_array_equal(t_native.mjd_int, t_python.mjd_int)
+    np.testing.assert_allclose(
+        t_native.sec.hi, t_python.sec.hi, rtol=0, atol=0
+    )
+    np.testing.assert_allclose(
+        t_native.sec.lo, t_python.sec.lo, rtol=0, atol=1e-24
+    )
