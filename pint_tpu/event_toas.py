@@ -51,8 +51,14 @@ def load_event_TOAs(
     mission: str = "generic",
     energy_range=None,
     errors_us: float = 0.0,
+    weightcol: str = None,
 ) -> TOAs:
-    """Event FITS -> TOAs (one per photon)."""
+    """Event FITS -> TOAs (one per photon).
+
+    weightcol: photon-weight column; weights ride in each TOA's flags
+    (key 'weight') so they stay aligned through the time sort and any
+    later subsetting.
+    """
     cfg = MISSIONS.get(mission.lower())
     if cfg is None:
         raise PintTpuError(
@@ -61,6 +67,10 @@ def load_event_TOAs(
     hdu = get_bintable(path, cfg["extname"])
     hdr = hdu.header
     met = np.asarray(hdu.column(cfg["timecol"]), dtype=np.float64)
+    weights = (
+        np.asarray(hdu.column(weightcol), dtype=np.float64)
+        if weightcol else None
+    )
     if energy_range is not None and "PI" in [
         c.upper() for c in hdu.columns()
     ]:
@@ -68,6 +78,8 @@ def load_event_TOAs(
         lo, hi = energy_range
         keep = (pi >= lo) & (pi <= hi)
         met = met[keep]
+        if weights is not None:
+            weights = weights[keep]
     mjdref = _mjdref(hdr)
     timezero = float(hdr.get("TIMEZERO", 0.0))
     timesys = str(hdr.get("TIMESYS", "TT")).upper()
@@ -90,18 +102,27 @@ def load_event_TOAs(
         # TOAs store UTC for topocentric sites; convert once here
         t = t.to_scale("utc")
     n = len(sec)
+    flags = [{"photon": "1", "mission": mission} for _ in range(n)]
+    if weights is not None:
+        for f, w in zip(flags, weights):
+            f["weight"] = repr(float(w))
     toas = TOAs(
         t,
         np.full(n, np.inf),  # photons: infinite frequency (no DM)
         np.full(n, errors_us),
         [site] * n,
-        [
-            {"photon": "1", "mission": mission}
-            for _ in range(n)
-        ],
+        flags,
     )
     toas.sort()
     return toas
+
+
+def get_event_weights(toas: TOAs):
+    """Per-photon weights from the 'weight' flags, or None."""
+    vals = toas.get_flag_value("weight", None)
+    if any(v is None for v in vals):
+        return None
+    return np.array([float(v) for v in vals])
 
 
 def load_fermi_TOAs(path, **kw) -> TOAs:

@@ -59,3 +59,31 @@ class Residuals:
         r = self.time_resids
         w = 1.0 / np.asarray(self.cm.scaled_sigma(self._x)) ** 2
         return float(np.sqrt(np.sum(w * r * r) / np.sum(w)))
+
+
+class CombinedResiduals:
+    """Concatenation of residual objects from independent data sets
+    (reference: residuals.py::CombinedResiduals — the chi2s add; the
+    unit-heterogeneous residual lists stay per-member)."""
+
+    def __init__(self, residual_list):
+        self.residual_objs = list(residual_list)
+
+    @property
+    def chi2(self) -> float:
+        return float(sum(r.chi2 for r in self.residual_objs))
+
+    @property
+    def dof(self) -> int:
+        # AttributeError surfaces for members without a dof notion
+        # (e.g. wideband pairs) rather than silently summing zeros
+        return int(sum(r.dof for r in self.residual_objs))
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    def __len__(self):
+        return sum(
+            len(getattr(r, "toas", [])) for r in self.residual_objs
+        )

@@ -1,0 +1,117 @@
+"""event_optimize: MCMC fit of a timing model to photon phases.
+
+Reference parity: src/pint/scripts/event_optimize.py — maximize the
+unbinned template likelihood sum(log f(phi_i(x))) over the model's free
+parameters with an ensemble sampler.  TPU-first: the per-photon phase
+kernel and the template density are one jitted pure function of the
+delta vector x, vmapped across walkers by pint_tpu.sampler.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import pint_tpu.logging as plog
+
+
+def build_lnpost(cm, template, weights=None):
+    """Photon-template log-posterior over parameter deltas x."""
+    import jax.numpy as jnp
+
+    tpar = jnp.asarray(template.get_parameters())
+    w = None if weights is None else jnp.asarray(weights)
+
+    def lnpost(x):
+        phases = jnp.mod(cm.phase(x).frac, 1.0)
+        f = template(phases, params=tpar)
+        if w is None:
+            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+        return jnp.sum(
+            jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300))
+        )
+
+    return lnpost
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="MCMC-fit a timing model to photon phases"
+    )
+    ap.add_argument("eventfile")
+    ap.add_argument("parfile")
+    ap.add_argument("gaussianfile",
+                    help="template: 'weight:width:loc' peaks, one per line")
+    ap.add_argument("--mission", default="generic")
+    ap.add_argument("--weightcol", default=None)
+    ap.add_argument("--nwalkers", type=int, default=32)
+    ap.add_argument("--nsteps", type=int, default=500)
+    ap.add_argument("--burnin", type=float, default=0.25)
+    ap.add_argument("--outfile", default="event_optimize_post.par")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+    log = plog.setup(args.log_level)
+
+    from pint_tpu.event_toas import get_event_weights, load_event_TOAs
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.sampler import run_ensemble
+    from pint_tpu.templates import LCGaussian, LCTemplate
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    model = get_model(args.parfile)
+    toas = load_event_TOAs(
+        args.eventfile, mission=args.mission, weightcol=args.weightcol
+    )
+    ingest_for_model(toas, model)
+    cm = model.compile(toas, subtract_mean=False)
+    log.info(
+        "loaded %d photons; free params %s", len(toas), cm.free_names
+    )
+
+    prims, wts = [], []
+    with open(args.gaussianfile) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            wt, width, loc = (float(v) for v in line.split(":"))
+            prims.append(LCGaussian(width=width, loc=loc))
+            wts.append(wt)
+    template = LCTemplate(prims, weights=wts)
+    weights = get_event_weights(toas)
+
+    lnpost = build_lnpost(cm, template, weights)
+    # seed the walker ball at the scale where each parameter shifts the
+    # mean photon phase by ~0.05 cycles
+    import jax
+
+    g = np.asarray(
+        jax.grad(lambda x: cm.phase(x).frac.mean())(cm.x0())
+    )
+    scales = 0.05 / np.maximum(np.abs(g), 1e-30)
+    chain, lnp, acc = run_ensemble(
+        lnpost, np.zeros(cm.nfree), nwalkers=args.nwalkers,
+        nsteps=args.nsteps, seed=args.seed, init_scale=scales,
+    )
+    log.info("acceptance %.3f", acc)
+    nburn = int(args.burnin * len(chain))
+    flat = chain[nburn:].reshape(-1, cm.nfree)
+    med = np.median(flat, axis=0)
+    std = np.std(flat, axis=0)
+    cm.commit(med, uncertainties=std)
+    i, j = np.unravel_index(np.argmax(lnp), lnp.shape)
+    print(f"max log-likelihood: {float(lnp[i, j]):.2f}  "
+          f"acceptance {acc:.3f}")
+    for n in cm.free_names:
+        p = model.params[n]
+        print(f"  {n:<10} {p._format_value()} +- {p.uncertainty:.3e}")
+    with open(args.outfile, "w") as f:
+        f.write(model.as_parfile())
+    log.info("wrote %s", args.outfile)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,130 @@
+"""TOA ingest cache: skip the clock/TDB/posvel pipeline on reload.
+
+Reference parity: src/pint/toa.py get_TOAs(usepickle=True) — the
+reference writes <tim>.pickle.gz keyed by a content hash.  Here the
+ingested columns are saved as a .npz next to the tim file (or in
+$PINT_TPU_CACHE_DIR), keyed on the tim bytes + ingest options hash;
+double-double columns round-trip exactly (hi/lo pairs).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.timebase.times import TimeArray
+from pint_tpu.toas.toas import TOAs
+from pint_tpu.utils import compute_hash
+
+_FORMAT_VERSION = 1
+
+
+def _cache_path(tim_path) -> Path:
+    cdir = os.environ.get("PINT_TPU_CACHE_DIR")
+    p = Path(tim_path)
+    if cdir:
+        return Path(cdir) / (p.name + ".ingest.npz")
+    return p.with_name(p.name + ".ingest.npz")
+
+
+def _options_key(tim_path, **options) -> str:
+    return compute_hash(
+        tim_path, _FORMAT_VERSION, sorted(options.items())
+    )
+
+
+def save_cache(toas: TOAs, tim_path, **options):
+    """Write the ingested TOA columns keyed on tim content + options."""
+    arrs = {
+        "key": np.array(_options_key(tim_path, **options)),
+        "t_day": toas.t.mjd_int, "t_hi": toas.t.sec.hi,
+        "t_lo": toas.t.sec.lo, "t_scale": np.array(toas.t.scale),
+        "freq": toas.freq, "error_us": toas.error_us,
+        "obs": np.array(toas.obs),
+        "flags": np.array(
+            [repr(sorted(f.items())) for f in toas.flags]
+        ),
+    }
+    if toas.t_tdb is not None:
+        arrs.update(
+            tdb_day=toas.t_tdb.mjd_int, tdb_hi=toas.t_tdb.sec.hi,
+            tdb_lo=toas.t_tdb.sec.lo,
+        )
+    for col in (
+        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
+        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
+    ):
+        v = getattr(toas, col)
+        if v is not None:
+            arrs[col] = v
+    for body, v in toas.obs_planet_pos.items():
+        arrs[f"planet:{body}"] = v
+    np.savez_compressed(_cache_path(tim_path), **arrs)
+
+
+def load_cache(tim_path, **options) -> Optional[TOAs]:
+    """Ingested TOAs from cache, or None on miss/stale key."""
+    path = _cache_path(tim_path)
+    if not path.exists():
+        return None
+    try:
+        z = np.load(path, allow_pickle=False)
+    except (OSError, ValueError):
+        return None
+    if str(z["key"]) != _options_key(tim_path, **options):
+        return None
+    import ast
+
+    flags = [
+        dict(ast.literal_eval(s)) for s in z["flags"].tolist()
+    ]
+    t = TimeArray(
+        z["t_day"], HostDD(z["t_hi"], z["t_lo"]), str(z["t_scale"])
+    )
+    toas = TOAs(t, z["freq"], z["error_us"], z["obs"].tolist(), flags)
+    if "tdb_day" in z:
+        toas.t_tdb = TimeArray(
+            z["tdb_day"], HostDD(z["tdb_hi"], z["tdb_lo"]), "tdb"
+        )
+    for col in (
+        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
+        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
+    ):
+        if col in z:
+            setattr(toas, col, z[col])
+    for name in z.files:
+        if name.startswith("planet:"):
+            toas.obs_planet_pos[name.split(":", 1)[1]] = z[name]
+    return toas
+
+
+def get_TOAs(
+    tim_path,
+    model=None,
+    usepickle: bool = False,
+    **ingest_kw,
+) -> TOAs:
+    """tim file -> ingested TOAs, with optional caching (the
+    reference's get_TOAs(usepickle=...) surface)."""
+    from pint_tpu.io.tim import get_TOAs_from_tim
+    from pint_tpu.toas.ingest import ingest, ingest_for_model
+
+    opts = dict(ingest_kw)
+    if model is not None:
+        opts["model_par"] = model.as_parfile()
+    if usepickle:
+        cached = load_cache(tim_path, **opts)
+        if cached is not None:
+            return cached
+    toas = get_TOAs_from_tim(tim_path)
+    if model is not None:
+        ingest_for_model(toas, model, **ingest_kw)
+    else:
+        ingest(toas, **ingest_kw)
+    if usepickle:
+        save_cache(toas, tim_path, **opts)
+    return toas

@@ -6,3 +6,14 @@ from pint_tpu.utils.angles import (  # noqa: F401
     format_angle_hms,
     format_angle_dms,
 )
+from pint_tpu.utils.misc import (  # noqa: F401
+    compute_hash,
+    dmx_ranges_from_toas,
+    dmxparse,
+    lines_of,
+    open_or_use,
+    split_intervals,
+    taylor_horner,
+    taylor_horner_deriv,
+    weighted_mean,
+)

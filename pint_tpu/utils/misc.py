@@ -1,0 +1,117 @@
+"""Utility grab-bag (reference parity: src/pint/utils.py).
+
+The reference's utils.py is ~3000 LoC; the numerics pieces
+(taylor_horner, PosVel algebra) live in pint_tpu.ops / the geometry
+columns here, so this module carries the host-side helpers: weighted
+statistics, DMX summaries (dmxparse), observing-epoch interval
+splitting, content hashing for caches, and file-or-object opening.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.ops.taylor import taylor_horner, taylor_horner_deriv  # noqa: F401
+
+
+def weighted_mean(values, errors, dof: bool = False):
+    """Weighted mean and its uncertainty; optionally the reduced chi2
+    about the mean (reference: utils.weighted_mean)."""
+    v = np.asarray(values, dtype=np.float64)
+    w = 1.0 / np.square(np.asarray(errors, dtype=np.float64))
+    mean = np.sum(w * v) / np.sum(w)
+    err = 1.0 / np.sqrt(np.sum(w))
+    if not dof:
+        return mean, err
+    chi2 = np.sum(w * (v - mean) ** 2) / max(len(v) - 1, 1)
+    return mean, err, chi2
+
+
+def split_intervals(mjds, gap_days: float = 0.5):
+    """Split sorted MJDs into observing-epoch groups at gaps
+    (reference: the interval splitters backing DMX range suggestions).
+    Returns a list of (start_idx, end_idx) half-open index pairs."""
+    mjds = np.asarray(mjds, dtype=np.float64)
+    order = np.argsort(mjds)
+    s = mjds[order]
+    breaks = np.flatnonzero(np.diff(s) > gap_days) + 1
+    bounds = np.concatenate([[0], breaks, [len(s)]])
+    return [
+        (int(bounds[i]), int(bounds[i + 1]))
+        for i in range(len(bounds) - 1)
+    ]
+
+
+def dmx_ranges_from_toas(toas, gap_days: float = 15.0, pad_days=0.1):
+    """Suggest (DMXR1, DMXR2) MJD ranges covering the TOAs (reference:
+    utils.dmx_ranges / dmx_setup workflows)."""
+    mjd = toas.mjd_float()
+    out = []
+    for i0, i1 in split_intervals(np.sort(mjd), gap_days):
+        s = np.sort(mjd)
+        out.append((s[i0] - pad_days, s[i1 - 1] + pad_days))
+    return out
+
+
+def dmxparse(model) -> dict:
+    """Summarize a fitted DMX model (reference: utils.dmxparse):
+    -> dict with per-range epochs, values, uncertainties, bounds."""
+    comp = model.components.get("DispersionDMX")
+    if comp is None:
+        raise ValueError("model has no DispersionDMX component")
+    idx = comp.dmx_indices
+    r1 = np.array([comp.params[f"DMXR1_{i:04d}"].value for i in idx])
+    r2 = np.array([comp.params[f"DMXR2_{i:04d}"].value for i in idx])
+    val = np.array(
+        [float(comp.params[f"DMX_{i:04d}"].value) for i in idx]
+    )
+    unc = np.array([
+        comp.params[f"DMX_{i:04d}"].uncertainty or np.nan for i in idx
+    ])
+    return {
+        "dmx_index": np.asarray(idx),
+        "dmx_epochs": (r1 + r2) / 2.0,
+        "dmx_r1": r1,
+        "dmx_r2": r2,
+        "dmxs": val,
+        "dmx_verrs": unc,
+        "mean_dmx": float(np.nanmean(val)) if len(val) else np.nan,
+    }
+
+
+def compute_hash(*items) -> str:
+    """Stable content hash for cache keys: file paths hash their bytes;
+    other values hash their repr (reference: utils.compute_hash backing
+    the TOA pickle cache)."""
+    h = hashlib.sha256()
+    for it in items:
+        if isinstance(it, (str, os.PathLike)) and os.path.isfile(it):
+            with open(it, "rb") as f:
+                for block in iter(lambda: f.read(1 << 20), b""):
+                    h.update(block)
+        else:
+            h.update(repr(it).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def open_or_use(obj, mode: str = "r"):
+    """Context manager: open(path) or pass a file object through
+    (reference: utils.open_or_use)."""
+    import contextlib
+
+    if isinstance(obj, (str, os.PathLike)):
+        return open(obj, mode)
+    return contextlib.nullcontext(obj)
+
+
+def lines_of(obj):
+    """Iterate lines of a path, file object, or multi-line string."""
+    if isinstance(obj, str) and "\n" in obj:
+        return io.StringIO(obj)
+    return open_or_use(obj)

@@ -1,0 +1,201 @@
+"""Utils / TOA cache / plots / CombinedResiduals / remaining scripts."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+"""
+
+
+def test_weighted_mean_and_intervals():
+    from pint_tpu.utils import split_intervals, weighted_mean
+
+    m, e = weighted_mean([1.0, 3.0], [1.0, 1.0])
+    assert m == 2.0 and e == pytest.approx(1 / np.sqrt(2))
+    m, e, red = weighted_mean([1.0, 3.0], [1.0, 1.0], dof=True)
+    assert red == pytest.approx(2.0)
+    groups = split_intervals([1.0, 1.1, 5.0, 5.2, 9.0], gap_days=1.0)
+    assert groups == [(0, 2), (2, 4), (4, 5)]
+
+
+def test_dmxparse():
+    from pint_tpu.utils import dmxparse
+
+    par = PAR + """
+DMX_0001 1e-3 1
+DMXR1_0001 54000
+DMXR2_0001 55000
+DMX_0002 -2e-3 1
+DMXR1_0002 55000
+DMXR2_0002 56000
+"""
+    m = get_model(par)
+    out = dmxparse(m)
+    np.testing.assert_allclose(out["dmxs"], [1e-3, -2e-3])
+    np.testing.assert_allclose(out["dmx_epochs"], [54500, 55500])
+    assert out["mean_dmx"] == pytest.approx(-5e-4)
+
+
+def test_compute_hash(tmp_path):
+    from pint_tpu.utils import compute_hash
+
+    p = tmp_path / "a.txt"
+    p.write_text("hello")
+    h1 = compute_hash(str(p), "opts")
+    assert h1 == compute_hash(str(p), "opts")
+    assert h1 != compute_hash(str(p), "other")
+    p.write_text("changed")
+    assert h1 != compute_hash(str(p), "opts")
+
+
+def test_toa_cache_roundtrip(tmp_path, monkeypatch):
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.toas.cache import get_TOAs
+
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    m, toas = make_test_pulsar(PAR, ntoa=30)
+    tim = tmp_path / "c.tim"
+    write_tim_file(str(tim), toas)
+
+    t1 = get_TOAs(str(tim), model=m, usepickle=True)
+    assert (tmp_path / "c.tim.ingest.npz").exists()
+    t2 = get_TOAs(str(tim), model=m, usepickle=True)  # cache hit
+    np.testing.assert_array_equal(t1.t_tdb.mjd_int, t2.t_tdb.mjd_int)
+    np.testing.assert_array_equal(t1.t_tdb.sec.hi, t2.t_tdb.sec.hi)
+    np.testing.assert_array_equal(t1.t_tdb.sec.lo, t2.t_tdb.sec.lo)
+    assert t2.flags[0] == t1.flags[0]
+    # cache must be keyed on the tim content
+    write_tim_file(str(tim), toas[:20])
+    t3 = get_TOAs(str(tim), model=m, usepickle=True)
+    assert len(t3) == 20
+
+
+def test_combined_residuals():
+    from pint_tpu.residuals import CombinedResiduals, Residuals
+
+    m1, t1 = make_test_pulsar(PAR, ntoa=30, seed=1)
+    m2, t2 = make_test_pulsar(PAR, ntoa=20, seed=2)
+    r1, r2 = Residuals(t1, m1), Residuals(t2, m2)
+    c = CombinedResiduals([r1, r2])
+    assert c.chi2 == pytest.approx(r1.chi2 + r2.chi2)
+    assert c.dof == r1.dof + r2.dof
+    assert len(c) == 50
+
+
+def test_plot_utils_smoke(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.plot_utils import (
+        phaseogram,
+        plot_random_models,
+        plot_residuals,
+    )
+    from pint_tpu.residuals import Residuals
+
+    m, toas = make_test_pulsar(PAR, ntoa=40)
+    phaseogram(
+        toas.mjd_float(), np.random.default_rng(0).uniform(size=40),
+        plotfile=str(tmp_path / "pg.png"),
+    )
+    assert (tmp_path / "pg.png").exists()
+    plot_residuals(
+        toas, Residuals(toas, m), plotfile=str(tmp_path / "r.png")
+    )
+    f = WLSFitter(toas, m)
+    f.fit_toas()
+    plot_random_models(f, n_models=5, plotfile=str(tmp_path / "rm.png"))
+    assert (tmp_path / "rm.png").exists()
+
+
+def test_t2binary2pint(tmp_path, capsys):
+    from pint_tpu.scripts.t2binary2pint import main
+
+    par = tmp_path / "t2.par"
+    par.write_text(PAR + """
+BINARY T2
+PB 1.5
+A1 3.2
+TASC 55000.1
+EPS1 1.2e-5
+EPS2 -0.7e-5
+""")
+    out = tmp_path / "pint.par"
+    assert main([str(par), str(out), "--log-level", "ERROR"]) == 0
+    m = get_model(str(out))
+    assert "BinaryELL1" in m.components
+
+
+def test_pintpublish(tmp_path, capsys):
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.scripts.pintpublish import main
+
+    m, toas = make_test_pulsar(PAR, ntoa=40)
+    par = tmp_path / "p.par"
+    par.write_text(PAR)
+    tim = tmp_path / "p.tim"
+    write_tim_file(str(tim), toas)
+    assert main([str(par), str(tim), "--log-level", "ERROR"]) == 0
+    out = capsys.readouterr().out
+    assert "Weighted RMS" in out and "Characteristic age" in out
+    assert main([str(par), str(tim), "--latex",
+                 "--log-level", "ERROR"]) == 0
+    assert "tabular" in capsys.readouterr().out
+
+
+def test_event_optimize_recovers_f0(tmp_path, capsys):
+    """Pulsed photons from truth; start with F0 slightly off; the
+    sampler must move the model back to the true F0."""
+    from pint_tpu.io.fits import write_event_fits
+    from pint_tpu.scripts.event_optimize import main
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    rng = np.random.default_rng(4)
+    m_true = get_model(PAR)
+    met = np.sort(rng.uniform(0, 3000.0, 8000))
+    path = str(tmp_path / "ev.fits")
+    write_event_fits(
+        path, {"TIME": met},
+        header_extra={"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+                      "TIMESYS": "TDB"},
+    )
+    from pint_tpu.event_toas import load_event_TOAs
+
+    toas = load_event_TOAs(path)
+    ingest_barycentric(toas)
+    cm = m_true.compile(toas, subtract_mean=False)
+    phases = np.mod(np.asarray(cm.phase(cm.x0()).frac), 1.0)
+    keep = (
+        rng.uniform(size=len(phases))
+        < 0.1 + np.exp(-0.5 * ((phases - 0.5) / 0.05) ** 2)
+    )
+    write_event_fits(
+        path, {"TIME": met[keep]},
+        header_extra={"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+                      "TIMESYS": "TDB"},
+    )
+    # fit par: F0 off by ~0.3 cycles over the 3000 s span, F0-only
+    par_fit = tmp_path / "fit.par"
+    par_fit.write_text(
+        "PSR J1744-1134\nF0 245.42621968980 1\nPEPOCH 55000\nDM 3.138\n"
+    )
+    gauss = tmp_path / "template.gauss"
+    gauss.write_text("0.5:0.05:0.5\n")
+    out = tmp_path / "post.par"
+    assert main([
+        path, str(par_fit), str(gauss), "--nsteps", "400",
+        "--nwalkers", "16", "--outfile", str(out), "--seed", "1",
+        "--log-level", "ERROR",
+    ]) == 0
+    m_post = get_model(str(out))
+    f0 = float(m_post.params["F0"].value.to_float())
+    # true F0 245.4261196898081; start was off by +1e-4
+    assert abs(f0 - 245.4261196898081) < 3e-5
