@@ -52,12 +52,17 @@ def load_event_TOAs(
     energy_range=None,
     errors_us: float = 0.0,
     weightcol: str = None,
+    site: str = None,
 ) -> TOAs:
     """Event FITS -> TOAs (one per photon).
 
     weightcol: photon-weight column; weights ride in each TOA's flags
     (key 'weight') so they stay aligned through the time sort and any
     later subsetting.
+    site: observatory code override — pass the name registered via
+    observatory.satellite.register_satellite to place the photons at
+    the spacecraft (orbit-table geometry) instead of the defaults
+    ('@' for barycentered TIMESYS=TDB files, '0' geocenter otherwise).
     """
     cfg = MISSIONS.get(mission.lower())
     if cfg is None:
@@ -89,13 +94,19 @@ def load_event_TOAs(
     sec = ref_sec + met + timezero
 
     if timesys == "TDB":
-        site = "@"
+        default_site = "@"
         scale = "tdb"
     elif timesys in ("TT", "UTC"):
-        site = "0"  # geocenter
+        default_site = "0"  # geocenter
         scale = timesys.lower()
     else:
         raise PintTpuError(f"unsupported event TIMESYS {timesys!r}")
+    if site is not None and timesys == "TDB":
+        raise PintTpuError(
+            "site override is for unbarycentered events; this file is "
+            "TIMESYS=TDB (already at the SSB)"
+        )
+    site = site if site is not None else default_site
     t = TimeArray(np.full(len(sec), ref_day, dtype=np.int64), 0.0, scale)
     t = t.add_seconds(sec)
     if scale == "tt":

@@ -69,6 +69,10 @@ class Observatory:
     def is_barycenter(self):
         return False
 
+    @property
+    def is_satellite(self):
+        return False
+
     def __repr__(self):
         return f"{type(self).__name__}({self.name!r})"
 
@@ -169,9 +173,13 @@ def bipm_correction(mjd_utc, version: str = "BIPM2021") -> np.ndarray:
     return np.zeros_like(mjd)
 
 
+_built = [False]
+
+
 def _build_registry():
-    if _registry:
+    if _built[0]:
         return
+    _built[0] = True  # set first: register_observatory re-enters here
     data = _OBS_DATA
     override = os.environ.get("PINT_TPU_OBS_OVERRIDE")
     if override and os.path.exists(override):
@@ -194,9 +202,19 @@ def _build_registry():
 
 
 def register_observatory(obs: Observatory):
+    # seed the built-ins first: registering a custom site as the very
+    # first registry touch must not suppress gbt/parkes/barycenter/...
+    _build_registry()
     _registry[obs.name.lower()] = obs
     for a in obs.aliases:
         _registry.setdefault(a, obs)
+
+
+def reset_registry():
+    """Clear the registry + caches (tests; $PINT_TPU_* env changes)."""
+    _registry.clear()
+    _gps_clock.clear()
+    _built[0] = False
 
 
 def get_observatory(name: str) -> Observatory:

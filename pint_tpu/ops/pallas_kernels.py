@@ -1,0 +1,193 @@
+"""Pallas TPU kernels for the GLS hot path.
+
+The north-star GLS step's largest tensor is the red-noise Fourier basis
+T (n_toa, 2k): XLA materializes it in HBM and re-reads it for each of
+the Woodbury products (T^T N^-1 T, T^T N^-1 X, T z).  These kernels
+stream TOA blocks through VMEM, generating the sin/cos rows on the fly
+inside the kernel and feeding the MXU directly — HBM traffic drops from
+O(n k) per product to O(n), the arithmetic-intensity shape the MXU
+wants (pallas_guide.md: keep matmuls large and resident).
+
+Precision: f32 compute (native TPU VPU/MXU).  This is an OPT-IN fast
+path for the noise-covariance side (weights/bases), where ~1e-6
+relative error perturbs parameter uncertainties, not the timing
+residuals themselves; the f64 XLA path stays the default everywhere.
+On CPU the kernels run in interpret mode (tests exercise both).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+_TWO_PI = 2.0 * math.pi
+
+
+def _pad_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _block_size(n: int, block: int) -> int:
+    """Largest 128-aligned block <= `block` that keeps padding bounded
+    by < 128 rows (n=8193 must not cost a whole extra 8192-row step)."""
+    n_steps = max(1, -(-n // block))
+    return min(block, _pad_to(-(-n // n_steps), 128))
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------- #
+# fourier_gram: Sigma = T^T diag(w) T, TWX = T^T diag(w) X, streaming
+# ---------------------------------------------------------------------- #
+def _gram_kernel(t_ref, w_ref, x_ref, f_ref, sig_ref, twx_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sig_ref[:] = jnp.zeros_like(sig_ref)
+        twx_ref[:] = jnp.zeros_like(twx_ref)
+
+    t = t_ref[0, :]  # (BN,)
+    w = w_ref[0, :]  # (BN,)
+    f = f_ref[:, 0]  # (K,) harmonic frequencies
+    # basis rows generated in VMEM: (2K, BN), never written to HBM
+    arg = _TWO_PI * f[:, None] * t[None, :]  # (K, BN)
+    T = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=0)  # (2K, BN)
+    Tw = T * w[None, :]
+    sig_ref[:] += jax.lax.dot_general(
+        Tw, T, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    twx_ref[:] += jax.lax.dot_general(
+        Tw, x_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def fourier_gram(t, freqs, w, X, block: int = 8192):
+    """(Sigma (2k, 2k), TWX (2k, p)) for T = [sin(2pi f t); cos(...)]^T
+    without materializing T.
+
+    t (n,) seconds; freqs (k,) Hz; w (n,) weights; X (n, p).
+    f32 compute; zero-padding on every axis is exact (padded TOAs get
+    w = 0; padded columns produce zero rows/cols that are sliced off).
+    Traced under enable_x64(False): Mosaic cannot legalize the int64
+    grid indices that global x64 mode would produce.
+    """
+    with jax.enable_x64(False):
+        return _fourier_gram_32(t, freqs, w, X, block)
+
+
+def _fourier_gram_32(t, freqs, w, X, block):
+    n = t.shape[0]
+    k = freqs.shape[0]
+    p = X.shape[1]
+    bn = _block_size(n, block)
+    n_pad = _pad_to(n, bn)
+    k_pad = _pad_to(k, 64)  # 2*k_pad = 128-lane aligned
+    p_pad = _pad_to(p, 128)
+
+    t_p = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(
+        t.astype(jnp.float32)
+    )
+    w_p = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(
+        w.astype(jnp.float32)
+    )
+    x_p = jnp.zeros((n_pad, p_pad), jnp.float32).at[:n, :p].set(
+        X.astype(jnp.float32)
+    )
+    f_p = jnp.zeros((k_pad, 1), jnp.float32).at[:k, 0].set(
+        freqs.astype(jnp.float32)
+    )
+
+    grid = (n_pad // bn,)
+    sig, twx = pl.pallas_call(
+        _gram_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((bn, p_pad), lambda i: (i, 0)),
+            pl.BlockSpec((k_pad, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((2 * k_pad, 2 * k_pad), lambda i: (0, 0)),
+            pl.BlockSpec((2 * k_pad, p_pad), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((2 * k_pad, 2 * k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((2 * k_pad, p_pad), jnp.float32),
+        ],
+        interpret=_on_cpu(),
+    )(t_p, w_p, x_p, f_p)
+    # padded harmonic rows are zero (sin(0 * t) = 0 rows cross terms...
+    # cos rows of padded harmonics are 1-rows, but they only land in
+    # the padded index range, which is sliced away here)
+    idx = np.concatenate([np.arange(k), k_pad + np.arange(k)])
+    return sig[np.ix_(idx, idx)], twx[idx, :p]
+
+
+# ---------------------------------------------------------------------- #
+# fourier_apply: y = T z, streaming
+# ---------------------------------------------------------------------- #
+def _apply_kernel(t_ref, z_ref, f_ref, y_ref):
+    t = t_ref[0, :]  # (BN,)
+    f = f_ref[:, 0]
+    arg = _TWO_PI * f[:, None] * t[None, :]  # (K, BN)
+    T = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=0)
+    y_ref[:] = jax.lax.dot_general(
+        T, z_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def fourier_apply(t, freqs, z, block: int = 8192):
+    """y (n, m) = T z for T = [sin | cos] basis, without materializing
+    T; z (2k, m)."""
+    with jax.enable_x64(False):
+        return _fourier_apply_32(t, freqs, z, block)
+
+
+def _fourier_apply_32(t, freqs, z, block):
+    n = t.shape[0]
+    k = freqs.shape[0]
+    m = z.shape[1]
+    bn = _block_size(n, block)
+    n_pad = _pad_to(n, bn)
+    k_pad = _pad_to(k, 64)  # 2*k_pad = 128-lane aligned
+    m_pad = _pad_to(m, 128)
+
+    t_p = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(
+        t.astype(jnp.float32)
+    )
+    f_p = jnp.zeros((k_pad, 1), jnp.float32).at[:k, 0].set(
+        freqs.astype(jnp.float32)
+    )
+    z_p = jnp.zeros((2 * k_pad, m_pad), jnp.float32)
+    z_p = z_p.at[:k, :m].set(z[:k].astype(jnp.float32))
+    z_p = z_p.at[k_pad:k_pad + k, :m].set(z[k:].astype(jnp.float32))
+
+    grid = (n_pad // bn,)
+    y = pl.pallas_call(
+        _apply_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((2 * k_pad, m_pad), lambda i: (0, 0)),
+            pl.BlockSpec((k_pad, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, m_pad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, m_pad), jnp.float32),
+        interpret=_on_cpu(),
+    )(t_p, z_p, f_p)
+    return y[:n, :m]

@@ -69,9 +69,15 @@ def ingest_topocentric(
     mjd_utc = toas.t.mjd_float()
     clock = np.zeros(n)
     itrf = np.zeros((n, 3))
+    sat_groups = []  # (bool index, SatelliteObs)
     for code in sorted(set(toas.obs)):
         idx = np.array([o == code for o in toas.obs])
         site = sites[int(np.flatnonzero(idx)[0])]
+        if site.is_satellite:
+            # spacecraft clocks are corrected upstream in the event
+            # products; position comes from the orbit table below
+            sat_groups.append((idx, site))
+            continue
         clock[idx] = site.clock_corrections(
             mjd_utc[idx], include_gps=include_gps, limits=limits
         )
@@ -83,7 +89,13 @@ def ingest_topocentric(
     # -- 2. UTC -> TT -----------------------------------------------------
     t_tt = t_utc.to_scale("tt")
     if include_bipm:
-        t_tt = t_tt.add_seconds(bipm_correction(mjd_utc, bipm_version))
+        bipm = bipm_correction(mjd_utc, bipm_version)
+        # spacecraft times are corrected upstream in the event products:
+        # no BIPM realization either (reference: satellite observatories
+        # default include_bipm=False)
+        for idx, _sat in sat_groups:
+            bipm[idx] = 0.0
+        t_tt = t_tt.add_seconds(bipm)
 
     # -- 4. Earth rotation (needed for the TDB topocentric term) ----------
     dut1, xp, yp = get_eop(mjd_utc)
@@ -100,6 +112,11 @@ def ingest_topocentric(
     obs_vel = (
         M @ np.cross(np.broadcast_to(omega, itrf.shape), itrf)[..., None]
     )[..., 0]
+    # spacecraft rows: orbit-table interpolation (already GCRS)
+    if sat_groups:
+        mjd_tt_f = t_tt.mjd_float()
+        for idx, sat in sat_groups:
+            obs_pos[idx], obs_vel[idx] = sat.posvel_gcrs(mjd_tt_f[idx])
 
     # -- 3. TT -> TDB (geocentric series + topocentric term) --------------
     t_tdb = t_tt.to_scale("tdb")

@@ -107,8 +107,7 @@ def test_clock_chain_files(tmp_path, monkeypatch):
     monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
     import pint_tpu.observatory as obsmod
 
-    obsmod._registry.clear()
-    obsmod._gps_clock.clear()
+    obsmod.reset_registry()
     try:
         toas = _gbt_toas(n=5)
         ingest(toas)
@@ -121,8 +120,7 @@ def test_clock_chain_files(tmp_path, monkeypatch):
         )
         np.testing.assert_allclose(dt, 27.7e-6, atol=2e-9)
     finally:
-        obsmod._registry.clear()
-        obsmod._gps_clock.clear()
+        obsmod.reset_registry()
 
 
 def test_mixed_sites_raise():

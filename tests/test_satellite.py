@@ -1,0 +1,95 @@
+"""Satellite observatory tests: orbit-file load, spline interpolation,
+ingest integration for spacecraft photon TOAs."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.io.fits import write_event_fits
+from pint_tpu.observatory.satellite import (
+    SatelliteObs,
+    register_satellite,
+)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:no Earth-orientation table",
+)
+
+R_ORB = 6.8e6  # ~LEO radius, m
+PERIOD_S = 5550.0
+
+
+def _circular_orbit_met(met):
+    w = 2 * np.pi / PERIOD_S
+    return np.stack([
+        R_ORB * np.cos(w * met), R_ORB * np.sin(w * met),
+        np.zeros_like(met),
+    ], axis=-1)
+
+
+@pytest.fixture
+def orbit_file(tmp_path):
+    met = np.arange(0.0, 20000.0, 10.0)
+    pos = _circular_orbit_met(met)
+    path = str(tmp_path / "orbit.fits")
+    write_event_fits(
+        path,
+        {"TIME": met, "X": pos[:, 0], "Y": pos[:, 1], "Z": pos[:, 2]},
+        header_extra={"MJDREFI": 56000, "MJDREFF": 0.0,
+                      "TIMEZERO": 0.0, "TIMESYS": "TT"},
+        extname="ORBIT",
+    )
+    return path
+
+
+def test_orbit_interpolation(orbit_file):
+    sat = SatelliteObs.from_orbit_file("testsat", orbit_file)
+    assert sat.is_satellite
+    # interpolate at off-grid epochs: compare to the analytic orbit
+    met = np.array([1234.5, 9876.25, 15000.125])
+    mjd_tt = 56000.0 + met / 86400.0
+    pos, vel = sat.posvel_gcrs(mjd_tt)
+    np.testing.assert_allclose(
+        pos, _circular_orbit_met(met), atol=5.0  # spline vs circle, m
+    )
+    # speed ~ w R
+    speed = np.linalg.norm(vel, axis=-1)
+    np.testing.assert_allclose(
+        speed, 2 * np.pi / PERIOD_S * R_ORB, rtol=1e-4
+    )
+    with pytest.raises(PintTpuError, match="outside"):
+        sat.posvel_gcrs([56001.0])
+
+
+def test_satellite_ingest(orbit_file, tmp_path):
+    import pint_tpu.observatory as obsmod
+
+    register_satellite("testsat", orbit_file)
+    try:
+        from pint_tpu.timebase.times import TimeArray
+        from pint_tpu.toas.ingest import ingest
+        from pint_tpu.toas.toas import TOAs
+
+        # TOAs in UTC whose TT lands inside the orbit span: TT-UTC ~ 67 s
+        n = 20
+        mjd = 56000.0 + (np.linspace(500, 15000, n) - 67.184) / 86400.0
+        toas = TOAs(
+            TimeArray.from_mjd_float(mjd, scale="utc"),
+            np.full(n, np.inf), np.zeros(n), ["testsat"] * n,
+            [dict() for _ in range(n)],
+        )
+        ingest(toas)
+        # geometry: |ssb_obs - earth_ssb| = orbit radius
+        from pint_tpu.ephemeris import get_ephemeris, mjd_tdb_to_et
+
+        eph = get_ephemeris("builtin")
+        et = mjd_tdb_to_et(
+            toas.t_tdb.mjd_int, toas.t_tdb.sec.to_float()
+        )
+        epos, _ = eph.ssb_posvel(399, et)
+        r = np.linalg.norm(toas.ssb_obs_pos - epos * 1000.0, axis=-1)
+        np.testing.assert_allclose(r, R_ORB, rtol=1e-4)
+        # no troposphere geometry for spacecraft
+        assert np.all(toas.obs_alt_m == 0.0)
+    finally:
+        obsmod.reset_registry()
