@@ -1,0 +1,62 @@
+"""Derivative-free / quasi-Newton chi2 minimization.
+
+Reference parity: src/pint/fitter.py::PowellFitter — scipy
+minimization over the model chi2 for problems where the Gauss-Newton
+step misbehaves (strong nonlinearity, near-degenerate geometry).
+TPU-first: the objective is the jitted chi2 kernel of x, and for the
+gradient-based methods jax.grad supplies exact derivatives (the
+reference's Powell is derivative-free only).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from scipy.optimize import minimize
+
+from pint_tpu.fitting.base import Fitter
+
+
+class MinimizeFitter(Fitter):
+    """scipy.optimize.minimize over the chi2 kernel (method='Powell'
+    reproduces the reference's PowellFitter; 'L-BFGS-B'/'BFGS' use jax
+    gradients)."""
+
+    def __init__(self, toas, model, method: str = "Powell"):
+        super().__init__(toas, model)
+        if self.cm.has_correlated_errors:
+            from pint_tpu.exceptions import CorrelatedErrors
+
+            raise CorrelatedErrors(model)
+        self.method = method
+
+    def fit_toas(self, maxiter: int = 2000) -> float:
+        chi2 = jax.jit(self.cm.chi2)
+        kw = {}
+        if self.method not in ("Powell", "Nelder-Mead"):
+            grad = jax.jit(jax.grad(self.cm.chi2))
+            kw["jac"] = lambda v: np.asarray(grad(np.asarray(v)))
+        res = minimize(
+            lambda v: float(chi2(np.asarray(v))),
+            np.zeros(self.cm.nfree),
+            method=self.method,
+            options={"maxiter": maxiter},
+            **kw,
+        )
+        self.converged = bool(res.success)
+        # uncertainties from the Gauss-Newton covariance at the optimum
+        from pint_tpu.fitting.wls import _wls_step
+        import jax.numpy as jnp
+
+        x = jnp.asarray(res.x)
+        M = self._design_with_offset(x)
+        w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
+        _, cov, _ = _wls_step(jnp.zeros(self.cm.bundle.ntoa), M, w)
+        return self._finalize(res.x, cov, float(res.fun))
+
+
+class PowellFitter(MinimizeFitter):
+    """Name-compatible alias (reference: fitter.PowellFitter)."""
+
+    def __init__(self, toas, model):
+        super().__init__(toas, model, method="Powell")

@@ -1,0 +1,112 @@
+"""Labeled-axis matrices: design/covariance with named axes.
+
+Reference parity: src/pint/pint_matrix.py (PintMatrix, DesignMatrix,
+combine_design_matrices_by_quantity/param) — the reference needs
+labeled matrices so wideband fitters can stack TOA and DM blocks
+coherently.  Here the stacking itself happens inside jacfwd of the
+combined residual vector (fitting/wideband.py), so this layer is the
+thin inspection/export surface: which column is which parameter, which
+row block is which quantity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DesignMatrix:
+    """matrix (n, p) + per-column parameter labels + per-row-block
+    quantity labels [(name, start, stop)]."""
+
+    def __init__(self, matrix, params, quantity_blocks=None):
+        self.matrix = np.asarray(matrix)
+        self.params = list(params)
+        if self.matrix.shape[1] != len(self.params):
+            raise ValueError(
+                f"{self.matrix.shape[1]} columns vs "
+                f"{len(self.params)} labels"
+            )
+        self.quantity_blocks = quantity_blocks or [
+            ("toa", 0, self.matrix.shape[0])
+        ]
+
+    @classmethod
+    def from_fitter(cls, fitter) -> "DesignMatrix":
+        """Labeled design matrix at the fitter's current state
+        (wideband fitters contribute their stacked [TOA; DM] blocks)."""
+        cm = fitter.cm
+        x = cm.x0()
+        design = getattr(
+            fitter, "_combined_design", fitter._design_with_offset
+        )
+        M = np.asarray(design(x))
+        params = (
+            ["Offset"] if fitter._noffset else []
+        ) + list(cm.free_names)
+        n = cm.bundle.ntoa
+        blocks = [("toa", 0, n)]
+        if M.shape[0] == 2 * n:  # wideband: [TOA; DM] stacking
+            blocks.append(("dm", n, 2 * n))
+        return cls(M, params, blocks)
+
+    def column(self, param) -> np.ndarray:
+        return self.matrix[:, self.params.index(param)]
+
+    def block(self, quantity) -> np.ndarray:
+        for name, a, b in self.quantity_blocks:
+            if name == quantity:
+                return self.matrix[a:b]
+        raise KeyError(quantity)
+
+    @property
+    def shape(self):
+        return self.matrix.shape
+
+    def combine_by_param(self, other: "DesignMatrix") -> "DesignMatrix":
+        """Stack rows; shared params align, disjoint params zero-fill
+        (reference: combine_design_matrices_by_quantity)."""
+        params = list(self.params) + [
+            p for p in other.params if p not in self.params
+        ]
+        n1, n2 = self.matrix.shape[0], other.matrix.shape[0]
+        out = np.zeros((n1 + n2, len(params)))
+        for j, p in enumerate(self.params):
+            out[:n1, params.index(p)] = self.matrix[:, j]
+        for j, p in enumerate(other.params):
+            out[n1:, params.index(p)] = other.matrix[:, j]
+        blocks = list(self.quantity_blocks) + [
+            (name, a + n1, b + n1) for name, a, b in other.quantity_blocks
+        ]
+        return DesignMatrix(out, params, blocks)
+
+    def __repr__(self):
+        return (
+            f"DesignMatrix{self.matrix.shape} params={self.params} "
+            f"blocks={[b[0] for b in self.quantity_blocks]}"
+        )
+
+
+class CovarianceMatrix:
+    """(p, p) parameter covariance with labels (reference:
+    pint_matrix covariance makers)."""
+
+    def __init__(self, matrix, params):
+        self.matrix = np.asarray(matrix)
+        self.params = list(params)
+
+    @classmethod
+    def from_fitter(cls, fitter) -> "CovarianceMatrix":
+        if fitter.parameter_covariance_matrix is None:
+            raise ValueError("fit first")
+        return cls(
+            fitter.parameter_covariance_matrix, fitter.cm.free_names
+        )
+
+    def sigma(self, param) -> float:
+        i = self.params.index(param)
+        return float(np.sqrt(self.matrix[i, i]))
+
+    def correlation(self) -> np.ndarray:
+        s = np.sqrt(np.diag(self.matrix))
+        s = np.where(s == 0, 1.0, s)
+        return self.matrix / np.outer(s, s)

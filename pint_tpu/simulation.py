@@ -46,7 +46,14 @@ def make_fake_toas_uniform(
         [dict() for _ in range(ntoa)],
     )
     _ingest(toas, model)
+    _invert_to_integer_phase(toas, model, iterations)
+    if add_noise:
+        _add_white_noise(toas, model, rng)
+    return toas
 
+
+def _invert_to_integer_phase(toas: TOAs, model: TimingModel, iterations):
+    """Shift arrival times until the model phase is (near-)integer."""
     for _ in range(iterations):
         cm = model.compile(toas, subtract_mean=False)
         cm.track_mode = "nearest"
@@ -54,12 +61,13 @@ def make_fake_toas_uniform(
         toas.t = toas.t.add_seconds(-resid)
         _ingest(toas, model)
 
-    if add_noise:
-        rng = rng or np.random.default_rng()
-        noise = rng.normal(0.0, error_us * 1e-6, ntoa)
-        toas.t = toas.t.add_seconds(noise)
-        _ingest(toas, model)
-    return toas
+
+def _add_white_noise(toas: TOAs, model: TimingModel, rng):
+    rng = rng or np.random.default_rng()
+    toas.t = toas.t.add_seconds(
+        rng.normal(0.0, toas.error_us * 1e-6)
+    )
+    _ingest(toas, model)
 
 
 def _ingest(toas: TOAs, model: TimingModel):
@@ -69,6 +77,29 @@ def _ingest(toas: TOAs, model: TimingModel):
         from pint_tpu.toas.ingest import ingest_for_model
 
         ingest_for_model(toas, model)
+
+
+def make_fake_toas_fromtim(
+    tim, model: TimingModel, add_noise: bool = False,
+    rng: Optional[np.random.Generator] = None, iterations: int = 3,
+) -> TOAs:
+    """Replace the TOAs of an existing tim file (path or TOAs object)
+    with model-perfect ones at the same epochs/frequencies/errors/sites
+    (reference: simulation.make_fake_toas_fromtim).  A passed-in TOAs
+    object is copied, never mutated."""
+    import os
+
+    from pint_tpu.io.tim import get_TOAs_from_tim
+
+    if isinstance(tim, (str, bytes, os.PathLike)):
+        toas = get_TOAs_from_tim(tim)
+    else:
+        toas = tim[:]  # slice-copy: the caller's object stays intact
+    _ingest(toas, model)
+    _invert_to_integer_phase(toas, model, iterations)
+    if add_noise:
+        _add_white_noise(toas, model, rng)
+    return toas
 
 
 def make_test_pulsar(

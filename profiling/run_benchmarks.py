@@ -1,0 +1,169 @@
+"""Multi-config benchmark harness (reference parity: the reference's
+repo-root profiling/ directory of cProfile scripts; SURVEY.md §5).
+
+Times the BASELINE.md config ladder on the current JAX backend and, for
+each, the identical computation pinned to host CPU:
+
+  1. small WLS fit            (~60 TOAs, NGC6440E-like)
+  2. 1e4-TOA GLS + red noise  (J1713-like scale)
+  3. 1e5-TOA GLS + red noise  (the north-star; same as bench.py)
+  4. wideband joint fit       (TOA + DM blocks)
+  5. PTA batch                (16 pulsars, vmapped GLS)
+
+Usage: python profiling/run_benchmarks.py [--configs 1 2 ...]
+Prints one JSON line per config.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(fn, nrep=3):
+    import jax
+
+    out = fn()
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _gls_step_fn(cm):
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.base import design_with_offset, noffset
+    from pint_tpu.fitting.gls import gls_step_woodbury
+
+    no = noffset(cm)
+
+    def step(x):
+        r = cm.time_residuals(x, subtract_mean=False)
+        M = design_with_offset(cm, x)
+        Nd = jnp.square(cm.scaled_sigma(x))
+        T, phi = cm.noise_basis_or_empty(x)
+        dx, _, chi2, _ = gls_step_woodbury(r, M, Nd, T, phi)
+        return x + dx[no:], chi2
+
+    return jax.jit(step)
+
+
+def config_1():
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = "PSR C1\nF0 61.485 1\nF1 -1.2e-15 1\nPEPOCH 53750\nDM 224.1 1\n"
+    m, toas = make_test_pulsar(par, ntoa=62, start_mjd=53478,
+                               end_mjd=54200)
+    cm = m.compile(toas)
+    return "config1 WLS ~60 TOAs", 62, _gls_step_fn(cm), cm.x0()
+
+
+def _gls_config(ntoa, label):
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR CX\nF0 218.81 1\nF1 -4.08e-16 1\nPEPOCH 55000\n"
+        "DM 15.99 1\nEFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n"
+        "TNREDAMP -13.8\nTNREDGAM 4.3\nTNREDC 30\n"
+    )
+    m, toas = make_test_pulsar(
+        par, ntoa=ntoa, start_mjd=53000, end_mjd=57000, iterations=1
+    )
+    cm = m.compile(toas)
+    return label, ntoa, _gls_step_fn(cm), cm.x0()
+
+
+def config_2():
+    return _gls_config(10_000, "config2 GLS 1e4 TOAs + red noise")
+
+
+def config_3():
+    return _gls_config(100_000, "config3 GLS 1e5 TOAs + red noise (north star)")
+
+
+def config_4():
+    import jax
+
+    from pint_tpu.fitting.wideband import WidebandTOAFitter
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR C4\nF0 205.53 1\nF1 -4.3e-16 1\nPEPOCH 55000\nDM 4.33 1\n"
+    )
+    rng = np.random.default_rng(0)
+    m, toas = make_test_pulsar(par, ntoa=4000, start_mjd=53000,
+                               end_mjd=57000, iterations=1)
+    for f in toas.flags:
+        f["pp_dm"] = f"{4.33 + rng.normal(0, 2e-4):.8f}"
+        f["pp_dme"] = "2e-4"
+    fitter = WidebandTOAFitter(toas, get_model(par))
+
+    @jax.jit
+    def step(x):
+        r = fitter._combined_residuals(x)
+        M = fitter._combined_design(x)
+        Nd, T, phi = fitter._combined_noise(x)
+        from pint_tpu.fitting.gls import gls_step_woodbury
+
+        dx, _, chi2, _ = gls_step_woodbury(r, M, Nd, T, phi)
+        return x + dx[fitter._noffset:], chi2
+
+    return "config4 wideband 4e3 TOAs", 4000, step, fitter.cm.x0()
+
+
+def config_5():
+    import jax
+
+    from pint_tpu.parallel.pta import PTABatch
+    from pint_tpu.simulation import make_test_pulsar
+
+    cms = []
+    for i in range(16):
+        par = (
+            f"PSR P{i}\nF0 {150 + 17 * i}.123 1\nF1 -3e-16 1\n"
+            f"PEPOCH 55000\nDM {5 + 3 * i}.1 1\nEFAC -f L-wide 1.1\n"
+            "TNREDAMP -13.5\nTNREDGAM 4.0\nTNREDC 15\n"
+        )
+        m, toas = make_test_pulsar(
+            par, ntoa=2000, start_mjd=53000, end_mjd=57000,
+            seed=i, iterations=1,
+        )
+        cms.append(m.compile(toas))
+    batch = PTABatch(cms)
+    step = jax.jit(batch.fit_step)
+    return "config5 PTA batch 16 x 2e3 TOAs", 16 * 2000, step, batch.x0()
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, nargs="+",
+                    default=[1, 2, 3, 4, 5])
+    args = ap.parse_args()
+    builders = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
+                5: config_5}
+    for c in args.configs:
+        label, ntoa, step, x0 = builders[c]()
+        t_dev = _timeit(lambda: step(x0))
+        print(json.dumps({
+            "config": label,
+            "backend": jax.default_backend(),
+            "ntoa": ntoa,
+            "fit_step_ms": round(t_dev * 1e3, 3),
+            "toas_per_sec": round(ntoa / t_dev, 1),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Labeled matrices, MinimizeFitter/Powell, make_fake_toas_fromtim."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_fromtim, make_test_pulsar
+
+PAR = """PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+"""
+
+
+def test_design_matrix_labels_and_blocks():
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.matrix import CovarianceMatrix, DesignMatrix
+
+    m, toas = make_test_pulsar(PAR, ntoa=40)
+    f = WLSFitter(toas, m)
+    dm = DesignMatrix.from_fitter(f)
+    assert dm.params[0] == "Offset"
+    assert set(dm.params[1:]) == {"F0", "F1", "DM"}
+    assert dm.shape == (40, 4)
+    np.testing.assert_array_equal(dm.column("Offset"), 1.0)
+    assert dm.block("toa").shape == (40, 4)
+    f.fit_toas()
+    cov = CovarianceMatrix.from_fitter(f)
+    assert cov.sigma("F0") == pytest.approx(
+        m.params["F0"].uncertainty, rel=1e-9
+    )
+    corr = cov.correlation()
+    np.testing.assert_allclose(np.diag(corr), 1.0)
+
+
+def test_design_matrix_from_wideband_fitter():
+    from pint_tpu.fitting import WidebandTOAFitter
+    from pint_tpu.matrix import DesignMatrix
+
+    m, toas = make_test_pulsar(PAR, ntoa=30)
+    rng = np.random.default_rng(0)
+    for f in toas.flags:
+        f["pp_dm"] = f"{3.138 + rng.normal(0, 1e-4):.8f}"
+        f["pp_dme"] = "1e-4"
+    wb = WidebandTOAFitter(toas, get_model(PAR))
+    dm = DesignMatrix.from_fitter(wb)
+    assert dm.shape == (60, 4)  # Offset + F0/F1/DM over [TOA; DM] rows
+    assert dm.block("dm").shape == (30, 4)
+    # the DM block's DM column is -1 (d(meas - model)/dDM)
+    np.testing.assert_allclose(
+        dm.block("dm")[:, dm.params.index("DM")], -1.0, atol=1e-12
+    )
+
+
+def test_design_matrix_combine_by_param():
+    from pint_tpu.matrix import DesignMatrix
+
+    a = DesignMatrix(np.ones((3, 2)), ["F0", "DM"])
+    b = DesignMatrix(2 * np.ones((2, 2)), ["DM", "PX"],
+                     [("dm", 0, 2)])
+    c = a.combine_by_param(b)
+    assert c.params == ["F0", "DM", "PX"]
+    assert c.shape == (5, 3)
+    np.testing.assert_array_equal(c.column("PX")[:3], 0.0)
+    np.testing.assert_array_equal(c.column("F0")[3:], 0.0)
+    assert c.block("dm").shape == (2, 3)
+
+
+def test_minimize_fitter_matches_wls():
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.fitting.minimize import MinimizeFitter, PowellFitter
+
+    m_true = get_model(PAR)
+    _, toas = make_test_pulsar(PAR, ntoa=60, seed=3)
+    m1, m2 = get_model(PAR), get_model(PAR)
+    WLSFitter(toas, m1).fit_toas()
+    f2 = MinimizeFitter(toas, m2, method="L-BFGS-B")
+    chi2 = f2.fit_toas()
+    assert np.isfinite(chi2)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        s = m1.params[n].uncertainty
+        assert abs(v1 - v2) < 3 * s, n
+    # Powell (derivative-free) on a 1-par problem
+    m3 = get_model(PAR)
+    m3.params["F1"].frozen = True
+    m3.params["DM"].frozen = True
+    f3 = PowellFitter(toas, m3)
+    f3.fit_toas()
+    assert float(m3.params["F0"].value.to_float()) == pytest.approx(
+        245.4261196898081, abs=1e-10
+    )
+
+
+def test_make_fake_toas_fromtim(tmp_path):
+    from pint_tpu.io.tim import write_tim_file
+
+    m, toas = make_test_pulsar(PAR, ntoa=30, jitter_us=50.0)
+    tim = tmp_path / "in.tim"
+    write_tim_file(str(tim), toas)
+    m2 = get_model(PAR)
+    fake = make_fake_toas_fromtim(str(tim), m2)
+    assert len(fake) == 30
+    np.testing.assert_array_equal(fake.freq, toas.freq)
+    cm = m2.compile(fake, subtract_mean=False)
+    r = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
+    assert np.max(np.abs(r)) < 1e-9  # model-perfect at the tim epochs
