@@ -204,6 +204,41 @@ class TimingModel:
             self, bundle, subtract_mean=subtract_mean, tzr_bundle=tzr_bundle
         )
 
+    # -- host-facing conveniences (reference: TimingModel.delay/.phase/
+    # .designmatrix; one-shot evaluations that compile under the hood —
+    # fitters hold a CompiledModel instead of re-calling these) ---------
+    def delay(self, toas) -> np.ndarray:
+        """Total delay (s) at each TOA for the current parameters."""
+        cm = self.compile(toas)
+        return np.asarray(cm.delay(cm.x0()))
+
+    def phase(self, toas):
+        """(int_cycles, frac) model phase arrays at each TOA."""
+        cm = self.compile(toas, subtract_mean=False)
+        ph = cm.phase(cm.x0())
+        return np.asarray(ph.int_), np.asarray(ph.frac)
+
+    def designmatrix(self, toas):
+        """(M (n, p) seconds-per-internal-unit, free-param names) —
+        reference signature minus the astropy units column."""
+        cm = self.compile(toas)
+        return np.asarray(cm.design_matrix(cm.x0())), list(cm.free_names)
+
+    def d_phase_d_param(self, toas, param: str) -> np.ndarray:
+        """Phase derivative (cycles per internal unit) for one free
+        parameter (reference: TimingModel.d_phase_d_param) — a single
+        jvp with a unit tangent, not a full-Jacobian column."""
+        cm = self.compile(toas)
+        if param not in cm.free_names:
+            raise TimingModelError(
+                f"{param} is not a free parameter of this model"
+            )
+        tangent = jnp.zeros(cm.nfree).at[
+            cm.free_names.index(param)
+        ].set(1.0)
+        _, col = jax.jvp(cm.phase_residuals, (cm.x0(),), (tangent,))
+        return np.asarray(col)
+
     # -- parfile ----------------------------------------------------------
     def as_parfile(self) -> str:
         lines = []

@@ -1,0 +1,159 @@
+"""Interactive fitting layer (pintk replacement).
+
+Reference parity: src/pint/pintk/ — a ~4000-LoC Tk GUI (plk residual
+canvas, par/tim editors).  Per SURVEY.md §7 the Tk GUI is out of scope;
+what IS in scope is its testable core, `pintk/pulsar.py::Pulsar` — the
+stateful wrapper the GUI drives: load par/tim, fit, delete/restore
+TOAs, add/remove jumps, random-model draws, undo.  That layer is here,
+headless, plus a minimal matplotlib front end (``plk()``) for
+interactive use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.fitting import auto_fitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.residuals import Residuals
+
+
+class Pulsar:
+    """Stateful par/tim session driving fits and TOA edits
+    (reference: pintk/pulsar.py::Pulsar)."""
+
+    def __init__(self, parfile, timfile=None, toas=None):
+        self.parfile = parfile
+        self.model = get_model(parfile)
+        self._par_backup = self.model.as_parfile()
+        if toas is not None:
+            self.all_toas = toas
+        else:
+            from pint_tpu.toas.cache import get_TOAs
+
+            self.all_toas = get_TOAs(timfile, model=self.model)
+        self.deleted = np.zeros(len(self.all_toas), dtype=bool)
+        self.fitter = None
+        self._fit_history: list[str] = []
+
+    # -- selection -------------------------------------------------------
+    @property
+    def selected_toas(self):
+        return self.all_toas[~self.deleted]
+
+    def delete_toas(self, indices):
+        self.deleted[np.asarray(indices, dtype=int)] = True
+
+    def restore_toas(self, indices=None):
+        if indices is None:
+            self.deleted[:] = False
+        else:
+            self.deleted[np.asarray(indices, dtype=int)] = False
+
+    # -- fitting ---------------------------------------------------------
+    def residuals(self) -> Residuals:
+        return Residuals(self.selected_toas, self.model)
+
+    def fit(self, **kw) -> float:
+        """Fit the non-deleted TOAs; history enables undo.  The undo
+        entry is recorded only after the fit succeeds, so a raising fit
+        leaves the history consistent."""
+        pre_fit = self.model.as_parfile()
+        fitter = auto_fitter(self.selected_toas, self.model, **kw)
+        chi2 = fitter.fit_toas()
+        self._fit_history.append(pre_fit)
+        self.fitter = fitter
+        return chi2
+
+    def undo_fit(self):
+        if not self._fit_history:
+            raise ValueError("nothing to undo")
+        self.model = get_model(self._fit_history.pop())
+        self.fitter = None
+
+    def reset_model(self):
+        self.model = get_model(self._par_backup)
+        self.fitter = None
+        self._fit_history.clear()
+
+    # -- jumps -----------------------------------------------------------
+    def add_jump(self, indices) -> str:
+        """JUMP the given TOA indices via a -gui_jump flag selection
+        (reference: pintk jump workflow)."""
+        from pint_tpu.models.jump import PhaseJump
+
+        comp = self.model.components.get("PhaseJump")
+        if comp is None:
+            comp = PhaseJump()
+            self.model.add_component(comp)
+        n_existing = len(comp.jump_params)
+        tag = str(n_existing + 1)
+        for i in np.asarray(indices):
+            self.all_toas.flags[int(i)]["gui_jump"] = tag
+        p = comp.mask_families()["JUMP"](n_existing + 1)
+        p.set_from_tokens(["-gui_jump", tag, "0", "1"])
+        self.model.setup()
+        return p.name
+
+    # -- random models ---------------------------------------------------
+    def random_models(self, n_models: int = 20):
+        if self.fitter is None:
+            raise ValueError("fit first")
+        from pint_tpu.simulation import calculate_random_models
+
+        return calculate_random_models(self.fitter, n_models=n_models)
+
+    def write_fit_par(self, path):
+        with open(path, "w") as f:
+            f.write(self.model.as_parfile())
+
+    def __repr__(self):
+        return (
+            f"Pulsar({self.model.name!r}, {len(self.all_toas)} TOAs, "
+            f"{int(self.deleted.sum())} deleted)"
+        )
+
+
+def plk(parfile, timfile, block: bool = True):
+    """Minimal interactive residual viewer/fitter (matplotlib):
+    'f' = fit, 'u' = undo fit, 'd' = delete nearest TOA, 'r' = restore
+    all, 'q' = close.  Returns the Pulsar session."""
+    import matplotlib.pyplot as plt
+
+    psr = Pulsar(parfile, timfile)
+    fig, ax = plt.subplots(figsize=(9, 5))
+
+    def redraw():
+        from pint_tpu.plot_utils import plot_residuals
+
+        ax.clear()
+        r = psr.residuals()
+        plot_residuals(psr.selected_toas, r, ax=ax)
+        ax.set_title(
+            f"{psr.model.name}  chi2={r.chi2:.2f} dof={r.dof}"
+        )
+        fig.canvas.draw_idle()
+
+    def on_key(event):
+        if event.key == "f":
+            psr.fit()
+            redraw()
+        elif event.key == "u":
+            psr.undo_fit()
+            redraw()
+        elif event.key == "r":
+            psr.restore_toas()
+            redraw()
+        elif event.key == "d" and event.xdata is not None:
+            live = np.flatnonzero(~psr.deleted)
+            mjd = psr.all_toas.mjd_float()[live]
+            psr.delete_toas([live[np.argmin(np.abs(mjd - event.xdata))]])
+            redraw()
+        elif event.key == "q":
+            plt.close(fig)
+
+    fig.canvas.mpl_connect("key_press_event", on_key)
+    redraw()
+    if block:
+        plt.show()
+    return psr

@@ -1,0 +1,123 @@
+"""Pulsar interactive-session layer (pintk replacement, headless),
+global clock-corrections manager, TimingModel convenience API."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.tim import write_tim_file
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+"""
+
+
+@pytest.fixture
+def session_files(tmp_path):
+    m, toas = make_test_pulsar(PAR, ntoa=60, jitter_us=1.0)
+    # outlier to delete
+    toas.t = toas.t.add_seconds(
+        np.where(np.arange(60) == 30, 5e-5, 0.0)
+    )
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    ingest_barycentric(toas)
+    par = tmp_path / "p.par"
+    par.write_text(PAR)
+    tim = tmp_path / "p.tim"
+    write_tim_file(str(tim), toas)
+    return str(par), str(tim)
+
+
+def test_pulsar_fit_delete_undo(session_files):
+    from pint_tpu.pintk import Pulsar
+
+    par, tim = session_files
+    psr = Pulsar(par, tim)
+    assert len(psr.all_toas) == 60
+    r0 = psr.residuals()
+    chi2_before = r0.chi2
+    # the outlier dominates: delete it, fit, chi2 collapses
+    mjd = psr.all_toas.mjd_float()
+    outlier = int(np.argmax(np.abs(r0.time_resids)))
+    psr.delete_toas([outlier])
+    assert len(psr.selected_toas) == 59
+    chi2 = psr.fit()
+    assert chi2 < chi2_before / 10
+    f0_fit = float(psr.model.params["F0"].value.to_float())
+    # undo returns the pre-fit model
+    psr.undo_fit()
+    assert float(
+        psr.model.params["F0"].value.to_float()
+    ) == pytest.approx(245.4261196898081, abs=1e-12)
+    psr.restore_toas()
+    assert len(psr.selected_toas) == 60
+    psr.reset_model()
+    assert psr.fitter is None
+    assert abs(f0_fit - 245.4261196898081) < 1e-7
+
+
+def test_pulsar_add_jump(session_files):
+    from pint_tpu.pintk import Pulsar
+
+    par, tim = session_files
+    psr = Pulsar(par, tim)
+    name = psr.add_jump(np.arange(10, 20))
+    assert name.startswith("JUMP")
+    assert "PhaseJump" in psr.model.components
+    p = psr.model.params[name]
+    assert not p.frozen
+    sel = p.select(psr.all_toas)
+    assert sel[10:20].all() and sel.sum() == 10
+    chi2 = psr.fit()
+    assert np.isfinite(chi2)
+    assert psr.random_models(5).shape == (5, 60)
+
+
+def test_global_clock_update(tmp_path):
+    from pint_tpu.observatory.global_clock import Index, update_clock_files
+
+    repo = tmp_path / "repo"
+    (repo / "t2").mkdir(parents=True)
+    (repo / "t2" / "gbt2gps.clk").write_text(
+        "# UTC(gbt) UTC(gps)\n50000 1e-6\n60000 1e-6\n"
+    )
+    (repo / "index.txt").write_text(
+        "# file update valid-end\nt2/gbt2gps.clk 60000.0 60200.0\n"
+    )
+    dest = tmp_path / "clk"
+    installed = update_clock_files(repo, clock_dir=dest, now_mjd=60050.0)
+    assert installed == ["gbt2gps.clk"]
+    assert (dest / "gbt2gps.clk").exists()
+    with pytest.warns(UserWarning, match="stale"):
+        update_clock_files(repo, clock_dir=dest, now_mjd=60500.0)
+    idx = Index.from_file(repo / "index.txt")
+    assert idx.stale_files(60500.0) == ["t2/gbt2gps.clk"]
+    assert idx.stale_files(60050.0) == []
+
+
+def test_timing_model_convenience_api():
+    from pint_tpu.models.builder import get_model
+
+    m, toas = make_test_pulsar(PAR, ntoa=30)
+    d = m.delay(toas)
+    assert d.shape == (30,)
+    # barycentric sim: delay is the dispersion term
+    from pint_tpu.constants import DM_CONST
+
+    np.testing.assert_allclose(
+        d, DM_CONST * 3.138 / toas.freq**2, rtol=1e-9
+    )
+    ints, frac = m.phase(toas)
+    assert np.all(np.abs(frac) <= 0.5)
+    M, names = m.designmatrix(toas)
+    assert M.shape == (30, 3) and set(names) == {"F0", "F1", "DM"}
+    dpdf0 = m.d_phase_d_param(toas, "F0")
+    # d phase / d F0 = dt (seconds from PEPOCH, delay-corrected)
+    dt = (toas.mjd_float() - 55000.0) * 86400.0
+    np.testing.assert_allclose(dpdf0, dt, rtol=1e-6)
+    with pytest.raises(Exception):
+        m.d_phase_d_param(toas, "PX")
