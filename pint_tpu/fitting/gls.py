@@ -50,22 +50,33 @@ def _solve_spd_threshold(A, B, threshold=None):
     return (V * winv[None, :]) @ (V.T @ B), jnp.sum(bad)
 
 
-def _solve_normal_eqs(cinv_mult, r, M):
-    """Shared GLS tail: column-normalize, form/solve normal equations,
-    post-solve chi2 (r^T C^-1 r minus the fitted decrement dx^T A dx —
-    removes the offset-column power, matching the reference)."""
+def _column_norms(M):
     norm = jnp.sqrt(jnp.sum(M * M, axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
+    return jnp.where(norm == 0, 1.0, norm)
+
+
+def _finish_normal_eqs(A, b, r_cinv_r, norm):
+    """Shared normal-equation tail for every GLS flavor: SPD-threshold
+    solve, covariance, chi2 = r^T C^-1 r minus the fitted decrement
+    dx^T b (removes the offset-column power, matching the reference),
+    column un-normalization."""
+    dxn, nbad = _solve_spd_threshold(A, b[:, None])
+    dxn = dxn[:, 0]
+    covn, _ = _solve_spd_threshold(A, jnp.eye(A.shape[0]))
+    chi2 = r_cinv_r - jnp.dot(dxn, b)
+    return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
+
+
+def _solve_normal_eqs(cinv_mult, r, M):
+    """Shared GLS tail: column-normalize, form/solve normal equations
+    via an explicit C^-1-apply."""
+    norm = _column_norms(M)
     Mn = M / norm[None, :]
     CiM = cinv_mult(Mn)
     Cir = cinv_mult(r[:, None])[:, 0]
     A = Mn.T @ CiM
     b = -(Mn.T @ Cir)
-    dxn, nbad = _solve_spd_threshold(A, b[:, None])
-    dxn = dxn[:, 0]
-    covn, _ = _solve_spd_threshold(A, jnp.eye(A.shape[0]))
-    chi2 = jnp.dot(r, Cir) - jnp.dot(dxn, b)
-    return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
+    return _finish_normal_eqs(A, b, jnp.dot(r, Cir), norm)
 
 
 def make_cinv_mult(Ndiag, T, phi):
@@ -92,6 +103,40 @@ def gls_step_woodbury(r, M, Ndiag, T, phi):
     (dx (p,), cov (p,p), chi2, n_degenerate).
     """
     return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M)
+
+
+def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
+    """Woodbury GLS with the Pallas fused-Gram kernels: the red-noise
+    basis T = [sin, cos](2 pi f t) is never materialized — its Gram
+    pieces stream through VMEM in f32 (ops/pallas_kernels.py).
+
+    Mixed precision by design: residuals, white-noise weighting, and
+    M^T N^-1 M stay f64; only the reduced-rank CORRECTION term (the
+    noise covariance's low-rank part) is f32 (~1e-6 relative), which
+    perturbs step directions/uncertainties at the 1e-6 level — validated
+    against the f64 path in tests/test_pallas_kernels.py.  Requires a
+    pure-Fourier basis (CompiledModel.noise_fourier_spec).
+    """
+    from pint_tpu.ops.pallas_kernels import fourier_gram
+
+    Ninv = 1.0 / Ndiag
+    norm = _column_norms(M)
+    Mn = M / norm[None, :]
+    # f64 white-noise block (cheap: p is small)
+    A_white = Mn.T @ (Mn * Ninv[:, None])
+    b_white = Mn.T @ (Ninv * r)
+    r_Nr = jnp.dot(r, Ninv * r)
+    # f32 fused Gram of the basis against [Mn | r]
+    X = jnp.concatenate([Mn, r[:, None]], axis=1)
+    sig_tt, twx = fourier_gram(t_sec, freqs, Ninv, X)
+    sig_tt = sig_tt.astype(jnp.float64)
+    twx = twx.astype(jnp.float64)
+    Sigma = jnp.diag(1.0 / phi) + sig_tt
+    corr = _chol_solve(Sigma, twx)  # Sigma^-1 T^T N^-1 [Mn | r]
+    A = A_white - twx[:, :-1].T @ corr[:, :-1]
+    b = -(b_white - twx[:, :-1].T @ corr[:, -1])
+    r_cinv_r = r_Nr - jnp.dot(twx[:, -1], corr[:, -1])
+    return _finish_normal_eqs(A, b, r_cinv_r, norm)
 
 
 def gls_step_full_cov(r, M, Ndiag, T, phi):

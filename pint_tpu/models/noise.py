@@ -216,12 +216,18 @@ def _toa_seconds(bundle) -> jnp.ndarray:
     return (bundle.tdb_day - day0) * 86400.0 + bundle.tdb_sec.to_float()
 
 
-def fourier_basis(bundle, nharm: int):
-    """(n, 2*nharm) sin/cos design matrix and the frequencies (Hz)."""
+def fourier_freqs(bundle, nharm: int):
+    """Harmonic layout shared by the materialized basis and the Pallas
+    fused-Gram path: (t_seconds (n,), freqs (nharm,), tspan)."""
     t = _toa_seconds(bundle)
     tspan = jnp.max(t) - jnp.min(t)
     j = jnp.arange(1, nharm + 1, dtype=jnp.float64)
-    f = j / tspan
+    return t, j / tspan, tspan
+
+
+def fourier_basis(bundle, nharm: int):
+    """(n, 2*nharm) sin/cos design matrix and the frequencies (Hz)."""
+    t, f, tspan = fourier_freqs(bundle, nharm)
     arg = 2.0 * math.pi * t[:, None] * f[None, :]
     F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=1)
     return F, jnp.concatenate([f, f]), tspan
@@ -273,6 +279,19 @@ class PLRedNoise(NoiseComponent):
             f, tspan, pdict["TNREDAMP"], pdict["TNREDGAM"]
         )
         return F, phi
+
+    def fourier_spec(self, pdict, bundle):
+        """(t_seconds, harmonic freqs (k,), phi (2k,)) — the pure
+        sin/cos structure consumed by the Pallas fused-Gram GLS path
+        (ops/pallas_kernels.py); only achromatic PL noise has it.
+        Shares fourier_freqs with basis_weight so the two paths can
+        never disagree on the harmonic layout."""
+        t, f, tspan = fourier_freqs(bundle, self._nharm())
+        phi = powerlaw_phi(
+            jnp.concatenate([f, f]), tspan,
+            pdict["TNREDAMP"], pdict["TNREDGAM"],
+        )
+        return t, f, phi
 
 
 class PLChromNoise(NoiseComponent):

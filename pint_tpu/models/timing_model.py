@@ -489,6 +489,24 @@ class CompiledModel:
             jnp.ones(1) * 1e-40,
         )
 
+    def noise_fourier_spec(self, x):
+        """(t_seconds, freqs, phi) when the model's correlated noise is
+        exactly one pure-Fourier basis (PL red noise) — the shape the
+        Pallas fused-Gram GLS path accepts; None otherwise."""
+        pd = self._pdict(x)
+        specs = [
+            c.fourier_spec(pd, self.bundle)
+            for c in self.model.noise_components
+            if hasattr(c, "fourier_spec")
+        ]
+        n_corr = sum(
+            c.introduces_correlated_errors
+            for c in self.model.noise_components
+        )
+        if len(specs) == 1 and n_corr == 1:
+            return specs[0]
+        return None
+
     @property
     def has_correlated_errors(self):
         return any(

@@ -94,7 +94,15 @@ def update_clock_files(
                     f"(older than {max_age_days} d or past validity)"
                 )
     installed = []
+    seen: dict = {}
     for src in sorted(repo.rglob("*.clk")):
+        if src.name in seen:
+            warnings.warn(
+                f"duplicate clock file name {src.name!r}: keeping "
+                f"{seen[src.name]}, skipping {src.relative_to(repo)}"
+            )
+            continue
+        seen[src.name] = src.relative_to(repo)
         dst = clock_dir / src.name
         if (
             not dst.exists()

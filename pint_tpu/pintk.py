@@ -135,22 +135,28 @@ def plk(parfile, timfile, block: bool = True):
         fig.canvas.draw_idle()
 
     def on_key(event):
-        if event.key == "f":
-            psr.fit()
-            redraw()
-        elif event.key == "u":
-            psr.undo_fit()
-            redraw()
-        elif event.key == "r":
-            psr.restore_toas()
-            redraw()
-        elif event.key == "d" and event.xdata is not None:
-            live = np.flatnonzero(~psr.deleted)
-            mjd = psr.all_toas.mjd_float()[live]
-            psr.delete_toas([live[np.argmin(np.abs(mjd - event.xdata))]])
-            redraw()
-        elif event.key == "q":
-            plt.close(fig)
+        try:
+            if event.key == "f":
+                psr.fit()
+                redraw()
+            elif event.key == "u":
+                psr.undo_fit()
+                redraw()
+            elif event.key == "r":
+                psr.restore_toas()
+                redraw()
+            elif event.key == "d" and event.xdata is not None:
+                live = np.flatnonzero(~psr.deleted)
+                mjd = psr.all_toas.mjd_float()[live]
+                psr.delete_toas(
+                    [live[np.argmin(np.abs(mjd - event.xdata))]]
+                )
+                redraw()
+            elif event.key == "q":
+                plt.close(fig)
+        except Exception as e:  # viewer must survive bad keypresses
+            ax.set_title(f"{type(e).__name__}: {e}")
+            fig.canvas.draw_idle()
 
     fig.canvas.mpl_connect("key_press_event", on_key)
     redraw()

@@ -56,6 +56,49 @@ def test_fourier_apply_matches_reference():
     )
 
 
+def test_gls_fourier_step_matches_f64():
+    """The mixed-precision fused-Gram GLS step must agree with the f64
+    Woodbury path to f32-correction accuracy."""
+    import jax
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import (
+        gls_step_woodbury,
+        gls_step_woodbury_fourier,
+    )
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR F\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+        "EFAC -f L-wide 1.2\nTNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 12\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=300, seed=4)
+    cm = m.compile(toas)
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Nd = jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    dx64, cov64, chi64, _ = jax.jit(gls_step_woodbury)(r, M, Nd, T, phi)
+    spec = cm.noise_fourier_spec(x)
+    assert spec is not None
+    t_sec, freqs, phi_f = spec
+    np.testing.assert_allclose(
+        np.asarray(phi_f), np.asarray(phi), rtol=1e-12
+    )
+    dx32, cov32, chi32, _ = jax.jit(gls_step_woodbury_fourier)(
+        r, M, Nd, t_sec, freqs, phi_f
+    )
+    np.testing.assert_allclose(
+        np.asarray(dx32), np.asarray(dx64),
+        atol=2e-3 * np.max(np.abs(np.asarray(dx64))),
+    )
+    assert float(chi32) == pytest.approx(float(chi64), rel=1e-3)
+    s64 = np.sqrt(np.diag(np.asarray(cov64)))
+    s32 = np.sqrt(np.diag(np.asarray(cov32)))
+    np.testing.assert_allclose(s32, s64, rtol=5e-3)
+
+
 def test_fourier_gram_weights_zero_padding():
     """Zero-weight TOAs must contribute nothing (the PTA/shard padding
     convention rides on this)."""
