@@ -10,9 +10,10 @@ config-2-style epoched data exercises ECORR in the tests instead.)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 value = TOAs/sec for one full fit step on the default backend (TPU
-under the driver) using the framework's production TPU path — the
-Pallas mixed-precision fused-Gram Woodbury when the noise structure
-allows it (f64-equivalent to <1e-3 sigma; tests/test_pallas_kernels).
+under the driver) using the framework's production TPU path — the same
+Pallas mixed-precision fused-Gram Woodbury that GLSFitter auto-selects
+on accelerators (fused='auto'; validated bounds in
+gls_step_woodbury_fourier / tests/test_pallas_kernels).
 vs_baseline = speedup over the all-f64 XLA computation pinned to host
 CPU, which stands in for the reference implementation class
 (single-process CPU; SURVEY.md §6 records no published throughput, so

@@ -1,0 +1,97 @@
+"""Fit-state checkpoints (save / resume).
+
+Reference parity: SURVEY.md §5 checkpoint/resume — the reference's
+story is (a) the TOA pickle cache (ours: toas/cache.py), (b) parfile
+round-trip as the model checkpoint (ours: TimingModel.as_parfile), and
+(c) nothing for long runs.  The TPU framework adds (c): an
+orbax-style-but-dependency-free .npz checkpoint of fitter state
+(parameters, covariance, chi2) and MCMC sampler state (chain tail, rng
+seed), so PTA-scale batch fits and long samplers resume across
+preemptions.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+_VERSION = 1
+
+
+def save_fit(path, fitter):
+    """Checkpoint a fitted fitter: par snapshot + covariance + chi2."""
+    if fitter.parameter_covariance_matrix is None:
+        raise ValueError("fit before checkpointing")
+    np.savez_compressed(
+        path,
+        version=_VERSION,
+        kind="fit",
+        parfile=np.array(fitter.model.as_parfile()),
+        free_names=np.array(list(fitter.cm.free_names)),
+        cov=fitter.parameter_covariance_matrix,
+        chi2=np.float64(fitter.chi2 if fitter.chi2 is not None else np.nan),
+        converged=np.bool_(fitter.converged),
+    )
+
+
+def load_fit(path):
+    """-> dict(model, free_names, cov, chi2, converged); the model is
+    rebuilt from the par snapshot (the framework's canonical model
+    serialization)."""
+    from pint_tpu.models.builder import get_model
+
+    z = np.load(path, allow_pickle=False)
+    if int(z["version"]) > _VERSION:
+        raise ValueError(
+            f"checkpoint version {int(z['version'])} is newer than "
+            f"this build ({_VERSION})"
+        )
+    return {
+        "model": get_model(str(z["parfile"])),
+        "free_names": [str(n) for n in z["free_names"]],
+        "cov": z["cov"],
+        "chi2": float(z["chi2"]),
+        "converged": bool(z["converged"]),
+    }
+
+
+def save_mcmc(path, mcmc_fitter, keep_last: int = 200):
+    """Checkpoint an MCMCFitter: par snapshot + the chain tail (enough
+    to re-seed walkers) + diagnostics."""
+    if mcmc_fitter.chain is None:
+        raise ValueError("sample before checkpointing")
+    tail = mcmc_fitter.chain[-keep_last:]
+    np.savez_compressed(
+        path,
+        version=_VERSION,
+        kind="mcmc",
+        parfile=np.array(mcmc_fitter.model.as_parfile()),
+        param_names=np.array(list(mcmc_fitter.bt.param_names)),
+        chain_tail=tail,
+        lnp_tail=mcmc_fitter.lnp[-keep_last:],
+        acceptance=np.float64(mcmc_fitter.acceptance),
+    )
+
+
+def resume_mcmc(path, toas, nsteps: int = 1000, seed: int = 1):
+    """Rebuild the model from a checkpoint and continue sampling from
+    the saved walker positions.  Returns the resumed MCMCFitter."""
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.sampler import MCMCFitter, run_ensemble
+
+    z = np.load(path, allow_pickle=False)
+    if str(z["kind"]) != "mcmc":
+        raise ValueError("not an MCMC checkpoint")
+    model = get_model(str(z["parfile"]))
+    mf = MCMCFitter(toas, model)
+    last = z["chain_tail"][-1]  # (nwalkers, ndim)
+    nwalkers = last.shape[0]
+    chain, lnp, acc = run_ensemble(
+        mf.bt.lnposterior, last.mean(axis=0), nwalkers=nwalkers,
+        nsteps=nsteps, seed=seed,
+        init_cov=np.cov(last.T) + 1e-300 * np.eye(last.shape[1]),
+    )
+    mf.chain, mf.lnp, mf.acceptance = chain, lnp, acc
+    return mf

@@ -112,10 +112,13 @@ def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
 
     Mixed precision by design: residuals, white-noise weighting, and
     M^T N^-1 M stay f64; only the reduced-rank CORRECTION term (the
-    noise covariance's low-rank part) is f32 (~1e-6 relative), which
-    perturbs step directions/uncertainties at the 1e-6 level — validated
-    against the f64 path in tests/test_pallas_kernels.py.  Requires a
-    pure-Fourier basis (CompiledModel.noise_fourier_spec).
+    noise covariance's low-rank part) runs f32.  Tested agreement vs
+    the f64 path (tests/test_pallas_kernels.py): step directions to
+    <2e-3 of the largest component, chi2 to <1e-3 relative,
+    uncertainties to <5e-3 — i.e. well under a per-iteration Gauss-
+    Newton tolerance, and iterated fits land within ~1e-2 sigma of the
+    f64 solution.  Requires a pure-Fourier basis
+    (CompiledModel.noise_fourier_spec).
     """
     from pint_tpu.ops.pallas_kernels import fourier_gram
 
@@ -156,20 +159,52 @@ def gls_step_full_cov(r, M, Ndiag, T, phi):
 
 class GLSFitter(Fitter):
     """Iterated GLS fit; also correct (equals WLS) with no correlated
-    noise in the model."""
+    noise in the model.
 
-    def __init__(self, toas: TOAs, model: TimingModel, full_cov: bool = False):
+    fused='auto' (default) uses the Pallas mixed-precision fused-Gram
+    Woodbury on accelerators when the correlated noise is a pure
+    Fourier basis (see gls_step_woodbury_fourier for the validated
+    accuracy bounds); fused=False forces the all-f64 path, fused=True
+    forces the fused path (errors if the noise structure disallows it).
+    """
+
+    def __init__(self, toas: TOAs, model: TimingModel,
+                 full_cov: bool = False, fused="auto"):
         super().__init__(toas, model)
         self.full_cov = full_cov
+        self.fused = fused
+
+    def _use_fused(self) -> bool:
+        if self.full_cov or self.fused is False:
+            return False
+        has_spec = self.cm.noise_fourier_spec(self.cm.x0()) is not None
+        if self.fused is True:
+            if not has_spec:
+                from pint_tpu.exceptions import PintTpuError
+
+                raise PintTpuError(
+                    "fused=True needs a single pure-Fourier correlated-"
+                    "noise basis (PL red noise)"
+                )
+            return True
+        # 'auto': accelerators only (interpret-mode Pallas on CPU is
+        # correct but slow)
+        return has_spec and jax.default_backend() != "cpu"
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
         full_cov = self.full_cov
+        use_fused = self._use_fused()
 
         @jax.jit
         def step(x):
             r = self.cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
             Ndiag = jnp.square(self.cm.scaled_sigma(x))
+            if use_fused:
+                t_sec, freqs, phi = self.cm.noise_fourier_spec(x)
+                return gls_step_woodbury_fourier(
+                    r, M, Ndiag, t_sec, freqs, phi
+                )
             # pure white: Woodbury with the empty basis degenerates to
             # WLS normal equations
             T, phi = self.cm.noise_basis_or_empty(x)

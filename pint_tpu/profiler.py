@@ -1,0 +1,61 @@
+"""Profiling hooks (reference parity: SURVEY.md §5 — the reference has
+manual cProfile scripts; the TPU equivalent is jax.profiler traces plus
+lightweight per-phase wall timers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a jax.profiler trace viewable in TensorBoard/Perfetto:
+
+        with device_trace("/tmp/trace"):
+            fitter.fit_toas()
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Named wall-clock phases with block_until_ready fencing:
+
+        timer = PhaseTimer()
+        with timer("ingest"): ...
+        with timer("fit"): ...
+        print(timer.report())
+    """
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, fence=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None:
+                jax.tree_util.tree_leaves(fence)[0].block_until_ready()
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = [f"{'phase':<24}{'calls':>7}{'total s':>12}{'mean ms':>12}"]
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            tot = self.totals[name]
+            n = self.counts[name]
+            lines.append(
+                f"{name:<24}{n:>7}{tot:>12.3f}{tot / n * 1e3:>12.2f}"
+            )
+        return "\n".join(lines)

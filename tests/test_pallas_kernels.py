@@ -99,6 +99,31 @@ def test_gls_fourier_step_matches_f64():
     np.testing.assert_allclose(s32, s64, rtol=5e-3)
 
 
+def test_gls_fitter_fused_matches_f64():
+    """GLSFitter(fused=True) — the path auto-selected on accelerators —
+    must land on the f64 fit within ~1e-2 sigma."""
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR F\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+        "TNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 10\n"
+    )
+    m_true, toas = make_test_pulsar(par, ntoa=200, seed=6)
+    m64, m32 = get_model(par), get_model(par)
+    c64 = GLSFitter(toas, m64, fused=False).fit_toas(maxiter=3)
+    c32 = GLSFitter(toas, m32, fused=True).fit_toas(maxiter=3)
+    assert c32 == pytest.approx(c64, rel=1e-3)
+    for n in ("F0", "F1", "DM"):
+        v64, v32 = m64.params[n].value, m32.params[n].value
+        if hasattr(v64, "to_float"):
+            v64, v32 = float(v64.to_float()), float(v32.to_float())
+        s = m64.params[n].uncertainty
+        assert abs(v64 - v32) < 2e-2 * s, n
+        assert m32.params[n].uncertainty == pytest.approx(s, rel=1e-2)
+
+
 def test_fourier_gram_weights_zero_padding():
     """Zero-weight TOAs must contribute nothing (the PTA/shard padding
     convention rides on this)."""

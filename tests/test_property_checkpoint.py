@@ -1,0 +1,125 @@
+"""Property-based precision tests (hypothesis; the reference uses the
+same strategy for its pulsar_mjd round-trips — SURVEY.md §4) plus
+checkpoint/resume and profiler smoke tests."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from pint_tpu.simulation import make_test_pulsar
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.timebase.times import TimeArray
+
+PAR = """PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+"""
+
+mjd_strings = st.builds(
+    lambda day, frac: f"{day}.{frac}",
+    st.integers(41684, 69000),
+    st.text("0123456789", min_size=1, max_size=19),
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(mjd_strings)
+def test_pulsar_mjd_string_roundtrip(s):
+    """parse -> serialize -> parse is exact (the reference's
+    tests/test_precision.py property)."""
+    t = TimeArray.from_mjd_strings([s], scale="tdb")
+    out = t.to_mjd_strings(25)[0]
+    t2 = TimeArray.from_mjd_strings([out], scale="tdb")
+    assert t2.mjd_int[0] == t.mjd_int[0]
+    assert t2.sec.hi[0] == t.sec.hi[0]
+    assert abs(t2.sec.lo[0] - t.sec.lo[0]) < 1e-22
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.integers(41684, 69000),
+    st.floats(0.0, 86399.999),
+    st.sampled_from(["tai", "tt", "tdb", "tcb", "tcg"]),
+)
+def test_time_scale_roundtrip(day, sec, scale):
+    """to_scale there-and-back is exact to <5e-15 s for every uniform
+    scale pair (the TCB/TCG rate constants round at ~1e-16 relative of
+    the ~15 s offset; leap-second UTC is handled by its own tests)."""
+    t = TimeArray(np.array([day]), HostDD(np.array([sec])), "tdb")
+    back = t.to_scale(scale).to_scale("tdb")
+    dsec = (back.mjd_int[0] - t.mjd_int[0]) * 86400.0 + float(
+        (back.sec - t.sec).to_float()[0]
+    )
+    assert abs(dsec) < 5e-15
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.floats(-1e9, 1e9), st.floats(-1.0, 1.0), st.floats(1e-9, 1e3)
+)
+def test_hostdd_sum_product_identities(a, b, c):
+    """(a + b) - a == b and (a*c)/c == a at DD precision."""
+    s = HostDD.from_sum(a, b)
+    db = (s - a).to_float()
+    assert db == pytest.approx(b, abs=max(1e-25, abs(a) * 1e-30))
+    p = HostDD.from_prod(a, c)
+    assert float((p / c).to_float()) == pytest.approx(
+        a, rel=1e-28, abs=1e-300
+    )
+
+
+def test_fit_checkpoint_roundtrip(tmp_path):
+    from pint_tpu.checkpoint import load_fit, save_fit
+    from pint_tpu.fitting import WLSFitter
+
+    m, toas = make_test_pulsar(PAR, ntoa=40)
+    f = WLSFitter(toas, m)
+    chi2 = f.fit_toas()
+    path = tmp_path / "fit.npz"
+    save_fit(path, f)
+    state = load_fit(path)
+    assert state["chi2"] == pytest.approx(chi2)
+    assert state["free_names"] == list(f.cm.free_names)
+    np.testing.assert_allclose(
+        state["cov"], f.parameter_covariance_matrix
+    )
+    f0 = float(state["model"].params["F0"].value.to_float())
+    assert f0 == pytest.approx(
+        float(m.params["F0"].value.to_float()), abs=1e-18
+    )
+
+
+def test_mcmc_checkpoint_resume(tmp_path):
+    from pint_tpu.checkpoint import resume_mcmc, save_mcmc
+    from pint_tpu.sampler import MCMCFitter
+
+    m, toas = make_test_pulsar(PAR, ntoa=40)
+    mf = MCMCFitter(toas, m)
+    mf.fit_toas(nsteps=120, nwalkers=16, seed=0)
+    path = tmp_path / "mcmc.npz"
+    save_mcmc(path, mf, keep_last=50)
+    mf2 = resume_mcmc(path, toas, nsteps=60, seed=1)
+    assert mf2.chain.shape[0] == 60
+    assert 0.05 < mf2.acceptance < 0.98
+    # resumed posterior stays in the same region
+    i = mf.bt.param_names.index("F0")
+    s1 = mf.get_posterior_samples()[:, i]
+    s2 = mf2.get_posterior_samples()[:, i]
+    assert abs(np.median(s2) - np.median(s1)) < 6 * np.std(s1)
+
+
+def test_phase_timer():
+    import jax.numpy as jnp
+
+    from pint_tpu.profiler import PhaseTimer
+
+    timer = PhaseTimer()
+    with timer("a"):
+        x = jnp.ones(10) * 2
+    with timer("a", fence=x):
+        y = x + 1
+    rep = timer.report()
+    assert "a" in rep and "2" in rep
