@@ -12,12 +12,16 @@ preemptions.
 
 from __future__ import annotations
 
-import json
-from pathlib import Path
-
 import numpy as np
 
 _VERSION = 1
+
+
+def _npz_path(path) -> str:
+    """np.savez appends .npz when missing; normalize so save/load pairs
+    round-trip with the same path string."""
+    s = str(path)
+    return s if s.endswith(".npz") else s + ".npz"
 
 
 def save_fit(path, fitter):
@@ -25,7 +29,7 @@ def save_fit(path, fitter):
     if fitter.parameter_covariance_matrix is None:
         raise ValueError("fit before checkpointing")
     np.savez_compressed(
-        path,
+        _npz_path(path),
         version=_VERSION,
         kind="fit",
         parfile=np.array(fitter.model.as_parfile()),
@@ -42,7 +46,7 @@ def load_fit(path):
     serialization)."""
     from pint_tpu.models.builder import get_model
 
-    z = np.load(path, allow_pickle=False)
+    z = np.load(_npz_path(path), allow_pickle=False)
     if int(z["version"]) > _VERSION:
         raise ValueError(
             f"checkpoint version {int(z['version'])} is newer than "
@@ -64,7 +68,7 @@ def save_mcmc(path, mcmc_fitter, keep_last: int = 200):
         raise ValueError("sample before checkpointing")
     tail = mcmc_fitter.chain[-keep_last:]
     np.savez_compressed(
-        path,
+        _npz_path(path),
         version=_VERSION,
         kind="mcmc",
         parfile=np.array(mcmc_fitter.model.as_parfile()),
@@ -81,17 +85,17 @@ def resume_mcmc(path, toas, nsteps: int = 1000, seed: int = 1):
     from pint_tpu.models.builder import get_model
     from pint_tpu.sampler import MCMCFitter, run_ensemble
 
-    z = np.load(path, allow_pickle=False)
+    z = np.load(_npz_path(path), allow_pickle=False)
     if str(z["kind"]) != "mcmc":
         raise ValueError("not an MCMC checkpoint")
     model = get_model(str(z["parfile"]))
     mf = MCMCFitter(toas, model)
     last = z["chain_tail"][-1]  # (nwalkers, ndim)
-    nwalkers = last.shape[0]
+    # TRUE resume: the equilibrated ensemble continues from its exact
+    # positions (multimodality preserved) — no re-initialization ball
     chain, lnp, acc = run_ensemble(
-        mf.bt.lnposterior, last.mean(axis=0), nwalkers=nwalkers,
-        nsteps=nsteps, seed=seed,
-        init_cov=np.cov(last.T) + 1e-300 * np.eye(last.shape[1]),
+        mf.bt.lnposterior, last.mean(axis=0),
+        nsteps=nsteps, seed=seed, init_walkers=last,
     )
     mf.chain, mf.lnp, mf.acceptance = chain, lnp, acc
     return mf

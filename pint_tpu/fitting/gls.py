@@ -175,6 +175,13 @@ class GLSFitter(Fitter):
         self.fused = fused
 
     def _use_fused(self) -> bool:
+        if self.fused is True and self.full_cov:
+            from pint_tpu.exceptions import PintTpuError
+
+            raise PintTpuError(
+                "fused=True and full_cov=True are mutually exclusive "
+                "(the fused path is reduced-rank by construction)"
+            )
         if self.full_cov or self.fused is False:
             return False
         has_spec = self.cm.noise_fourier_spec(self.cm.x0()) is not None

@@ -1,0 +1,99 @@
+"""Explicitly-sharded GLS: shard_map + psum over the TOA axis.
+
+SURVEY.md §5 "distributed communication backend": the framework's
+collective layer is XLA collectives over the mesh — here made explicit
+with shard_map so the communication pattern is auditable and portable
+to multi-host slices (ICI within a slice, DCN across; the same psum
+works over both).
+
+The GLS normal equations decompose exactly over TOA shards:
+
+  M^T N^-1 M   = sum_s  M_s^T N_s^-1 M_s          (psum, (p, p))
+  T^T N^-1 T   = sum_s  T_s^T N_s^-1 T_s          (psum, (k, k))
+  T^T N^-1 M/r = sum_s  ...                       (psum, (k, p+1))
+  r^T N^-1 r   = sum_s  ...                       (psum, scalar)
+
+so each device touches only its TOA shard; the only communication is
+the psum of small (p, p)/(k, k)/(k, p) blocks — O(k^2) bytes per step,
+independent of n.  The k x k and p x p solves then run replicated.
+This is the pjit-autosharding path's explicit twin: results match
+gls_step_woodbury exactly (tests/test_sharded_gls.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pint_tpu.fitting.gls import _chol_solve, _finish_normal_eqs
+
+
+def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
+    """One Woodbury GLS solve with the TOA axis sharded over `axis`.
+
+    r (n,), M (n, p), Ndiag (n,), T (n, k) must have n divisible by the
+    mesh axis size (pad with ~infinite-error TOAs via parallel.mesh /
+    parallel.pta helpers).  phi (k,) is replicated.
+    Returns (dx (p,), cov (p, p), chi2, n_degenerate) — identical to
+    gls_step_woodbury.
+    """
+    from jax import shard_map
+
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+
+    def local_blocks(r_s, M_s, Nd_s, T_s):
+        """Per-shard partial sums; psum makes them global."""
+        Ninv = 1.0 / Nd_s
+        NM = M_s * Ninv[:, None]
+        blocks = (
+            M_s.T @ NM,                 # (p, p)
+            T_s.T @ (T_s * Ninv[:, None]),  # (k, k)
+            T_s.T @ NM,                 # (k, p)
+            M_s.T @ (Ninv * r_s),       # (p,)
+            T_s.T @ (Ninv * r_s),       # (k,)
+            jnp.dot(r_s, Ninv * r_s),   # ()
+        )
+        return jax.tree_util.tree_map(
+            lambda b: jax.lax.psum(b, axis), blocks
+        )
+
+    sm = shard_map(
+        local_blocks,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis, None), P(axis), P(axis, None)),
+        out_specs=(P(), P(), P(), P(), P(), P()),
+    )
+
+    # column normalization must be global: compute norms first (also a
+    # psum under the hood via jnp on sharded input)
+    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+
+    MNM, TNT, TNM, MNr, TNr, rNr = sm(r, Mn, Ndiag, T)
+
+    # replicated small solves (Woodbury assembly)
+    Sigma = jnp.diag(1.0 / phi) + TNT
+    corrM = _chol_solve(Sigma, TNM)       # Sigma^-1 T^T N^-1 Mn
+    corrR = _chol_solve(Sigma, TNr[:, None])[:, 0]
+    A = MNM - TNM.T @ corrM
+    b = -(MNr - TNM.T @ corrR)
+    r_cinv_r = rNr - jnp.dot(TNr, corrR)
+    return _finish_normal_eqs(A, b, r_cinv_r, norm)
+
+
+def place_gls_operands(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
+    """Device-put the operands with the sharding sharded_gls_step
+    expects (TOA axis across `axis`, phi replicated)."""
+    shard1 = NamedSharding(mesh, P(axis))
+    shard2 = NamedSharding(mesh, P(axis, None))
+    repl = NamedSharding(mesh, P())
+    return (
+        jax.device_put(r, shard1),
+        jax.device_put(M, shard2),
+        jax.device_put(Ndiag, shard1),
+        jax.device_put(T, shard2),
+        jax.device_put(phi, repl),
+    )

@@ -26,12 +26,32 @@ def device_trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+class _Phase:
+    """Handle yielded by PhaseTimer: register the block's result with
+    .fence(value) so EVERY device leaf is block_until_ready'd before the
+    clock stops (jax dispatch is async — without a fence the timer
+    records dispatch latency, not compute)."""
+
+    def __init__(self):
+        self._fences = []
+
+    def fence(self, value):
+        self._fences.append(value)
+        return value
+
+    def _wait(self):
+        for v in self._fences:
+            for leaf in jax.tree_util.tree_leaves(v):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+
+
 class PhaseTimer:
-    """Named wall-clock phases with block_until_ready fencing:
+    """Named wall-clock phases with device fencing:
 
         timer = PhaseTimer()
-        with timer("ingest"): ...
-        with timer("fit"): ...
+        with timer("fit") as ph:
+            result = ph.fence(step(x))   # all leaves synced at exit
         print(timer.report())
     """
 
@@ -40,13 +60,13 @@ class PhaseTimer:
         self.counts = defaultdict(int)
 
     @contextlib.contextmanager
-    def __call__(self, name: str, fence=None):
+    def __call__(self, name: str):
+        ph = _Phase()
         t0 = time.perf_counter()
         try:
-            yield
+            yield ph
         finally:
-            if fence is not None:
-                jax.tree_util.tree_leaves(fence)[0].block_until_ready()
+            ph._wait()
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
 

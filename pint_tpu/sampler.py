@@ -27,33 +27,43 @@ def run_ensemble(
     seed: int = 0,
     init_scale=1e-8,
     init_cov=None,
+    init_walkers=None,
 ):
     """Sample lnpost with stretch moves.
 
-    x0 (ndim,): starting point.  Walkers start in a ball shaped by
-    init_cov (ndim, ndim) if given, else isotropic init_scale (scalar or
-    per-dim vector).  Stretch moves are affine-invariant, but a
-    well-shaped initial ensemble is what makes them mix immediately when
-    parameter scales span many decades.  Returns (chain (nsteps,
-    nwalkers, ndim), lnp (nsteps, nwalkers), acceptance_fraction).
+    x0 (ndim,): starting point.  Walkers start at init_walkers
+    (nwalkers, ndim) when given — the exact-resume path used by
+    checkpoint.resume_mcmc — else in a ball shaped by init_cov
+    (ndim, ndim), else isotropic init_scale (scalar or per-dim vector).
+    Stretch moves are affine-invariant, but a well-shaped initial
+    ensemble is what makes them mix immediately when parameter scales
+    span many decades.  Returns (chain (nsteps, nwalkers, ndim),
+    lnp (nsteps, nwalkers), acceptance_fraction).
     """
     ndim = int(np.asarray(x0).shape[-1])
-    if nwalkers < 2 * ndim:
-        nwalkers = 2 * ndim
-    if nwalkers % 2:
-        nwalkers += 1
+    if init_walkers is not None:
+        walkers = jnp.asarray(init_walkers)
+        nwalkers = walkers.shape[0]
+        if nwalkers % 2:
+            raise ValueError("init_walkers needs an even walker count")
+    else:
+        if nwalkers < 2 * ndim:
+            nwalkers = 2 * ndim
+        if nwalkers % 2:
+            nwalkers += 1
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
-    ball = jax.random.normal(k0, (nwalkers, ndim))
-    if init_cov is not None:
-        L = jnp.linalg.cholesky(
-            jnp.asarray(init_cov)
-            + 1e-30 * jnp.eye(ndim) * jnp.max(jnp.diag(init_cov))
-        )
-        offs = ball @ L.T
-    else:
-        offs = ball * jnp.asarray(init_scale)
-    walkers = jnp.asarray(x0) + offs
+    if init_walkers is None:
+        ball = jax.random.normal(k0, (nwalkers, ndim))
+        if init_cov is not None:
+            L = jnp.linalg.cholesky(
+                jnp.asarray(init_cov)
+                + 1e-30 * jnp.eye(ndim) * jnp.max(jnp.diag(init_cov))
+            )
+            offs = ball @ L.T
+        else:
+            offs = ball * jnp.asarray(init_scale)
+        walkers = jnp.asarray(x0) + offs
     lnpost_v = jax.vmap(lnpost)
     lp = lnpost_v(walkers)
     half = nwalkers // 2

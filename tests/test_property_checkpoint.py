@@ -117,9 +117,22 @@ def test_phase_timer():
     from pint_tpu.profiler import PhaseTimer
 
     timer = PhaseTimer()
-    with timer("a"):
-        x = jnp.ones(10) * 2
-    with timer("a", fence=x):
-        y = x + 1
+    with timer("a") as ph:
+        x = ph.fence(jnp.ones(10) * 2)
+    with timer("a") as ph:
+        ph.fence((x + 1, x * 2))  # pytree fence: every leaf synced
     rep = timer.report()
     assert "a" in rep and "2" in rep
+
+
+def test_checkpoint_path_without_extension(tmp_path):
+    from pint_tpu.checkpoint import load_fit, save_fit
+    from pint_tpu.fitting import WLSFitter
+
+    m, toas = make_test_pulsar(PAR, ntoa=30)
+    f = WLSFitter(toas, m)
+    f.fit_toas()
+    bare = str(tmp_path / "ck")  # no .npz: save/load must round-trip
+    save_fit(bare, f)
+    state = load_fit(bare)
+    assert state["chi2"] == pytest.approx(f.chi2)
