@@ -489,6 +489,18 @@ class CompiledModel:
             jnp.ones(1) * 1e-40,
         )
 
+    def noise_covariance(self, x):
+        """Dense (n, n) noise covariance C = diag(N) + T phi T^T
+        (reference: TimingModel.covariance_matrix / the full_cov GLS
+        input).  O(n^2) memory — diagnostics and small-n use only."""
+        Ndiag = jnp.square(self.scaled_sigma(x))
+        C = jnp.diag(Ndiag)
+        bw = self.noise_basis(x)
+        if bw is not None:
+            T, phi = bw
+            C = C + (T * phi[None, :]) @ T.T
+        return C
+
     def noise_fourier_spec(self, x):
         """(t_seconds, freqs, phi) when the model's correlated noise is
         exactly one pure-Fourier basis (PL red noise) — the shape the

@@ -40,9 +40,6 @@ def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
     """
     from jax import shard_map
 
-    shard = NamedSharding(mesh, P(axis))
-    repl = NamedSharding(mesh, P())
-
     def local_blocks(r_s, M_s, Nd_s, T_s):
         """Per-shard partial sums; psum makes them global."""
         Ninv = 1.0 / Nd_s
@@ -66,10 +63,11 @@ def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
         out_specs=(P(), P(), P(), P(), P(), P()),
     )
 
-    # column normalization must be global: compute norms first (also a
-    # psum under the hood via jnp on sharded input)
-    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
+    # column normalization must be global (shared helper keeps this
+    # path numerically identical to the unsharded one)
+    from pint_tpu.fitting.gls import _column_norms
+
+    norm = _column_norms(M)
     Mn = M / norm[None, :]
 
     MNM, TNT, TNM, MNr, TNr, rNr = sm(r, Mn, Ndiag, T)

@@ -151,3 +151,60 @@ class TOAs:
             f"TOAs(n={len(self)}, mjd {self.first_mjd():.1f}-"
             f"{self.last_mjd():.1f}, obs {sorted(set(self.obs))})"
         )
+
+
+def merge_TOAs(toas_list) -> TOAs:
+    """Concatenate TOA sets (reference: toa.merge_TOAs).  Computed
+    columns merge only when present on every member (else they reset to
+    None and a re-ingest is needed); the result is time-sorted.
+    Members ingested with different ephemerides refuse to merge (their
+    geometry columns would be inconsistent)."""
+    if not toas_list:
+        raise ValueError("nothing to merge")
+    t0 = toas_list[0]
+    ephems = {t.ephem for t in toas_list if t.ephem is not None}
+    if len(ephems) > 1:
+        raise ValueError(
+            f"cannot merge TOAs ingested with different ephemerides: "
+            f"{sorted(ephems)}"
+        )
+    out = TOAs(
+        TimeArray(
+            np.concatenate([t.t.mjd_int for t in toas_list]),
+            HostDD(
+                np.concatenate([t.t.sec.hi for t in toas_list]),
+                np.concatenate([t.t.sec.lo for t in toas_list]),
+            ),
+            t0.t.scale,
+        ),
+        np.concatenate([t.freq for t in toas_list]),
+        np.concatenate([t.error_us for t in toas_list]),
+        sum((t.obs for t in toas_list), []),
+        sum(([dict(f) for f in t.flags] for t in toas_list), []),
+    )
+    if any(t.t.scale != t0.t.scale for t in toas_list):
+        raise ValueError("cannot merge TOAs with different time scales")
+    for col in TOAs._COMPUTED_COLS:
+        vals = [getattr(t, col) for t in toas_list]
+        if all(v is not None for v in vals):
+            setattr(out, col, np.concatenate(vals))
+    if all(t.t_tdb is not None for t in toas_list):
+        out.t_tdb = TimeArray(
+            np.concatenate([t.t_tdb.mjd_int for t in toas_list]),
+            HostDD(
+                np.concatenate([t.t_tdb.sec.hi for t in toas_list]),
+                np.concatenate([t.t_tdb.sec.lo for t in toas_list]),
+            ),
+            "tdb",
+        )
+    bodies = set().union(*(t.obs_planet_pos for t in toas_list))
+    for b in bodies:
+        if all(b in t.obs_planet_pos for t in toas_list):
+            out.obs_planet_pos[b] = np.concatenate(
+                [t.obs_planet_pos[b] for t in toas_list]
+            )
+    out.ephem = next(iter(ephems), None)
+    for t in toas_list:
+        out.clock_info.update(t.clock_info)
+    out.sort()
+    return out

@@ -38,21 +38,9 @@ DMXR2_0003 55410
             freq_mhz=np.resize([700.0, 1400.0], 40),
         )
         chunks.append(t)
-    # concatenate chunk TOAs into one set
-    from pint_tpu.timebase.hostdd import HostDD
-    from pint_tpu.timebase.times import TimeArray
-    from pint_tpu.toas.toas import TOAs
+    from pint_tpu.toas.toas import merge_TOAs
 
-    day = np.concatenate([c.t.mjd_int for c in chunks])
-    hi = np.concatenate([c.t.sec.hi for c in chunks])
-    lo = np.concatenate([c.t.sec.lo for c in chunks])
-    toas = TOAs(
-        TimeArray(day, HostDD(hi, lo), "utc"),
-        np.concatenate([c.freq for c in chunks]),
-        np.concatenate([c.error_us for c in chunks]),
-        sum((c.obs for c in chunks), []),
-        sum((c.flags for c in chunks), []),
-    )
+    toas = merge_TOAs(chunks)
     toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, len(toas)))
     ingest_barycentric(toas)
 
@@ -81,3 +69,29 @@ DMXR2_0003 55410
     )
     assert np.all(out["dmx_verrs"] < 1e-4)
     assert out["dmx_epochs"][0] == pytest.approx(55000, abs=10)
+
+
+def test_merge_toas_and_noise_covariance():
+    from pint_tpu.toas.toas import merge_TOAs
+
+    par = BASE + "TNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 4\n"
+    m, t1 = make_test_pulsar(par, ntoa=30, seed=1)
+    _, t2 = make_test_pulsar(par, ntoa=20, seed=2,
+                             start_mjd=56100, end_mjd=56900)
+    merged = merge_TOAs([t1, t2])
+    assert len(merged) == 50
+    assert np.all(np.diff(merged.mjd_float()) > 0)
+    assert merged.t_tdb is not None  # ingested columns carried through
+    # dense noise covariance equals the Woodbury structure
+    import jax.numpy as jnp
+
+    cm = m.compile(t1)
+    x = cm.x0()
+    C = np.asarray(cm.noise_covariance(x))
+    assert C.shape == (30, 30)
+    T, phi = cm.noise_basis_or_empty(x)
+    Nd = jnp.square(cm.scaled_sigma(x))
+    np.testing.assert_allclose(
+        C, np.diag(np.asarray(Nd))
+        + np.asarray((T * phi[None, :]) @ T.T), rtol=1e-12
+    )
