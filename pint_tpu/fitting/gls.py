@@ -105,50 +105,90 @@ def gls_step_woodbury(r, M, Ndiag, T, phi):
     return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M)
 
 
-def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
-    """Woodbury GLS with the Pallas fused-Gram kernels: the red-noise
-    basis T = [sin, cos](2 pi f t) is never materialized — its Gram
-    pieces stream through VMEM in f32 (ops/pallas_kernels.py).
+def _woodbury_mixed_tail(r, Mn, Ninv, sig_tt, twx, phi, norm,
+                         A_white=None):
+    """Shared mixed-precision Woodbury assembly: given the f32-grade
+    basis Grams sig_tt = T^T N^-1 T and twx = T^T N^-1 [Mn | r], build
+    and solve the normal equations.
 
-    Mixed precision by design: residuals, white-noise weighting, and
-    M^T N^-1 M stay f64; only the reduced-rank CORRECTION term (the
-    noise covariance's low-rank part) runs f32.  Tested agreement vs
-    the f64 path (tests/test_pallas_kernels.py): step directions to
-    <2e-3 of the largest component, chi2 to <1e-3 relative,
-    uncertainties to <5e-3 — i.e. well under a per-iteration Gauss-
-    Newton tolerance, and iterated fits land within ~1e-2 sigma of the
-    f64 solution.  Requires a pure-Fourier basis
-    (CompiledModel.noise_fourier_spec).
+    Precision contract (validated in tests/test_pallas_kernels.py and
+    tests/test_ffgram.py): the gradient's white part b_white and
+    r^T N^-1 r are exact-f64 matvec/dot — the Gauss-Newton FIXED POINT
+    is set by b, so the converged parameters inherit f64 accuracy; the
+    design Gram M^T N^-1 M runs as a chunked f32 MXU Gram with f64
+    chunk accumulation (~3e-8 relative); the basis correction terms and
+    the k x k factorization (equilibrated f32 Cholesky + f64 iterative
+    refinement) are f32-grade.  Net agreement vs the all-f64 path:
+    step directions <2e-3 of the largest component, chi2 <1e-3
+    relative, uncertainties <5e-3; iterated fits land within ~1e-2
+    sigma of the f64 solution.
     """
-    from pint_tpu.ops.pallas_kernels import fourier_gram
+    from pint_tpu.ops.ffgram import chol_solve_ir, gram32
 
-    Ninv = 1.0 / Ndiag
-    norm = _column_norms(M)
-    Mn = M / norm[None, :]
-    # f64 white-noise block (cheap: p is small)
-    A_white = Mn.T @ (Mn * Ninv[:, None])
-    b_white = Mn.T @ (Ninv * r)
+    if A_white is None:
+        A_white = gram32(Mn, Ninv)
+    b_white = Mn.T @ (Ninv * r)  # exact f64: sets the fixed point
     r_Nr = jnp.dot(r, Ninv * r)
-    # f32 fused Gram of the basis against [Mn | r]
-    X = jnp.concatenate([Mn, r[:, None]], axis=1)
-    sig_tt, twx = fourier_gram(t_sec, freqs, Ninv, X)
-    sig_tt = sig_tt.astype(jnp.float64)
-    twx = twx.astype(jnp.float64)
     Sigma = jnp.diag(1.0 / phi) + sig_tt
-    corr = _chol_solve(Sigma, twx)  # Sigma^-1 T^T N^-1 [Mn | r]
+    corr = chol_solve_ir(Sigma, twx)  # Sigma^-1 T^T N^-1 [Mn | r]
     A = A_white - twx[:, :-1].T @ corr[:, :-1]
     b = -(b_white - twx[:, :-1].T @ corr[:, -1])
     r_cinv_r = r_Nr - jnp.dot(twx[:, -1], corr[:, -1])
     return _finish_normal_eqs(A, b, r_cinv_r, norm)
 
 
+def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
+    """Woodbury GLS with the Pallas fused-Gram kernels: the red-noise
+    basis T = [sin, cos](2 pi f t) is never materialized — its Gram
+    pieces stream through VMEM in f32 (ops/pallas_kernels.py), then the
+    shared mixed-precision assembly (_woodbury_mixed_tail, which
+    documents the precision contract) finishes the solve.  Requires a
+    pure-Fourier basis (CompiledModel.noise_fourier_spec).
+    """
+    from pint_tpu.ops.pallas_kernels import fourier_gram
+
+    Ninv = 1.0 / Ndiag
+    norm = _column_norms(M)
+    Mn = M / norm[None, :]
+    X = jnp.concatenate([Mn, r[:, None]], axis=1)
+    sig_tt, twx = fourier_gram(t_sec, freqs, Ninv, X)
+    return _woodbury_mixed_tail(
+        r, Mn, Ninv,
+        sig_tt.astype(jnp.float64), twx.astype(jnp.float64), phi, norm,
+    )
+
+
+def gls_step_woodbury_mixed(r, M, Ndiag, T, phi):
+    """Woodbury GLS for an arbitrary reduced-rank basis (ECORR
+    quantization blocks, combined ECORR+Fourier stacks) with the noise
+    side in f32 on the MXU — the general-basis sibling of the Pallas
+    fourier path, same validated tolerance class.
+
+    The basis columns T only carry f32 information (0/1 quantization
+    entries are exact; Fourier columns are smooth O(1) values), so
+    T^T N^-1 T and T^T N^-1 [M | r] run as one chunked f32 MXU Gram
+    (ops/ffgram.py); the shared mixed-precision assembly
+    (_woodbury_mixed_tail, which documents the precision contract)
+    finishes the solve.
+    """
+    from pint_tpu.ops.ffgram import gram32_joint
+
+    Ninv = 1.0 / Ndiag
+    norm = _column_norms(M)
+    Mn = M / norm[None, :]
+    X = jnp.concatenate([Mn, r[:, None]], axis=1)
+    sig_tt, twx, G_XX = gram32_joint(T.astype(jnp.float32), X, Ninv)
+    return _woodbury_mixed_tail(
+        r, Mn, Ninv, sig_tt, twx, phi, norm, A_white=G_XX[:-1, :-1]
+    )
+
+
 def gls_step_full_cov(r, M, Ndiag, T, phi):
     """Dense-covariance path: C = diag(N) + T phi T^T, explicit n x n
     Cholesky (reference full_cov=True)."""
-    C = jnp.diag(Ndiag)
-    if T is not None:
-        C = C + (T * phi[None, :]) @ T.T
-    L = jnp.linalg.cholesky(C)
+    from pint_tpu.models.noise import dense_noise_cov
+
+    L = jnp.linalg.cholesky(dense_noise_cov(Ndiag, T, phi))
 
     def cinv_mult(X):
         Y = jax.scipy.linalg.solve_triangular(L, X, lower=True)
@@ -161,11 +201,13 @@ class GLSFitter(Fitter):
     """Iterated GLS fit; also correct (equals WLS) with no correlated
     noise in the model.
 
-    fused='auto' (default) uses the Pallas mixed-precision fused-Gram
-    Woodbury on accelerators when the correlated noise is a pure
-    Fourier basis (see gls_step_woodbury_fourier for the validated
-    accuracy bounds); fused=False forces the all-f64 path, fused=True
-    forces the fused path (errors if the noise structure disallows it).
+    fused='auto' (default) picks, on accelerators, the Pallas
+    fused-Gram Woodbury when the correlated noise is a pure Fourier
+    basis, or the general-basis mixed-precision MXU path otherwise
+    (see _woodbury_mixed_tail for the validated accuracy bounds);
+    fused=False forces the all-f64 path (always used on CPU),
+    fused=True forces the Pallas path (errors if the noise structure
+    disallows it).
     """
 
     def __init__(self, toas: TOAs, model: TimingModel,
@@ -173,8 +215,11 @@ class GLSFitter(Fitter):
         super().__init__(toas, model)
         self.full_cov = full_cov
         self.fused = fused
+        self._fit_loops: dict = {}
 
-    def _use_fused(self) -> bool:
+    def _step_mode(self) -> str:
+        """'fourier' (Pallas fused Gram), 'mixed' (general-basis f32
+        MXU), 'f64' (all-f64 XLA), or 'full_cov' (dense n x n)."""
         if self.fused is True and self.full_cov:
             from pint_tpu.exceptions import PintTpuError
 
@@ -182,9 +227,15 @@ class GLSFitter(Fitter):
                 "fused=True and full_cov=True are mutually exclusive "
                 "(the fused path is reduced-rank by construction)"
             )
-        if self.full_cov or self.fused is False:
-            return False
-        has_spec = self.cm.noise_fourier_spec(self.cm.x0()) is not None
+        if self.full_cov:
+            return "full_cov"
+        if self.fused is False:
+            return "f64"
+        # eval_shape: trace-only structure queries, no device work
+        has_spec = (
+            jax.eval_shape(self.cm.noise_fourier_spec, self.cm.x0())
+            is not None
+        )
         if self.fused is True:
             if not has_spec:
                 from pint_tpu.exceptions import PintTpuError
@@ -193,21 +244,23 @@ class GLSFitter(Fitter):
                     "fused=True needs a single pure-Fourier correlated-"
                     "noise basis (PL red noise)"
                 )
-            return True
-        # 'auto': accelerators only (interpret-mode Pallas on CPU is
-        # correct but slow)
-        return has_spec and jax.default_backend() != "cpu"
+            return "fourier"
+        # 'auto': mixed precision on accelerators only (on CPU native
+        # f64 is fast and interpret-mode Pallas is slow)
+        if jax.default_backend() == "cpu":
+            return "f64"
+        if has_spec:
+            return "fourier"
+        # pure-white models keep the exact f64 path (and tolerance):
+        # noise_basis_or_empty's dummy column is not a real basis
+        return "mixed" if self.cm.has_correlated_errors else "f64"
 
-    def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
-        full_cov = self.full_cov
-        use_fused = self._use_fused()
-
-        @jax.jit
+    def _make_step(self, mode: str):
         def step(x):
             r = self.cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
             Ndiag = jnp.square(self.cm.scaled_sigma(x))
-            if use_fused:
+            if mode == "fourier":
                 t_sec, freqs, phi = self.cm.noise_fourier_spec(x)
                 return gls_step_woodbury_fourier(
                     r, M, Ndiag, t_sec, freqs, phi
@@ -215,33 +268,102 @@ class GLSFitter(Fitter):
             # pure white: Woodbury with the empty basis degenerates to
             # WLS normal equations
             T, phi = self.cm.noise_basis_or_empty(x)
-            if full_cov:
+            if mode == "full_cov":
                 return gls_step_full_cov(r, M, Ndiag, T, phi)
+            if mode == "mixed":
+                return gls_step_woodbury_mixed(r, M, Ndiag, T, phi)
             return gls_step_woodbury(r, M, Ndiag, T, phi)
 
-        x = self.cm.x0()
-        chi2 = None
-        cov = None
-        for it in range(maxiter):
-            dx, cov, chi2_new, nbad = step(x)
-            if int(nbad):
-                from pint_tpu.exceptions import DegeneracyWarning
+        return step
 
-                warnings.warn(
-                    f"{int(nbad)} degenerate normal-equation directions "
-                    "zeroed in GLS solve",
-                    DegeneracyWarning,
-                )
-            chi2_new = float(chi2_new)
-            if not np.isfinite(chi2_new):
-                raise ConvergenceFailure("non-finite chi2 during GLS fit")
-            x = x + dx[self._noffset:]  # dx[0] is the offset column
-            if chi2 is not None and abs(chi2 - chi2_new) < tol_chi2 * max(
-                chi2_new, 1.0
-            ):
-                chi2 = chi2_new
-                self.converged = True
-                break
-            chi2 = chi2_new
+    def _make_fit_loop(self, mode: str, maxiter: int, tol_chi2: float):
+        """The whole Gauss-Newton iteration as ONE device program
+        (lax.scan), so a fit costs a single dispatch instead of
+        `maxiter` host round-trips (~85 ms each through the axon
+        tunnel).  Semantics match the reference host loop
+        (src/pint/fitter.py::GLSFitter.fit_toas): apply the step, stop
+        when chi2 stops moving, freeze on non-finite chi2 (the host
+        raises ConvergenceFailure from the reported flag afterwards).
+        """
+        step = self._make_step(mode)
+        no = self._noffset
+        nfree = len(self.cm.free_names)
+        p = nfree + no
 
-        return self._finalize(x, cov, float(chi2))
+        def zeros_like_step(_x):
+            return (
+                jnp.zeros((p,)),
+                jnp.zeros((p, p)),
+                jnp.asarray(jnp.inf),
+                jnp.asarray(0, jnp.int32),
+            )
+
+        def live_step(x):
+            dx, cov, chi2, nbad = step(x)
+            return dx, cov, chi2, nbad.astype(jnp.int32)
+
+        def body(carry, _):
+            x, chi2_prev, cov_prev, done, conv = carry
+            dx, cov, chi2, nbad = jax.lax.cond(
+                done, zeros_like_step, live_step, x
+            )
+            bad = ~jnp.isfinite(chi2)
+            x_new = jnp.where(done | bad, x, x + dx[no:])
+            converged = jnp.abs(chi2_prev - chi2) < tol_chi2 * jnp.maximum(
+                chi2, 1.0
+            )
+            chi2_keep = jnp.where(done | bad, chi2_prev, chi2)
+            cov_keep = jnp.where(done | bad, cov_prev, cov)
+            new_done = done | bad | converged
+            new_conv = conv | (converged & ~done)
+            return (
+                (x_new, chi2_keep, cov_keep, new_done, new_conv),
+                (chi2, nbad, bad & ~done),
+            )
+
+        @jax.jit
+        def fit_loop(x0):
+            init = (
+                x0,
+                jnp.asarray(jnp.inf),
+                jnp.zeros((p, p)),
+                jnp.asarray(False),
+                jnp.asarray(False),
+            )
+            (x, chi2, cov, _done, conv), (chi2s, nbads, bads) = jax.lax.scan(
+                body, init, None, length=maxiter
+            )
+            return x, chi2, cov, conv, chi2s, nbads, bads
+
+        return fit_loop
+
+    def fit_toas(self, maxiter: int = 4, tol_chi2: float | None = None) -> float:
+        mode = self._step_mode()
+        if tol_chi2 is None:
+            # the mixed-precision modes carry ~1e-6 relative f32 noise
+            # in chi2 between iterations; demanding the f64 tolerance
+            # there would spin to maxiter and report converged=False
+            tol_chi2 = 1e-10 if mode in ("f64", "full_cov") else 3e-6
+        key = (mode, maxiter, tol_chi2)
+        if key not in self._fit_loops:  # reuse compiled loops across
+            self._fit_loops[key] = self._make_fit_loop(*key)  # re-fits
+        x, chi2, cov, conv, chi2s, nbads, bads = self._fit_loops[key](
+            self.cm.x0()
+        )
+        nbads = np.asarray(nbads)
+        for nb in nbads[nbads > 0]:
+            from pint_tpu.exceptions import DegeneracyWarning
+
+            warnings.warn(
+                f"{int(nb)} degenerate normal-equation directions "
+                "zeroed in GLS solve",
+                DegeneracyWarning,
+            )
+        if np.any(np.asarray(bads)):
+            raise ConvergenceFailure("non-finite chi2 during GLS fit")
+        self.converged = bool(conv)
+        chi2 = self._finalize(x, cov, float(chi2))
+        # _finalize -> cm.commit() rebased cm.ref (x=0 is now the
+        # fitted model): compiled loops baked the old ref as constants
+        self._fit_loops.clear()
+        return chi2

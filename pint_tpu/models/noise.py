@@ -134,6 +134,19 @@ class ScaleDmError(NoiseComponent):
         return efac * jnp.sqrt(jnp.square(sigma_dm) + equad2)
 
 
+def dense_noise_cov(Ndiag, T, phi):
+    """Dense (n, n) noise covariance C = diag(Ndiag) + T diag(phi) T^T
+    — the single assembly shared by CompiledModel.noise_covariance and
+    the full_cov GLS path (reference: the full_cov=True input of
+    src/pint/fitter.py::GLSFitter.fit_toas)."""
+    import jax.numpy as jnp
+
+    C = jnp.diag(Ndiag)
+    if T is not None:
+        C = C + (T * phi[None, :]) @ T.T
+    return C
+
+
 def quantize_epochs(mjd: np.ndarray, select: np.ndarray,
                     gap_s: float = ECORR_EPOCH_GAP_S) -> np.ndarray:
     """Host-side: (n, n_epoch) 0/1 quantization matrix U grouping

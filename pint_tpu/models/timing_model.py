@@ -493,13 +493,12 @@ class CompiledModel:
         """Dense (n, n) noise covariance C = diag(N) + T phi T^T
         (reference: TimingModel.covariance_matrix / the full_cov GLS
         input).  O(n^2) memory — diagnostics and small-n use only."""
+        from pint_tpu.models.noise import dense_noise_cov
+
         Ndiag = jnp.square(self.scaled_sigma(x))
-        C = jnp.diag(Ndiag)
         bw = self.noise_basis(x)
-        if bw is not None:
-            T, phi = bw
-            C = C + (T * phi[None, :]) @ T.T
-        return C
+        T, phi = bw if bw is not None else (None, None)
+        return dense_noise_cov(Ndiag, T, phi)
 
     def noise_fourier_spec(self, x):
         """(t_seconds, freqs, phi) when the model's correlated noise is

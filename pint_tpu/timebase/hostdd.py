@@ -161,6 +161,10 @@ class HostDD:
     def to_float(self):
         return self.hi + self.lo
 
+    def __float__(self):
+        # scalar only (numpy raises on arrays, matching ndarray rules)
+        return float(self.hi + self.lo)
+
     def split_int_frac(self):
         ihi = np.floor(self.hi + 0.5)
         rem = HostDD(self.hi - ihi, self.lo).normalize()

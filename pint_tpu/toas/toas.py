@@ -162,12 +162,17 @@ def merge_TOAs(toas_list) -> TOAs:
     if not toas_list:
         raise ValueError("nothing to merge")
     t0 = toas_list[0]
-    ephems = {t.ephem for t in toas_list if t.ephem is not None}
-    if len(ephems) > 1:
+    # geometry consistency: members whose SSB geometry columns are
+    # populated must agree on the ephemeris that produced them —
+    # including ephem=None members (barycentric ingest), whose columns
+    # would otherwise silently concatenate under another member's tag
+    geom_ephems = {t.ephem for t in toas_list if t.ssb_obs_pos is not None}
+    if len(geom_ephems) > 1:
         raise ValueError(
-            f"cannot merge TOAs ingested with different ephemerides: "
-            f"{sorted(ephems)}"
+            "cannot merge TOAs with geometry computed under different "
+            f"ephemerides: {sorted(str(e) for e in geom_ephems)}"
         )
+    ephems = {t.ephem for t in toas_list}
     out = TOAs(
         TimeArray(
             np.concatenate([t.t.mjd_int for t in toas_list]),
@@ -203,7 +208,9 @@ def merge_TOAs(toas_list) -> TOAs:
             out.obs_planet_pos[b] = np.concatenate(
                 [t.obs_planet_pos[b] for t in toas_list]
             )
-    out.ephem = next(iter(ephems), None)
+    # single shared tag propagates; a mix (e.g. tagged + never-
+    # ingested) leaves the merged set untagged
+    out.ephem = ephems.pop() if len(ephems) == 1 else None
     for t in toas_list:
         out.clock_info.update(t.clock_info)
     out.sort()

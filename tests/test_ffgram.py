@@ -1,0 +1,88 @@
+"""Accuracy of the mixed-precision f32-MXU linear algebra
+(ops/ffgram.py) against all-f64 reference computations.
+
+Runs on the CPU test backend where f64 is IEEE, so these bounds are the
+real guarantees the TPU fast path inherits (both backends do IEEE f32
+multiplies at Precision.HIGHEST; in-chunk f32 accumulation order
+differs, bounded by the chunk size either way).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.ops.ffgram import chol_solve_ir, gram32, gram32_joint
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_gram32_matches_f64(rng):
+    n, p = 100_000, 12
+    A = jnp.asarray(rng.standard_normal((n, p)))
+    # columns with wildly different scales (pre-normalization design)
+    A = A * (10.0 ** rng.uniform(-6, 6, p))[None, :]
+    w = jnp.asarray(10.0 ** rng.uniform(-2, 2, n))
+    G = gram32(A, w)
+    G64 = (A * w[:, None]).T @ A
+    scale = np.sqrt(np.outer(np.diag(G64), np.diag(G64)))
+    rel = np.max(np.abs(np.asarray(G - G64)) / scale)
+    assert rel < 5e-7
+
+
+def test_gram32_chunk_padding_exact(rng):
+    # n not a multiple of the chunk: zero-padding must be exact
+    n, p = 1003, 3
+    A = jnp.asarray(rng.standard_normal((n, p)))
+    w = jnp.asarray(np.abs(rng.standard_normal(n)) + 0.1)
+    G = gram32(A, w, chunk=128)
+    G64 = (A * w[:, None]).T @ A
+    scale = np.sqrt(np.outer(np.diag(G64), np.diag(G64)))
+    assert np.max(np.abs(np.asarray(G - G64)) / scale) < 1e-6
+
+
+def test_gram32_joint_matches_f64(rng):
+    n, k, p = 10_000, 40, 9
+    t = np.sort(rng.uniform(0, 1e8, n))
+    freqs = (np.arange(1, k // 2 + 1)) / 1e8
+    arg = 2 * np.pi * freqs[None, :] * t[:, None]
+    T = np.concatenate([np.sin(arg), np.cos(arg)], axis=1)
+    A = jnp.asarray(rng.standard_normal((n, p)))
+    w = jnp.asarray(10.0 ** rng.uniform(-1, 1, n))
+    T32 = jnp.asarray(T, jnp.float32)
+    G_TT, G_TA, G_AA = gram32_joint(T32, A, w)
+    Tw = T * np.asarray(w)[:, None]
+    # T-blocks: f32-input-grade (the basis itself is only f32 accurate)
+    tt_scale = np.sqrt(np.outer(np.diag(Tw.T @ T), np.diag(Tw.T @ T)))
+    assert np.max(np.abs(np.asarray(G_TT) - Tw.T @ T) / tt_scale) < 1e-5
+    G64_TA = Tw.T @ np.asarray(A)
+    assert np.allclose(np.asarray(G_TA), G64_TA, rtol=0,
+                       atol=1e-5 * np.max(np.abs(G64_TA)))
+    # design block keeps near-f64 accuracy
+    G64 = (np.asarray(A) * np.asarray(w)[:, None]).T @ np.asarray(A)
+    scale = np.sqrt(np.outer(np.diag(G64), np.diag(G64)))
+    assert np.max(np.abs(np.asarray(G_AA) - G64) / scale) < 5e-7
+
+
+def test_chol_solve_ir_power_law_conditioning(rng):
+    # Woodbury Sigma = diag(1/phi) + T^T N^-1 T with power-law phi:
+    # diagonal dynamic range ~1e10 — the regime the equilibration +
+    # refinement is built for.
+    k = 60
+    phi = 1e-2 * (np.arange(1, k + 1) ** -4.0)
+    M = rng.standard_normal((k, k))
+    Sigma = jnp.asarray(np.diag(1.0 / phi) + M @ M.T * 1e3)
+    B = jnp.asarray(rng.standard_normal((k, 5)))
+    X = chol_solve_ir(Sigma, B)
+    X64 = np.linalg.solve(np.asarray(Sigma), np.asarray(B))
+    denom = np.max(np.abs(X64), axis=0, keepdims=True)
+    assert np.max(np.abs(np.asarray(X) - X64) / denom) < 1e-9
+
+
+def test_chol_solve_ir_identity():
+    A = jnp.eye(8) * 3.0
+    B = jnp.arange(16.0).reshape(8, 2)
+    assert np.allclose(np.asarray(chol_solve_ir(A, B)), np.asarray(B) / 3.0,
+                       rtol=1e-14)

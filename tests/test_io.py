@@ -200,3 +200,29 @@ def test_clock_file(tmp_path):
     tclk.write_text("  50000.0  1.5\n  51000.0  2.5\n")
     cft = ClockFile.from_tempo(tclk)
     np.testing.assert_allclose(cft.evaluate([50500.0]), 2.0e-6)
+
+
+def test_merge_refuses_mixed_geometry_provenance():
+    """A barycentric-ingested set (ephem=None, geometry populated) must
+    not merge with an ephemeris-tagged set: their geometry columns come
+    from different provenance (regression: the None member previously
+    slipped past the guard and inherited the other member's tag)."""
+    import copy
+
+    import pytest
+
+    from pint_tpu.simulation import make_test_pulsar
+    from pint_tpu.toas.toas import merge_TOAs
+
+    par = "PSR M\nF0 100.0\nPEPOCH 55000\n"
+    _, t1 = make_test_pulsar(par, ntoa=8, seed=0)
+    _, t2 = make_test_pulsar(par, ntoa=8, start_mjd=56100.0,
+                             end_mjd=56400.0, seed=1)
+    assert t1.ssb_obs_pos is not None
+    t2 = copy.deepcopy(t2)
+    t2.ephem = "DE440"
+    with pytest.raises(ValueError, match="different\\s+ephemerides"):
+        merge_TOAs([t1, t2])
+    # identical provenance still merges and keeps the (None) tag
+    merged = merge_TOAs([t1, copy.deepcopy(t1)])
+    assert merged.ephem is None and len(merged) == 16

@@ -147,3 +147,42 @@ def test_fourier_gram_weights_zero_padding():
     np.testing.assert_allclose(
         np.asarray(twx_full), np.asarray(twx_cut), atol=1e-3
     )
+
+
+def test_gls_mixed_step_matches_f64_ecorr():
+    """The general-basis mixed-precision step (gram32_joint path) must
+    agree with the f64 Woodbury path on an ECORR + red-noise model —
+    the basis shape the Pallas fourier path cannot take."""
+    import jax
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import (
+        gls_step_woodbury,
+        gls_step_woodbury_mixed,
+    )
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR E\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+        "EFAC -f L-wide 1.2\nECORR -f L-wide 0.8\n"
+        "TNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 8\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=240, seed=7)
+    cm = m.compile(toas)
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Nd = jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    assert T.shape[1] > 16  # ECORR epochs + 2*8 harmonics stacked
+    dx64, cov64, chi64, _ = jax.jit(gls_step_woodbury)(r, M, Nd, T, phi)
+    dxm, covm, chim, _ = jax.jit(gls_step_woodbury_mixed)(r, M, Nd, T, phi)
+    np.testing.assert_allclose(
+        np.asarray(dxm), np.asarray(dx64),
+        atol=2e-3 * np.max(np.abs(np.asarray(dx64))),
+    )
+    assert float(chim) == pytest.approx(float(chi64), rel=1e-3)
+    np.testing.assert_allclose(
+        np.sqrt(np.diag(np.asarray(covm))),
+        np.sqrt(np.diag(np.asarray(cov64))), rtol=5e-3,
+    )
