@@ -183,6 +183,17 @@ def gls_step_woodbury_mixed(r, M, Ndiag, T, phi):
     )
 
 
+def default_accel_mode(cm) -> str:
+    """The production precision policy shared by GLSFitter ('auto') and
+    PTABatch: mixed precision (f32 MXU) on accelerators when a
+    correlated basis exists, exact f64 on CPU backends and for
+    pure-white models (noise_basis_or_empty's dummy column is not a
+    real basis)."""
+    if jax.default_backend() == "cpu":
+        return "f64"
+    return "mixed" if cm.has_correlated_errors else "f64"
+
+
 def gls_step_full_cov(r, M, Ndiag, T, phi):
     """Dense-covariance path: C = diag(N) + T phi T^T, explicit n x n
     Cholesky (reference full_cov=True)."""
@@ -207,7 +218,8 @@ class GLSFitter(Fitter):
     (see _woodbury_mixed_tail for the validated accuracy bounds);
     fused=False forces the all-f64 path (always used on CPU),
     fused=True forces the Pallas path (errors if the noise structure
-    disallows it).
+    disallows it), fused='mixed' forces the general-basis
+    mixed-precision path (any backend — used by cross-path tests).
     """
 
     def __init__(self, toas: TOAs, model: TimingModel,
@@ -217,25 +229,45 @@ class GLSFitter(Fitter):
         self.fused = fused
         self._fit_loops: dict = {}
 
+    def _step_inputs(self, x):
+        """(residuals, design-with-offset, Ndiag) for one GLS step;
+        wideband overrides with the stacked [TOA; DM] blocks."""
+        r = self.cm.time_residuals(x, subtract_mean=False)
+        M = self._design_with_offset(x)
+        Ndiag = jnp.square(self.cm.scaled_sigma(x))
+        return r, M, Ndiag
+
+    def _step_noise(self, x):
+        """(T, phi) reduced-rank basis matching _step_inputs' rows."""
+        return self.cm.noise_basis_or_empty(x)
+
+    def _fourier_available(self) -> bool:
+        """Whether the Pallas pure-Fourier fused path applies; wideband
+        overrides to False (its rows are [TOA; DM]-stacked)."""
+        # eval_shape: trace-only structure query, no device work
+        return (
+            jax.eval_shape(self.cm.noise_fourier_spec, self.cm.x0())
+            is not None
+        )
+
     def _step_mode(self) -> str:
         """'fourier' (Pallas fused Gram), 'mixed' (general-basis f32
         MXU), 'f64' (all-f64 XLA), or 'full_cov' (dense n x n)."""
-        if self.fused is True and self.full_cov:
+        if self.full_cov and self.fused in (True, "mixed"):
             from pint_tpu.exceptions import PintTpuError
 
             raise PintTpuError(
-                "fused=True and full_cov=True are mutually exclusive "
-                "(the fused path is reduced-rank by construction)"
+                f"fused={self.fused!r} and full_cov=True are mutually "
+                "exclusive (the fused/mixed paths are reduced-rank by "
+                "construction)"
             )
         if self.full_cov:
             return "full_cov"
         if self.fused is False:
             return "f64"
-        # eval_shape: trace-only structure queries, no device work
-        has_spec = (
-            jax.eval_shape(self.cm.noise_fourier_spec, self.cm.x0())
-            is not None
-        )
+        if self.fused == "mixed":
+            return "mixed"
+        has_spec = self._fourier_available()
         if self.fused is True:
             if not has_spec:
                 from pint_tpu.exceptions import PintTpuError
@@ -247,19 +279,13 @@ class GLSFitter(Fitter):
             return "fourier"
         # 'auto': mixed precision on accelerators only (on CPU native
         # f64 is fast and interpret-mode Pallas is slow)
-        if jax.default_backend() == "cpu":
-            return "f64"
-        if has_spec:
+        if has_spec and jax.default_backend() != "cpu":
             return "fourier"
-        # pure-white models keep the exact f64 path (and tolerance):
-        # noise_basis_or_empty's dummy column is not a real basis
-        return "mixed" if self.cm.has_correlated_errors else "f64"
+        return default_accel_mode(self.cm)
 
     def _make_step(self, mode: str):
         def step(x):
-            r = self.cm.time_residuals(x, subtract_mean=False)
-            M = self._design_with_offset(x)
-            Ndiag = jnp.square(self.cm.scaled_sigma(x))
+            r, M, Ndiag = self._step_inputs(x)
             if mode == "fourier":
                 t_sec, freqs, phi = self.cm.noise_fourier_spec(x)
                 return gls_step_woodbury_fourier(
@@ -267,7 +293,7 @@ class GLSFitter(Fitter):
                 )
             # pure white: Woodbury with the empty basis degenerates to
             # WLS normal equations
-            T, phi = self.cm.noise_basis_or_empty(x)
+            T, phi = self._step_noise(x)
             if mode == "full_cov":
                 return gls_step_full_cov(r, M, Ndiag, T, phi)
             if mode == "mixed":

@@ -14,24 +14,24 @@ DMJUMP*) get design-matrix rows in both blocks automatically — the
 combined residual vector is one pure function of x and the design matrix
 is its jacfwd, so the cross-block bookkeeping the reference does with
 labeled-axis matrix combiners reduces to an array concatenation here.
+
+TPU-first: WidebandTOAFitter subclasses GLSFitter, so the whole
+Gauss-Newton iteration runs as ONE device program (lax.scan) and the
+general-basis mixed-precision MXU path applies to the stacked system on
+accelerators (the Pallas pure-Fourier path does not — its streamed basis
+rows are TOA-indexed, while the stacked system has 2n rows).
 """
 
 from __future__ import annotations
-
-import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.exceptions import (
-    ConvergenceFailure,
-    DegeneracyWarning,
-    PintTpuError,
-)
-from pint_tpu.fitting.base import Fitter
+from pint_tpu.exceptions import PintTpuError
 from pint_tpu.fitting.downhill import DownhillFitter
 from pint_tpu.fitting.gls import (
+    GLSFitter,
     gls_step_full_cov,
     gls_step_woodbury,
     make_cinv_mult,
@@ -68,26 +68,25 @@ class WidebandResiduals:
         return self.toa.chi2 + self.dm_chi2
 
 
-class _WidebandKernels(Fitter):
-    """Shared wideband kernel builders (combined residuals / noise)."""
+def _validate_wideband(toas: TOAs) -> None:
+    if not toas.is_wideband():
+        raise PintTpuError(
+            "wideband fitter requires -pp_dm flags on every TOA"
+        )
+    _, dme = toas.get_dm_measurements()
+    bad = ~np.isfinite(dme) | (dme <= 0)
+    if bad.any():
+        raise PintTpuError(
+            f"{int(bad.sum())} TOAs have missing/invalid -pp_dme DM "
+            "uncertainties (first at index "
+            f"{int(np.flatnonzero(bad)[0])})"
+        )
 
-    def __init__(self, toas: TOAs, model: TimingModel, full_cov=False):
-        if not toas.is_wideband():
-            raise PintTpuError(
-                "wideband fitter requires -pp_dm flags on every TOA"
-            )
-        _, dme = toas.get_dm_measurements()
-        bad = ~np.isfinite(dme) | (dme <= 0)
-        if bad.any():
-            raise PintTpuError(
-                f"{int(bad.sum())} TOAs have missing/invalid -pp_dme DM "
-                "uncertainties (first at index "
-                f"{int(np.flatnonzero(bad)[0])})"
-            )
-        super().__init__(toas, model)
-        self.full_cov = full_cov
-        self.resids_init = self._make_resids()
-        self.resids = self.resids_init
+
+class _WidebandKernels:
+    """Shared wideband kernel builders (combined residuals / design /
+    noise over the stacked [TOA; DM] rows).  Mixin over a Fitter
+    subclass providing self.cm / self._noffset."""
 
     def _make_resids(self):
         return WidebandResiduals(self.toas, self.model, compiled=self.cm)
@@ -110,65 +109,74 @@ class _WidebandKernels(Fitter):
         ones = jnp.concatenate([jnp.ones(n), jnp.zeros(n)])[:, None]
         return jnp.concatenate([ones, M], axis=1)
 
-    def _combined_noise(self, x):
-        """(Ndiag (2n,), T (2n,k), phi (k,)): correlated bases act on the
-        TOA block only; the DM block is diagonal."""
-        n = self.cm.bundle.ntoa
-        Ndiag = jnp.concatenate(
+    def _combined_ndiag(self, x):
+        """(2n,) stacked diagonal variances [white TOA; DM]."""
+        return jnp.concatenate(
             [
                 jnp.square(self.cm.scaled_sigma(x)),
                 jnp.square(self.cm.scaled_dm_sigma(x)),
             ]
         )
+
+    def _combined_basis(self, x):
+        """(2n, k) basis + (k,) weights: correlated bases act on the TOA
+        block only; the DM block is diagonal."""
+        n = self.cm.bundle.ntoa
         Tt, phi = self.cm.noise_basis_or_empty(x)
         T = jnp.concatenate([Tt, jnp.zeros((n, Tt.shape[1]))], axis=0)
-        return Ndiag, T, phi
+        return T, phi
+
+    def _combined_noise(self, x):
+        """(Ndiag (2n,), T (2n,k), phi (k,))."""
+        T, phi = self._combined_basis(x)
+        return self._combined_ndiag(x), T, phi
 
 
-class WidebandTOAFitter(_WidebandKernels):
-    """Iterated joint GLS over [TOA; DM] residual blocks."""
+class WidebandTOAFitter(_WidebandKernels, GLSFitter):
+    """Iterated joint GLS over [TOA; DM] residual blocks, run as one
+    lax.scan device program with GLSFitter's mode selection ('auto'
+    picks the mixed-precision MXU path on accelerators)."""
 
-    def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
-        full_cov = self.full_cov
+    def __init__(self, toas: TOAs, model: TimingModel,
+                 full_cov: bool = False, fused="auto"):
+        _validate_wideband(toas)
+        if fused is True:
+            # fail fast with the real reason: the Pallas fourier kernel
+            # streams TOA-indexed basis rows, but the wideband system
+            # has stacked [TOA; DM] rows — regardless of noise content
+            raise PintTpuError(
+                "the Pallas pure-Fourier path (fused=True) does not "
+                "apply to wideband's stacked [TOA; DM] system; use "
+                "fused='mixed' to force the mixed-precision MXU path"
+            )
+        super().__init__(toas, model, full_cov=full_cov, fused=fused)
+        self.resids_init = self._make_resids()
+        self.resids = self.resids_init
 
-        @jax.jit
-        def step(x):
-            r = self._combined_residuals(x)
-            M = self._combined_design(x)
-            Ndiag, T, phi = self._combined_noise(x)
-            fn = gls_step_full_cov if full_cov else gls_step_woodbury
-            return fn(r, M, Ndiag, T, phi)
+    def _fourier_available(self) -> bool:
+        return False
 
-        x = self.cm.x0()
-        chi2 = None
-        cov = None
-        for it in range(maxiter):
-            dx, cov, chi2_new, nbad = step(x)
-            if int(nbad):
-                warnings.warn(
-                    f"{int(nbad)} degenerate normal-equation directions "
-                    "zeroed in wideband GLS solve",
-                    DegeneracyWarning,
-                )
-            chi2_new = float(chi2_new)
-            if not np.isfinite(chi2_new):
-                raise ConvergenceFailure(
-                    "non-finite chi2 during wideband fit"
-                )
-            x = x + dx[self._noffset:]
-            if chi2 is not None and abs(chi2 - chi2_new) < tol_chi2 * max(
-                chi2_new, 1.0
-            ):
-                chi2 = chi2_new
-                self.converged = True
-                break
-            chi2 = chi2_new
+    def _step_inputs(self, x):
+        return (
+            self._combined_residuals(x),
+            self._combined_design(x),
+            self._combined_ndiag(x),
+        )
 
-        return self._finalize(x, cov, float(chi2))
+    def _step_noise(self, x):
+        return self._combined_basis(x)
 
 
 class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
     """Step-halving wideband fitter (reference: WidebandDownhillFitter)."""
+
+    def __init__(self, toas: TOAs, model: TimingModel,
+                 full_cov: bool = False):
+        _validate_wideband(toas)
+        super().__init__(toas, model)
+        self.full_cov = full_cov
+        self.resids_init = self._make_resids()
+        self.resids = self.resids_init
 
     def _make_proposal(self):
         noffset, full_cov = self._noffset, self.full_cov

@@ -21,7 +21,11 @@ import numpy as np
 
 from pint_tpu.exceptions import PintTpuError
 from pint_tpu.fitting.base import design_with_offset, noffset
-from pint_tpu.fitting.gls import gls_step_woodbury
+from pint_tpu.fitting.gls import (
+    default_accel_mode,
+    gls_step_woodbury,
+    gls_step_woodbury_mixed,
+)
 from pint_tpu.ops.dd import DD
 from pint_tpu.timebase.hostdd import HostDD
 from pint_tpu.toas.bundle import TOABundle
@@ -93,6 +97,7 @@ class PTABatch:
         self.cms = cms
         self.free_names = names
         self.npulsars = len(cms)
+        self._fit_loops: dict = {}  # compiled scan loops by (mode, maxiter)
         nmax = max(cm.bundle.ntoa for cm in cms)
         padded = [pad_bundle_to(cm.bundle, nmax) for cm in cms]
         self.bundle = jax.tree_util.tree_map(
@@ -173,33 +178,77 @@ class PTABatch:
         call = self._with_state(lambda cm, x: cm.chi2(x))
         return jax.vmap(call)(self.bundle, self.ref, xs)
 
-    def fit_step(self, xs):
+    def _step_mode(self) -> str:
+        """GLSFitter's production precision policy (shared helper:
+        fitting/gls.py::default_accel_mode — mixed-precision MXU on
+        accelerators with a correlated basis, exact f64 otherwise)."""
+        return default_accel_mode(self._proto)
+
+    def fit_step(self, xs, mode: str | None = None):
         """One batched GLS Gauss-Newton step for every pulsar:
-        -> (new xs (P, p), chi2 (P,), cov (P, p, p))."""
+        -> (new xs (P, p), chi2 (P,), cov (P, p, p)).
+
+        mode: 'mixed' | 'f64' | None (None = _step_mode policy)."""
         no = noffset(self._proto)
+        mode = mode or self._step_mode()
+        if mode not in ("mixed", "f64"):
+            raise PintTpuError(
+                f"unknown PTA fit mode {mode!r}: expected 'mixed' or "
+                "'f64'"
+            )
+        gls_step = (
+            gls_step_woodbury_mixed if mode == "mixed"
+            else gls_step_woodbury
+        )
 
         def single(cm, x):
             r = cm.time_residuals(x, subtract_mean=False)
             M = design_with_offset(cm, x)
             Ndiag = jnp.square(cm.scaled_sigma(x))
             T, phi = cm.noise_basis_or_empty(x)
-            dx, cov, chi2, _ = gls_step_woodbury(r, M, Ndiag, T, phi)
+            dx, cov, chi2, _ = gls_step(r, M, Ndiag, T, phi)
             return x + dx[no:], chi2, cov[no:, no:]
 
         call = self._with_state(single)
         return jax.vmap(call)(self.bundle, self.ref, xs)
 
-    def fit(self, maxiter: int = 3):
-        """Iterated batched fit; returns (xs, chi2 (P,))."""
+    def fit(self, maxiter: int = 3, mode: str | None = None):
+        """Iterated batched fit; returns (xs, chi2 (P,)).
+
+        The whole iteration runs as ONE device program (lax.scan over
+        the Gauss-Newton steps), so a PTA-batch fit costs a single
+        dispatch regardless of maxiter — the batched sibling of
+        GLSFitter._make_fit_loop."""
         if maxiter < 1:
             raise PintTpuError("PTABatch.fit needs maxiter >= 1")
-        step = jax.jit(self.fit_step)
-        xs = self.x0()
-        chi2 = None
-        for _ in range(maxiter):
-            xs, chi2, cov = step(xs)
+        mode = mode or self._step_mode()
+        key = (mode, maxiter)
+        if key not in self._fit_loops:
+            self._fit_loops[key] = self._make_fit_loop(mode, maxiter)
+        xs, chi2, cov = self._fit_loops[key](self.x0())
         self.cov = cov
         return xs, chi2
+
+    def _make_fit_loop(self, mode: str, maxiter: int):
+        p = len(self.free_names)
+
+        @jax.jit
+        def run(xs0):
+            def body(carry, _):
+                xs, _, _ = carry
+                return self.fit_step(xs, mode=mode), None
+
+            init = (
+                xs0,
+                jnp.zeros((self.npulsars,)),
+                jnp.zeros((self.npulsars, p, p)),
+            )
+            (xs, chi2, cov), _ = jax.lax.scan(
+                body, init, None, length=maxiter
+            )
+            return xs, chi2, cov
+
+        return run
 
     def commit(self, xs):
         """Fold fitted deltas back into each pulsar's host model."""
@@ -219,4 +268,7 @@ class PTABatch:
             return x
 
         self.bundle = jax.tree_util.tree_map(place, self.bundle)
+        # compiled loops baked the previous (unsharded) arrays as
+        # closure constants — they must not be reused
+        self._fit_loops.clear()
         return self

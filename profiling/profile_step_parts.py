@@ -1,0 +1,94 @@
+"""Decompose the north-star GLS fit step into its pieces and time each
+as a chained device program (amortizing the axon dispatch latency), to
+see where the next optimization dollar goes.
+
+Usage: python profiling/profile_step_parts.py [ntoa]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def _chain_time(fn, x0, chain=192, nrep=3):
+    import jax
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            out = fn(c)
+            # feed ONE element of the output back so steps are
+            # dependent (a full f64-emulated reduction here would cost
+            # ~3 ms/step on TPU and swamp the part being measured)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            return c + 0.0 * leaf.ravel()[0].astype(c.dtype), None
+
+        return jax.lax.scan(body, x, None, length=chain)[0]
+
+    out = run(x0)
+    out.block_until_ready()
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        run(x0).block_until_ready()
+        ts.append((time.perf_counter() - t0) / chain)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    sys.path.insert(0, ".")
+    from bench import _build
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import gls_step_woodbury_fourier
+
+    ntoa = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    _, _, cm = _build(ntoa)
+    x0 = cm.x0()
+
+    parts = {
+        "empty(baseline)": lambda x: x * 1.0000000001,
+        "residuals": lambda x: cm.time_residuals(x, subtract_mean=False),
+        "design(jacfwd)": lambda x: design_with_offset(cm, x),
+        "scaled_sigma": lambda x: cm.scaled_sigma(x),
+        "fourier_spec": lambda x: cm.noise_fourier_spec(x)[2],
+    }
+
+    def full(x):
+        r = cm.time_residuals(x, subtract_mean=False)
+        M = design_with_offset(cm, x)
+        Nd = jnp.square(cm.scaled_sigma(x))
+        t_sec, freqs, phi = cm.noise_fourier_spec(x)
+        dx, cov, chi2, _ = gls_step_woodbury_fourier(
+            r, M, Nd, t_sec, freqs, phi
+        )
+        return dx
+
+    def solve_only(x):
+        # r/M/Nd as constants (precomputed outside): isolates the solver
+        dx, cov, chi2, _ = gls_step_woodbury_fourier(
+            R, M0, Nd0, TS, FR, PHI
+        )
+        return dx + 0.0 * x[0]
+
+    R = cm.time_residuals(x0, subtract_mean=False)
+    M0 = design_with_offset(cm, x0)
+    Nd0 = np.square(cm.scaled_sigma(x0))
+    TS, FR, PHI = cm.noise_fourier_spec(x0)
+
+    print(f"backend={jax.default_backend()} ntoa={ntoa}")
+    t_full = _chain_time(full, x0)
+    print(f"full step          : {t_full*1e3:8.3f} ms")
+    for name, fn in parts.items():
+        t = _chain_time(fn, x0)
+        print(f"{name:<19}: {t*1e3:8.3f} ms  ({100*t/t_full:5.1f}%)")
+    t = _chain_time(solve_only, x0)
+    print(f"{'woodbury solve':<19}: {t*1e3:8.3f} ms  ({100*t/t_full:5.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

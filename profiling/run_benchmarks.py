@@ -35,37 +35,37 @@ def _timeit(fn, nrep=3):
     return float(np.median(ts))
 
 
-def _gls_step_fn(cm):
+def _fitter_step_fn(fitter):
+    """The fitter's PRODUCTION step (GLSFitter mode auto-selection:
+    Pallas fourier / mixed-precision MXU on accelerators, f64 on CPU),
+    wrapped as x -> (x', chi2)."""
     import jax
-    import jax.numpy as jnp
 
-    from pint_tpu.fitting.base import design_with_offset, noffset
-    from pint_tpu.fitting.gls import gls_step_woodbury
+    mode = fitter._step_mode()
+    step = fitter._make_step(mode)
+    no = fitter._noffset
 
-    no = noffset(cm)
-
-    def step(x):
-        r = cm.time_residuals(x, subtract_mean=False)
-        M = design_with_offset(cm, x)
-        Nd = jnp.square(cm.scaled_sigma(x))
-        T, phi = cm.noise_basis_or_empty(x)
-        dx, _, chi2, _ = gls_step_woodbury(r, M, Nd, T, phi)
+    def fit_step(x):
+        dx, _, chi2, _ = step(x)
         return x + dx[no:], chi2
 
-    return jax.jit(step)
+    return jax.jit(fit_step), mode
 
 
 def config_1():
+    from pint_tpu.fitting.gls import GLSFitter
     from pint_tpu.simulation import make_test_pulsar
 
     par = "PSR C1\nF0 61.485 1\nF1 -1.2e-15 1\nPEPOCH 53750\nDM 224.1 1\n"
     m, toas = make_test_pulsar(par, ntoa=62, start_mjd=53478,
                                end_mjd=54200)
-    cm = m.compile(toas)
-    return "config1 WLS ~60 TOAs", 62, _gls_step_fn(cm), cm.x0()
+    fitter = GLSFitter(toas, m)
+    step, mode = _fitter_step_fn(fitter)
+    return f"config1 WLS ~60 TOAs [{mode}]", 62, step, fitter.cm.x0()
 
 
 def _gls_config(ntoa, label):
+    from pint_tpu.fitting.gls import GLSFitter
     from pint_tpu.simulation import make_test_pulsar
 
     par = (
@@ -76,8 +76,9 @@ def _gls_config(ntoa, label):
     m, toas = make_test_pulsar(
         par, ntoa=ntoa, start_mjd=53000, end_mjd=57000, iterations=1
     )
-    cm = m.compile(toas)
-    return label, ntoa, _gls_step_fn(cm), cm.x0()
+    fitter = GLSFitter(toas, m)
+    step, mode = _fitter_step_fn(fitter)
+    return f"{label} [{mode}]", ntoa, step, fitter.cm.x0()
 
 
 def config_2():
@@ -89,8 +90,6 @@ def config_3():
 
 
 def config_4():
-    import jax
-
     from pint_tpu.fitting.wideband import WidebandTOAFitter
     from pint_tpu.models.builder import get_model
     from pint_tpu.simulation import make_test_pulsar
@@ -105,18 +104,8 @@ def config_4():
         f["pp_dm"] = f"{4.33 + rng.normal(0, 2e-4):.8f}"
         f["pp_dme"] = "2e-4"
     fitter = WidebandTOAFitter(toas, get_model(par))
-
-    @jax.jit
-    def step(x):
-        r = fitter._combined_residuals(x)
-        M = fitter._combined_design(x)
-        Nd, T, phi = fitter._combined_noise(x)
-        from pint_tpu.fitting.gls import gls_step_woodbury
-
-        dx, _, chi2, _ = gls_step_woodbury(r, M, Nd, T, phi)
-        return x + dx[fitter._noffset:], chi2
-
-    return "config4 wideband 4e3 TOAs", 4000, step, fitter.cm.x0()
+    step, mode = _fitter_step_fn(fitter)
+    return f"config4 wideband 4e3 TOAs [{mode}]", 4000, step, fitter.cm.x0()
 
 
 def config_5():
@@ -138,8 +127,12 @@ def config_5():
         )
         cms.append(m.compile(toas))
     batch = PTABatch(cms)
-    step = jax.jit(batch.fit_step)
-    return "config5 PTA batch 16 x 2e3 TOAs", 16 * 2000, step, batch.x0()
+    mode = batch._step_mode()
+    step = jax.jit(lambda xs: batch.fit_step(xs, mode=mode))
+    return (
+        f"config5 PTA batch 16 x 2e3 TOAs [{mode}]",
+        16 * 2000, step, batch.x0(),
+    )
 
 
 def main():

@@ -80,6 +80,20 @@ def test_pta_batch_sharded_matches(pulsars):
     )
 
 
+def test_pta_batch_mixed_mode_matches_f64(pulsars):
+    """The accelerator-default mixed-precision batched step must land
+    within the validated tolerance class of the f64 path."""
+    batch = PTABatch([m.compile(t) for m, t in pulsars])
+    xs_f, chi2_f = batch.fit(maxiter=3, mode="f64")
+    cov_f = np.asarray(batch.cov)
+    xs_m, chi2_m = batch.fit(maxiter=3, mode="mixed")
+    np.testing.assert_allclose(
+        np.asarray(chi2_m), np.asarray(chi2_f), rtol=1e-3
+    )
+    sig = np.sqrt(np.diagonal(cov_f, axis1=1, axis2=2))
+    assert np.all(np.abs(np.asarray(xs_m - xs_f)) < 5e-2 * sig)
+
+
 def test_pta_batch_rejects_mismatched_layouts(pulsars):
     from pint_tpu.exceptions import PintTpuError
 
@@ -116,3 +130,13 @@ def test_pta_batch_fit_maxiter_guard(pulsars):
     batch = PTABatch([pulsars[0][0].compile(pulsars[0][1])])
     with pytest.raises(PintTpuError, match="maxiter"):
         batch.fit(maxiter=0)
+    with pytest.raises(PintTpuError, match="unknown PTA fit mode"):
+        batch.fit(maxiter=1, mode="fourier")
+
+
+def test_gls_fused_mixed_full_cov_conflict(pulsars):
+    from pint_tpu.exceptions import PintTpuError
+
+    m, t = pulsars[0]
+    with pytest.raises(PintTpuError, match="mutually"):
+        GLSFitter(t, m, full_cov=True, fused="mixed").fit_toas(maxiter=1)

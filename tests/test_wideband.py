@@ -163,6 +163,37 @@ def test_dmjump_absorbs_receiver_offset():
     assert float(m.params["DM"].value) == pytest.approx(21.7, abs=2e-4)
 
 
+def test_wideband_fused_true_rejected_with_real_reason():
+    m = get_model(PAR + "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 6\n")
+    toas = _wb_toas(m)
+    with pytest.raises(PintTpuError, match="stacked"):
+        WidebandTOAFitter(toas, m, fused=True)
+
+
+def test_wideband_mixed_path_matches_f64():
+    """The forced mixed-precision (f32-MXU) wideband path must land
+    within the validated tolerance class of the all-f64 fit
+    (fitting/gls.py::_woodbury_mixed_tail contract)."""
+    par = PAR + "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 6\n"
+    m_true = get_model(PAR)
+    toas = _wb_toas(m_true)
+    m1, m2 = get_model(par), get_model(par)
+    for m in (m1, m2):
+        m.params["DM"].value = 21.7003
+    c1 = WidebandTOAFitter(toas, m1, fused=False).fit_toas(maxiter=4)
+    c2 = WidebandTOAFitter(toas, m2, fused="mixed").fit_toas(maxiter=4)
+    assert c2 == pytest.approx(c1, rel=1e-3)
+    for n in ("F0", "F1", "DM"):
+        v1, v2 = m1.params[n].value, m2.params[n].value
+        if hasattr(v1, "to_float"):
+            v1, v2 = float(v1.to_float()), float(v2.to_float())
+        unc = float(m1.params[n].uncertainty)
+        assert abs(v1 - v2) < 5e-2 * unc, n
+        assert float(m2.params[n].uncertainty) == pytest.approx(
+            unc, rel=5e-3
+        ), n
+
+
 def test_dmefac_scales_dm_chi2():
     m_true = get_model(PAR)
     toas = _wb_toas(m_true, seed=9)
