@@ -2,14 +2,20 @@
 
 Reference parity: src/pint/fitter.py::Fitter (the common state held by
 WLS/GLS/downhill variants: compiled model, residuals, covariance,
-offset-column handling, post-fit commit, summary printing).
+offset-column handling, post-fit commit, summary printing) — plus the
+TPU-first single-dispatch scan harness (make_scan_fit_loop) that runs a
+whole Gauss-Newton iteration as ONE device program.
 """
 
 from __future__ import annotations
 
+import warnings
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.residuals import Residuals
 from pint_tpu.toas.toas import TOAs
@@ -31,6 +37,66 @@ def design_with_offset(cm, x):
     return jnp.concatenate([ones, M], axis=1)
 
 
+def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
+    """The whole Gauss-Newton iteration as ONE device program
+    (lax.scan), so a fit costs a single dispatch instead of `maxiter`
+    host round-trips (~85 ms each through the axon tunnel).  Semantics
+    match the reference host loops (src/pint/fitter.py::*Fitter
+    .fit_toas): apply the step, stop when chi2 stops moving, freeze on
+    non-finite chi2 (the host raises ConvergenceFailure from the
+    reported flags afterwards — Fitter._finish_scan_fit).
+
+    live_step(x) -> (x_new, cov (p,p), chi2, nbad int32); chi2 may be
+    evaluated pre-step (GLS: the whitened chi2 of the solve) or
+    post-step (WLS: cm.chi2 at x_new) — convergence compares
+    successive values either way.  init_chi2(x0) seeds the comparison
+    (inf when the first step must always run).
+    """
+
+    def dead_step(x):
+        return (
+            x,
+            jnp.zeros((p, p)),
+            jnp.asarray(jnp.inf),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    def body(carry, _):
+        x, chi2_prev, cov_prev, done, conv = carry
+        x_new, cov, chi2, nbad = jax.lax.cond(
+            done, dead_step, live_step, x
+        )
+        bad = ~jnp.isfinite(chi2)
+        x_keep = jnp.where(done | bad, x, x_new)
+        converged = jnp.abs(chi2_prev - chi2) < tol_chi2 * jnp.maximum(
+            chi2, 1.0
+        )
+        chi2_keep = jnp.where(done | bad, chi2_prev, chi2)
+        cov_keep = jnp.where(done | bad, cov_prev, cov)
+        new_done = done | bad | converged
+        new_conv = conv | (converged & ~done)
+        return (
+            (x_keep, chi2_keep, cov_keep, new_done, new_conv),
+            (nbad, bad & ~done),
+        )
+
+    @jax.jit
+    def fit_loop(x0):
+        init = (
+            x0,
+            init_chi2(x0),
+            jnp.zeros((p, p)),
+            jnp.asarray(False),
+            jnp.asarray(False),
+        )
+        (x, chi2, cov, _done, conv), (nbads, bads) = jax.lax.scan(
+            body, init, None, length=maxiter
+        )
+        return x, chi2, cov, conv, nbads, bads
+
+    return fit_loop
+
+
 class Fitter:
     """Common base: compiled kernels + offset column + post-fit commit."""
 
@@ -38,8 +104,8 @@ class Fitter:
         self.toas = toas
         self.model = model
         self.cm = model.compile(toas)
-        self.resids_init = Residuals(toas, model, compiled=self.cm)
-        self.resids: Residuals = self.resids_init
+        self.resids_init = self._make_resids()  # wideband overrides
+        self.resids = self.resids_init
         self.converged = False
         self.parameter_covariance_matrix: np.ndarray | None = None
         self.chi2: float | None = None
@@ -55,6 +121,23 @@ class Fitter:
         """Residuals object for the current compiled state; wideband
         fitters override to return WidebandResiduals."""
         return Residuals(self.toas, self.model, compiled=self.cm)
+
+    def _finish_scan_fit(self, result, warn_msg: str, fail_msg: str):
+        """Shared host tail of a make_scan_fit_loop run: emit one
+        DegeneracyWarning per degenerate iteration, raise on non-finite
+        chi2, record convergence, commit, and drop compiled loops
+        (cm.commit() rebased cm.ref, which the loops baked in as
+        constants; the cache still serves retries after a raise)."""
+        x, chi2, cov, conv, nbads, bads = result
+        nbads = np.asarray(nbads)
+        for nb in nbads[nbads > 0]:
+            warnings.warn(f"{int(nb)} {warn_msg}", DegeneracyWarning)
+        if np.any(np.asarray(bads)):
+            raise ConvergenceFailure(fail_msg)
+        self.converged = bool(conv)
+        chi2 = self._finalize(x, cov, float(chi2))
+        self._fit_loops.clear()
+        return chi2
 
     def _finalize(self, x, cov, chi2: float):
         """Drop the offset row/col, commit fitted deltas + uncertainties

@@ -16,14 +16,10 @@ for cross-validation and benchmarking).
 
 from __future__ import annotations
 
-import warnings
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from pint_tpu.exceptions import ConvergenceFailure
-from pint_tpu.fitting.base import Fitter
+from pint_tpu.fitting.base import Fitter, make_scan_fit_loop
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
@@ -37,32 +33,43 @@ def _chol_solve(A, B, jitter: float = 0.0):
     return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
 
 
-def _solve_spd_threshold(A, B, threshold=None):
-    """Solve A X = B (A symmetric PSD) zeroing near-degenerate
-    eigendirections, mirroring the WLS SVD-threshold behavior so that
-    degenerate models (e.g. a JUMP selecting all TOAs) produce a
-    min-norm answer + DegeneracyWarning count instead of NaNs."""
+def _column_norms(M):
+    """Column norms with |max| pre-scaling: design columns reach ~1e17
+    (the F1 column is dt^2/2), and on backends with f32-pair emulated
+    f64 (axon TPU — f32 EXPONENT range) the squares overflow to inf
+    for multi-decade spans; dividing by the column max first keeps
+    every squared intermediate <= n."""
+    mx = jnp.max(jnp.abs(M), axis=0)
+    mx = jnp.where(mx == 0, 1.0, mx)
+    norm = jnp.sqrt(jnp.sum(jnp.square(M / mx[None, :]), axis=0)) * mx
+    return jnp.where(norm == 0, 1.0, norm)
+
+
+def _eigh_threshold_solve(A, b, threshold=None):
+    """Min-norm solve of SPD A x = b with near-degenerate
+    eigendirections zeroed (so degenerate models — e.g. a JUMP
+    selecting all TOAs — produce a min-norm answer + DegeneracyWarning
+    count instead of NaNs).  One eigendecomposition serves both the
+    solve and the pseudo-inverse (a p x p eigh is emulated-f64 work on
+    TPU — paying it twice showed up in profiling/profile_solve_parts).
+    The default eigenvalue cut eps*p*lam_max is the Gram's own
+    roundoff floor.  Returns (x, pinv(A), n_zeroed).  Shared by the
+    GLS normal-equation tail and the WLS 'gram' method."""
     w, V = jnp.linalg.eigh(A)
     if threshold is None:
         threshold = jnp.finfo(jnp.float64).eps * A.shape[0]
     bad = w < threshold * jnp.max(w)
     winv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, w))
-    return (V * winv[None, :]) @ (V.T @ B), jnp.sum(bad)
-
-
-def _column_norms(M):
-    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
-    return jnp.where(norm == 0, 1.0, norm)
+    Vw = V * winv[None, :]
+    return Vw @ (V.T @ b), Vw @ V.T, jnp.sum(bad)
 
 
 def _finish_normal_eqs(A, b, r_cinv_r, norm):
-    """Shared normal-equation tail for every GLS flavor: SPD-threshold
+    """Shared normal-equation tail for every GLS flavor: thresholded
     solve, covariance, chi2 = r^T C^-1 r minus the fitted decrement
     dx^T b (removes the offset-column power, matching the reference),
     column un-normalization."""
-    dxn, nbad = _solve_spd_threshold(A, b[:, None])
-    dxn = dxn[:, 0]
-    covn, _ = _solve_spd_threshold(A, jnp.eye(A.shape[0]))
+    dxn, covn, nbad = _eigh_threshold_solve(A, b)
     chi2 = r_cinv_r - jnp.dot(dxn, b)
     return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
 
@@ -105,30 +112,33 @@ def gls_step_woodbury(r, M, Ndiag, T, phi):
     return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M)
 
 
-def _woodbury_mixed_tail(r, Mn, Ninv, sig_tt, twx, phi, norm,
-                         A_white=None):
+def _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm):
     """Shared mixed-precision Woodbury assembly: given the f32-grade
-    basis Grams sig_tt = T^T N^-1 T and twx = T^T N^-1 [Mn | r], build
-    and solve the normal equations.
+    Grams G_XX = X^T N^-1 X for X = [Mn | r], sig_tt = T^T N^-1 T, and
+    twx = T^T N^-1 X, build and solve the normal equations.
 
-    Precision contract (validated in tests/test_pallas_kernels.py and
-    tests/test_ffgram.py): the gradient's white part b_white and
-    r^T N^-1 r are exact-f64 matvec/dot — the Gauss-Newton FIXED POINT
-    is set by b, so the converged parameters inherit f64 accuracy; the
-    design Gram M^T N^-1 M runs as a chunked f32 MXU Gram with f64
-    chunk accumulation (~3e-8 relative); the basis correction terms and
-    the k x k factorization (equilibrated f32 Cholesky + f64 iterative
-    refinement) are f32-grade.  Net agreement vs the all-f64 path:
-    step directions <2e-3 of the largest component, chi2 <1e-3
-    relative, uncertainties <5e-3; iterated fits land within ~1e-2
-    sigma of the f64 solution.
+    Precision contract (validated in tests/test_pallas_kernels.py,
+    tests/test_ffgram.py): every Gram — including the gradient
+    b_white = Mn^T N^-1 r and r^T N^-1 r — runs as a chunked f32 MXU
+    Gram with f64 chunk accumulation (~3e-8 relative to summed-term
+    magnitudes; ops/ffgram.py); the k x k factorization is an
+    equilibrated f32 Cholesky + f64 iterative refinement.  The
+    gradient's f32-grade error scales with the CURRENT residual norm,
+    so Gauss-Newton stays contracting from far-off starts, and at the
+    fixed point (residuals at the noise floor) the converged
+    parameters land within ~2e-4 sigma of the all-f64 solution
+    (measured, 2e4-TOA red-noise config; the earlier exact-f64
+    gradient bought ~100x tighter agreement at ~1.4 ms/step of
+    emulated-f64 reductions — profiling/profile_solve_parts.py).
+    Net agreement vs the all-f64 path: step directions <2e-3 of the
+    largest component, chi2 <1e-3 relative, uncertainties <5e-3;
+    iterated fits within ~1e-2 sigma.
     """
-    from pint_tpu.ops.ffgram import chol_solve_ir, gram32
+    from pint_tpu.ops.ffgram import chol_solve_ir
 
-    if A_white is None:
-        A_white = gram32(Mn, Ninv)
-    b_white = Mn.T @ (Ninv * r)  # exact f64: sets the fixed point
-    r_Nr = jnp.dot(r, Ninv * r)
+    A_white = G_XX[:-1, :-1]
+    b_white = G_XX[:-1, -1]
+    r_Nr = G_XX[-1, -1]
     Sigma = jnp.diag(1.0 / phi) + sig_tt
     corr = chol_solve_ir(Sigma, twx)  # Sigma^-1 T^T N^-1 [Mn | r]
     A = A_white - twx[:, :-1].T @ corr[:, :-1]
@@ -145,6 +155,7 @@ def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
     documents the precision contract) finishes the solve.  Requires a
     pure-Fourier basis (CompiledModel.noise_fourier_spec).
     """
+    from pint_tpu.ops.ffgram import gram32
     from pint_tpu.ops.pallas_kernels import fourier_gram
 
     Ninv = 1.0 / Ndiag
@@ -153,7 +164,7 @@ def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
     X = jnp.concatenate([Mn, r[:, None]], axis=1)
     sig_tt, twx = fourier_gram(t_sec, freqs, Ninv, X)
     return _woodbury_mixed_tail(
-        r, Mn, Ninv,
+        gram32(X, Ninv),
         sig_tt.astype(jnp.float64), twx.astype(jnp.float64), phi, norm,
     )
 
@@ -178,9 +189,7 @@ def gls_step_woodbury_mixed(r, M, Ndiag, T, phi):
     Mn = M / norm[None, :]
     X = jnp.concatenate([Mn, r[:, None]], axis=1)
     sig_tt, twx, G_XX = gram32_joint(T.astype(jnp.float32), X, Ninv)
-    return _woodbury_mixed_tail(
-        r, Mn, Ninv, sig_tt, twx, phi, norm, A_white=G_XX[:-1, :-1]
-    )
+    return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm)
 
 
 def default_accel_mode(cm) -> str:
@@ -303,65 +312,22 @@ class GLSFitter(Fitter):
         return step
 
     def _make_fit_loop(self, mode: str, maxiter: int, tol_chi2: float):
-        """The whole Gauss-Newton iteration as ONE device program
-        (lax.scan), so a fit costs a single dispatch instead of
-        `maxiter` host round-trips (~85 ms each through the axon
-        tunnel).  Semantics match the reference host loop
-        (src/pint/fitter.py::GLSFitter.fit_toas): apply the step, stop
-        when chi2 stops moving, freeze on non-finite chi2 (the host
-        raises ConvergenceFailure from the reported flag afterwards).
-        """
+        """The whole Gauss-Newton iteration as one device program —
+        the shared scan harness (base.make_scan_fit_loop) around this
+        fitter's step; chi2 here is the step's whitened chi2 at the
+        pre-step state (reference semantics:
+        src/pint/fitter.py::GLSFitter.fit_toas)."""
         step = self._make_step(mode)
         no = self._noffset
-        nfree = len(self.cm.free_names)
-        p = nfree + no
-
-        def zeros_like_step(_x):
-            return (
-                jnp.zeros((p,)),
-                jnp.zeros((p, p)),
-                jnp.asarray(jnp.inf),
-                jnp.asarray(0, jnp.int32),
-            )
+        p = len(self.cm.free_names) + no
 
         def live_step(x):
             dx, cov, chi2, nbad = step(x)
-            return dx, cov, chi2, nbad.astype(jnp.int32)
+            return x + dx[no:], cov, chi2, nbad.astype(jnp.int32)
 
-        def body(carry, _):
-            x, chi2_prev, cov_prev, done, conv = carry
-            dx, cov, chi2, nbad = jax.lax.cond(
-                done, zeros_like_step, live_step, x
-            )
-            bad = ~jnp.isfinite(chi2)
-            x_new = jnp.where(done | bad, x, x + dx[no:])
-            converged = jnp.abs(chi2_prev - chi2) < tol_chi2 * jnp.maximum(
-                chi2, 1.0
-            )
-            chi2_keep = jnp.where(done | bad, chi2_prev, chi2)
-            cov_keep = jnp.where(done | bad, cov_prev, cov)
-            new_done = done | bad | converged
-            new_conv = conv | (converged & ~done)
-            return (
-                (x_new, chi2_keep, cov_keep, new_done, new_conv),
-                (chi2, nbad, bad & ~done),
-            )
-
-        @jax.jit
-        def fit_loop(x0):
-            init = (
-                x0,
-                jnp.asarray(jnp.inf),
-                jnp.zeros((p, p)),
-                jnp.asarray(False),
-                jnp.asarray(False),
-            )
-            (x, chi2, cov, _done, conv), (chi2s, nbads, bads) = jax.lax.scan(
-                body, init, None, length=maxiter
-            )
-            return x, chi2, cov, conv, chi2s, nbads, bads
-
-        return fit_loop
+        return make_scan_fit_loop(
+            live_step, p, maxiter, tol_chi2, lambda x0: jnp.asarray(jnp.inf)
+        )
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float | None = None) -> float:
         mode = self._step_mode()
@@ -371,25 +337,10 @@ class GLSFitter(Fitter):
             # there would spin to maxiter and report converged=False
             tol_chi2 = 1e-10 if mode in ("f64", "full_cov") else 3e-6
         key = (mode, maxiter, tol_chi2)
-        if key not in self._fit_loops:  # reuse compiled loops across
-            self._fit_loops[key] = self._make_fit_loop(*key)  # re-fits
-        x, chi2, cov, conv, chi2s, nbads, bads = self._fit_loops[key](
-            self.cm.x0()
+        if key not in self._fit_loops:
+            self._fit_loops[key] = self._make_fit_loop(*key)
+        return self._finish_scan_fit(
+            self._fit_loops[key](self.cm.x0()),
+            "degenerate normal-equation directions zeroed in GLS solve",
+            "non-finite chi2 during GLS fit",
         )
-        nbads = np.asarray(nbads)
-        for nb in nbads[nbads > 0]:
-            from pint_tpu.exceptions import DegeneracyWarning
-
-            warnings.warn(
-                f"{int(nb)} degenerate normal-equation directions "
-                "zeroed in GLS solve",
-                DegeneracyWarning,
-            )
-        if np.any(np.asarray(bads)):
-            raise ConvergenceFailure("non-finite chi2 during GLS fit")
-        self.converged = bool(conv)
-        chi2 = self._finalize(x, cov, float(chi2))
-        # _finalize -> cm.commit() rebased cm.ref (x=0 is now the
-        # fitted model): compiled loops baked the old ref as constants
-        self._fit_loops.clear()
-        return chi2

@@ -150,8 +150,6 @@ class WidebandTOAFitter(_WidebandKernels, GLSFitter):
                 "fused='mixed' to force the mixed-precision MXU path"
             )
         super().__init__(toas, model, full_cov=full_cov, fused=fused)
-        self.resids_init = self._make_resids()
-        self.resids = self.resids_init
 
     def _fourier_available(self) -> bool:
         return False
@@ -175,8 +173,6 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
         _validate_wideband(toas)
         super().__init__(toas, model)
         self.full_cov = full_cov
-        self.resids_init = self._make_resids()
-        self.resids = self.resids_init
 
     def _make_proposal(self):
         noffset, full_cov = self._noffset, self.full_cov

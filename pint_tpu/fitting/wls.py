@@ -13,30 +13,52 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
-from pint_tpu.fitting.base import Fitter
+from pint_tpu.fitting.base import Fitter, make_scan_fit_loop
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
 
-def _wls_step(r, M, w, threshold=None):
-    """One WLS normal-equation solve via column-scaled SVD.
+def _wls_step(r, M, w, threshold=None, method=None):
+    """One WLS least-squares solve with degenerate-direction zeroing.
 
     r (n,), M (n,p) = d resid/d x, w (n,) weights -> (delta_x (p,),
-    covariance (p,p)).  Mirrors the reference's conditioning trick:
-    scale columns to unit norm before SVD (fitter.py::WLSFitter).
+    covariance (p,p), n_degenerate).  Mirrors the reference's
+    conditioning trick: scale columns to unit norm first
+    (fitter.py::WLSFitter).
+
+    method='svd' (CPU default) is the reference's column-scaled SVD
+    lstsq.  method='gram' (accelerator default) solves the p x p
+    normal equations by thresholded eigh instead: the axon TPU's
+    emulated-f64 SVD returns NaNs (and a native-f32 SVD would cost the
+    full conditioning), while eigh is exact to emulated-f64 — the same
+    factorization the GLS tail uses.  The Gram squares the condition
+    number, which column normalization keeps benign for timing design
+    matrices (p ~ 10-100); the eigenvalue cut is eps*max(n,p)*lam_max —
+    the Gram's own roundoff floor (the GLS-tail convention,
+    gls.py::_finish_normal_eqs), NOT the square of the SVD cut (which
+    sits far below that floor and would never fire): it zeroes
+    directions with s/s0 below ~1e-8, exactly those whose Gram content
+    is roundoff.
     """
+    from pint_tpu.fitting.gls import _column_norms, _eigh_threshold_solve
+
+    if method is None:
+        method = "svd" if jax.default_backend() == "cpu" else "gram"
     sw = jnp.sqrt(w)
-    A = M * sw[:, None]
     b = -r * sw
-    norm = jnp.sqrt(jnp.sum(A * A, axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
-    A = A / norm[None, :]
-    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+    # _column_norms is the overflow-safe (|max|-prescaled) column norm:
+    # weighted design columns reach ~1e21 (the F1 column is
+    # dt^2/2 * 1/sigma) and naive squares overflow the f32 EXPONENT
+    # range of f32-pair emulated f64 (axon TPU)
+    norm = _column_norms(M * sw[:, None])
+    A = (M / norm[None, :]) * sw[:, None]
     if threshold is None:
         threshold = jnp.finfo(jnp.float64).eps * max(A.shape)
+    if method == "gram":
+        dx, cov, nbad = _eigh_threshold_solve(A.T @ A, A.T @ b, threshold)
+        return dx / norm, cov / jnp.outer(norm, norm), nbad
+    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
     bad = s < threshold * s[0]
     s_inv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, s))
     dx = (Vt.T * s_inv[None, :]) @ (U.T @ b) / norm
@@ -45,52 +67,52 @@ def _wls_step(r, M, w, threshold=None):
 
 
 class WLSFitter(Fitter):
+    """Iterated WLS fit, run — like GLSFitter — as ONE device program
+    (the whole Gauss-Newton iteration in a lax.scan, one dispatch per
+    fit instead of 2·maxiter host round-trips)."""
+
+    def __init__(self, toas: TOAs, model: TimingModel):
+        super().__init__(toas, model)
+        self._fit_loops: dict = {}
+
     # residuals WITHOUT mean subtraction; the offset column absorbs the
     # mean exactly as the reference's "Offset" design-matrix column does.
     def _r(self, x):
         return self.cm.time_residuals(x, subtract_mean=False)
+
+    def _make_fit_loop(self, maxiter: int, tol_chi2: float):
+        """Shared scan harness (base.make_scan_fit_loop) around the WLS
+        step; chi2 is cm.chi2 at the post-step state and the
+        comparison seed is chi2(x0) (reference semantics:
+        src/pint/fitter.py::WLSFitter.fit_toas)."""
+        no = self._noffset
+        p = len(self.cm.free_names) + no
+
+        def live_step(x):
+            r = self._r(x)
+            M = self._design_with_offset(x)
+            w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
+            dx, cov, nbad = _wls_step(r, M, w)
+            x_new = x + dx[no:]  # dx[0] is the offset column
+            return x_new, cov, self.cm.chi2(x_new), nbad.astype(jnp.int32)
+
+        return make_scan_fit_loop(
+            live_step, p, maxiter, tol_chi2, self.cm.chi2
+        )
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
         if self.cm.has_correlated_errors:
             from pint_tpu.exceptions import CorrelatedErrors
 
             raise CorrelatedErrors(self.model)
-
-        @jax.jit
-        def step(x):
-            r = self._r(x)
-            M = self._design_with_offset(x)
-            w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
-            dx, cov, nbad = _wls_step(r, M, w)
-            return dx, cov, nbad
-
-        @jax.jit
-        def chi2_of(x):
-            return self.cm.chi2(x)
-
-        x = self.cm.x0()
-        chi2 = float(chi2_of(x))
-        cov = None
-        for it in range(maxiter):
-            dx, cov, nbad = step(x)
-            if int(nbad):
-                import warnings
-
-                warnings.warn(
-                    f"{int(nbad)} degenerate design-matrix directions "
-                    "zeroed in SVD solve",
-                    DegeneracyWarning,
-                )
-            x_new = x + dx[self._noffset:]  # dx[0] is the offset column
-            chi2_new = float(chi2_of(x_new))
-            if not np.isfinite(chi2_new):
-                raise ConvergenceFailure("non-finite chi2 during WLS fit")
-            x, last_chi2, chi2 = x_new, chi2, chi2_new
-            if abs(last_chi2 - chi2) < tol_chi2 * max(chi2, 1.0):
-                self.converged = True
-                break
-
-        # parameter covariance in free_names order (offset row/col
-        # dropped, matching the reference's parameter_covariance_matrix
-        # without Offset)
-        return self._finalize(x, cov, chi2)
+        key = (maxiter, tol_chi2)
+        if key not in self._fit_loops:
+            self._fit_loops[key] = self._make_fit_loop(*key)
+        # parameter covariance comes back in free_names order (offset
+        # row/col dropped in _finalize, matching the reference's
+        # parameter_covariance_matrix without Offset)
+        return self._finish_scan_fit(
+            self._fit_loops[key](self.cm.x0()),
+            "degenerate design-matrix directions zeroed in WLS solve",
+            "non-finite chi2 during WLS fit",
+        )

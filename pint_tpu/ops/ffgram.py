@@ -11,11 +11,14 @@ the MXU while keeping errors far below fit tolerances:
   bf16-pass MXU) whose per-chunk partials accumulate in f64.  Chunking
   bounds the f32 in-chunk accumulation error; measured relative error
   ~3e-8 at chunk=128 (tests/test_ffgram.py) — far below the validated
-  mixed-precision GLS tolerances (see fitting/gls.py).  Accuracy
-  analysis: the Gauss-Newton FIXED POINT depends only on the gradient
-  b = -M^T C^-1 r, whose dominant white-noise part stays an exact-f64
-  matvec in the callers; the Gram A only preconditions the iteration
-  and scales the covariance, where ~1e-7 relative is ample.
+  mixed-precision GLS tolerances.  Accuracy analysis: the callers
+  (fitting/gls.py::_woodbury_mixed_tail, whose docstring is the
+  authoritative precision contract) read the normal-equation matrix A,
+  the gradient b, and r^T N^-1 r all from these Grams; the gradient's
+  ~3e-8 error scales with the current residual norm, so Gauss-Newton
+  stays contracting and converged fits land within ~2e-4 sigma of the
+  all-f64 solution (measured — see the contract for the bound's
+  provenance).
 
 - ``chol_solve_ir``: solve SPD A X = B by Jacobi-equilibrating A
   (D^-1/2 A D^-1/2 tames the ~1e10 dynamic range of power-law

@@ -1,0 +1,96 @@
+"""Decompose the mixed-precision Woodbury solve (the dominant piece of
+the north-star step per profile_step_parts) into its internals.
+
+Usage: python profiling/profile_solve_parts.py [ntoa]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def _chain_time(fn, x0, chain=192, nrep=3):
+    import jax
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            out = fn(c)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            # f32 full reduction: forces the whole output without the
+            # ~3 ms/step cost of an emulated-f64 reduction
+            dep = jax.numpy.sum(leaf.astype(jax.numpy.float32))
+            return c + 0.0 * dep.astype(c.dtype), None
+
+        return jax.lax.scan(body, x, None, length=chain)[0]
+
+    run(x0).block_until_ready()
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        run(x0).block_until_ready()
+        ts.append((time.perf_counter() - t0) / chain)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    sys.path.insert(0, ".")
+    from bench import _build
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import _column_norms
+    from pint_tpu.ops.ffgram import chol_solve_ir, gram32
+    from pint_tpu.ops.pallas_kernels import fourier_gram
+
+    ntoa = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    _, _, cm = _build(ntoa)
+    x0 = cm.x0()
+
+    R = np.asarray(cm.time_residuals(x0, subtract_mean=False))
+    M0 = np.asarray(design_with_offset(cm, x0))
+    Nd0 = np.square(np.asarray(cm.scaled_sigma(x0)))
+    TS, FR, PHI = (np.asarray(a) for a in cm.noise_fourier_spec(x0))
+    Ninv = 1.0 / Nd0
+    norm = np.asarray(_column_norms(jnp.asarray(M0)))
+    Mn = M0 / norm[None, :]
+    X = np.concatenate([Mn, R[:, None]], axis=1)
+    p = Mn.shape[1]
+    k = 2 * len(FR)
+    Sigma0 = np.diag(np.exp(np.random.default_rng(0).normal(0, 2, k))) \
+        + 1e-3 * np.eye(k)
+    B0 = np.random.default_rng(1).normal(size=(k, p + 1))
+
+    parts = {
+        "b_white f64 matvec":
+            lambda x: Mn.T @ (Ninv * (R + 0.0 * x[0])),
+        "r_Nr f64 dot":
+            lambda x: jnp.dot(R + 0.0 * x[0], Ninv * R),
+        "gram32 (A_white)":
+            lambda x: gram32(jnp.asarray(Mn) + 0.0 * x[0], Ninv),
+        "fourier_gram (Pallas)":
+            lambda x: fourier_gram(
+                jnp.asarray(TS) + 0.0 * x[0], FR, Ninv, X
+            )[1],
+        "chol_solve_ir (k x k)":
+            lambda x: chol_solve_ir(
+                jnp.asarray(Sigma0) + 0.0 * x[0], B0
+            ),
+        "eigh (p x p)":
+            lambda x: jnp.linalg.eigh(
+                (Mn.T @ Mn) * (1.0 + 0.0 * x[0])
+            )[1],
+        "empty(baseline)":
+            lambda x: x * 1.0000000001,
+    }
+    print(f"backend={jax.default_backend()} ntoa={ntoa} p={p} k={k}")
+    for name, fn in parts.items():
+        t = _chain_time(fn, cm.x0())
+        print(f"{name:<22}: {t*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -176,3 +176,39 @@ def test_design_matrix_matches_finite_difference():
             M[:, j] / scale, fd / scale, atol=2e-6,
             err_msg=f"design-matrix column {name}",
         )
+
+
+def test_wls_step_gram_matches_svd():
+    """The accelerator 'gram' solve (eigh of the normal equations —
+    emulated-f64 SVD NaNs on the axon TPU) must match the reference
+    'svd' solve, including which degenerate directions get zeroed."""
+    from pint_tpu.fitting.wls import _wls_step
+
+    rng = np.random.default_rng(11)
+    n, p = 600, 6
+    M = rng.normal(size=(n, p)) * np.logspace(0, 5, p)[None, :]
+    r = rng.normal(size=n)
+    w = rng.uniform(0.5, 2.0, n)
+    dx_s, cov_s, nb_s = _wls_step(
+        jnp.asarray(r), jnp.asarray(M), jnp.asarray(w), method="svd"
+    )
+    dx_g, cov_g, nb_g = _wls_step(
+        jnp.asarray(r), jnp.asarray(M), jnp.asarray(w), method="gram"
+    )
+    assert int(nb_s) == int(nb_g) == 0
+    np.testing.assert_allclose(np.asarray(dx_g), np.asarray(dx_s),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(cov_g), np.asarray(cov_s),
+                               rtol=1e-8)
+    # degenerate: duplicate a column -> exactly one zeroed direction,
+    # same min-norm answer from both methods
+    Md = np.concatenate([M, M[:, :1]], axis=1)
+    dx_s, _, nb_s = _wls_step(
+        jnp.asarray(r), jnp.asarray(Md), jnp.asarray(w), method="svd"
+    )
+    dx_g, _, nb_g = _wls_step(
+        jnp.asarray(r), jnp.asarray(Md), jnp.asarray(w), method="gram"
+    )
+    assert int(nb_s) == int(nb_g) == 1
+    np.testing.assert_allclose(np.asarray(dx_g), np.asarray(dx_s),
+                               rtol=1e-7, atol=1e-10)
