@@ -10,10 +10,12 @@ config-2-style epoched data exercises ECORR in the tests instead.)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 value = TOAs/sec for one full fit step on the default backend (TPU
-under the driver) using the framework's production TPU path — the same
-Pallas mixed-precision fused-Gram Woodbury that GLSFitter auto-selects
-on accelerators (fused='auto'; validated bounds in
-gls_step_woodbury_fourier / tests/test_pallas_kernels).
+under the driver) using the framework's production TPU path — the
+general-basis mixed-precision MXU Woodbury that GLSFitter auto-selects
+on accelerators (fused='auto'; compile-time precomputed Fourier basis,
+validated bounds in fitting/gls.py::_woodbury_mixed_tail /
+tests/test_ffgram.py; faster AND more accurate than the opt-in Pallas
+streaming path — see gls_step_woodbury_fourier's accuracy note).
 vs_baseline = speedup over the all-f64 XLA computation pinned to host
 CPU, which stands in for the reference implementation class
 (single-process CPU; SURVEY.md §6 records no published throughput, so
@@ -77,62 +79,61 @@ TNREDC           30
     return model, toas, cm
 
 
-def _fit_step_fn(cm, fused: bool = False):
-    """One GLS Gauss-Newton step.  fused=True uses the Pallas
-    mixed-precision Woodbury (the TPU-first fast path: the red-noise
-    Gram streams through VMEM in f32, validated against f64 in
-    tests/test_pallas_kernels.py); fused=False is the all-f64 XLA path
-    that also serves as the CPU reference-class computation."""
+def _fit_step_fn(cm, mode: str = "f64"):
+    """One GLS Gauss-Newton step.  mode='mixed' is the production
+    accelerator path GLSFitter auto-selects (f32 MXU Grams over the
+    precomputed f64 basis; validated in tests/test_ffgram.py);
+    mode='f64' is the all-f64 XLA path that also serves as the CPU
+    reference-class computation."""
     import jax
     import jax.numpy as jnp
 
     from pint_tpu.fitting.base import design_with_offset, noffset
     from pint_tpu.fitting.gls import (
         gls_step_woodbury,
-        gls_step_woodbury_fourier,
+        gls_step_woodbury_mixed,
     )
 
     no = noffset(cm)
+    step = (
+        gls_step_woodbury_mixed if mode == "mixed" else gls_step_woodbury
+    )
 
     def fit_step(x):
         r = cm.time_residuals(x, subtract_mean=False)
         M = design_with_offset(cm, x)
         Ndiag = jnp.square(cm.scaled_sigma(x))
-        if fused:
-            t_sec, freqs, phi = cm.noise_fourier_spec(x)
-            dx, cov, chi2, _ = gls_step_woodbury_fourier(
-                r, M, Ndiag, t_sec, freqs, phi
-            )
-        else:
-            T, phi = cm.noise_basis_or_empty(x)
-            dx, cov, chi2, _ = gls_step_woodbury(r, M, Ndiag, T, phi)
+        T, phi = cm.noise_basis_or_empty(x)
+        dx, cov, chi2, _ = step(r, M, Ndiag, T, phi)
         return x + dx[no:], chi2
 
     return jax.jit(fit_step)
 
 
-def _time_step(step, x0, nrep=3, chain=16):
+def _time_step(step, x0, nrep=3, chain=16, data_args=()):
     """Median time per fit step, measured as ONE device program of
     `chain` DEPENDENT steps (lax.scan, x feeding forward — exactly how
     GLSFitter._make_fit_loop runs a production fit), so the whole
     chain costs a single dispatch: the ~85 ms axon-tunnel round-trip,
-    irrelevant to TPU throughput, is amortized 1/chain."""
+    irrelevant to TPU throughput, is amortized 1/chain.  data_args:
+    extra runtime arguments prepended to each step call (the CPU
+    baseline passes the bundle this way to defeat constant folding)."""
     import jax
 
     @jax.jit
-    def run_chain(x):
+    def run_chain(x, *data):
         def body(c, _):
-            x2, chi2 = step(c)
+            x2, chi2 = step(*data, c) if data else step(c)
             return x2, chi2
 
         return jax.lax.scan(body, x, None, length=chain)
 
-    x, c = run_chain(x0)  # warmup/compile
+    x, c = run_chain(x0, *data_args)  # warmup/compile
     x.block_until_ready()
     ts = []
     for _ in range(nrep):
         t0 = time.perf_counter()
-        x, c = run_chain(x0)
+        x, c = run_chain(x0, *data_args)
         x.block_until_ready()
         ts.append((time.perf_counter() - t0) / chain)
     return float(np.median(ts))
@@ -146,29 +147,48 @@ def main():
     ntoa = 100_000
     model, toas, cm = _build(ntoa)
 
-    # device path: Pallas fused Woodbury when the noise structure
-    # allows it and a real accelerator is present (on CPU the kernels
-    # run interpreted — correct but not a benchmark path)
-    fused = (
-        jax.default_backend() != "cpu"
-        and cm.noise_fourier_spec(cm.x0()) is not None
-    )
-    step = _fit_step_fn(cm, fused=fused)
+    # device path: the production accelerator mode (GLSFitter 'auto')
+    from pint_tpu.fitting.gls import default_accel_mode
+
+    step = _fit_step_fn(cm, mode=default_accel_mode(cm))
     # chain=64 on device: the steady-state per-step cost (production
     # fits amortize the one-dispatch cost over GN iterations and over
     # vmapped PTA batches; the tunnel round-trip is not TPU work)
     t_dev = _time_step(step, cm.x0(), chain=64)
 
     # CPU baseline: the all-f64 reference-class computation on host
-    # (dispatch-free, so a short chain measures the same steady state)
+    # (dispatch-free, so a short chain measures the same steady state).
+    # Faithfulness guards — the reference (src/pint/fitter.py GLS loop)
+    # recomputes the noise design matrix and refactorizes every
+    # iteration, so the stand-in must too: (a) strip the compile-time
+    # precomputed Fourier-basis masks (a framework feature the
+    # reference class lacks) so the basis sin/cos are recomputed per
+    # step; (b) pass the TOA bundle as a RUNTIME argument so XLA
+    # cannot constant-fold the x-independent noise factorization out
+    # of the loop (folding it would credit the reference class with
+    # our trace-time specialization).
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         cpu_bundle = jax.device_put(cm.bundle, cpu)
+        cpu_bundle = cpu_bundle._replace(masks={
+            k: v for k, v in cpu_bundle.masks.items()
+            if not k.endswith(":F")
+        })
         cm_cpu = type(cm)(cm.model, cpu_bundle, subtract_mean=True)
         cm_cpu.track_mode = cm.track_mode
-        step_cpu = _fit_step_fn(cm_cpu)
+        step_cpu_x = _fit_step_fn(cm_cpu)
+
+        def step_cpu(bundle, x):
+            saved = cm_cpu.bundle
+            cm_cpu.bundle = bundle
+            try:
+                return step_cpu_x(x)
+            finally:
+                cm_cpu.bundle = saved
+
         t_cpu = _time_step(
-            step_cpu, jax.device_put(cm.x0(), cpu), nrep=3, chain=4
+            step_cpu, jax.device_put(cm.x0(), cpu), nrep=3, chain=4,
+            data_args=(cpu_bundle,),
         )
 
     print(

@@ -46,17 +46,21 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
     non-finite chi2 (the host raises ConvergenceFailure from the
     reported flags afterwards — Fitter._finish_scan_fit).
 
-    live_step(x) -> (x_new, cov (p,p), chi2, nbad int32); chi2 may be
+    live_step(x) -> (x_new, cov, chi2, nbad int32) where cov is the
+    NORMALIZED covariance pytree (covn (p,p), norm (p,)) — kept in
+    O(1) device units because raw variances of stiff columns underflow
+    f32-range emulated f64 (gls.py::_finish_normal_eqs); chi2 may be
     evaluated pre-step (GLS: the whitened chi2 of the solve) or
     post-step (WLS: cm.chi2 at x_new) — convergence compares
     successive values either way.  init_chi2(x0) seeds the comparison
     (inf when the first step must always run).
     """
+    cov_init = (jnp.zeros((p, p)), jnp.ones((p,)))
 
     def dead_step(x):
         return (
             x,
-            jnp.zeros((p, p)),
+            cov_init,
             jnp.asarray(jnp.inf),
             jnp.asarray(0, jnp.int32),
         )
@@ -72,7 +76,9 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
             chi2, 1.0
         )
         chi2_keep = jnp.where(done | bad, chi2_prev, chi2)
-        cov_keep = jnp.where(done | bad, cov_prev, cov)
+        cov_keep = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done | bad, a, b), cov_prev, cov
+        )
         new_done = done | bad | converged
         new_conv = conv | (converged & ~done)
         return (
@@ -85,7 +91,7 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
         init = (
             x0,
             init_chi2(x0),
-            jnp.zeros((p, p)),
+            cov_init,
             jnp.asarray(False),
             jnp.asarray(False),
         )
@@ -109,6 +115,9 @@ class Fitter:
         self.converged = False
         self.parameter_covariance_matrix: np.ndarray | None = None
         self.chi2: float | None = None
+        # compiled scan fit loops, keyed per-fitter (mode/maxiter/tol);
+        # here so _finish_scan_fit is self-contained for any subclass
+        self._fit_loops: dict = {}
 
     @property
     def _noffset(self):
@@ -121,6 +130,17 @@ class Fitter:
         """Residuals object for the current compiled state; wideband
         fitters override to return WidebandResiduals."""
         return Residuals(self.toas, self.model, compiled=self.cm)
+
+    @staticmethod
+    def _unnorm_cov(cov):
+        """(covn, norm) -> covn/outer(norm, norm) in HOST IEEE f64
+        (device f64 on axon keeps only the f32 exponent range, where
+        variances of stiff columns like F1 underflow to zero); plain
+        arrays pass through."""
+        if isinstance(cov, tuple):
+            covn, norm = (np.asarray(c) for c in cov)
+            return covn / np.outer(norm, norm)
+        return np.asarray(cov)
 
     def _finish_scan_fit(self, result, warn_msg: str, fail_msg: str):
         """Shared host tail of a make_scan_fit_loop run: emit one
@@ -143,7 +163,7 @@ class Fitter:
         """Drop the offset row/col, commit fitted deltas + uncertainties
         back into host Parameters, refresh residuals."""
         no = self._noffset
-        cov = np.asarray(cov)[no:, no:]
+        cov = self._unnorm_cov(cov)[no:, no:]
         sigmas = np.sqrt(np.diag(cov))
         self.parameter_covariance_matrix = cov
         self.cm.commit(np.asarray(x), uncertainties=sigmas)

@@ -139,7 +139,7 @@ class DownhillWLSFitter(DownhillFitter):
             r = cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
             w = 1.0 / jnp.square(cm.scaled_sigma(x))
-            dx, cov, nbad = _wls_step(r, M, w)
+            dx, cov, nbad = _wls_step(r, M, w, normalized_cov=True)
             return dx[noffset:], cov, nbad
 
         return proposal
@@ -172,7 +172,8 @@ class DownhillGLSFitter(DownhillFitter):
             M = self._design_with_offset(x)
             Ndiag, T, phi = self._noise(x)
             step = gls_step_full_cov if full_cov else gls_step_woodbury
-            dx, cov, _, nbad = step(r, M, Ndiag, T, phi)
+            dx, cov, _, nbad = step(r, M, Ndiag, T, phi,
+                                    normalized_cov=True)
             return dx[noffset:], cov, nbad
 
         return proposal

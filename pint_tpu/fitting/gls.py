@@ -64,17 +64,26 @@ def _eigh_threshold_solve(A, b, threshold=None):
     return Vw @ (V.T @ b), Vw @ V.T, jnp.sum(bad)
 
 
-def _finish_normal_eqs(A, b, r_cinv_r, norm):
+def _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov=False):
     """Shared normal-equation tail for every GLS flavor: thresholded
     solve, covariance, chi2 = r^T C^-1 r minus the fitted decrement
     dx^T b (removes the offset-column power, matching the reference),
-    column un-normalization."""
+    column un-normalization.
+
+    normalized_cov=True returns the covariance as (covn, norm) — O(1)
+    device magnitudes — instead of covn/outer(norm, norm): the
+    unnormalized variance of a stiff column (F1 ~ 1e-40 s^-4) sits
+    BELOW the f32 exponent range that axon's emulated f64 inherits and
+    flushes to zero on device; fitters unnormalize on the host in IEEE
+    f64 (Fitter._unnorm_cov)."""
     dxn, covn, nbad = _eigh_threshold_solve(A, b)
     chi2 = r_cinv_r - jnp.dot(dxn, b)
+    if normalized_cov:
+        return dxn / norm, (covn, norm), chi2, nbad
     return dxn / norm, covn / jnp.outer(norm, norm), chi2, nbad
 
 
-def _solve_normal_eqs(cinv_mult, r, M):
+def _solve_normal_eqs(cinv_mult, r, M, normalized_cov=False):
     """Shared GLS tail: column-normalize, form/solve normal equations
     via an explicit C^-1-apply."""
     norm = _column_norms(M)
@@ -83,7 +92,8 @@ def _solve_normal_eqs(cinv_mult, r, M):
     Cir = cinv_mult(r[:, None])[:, 0]
     A = Mn.T @ CiM
     b = -(Mn.T @ Cir)
-    return _finish_normal_eqs(A, b, jnp.dot(r, Cir), norm)
+    return _finish_normal_eqs(A, b, jnp.dot(r, Cir), norm,
+                              normalized_cov)
 
 
 def make_cinv_mult(Ndiag, T, phi):
@@ -103,16 +113,19 @@ def make_cinv_mult(Ndiag, T, phi):
     return cinv_mult
 
 
-def gls_step_woodbury(r, M, Ndiag, T, phi):
+def gls_step_woodbury(r, M, Ndiag, T, phi, normalized_cov=False):
     """One GLS normal-equation solve, reduced-rank path.
 
     r (n,), M (n,p), Ndiag (n,), T (n,k), phi (k,) ->
-    (dx (p,), cov (p,p), chi2, n_degenerate).
+    (dx (p,), cov (p,p), chi2, n_degenerate); normalized_cov=True
+    returns cov as (covn, norm) — see _finish_normal_eqs.
     """
-    return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M)
+    return _solve_normal_eqs(make_cinv_mult(Ndiag, T, phi), r, M,
+                             normalized_cov)
 
 
-def _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm):
+def _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
+                         normalized_cov=False):
     """Shared mixed-precision Woodbury assembly: given the f32-grade
     Grams G_XX = X^T N^-1 X for X = [Mn | r], sig_tt = T^T N^-1 T, and
     twx = T^T N^-1 X, build and solve the normal equations.
@@ -144,16 +157,26 @@ def _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm):
     A = A_white - twx[:, :-1].T @ corr[:, :-1]
     b = -(b_white - twx[:, :-1].T @ corr[:, -1])
     r_cinv_r = r_Nr - jnp.dot(twx[:, -1], corr[:, -1])
-    return _finish_normal_eqs(A, b, r_cinv_r, norm)
+    return _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov)
 
 
-def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
+def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi,
+                              normalized_cov=False):
     """Woodbury GLS with the Pallas fused-Gram kernels: the red-noise
     basis T = [sin, cos](2 pi f t) is never materialized — its Gram
     pieces stream through VMEM in f32 (ops/pallas_kernels.py), then the
-    shared mixed-precision assembly (_woodbury_mixed_tail, which
-    documents the precision contract) finishes the solve.  Requires a
-    pure-Fourier basis (CompiledModel.noise_fourier_spec).
+    shared mixed-precision assembly (_woodbury_mixed_tail) finishes the
+    solve.  Requires a pure-Fourier basis
+    (CompiledModel.noise_fourier_spec).
+
+    ACCURACY NOTE (why this is opt-in, not 'auto'): the in-kernel f32
+    phase arguments 2 pi f t carry ~1e-5 rad error at multi-year
+    spans, a SYSTEMATIC basis perturbation that moves red-noise-
+    degenerate parameters (F1) by ~0.2 sigma at PTA scale (measured vs
+    the dense and general-mixed paths, which agree with each other to
+    ~2e-3 sigma).  Use for quick-look fits or when n*2k is too large
+    to materialize; the 'mixed' path with the compile-time precomputed
+    basis is both faster and f64-basis accurate at bench scale.
     """
     from pint_tpu.ops.ffgram import gram32
     from pint_tpu.ops.pallas_kernels import fourier_gram
@@ -166,10 +189,11 @@ def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi):
     return _woodbury_mixed_tail(
         gram32(X, Ninv),
         sig_tt.astype(jnp.float64), twx.astype(jnp.float64), phi, norm,
+        normalized_cov,
     )
 
 
-def gls_step_woodbury_mixed(r, M, Ndiag, T, phi):
+def gls_step_woodbury_mixed(r, M, Ndiag, T, phi, normalized_cov=False):
     """Woodbury GLS for an arbitrary reduced-rank basis (ECORR
     quantization blocks, combined ECORR+Fourier stacks) with the noise
     side in f32 on the MXU — the general-basis sibling of the Pallas
@@ -189,7 +213,8 @@ def gls_step_woodbury_mixed(r, M, Ndiag, T, phi):
     Mn = M / norm[None, :]
     X = jnp.concatenate([Mn, r[:, None]], axis=1)
     sig_tt, twx, G_XX = gram32_joint(T.astype(jnp.float32), X, Ninv)
-    return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm)
+    return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
+                                normalized_cov)
 
 
 def default_accel_mode(cm) -> str:
@@ -203,32 +228,59 @@ def default_accel_mode(cm) -> str:
     return "mixed" if cm.has_correlated_errors else "f64"
 
 
-def gls_step_full_cov(r, M, Ndiag, T, phi):
+def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
+                      normalized_cov=False):
     """Dense-covariance path: C = diag(N) + T phi T^T, explicit n x n
-    Cholesky (reference full_cov=True)."""
+    factorization (reference full_cov=True) — the O(n^3) wall the TPU
+    build attacks.
+
+    method='f64' (CPU default): explicit f64 Cholesky.
+    method='mixed' (accelerator default): equilibrated f32 MXU Cholesky
+    + iterative refinement (ops/ffgram.py::chol_solve_ir, whose
+    refinement residuals use the split-f32 matmul above n=1024), one
+    factorization applied to [Mn | r] jointly — an emulated-f64 n x n
+    Cholesky is ~300x slower than f32 on TPU.  Same validated
+    tolerance class as the reduced-rank mixed paths
+    (_woodbury_mixed_tail)."""
     from pint_tpu.models.noise import dense_noise_cov
 
-    L = jnp.linalg.cholesky(dense_noise_cov(Ndiag, T, phi))
+    if method is None:
+        method = "f64" if jax.default_backend() == "cpu" else "mixed"
+    C = dense_noise_cov(Ndiag, T, phi)
+    if method == "mixed":
+        from pint_tpu.ops.ffgram import chol_solve_ir, matmul_split32
+
+        norm = _column_norms(M)
+        Mn = M / norm[None, :]
+        X = jnp.concatenate([Mn, r[:, None]], axis=1)
+        CiX = chol_solve_ir(C, X)
+        # X^T C^-1 X on the MXU (an n x (p+1) emulated-f64 matmul
+        # would cost more than the factorization on TPU)
+        G = matmul_split32(X.T, CiX)
+        return _finish_normal_eqs(
+            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
+        )
+    L = jnp.linalg.cholesky(C)
 
     def cinv_mult(X):
         Y = jax.scipy.linalg.solve_triangular(L, X, lower=True)
         return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
 
-    return _solve_normal_eqs(cinv_mult, r, M)
+    return _solve_normal_eqs(cinv_mult, r, M, normalized_cov)
 
 
 class GLSFitter(Fitter):
     """Iterated GLS fit; also correct (equals WLS) with no correlated
     noise in the model.
 
-    fused='auto' (default) picks, on accelerators, the Pallas
-    fused-Gram Woodbury when the correlated noise is a pure Fourier
-    basis, or the general-basis mixed-precision MXU path otherwise
-    (see _woodbury_mixed_tail for the validated accuracy bounds);
+    fused='auto' (default) picks, on accelerators, the general-basis
+    mixed-precision MXU path for correlated-noise models (see
+    _woodbury_mixed_tail for the validated accuracy bounds; the
+    Fourier basis is a compile-time host-precomputed constant);
     fused=False forces the all-f64 path (always used on CPU),
-    fused=True forces the Pallas path (errors if the noise structure
-    disallows it), fused='mixed' forces the general-basis
-    mixed-precision path (any backend — used by cross-path tests).
+    fused=True opts into the Pallas streaming-basis path (see
+    gls_step_woodbury_fourier's accuracy note), fused='mixed' forces
+    the mixed path on any backend (used by cross-path tests).
     """
 
     def __init__(self, toas: TOAs, model: TimingModel,
@@ -236,7 +288,6 @@ class GLSFitter(Fitter):
         super().__init__(toas, model)
         self.full_cov = full_cov
         self.fused = fused
-        self._fit_loops: dict = {}
 
     def _step_inputs(self, x):
         """(residuals, design-with-offset, Ndiag) for one GLS step;
@@ -276,9 +327,8 @@ class GLSFitter(Fitter):
             return "f64"
         if self.fused == "mixed":
             return "mixed"
-        has_spec = self._fourier_available()
         if self.fused is True:
-            if not has_spec:
+            if not self._fourier_available():
                 from pint_tpu.exceptions import PintTpuError
 
                 raise PintTpuError(
@@ -286,28 +336,43 @@ class GLSFitter(Fitter):
                     "noise basis (PL red noise)"
                 )
             return "fourier"
-        # 'auto': mixed precision on accelerators only (on CPU native
-        # f64 is fast and interpret-mode Pallas is slow)
-        if has_spec and jax.default_backend() != "cpu":
-            return "fourier"
+        # 'auto': the general mixed path on accelerators — with the
+        # compile-time precomputed Fourier basis (models/noise.py::
+        # fourier_basis) it is both faster than the Pallas streaming
+        # path (30.5M vs 28.4M TOAs/s at the 1e5-TOA bench) and far
+        # more accurate (the in-kernel f32 phases cost ~0.2 sigma on
+        # stiff spin parameters; see gls_step_woodbury_fourier).
+        # fused=True opts into the streaming kernel (it never
+        # materializes the (n, 2k) basis — useful at very large n*k).
         return default_accel_mode(self.cm)
 
     def _make_step(self, mode: str):
+        """Step closure returning (dx, (covn, norm), chi2, nbad) — the
+        covariance stays normalized on device (see _finish_normal_eqs)
+        and is unnormalized on the host by _finish_scan_fit."""
         def step(x):
             r, M, Ndiag = self._step_inputs(x)
             if mode == "fourier":
                 t_sec, freqs, phi = self.cm.noise_fourier_spec(x)
                 return gls_step_woodbury_fourier(
-                    r, M, Ndiag, t_sec, freqs, phi
+                    r, M, Ndiag, t_sec, freqs, phi, normalized_cov=True
                 )
             # pure white: Woodbury with the empty basis degenerates to
             # WLS normal equations
             T, phi = self._step_noise(x)
             if mode == "full_cov":
-                return gls_step_full_cov(r, M, Ndiag, T, phi)
+                return gls_step_full_cov(
+                    r, M, Ndiag, T, phi,
+                    method="f64" if self.fused is False else None,
+                    normalized_cov=True,
+                )
             if mode == "mixed":
-                return gls_step_woodbury_mixed(r, M, Ndiag, T, phi)
-            return gls_step_woodbury(r, M, Ndiag, T, phi)
+                return gls_step_woodbury_mixed(
+                    r, M, Ndiag, T, phi, normalized_cov=True
+                )
+            return gls_step_woodbury(
+                r, M, Ndiag, T, phi, normalized_cov=True
+            )
 
         return step
 
@@ -334,8 +399,16 @@ class GLSFitter(Fitter):
         if tol_chi2 is None:
             # the mixed-precision modes carry ~1e-6 relative f32 noise
             # in chi2 between iterations; demanding the f64 tolerance
-            # there would spin to maxiter and report converged=False
-            tol_chi2 = 1e-10 if mode in ("f64", "full_cov") else 3e-6
+            # there would spin to maxiter and report converged=False.
+            # full_cov is only exact when its method resolves to f64
+            # (CPU backend or fused=False) — on accelerators it takes
+            # the f32-Cholesky mixed method.
+            exact = mode == "f64" or (
+                mode == "full_cov"
+                and (self.fused is False
+                     or jax.default_backend() == "cpu")
+            )
+            tol_chi2 = 1e-10 if exact else 3e-6
         key = (mode, maxiter, tol_chi2)
         if key not in self._fit_loops:
             self._fit_loops[key] = self._make_fit_loop(*key)

@@ -51,7 +51,11 @@ class MinimizeFitter(Fitter):
         x = jnp.asarray(res.x)
         M = self._design_with_offset(x)
         w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
-        _, cov, _ = _wls_step(jnp.zeros(self.cm.bundle.ntoa), M, w)
+        # normalized covariance (device outer(norm, norm) overflows
+        # f32-range emulated f64); _finalize unnormalizes on the host
+        _, cov, _ = _wls_step(
+            jnp.zeros(self.cm.bundle.ntoa), M, w, normalized_cov=True
+        )
         return self._finalize(res.x, cov, float(res.fun))
 
 

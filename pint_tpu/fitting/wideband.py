@@ -183,7 +183,8 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
             M = self._combined_design(x)
             Ndiag, T, phi = self._combined_noise(x)
             fn = gls_step_full_cov if full_cov else gls_step_woodbury
-            dx, cov, _, nbad = fn(r, M, Ndiag, T, phi)
+            dx, cov, _, nbad = fn(r, M, Ndiag, T, phi,
+                                  normalized_cov=True)
             return dx[noffset:], cov, nbad
 
         return proposal

@@ -19,7 +19,8 @@ from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
 
-def _wls_step(r, M, w, threshold=None, method=None):
+def _wls_step(r, M, w, threshold=None, method=None,
+              normalized_cov=False):
     """One WLS least-squares solve with degenerate-direction zeroing.
 
     r (n,), M (n,p) = d resid/d x, w (n,) weights -> (delta_x (p,),
@@ -38,8 +39,8 @@ def _wls_step(r, M, w, threshold=None, method=None):
     the Gram's own roundoff floor (the GLS-tail convention,
     gls.py::_finish_normal_eqs), NOT the square of the SVD cut (which
     sits far below that floor and would never fire): it zeroes
-    directions with s/s0 below ~1e-8, exactly those whose Gram content
-    is roundoff.
+    directions with s/s0 below sqrt(eps*max(n,p)) — ~4e-7 at n=600,
+    ~1.5e-5 at n=1e5 — exactly those whose Gram content is roundoff.
     """
     from pint_tpu.fitting.gls import _column_norms, _eigh_threshold_solve
 
@@ -56,24 +57,23 @@ def _wls_step(r, M, w, threshold=None, method=None):
     if threshold is None:
         threshold = jnp.finfo(jnp.float64).eps * max(A.shape)
     if method == "gram":
-        dx, cov, nbad = _eigh_threshold_solve(A.T @ A, A.T @ b, threshold)
-        return dx / norm, cov / jnp.outer(norm, norm), nbad
-    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
-    bad = s < threshold * s[0]
-    s_inv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, s))
-    dx = (Vt.T * s_inv[None, :]) @ (U.T @ b) / norm
-    cov = (Vt.T * s_inv[None, :] ** 2) @ Vt / jnp.outer(norm, norm)
-    return dx, cov, jnp.sum(bad)
+        dx, covn, nbad = _eigh_threshold_solve(A.T @ A, A.T @ b, threshold)
+    else:
+        U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+        bad = s < threshold * s[0]
+        s_inv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, s))
+        dx = (Vt.T * s_inv[None, :]) @ (U.T @ b)
+        covn = (Vt.T * s_inv[None, :] ** 2) @ Vt
+        nbad = jnp.sum(bad)
+    if normalized_cov:  # see gls.py::_finish_normal_eqs on why
+        return dx / norm, (covn, norm), nbad
+    return dx / norm, covn / jnp.outer(norm, norm), nbad
 
 
 class WLSFitter(Fitter):
     """Iterated WLS fit, run — like GLSFitter — as ONE device program
     (the whole Gauss-Newton iteration in a lax.scan, one dispatch per
     fit instead of 2·maxiter host round-trips)."""
-
-    def __init__(self, toas: TOAs, model: TimingModel):
-        super().__init__(toas, model)
-        self._fit_loops: dict = {}
 
     # residuals WITHOUT mean subtraction; the offset column absorbs the
     # mean exactly as the reference's "Offset" design-matrix column does.
@@ -92,7 +92,7 @@ class WLSFitter(Fitter):
             r = self._r(x)
             M = self._design_with_offset(x)
             w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
-            dx, cov, nbad = _wls_step(r, M, w)
+            dx, cov, nbad = _wls_step(r, M, w, normalized_cov=True)
             x_new = x + dx[no:]  # dx[0] is the offset column
             return x_new, cov, self.cm.chi2(x_new), nbad.astype(jnp.int32)
 

@@ -50,7 +50,9 @@ class Astrometry(DelayComponent):
         elif self.params.get("PEPOCH_FALLBACK") is not None:  # pragma: no cover
             day, sec = pdict["PEPOCH"]
         else:
-            day, sec = float(np.asarray(bundle.tdb_day)[0]), 0.0
+            # first-TOA fallback epoch; keep traceable (the bundle may
+            # be a tracer under vmap / bundle-as-argument callers)
+            day, sec = bundle.tdb_day[0], 0.0
         return bundle.dt_seconds(day, sec).to_float()
 
     def ssb_to_psr_xyz(self, pdict, bundle):

@@ -238,12 +238,35 @@ def fourier_freqs(bundle, nharm: int):
     return t, j / tspan, tspan
 
 
-def fourier_basis(bundle, nharm: int):
-    """(n, 2*nharm) sin/cos design matrix and the frequencies (Hz)."""
+def fourier_basis(bundle, nharm: int, mask_key: str | None = None):
+    """(n, 2*nharm) sin/cos design matrix and the frequencies (Hz).
+
+    The basis depends only on static TOA times, so components
+    precompute it host-side in IEEE f64 at compile time (extra_masks)
+    and pass its bundle.masks key: that makes every fit-loop step read
+    a constant instead of re-evaluating n*k emulated-f64 sin/cos on
+    device (~1 ms/step at 1e5 TOAs x 30 harmonics on TPU), and is also
+    MORE accurate on axon (emulated f64 is non-IEEE).  The traced
+    fallback serves hand-built bundles without the mask."""
     t, f, tspan = fourier_freqs(bundle, nharm)
-    arg = 2.0 * math.pi * t[:, None] * f[None, :]
-    F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=1)
+    F = bundle.masks.get(mask_key) if mask_key else None
+    if F is None:
+        arg = 2.0 * math.pi * t[:, None] * f[None, :]
+        F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=1)
     return F, jnp.concatenate([f, f]), tspan
+
+
+def host_fourier_basis(toas, nharm: int) -> np.ndarray:
+    """Host-side (IEEE f64 numpy) twin of fourier_basis's sin/cos
+    matrix, from the same TDB columns bundle.py packs — computed once
+    per dataset at compile time."""
+    day = np.asarray(toas.t_tdb.mjd_int, dtype=np.float64)
+    sec = np.asarray(toas.t_tdb.sec.to_float(), dtype=np.float64)
+    t = (day - day[0]) * 86400.0 + sec
+    tspan = t.max() - t.min()
+    f = np.arange(1, nharm + 1, dtype=np.float64) / tspan
+    arg = 2.0 * np.pi * t[:, None] * f[None, :]
+    return np.concatenate([np.sin(arg), np.cos(arg)], axis=1)
 
 
 def powerlaw_phi(f, tspan, log10_amp, gamma):
@@ -258,7 +281,18 @@ def powerlaw_phi(f, tspan, log10_amp, gamma):
     )
 
 
-class PLRedNoise(NoiseComponent):
+class _FourierBasisNoise(NoiseComponent):
+    """Base for PL Fourier-basis noise: precomputes the sin/cos basis
+    host-side at compile (see fourier_basis)."""
+
+    def _basis_key(self) -> str:
+        return f"{self.category}:F"
+
+    def extra_masks(self, toas) -> dict:
+        return {self._basis_key(): host_fourier_basis(toas, self._nharm())}
+
+
+class PLRedNoise(_FourierBasisNoise):
     """Power-law achromatic red noise (TNREDAMP/TNREDGAM/TNREDC)."""
 
     register = True
@@ -287,7 +321,8 @@ class PLRedNoise(NoiseComponent):
         return int(v) if v is not None else 30
 
     def basis_weight(self, pdict, bundle):
-        F, f, tspan = fourier_basis(bundle, self._nharm())
+        F, f, tspan = fourier_basis(bundle, self._nharm(),
+                                    self._basis_key())
         phi = powerlaw_phi(
             f, tspan, pdict["TNREDAMP"], pdict["TNREDGAM"]
         )
@@ -307,7 +342,7 @@ class PLRedNoise(NoiseComponent):
         return t, f, phi
 
 
-class PLChromNoise(NoiseComponent):
+class PLChromNoise(_FourierBasisNoise):
     """Power-law chromatic noise (reference: noise_model.py::
     PLChromNoise) — basis columns scaled by (1400 MHz / f)^index.  The
     chromatic index is the ChromaticCM component's CMIDX/TNCHROMIDX (the
@@ -336,7 +371,8 @@ class PLChromNoise(NoiseComponent):
         return int(v) if v is not None else 30
 
     def basis_weight(self, pdict, bundle):
-        F, f, tspan = fourier_basis(bundle, self._nharm())
+        F, f, tspan = fourier_basis(bundle, self._nharm(),
+                                    self._basis_key())
         idx = pdict.get("CMIDX")
         if idx is None:
             idx = 4.0
@@ -348,7 +384,7 @@ class PLChromNoise(NoiseComponent):
         return F, phi
 
 
-class PLDMNoise(NoiseComponent):
+class PLDMNoise(_FourierBasisNoise):
     """Power-law DM (chromatic nu^-2) noise; basis columns scaled by
     (1400 MHz / f)^2 so amplitudes share the red-noise convention."""
 
@@ -372,7 +408,8 @@ class PLDMNoise(NoiseComponent):
         return int(v) if v is not None else 30
 
     def basis_weight(self, pdict, bundle):
-        F, f, tspan = fourier_basis(bundle, self._nharm())
+        F, f, tspan = fourier_basis(bundle, self._nharm(),
+                                    self._basis_key())
         chrom = jnp.square(1400.0 / bundle.freq_mhz)
         F = F * chrom[:, None]
         phi = powerlaw_phi(f, tspan, pdict["TNDMAMP"], pdict["TNDMGAM"])

@@ -86,13 +86,62 @@ def gram32_joint(T32, A, w, chunk: int = 128):
     return G[:k, :k], G[:k, k:], G[k:, k:]
 
 
+def make_matmul_split32(A, chunk: int = 128):
+    """Pre-split A (m, K) f64 into chunked two-term f32 blocks and
+    return B -> A @ B.  Splitting costs O(m*K) pad/cast/transpose
+    traffic, so callers that apply the same A repeatedly (the
+    iterative-refinement loop) must split once, not per product."""
+    m, K = A.shape
+    K_pad = (K + chunk - 1) // chunk * chunk
+    nc = K_pad // chunk
+    Ap = jnp.zeros((m, K_pad), A.dtype).at[:, :K].set(A)
+    A_hi = Ap.astype(jnp.float32)
+    A_lo = (Ap - A_hi).astype(jnp.float32)
+    Ab_hi = A_hi.reshape(m, nc, chunk).transpose(1, 0, 2)
+    Ab_lo = A_lo.reshape(m, nc, chunk).transpose(1, 0, 2)
+
+    def bmm(X, Y):
+        return jax.lax.dot_general(
+            X, Y, (((2,), (1,)), ((0,), (0,))),
+            precision=_HIGHEST,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.float64)
+
+    def matmul(B):
+        Bp = jnp.zeros((K_pad, B.shape[1]), B.dtype).at[:K].set(B)
+        B_hi = Bp.astype(jnp.float32)
+        B_lo = (Bp - B_hi).astype(jnp.float32)
+        Bb_hi = B_hi.reshape(nc, chunk, B.shape[1])
+        Bb_lo = B_lo.reshape(nc, chunk, B.shape[1])
+        C = bmm(Ab_hi, Bb_hi) + bmm(Ab_hi, Bb_lo) + bmm(Ab_lo, Bb_hi)
+        return jnp.sum(C, axis=0)
+
+    return matmul
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def matmul_split32(A, B, chunk: int = 128):
+    """C = A @ B (f64 in/out) via an error-free two-term f32 split of
+    both operands: three chunked f32 MXU matmuls (hi*hi, hi*lo, lo*hi;
+    lo*lo is ~2^-48 relative and dropped) whose per-chunk partials
+    accumulate in f64.  Error class matches gram32 (~1e-7 relative to
+    summed-term magnitudes for deep contractions).  Used where a large
+    f64 matmul would otherwise run emulated (dense-covariance
+    refinement residuals, normal-equation tails)."""
+    return make_matmul_split32(A, chunk)(B)
+
+
 def chol_solve_ir(A, B, refine: int = 2):
     """Solve SPD A X = B (f64) with an f32 Cholesky + f64 iterative
     refinement.  Jacobi equilibration first: power-law red-noise
     Woodbury matrices have ~1e10 dynamic range on the diagonal, beyond
     f32 Cholesky's reach; D^-1/2 A D^-1/2 has unit diagonal and mild
-    conditioning, after which `refine` f64 residual-correction passes
-    (error ~ (eps32 * cond)^(refine+1)) recover f64-grade accuracy.
+    conditioning, after which `refine` residual-correction passes
+    (error ~ (eps32 * cond)^(refine+1)) recover f64-grade accuracy —
+    down to the residual's own accuracy: exact f64 for small systems,
+    the split-f32 matmul's ~3e-8 class for large ones (where an
+    emulated-f64 dense matmul would dominate the dense-covariance
+    solve on TPU).
     """
     d = jnp.sqrt(jnp.diagonal(A))
     dinv = 1.0 / d
@@ -107,8 +156,13 @@ def chol_solve_ir(A, B, refine: int = 2):
         Z = jax.scipy.linalg.solve_triangular(L32.T, Y, lower=False)
         return Z.astype(jnp.float64)
 
+    if A.shape[0] >= 1024:  # static: shape known at trace time
+        mm = make_matmul_split32(Aeq)  # split Aeq ONCE for all passes
+    else:
+        def mm(X):
+            return Aeq @ X  # f64: one small matmul per pass
+
     X = solve32(Beq)
     for _ in range(refine):
-        R = Beq - Aeq @ X  # f64: one small matmul per pass
-        X = X + solve32(R)
+        X = X + solve32(Beq - mm(X))
     return X * dinv[:, None]

@@ -8,10 +8,15 @@ inside the kernel and feeding the MXU directly — HBM traffic drops from
 O(n k) per product to O(n), the arithmetic-intensity shape the MXU
 wants (pallas_guide.md: keep matmuls large and resident).
 
-Precision: f32 compute (native TPU VPU/MXU).  This is an OPT-IN fast
-path for the noise-covariance side (weights/bases), where ~1e-6
-relative error perturbs parameter uncertainties, not the timing
-residuals themselves; the f64 XLA path stays the default everywhere.
+Precision: f32 compute (native TPU VPU/MXU).  OPT-IN (GLSFitter
+fused=True): the in-kernel f32 phase arguments 2 pi f t carry ~1e-5
+rad error over multi-year spans — a systematic basis perturbation that
+moves red-noise-degenerate parameters (F1) by ~0.2 sigma at PTA scale
+(fitting/gls.py::gls_step_woodbury_fourier documents the measurement).
+The production 'auto' path instead reads the compile-time
+host-precomputed f64 basis (models/noise.py::fourier_basis) and
+f32-Grams it on the MXU — as fast, and f64-basis accurate.  These
+kernels remain the answer when n*2k is too large to materialize.
 On CPU the kernels run in interpret mode (tests exercise both).
 """
 

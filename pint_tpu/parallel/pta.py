@@ -94,6 +94,17 @@ class PTABatch:
                 )
             if set(cm.bundle.masks) != set(cms[0].bundle.masks):
                 raise PintTpuError("PTA batch needs identical mask keys")
+            for k, v0 in cms[0].bundle.masks.items():
+                v = cm.bundle.masks[k]
+                if v.shape[1:] != v0.shape[1:]:
+                    # e.g. precomputed noise-basis matrices with
+                    # different harmonic counts (mismatched TNREDC)
+                    raise PintTpuError(
+                        "PTA batch needs identical noise-basis/mask "
+                        f"structure: mask {k!r} is {v0.shape} vs "
+                        f"{v.shape} — match TNREDC / ECORR epoch "
+                        "structures across pulsars"
+                    )
         self.cms = cms
         self.free_names = names
         self.npulsars = len(cms)
@@ -186,7 +197,7 @@ class PTABatch:
 
     def fit_step(self, xs, mode: str | None = None):
         """One batched GLS Gauss-Newton step for every pulsar:
-        -> (new xs (P, p), chi2 (P,), cov (P, p, p)).
+        -> (new xs (P, p), chi2 (P,), (covn (P, p, p), norm (P, p))).
 
         mode: 'mixed' | 'f64' | None (None = _step_mode policy)."""
         no = noffset(self._proto)
@@ -206,8 +217,14 @@ class PTABatch:
             M = design_with_offset(cm, x)
             Ndiag = jnp.square(cm.scaled_sigma(x))
             T, phi = cm.noise_basis_or_empty(x)
-            dx, cov, chi2, _ = gls_step(r, M, Ndiag, T, phi)
-            return x + dx[no:], chi2, cov[no:, no:]
+            # covariance stays NORMALIZED on device ((covn, norm) —
+            # raw variances of stiff columns underflow f32-range
+            # emulated f64; see fitting/gls.py::_finish_normal_eqs);
+            # fit() unnormalizes on the host
+            dx, (covn, nrm), chi2, _ = gls_step(
+                r, M, Ndiag, T, phi, normalized_cov=True
+            )
+            return x + dx[no:], chi2, (covn[no:, no:], nrm[no:])
 
         call = self._with_state(single)
         return jax.vmap(call)(self.bundle, self.ref, xs)
@@ -225,8 +242,10 @@ class PTABatch:
         key = (mode, maxiter)
         if key not in self._fit_loops:
             self._fit_loops[key] = self._make_fit_loop(mode, maxiter)
-        xs, chi2, cov = self._fit_loops[key](self.x0())
-        self.cov = cov
+        xs, chi2, (covn, nrm) = self._fit_loops[key](self.x0())
+        # unnormalize in HOST IEEE f64 (see fit_step)
+        covn, nrm = np.asarray(covn), np.asarray(nrm)
+        self.cov = covn / (nrm[:, :, None] * nrm[:, None, :])
         return xs, chi2
 
     def _make_fit_loop(self, mode: str, maxiter: int):
@@ -241,7 +260,10 @@ class PTABatch:
             init = (
                 xs0,
                 jnp.zeros((self.npulsars,)),
-                jnp.zeros((self.npulsars, p, p)),
+                (
+                    jnp.zeros((self.npulsars, p, p)),
+                    jnp.ones((self.npulsars, p)),
+                ),
             )
             (xs, chi2, cov), _ = jax.lax.scan(
                 body, init, None, length=maxiter

@@ -144,9 +144,17 @@ class MCMCFitter:
         x = cm.x0()
         M = design_with_offset(cm, x)
         w = 1.0 / jnp.square(cm.scaled_sigma(x))
-        _, cov, _ = _wls_step(jnp.zeros(cm.bundle.ntoa), M, w)
+        # normalized covariance + host unnormalization: device
+        # outer(norm, norm) overflows f32-range emulated f64 for stiff
+        # columns (F1) and would zero the walker spread there
+        # (fitting/gls.py::_finish_normal_eqs)
+        _, (covn, norm), _ = _wls_step(
+            jnp.zeros(cm.bundle.ntoa), M, w, normalized_cov=True
+        )
+        covn, norm = np.asarray(covn), np.asarray(norm)
+        cov = covn / np.outer(norm, norm)
         no = noffset(cm)
-        return np.asarray(cov)[no:, no:]
+        return cov[no:, no:]
 
     def fit_toas(
         self, nsteps: int = 1000, nwalkers: int = 64, burn: float = 0.25,

@@ -86,3 +86,68 @@ def test_chol_solve_ir_identity():
     B = jnp.arange(16.0).reshape(8, 2)
     assert np.allclose(np.asarray(chol_solve_ir(A, B)), np.asarray(B) / 3.0,
                        rtol=1e-14)
+
+
+def test_matmul_split32_matches_f64(rng):
+    from pint_tpu.ops.ffgram import matmul_split32
+
+    A = rng.normal(size=(300, 777)) * np.exp(rng.normal(0, 3, (300, 777)))
+    B = rng.normal(size=(777, 5))
+    C = matmul_split32(jnp.asarray(A), jnp.asarray(B))
+    C_ref = A @ B
+    scale = np.abs(A) @ np.abs(B)  # summed-term magnitudes
+    assert np.max(np.abs(np.asarray(C) - C_ref) / scale) < 1e-6
+
+
+def test_chol_solve_ir_large_uses_split_residual(rng):
+    """n >= 1024 switches the refinement residual to matmul_split32;
+    the solve must still reach the split-residual floor (~1e-7
+    class — IR converges down to its residual's own accuracy)."""
+    from pint_tpu.ops.ffgram import chol_solve_ir
+
+    n = 1100
+    Q = rng.normal(size=(n, n)) / np.sqrt(n)
+    A = Q @ Q.T + np.diag(np.exp(rng.uniform(-3, 3, n)))
+    X_true = rng.normal(size=(n, 3))
+    B = A @ X_true
+    X = chol_solve_ir(jnp.asarray(A), jnp.asarray(B))
+    err = np.max(np.abs(np.asarray(X) - X_true)) / np.max(np.abs(X_true))
+    assert err < 1e-6
+
+
+def test_gls_full_cov_mixed_matches_f64():
+    """The accelerator dense-covariance path (f32 MXU Cholesky + IR)
+    must match the f64 dense path within the mixed tolerance class."""
+    import jax
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import gls_step_full_cov
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR D\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+        "EFAC -f L-wide 1.2\nECORR -f L-wide 0.8\n"
+        "TNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 8\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=240, seed=8)
+    cm = m.compile(toas)
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Nd = jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    dx64, cov64, chi64, _ = jax.jit(
+        lambda *a: gls_step_full_cov(*a, method="f64")
+    )(r, M, Nd, T, phi)
+    dxm, covm, chim, _ = jax.jit(
+        lambda *a: gls_step_full_cov(*a, method="mixed")
+    )(r, M, Nd, T, phi)
+    np.testing.assert_allclose(
+        np.asarray(dxm), np.asarray(dx64),
+        atol=2e-3 * np.max(np.abs(np.asarray(dx64))),
+    )
+    assert float(chim) == pytest.approx(float(chi64), rel=1e-3)
+    np.testing.assert_allclose(
+        np.sqrt(np.diag(np.asarray(covm))),
+        np.sqrt(np.diag(np.asarray(cov64))), rtol=5e-3,
+    )

@@ -199,8 +199,10 @@ def test_refit_after_commit_is_stable():
 
 
 def test_step_mode_selection(monkeypatch):
-    """Mode ladder: pure-Fourier -> 'fourier', general basis ->
-    'mixed', pure white -> 'f64' (on accelerators); CPU always 'f64'."""
+    """Mode ladder: any correlated basis -> 'mixed' on accelerators
+    (the Pallas 'fourier' path is opt-in via fused=True — its
+    in-kernel f32 phases cost accuracy), pure white -> 'f64'; CPU
+    always 'f64'."""
     import jax
 
     from pint_tpu.fitting import GLSFitter
@@ -212,17 +214,53 @@ def test_step_mode_selection(monkeypatch):
     fitters = {}
     for name, par in (
         ("white", base),
-        ("fourier", base + red),
-        ("mixed", base + red + ecorr),
+        ("red", base + red),
+        ("red_ecorr", base + red + ecorr),
     ):
         m, toas = make_test_pulsar(par, ntoa=40, seed=1)
         fitters[name] = GLSFitter(toas, m)
-    # on the CPU test backend everything is f64
-    assert {f._step_mode() for f in fitters.values()} == {"f64"}
+    m_f, toas_f = make_test_pulsar(base + red, ntoa=40, seed=1)
+    fitters["fused_true"] = GLSFitter(toas_f, m_f, fused=True)
+    # on the CPU test backend 'auto' is always f64
+    assert {
+        f._step_mode() for k, f in fitters.items() if k != "fused_true"
+    } == {"f64"}
     # pretend-accelerator: selection logic only (no device work)
     import pint_tpu.fitting.gls as gls_mod
 
     monkeypatch.setattr(gls_mod.jax, "default_backend", lambda: "tpu")
     assert fitters["white"]._step_mode() == "f64"
-    assert fitters["fourier"]._step_mode() == "fourier"
-    assert fitters["mixed"]._step_mode() == "mixed"
+    assert fitters["red"]._step_mode() == "mixed"
+    assert fitters["red_ecorr"]._step_mode() == "mixed"
+    # the Pallas streaming path remains reachable by explicit opt-in
+    assert fitters["fused_true"]._step_mode() == "fourier"
+
+
+def test_host_fourier_basis_matches_traced_fallback():
+    """The compile-time host-precomputed Fourier basis (the production
+    'auto' path reads it from bundle.masks) must equal the traced
+    device sin/cos fallback it replaces — pins the twin derivations of
+    t/tspan/f in models/noise.py."""
+    from pint_tpu.models.noise import fourier_basis
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR B\nF0 245.42 1\nPEPOCH 55000\nEFAC -f L-wide 1.1\n"
+        "TNREDAMP -13.2\nTNREDGAM 3.5\nTNREDC 7\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=64, seed=3)
+    cm = m.compile(toas)
+    key = "pl_red_noise:F"
+    assert key in cm.bundle.masks
+    F_mask, f_mask, ts_mask = fourier_basis(cm.bundle, 7, key)
+    stripped = cm.bundle._replace(
+        masks={k: v for k, v in cm.bundle.masks.items() if k != key}
+    )
+    F_traced, f_traced, ts_traced = fourier_basis(stripped, 7, key)
+    np.testing.assert_allclose(
+        np.asarray(F_mask), np.asarray(F_traced), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(f_mask), np.asarray(f_traced), rtol=1e-14
+    )
+    assert float(ts_mask) == pytest.approx(float(ts_traced), rel=1e-14)
