@@ -40,7 +40,7 @@ def _wls_step(r, M, w, threshold=None, method=None,
     gls.py::_finish_normal_eqs), NOT the square of the SVD cut (which
     sits far below that floor and would never fire): it zeroes
     directions with s/s0 below sqrt(eps*max(n,p)) — ~4e-7 at n=600,
-    ~1.5e-5 at n=1e5 — exactly those whose Gram content is roundoff.
+    ~4.7e-6 at n=1e5 — exactly those whose Gram content is roundoff.
     """
     from pint_tpu.fitting.gls import _column_norms, _eigh_threshold_solve
 

@@ -282,8 +282,12 @@ def powerlaw_phi(f, tspan, log10_amp, gamma):
 
 
 class _FourierBasisNoise(NoiseComponent):
-    """Base for PL Fourier-basis noise: precomputes the sin/cos basis
-    host-side at compile (see fourier_basis)."""
+    """Base for PL Fourier-basis noise components (reference:
+    src/pint/models/noise_model.py — the pl_rn_basis_weight_pair /
+    create_fourier_design_matrix machinery shared by PLRedNoise /
+    PLDMNoise / PLChromNoise).  TPU-first deviation: the sin/cos basis
+    is precomputed host-side at compile time into bundle.masks (see
+    fourier_basis) instead of being rebuilt per fit iteration."""
 
     def _basis_key(self) -> str:
         return f"{self.category}:F"

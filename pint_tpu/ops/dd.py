@@ -73,6 +73,96 @@ def _two_prod(a, b):
     return p, err
 
 
+# -- custom JVPs: plain-f64 tangents through DD arithmetic ----------------
+# jacfwd of the phase kernel is the design matrix (the architecture's
+# single derivative mechanism).  Differentiating *through* the error-free
+# transforms would trace ~15 tangent ops (plus optimization barriers —
+# which also block fusion) per DD op, yet the mathematical tangent of
+# value = hi + lo is 1-3 plain f64 ops: DD precision exists to protect
+# the 1e-19-relative PRIMAL phase; derivatives feed design-matrix Grams
+# where f64 tangents are ~1e-16 accurate — far beyond need.  Each core
+# op below therefore computes its primal with the exact EFT sequence and
+# its tangent in plain f64, carried as (t, 0) DD-tangent pairs.
+# Tangent maps are linear, so reverse-mode (jax.grad) transposes them
+# automatically.  Validated against central finite differences in
+# tests/test_e2e_wls.py::test_design_matrix_matches_finite_difference.
+
+
+@jax.custom_jvp
+def _dd_add_core(ahi, alo, bhi, blo):
+    s, e = _two_sum(ahi, bhi)
+    e = e + (alo + blo)
+    return _quick_two_sum(s, e)
+
+
+@_dd_add_core.defjvp
+def _dd_add_core_jvp(primals, tangents):
+    out = _dd_add_core(*primals)
+    tahi, talo, tbhi, tblo = tangents
+    t = (tahi + talo) + (tbhi + tblo)
+    t = jnp.broadcast_to(t, jnp.shape(out[0]))
+    return out, (t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def _dd_mul_core(ahi, alo, bhi, blo):
+    p, e = _two_prod(ahi, bhi)
+    e = e + (ahi * blo + alo * bhi)
+    return _quick_two_sum(p, e)
+
+
+@_dd_mul_core.defjvp
+def _dd_mul_core_jvp(primals, tangents):
+    ahi, alo, bhi, blo = primals
+    out = _dd_mul_core(*primals)
+    tahi, talo, tbhi, tblo = tangents
+    t = (ahi + alo) * (tbhi + tblo) + (bhi + blo) * (tahi + talo)
+    t = jnp.broadcast_to(t, jnp.shape(out[0]))
+    return out, (t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def _dd_norm_core(hi, lo):
+    return _quick_two_sum(hi, lo)
+
+
+@_dd_norm_core.defjvp
+def _dd_norm_core_jvp(primals, tangents):
+    out = _dd_norm_core(*primals)
+    thi, tlo = tangents
+    t = thi + tlo
+    return out, (t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def _dd_from_sum_core(a, b):
+    return _two_sum(a, b)
+
+
+@_dd_from_sum_core.defjvp
+def _dd_from_sum_core_jvp(primals, tangents):
+    out = _dd_from_sum_core(*primals)
+    ta, tb = tangents
+    t = ta + tb
+    t = jnp.broadcast_to(t, jnp.shape(out[0]))
+    return out, (t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def _dd_from_prod_core(a, b):
+    return _two_prod(a, b)
+
+
+@_dd_from_prod_core.defjvp
+def _dd_from_prod_core_jvp(primals, tangents):
+    a, b = primals
+    out = _dd_from_prod_core(*primals)
+    ta, tb = tangents
+    t = a * tb + b * ta
+    t = jnp.broadcast_to(t, jnp.shape(out[0]))
+    return out, (t, jnp.zeros_like(t))
+
+
 class DD(NamedTuple):
     """A double-double number (or array): value = hi + lo.
 
@@ -95,14 +185,14 @@ class DD(NamedTuple):
         """DD representing a + b exactly (a, b floats)."""
         a = jnp.asarray(a, dtype=jnp.float64)
         b = jnp.asarray(b, dtype=jnp.float64)
-        return DD(*_two_sum(a, b))
+        return DD(*_dd_from_sum_core(a, b))
 
     @staticmethod
     def from_prod(a: Arrayish, b: Arrayish) -> "DD":
         """DD representing a * b exactly (a, b floats)."""
         a = jnp.asarray(a, dtype=jnp.float64)
         b = jnp.asarray(b, dtype=jnp.float64)
-        return DD(*_two_prod(a, b))
+        return DD(*_dd_from_prod_core(a, b))
 
     @staticmethod
     def from_string(s: str) -> "DD":
@@ -124,15 +214,13 @@ class DD(NamedTuple):
 
     # -- norm ------------------------------------------------------------
     def normalize(self) -> "DD":
-        return DD(*_quick_two_sum(self.hi, self.lo))
+        return DD(*_dd_norm_core(self.hi, self.lo))
 
     # -- arithmetic ------------------------------------------------------
     def __add__(self, other) -> "DD":
         if not isinstance(other, DD):
             other = DD.from_float(other)
-        s, e = _two_sum(self.hi, other.hi)
-        e = e + (self.lo + other.lo)
-        return DD(*_quick_two_sum(s, e))
+        return DD(*_dd_add_core(self.hi, self.lo, other.hi, other.lo))
 
     __radd__ = __add__
 
@@ -150,9 +238,7 @@ class DD(NamedTuple):
     def __mul__(self, other) -> "DD":
         if not isinstance(other, DD):
             other = DD.from_float(other)
-        p, e = _two_prod(self.hi, other.hi)
-        e = e + (self.hi * other.lo + self.lo * other.hi)
-        return DD(*_quick_two_sum(p, e))
+        return DD(*_dd_mul_core(self.hi, self.lo, other.hi, other.lo))
 
     __rmul__ = __mul__
 

@@ -29,14 +29,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pint_tpu.fitting.gls import _chol_solve, _finish_normal_eqs
 
 
-def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
+def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
+                     normalized_cov=False):
     """One Woodbury GLS solve with the TOA axis sharded over `axis`.
 
     r (n,), M (n, p), Ndiag (n,), T (n, k) must have n divisible by the
     mesh axis size (pad with ~infinite-error TOAs via parallel.mesh /
     parallel.pta helpers).  phi (k,) is replicated.
     Returns (dx (p,), cov (p, p), chi2, n_degenerate) — identical to
-    gls_step_woodbury.
+    gls_step_woodbury.  On backends whose emulated f64 keeps only the
+    f32 exponent range (axon TPU), pass normalized_cov=True and
+    unnormalize cov = covn/outer(norm, norm) on the HOST — stiff-column
+    variances underflow on device (fitting/gls.py::_finish_normal_eqs).
     """
     from jax import shard_map
 
@@ -79,7 +83,7 @@ def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
     A = MNM - TNM.T @ corrM
     b = -(MNr - TNM.T @ corrR)
     r_cinv_r = rNr - jnp.dot(TNr, corrR)
-    return _finish_normal_eqs(A, b, r_cinv_r, norm)
+    return _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov)
 
 
 def place_gls_operands(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):

@@ -65,3 +65,22 @@ def test_sharded_collective_bytes_independent_of_n(operands):
     for line in hlo.splitlines():
         if "all-reduce" in line and "f64[" in line:
             assert f"f64[{n}" not in line, line
+
+
+def test_sharded_normalized_cov_matches(operands):
+    """normalized_cov=True must return (covn, norm) whose host
+    unnormalization equals the device covariance (the accelerator
+    convention — device unnorm underflows stiff columns there)."""
+    r, M, Nd, T, phi = operands
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_gls_operands(mesh, r, M, Nd, T, phi)
+    dx, cov, chi2, _ = jax.jit(
+        lambda *a: sharded_gls_step(mesh, *a)
+    )(*args)
+    dxn, (covn, norm), chi2n, _ = jax.jit(
+        lambda *a: sharded_gls_step(mesh, *a, normalized_cov=True)
+    )(*args)
+    np.testing.assert_allclose(np.asarray(dxn), np.asarray(dx), rtol=1e-12)
+    host_cov = np.asarray(covn) / np.outer(np.asarray(norm), np.asarray(norm))
+    np.testing.assert_allclose(host_cov, np.asarray(cov), rtol=1e-10)
+    assert float(chi2n) == pytest.approx(float(chi2), rel=1e-12)
