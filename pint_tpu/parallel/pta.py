@@ -265,17 +265,29 @@ class PTABatch:
                     jnp.ones((self.npulsars, p)),
                 ),
             )
-            (xs, chi2, cov), _ = jax.lax.scan(
+            (xs, chi2, _stale_cov), _ = jax.lax.scan(
                 body, init, None, length=maxiter
             )
+            # the scan's covariance was evaluated at the PRE-step state
+            # of the last iteration; re-evaluate at the returned xs so
+            # committed uncertainties are not one step stale (the same
+            # convention as fitting/downhill.py's final proposal)
+            _xs_next, _chi2_next, cov = self.fit_step(xs, mode=mode)
             return xs, chi2, cov
 
         return run
 
-    def commit(self, xs):
-        """Fold fitted deltas back into each pulsar's host model."""
-        for cm, x in zip(self.cms, np.asarray(xs)):
-            cm.commit(x)
+    def commit(self, xs, covs=None):
+        """Fold fitted deltas back into each pulsar's host model, with
+        per-parameter uncertainties from covs (P, p, p) — defaults to
+        the last fit()'s covariance."""
+        if covs is None:
+            covs = getattr(self, "cov", None)
+        for i, (cm, x) in enumerate(zip(self.cms, np.asarray(xs))):
+            unc = None
+            if covs is not None:
+                unc = np.sqrt(np.diag(np.asarray(covs)[i]))
+            cm.commit(x, uncertainties=unc)
 
     def shard(self, mesh):
         """Place the batch across the mesh: pulsar axis on 'pulsar',

@@ -67,10 +67,11 @@ def main():
         return dx
 
     def solve_only(x):
-        # r/M/Nd as runtime-ish constants: isolates the solver; the
-        # dependence on x[0] stops XLA folding the whole thing
+        # r AND M made runtime-dependent: with M0 constant XLA could
+        # fold the M-side Grams (tiny outputs of constant inputs) out
+        # of the timed program and under-report the solver
         dx, cov, chi2, _ = gls_step_woodbury_mixed(
-            R * (1.0 + 0.0 * x[0]), M0, Nd0, T0, PHI
+            R * (1.0 + 0.0 * x[0]), M0 * (1.0 + 0.0 * x[0]), Nd0, T0, PHI
         )
         return dx
 

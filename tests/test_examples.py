@@ -1,0 +1,18 @@
+"""The examples/ scripts double as integration tests (the reference
+executes its docs/examples in CI the same way; SURVEY.md §4)."""
+
+import pathlib
+import runpy
+
+import pytest
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).parent.parent / "examples").glob("*.py")
+)
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(path):
+    ns = runpy.run_path(str(path))
+    # each example exposes main() with its own internal assertions
+    ns["main"]()
