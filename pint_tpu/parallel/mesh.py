@@ -21,6 +21,36 @@ from pint_tpu.ops.dd import DD
 from pint_tpu.toas.bundle import TOABundle
 
 
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join a multi-host run (the framework's distributed communication
+    backend is XLA collectives over ICI within a slice and DCN across
+    hosts — docs/parallelism.md; the reference has no distributed
+    backend at all, SURVEY.md §5).
+
+    Call once per process before any jax computation; with no arguments
+    on Cloud TPU the coordinator is auto-discovered from the TPU
+    environment.  After this, jax.devices() is the GLOBAL device list,
+    so make_mesh() spans all hosts and the same fit/PTA programs run
+    unchanged — the Gram psums are the only cross-host traffic
+    (k-sized blocks, a few hundred KB per step).  Returns the process
+    index.  No-op when already initialized or single-process.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # already initialized (idempotent use from scripts)
+        pass
+    return jax.process_index()
+
+
 def make_mesh(
     n_toa_shards: Optional[int] = None,
     n_pulsar_shards: int = 1,

@@ -43,8 +43,7 @@ def main():
     from bench import _build
     from pint_tpu.fitting.base import design_with_offset
     from pint_tpu.fitting.gls import _column_norms
-    from pint_tpu.ops.ffgram import chol_solve_ir, gram32
-    from pint_tpu.ops.pallas_kernels import fourier_gram
+    from pint_tpu.ops.ffgram import chol_solve_ir, gram32, gram32_joint
 
     ntoa = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     _, _, cm = _build(ntoa)
@@ -53,36 +52,39 @@ def main():
     R = np.asarray(cm.time_residuals(x0, subtract_mean=False))
     M0 = np.asarray(design_with_offset(cm, x0))
     Nd0 = np.square(np.asarray(cm.scaled_sigma(x0)))
-    TS, FR, PHI = (np.asarray(a) for a in cm.noise_fourier_spec(x0))
+    T0, PHI = (np.asarray(a) for a in cm.noise_basis_or_empty(x0))
     Ninv = 1.0 / Nd0
     norm = np.asarray(_column_norms(jnp.asarray(M0)))
     Mn = M0 / norm[None, :]
     X = np.concatenate([Mn, R[:, None]], axis=1)
     p = Mn.shape[1]
-    k = 2 * len(FR)
+    k = T0.shape[1]
     Sigma0 = np.diag(np.exp(np.random.default_rng(0).normal(0, 2, k))) \
         + 1e-3 * np.eye(k)
     B0 = np.random.default_rng(1).normal(size=(k, p + 1))
+    TWX = np.random.default_rng(2).normal(size=(k, p + 1))
 
     parts = {
-        "b_white f64 matvec":
-            lambda x: Mn.T @ (Ninv * (R + 0.0 * x[0])),
-        "r_Nr f64 dot":
-            lambda x: jnp.dot(R + 0.0 * x[0], Ninv * R),
+        "gram32_joint (T,X)":
+            lambda x: gram32_joint(
+                jnp.asarray(T0, jnp.float32),
+                jnp.asarray(X) * (1.0 + 0.0 * x[0]), Ninv,
+            )[2],
         "gram32 (A_white)":
-            lambda x: gram32(jnp.asarray(Mn) + 0.0 * x[0], Ninv),
-        "fourier_gram (Pallas)":
-            lambda x: fourier_gram(
-                jnp.asarray(TS) + 0.0 * x[0], FR, Ninv, X
-            )[1],
+            lambda x: gram32(jnp.asarray(Mn) * (1.0 + 0.0 * x[0]), Ninv),
         "chol_solve_ir (k x k)":
             lambda x: chol_solve_ir(
-                jnp.asarray(Sigma0) + 0.0 * x[0], B0
+                jnp.asarray(Sigma0) * (1.0 + 0.0 * x[0]), B0
             ),
         "eigh (p x p)":
             lambda x: jnp.linalg.eigh(
                 (Mn.T @ Mn) * (1.0 + 0.0 * x[0])
             )[1],
+        "tail matmuls (k,p)":
+            lambda x: (jnp.asarray(TWX[:, :-1]).T
+                       @ (jnp.asarray(B0) * (1.0 + 0.0 * x[0]))),
+        "column_norms(M)":
+            lambda x: _column_norms(jnp.asarray(M0) * (1.0 + 0.0 * x[0])),
         "empty(baseline)":
             lambda x: x * 1.0000000001,
     }
