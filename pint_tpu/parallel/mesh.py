@@ -32,22 +32,48 @@ def initialize_distributed(
     backend at all, SURVEY.md §5).
 
     Call once per process before any jax computation; with no arguments
-    on Cloud TPU the coordinator is auto-discovered from the TPU
+    on Cloud TPU the coordinator is auto-discovered from the cluster
     environment.  After this, jax.devices() is the GLOBAL device list,
     so make_mesh() spans all hosts and the same fit/PTA programs run
     unchanged — the Gram psums are the only cross-host traffic
     (k-sized blocks, a few hundred KB per step).  Returns the process
-    index.  No-op when already initialized or single-process.
+    index.  Explicit no-op when already initialized, or when neither an
+    address nor a detectable cluster environment exists (single-process
+    dev boxes); anything else propagates — a silently-degraded
+    "multi-host" job that actually runs single-host must not happen.
     """
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return jax.process_index()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+    except ValueError as e:
+        # jax's own cluster auto-detection found nothing and no address
+        # was given: a single-process environment — no-op.
+        if coordinator_address is None and "coordinator_address" in str(e):
+            return 0
+        raise
     except RuntimeError:
-        # already initialized (idempotent use from scripts)
-        pass
+        # "must be called before any JAX calls": too late to join.
+        # With an EXPLICIT coordinator this must fail loudly (a
+        # silently single-host "multi-host" job is the worst outcome);
+        # without one, the caller was only opportunistically probing —
+        # warn and stay single-process.
+        if coordinator_address is not None:
+            raise
+        import warnings
+
+        warnings.warn(
+            "initialize_distributed() called after the JAX backend "
+            "initialized; staying single-process (call it first to "
+            "join a cluster)",
+            RuntimeWarning,
+        )
+        return jax.process_index()
     return jax.process_index()
 
 

@@ -147,6 +147,31 @@ def config_5():
     )
 
 
+def config_6():
+    """Photon-phase assignment (the photonphase/event_optimize inner
+    loop): absolute model phase for 1e6 barycentric photon events."""
+    import jax.numpy as jnp
+
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    n = 1_000_000
+    par = "PSR C6\nF0 29.946923\nF1 -3.77e-10\nPEPOCH 55500\n"
+    m = get_model(par)
+    # make_fake_toas_uniform ingests internally (obs='@' barycentric)
+    toas = make_fake_toas_uniform(55000, 55060, n, m, error_us=0.0,
+                                  freq_mhz=1400.0)
+    cm = m.compile(toas, subtract_mean=False)
+
+    def step(x):
+        frac = cm.phase(x).frac
+        # scalar feedback keeps scan steps dependent without an
+        # emulated-f64 full reduction
+        return x + 0.0 * frac[0], jnp.sum(frac.astype(jnp.float32))
+
+    return "config6 photon phase 1e6 events", n, step, cm.x0()
+
+
 def main():
     import jax
 
@@ -154,10 +179,10 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="+",
-                    default=[1, 2, 3, 4, 5])
+                    default=[1, 2, 3, 4, 5, 6])
     args = ap.parse_args()
     builders = {1: config_1, 2: config_2, 3: config_3, 4: config_4,
-                5: config_5}
+                5: config_5, 6: config_6}
     for c in args.configs:
         label, ntoa, step, x0 = builders[c]()
         t_dev = _timeit(step, x0)

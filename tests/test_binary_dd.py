@@ -386,3 +386,38 @@ def test_ddk_model_builds_with_astrometry():
     )
     m = get_model(par)
     assert "BinaryDDK" in m.components
+
+
+def test_ddgr_matches_dd_with_gr_pk_params():
+    """DDGR (masses-only) must equal DD given the explicitly computed
+    GR post-Keplerian parameters for the same system (B1913+16-like)."""
+    from pint_tpu.constants import TSUN
+    from pint_tpu.models.binaries.dd import gr_pk_params
+
+    pb_days, a1, ecc, om_deg = 0.322997448918, 2.341782, 0.6171338, 292.54
+    mtot, m2 = 2.828378, 1.389
+    pb_s = pb_days * 86400.0
+    gr = gr_pk_params(pb_s, ecc, a1, TSUN * mtot, TSUN * m2)
+    n_orb = TWOPI / pb_s
+    omdot_degyr = float(gr["k"]) * n_orb * (180.0 / np.pi) * (
+        365.25 * 86400.0
+    )
+    ev_gr = make_component_eval(
+        "BinaryDDGR", PB=pb_days, A1=a1, ECC=ecc, OM=om_deg,
+        T0=55000.0, MTOT=mtot, M2=m2,
+    )
+    ev_dd = make_component_eval(
+        "BinaryDD", PB=pb_days, A1=a1, ECC=ecc, OM=om_deg,
+        T0=55000.0, M2=m2,
+        OMDOT=omdot_degyr, GAMMA=float(gr["gamma"]),
+        PBDOT=float(gr["pbdot"]), SINI=float(gr["sini"]),
+        DR=float(gr["dr"]), DTH=float(gr["dth"]),
+    )
+    t = np.linspace(0.0, 60 * pb_s, 600)
+    d_gr, d_dd = ev_gr(t), ev_dd(t)
+    # same formulas, same PK values -> agreement at roundoff level
+    assert np.max(np.abs(d_gr - d_dd)) < 1e-10
+    # sanity: the GR values are the known B1913+16 ones
+    assert omdot_degyr == pytest.approx(4.22, abs=0.03)
+    assert float(gr["gamma"]) == pytest.approx(4.29e-3, rel=0.03)
+    assert float(gr["pbdot"]) == pytest.approx(-2.40e-12, rel=0.03)

@@ -221,3 +221,51 @@ def test_ell1_fit_recovery():
     )
     assert abs(dt_days) * 86400 < 1e-3
     assert chi2 < len(toas)
+
+
+def test_ell1k_reduces_to_ell1_without_rates():
+    """OMDOT = LNEDOT = 0: ELL1k must equal plain ELL1 exactly."""
+    from tests.test_binary_dd import make_component_eval
+
+    pb, a1 = 2.1e5, 4.3
+    eps1, eps2 = 2.5e-5, -1.2e-5
+    common = dict(PB=pb / 86400.0, A1=a1, TASC=55000.0,
+                  EPS1=eps1, EPS2=eps2)
+    ev_k = make_component_eval("BinaryELL1k", OMDOT=0.0, LNEDOT=0.0,
+                               **common)
+    ev_0 = make_component_eval("BinaryELL1", **common)
+    t = np.linspace(0.0, 20 * pb, 400)
+    np.testing.assert_allclose(ev_k(t), ev_0(t), rtol=0, atol=1e-14)
+
+
+def test_ell1k_omdot_lnedot_evolution():
+    """ELL1k with OMDOT/LNEDOT must equal ELL1 evaluated with the
+    rotated/scaled Laplace-Lagrange parameters at each epoch:
+    e(t) = e0 (1 + LNEDOT t), omega(t) = omega0 + OMDOT t
+    (Susobhanan et al. 2018; reference models/binary_ell1.py::
+    BinaryELL1k)."""
+    from tests.test_binary_dd import make_component_eval
+
+    pb, a1 = 2.1e5, 4.3
+    eps1, eps2 = 2.5e-5, -1.2e-5
+    omdot_degyr = 30.0          # exaggerated for leverage
+    lnedot = 3e-10              # 1/s
+    ev_k = make_component_eval(
+        "BinaryELL1k", PB=pb / 86400.0, A1=a1, TASC=55000.0,
+        EPS1=eps1, EPS2=eps2, OMDOT=omdot_degyr, LNEDOT=lnedot,
+    )
+    omdot = omdot_degyr * np.pi / 180.0 / (365.25 * 86400.0)
+    om0 = np.arctan2(eps1, eps2)
+    e0 = np.hypot(eps1, eps2)
+    for t in (0.0, 3.7e6, 2.3e7, 8.9e7):
+        dt = t  # TASC at t=0 of the evaluator's time axis
+        e_t = e0 * (1.0 + lnedot * dt)
+        om_t = om0 + omdot * dt
+        ev_ref = make_component_eval(
+            "BinaryELL1", PB=pb / 86400.0, A1=a1, TASC=55000.0,
+            EPS1=float(e_t * np.sin(om_t)), EPS2=float(e_t * np.cos(om_t)),
+        )
+        ta = np.asarray([t])
+        np.testing.assert_allclose(
+            ev_k(ta), ev_ref(ta), rtol=0, atol=1e-12,
+        )
