@@ -172,6 +172,50 @@ class Fitter:
         self.chi2 = float(chi2)
         return float(chi2)
 
+    def get_derived_params(self) -> str:
+        """Derived quantities from the (fitted) model — spin period,
+        characteristic age, surface B field, spin-down luminosity, and
+        binary mass function when applicable (reference:
+        src/pint/fitter.py::Fitter.get_derived_params)."""
+        from pint_tpu import derived_quantities as dq
+
+        m = self.model
+        lines = []
+
+        def _val(name):
+            p = m.params.get(name)
+            if p is None or p.value is None:
+                return None
+            v = p.value
+            return float(v.to_float()) if hasattr(v, "to_float") else float(v)
+
+        f0, f1 = _val("F0"), _val("F1")
+        if f0:
+            p0, p1 = dq.p_to_f(f0, f1 or 0.0)  # involution: f->p too
+            lines.append(f"P0 = {p0:.15g} s")
+            if f1:
+                lines.append(f"P1 = {p1:.6g}")
+                lines.append(
+                    f"tau_c = {dq.pulsar_age(f0, f1):.4g} yr"
+                )
+                lines.append(f"B_surf = {dq.pulsar_B(f0, f1):.4g} G")
+                lines.append(
+                    f"Edot = {dq.pulsar_edot(f0, f1):.4g} erg/s"
+                )
+        pb, a1 = _val("PB"), _val("A1")
+        if pb is None and _val("FB0"):
+            pb = 1.0 / _val("FB0") / 86400.0
+        if pb and a1:
+            mf = dq.mass_funct(pb * 86400.0, a1)
+            lines.append(f"mass function = {mf:.6g} Msun")
+            lines.append(
+                "companion mass (i=60deg, mp=1.4) = "
+                f"{dq.companion_mass(pb * 86400.0, a1):.4g} Msun"
+            )
+        out = "\n".join(lines)
+        print(out)
+        return out
+
     def print_summary(self) -> str:
         chi2 = self.chi2 if self.chi2 is not None else self.resids.chi2
         lines = [

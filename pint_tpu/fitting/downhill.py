@@ -27,8 +27,10 @@ from pint_tpu.exceptions import (
 )
 from pint_tpu.fitting.base import Fitter
 from pint_tpu.fitting.gls import (
+    default_accel_mode,
     gls_step_full_cov,
     gls_step_woodbury,
+    gls_step_woodbury_mixed,
     make_cinv_mult,
 )
 from pint_tpu.fitting.wls import _wls_step
@@ -179,13 +181,21 @@ class DownhillGLSFitter(DownhillFitter):
 
     def _make_proposal(self):
         cm, noffset, full_cov = self.cm, self._noffset, self.full_cov
+        # proposal DIRECTION quality is all that matters here (the
+        # vmapped chi2 ladder still gates acceptance), so the
+        # accelerator mixed path applies (GLSFitter's policy)
+        if full_cov:
+            step = gls_step_full_cov
+        elif default_accel_mode(cm) == "mixed":
+            step = gls_step_woodbury_mixed
+        else:
+            step = gls_step_woodbury
 
         @jax.jit
         def proposal(x):
             r = cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
             Ndiag, T, phi = self._noise(x)
-            step = gls_step_full_cov if full_cov else gls_step_woodbury
             dx, cov, _, nbad = step(r, M, Ndiag, T, phi,
                                     normalized_cov=True)
             return dx[noffset:], cov, nbad

@@ -32,8 +32,10 @@ from pint_tpu.exceptions import PintTpuError
 from pint_tpu.fitting.downhill import DownhillFitter
 from pint_tpu.fitting.gls import (
     GLSFitter,
+    default_accel_mode,
     gls_step_full_cov,
     gls_step_woodbury,
+    gls_step_woodbury_mixed,
     make_cinv_mult,
 )
 from pint_tpu.models.timing_model import TimingModel
@@ -176,13 +178,20 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
 
     def _make_proposal(self):
         noffset, full_cov = self._noffset, self.full_cov
+        # accelerator mixed proposals, as in DownhillGLSFitter (the
+        # chi2 ladder still gates acceptance)
+        if full_cov:
+            fn = gls_step_full_cov
+        elif default_accel_mode(self.cm) == "mixed":
+            fn = gls_step_woodbury_mixed
+        else:
+            fn = gls_step_woodbury
 
         @jax.jit
         def proposal(x):
             r = self._combined_residuals(x)
             M = self._combined_design(x)
             Ndiag, T, phi = self._combined_noise(x)
-            fn = gls_step_full_cov if full_cov else gls_step_woodbury
             dx, cov, _, nbad = fn(r, M, Ndiag, T, phi,
                                   normalized_cov=True)
             return dx[noffset:], cov, nbad

@@ -332,3 +332,22 @@ def test_template_fit_recovers_profile():
     assert locs[1] == pytest.approx(0.7, abs=0.02)
     w = np.sort(fit_t.weights)
     assert w[1] == pytest.approx(0.35, abs=0.05)
+
+
+def test_fitter_get_derived_params():
+    """Fitter.get_derived_params (reference: fitter.py) prints spin +
+    binary derived quantities from the fitted model."""
+    from pint_tpu.fitting import auto_fitter
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR JD\nF0 100.0 1\nF1 -1e-15 1\nPEPOCH 55000\nDM 10.0\n"
+        "BINARY ELL1\nPB 1.2\nA1 3.4\nTASC 55000.1\n"
+        "EPS1 1e-5\nEPS2 2e-5\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=60, seed=2)
+    f = auto_fitter(toas, m, downhill=False)
+    out = f.get_derived_params()
+    assert "P0 = 0.01" in out
+    assert "tau_c" in out and "B_surf" in out
+    assert "mass function" in out
