@@ -212,9 +212,10 @@ class Fitter:
                 "companion mass (i=60deg, mp=1.4) = "
                 f"{dq.companion_mass(pb * 86400.0, a1):.4g} Msun"
             )
-        out = "\n".join(lines)
-        print(out)
-        return out
+        # returns the string and leaves printing to the caller, like
+        # the reference Fitter.get_derived_params; print_summary
+        # appends it to its (printed) report
+        return "\n".join(lines)
 
     def print_summary(self) -> str:
         chi2 = self.chi2 if self.chi2 is not None else self.resids.chi2
@@ -240,6 +241,10 @@ class Fitter:
             p = self.model.params[n]
             unc = p.uncertainty if p.uncertainty is not None else float("nan")
             lines.append(f"{n:<12}{p._format_value():>25}{unc:>15.3e}")
+        derived = self.get_derived_params()
+        if derived:
+            lines.append("Derived Parameters:")
+            lines.append(derived)
         out = "\n".join(lines)
         print(out)
         return out

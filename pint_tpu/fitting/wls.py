@@ -19,6 +19,15 @@ from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
 
+def default_wls_method() -> str:
+    """The backend-dependent WLS solve policy: the reference's
+    column-scaled 'svd' lstsq on CPU, the thresholded-eigh 'gram'
+    normal equations on accelerators (axon's emulated-f64 SVD NaNs).
+    Single source of truth for _wls_step and every fitter that names
+    the method in a DegeneracyWarning."""
+    return "svd" if jax.default_backend() == "cpu" else "gram"
+
+
 def _wls_step(r, M, w, threshold=None, method=None,
               normalized_cov=False):
     """One WLS least-squares solve with degenerate-direction zeroing.
@@ -45,7 +54,7 @@ def _wls_step(r, M, w, threshold=None, method=None,
     from pint_tpu.fitting.gls import _column_norms, _eigh_threshold_solve
 
     if method is None:
-        method = "svd" if jax.default_backend() == "cpu" else "gram"
+        method = default_wls_method()
     sw = jnp.sqrt(w)
     b = -r * sw
     # _column_norms is the overflow-safe (|max|-prescaled) column norm:
@@ -87,12 +96,18 @@ class WLSFitter(Fitter):
         src/pint/fitter.py::WLSFitter.fit_toas)."""
         no = self._noffset
         p = len(self.cm.free_names) + no
+        # resolve the solve method here so DegeneracyWarning can name it
+        # (the 'gram' eigenvalue cut zeroes directions ~1e-6 that 'svd'
+        # keeps — backend-dependent min-norm answers, docs/precision.md)
+        self._wls_method = default_wls_method()
 
         def live_step(x):
             r = self._r(x)
             M = self._design_with_offset(x)
             w = 1.0 / jnp.square(self.cm.scaled_sigma(x))
-            dx, cov, nbad = _wls_step(r, M, w, normalized_cov=True)
+            dx, cov, nbad = _wls_step(
+                r, M, w, method=self._wls_method, normalized_cov=True
+            )
             x_new = x + dx[no:]  # dx[0] is the offset column
             return x_new, cov, self.cm.chi2(x_new), nbad.astype(jnp.int32)
 
@@ -113,6 +128,8 @@ class WLSFitter(Fitter):
         # parameter_covariance_matrix without Offset)
         return self._finish_scan_fit(
             self._fit_loops[key](self.cm.x0()),
-            "degenerate design-matrix directions zeroed in WLS solve",
+            "degenerate design-matrix directions zeroed in WLS solve "
+            f"(method={self._wls_method}; threshold is backend-dependent"
+            " — see docs/precision.md)",
             "non-finite chi2 during WLS fit",
         )

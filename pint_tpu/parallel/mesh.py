@@ -52,11 +52,27 @@ def initialize_distributed(
             process_id=process_id,
         )
     except ValueError as e:
-        # jax's own cluster auto-detection found nothing and no address
-        # was given: a single-process environment — no-op.
-        if coordinator_address is None and "coordinator_address" in str(e):
-            return 0
-        raise
+        # With an explicit coordinator (or explicit process topology)
+        # a ValueError is a real configuration error and must
+        # propagate.  Otherwise jax's cluster auto-detection found no
+        # usable environment: stay single-process, but say so — a
+        # misconfigured auto-detected cluster (e.g. inconsistent SLURM
+        # env) lands here too, and the sibling ranks would hang at the
+        # coordinator while this rank silently ran alone.
+        if (
+            coordinator_address is not None
+            or num_processes is not None
+            or process_id is not None
+        ):
+            raise
+        import warnings
+
+        warnings.warn(
+            "initialize_distributed(): no cluster environment joined "
+            f"({e}); staying single-process",
+            RuntimeWarning,
+        )
+        return 0
     except RuntimeError:
         # "must be called before any JAX calls": too late to join.
         # With an EXPLICIT coordinator this must fail loudly (a

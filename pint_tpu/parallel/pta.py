@@ -268,10 +268,12 @@ class PTABatch:
             (xs, chi2, _stale_cov), _ = jax.lax.scan(
                 body, init, None, length=maxiter
             )
-            # the scan's covariance was evaluated at the PRE-step state
-            # of the last iteration; re-evaluate at the returned xs so
-            # committed uncertainties are not one step stale (the same
-            # convention as fitting/downhill.py's final proposal)
+            # fit_step's chi2 is the linearized POST-step value of its
+            # proposal (gls.py::_finish_normal_eqs: r_cinv_r - dx.b),
+            # so the scan's last carry already belongs to the returned
+            # xs — keep it (the GLSFitter convention).  The covariance,
+            # however, was linearized at the PRE-step state; re-evaluate
+            # at xs so committed uncertainties are not one step stale.
             _xs_next, _chi2_next, cov = self.fit_step(xs, mode=mode)
             return xs, chi2, cov
 
