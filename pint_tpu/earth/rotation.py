@@ -7,12 +7,16 @@ equinox-based chain is implemented directly:
 
   r_GCRS = B^T P^T(t) N^T(t) R3(-GAST) W^T(t) r_ITRF
 
-with the nutation series truncated to the 18 largest IAU1980 terms.
-Truncation error is < 0.004" of orientation = < 12 cm of observatory
-position = < 0.4 ns of timing — below the clock/EOP noise floor for any
-offline dataset.  (The reference's full series is exact to < 1 mas; when
-line-level parity matters, extend _NUT_TERMS — the structure is the
-complete table, only rows are omitted.)
+with the nutation series truncated to the 54 largest IAU1980 terms
+(every term with |dpsi| >= 0.4 mas or |deps| >= 0.2 mas).  The ~52
+omitted terms are each <= 0.3 mas with RSS < ~0.7 mas, so the series
+is ~1 mas-class vs full IAU1980 — the same class as IAU2000B vs
+IAU2000A (the reference's full machinery).  1 mas of orientation is
+~3 cm of observatory position ~ 0.1 ns of timing; IAU1980 itself
+differs from IAU2000A by a further ~3 mas (updated amplitudes +
+planetary nutation), which the frame-bias + EOP corrections absorb in
+practice.  GAST includes the two largest complementary terms of the
+equation of the equinoxes (IAU 2000 definition).
 
 All functions are vectorized numpy over the TOA axis and run host-side
 at ingest (SURVEY.md §3.1: load-time work); the products ship to device
@@ -91,7 +95,7 @@ def mean_obliquity(t_tt_cent):
     ) * ARCSEC
 
 
-# -- IAU1980 nutation, largest 18 terms ----------------------------------
+# -- IAU1980 nutation, largest 54 terms ----------------------------------
 # rows: (l, l', F, D, Om multipliers, psi_0.1mas, psi_t, eps_0.1mas, eps_t)
 _NUT_TERMS = np.array([
     [0, 0, 0, 0, 1, -171996.0, -174.2, 92025.0, 8.9],
@@ -112,6 +116,42 @@ _NUT_TERMS = np.array([
     [-1, 0, 2, 2, 2, -59.0, 0.0, 26.0, 0.0],
     [-1, 0, 0, 0, 1, -58.0, -0.1, 32.0, 0.0],
     [1, 0, 2, 0, 1, -51.0, 0.0, 27.0, 0.0],
+    [-2, 0, 2, 0, 1, 46.0, 0.0, -24.0, 0.0],
+    [0, 0, 2, 2, 2, -38.0, 0.0, 16.0, 0.0],
+    [2, 0, 2, 0, 2, -31.0, 0.0, 13.0, 0.0],
+    [2, 0, 0, 0, 0, 29.0, 0.0, -1.0, 0.0],
+    [1, 0, 2, -2, 2, 29.0, 0.0, -12.0, 0.0],
+    [0, 0, 2, 0, 0, 26.0, 0.0, -1.0, 0.0],
+    [0, 0, 2, -2, 0, -22.0, 0.0, 0.0, 0.0],
+    [-1, 0, 2, 0, 1, 21.0, 0.0, -10.0, 0.0],
+    [0, 2, 0, 0, 0, 17.0, -0.1, 0.0, 0.0],
+    [0, 2, 2, -2, 2, -16.0, 0.1, 7.0, 0.0],
+    [-1, 0, 0, 2, 1, 16.0, 0.0, -8.0, 0.0],
+    [0, 1, 0, 0, 1, -15.0, 0.0, 9.0, 0.0],
+    [1, 0, 0, -2, 1, -13.0, 0.0, 7.0, 0.0],
+    [0, -1, 0, 0, 1, -12.0, 0.0, 6.0, 0.0],
+    [2, 0, -2, 0, 0, 11.0, 0.0, 0.0, 0.0],
+    [-1, 0, 2, 2, 1, -10.0, 0.0, 5.0, 0.0],
+    [1, 0, 2, 2, 2, -8.0, 0.0, 3.0, 0.0],
+    [0, -1, 2, 0, 2, -7.0, 0.0, 3.0, 0.0],
+    [0, 0, 2, 2, 1, -7.0, 0.0, 3.0, 0.0],
+    [1, 1, 0, -2, 0, -7.0, 0.0, 0.0, 0.0],
+    [0, 1, 2, 0, 2, 7.0, 0.0, -3.0, 0.0],
+    [-2, 0, 0, 2, 1, -6.0, 0.0, 3.0, 0.0],
+    [0, 0, 0, 2, 1, -6.0, 0.0, 3.0, 0.0],
+    [2, 0, 2, -2, 2, 6.0, 0.0, -3.0, 0.0],
+    [1, 0, 0, 2, 0, 6.0, 0.0, 0.0, 0.0],
+    [1, 0, 2, -2, 1, 6.0, 0.0, -3.0, 0.0],
+    [0, 0, 0, -2, 1, -5.0, 0.0, 3.0, 0.0],
+    [0, -1, 2, -2, 1, -5.0, 0.0, 3.0, 0.0],
+    [2, 0, 2, 0, 1, -5.0, 0.0, 3.0, 0.0],
+    [1, -1, 0, 0, 0, 5.0, 0.0, 0.0, 0.0],
+    [1, 0, 0, -1, 0, -4.0, 0.0, 0.0, 0.0],
+    [0, 0, 0, 1, 0, -4.0, 0.0, 0.0, 0.0],
+    [0, 1, 0, -2, 0, -4.0, 0.0, 0.0, 0.0],
+    [1, 0, -2, 0, 0, 4.0, 0.0, 0.0, 0.0],
+    [2, 0, 0, -2, 1, 4.0, 0.0, -2.0, 0.0],
+    [0, 1, 2, -2, 1, 4.0, 0.0, -2.0, 0.0],
 ])
 
 
@@ -187,10 +227,14 @@ def gmst82(mjd_ut1):
 
 
 def gast(mjd_ut1, t_tt_cent):
-    """Greenwich apparent sidereal time = GMST + dpsi cos(eps)."""
+    """Greenwich apparent sidereal time = GMST + equation of the
+    equinoxes (dpsi cos(eps) + the two largest complementary terms of
+    the IAU 2000 definition, ~0.9 mas total)."""
     eps0 = mean_obliquity(t_tt_cent)
     dpsi, deps = nutation_angles(t_tt_cent)
-    return gmst82(mjd_ut1) + dpsi * np.cos(eps0 + deps)
+    _, _, _, _, Om = fundamental_args(t_tt_cent)
+    ee_ct = (0.00264 * np.sin(Om) + 0.000063 * np.sin(2.0 * Om)) * ARCSEC
+    return gmst82(mjd_ut1) + dpsi * np.cos(eps0 + deps) + ee_ct
 
 
 # -- full chain -----------------------------------------------------------

@@ -1,18 +1,25 @@
 """Built-in analytic solar-system ephemeris (no kernel file needed).
 
-Keplerian mean elements + rates for the planets (Standish & Williams,
-"Approximate Positions of the Major Planets", valid 1800-2050), a
-truncated lunar theory for the EMB->Earth offset, and the Sun-SSB
-barycenter offset from the giant planets.
+The Earth family (earth/moon/emb) comes from the truncated VSOP87
+theory (ephemeris/vsop87.py, ~0.2 arcsec for the geocenter) plus a
+truncated lunar theory (Meeus ch.47 main terms, ~30 km for the Moon ->
+~0.4 km for the EMB offset), both rotated from the ecliptic of date to
+equatorial J2000 via the IAU1976 precession chain.  The planets use
+Keplerian mean elements + rates (Standish & Williams, "Approximate
+Positions of the Major Planets", valid 1800-2050, ~10-20 arcsec), and
+the Sun-SSB barycenter offset is the mass-ratio-weighted sum over the
+Kepler planets.
 
-ACCURACY (documented, by design): planetary positions are good to
-~10-20 arcsec (~1e4 km for the EMB) -> tens of milliseconds of Roemer
-delay.  That is ample for SIMULATION and for internal round-trip
-consistency (fits of simulated data use the same ephemeris and agree to
-sub-ns), and for Shapiro-delay geometry (angle errors only), but NOT for
-absolute timing parity with DExxx-based packages — supply a real .bsp
-kernel (pint_tpu.ephemeris.spk) for that; the reference has the same
-split via jplephem + astropy's 'builtin' ephemeris.
+ACCURACY (documented, by design): the geocenter is arcsecond-class
+(~150-700 km; dominated by VSOP87 truncation + the Kepler-grade Sun
+wobble), the planets ~10-20 arcsec.  That is ample for SIMULATION,
+internal round-trip consistency (fits of simulated data use the same
+ephemeris and agree to sub-ns), Shapiro-delay geometry (angle errors
+only), and for driving the TDB-TT defining integral to ~0.1 us
+(ephemeris/time_ephemeris.py) — but NOT for absolute timing parity
+with DExxx-based packages; supply a real .bsp kernel
+(pint_tpu.ephemeris.spk) for that; the reference has the same split
+via jplephem + astropy's 'builtin' ephemeris.
 """
 
 from __future__ import annotations
@@ -100,9 +107,10 @@ def _kepler_xyz(name, t_cent):
 
 
 def _moon_geocentric_km(t_cent):
-    """Geocentric Moon, ecliptic J2000 (km); truncated ELP (Meeus ch.47
-    main terms, ~0.01 deg / ~30 km — the EMB offset error this induces
-    is ~0.4 km)."""
+    """Geocentric Moon, ecliptic + mean equinox OF DATE (km); truncated
+    ELP (Meeus ch.47 main terms, ~0.01 deg / ~30 km — the EMB offset
+    error this induces is ~0.4 km).  Callers must rotate to J2000 via
+    vsop87._ecl_of_date_to_eq_j2000 (see _pos_eq_au)."""
     T = np.asarray(t_cent, dtype=np.float64)
     d2r = np.deg2rad
     Lp = d2r(218.3164477 + 481267.88123421 * T)
@@ -160,17 +168,38 @@ class BuiltinEphemeris:
     def _pos_au_ecl(self, body, t_cent):
         if body == "sun":
             return self._sun_ssb_au(t_cent)
-        sun = self._sun_ssb_au(t_cent)
-        if body == "emb":
-            return sun + _kepler_xyz("emb", t_cent)
-        if body in ("earth", "moon"):
-            emb = sun + _kepler_xyz("emb", t_cent)
-            moon_geo = _moon_geocentric_km(t_cent) / AU_KM
-            earth = emb - moon_geo / (1.0 + _EMRAT)
+        return self._sun_ssb_au(t_cent) + _kepler_xyz(body, t_cent)
+
+    def _pos_eq_au(self, body, t_cent):
+        """SSB-centric equatorial J2000 position (AU)."""
+        if body in ("earth", "moon", "emb"):
+            from pint_tpu.ephemeris import vsop87
+
+            sun = _ecl_to_eq(self._sun_ssb_au(t_cent))
+            earth = sun + vsop87.earth_heliocentric_j2000(
+                np.asarray(t_cent, dtype=np.float64) / 10.0
+            )
             if body == "earth":
                 return earth
-            return earth + moon_geo
-        return sun + _kepler_xyz(body, t_cent)
+            # Meeus lunar theory is ecliptic+equinox OF DATE
+            moon_geo = vsop87._ecl_of_date_to_eq_j2000(
+                _moon_geocentric_km(t_cent) / AU_KM, t_cent
+            )
+            if body == "moon":
+                return earth + moon_geo
+            return earth + moon_geo / (1.0 + _EMRAT)  # emb
+        return _ecl_to_eq(self._pos_au_ecl(body, t_cent))
+
+    def ssb_pos(self, body, et):
+        """Position-only ssb_posvel (km): skips the central-difference
+        velocity (3x fewer theory evaluations — the TDB integrand's
+        potential loop only needs positions)."""
+        if isinstance(body, (int, np.integer)):
+            body = self._IDS[int(body)]
+        et = np.asarray(et, dtype=np.float64)
+        return self._pos_eq_au(
+            body.lower(), et / (36525.0 * S_PER_DAY)
+        ) * AU_KM
 
     def ssb_posvel(self, body, et):
         """SSB-centric equatorial-J2000 position (km) and velocity
@@ -181,12 +210,11 @@ class BuiltinEphemeris:
         body = body.lower()
         et = np.asarray(et, dtype=np.float64)
         t_cent = et / (36525.0 * S_PER_DAY)
-        pos = _ecl_to_eq(self._pos_au_ecl(body, t_cent)) * AU_KM
+        pos = self._pos_eq_au(body, t_cent) * AU_KM
         h = 60.0
         tp = (et + h) / (36525.0 * S_PER_DAY)
         tm = (et - h) / (36525.0 * S_PER_DAY)
         vel = (
-            _ecl_to_eq(self._pos_au_ecl(body, tp))
-            - _ecl_to_eq(self._pos_au_ecl(body, tm))
+            self._pos_eq_au(body, tp) - self._pos_eq_au(body, tm)
         ) * AU_KM / (2.0 * h)
         return pos, vel

@@ -45,7 +45,7 @@ class Segment(NamedTuple):
 
     @property
     def ncomp(self):
-        return 3 if self.data_type == 2 else 6
+        return self.coeffs.shape[1]
 
 
 class SPK:
@@ -94,7 +94,12 @@ class SPK:
                 seg_words = words[ia - 1:ib]
                 init, intlen, rsize, n = seg_words[-4:]
                 rsize, n = int(rsize), int(n)
-                ncomp = 3 if dtype_ == 2 else 6
+                if target >= 1000000000:
+                    # DE-t time-ephemeris convention (TDB-TT seconds):
+                    # 1-component Chebyshev records
+                    ncomp = 1
+                else:
+                    ncomp = 3 if dtype_ == 2 else 6
                 ncoef = (rsize - 2) // ncomp
                 recs = seg_words[: rsize * n].reshape(n, rsize)
                 mid, radius = recs[:, 0].copy(), recs[:, 1].copy()
@@ -115,7 +120,8 @@ class SPK:
         if len(segs) == 1:
             return _eval_type23(segs[0], et)
         et1 = np.atleast_1d(et)
-        pos = np.empty((*et1.shape, 3))
+        nc = min(segs[0].ncomp, 3)
+        pos = np.empty((*et1.shape, nc))
         vel = np.empty_like(pos)
         done = np.zeros(et1.shape, dtype=bool)
         for seg in segs:
@@ -243,7 +249,8 @@ def write_spk_type2(
     """Write a little-endian type-2 SPK.
 
     Each segment dict: target, center, frame, init, intlen,
-    coeffs (n_rec, 3, ncoef).
+    coeffs (n_rec, ncomp, ncoef) — ncomp 3 for position segments, 1 for
+    DE-t style TDB-TT time segments (target >= 1000000000).
     """
     word_buf: list[float] = []
 
@@ -254,10 +261,15 @@ def write_spk_type2(
     for sd in segments:
         coeffs = np.asarray(sd["coeffs"], dtype=np.float64)
         n_rec, ncomp, ncoef = coeffs.shape
-        if ncomp != 3:
-            raise ValueError("type 2 segments have 3 components")
+        if ncomp not in (1, 3) or (
+            ncomp == 1 and sd["target"] < 1000000000
+        ):
+            raise ValueError(
+                "type 2 segments have 3 components (1 only for "
+                "time-ephemeris targets >= 1000000000)"
+            )
         init, intlen = float(sd["init"]), float(sd["intlen"])
-        rsize = 2 + 3 * ncoef
+        rsize = 2 + ncomp * ncoef
         ia = addr()
         for r in range(n_rec):
             mid = init + intlen * (r + 0.5)
@@ -314,10 +326,11 @@ def write_spk_type2(
             f.write(b"\x00" * (RECLEN - rem))
 
 
-def chebyshev_fit_records(fn, t0, t1, n_records, degree):
-    """Fit fn(t)->(...,3) over [t0, t1] as n_records Chebyshev pieces of
-    the given degree; returns coeffs (n_records, 3, degree+1) for
-    write_spk_type2.  Used to build kernels from analytic ephemerides."""
+def chebyshev_fit_records(fn, t0, t1, n_records, degree, ncomp=3):
+    """Fit fn(t)->(...,ncomp) over [t0, t1] as n_records Chebyshev
+    pieces of the given degree; returns coeffs (n_records, ncomp,
+    degree+1) for write_spk_type2.  Used to build kernels from analytic
+    ephemerides (and 1-component TDB-TT time ephemerides)."""
     intlen = (t1 - t0) / n_records
     ncoef = degree + 1
     # Chebyshev-Gauss nodes
@@ -326,13 +339,13 @@ def chebyshev_fit_records(fn, t0, t1, n_records, degree):
     Tmat = np.cos(
         np.outer(np.arange(ncoef), np.arccos(nodes))
     )  # (ncoef, ncoef): T_i(node_j)
-    out = np.zeros((n_records, 3, ncoef))
+    out = np.zeros((n_records, ncomp, ncoef))
     for r in range(n_records):
         mid = t0 + intlen * (r + 0.5)
         rad = intlen / 2.0
-        samples = fn(mid + rad * nodes)  # (ncoef, 3)
+        samples = fn(mid + rad * nodes)  # (ncoef, ncomp)
         # discrete Chebyshev transform
-        c = 2.0 / ncoef * (Tmat @ samples)  # (ncoef, 3)
+        c = 2.0 / ncoef * (Tmat @ samples)  # (ncoef, ncomp)
         c[0] *= 0.5
         out[r] = c.T
     return out

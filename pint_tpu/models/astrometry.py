@@ -11,8 +11,12 @@ plain f64 dot products (sub-ps precision at AU scales).
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
+
+from pint_tpu.ops.scalarmath import cos_p, sin_p
 
 from pint_tpu.constants import (
     AU_LIGHT_SEC,
@@ -116,30 +120,29 @@ class AstrometryEquatorial(Astrometry):
         self.require("RAJ", "DECJ")
 
     def ssb_to_psr_xyz(self, pdict, bundle):
+        # sin_p/cos_p, NOT jnp trig: ra/dec are 0-d scalars without PM,
+        # and axon's scalar transcendental path is f32-accurate — a
+        # 3e-8 direction error is ~15 us of Roemer delay
+        # (ops/scalarmath.py; tests/test_onchip_accuracy.py)
         dt = self._dt_pos(pdict, bundle)
         ra0, dec0 = pdict["RAJ"], pdict["DECJ"]
         pmra = pdict.get("PMRA")
         pmdec = pdict.get("PMDEC")
         dec = dec0 if pmdec is None else dec0 + pmdec * dt
-        cosd = jnp.cos(dec)
-        ra = ra0 if pmra is None else ra0 + pmra * dt / jnp.cos(dec0)
+        cosd = cos_p(dec)
+        ra = ra0 if pmra is None else ra0 + pmra * dt / cos_p(dec0)
         return jnp.stack(
-            [jnp.cos(ra) * cosd, jnp.sin(ra) * cosd, jnp.sin(dec)], axis=-1
+            [cos_p(ra) * cosd, sin_p(ra) * cosd, sin_p(dec)], axis=-1
         )
 
     def sky_basis(self, pdict):
         ra, dec = pdict["RAJ"], pdict["DECJ"]
+        sr, cr = sin_p(ra), cos_p(ra)
+        sd, cd = sin_p(dec), cos_p(dec)
         east = jnp.stack(
-            [-jnp.sin(ra), jnp.cos(ra), jnp.zeros_like(ra)], axis=-1
+            [-sr, cr, jnp.zeros_like(cr)], axis=-1
         )
-        north = jnp.stack(
-            [
-                -jnp.cos(ra) * jnp.sin(dec),
-                -jnp.sin(ra) * jnp.sin(dec),
-                jnp.cos(dec),
-            ],
-            axis=-1,
-        )
+        north = jnp.stack([-cr * sd, -sr * sd, cd], axis=-1)
         return east, north
 
     def proper_motion(self, pdict):
@@ -188,7 +191,9 @@ class AstrometryEcliptic(Astrometry):
 
     def _ecl_to_equ(self, v):
         eps = self._obliquity()
-        ce, se = jnp.cos(eps), jnp.sin(eps)
+        # static python-float obliquity: rotate with HOST trig (device
+        # 0-d trig is f32-accurate on axon, ops/scalarmath.py)
+        ce, se = math.cos(eps), math.sin(eps)
         # rotate ecliptic -> equatorial (x axis shared)
         x = v[..., 0]
         y = ce * v[..., 1] - se * v[..., 2]
@@ -196,31 +201,25 @@ class AstrometryEcliptic(Astrometry):
         return jnp.stack([x, y, z], axis=-1)
 
     def ssb_to_psr_xyz(self, pdict, bundle):
+        # scalar-safe trig: see AstrometryEquatorial.ssb_to_psr_xyz
         dt = self._dt_pos(pdict, bundle)
         lam0, bet0 = pdict["ELONG"], pdict["ELAT"]
         pml = pdict.get("PMELONG")
         pmb = pdict.get("PMELAT")
         bet = bet0 if pmb is None else bet0 + pmb * dt
-        lam = lam0 if pml is None else lam0 + pml * dt / jnp.cos(bet0)
-        cb = jnp.cos(bet)
+        lam = lam0 if pml is None else lam0 + pml * dt / cos_p(bet0)
+        cb = cos_p(bet)
         x_ecl = jnp.stack(
-            [jnp.cos(lam) * cb, jnp.sin(lam) * cb, jnp.sin(bet)], axis=-1
+            [cos_p(lam) * cb, sin_p(lam) * cb, sin_p(bet)], axis=-1
         )
         return self._ecl_to_equ(x_ecl)
 
     def sky_basis(self, pdict):
         lam, bet = pdict["ELONG"], pdict["ELAT"]
-        east = jnp.stack(
-            [-jnp.sin(lam), jnp.cos(lam), jnp.zeros_like(lam)], axis=-1
-        )
-        north = jnp.stack(
-            [
-                -jnp.cos(lam) * jnp.sin(bet),
-                -jnp.sin(lam) * jnp.sin(bet),
-                jnp.cos(bet),
-            ],
-            axis=-1,
-        )
+        sl, cl = sin_p(lam), cos_p(lam)
+        sb, cb = sin_p(bet), cos_p(bet)
+        east = jnp.stack([-sl, cl, jnp.zeros_like(cl)], axis=-1)
+        north = jnp.stack([-cl * sb, -sl * sb, cb], axis=-1)
         return self._ecl_to_equ(east), self._ecl_to_equ(north)
 
     def proper_motion(self, pdict):

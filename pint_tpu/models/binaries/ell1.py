@@ -75,7 +75,10 @@ def eps_at_t_k(dt_f, eps1_0, eps2_0, omdot=0.0, lnedot=0.0):
 
       e(t) = e0 (1 + lnedot dt);  omega(t) = omega0 + omdot dt
     """
-    om0 = jnp.arctan2(eps1_0, eps2_0)
+    from pint_tpu.ops.scalarmath import arctan2_p
+
+    # 0-d arctan2 is f32-accurate on axon (ops/scalarmath.py)
+    om0 = arctan2_p(eps1_0, eps2_0)
     e0 = jnp.sqrt(eps1_0 * eps1_0 + eps2_0 * eps2_0)
     e = e0 * (1.0 + lnedot * dt_f)
     om = om0 + omdot * dt_f

@@ -29,6 +29,7 @@ import numpy as np
 from pint_tpu.constants import SECS_PER_JULIAN_YEAR
 from pint_tpu.models.component import NoiseComponent
 from pint_tpu.models.parameter import floatParameter, maskParameter
+from pint_tpu.ops.scalarmath import power_p
 
 F_YR = 1.0 / SECS_PER_JULIAN_YEAR
 
@@ -89,7 +90,10 @@ class ScaleToaError(NoiseComponent):
         for n in self.equad_params:
             equad2 = equad2 + jnp.square(pdict[n]) * bundle.masks[n]
         for n in self.tneq_params:
-            equad2 = equad2 + jnp.square(10.0 ** pdict[n]) * bundle.masks[n]
+            # power_p: 0-d pow is f32-accurate on axon (ops/scalarmath)
+            equad2 = equad2 + jnp.square(
+                power_p(10.0, pdict[n])
+            ) * bundle.masks[n]
         efac = jnp.ones_like(sigma_s)
         for n in self.efac_params:
             # masked multiplicative: efac where selected, 1 elsewhere
@@ -272,10 +276,12 @@ def host_fourier_basis(toas, nharm: int) -> np.ndarray:
 def powerlaw_phi(f, tspan, log10_amp, gamma):
     """Power-law PSD weights phi_j (s^2), enterprise convention:
     phi_j = A^2/(12 pi^2) f_yr^(gamma-3) f_j^(-gamma) / Tspan."""
-    amp = 10.0 ** log10_amp
+    # power_p on the scalar parameters (0-d pow takes axon's f32 scalar
+    # path, ops/scalarmath.py); f is per-harmonic, so plain ** is fine
+    amp = power_p(10.0, log10_amp)
     return (
         amp * amp / (12.0 * math.pi * math.pi)
-        * F_YR ** (gamma - 3.0)
+        * power_p(F_YR, gamma - 3.0)
         * f ** (-gamma)
         / tspan
     )

@@ -33,6 +33,7 @@ from pint_tpu.models.parameter import (
     floatParameter,
 )
 from pint_tpu.ops.dd import DD
+from pint_tpu.ops.scalarmath import cos_p, exp_p, sin_p
 
 _DEG = math.pi / 180.0
 _DEG_PER_YEAR = _DEG / SECS_PER_JULIAN_YEAR
@@ -411,7 +412,9 @@ class BinaryDDS(BinaryDD):
 
     def _pk(self, pdict, dt_f):
         pk = super()._pk(pdict, dt_f)
-        pk["sini"] = 1.0 - jnp.exp(-self.val(pdict, "SHAPMAX"))
+        # exp_p: 0-d transcendentals are f32-accurate on axon
+        # (ops/scalarmath.py)
+        pk["sini"] = 1.0 - exp_p(-self.val(pdict, "SHAPMAX"))
         return pk
 
 
@@ -533,9 +536,11 @@ class BinaryDDK(BinaryDD):
         ast = self._astrometry_ref
         kin0 = pdict["KIN"]
         kom = pdict["KOM"]
-        sk, ck = jnp.sin(kom), jnp.cos(kom)
-        sin_kin0 = jnp.sin(kin0)
-        cot_kin0 = jnp.cos(kin0) / sin_kin0
+        # scalar-safe trig: KIN/KOM are 0-d parameters and axon's
+        # scalar transcendental path is f32-accurate (ops/scalarmath.py)
+        sk, ck = sin_p(kom), cos_p(kom)
+        sin_kin0 = sin_p(kin0)
+        cot_kin0 = cos_p(kin0) / sin_kin0
         pml, pmb = ast.proper_motion(pdict)
         # Kopeikin 1996: secular drift from proper motion
         dkin_pm = (-pml * sk + pmb * ck) * dt_f

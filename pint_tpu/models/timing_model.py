@@ -484,9 +484,14 @@ class CompiledModel:
         bw = self.noise_basis(x)
         if bw is not None:
             return bw
+        # weight 1e-30, NOT smaller: the Woodbury inner solve forms
+        # 1/phi, and axon's f32-pair emulated f64 keeps the f32
+        # EXPONENT range — 1e40 overflows to inf and NaNs the whole
+        # fit (the basis column is zero, so any finite weight is
+        # exact; caught by the on-TPU smoke suite, docs/precision.md)
         return (
             jnp.zeros((self.bundle.ntoa, 1)),
-            jnp.ones(1) * 1e-40,
+            jnp.ones(1) * 1e-30,
         )
 
     def noise_covariance(self, x):

@@ -1,0 +1,70 @@
+"""Precision-safe transcendentals for 0-d (scalar) operands on axon.
+
+Empirical axon-TPU hazard (see docs/precision.md): transcendental ops
+on 0-d f64 operands lower to a scalar path that is only f32-accurate
+(~2e-8 absolute for sin/cos), while the same op on a rank>=1 array
+takes the emulated-f64 vector path (~1e-14).  A scalar sky position
+fed to jnp.cos therefore poisons the Roemer dot product at the 10 us
+level (499 s * 3e-8) — caught by tests/test_onchip_accuracy.py.
+
+These wrappers lift 0-d operands to a 2-element vector (the operand
+plus a finite dummy lane) around the op and take lane 0; rank>=1
+inputs pass through untouched.  A plain reshape to (1,) or a
+broadcast does NOT work — XLA folds those back onto the scalar path;
+a stack of two distinct lanes is what forces the vector lowering
+(verified on-chip).  Shapes are static under jit, so the branch costs
+nothing at trace time.  Use them wherever a SCALAR MODEL PARAMETER
+(sky angle, orientation angle, log-amplitude) meets a transcendental;
+array-valued per-TOA math can keep the plain jnp ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _lift1(f, x, dummy=0.0):
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return f(jnp.stack([x, jnp.full_like(x, dummy)]))[0]
+    return f(x)
+
+
+def _lift2(f, x, y, dummy=(0.0, 1.0)):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim == 0 and y.ndim == 0:
+        return f(
+            jnp.stack([x, jnp.full_like(x, dummy[0])]),
+            jnp.stack([y, jnp.full_like(y, dummy[1])]),
+        )[0]
+    return f(x, y)
+
+
+def sin_p(x):
+    return _lift1(jnp.sin, x)
+
+
+def cos_p(x):
+    return _lift1(jnp.cos, x)
+
+
+def tan_p(x):
+    return _lift1(jnp.tan, x)
+
+
+def exp_p(x):
+    return _lift1(jnp.exp, x)
+
+
+def log_p(x):
+    # dummy lane 1.0: log(0) would put an inf in the discarded lane
+    return _lift1(jnp.log, x, dummy=1.0)
+
+
+def arctan2_p(x, y):
+    return _lift2(jnp.arctan2, x, y)
+
+
+def power_p(x, y):
+    return _lift2(jnp.power, x, y)

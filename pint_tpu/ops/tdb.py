@@ -4,18 +4,28 @@ Reference parity: the reference gets TDB from astropy/ERFA (``dtdb``),
 which implements the full 787-term Fairhead & Bretagnon (1990) series;
 ``toa.py::TOAs.compute_TDBs`` applies it per TOA.
 
-Here we implement the standard truncated series (USNO Circular 179 §2.3 /
-Explanatory Supplement form), accurate to a few microseconds over
-1600-2200.  That is ample for *internal consistency* (simulation and
-fitting share the same conversion, so residual round-trips hold to sub-ns)
-and for most timing applications; for sub-µs absolute parity with
-ephemeris time arguments, supply a DE440t-style TT-TDB ephemeris segment
-(see pint_tpu.ephemeris) which then overrides this series.
+Here we implement the dominant terms of the same FB1990 harmonic model:
+every t^0 term with amplitude >= 30 ns (57 terms), every t^1 term with
+amplitude >= 17 ns (18 terms), and the leading t^2/t^3/t^4 terms (9),
+84 terms total.  The full 787-term series reproduces ephemeris time to
+~3 ns (1600-2200); the truncation here omits t^0 terms of individual
+amplitude < 30 ns whose root-sum-square is ~60 ns, so the absolute
+accuracy class of this function is ~0.1 us — three orders better than
+the previous 7-term truncation (few us), and validated against an
+INDEPENDENT numerical integration of the defining IAU 2006 TDB integral
+over the solar-system ephemeris (tests/test_tdb_series.py; the two
+implementations share no code or coefficients).
 
-The periodic terms are functions of TT Julian centuries from J2000.
-A topocentric correction (observer velocity dot geocentric position /
-c^2, <2.1 µs annual + <2 ns diurnal) is applied separately in the ingest
-pipeline where observatory geometry is known.
+For exact parity with a DE-t ephemeris, supply a DE440t-style TT-TDB
+time-ephemeris segment (pint_tpu.ephemeris.time_ephemeris) which then
+overrides this series — the same split the reference has between
+astropy's analytic scales and ephemeris time arguments.
+
+The series argument is TDB Julian millennia from J2000 (TT is
+indistinguishable at this precision: dt ~ 1.7 ms changes the annual
+term by ~3e-13 s).  A topocentric correction (observer velocity dot
+geocentric position / c^2, < 2.1 us annual + < 2 ns diurnal) is applied
+separately in the ingest pipeline where observatory geometry is known.
 
 Written against the array module ``xp`` (numpy or jax.numpy) so the same
 series serves host ingest (numpy, IEEE f64) and device kernels.
@@ -25,30 +35,136 @@ from __future__ import annotations
 
 import numpy as np
 
-# (amplitude_seconds, rate_rad_per_century, phase_rad, t_power)
-_TDB_TERMS = [
-    (0.001657, 628.3076, 6.2401, 0),
-    (0.000022, 575.3385, 4.2970, 0),
-    (0.000014, 1256.6152, 6.1969, 0),
-    (0.000005, 606.9777, 4.0212, 0),
-    (0.000005, 52.9691, 0.4444, 0),
-    (0.000002, 21.3299, 5.5431, 0),
-    (0.000010, 628.3076, 4.2490, 1),
-]
+# Fairhead & Bretagnon (1990) harmonic model, largest terms.
+# Rows: (amplitude s, frequency rad/Julian-millennium, phase rad);
+# contribution = amp * sin(freq * t + phase) * t^k for group k.
+_FB_T0 = np.array([
+    (1656.674564e-6, 6283.075849991, 6.240054195),
+    (22.417471e-6, 5753.384884897, 4.296977442),
+    (13.839792e-6, 12566.151699983, 6.196904410),
+    (4.770086e-6, 529.690965095, 0.444401603),
+    (4.676740e-6, 6069.776754553, 4.021195093),
+    (2.256707e-6, 213.299095438, 5.543113262),
+    (1.694205e-6, -3.523118349, 5.025132748),
+    (1.554905e-6, 77713.771467920, 5.198467090),
+    (1.276839e-6, 7860.419392439, 5.988822341),
+    (1.193379e-6, 5223.693919802, 3.649823730),
+    (1.115322e-6, 3930.209696220, 1.422745069),
+    (0.794185e-6, 11506.769769794, 2.322313077),
+    (0.600309e-6, 1577.343542448, 2.678271909),
+    (0.496817e-6, 6208.294251424, 5.696701824),
+    (0.486306e-6, 5884.926846583, 0.520007179),
+    (0.468597e-6, 6244.942814354, 5.866398759),
+    (0.447061e-6, 26.298319800, 3.615796498),
+    (0.435206e-6, -398.149003408, 4.349338347),
+    (0.432392e-6, 74.781598567, 2.435898309),
+    (0.375510e-6, 5507.553238667, 4.103476804),
+    (0.243085e-6, -775.522611324, 3.651837925),
+    (0.230685e-6, 5856.477659115, 4.773852582),
+    (0.203747e-6, 12036.460734888, 4.333987818),
+    (0.173435e-6, 18849.227549974, 6.153743485),
+    (0.159080e-6, 10977.078804699, 1.890075226),
+    (0.143935e-6, -796.298006816, 5.957517795),
+    (0.137927e-6, 11790.629088659, 1.135934669),
+    (0.119979e-6, 38.133035638, 4.551585768),
+    (0.118971e-6, 5486.777843175, 1.914547226),
+    (0.116120e-6, 1059.381930189, 0.873504123),
+    (0.101868e-6, -5573.142801634, 5.984503847),
+    (0.098358e-6, 2544.314419883, 0.092793886),
+    (0.080164e-6, 206.185548437, 2.095377709),
+    (0.079645e-6, 4694.002954708, 2.949233637),
+    (0.075019e-6, 2942.463423292, 4.980931759),
+    (0.064397e-6, 5746.271337896, 1.280308748),
+    (0.063814e-6, 5760.498431898, 4.167901731),
+    (0.062617e-6, 20.775395492, 2.654394814),
+    (0.058844e-6, 426.598190876, 4.839650148),
+    (0.054139e-6, 17260.154654690, 3.411091093),
+    (0.048373e-6, 155.420399434, 2.251573730),
+    (0.048042e-6, 2146.165416475, 1.495846011),
+    (0.046551e-6, -0.980321068, 0.921573539),
+    (0.042732e-6, 632.783739313, 5.720622217),
+    (0.042560e-6, 161000.685737473, 1.270837679),
+    (0.042411e-6, 6275.962302991, 2.869567043),
+    (0.040759e-6, 12352.852604545, 3.981496998),
+    (0.040480e-6, 15720.838784878, 2.546610123),
+    (0.040184e-6, -7.113547001, 3.565975565),
+    (0.036955e-6, 3154.687084896, 5.071801441),
+    (0.036564e-6, 5088.628839767, 3.324679049),
+    (0.036507e-6, 801.820931124, 6.248866009),
+    (0.034867e-6, 522.577418094, 5.210064075),
+    (0.033529e-6, 9437.762934887, 2.404714239),
+    (0.033477e-6, 6062.663207553, 4.144987272),
+    (0.032438e-6, 6076.890301554, 0.749317412),
+    (0.030215e-6, 7084.896781115, 3.389610345),
+])
+_FB_T1 = np.array([
+    (102.156724e-6, 6283.075849991, 4.249032005),
+    (1.706807e-6, 12566.151699983, 4.205904248),
+    (0.269668e-6, 213.299095438, 3.400290479),
+    (0.265919e-6, 529.690965095, 5.836047367),
+    (0.210568e-6, -3.523118349, 6.262738348),
+    (0.077996e-6, 5223.693919802, 4.670344204),
+    (0.059146e-6, 26.298319800, 1.083044735),
+    (0.054764e-6, 1577.343542448, 4.534800170),
+    (0.034420e-6, -398.149003408, 5.980077351),
+    (0.033595e-6, 5507.553238667, 5.980162321),
+    (0.032088e-6, 18849.227549974, 4.162913471),
+    (0.029198e-6, 5856.477659115, 0.623811863),
+    (0.027764e-6, 155.420399434, 3.745318113),
+    (0.025190e-6, 5746.271337896, 2.980330535),
+    (0.024976e-6, 5760.498431898, 2.467913690),
+    (0.022997e-6, -796.298006816, 1.174411803),
+    (0.021774e-6, 206.185548437, 3.854787540),
+    (0.017925e-6, -775.522611324, 1.092065955),
+])
+_FB_T2 = np.array([
+    (4.322990e-6, 6283.075849991, 2.642893748),
+    (0.406495e-6, 0.0, 4.712388980),
+    (0.122605e-6, 12566.151699983, 2.438140634),
+    (0.019476e-6, 213.299095438, 1.642186981),
+    (0.016916e-6, 529.690965095, 4.510959344),
+    (0.013374e-6, -3.523118349, 1.502210314),
+])
+_FB_T3 = np.array([
+    (0.143388e-6, 6283.075849991, 1.131453581),
+    (0.006671e-6, 12566.151699983, 0.775148593),
+])
+_FB_T4 = np.array([
+    (0.003826e-6, 6283.075849991, 5.755066566),
+])
+_FB_GROUPS = (_FB_T0, _FB_T1, _FB_T2, _FB_T3, _FB_T4)
+
+
+# optional global override: a TDB-TT provider taking ET seconds past
+# J2000 (installed by ephemeris.time_ephemeris.install_time_ephemeris
+# when a DE-t style kernel is supplied; host/numpy path only — TDB
+# conversion happens at ingest per the architecture invariants)
+_time_ephemeris_fn = None
 
 
 def tdb_minus_tt(tt_centuries_j2000, xp=np):
     """TDB - TT in seconds, given TT as Julian centuries from J2000.0.
 
-    Accuracy: few µs (truncated FB90). ``xp`` selects numpy or jax.numpy.
+    Accuracy ~0.1 us absolute (truncated FB90, see module docstring);
+    an installed time ephemeris overrides the series on the host path.
+    ``xp`` selects numpy or jax.numpy.
     """
-    T = tt_centuries_j2000
-    out = None
-    for amp, rate, phase, power in _TDB_TERMS:
-        term = amp * xp.sin(rate * T + phase)
-        if power == 1:
-            term = term * T
-        out = term if out is None else out + term
+    if _time_ephemeris_fn is not None and xp is np:
+        et = np.asarray(tt_centuries_j2000, dtype=np.float64) * (
+            36525.0 * 86400.0
+        )
+        return _time_ephemeris_fn(et)
+    t = xp.asarray(tt_centuries_j2000) / 10.0  # Julian millennia
+    out = 0.0
+    tk = 1.0
+    for group in _FB_GROUPS:
+        amp = group[:, 0]
+        freq = group[:, 1]
+        phase = group[:, 2]
+        out = out + tk * xp.sum(
+            amp * xp.sin(freq * t[..., None] + phase), axis=-1
+        )
+        tk = tk * t
     return out
 
 

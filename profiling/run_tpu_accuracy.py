@@ -1,0 +1,35 @@
+"""Round artifact: on-TPU accuracy suite -> JSON + STATUS.md line.
+
+Runs tests/test_onchip_accuracy.py on the DEFAULT backend (the real
+chip under axon) and writes TPU_ACCURACY.json at the repo root.  Part
+of the per-round workflow (VERDICT r1 items 1/8): an on-TPU accuracy
+artifact alongside the TOAs/sec headline.
+
+    python profiling/run_tpu_accuracy.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+if __name__ == "__main__":
+    env = dict(os.environ, PINT_TPU_TEST_BACKEND="tpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_onchip_accuracy.py", "-q", "--no-header"],
+        cwd=ROOT, env=env, capture_output=True, text=True,
+        timeout=1800,
+    )
+    tail = (proc.stdout or "").strip().splitlines()[-1:]
+    out = {
+        "ok": proc.returncode == 0,
+        "rc": proc.returncode,
+        "summary": tail[0] if tail else "",
+    }
+    (ROOT / "TPU_ACCURACY.json").write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+    sys.exit(proc.returncode)

@@ -1,0 +1,113 @@
+"""Regenerate the golden regression oracles (CPU IEEE f64).
+
+Run after any INTENDED numerics change (ephemeris upgrade, TDB series
+extension, nutation terms, ...):
+
+    python tests/datafile/make_golden_oracle.py
+
+The stored npz is a REGRESSION oracle — it pins the pipeline at
+generation time so unintended numerics drift fails the suite.  The
+independent parity check (which a framework bug at generation time
+cannot fool) is tests/test_independent_oracle.py's mpmath pipeline.
+"""
+
+import warnings
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+DATADIR = Path(__file__).parent
+
+# (stem, ntoa, start, end, seed): the .par in DATADIR is the source of
+# truth; the .tim is synthesized from it (model-perfect + 1 us white
+# jitter) so the dataset embodies the CURRENT ingest physics.
+# wideband=True attaches -pp_dm/-pp_dme DM measurements.
+_DATASETS = {
+    "golden1": dict(ntoa=150, start_mjd=54000.0, end_mjd=56500.0, seed=1),
+    "golden2": dict(ntoa=120, start_mjd=54200.0, end_mjd=56400.0, seed=2),
+    "golden3": dict(ntoa=100, start_mjd=54800.0, end_mjd=56200.0, seed=3),
+    "golden4": dict(
+        ntoa=110, start_mjd=54700.0, end_mjd=55900.0, seed=4,
+        wideband=True,
+    ),
+}
+
+
+def regen_tim(stem: str):
+    import numpy as np
+
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.simulation import make_test_pulsar
+
+    cfg = _DATASETS[stem]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        par_text = (DATADIR / f"{stem}.par").read_text()
+        model, toas = make_test_pulsar(
+            par_text, ntoa=cfg["ntoa"], start_mjd=cfg["start_mjd"],
+            end_mjd=cfg["end_mjd"], seed=cfg["seed"], obs="gbt",
+        )
+        if cfg.get("wideband"):
+            cm = model.compile(toas)
+            dm_model = np.asarray(cm.dm_model(cm.x0()))
+            rng = np.random.default_rng(cfg["seed"] + 100)
+            dm_sigma = 2e-4
+            dm_meas = dm_model + rng.normal(0.0, dm_sigma, len(toas))
+            for i, f in enumerate(toas.flags):
+                f["pp_dm"] = f"{dm_meas[i]:.10f}"
+                f["pp_dme"] = f"{dm_sigma:.2e}"
+        write_tim_file(DATADIR / f"{stem}.tim", toas)
+    print(f"{stem}: wrote {cfg['ntoa']}-TOA tim")
+
+
+def regen(stem: str):
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.fitting.wideband import WidebandTOAFitter
+    from pint_tpu.models.builder import get_model, get_model_and_toas
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = get_model_and_toas(
+            str(DATADIR / f"{stem}.par"), str(DATADIR / f"{stem}.tim")
+        )
+        cm = model.compile(toas)
+        resid = np.asarray(cm.time_residuals(cm.x0()))
+        if _DATASETS[stem].get("wideband"):
+            f = WidebandTOAFitter(
+                toas, get_model(str(DATADIR / f"{stem}.par"))
+            )
+        else:
+            f = GLSFitter(
+                toas, get_model(str(DATADIR / f"{stem}.par")),
+                fused=False,
+            )
+        chi2 = f.fit_toas(maxiter=3)
+    names = list(f.cm.free_names)
+    np.savez(
+        DATADIR / f"{stem}_oracle.npz",
+        resid=resid,
+        chi2=float(chi2),
+        names=np.asarray(names),
+        values=np.asarray(
+            [float(f.model.params[n].value) for n in names]
+        ),
+        uncs=np.asarray(
+            [float(f.model.params[n].uncertainty) for n in names]
+        ),
+    )
+    print(f"{stem}: wrote oracle ({len(resid)} TOAs, chi2={chi2:.4f})")
+
+
+if __name__ == "__main__":
+    import sys
+
+    regen_data = "--regen-data" in sys.argv
+    for stem in _DATASETS:
+        if regen_data:
+            regen_tim(stem)
+        regen(stem)

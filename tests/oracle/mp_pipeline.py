@@ -1,0 +1,684 @@
+"""Independent mpmath oracle for the full timing pipeline.
+
+A from-scratch 40-digit implementation of ingest -> delays -> phase ->
+residuals, sharing NO evaluation code with the framework: every
+transformation (leap seconds, TT->TDB, precession/nutation/GAST,
+VSOP87/Kepler ephemeris, Roemer/Shapiro/dispersion/binary delays,
+Taylor phase) is re-derived here in mpmath.  Published COEFFICIENT
+TABLES (leap-second history, FB1990 TDB terms, IAU1980 nutation rows,
+VSOP87 terms, Kepler elements) are imported from the framework as
+*data* — re-typing them would only add transcription risk; the point
+of independence is the arithmetic and the pipeline wiring, which is
+where bugs live.
+
+Reference parity: this plays the role of the reference's stored Tempo2
+residual oracles over tests/datafile/ (SURVEY.md §4): an external
+ns-level check the framework cannot fool by being self-consistent.
+
+Supported components (grown with the golden datasets): Spindown,
+AstrometryEquatorial (+PM, +PX), DispersionDM (+DMX), SolarSystemShapiro
+(Sun), BinaryELL1, BinaryDD, JUMP (flag masks), ScaleToaError
+(EFAC/EQUAD, for the weighted mean).  PLRedNoise affects fitting, not
+pre-fit residuals, and is ignored here.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+from mpmath import mp, mpf, sin, cos, sqrt, log, atan2, floor, pi
+
+mp.dps = 40
+
+# -- published data tables + defining constants (imported as data) -------
+from pint_tpu.constants import (  # noqa: E402
+    AU_LIGHT_SEC, C, DM_CONST, GM_SUN, TSUN, SECS_PER_JULIAN_YEAR,
+    MAS_TO_RAD,
+)
+from pint_tpu.ephemeris.builtin import (  # noqa: E402
+    _ELEMENTS, _EMRAT, _MASS_RATIO, AU_KM,
+)
+from pint_tpu.ephemeris.vsop87 import (  # noqa: E402
+    _B_SERIES, _L_SERIES, _R_SERIES,
+)
+from pint_tpu.earth.rotation import _NUT_TERMS  # noqa: E402
+from pint_tpu.ops.tdb import _FB_GROUPS  # noqa: E402
+from pint_tpu.timebase.leapseconds import (  # noqa: E402
+    _LEAP_MJDS, _LEAP_OFFSETS,
+)
+
+ARCSEC = pi / (180 * 3600)
+DEG = pi / 180
+TT_MINUS_TAI = mpf("32.184")
+SPD = mpf(86400)
+
+
+# ========================= par / tim parsing ============================
+def parse_par(path):
+    d = {}
+    for line in open(path):
+        parts = line.split()
+        if not parts or parts[0].startswith("#"):
+            continue
+        key = parts[0].upper()
+        d.setdefault(key, []).append(parts[1:])
+    return d
+
+
+def par_val(par, key, default=None):
+    if key not in par:
+        return default
+    return par[key][0][0]
+
+
+def parse_tim(path):
+    """-> list of dicts (freq, day, frac, err_us, obs, flags)."""
+    toas = []
+    for line in open(path):
+        if line.startswith(("FORMAT", "MODE", "C ", "#")):
+            continue
+        parts = line.split()
+        if len(parts) < 5:
+            continue
+        name, freq, mjd, err, obs = parts[:5]
+        flags = {}
+        rest = parts[5:]
+        for i in range(0, len(rest) - 1, 2):
+            if rest[i].startswith("-"):
+                flags[rest[i][1:]] = rest[i + 1]
+        day_s, _, frac_s = mjd.partition(".")
+        toas.append(dict(
+            freq=mpf(freq), day=int(day_s),
+            frac=mpf("0." + (frac_s or "0")),
+            err_us=mpf(err), obs=obs, flags=flags,
+        ))
+    return toas
+
+
+def parse_hms(s):
+    """H:M:S -> rad."""
+    h, m, sec = s.split(":")
+    sign = -1 if h.strip().startswith("-") else 1
+    return sign * (
+        abs(int(h)) * mpf(3600) + int(m) * 60 + mpf(sec)
+    ) * 15 * ARCSEC
+
+
+def parse_dms(s):
+    d, m, sec = s.split(":")
+    sign = -1 if d.strip().startswith("-") else 1
+    return sign * (
+        abs(int(d)) * mpf(3600) + int(m) * 60 + mpf(sec)
+    ) * ARCSEC
+
+
+# ========================= time scales ==================================
+def tai_minus_utc(day):
+    off = 0
+    for mjd, o in zip(_LEAP_MJDS, _LEAP_OFFSETS):
+        if day >= mjd:
+            off = o
+    return mpf(off)
+
+
+def utc_to_tt(day, sec):
+    """(day, sec UTC, pulsar_mjd convention) -> (day, sec TT)."""
+    return norm_day_sec(day, sec + tai_minus_utc(day) + TT_MINUS_TAI)
+
+
+def norm_day_sec(day, sec):
+    d = int(floor(sec / SPD))
+    return day + d, sec - d * SPD
+
+
+def tt_centuries(day, sec):
+    return ((day - mpf("51544.5")) + sec / SPD) / 36525
+
+
+def tdb_minus_tt_series(T_cent):
+    """FB1990 truncation, evaluated independently in mpmath."""
+    t = T_cent / 10
+    out = mpf(0)
+    tk = mpf(1)
+    for group in _FB_GROUPS:
+        for amp, freq, phase in group:
+            out += tk * mpf(amp) * sin(mpf(freq) * t + mpf(phase))
+        tk *= t
+    return out
+
+
+def tt_to_tdb_geo(day, sec):
+    d = tdb_minus_tt_series(tt_centuries(day, sec))
+    return norm_day_sec(day, sec + d)
+
+
+# ========================= earth orientation ============================
+def r1(a):
+    return np.array([
+        [mpf(1), mpf(0), mpf(0)],
+        [mpf(0), cos(a), sin(a)],
+        [mpf(0), -sin(a), cos(a)],
+    ])
+
+
+def r2(a):
+    return np.array([
+        [cos(a), mpf(0), -sin(a)],
+        [mpf(0), mpf(1), mpf(0)],
+        [sin(a), mpf(0), cos(a)],
+    ])
+
+
+def r3(a):
+    return np.array([
+        [cos(a), sin(a), mpf(0)],
+        [-sin(a), cos(a), mpf(0)],
+        [mpf(0), mpf(0), mpf(1)],
+    ])
+
+
+def bias_matrix():
+    xi0 = mpf("-0.0166170") * ARCSEC
+    eta0 = mpf("-0.0068192") * ARCSEC
+    da0 = mpf("-0.01460") * ARCSEC
+    return r1(-eta0) @ r2(xi0) @ r3(da0)
+
+
+def precession_matrix(T):
+    zeta = (mpf("2306.2181") * T + mpf("0.30188") * T**2
+            + mpf("0.017998") * T**3) * ARCSEC
+    z = (mpf("2306.2181") * T + mpf("1.09468") * T**2
+         + mpf("0.018203") * T**3) * ARCSEC
+    theta = (mpf("2004.3109") * T - mpf("0.42665") * T**2
+             - mpf("0.041833") * T**3) * ARCSEC
+    return r3(-z) @ r2(theta) @ r3(-zeta)
+
+
+def mean_obliquity(T):
+    return (mpf("84381.448") - mpf("46.8150") * T
+            - mpf("0.00059") * T**2 + mpf("0.001813") * T**3) * ARCSEC
+
+
+def fundamental_args(T):
+    def poly(deg0, c1, c2, c3):
+        return (mpf(deg0) + (mpf(c1) * T + mpf(c2) * T**2
+                             + mpf(c3) * T**3) / 3600) * DEG
+
+    l = poly("134.96340251", "1717915923.2178", "31.8792", "0.051635")
+    lp = poly("357.52910918", "129596581.0481", "-0.5532", "0.000136")
+    F = poly("93.27209062", "1739527262.8478", "-12.7512", "-0.001037")
+    D = poly("297.85019547", "1602961601.2090", "-6.3706", "0.006593")
+    Om = poly("125.04455501", "-6962890.5431", "7.4722", "0.007702")
+    return l, lp, F, D, Om
+
+
+def nutation_angles(T):
+    l, lp, F, D, Om = fundamental_args(T)
+    dpsi = mpf(0)
+    deps = mpf(0)
+    for row in _NUT_TERMS:
+        arg = (row[0] * l + row[1] * lp + row[2] * F + row[3] * D
+               + row[4] * Om)
+        dpsi += (mpf(row[5]) + mpf(row[6]) * T) * sin(arg)
+        deps += (mpf(row[7]) + mpf(row[8]) * T) * cos(arg)
+    return dpsi * mpf("1e-4") * ARCSEC, deps * mpf("1e-4") * ARCSEC
+
+
+def gmst82(mjd_ut1_day, ut1_sec):
+    Tu = ((mjd_ut1_day - mpf("51544.5")) + ut1_sec / SPD) / 36525
+    gmst_s = (mpf("67310.54841")
+              + (mpf(876600) * 3600 + mpf("8640184.812866")) * Tu
+              + mpf("0.093104") * Tu**2 - mpf("6.2e-6") * Tu**3)
+    return (gmst_s % SPD) * 2 * pi / SPD
+
+
+def gast(mjd_ut1_day, ut1_sec, T_tt):
+    eps0 = mean_obliquity(T_tt)
+    dpsi, deps = nutation_angles(T_tt)
+    _, _, _, _, Om = fundamental_args(T_tt)
+    ee_ct = (mpf("0.00264") * sin(Om)
+             + mpf("0.000063") * sin(2 * Om)) * ARCSEC
+    return gmst82(mjd_ut1_day, ut1_sec) + dpsi * cos(eps0 + deps) + ee_ct
+
+
+def itrf_to_gcrs_matrix(mjd_ut1_day, ut1_sec, T_tt):
+    """EOP-free chain (dut1 = xp = yp = 0, the no-data ingest default)."""
+    B = bias_matrix()
+    P = precession_matrix(T_tt)
+    eps0 = mean_obliquity(T_tt)
+    dpsi, deps = nutation_angles(T_tt)
+    N = r1(-(eps0 + deps)) @ r3(-dpsi) @ r1(eps0)
+    theta = gast(mjd_ut1_day, ut1_sec, T_tt)
+    M_c2t = r3(theta) @ N @ P @ B
+    return M_c2t.T
+
+
+OMEGA_EARTH = mpf("7.292115855306589e-5")
+
+
+# ========================= ephemeris ====================================
+def _eval_vsop(series, t):
+    out = mpf(0)
+    tk = mpf(1)
+    for tab in series:
+        for A, Bp, Cf in tab:
+            out += tk * mpf(A) * cos(mpf(Bp) + mpf(Cf) * t)
+        tk *= t
+    return out
+
+
+def earth_heliocentric_ecl_date_au(t_mill):
+    L = _eval_vsop(_L_SERIES, t_mill)
+    B = _eval_vsop(_B_SERIES, t_mill)
+    R = _eval_vsop(_R_SERIES, t_mill)
+    cb = cos(B)
+    return np.array([R * cb * cos(L), R * cb * sin(L), R * sin(B)])
+
+
+def ecl_of_date_to_eq_j2000(xyz, T_cent):
+    M = precession_matrix(T_cent).T @ r1(-mean_obliquity(T_cent))
+    return M @ xyz
+
+
+_OBL_KEPLER = mpf("84381.448") / 3600 * DEG
+
+
+def ecl_to_eq_j2000(xyz):
+    c, s = cos(_OBL_KEPLER), sin(_OBL_KEPLER)
+    x, y, z = xyz
+    return np.array([x, c * y - s * z, s * y + c * z])
+
+
+def kepler_xyz_au(name, T_cent):
+    el0, rate = _ELEMENTS[name]
+    a = mpf(el0[0]) + mpf(rate[0]) * T_cent
+    e = mpf(el0[1]) + mpf(rate[1]) * T_cent
+    inc = (mpf(el0[2]) + mpf(rate[2]) * T_cent) * DEG
+    L = (mpf(el0[3]) + mpf(rate[3]) * T_cent) * DEG
+    varpi = (mpf(el0[4]) + mpf(rate[4]) * T_cent) * DEG
+    Om = (mpf(el0[5]) + mpf(rate[5]) * T_cent) * DEG
+    om = varpi - Om
+    M = ((L - varpi + pi) % (2 * pi)) - pi
+    E = M + e * sin(M)
+    for _ in range(8):
+        E = E - (E - e * sin(E) - M) / (1 - e * cos(E))
+    xp = a * (cos(E) - e)
+    yp = a * sqrt(1 - e * e) * sin(E)
+    co, so = cos(om), sin(om)
+    cO, sO = cos(Om), sin(Om)
+    ci, si = cos(inc), sin(inc)
+    return np.array([
+        (co * cO - so * sO * ci) * xp + (-so * cO - co * sO * ci) * yp,
+        (co * sO + so * cO * ci) * xp + (-so * sO + co * cO * ci) * yp,
+        (so * si) * xp + (co * si) * yp,
+    ])
+
+
+def sun_ssb_ecl_au(T_cent):
+    num = np.array([mpf(0)] * 3)
+    msum = mpf(0)
+    for nm, mr in _MASS_RATIO.items():
+        num = num + mpf(mr) * kepler_xyz_au(nm, T_cent)
+        msum += mpf(mr)
+    return -num / (1 + msum)
+
+
+def moon_geocentric_ecl_date_km(T):
+    d2r = DEG
+    Lp = (mpf("218.3164477") + mpf("481267.88123421") * T) * d2r
+    D = (mpf("297.8501921") + mpf("445267.1114034") * T) * d2r
+    M = (mpf("357.5291092") + mpf("35999.0502909") * T) * d2r
+    Mp = (mpf("134.9633964") + mpf("477198.8675055") * T) * d2r
+    F = (mpf("93.2720950") + mpf("483202.0175233") * T) * d2r
+    lon = Lp + (
+        mpf("6.288774") * sin(Mp) + mpf("1.274027") * sin(2 * D - Mp)
+        + mpf("0.658314") * sin(2 * D) + mpf("0.213618") * sin(2 * Mp)
+        - mpf("0.185116") * sin(M) - mpf("0.114332") * sin(2 * F)
+    ) * d2r
+    lat = (
+        mpf("5.128122") * sin(F) + mpf("0.280602") * sin(Mp + F)
+        + mpf("0.277693") * sin(Mp - F)
+    ) * d2r
+    r = (mpf("385000.56") - mpf("20905.355") * cos(Mp)
+         - mpf("3699.111") * cos(2 * D - Mp)
+         - mpf("2955.968") * cos(2 * D))
+    cl, sl = cos(lon), sin(lon)
+    cb, sb = cos(lat), sin(lat)
+    return np.array([r * cb * cl, r * cb * sl, r * sb])
+
+
+def earth_ssb_eq_km(T_cent):
+    """SSB->geocenter, equatorial J2000, km (mirrors BuiltinEphemeris
+    composition: Kepler Sun wobble + VSOP87 geocenter)."""
+    sun = ecl_to_eq_j2000(sun_ssb_ecl_au(T_cent))
+    earth_h = ecl_of_date_to_eq_j2000(
+        earth_heliocentric_ecl_date_au(T_cent / 10), T_cent
+    )
+    return (sun + earth_h) * mpf(AU_KM)
+
+
+def sun_ssb_eq_km(T_cent):
+    return ecl_to_eq_j2000(sun_ssb_ecl_au(T_cent)) * mpf(AU_KM)
+
+
+def posvel(fn, T_cent, h_sec=60):
+    """Central-difference velocity, mirroring the builtin's h=60 s."""
+    h = mpf(h_sec) / (36525 * SPD)
+    p = fn(T_cent)
+    v = (fn(T_cent + h) - fn(T_cent - h)) / (2 * mpf(h_sec))
+    return p, v
+
+
+# ========================= delays =======================================
+def taylor_phase(dt, coeffs):
+    """sum_k c_k dt^(k+1) / (k+1)!  for coeffs = [F0, F1, ...]."""
+    out = mpf(0)
+    fact = mpf(1)
+    for k, c in enumerate(coeffs):
+        fact *= (k + 1)
+        out += c * dt ** (k + 1) / fact
+    return out
+
+
+def taylor_freq(dt, coeffs):
+    out = mpf(0)
+    fact = mpf(1)
+    for k, c in enumerate(coeffs):
+        if k > 0:
+            fact *= k
+        out += c * dt**k / fact
+    return out
+
+
+def ell1_delay(dt, nb_orbits, pars):
+    """ELL1 Roemer(+inverse timing)+Shapiro; dt = t - TASC seconds."""
+    phi = 2 * pi * nb_orbits
+    a1 = pars["A1"] + pars.get("A1DOT", mpf(0)) * dt
+    eps1 = pars["EPS1"] + pars.get("EPS1DOT", mpf(0)) * dt
+    eps2 = pars["EPS2"] + pars.get("EPS2DOT", mpf(0)) * dt
+    s, c = sin(phi), cos(phi)
+    s2, c2 = sin(2 * phi), cos(2 * phi)
+    dre = a1 * (s + (eps2 * s2 - eps1 * c2) / 2)
+    drep = a1 * (c + eps2 * c2 + eps1 * s2)
+    drepp = a1 * (-s + 2 * (eps1 * c2 - eps2 * s2))
+    nb = pars["NB"]
+    d = dre * (1 - nb * drep + (nb * drep) ** 2
+               + nb * nb * dre * drepp / 2)
+    if "M2" in pars and "SINI" in pars:
+        arg = 1 - pars["SINI"] * s
+        d += -2 * mpf(TSUN) * pars["M2"] * log(arg)
+    return d
+
+
+def dd_delay(dt, orbits_frac, pars):
+    """Damour-Deruelle delay (Roemer+Einstein with inverse-timing
+    expansion + Shapiro), mirroring the published DD model."""
+    e = pars["ECC"] + pars.get("EDOT", mpf(0)) * dt
+    a1 = pars["A1"] + pars.get("A1DOT", mpf(0)) * dt
+    M = 2 * pi * orbits_frac
+    E = M + e * sin(M)
+    for _ in range(60):
+        dE = (E - e * sin(E) - M) / (1 - e * cos(E))
+        E = E - dE
+        if abs(dE) < mpf("1e-35"):
+            break
+    # true anomaly on the same branch as E (in (-pi, pi]); periastron
+    # advance uses the CUMULATIVE anomaly nu + 2*pi*norbits (DD eq. 16)
+    Ae = 2 * atan2(sqrt(1 + e) * sin(E / 2), sqrt(1 - e) * cos(E / 2))
+    omega = (pars["OM"] + pars["K"] * (Ae + 2 * pi * pars["NORB"]))
+    dr = pars.get("DR", mpf(0))
+    dth = pars.get("DTH", mpf(0))
+    er, eth = e * (1 + dr), e * (1 + dth)
+    gamma = pars.get("GAMMA", mpf(0))
+    so, co = sin(omega), cos(omega)
+    alpha = a1 * so
+    beta = a1 * sqrt(1 - eth**2) * co
+    dre = alpha * (cos(E) - er) + (beta + gamma) * sin(E)
+    drep = -alpha * sin(E) + (beta + gamma) * cos(E)
+    drepp = -alpha * cos(E) - (beta + gamma) * sin(E)
+    nb = pars["NB"]
+    # Damour & Deruelle inverse-timing expansion (DD eq. 46-52)
+    onemecu = 1 - e * cos(E)
+    nhat = nb / onemecu
+    d = dre * (
+        1 - nhat * drep + (nhat * drep) ** 2
+        + nhat * nhat * dre * drepp / 2
+        - nhat * nhat * e * sin(E) / onemecu * dre * drep / 2
+    )
+    if "M2" in pars and "SINI" in pars:
+        m2r = mpf(TSUN) * pars["M2"]
+        sini = pars["SINI"]
+        # Shapiro brace uses the BARE eccentricity (DD eq. 26)
+        arg = (onemecu
+               - sini * (so * (cos(E) - e)
+                         + sqrt(1 - e**2) * co * sin(E)))
+        d += -2 * m2r * log(arg)
+    # aberration terms (A0/B0)
+    a0, b0 = pars.get("A0", mpf(0)), pars.get("B0", mpf(0))
+    if a0 or b0:
+        d += a0 * (sin(omega + Ae) + e * so) \
+            + b0 * (cos(omega + Ae) + e * co)
+    return d
+
+
+# ========================= the pipeline =================================
+class OraclePulsar:
+    """mpmath end-to-end residuals for one par/tim dataset."""
+
+    def __init__(self, par_path, tim_path):
+        self.par = parse_par(par_path)
+        self.toas = parse_tim(tim_path)
+        from pint_tpu.observatory import get_observatory
+
+        self.itrf = {}
+        for t in self.toas:
+            code = t["obs"]
+            if code not in self.itrf:
+                loc = get_observatory(code).earth_location_itrf()
+                self.itrf[code] = (
+                    np.array([mpf(0)] * 3) if loc is None
+                    # mpf(float) is exact: the framework's f64 ITRF IS
+                    # the datum
+                    else np.array([mpf(float(v)) for v in loc])
+                )
+
+    def _p(self, key, default=None):
+        v = par_val(self.par, key, default)
+        return None if v is None else mpf(v)
+
+    def _epoch(self, key):
+        """Par epoch (TDB) -> (day, sec)."""
+        s = par_val(self.par, key)
+        day_s, _, frac_s = s.partition(".")
+        return int(day_s), mpf("0." + (frac_s or "0")) * SPD
+
+    def residuals(self):
+        """Weighted-mean-subtracted time residuals (seconds, f64)."""
+        raw, freqs, errs = [], [], []
+        for t in self.toas:
+            raw.append(self._one_residual_raw(t))
+        raw = np.array(raw)
+        # weighted mean with EFAC/EQUAD-scaled errors
+        w = np.array([self._weight(t) for t in self.toas])
+        mean = (w * raw).sum() / w.sum()
+        return np.array([float(r - mean) for r in raw])
+
+    def _weight(self, toa):
+        sig = toa["err_us"] * mpf("1e-6")
+        # tempo2 convention: EFAC * sqrt(sig^2 + EQUAD^2)
+        for key in ("EQUAD", "T2EQUAD"):
+            for args in self.par.get(key, []):
+                if self._mask_match(toa, args):
+                    sig = sqrt(sig**2 + (mpf(args[-1]) * mpf("1e-6"))**2)
+        for key in ("EFAC", "T2EFAC"):
+            for args in self.par.get(key, []):
+                if self._mask_match(toa, args):
+                    sig = mpf(args[-1]) * sig
+        return 1 / sig**2
+
+    @staticmethod
+    def _mask_match(toa, args):
+        """maskParameter selection: '-f L-wide <val>' style."""
+        if args[0].startswith("-"):
+            flag, val = args[0][1:], args[1]
+            return toa["flags"].get(flag) == val
+        return True  # bare value: applies to all
+
+    def _one_residual_raw(self, toa):
+        # -- clock chain: no site clock data -> 0; UTC -> TT -----------
+        day_utc, sec_utc = toa["day"], toa["frac"] * SPD
+        day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
+        T_tt = tt_centuries(day_tt, sec_tt)
+
+        # -- observatory GCRS (EOP-free; UT1 = UTC) --------------------
+        M = itrf_to_gcrs_matrix(day_utc, sec_utc, T_tt)
+        itrf = self.itrf[toa["obs"]]
+        obs_pos = M @ itrf  # meters
+        omega = np.array([mpf(0), mpf(0), OMEGA_EARTH])
+        obs_vel = M @ np.cross(omega, itrf)
+
+        # -- TT -> TDB: geocentric series + topocentric term -----------
+        day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
+        T1 = tt_centuries(day_tdb, sec_tdb)
+        _, evel_km = posvel(earth_ssb_eq_km, T1)
+        topo = (evel_km * 1000) @ obs_pos / mpf(C) ** 2
+        day_tdb, sec_tdb = norm_day_sec(day_tdb, sec_tdb + topo)
+
+        # -- SSB geometry ----------------------------------------------
+        T2 = tt_centuries(day_tdb, sec_tdb)
+        epos_km, evel_km = posvel(earth_ssb_eq_km, T2)
+        ssb_obs_m = epos_km * 1000 + obs_pos
+        sun_m = sun_ssb_eq_km(T2) * 1000 - ssb_obs_m
+        r_ls = ssb_obs_m / mpf(C)
+        sun_ls = sun_m / mpf(C)
+
+        # -- astrometry: Roemer + parallax ------------------------------
+        ra = parse_hms(par_val(self.par, "RAJ"))
+        dec = parse_dms(par_val(self.par, "DECJ"))
+        if "POSEPOCH" in self.par:
+            pe_day, pe_sec = self._epoch("POSEPOCH")
+            dt_pos = (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec)
+        else:
+            dt_pos = mpf(0)  # first-TOA fallback handled below
+        pmra = (self._p("PMRA") * mpf(MAS_TO_RAD)
+                / mpf(SECS_PER_JULIAN_YEAR)
+                if "PMRA" in self.par else mpf(0))
+        pmdec = (self._p("PMDEC") * mpf(MAS_TO_RAD)
+                 / mpf(SECS_PER_JULIAN_YEAR)
+                 if "PMDEC" in self.par else mpf(0))
+        if (pmra or pmdec) and "POSEPOCH" not in self.par:
+            raise ValueError("oracle needs POSEPOCH when PM is set")
+        # framework convention: dec(t) = dec0 + pmdec*dt;
+        # ra(t) = ra0 + pmra*dt/cos(dec0)  [PMRA = mu_alpha cos(dec)]
+        ra_t = ra + pmra * dt_pos / cos(dec)
+        dec_t = dec + pmdec * dt_pos
+        n = np.array([
+            cos(dec_t) * cos(ra_t), cos(dec_t) * sin(ra_t), sin(dec_t)
+        ])
+        rn = r_ls @ n
+        delay = -rn
+        if "PX" in self.par:
+            px = self._p("PX") * mpf(MAS_TO_RAD)
+            delay += px / (2 * mpf(AU_LIGHT_SEC)) * (r_ls @ r_ls - rn**2)
+
+        # -- solar-system Shapiro (Sun) ---------------------------------
+        rs = sqrt(sun_ls @ sun_ls)
+        rsn = sun_ls @ n
+        delay += -(2 * mpf(GM_SUN) / mpf(C) ** 3) * log(
+            (rs - rsn) / mpf(AU_LIGHT_SEC)
+        )
+
+        # -- dispersion -------------------------------------------------
+        dm = self._p("DM", mpf(0))
+        if "DMEPOCH" in self.par:
+            de_day, de_sec = self._epoch("DMEPOCH")
+            dt_dm = (day_tdb - de_day) * SPD + (sec_tdb - de_sec)
+            k = 1
+            fact = mpf(1)
+            while f"DM{k}" in self.par:
+                fact *= k
+                dm += (self._p(f"DM{k}")
+                       / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt_dm**k / fact
+                k += 1
+        # DMX piecewise offsets
+        mjd_f = mpf(day_tdb) + sec_tdb / SPD
+        for key in self.par:
+            if key.startswith("DMX_"):
+                idx = key[4:]
+                r1v = mpf(par_val(self.par, f"DMXR1_{idx}"))
+                r2v = mpf(par_val(self.par, f"DMXR2_{idx}"))
+                if r1v <= mjd_f <= r2v:
+                    dm += mpf(par_val(self.par, key))
+        delay += mpf(DM_CONST) * dm / toa["freq"] ** 2
+
+        # -- binary -----------------------------------------------------
+        model = par_val(self.par, "BINARY")
+        if model in ("ELL1",):
+            tasc_day, tasc_sec = self._epoch("TASC")
+            dt_b = (day_tdb - tasc_day) * SPD + (sec_tdb - tasc_sec) \
+                - delay
+            pb = self._p("PB") * SPD
+            pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
+            nbdt = dt_b / pb
+            orbits = nbdt - (nbdt**2) * pbdot / 2
+            norb = floor(orbits + mpf("0.5"))
+            frac = orbits - norb  # in [-0.5, 0.5)
+            nb = 2 * pi / pb * (1 - pbdot * nbdt)
+            pars = {
+                "A1": self._p("A1"), "EPS1": self._p("EPS1"),
+                "EPS2": self._p("EPS2"), "NB": nb,
+            }
+            for k_, pk in (("A1DOT", "A1DOT"), ("EPS1DOT", "EPS1DOT"),
+                           ("EPS2DOT", "EPS2DOT")):
+                if k_ in self.par:
+                    pars[pk] = self._p(k_)
+            if "M2" in self.par and "SINI" in self.par:
+                pars["M2"] = self._p("M2")
+                pars["SINI"] = self._p("SINI")
+            delay += ell1_delay(dt_b, frac, pars)
+        elif model in ("DD",):
+            t0_day, t0_sec = self._epoch("T0")
+            dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
+            pb = self._p("PB") * SPD
+            pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
+            nbdt = dt_b / pb
+            orbits = nbdt - (nbdt**2) * pbdot / 2
+            norb = floor(orbits + mpf("0.5"))
+            frac = orbits - norb
+            nb = 2 * pi / pb * (1 - pbdot * nbdt)
+            nb0 = 2 * pi / pb
+            omdot = (self._p("OMDOT", mpf(0)) or mpf(0)) * DEG \
+                / mpf(SECS_PER_JULIAN_YEAR)  # deg/yr -> rad/s
+            pars = {
+                "A1": self._p("A1"), "ECC": self._p("ECC"),
+                "OM": (self._p("OM") or mpf(0)) * DEG,
+                "K": omdot / nb0, "NB": nb, "NORB": norb,
+            }
+            for k_ in ("EDOT", "A1DOT", "GAMMA", "DR", "DTH",
+                       "M2", "SINI"):
+                if k_ in self.par:
+                    pars[k_] = self._p(k_)
+            delay += dd_delay(dt_b, frac, pars)
+        elif model:
+            raise NotImplementedError(f"oracle binary {model}")
+
+        # -- spindown phase --------------------------------------------
+        pe_day, pe_sec = self._epoch("PEPOCH")
+        dt = (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec) - delay
+        coeffs = [self._p("F0")]
+        k = 1
+        while f"F{k}" in self.par:
+            coeffs.append(self._p(f"F{k}"))
+            k += 1
+        phase = taylor_phase(dt, coeffs)
+        # JUMP (PhaseJump convention): J seconds = -J*F0 cycles, F0 in
+        # f64 as the framework's kernel consumes it
+        for args in self.par.get("JUMP", []):
+            if args[0].startswith("-") and self._mask_match(toa, args):
+                phase += -mpf(args[2]) * mpf(float(coeffs[0]))
+        frac = phase - floor(phase + mpf("0.5"))
+        f_inst = taylor_freq(
+            (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec), coeffs
+        )
+        return frac / f_inst

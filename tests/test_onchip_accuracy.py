@@ -1,0 +1,114 @@
+"""On-TPU end-to-end fit accuracy (the axon-f64 pathology net).
+
+CPU tests cannot catch accelerator-precision failures: axon's emulated
+f64 keeps only the f32 exponent range (overflow at ~3.4e38 — the
+1e-40-weight degenerate-basis NaN this suite exists to catch) and is
+non-IEEE (~1e-15 rel error per op).  This file runs ONLY when the jax
+backend is a real accelerator:
+
+    PINT_TPU_TEST_BACKEND=tpu python -m pytest tests/test_onchip_accuracy.py -q
+
+and is part of the round workflow via profiling/run_tpu_accuracy.py,
+which records the result in STATUS.md (VERDICT r1 item 8).
+
+Accuracy contract verified here (docs/precision.md):
+- residuals within 0.5 us of the CPU IEEE-f64 oracle (DD compensation
+  degrades to ~1e-7 s deterministic noise on emulated f64);
+- GLS/WLS fitted parameters within 0.2 sigma of the CPU oracle.  The
+  solver's own mixed-precision contract is ~2e-4 sigma, but on-chip
+  the RESIDUALS differ from CPU by the ~1e-7 s emulated-f64 noise
+  floor, which propagates to ~0.05-0.1 sigma on parameters with long
+  lever arms (PM/PX); 0.2 sigma bounds that while still catching any
+  real solve failure (a NaN, a wrong mode, a dropped column).
+"""
+
+import warnings
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+DATADIR = Path(__file__).parent / "datafile"
+
+pytestmark = [
+    pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="on-chip accuracy suite needs a real accelerator "
+        "(PINT_TPU_TEST_BACKEND=tpu)",
+    ),
+    pytest.mark.filterwarnings("ignore"),
+]
+
+
+def _load(stem):
+    from pint_tpu.models.builder import get_model_and_toas
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = get_model_and_toas(
+            str(DATADIR / f"{stem}.par"), str(DATADIR / f"{stem}.tim")
+        )
+    return model, toas, np.load(DATADIR / f"{stem}_oracle.npz")
+
+
+@pytest.mark.parametrize("stem", ["golden1", "golden2"])
+def test_onchip_residuals_vs_cpu_oracle(stem):
+    model, toas, oracle = _load(stem)
+    cm = model.compile(toas)
+    r = np.asarray(cm.time_residuals(cm.x0()))
+    d = r - oracle["resid"]
+    assert np.sqrt(np.mean(d**2)) < 5e-7, (
+        f"on-chip residuals {1e9*np.sqrt(np.mean(d**2)):.1f} ns RMS "
+        "from CPU oracle"
+    )
+
+
+@pytest.mark.parametrize("stem", ["golden1", "golden2"])
+def test_onchip_gls_fit_vs_cpu_oracle(stem):
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.models.builder import get_model
+
+    model, toas, oracle = _load(stem)
+    f = GLSFitter(toas, get_model(str(DATADIR / f"{stem}.par")))
+    chi2 = f.fit_toas(maxiter=3)
+    assert np.isfinite(chi2)
+    for n, v, u in zip(oracle["names"], oracle["values"], oracle["uncs"]):
+        p = f.model.params[str(n)]
+        pv = p.value
+        pv = float(pv.to_float()) if hasattr(pv, "to_float") else float(pv)
+        assert abs(pv - v) < 0.2 * u + 1e-12, (
+            f"{n}: on-chip {pv} vs oracle {v} ({abs(pv-v)/u:.3f} sigma)"
+        )
+
+
+def test_onchip_wls_fit():
+    # A clean well-conditioned pulsar: the golden sets either carry
+    # correlated noise (WLS refuses, correctly) or deliberately
+    # near-degenerate DM/DMX directions where the on-chip 'gram'
+    # degeneracy cut returns a different min-norm answer than CPU
+    # 'svd' (documented, docs/precision.md) — that behavior is tested
+    # elsewhere; here we prove the on-chip WLS solve recovers truth.
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = """
+PSR   ONCHIP
+F0    339.31568728824463  1
+F1    -1.6148e-13         1
+PEPOCH 55555
+DM    12.345              1
+"""
+    F0_TRUE = 339.31568728824463
+    model, toas = make_test_pulsar(
+        par, ntoa=800, start_mjd=55000.0, end_mjd=56000.0, seed=11
+    )
+    model.F0.value = F0_TRUE + 1e-9  # perturb; fit must pull it back
+    f = WLSFitter(toas, model)
+    chi2 = f.fit_toas()
+    assert np.isfinite(chi2)
+    assert chi2 / f.resids.dof < 2.0
+    dF0 = abs(float(f.model.F0.value) - F0_TRUE)
+    assert dF0 < 5.0 * float(f.model.F0.uncertainty) + 1e-12
+    dDM = abs(float(f.model.DM.value) - 12.345)
+    assert dDM < 5.0 * float(f.model.DM.uncertainty) + 1e-12
