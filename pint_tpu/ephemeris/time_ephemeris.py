@@ -71,33 +71,51 @@ _NAIF = {
 _builtin_fallback = None
 
 
-def _posvel(ephem, body: str, et):
-    """ssb_posvel accepting name-keyed bodies on both ephemeris kinds;
-    bodies absent from a partial SPK kernel fall back to the builtin
-    analytic theory (a planet's potential term needs only ~1e-6
-    fractional accuracy, far below Kepler-element error)."""
+def _builtin():
     global _builtin_fallback
+    if _builtin_fallback is None:
+        from pint_tpu.ephemeris.builtin import BuiltinEphemeris
+
+        _builtin_fallback = BuiltinEphemeris()
+    return _builtin_fallback
+
+
+def _posvel(ephem, body: str, et):
+    """ssb_posvel accepting name-keyed bodies on both ephemeris kinds.
+
+    A PERTURBING body absent from a partial SPK kernel falls back to
+    the builtin analytic theory — its potential term needs only ~1e-4
+    fractional accuracy.  'earth' and 'sun' get NO fallback: they set
+    the dominant v^2/2 and GM_sun/r terms, and silently substituting
+    the builtin there would defeat the point of supplying a DE kernel
+    (the KeyError propagates instead)."""
     try:
+        # name-keyed (BuiltinEphemeris); SPK raises KeyError ("no
+        # segment path") on a string target, TypeError on odd inputs
         return ephem.ssb_posvel(body, et)
-    except (KeyError, TypeError, AttributeError):
-        pass
+    except (KeyError, TypeError):
+        pass  # retry with the NAIF id
     try:
         return ephem.ssb_posvel(_NAIF[body], et)
     except KeyError:
-        from pint_tpu.ephemeris.builtin import BuiltinEphemeris
-
-        if _builtin_fallback is None:
-            _builtin_fallback = BuiltinEphemeris()
-        return _builtin_fallback.ssb_posvel(body, et)
+        if body in ("earth", "sun"):
+            raise
+        return _builtin().ssb_posvel(body, et)
 
 
 def _pos(ephem, body: str, et):
     """Position-only when the ephemeris offers it (skips the builtin's
-    central-difference velocity — 3x fewer theory evaluations)."""
+    central-difference velocity — 3x fewer theory evaluations); same
+    fallback policy as _posvel."""
     fn = getattr(ephem, "ssb_pos", None)
     if fn is not None:
         return fn(body, et)
-    return _posvel(ephem, body, et)[0]
+    try:
+        return ephem.ssb_posvel(_NAIF[body], et)[0]
+    except KeyError:
+        if body in ("earth", "sun"):
+            raise
+        return _builtin().ssb_pos(body, et)
 
 
 def tdb_rate(ephem, et):

@@ -131,7 +131,7 @@ def matmul_split32(A, B, chunk: int = 128):
     return make_matmul_split32(A, chunk)(B)
 
 
-def chol_solve_ir(A, B, refine: int = 2):
+def chol_solve_ir(A, B, refine: int = 2, cholesky=None):
     """Solve SPD A X = B (f64) with an f32 Cholesky + f64 iterative
     refinement.  Jacobi equilibration first: power-law red-noise
     Woodbury matrices have ~1e10 dynamic range on the diagonal, beyond
@@ -142,12 +142,18 @@ def chol_solve_ir(A, B, refine: int = 2):
     the split-f32 matmul's ~3e-8 class for large ones (where an
     emulated-f64 dense matmul would dominate the dense-covariance
     solve on TPU).
+
+    `cholesky` swaps the factorization (default jnp.linalg.cholesky;
+    parallel/dense.py passes its mesh-sharded blocked variant) — ONE
+    copy of the equilibration+IR recipe serves both.
     """
+    if cholesky is None:
+        cholesky = jnp.linalg.cholesky
     d = jnp.sqrt(jnp.diagonal(A))
     dinv = 1.0 / d
     Aeq = A * jnp.outer(dinv, dinv)
     Beq = B * dinv[:, None]
-    L32 = jnp.linalg.cholesky(Aeq.astype(jnp.float32))
+    L32 = cholesky(Aeq.astype(jnp.float32))
 
     def solve32(R):
         Y = jax.scipy.linalg.solve_triangular(

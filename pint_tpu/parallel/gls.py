@@ -86,6 +86,50 @@ def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     return _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov)
 
 
+def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
+                           normalized_cov=False):
+    """The PRODUCTION accelerator path (mixed precision, f32 MXU
+    Grams with f64 accumulation — fitting/gls.py::
+    gls_step_woodbury_mixed) with the TOA axis sharded over `axis`.
+
+    The chunked f32 Grams decompose over TOA shards exactly like the
+    f64 ones: each device runs gram32_joint on its shard and the psum
+    of the small (k+p+1)^2 blocks makes them global — identical
+    collective pattern and O(k^2) bytes per step as sharded_gls_step,
+    same precision contract as the single-device mixed path
+    (_woodbury_mixed_tail; chunk-level f64 accumulation happens within
+    each shard, and the cross-shard psum is f64).
+    """
+    from jax import shard_map
+
+    from pint_tpu.fitting.gls import _column_norms
+    from pint_tpu.fitting.gls import _woodbury_mixed_tail
+    from pint_tpu.ops.ffgram import gram32_joint
+
+    norm = _column_norms(M)
+    Mn = M / norm[None, :]
+
+    def local_grams(r_s, Mn_s, Nd_s, T_s):
+        Ninv = 1.0 / Nd_s
+        X = jnp.concatenate([Mn_s, r_s[:, None]], axis=1)
+        sig_tt, twx, G_XX = gram32_joint(
+            T_s.astype(jnp.float32), X, Ninv
+        )
+        return jax.tree_util.tree_map(
+            lambda b: jax.lax.psum(b, axis), (sig_tt, twx, G_XX)
+        )
+
+    sm = shard_map(
+        local_grams,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis, None), P(axis), P(axis, None)),
+        out_specs=(P(), P(), P()),
+    )
+    sig_tt, twx, G_XX = sm(r, Mn, Ndiag, T)
+    return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
+                                normalized_cov)
+
+
 def place_gls_operands(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):
     """Device-put the operands with the sharding sharded_gls_step
     expects (TOA axis across `axis`, phi replicated)."""

@@ -84,3 +84,94 @@ def test_sharded_normalized_cov_matches(operands):
     host_cov = np.asarray(covn) / np.outer(np.asarray(norm), np.asarray(norm))
     np.testing.assert_allclose(host_cov, np.asarray(cov), rtol=1e-10)
     assert float(chi2n) == pytest.approx(float(chi2), rel=1e-12)
+
+
+def test_sharded_mixed_matches_unsharded_mixed(operands):
+    """The sharded PRODUCTION (mixed-precision) path vs the
+    single-device mixed path: the chunked f32 Grams decompose over
+    shards, so agreement is tight (same arithmetic, different chunk
+    boundaries -> ~1e-12 of the Gram scale, far inside the mixed
+    contract of ~2e-3)."""
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+    from pint_tpu.parallel.gls import sharded_gls_step_mixed
+
+    r, M, Nd, T, phi = operands
+    dx0, cov0, chi0, nb0 = jax.jit(gls_step_woodbury_mixed)(
+        r, M, Nd, T, phi
+    )
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_gls_operands(mesh, r, M, Nd, T, phi)
+    dx1, cov1, chi1, nb1 = jax.jit(
+        lambda *a: sharded_gls_step_mixed(mesh, *a)
+    )(*args)
+    scale = np.max(np.abs(np.asarray(dx0)))
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=2e-3, atol=2e-6 * scale
+    )
+    assert float(chi1) == pytest.approx(float(chi0), rel=1e-6)
+    # and the f64 reference agrees with both to the documented class
+    dxf, _, chif, _ = jax.jit(gls_step_woodbury)(r, M, Nd, T, phi)
+    assert float(chi1) == pytest.approx(float(chif), rel=1e-3)
+
+
+def test_blocked_cholesky_matches_lapack():
+    from pint_tpu.parallel.dense import blocked_cholesky
+
+    rng = np.random.default_rng(3)
+    n, b = 256, 32
+    A = rng.normal(size=(n, n))
+    C = A @ A.T + n * np.eye(n)
+    L0 = np.linalg.cholesky(C)
+    mesh = make_mesh(n_pulsar_shards=1)
+    L1 = np.asarray(jax.jit(
+        lambda c: blocked_cholesky(c, block=b, mesh=mesh)
+    )(jnp.asarray(C)))
+    np.testing.assert_allclose(L1, L0, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("method", ["f64", "mixed"])
+def test_sharded_full_cov_matches_single_device(operands, method):
+    """Sharded dense-covariance step vs fitting/gls.py's single-device
+    gls_step_full_cov: exact for f64, mixed-contract for mixed."""
+    from pint_tpu.fitting.gls import gls_step_full_cov
+    from pint_tpu.parallel.dense import sharded_gls_step_full_cov
+
+    r, M, Nd, T, phi = operands
+    n = r.shape[0]
+    dx0, cov0, chi0, nb0 = jax.jit(
+        lambda *a: gls_step_full_cov(*a, method=method)
+    )(r, M, Nd, T, phi)
+    mesh = make_mesh(n_pulsar_shards=1)
+    dx1, cov1, chi1, nb1 = jax.jit(
+        lambda *a: sharded_gls_step_full_cov(
+            mesh, *a, method=method, block=n // 8
+        )
+    )(r, M, Nd, T, phi)
+    tol = 1e-9 if method == "f64" else 2e-3
+    scale = np.max(np.abs(np.asarray(dx0)))
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=tol, atol=tol * scale
+    )
+    assert float(chi1) == pytest.approx(
+        float(chi0), rel=1e-8 if method == "f64" else 1e-4
+    )
+
+
+def test_sharded_full_cov_matches_woodbury(operands):
+    """Dense (sharded, f64) and reduced-rank Woodbury agree — the two
+    factorizations of the same C."""
+    from pint_tpu.parallel.dense import sharded_gls_step_full_cov
+
+    r, M, Nd, T, phi = operands
+    n = r.shape[0]
+    dx0, _, chi0, _ = jax.jit(gls_step_woodbury)(r, M, Nd, T, phi)
+    mesh = make_mesh(n_pulsar_shards=1)
+    dx1, _, chi1, _ = jax.jit(
+        lambda *a: sharded_gls_step_full_cov(
+            mesh, *a, method="f64", block=n // 8
+        )
+    )(r, M, Nd, T, phi)
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=1e-8, atol=1e-24
+    )
+    assert float(chi1) == pytest.approx(float(chi0), rel=1e-8)
