@@ -108,8 +108,16 @@ class Parameter:
 
     # -- par-file IO -----------------------------------------------------
     def set_from_tokens(self, tokens: list[str]):
-        """tokens: [value] or [value fit] or [value fit unc]."""
+        """tokens: [value] or [value fit] or [value fit unc].
+
+        Tempo convention (matching the reference's par reading): a
+        parameter READ FROM A PAR FILE is frozen unless its fit flag
+        is '1' — component-constructor frozen defaults only apply to
+        programmatically built models.  (Caught by an event_optimize
+        run where a flagless 'DM' line was sampled with zero gradient
+        at infinite photon frequency and walked to 1e34.)"""
         self.value = self._parse_value_str(tokens[0])
+        self.frozen = True
         if len(tokens) >= 2:
             # fit flags are exactly '0'/'1' (tempo convention); any other
             # numeric second token is a tempo2-style bare uncertainty

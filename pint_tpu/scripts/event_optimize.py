@@ -41,8 +41,18 @@ def main(argv=None):
     )
     ap.add_argument("eventfile")
     ap.add_argument("parfile")
-    ap.add_argument("gaussianfile",
-                    help="template: 'weight:width:loc' peaks, one per line")
+    ap.add_argument(
+        "gaussianfile",
+        help="template: a .gauss component file (itemplate "
+        "convention), a binned .prof profile, or the plain "
+        "'weight:width:loc' one-peak-per-line format",
+    )
+    ap.add_argument(
+        "--fit-template", action="store_true",
+        help="ML-refit the template to the starting phases (with "
+        "Hessian errors) before sampling, and write it back out as "
+        "<outfile>.gauss when it is a Gaussian template",
+    )
     ap.add_argument("--mission", default="generic")
     ap.add_argument("--weightcol", default=None)
     ap.add_argument("--nwalkers", type=int, default=32)
@@ -57,7 +67,7 @@ def main(argv=None):
     from pint_tpu.event_toas import get_event_weights, load_event_TOAs
     from pint_tpu.models.builder import get_model
     from pint_tpu.sampler import run_ensemble
-    from pint_tpu.templates import LCGaussian, LCTemplate
+    from pint_tpu.templates import LCGaussian
     from pint_tpu.toas.ingest import ingest_for_model
 
     model = get_model(args.parfile)
@@ -70,17 +80,22 @@ def main(argv=None):
         "loaded %d photons; free params %s", len(toas), cm.free_names
     )
 
-    prims, wts = [], []
-    with open(args.gaussianfile) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            wt, width, loc = (float(v) for v in line.split(":"))
-            prims.append(LCGaussian(width=width, loc=loc))
-            wts.append(wt)
-    template = LCTemplate(prims, weights=wts)
+    from pint_tpu.templates import read_template
+
+    template, _errs = read_template(args.gaussianfile)
     weights = get_event_weights(toas)
+
+    if args.fit_template:
+        from pint_tpu.templates import LCFitter, write_gauss
+
+        phases = np.asarray(cm.phase(cm.x0()).frac) % 1.0
+        lcf = LCFitter(template, phases, weights=weights)
+        ll = lcf.fit()
+        errs = lcf.errors()
+        log.info("template refit: loglike %.2f", ll)
+        if all(isinstance(p, LCGaussian) for p in template.primitives):
+            write_gauss(template, args.outfile + ".gauss", errors=errs)
+            log.info("wrote %s.gauss", args.outfile)
 
     lnpost = build_lnpost(cm, template, weights)
     # seed the walker ball at the scale where each parameter shifts the

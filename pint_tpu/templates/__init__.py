@@ -9,8 +9,18 @@ L-BFGS-B with jax gradients (host driver, device math).
 """
 
 from pint_tpu.templates.lcprimitives import (  # noqa: F401
+    LCBinnedProfile,
     LCGaussian,
+    LCGaussian2,
+    LCLorentzian,
     LCVonMises,
 )
 from pint_tpu.templates.lctemplate import LCTemplate  # noqa: F401
 from pint_tpu.templates.lcfitters import LCFitter  # noqa: F401
+from pint_tpu.templates.lcio import (  # noqa: F401
+    read_gauss,
+    read_prof,
+    read_template,
+    write_gauss,
+    write_prof,
+)

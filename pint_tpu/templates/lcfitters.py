@@ -45,7 +45,7 @@ class LCFitter:
 
         bounds = [(1e-6, 1.0)] * n
         for p in self.template.primitives:
-            bounds += [(1e-4, 0.5), (None, None)]
+            bounds += p.fit_bounds()
 
         res = minimize(
             lambda v: float(obj(jnp.asarray(v))),
@@ -58,9 +58,25 @@ class LCFitter:
         self.template.set_parameters(res.x)
         # wrap fitted locations into [0, 1)
         for p in self.template.primitives:
-            p.params[1] = p.params[1] % 1.0
+            p.params[-1] = p.params[-1] % 1.0
         self.result = res
         return -float(res.fun)
+
+    def errors(self):
+        """Parameter uncertainties from the observed information: the
+        jax Hessian of -loglikelihood at the fitted parameters,
+        pseudo-inverted (weight parameters pinned at a bound get a 0
+        eigenvalue rather than a spurious tiny error).  Reference:
+        LCFitter's hess_errors.  Stored on the template as
+        .param_errors (get_parameters() layout) and returned."""
+        v0 = jnp.asarray(self.template.get_parameters())
+        H = np.asarray(
+            jax.hessian(lambda v: -self.loglikelihood(params=v))(v0)
+        )
+        cov = np.linalg.pinv(H, rcond=1e-12)
+        err = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        self.template.param_errors = err
+        return err
 
     def __repr__(self):
         return f"LCFitter({self.template!r}, n={len(self.phases)})"

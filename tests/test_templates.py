@@ -1,0 +1,201 @@
+"""Templates subsystem: primitives, file IO, ML fit + Hessian errors.
+
+Reference parity: src/pint/templates/ (lcprimitives, lctemplate,
+lcfitters) and the .gauss/.prof template files the photon pipeline
+(event_optimize) exchanges with itemplate/tempo tooling.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.templates import (
+    LCBinnedProfile,
+    LCFitter,
+    LCGaussian,
+    LCGaussian2,
+    LCLorentzian,
+    LCTemplate,
+    LCVonMises,
+    read_gauss,
+    read_prof,
+    read_template,
+    write_gauss,
+    write_prof,
+)
+
+
+@pytest.mark.parametrize("prim", [
+    LCGaussian(width=0.05, loc=0.3),
+    LCVonMises(width=0.05, loc=0.3),
+    LCLorentzian(width=0.02, loc=0.7),
+    LCGaussian2(width=0.03, width2=0.08, loc=0.4),
+    LCBinnedProfile(np.exp(-0.5 * ((np.arange(64) / 64 - 0.5) / 0.1) ** 2)),
+])
+def test_primitive_normalization(prim):
+    """Every primitive is a density: integral over one cycle = 1."""
+    x = (np.arange(20000) + 0.5) / 20000
+    f = np.asarray(prim(x))
+    assert f.min() >= 0
+    assert np.trapezoid(np.r_[f, f[:1]], np.r_[x, 1.0 + x[:1]]) == (
+        pytest.approx(1.0, abs=2e-3)
+    )
+
+
+def test_gaussian2_asymmetry_and_continuity():
+    p = LCGaussian2(width=0.02, width2=0.08, loc=0.5)
+    x = np.linspace(0.3, 0.7, 4001)
+    f = np.asarray(p(x))
+    ipk = np.argmax(f)
+    assert x[ipk] == pytest.approx(0.5, abs=1e-3)
+    # trailing side is wider: density at loc+0.05 > density at loc-0.05
+    assert p(np.array([0.55]))[0] > p(np.array([0.45]))[0]
+    # continuous at the peak (no jump across dphi=0)
+    assert abs(f[ipk + 1] - f[ipk - 1]) < 0.1 * f[ipk]
+
+
+def test_gauss_file_roundtrip(tmp_path):
+    tmpl = LCTemplate(
+        [LCGaussian(width=0.04, loc=0.21), LCGaussian(width=0.1, loc=0.6)],
+        weights=[0.35, 0.25],
+    )
+    errs = np.abs(np.random.default_rng(0).normal(0.01, 0.002, 6))
+    path = tmp_path / "t.gauss"
+    write_gauss(tmpl, path, errors=errs)
+    back, errs2 = read_gauss(path)
+    np.testing.assert_allclose(
+        back.get_parameters(), tmpl.get_parameters(), atol=1e-6
+    )
+    np.testing.assert_allclose(errs2, errs, atol=1e-5)
+    # dispatch helper
+    t3, e3 = read_template(str(path))
+    np.testing.assert_allclose(
+        t3.get_parameters(), tmpl.get_parameters(), atol=1e-6
+    )
+
+
+def test_prof_file_roundtrip(tmp_path):
+    tmpl = LCTemplate([LCGaussian(width=0.05, loc=0.4)], weights=[0.8])
+    path = tmp_path / "t.prof"
+    write_prof(tmpl, path, nbins=128)
+    back = read_prof(path)
+    x = (np.arange(1024) + 0.5) / 1024
+    f0 = np.asarray(tmpl(x))
+    f1 = np.asarray(back(x))
+    # binned + background-split representation: few-% density agreement
+    assert np.max(np.abs(f1 - f0)) < 0.05 * f0.max()
+
+
+def test_fit_recovers_template_and_errors():
+    truth = LCTemplate(
+        [LCGaussian2(width=0.02, width2=0.05, loc=0.3)], weights=[0.6]
+    )
+    rng = np.random.default_rng(5)
+    phases = truth.random(4000, rng=rng)
+    start = LCTemplate(
+        [LCGaussian2(width=0.04, width2=0.04, loc=0.33)], weights=[0.4]
+    )
+    f = LCFitter(start, phases)
+    ll = f.fit()
+    assert np.isfinite(ll)
+    errs = f.errors()
+    assert errs.shape == start.get_parameters().shape
+    assert np.all(errs[:1] > 0) and np.all(np.isfinite(errs))
+    got = start.get_parameters()
+    want = truth.get_parameters()
+    # weight, widths, loc recovered within 5 sigma (or 0.02 absolute)
+    for g, w, e in zip(got, want, errs):
+        assert abs(g - w) < max(5 * e, 0.02), (g, w, e)
+    # loc error should be small and positive for a 4000-photon peak
+    assert 0 < errs[-1] < 0.01
+
+
+def test_lorentzian_fit():
+    truth = LCTemplate([LCLorentzian(width=0.01, loc=0.52)], weights=[0.5])
+    rng = np.random.default_rng(8)
+    phases = truth.random(3000, rng=rng)
+    start = LCTemplate([LCLorentzian(width=0.03, loc=0.5)], weights=[0.3])
+    f = LCFitter(start, phases)
+    f.fit()
+    got = start.get_parameters()
+    assert got[0] == pytest.approx(0.5, abs=0.08)   # weight
+    assert got[1] == pytest.approx(0.01, abs=0.01)  # width
+    assert got[2] == pytest.approx(0.52, abs=0.01)  # loc
+
+
+def test_binned_profile_shift_fit():
+    """A .prof template's only free shape parameter is the phase
+    shift: the fitter localizes it."""
+    base = LCTemplate([LCGaussian(width=0.04, loc=0.5)], weights=[0.7])
+    rng = np.random.default_rng(9)
+    phases = (base.random(3000, rng=rng) + 0.1) % 1.0  # shifted data
+    vals = np.asarray(base(np.linspace(0, 1, 128, endpoint=False)))
+    tmpl = LCTemplate([LCBinnedProfile(vals)], weights=[0.7])
+    f = LCFitter(tmpl, phases)
+    f.fit()
+    assert tmpl.primitives[0].params[1] % 1.0 == pytest.approx(
+        0.1, abs=0.02
+    )
+    assert tmpl.primitives[0].params[0] == 1.0  # pinned scale
+
+
+def test_event_optimize_fit_template_cli(tmp_path):
+    """The --fit-template CLI path: refit the template on the starting
+    phases, write <outfile>.gauss with Hessian errors, keep sampling.
+    (F0 recovery itself is covered by test_utils_cache_plots.)"""
+    from pint_tpu.event_toas import load_event_TOAs
+    from pint_tpu.io.fits import write_event_fits
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.scripts.event_optimize import main
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    PAR = "PSR T\nF0 245.4261196898081 1\nPEPOCH 55000\nDM 3.138\n"
+    rng = np.random.default_rng(6)
+    m_true = get_model(PAR)
+    met = np.sort(rng.uniform(0, 2000.0, 5000))
+    path = str(tmp_path / "ev.fits")
+    hdr = {"MJDREFI": 55000, "MJDREFF": 0.0, "TIMEZERO": 0.0,
+           "TIMESYS": "TDB"}
+    write_event_fits(path, {"TIME": met}, header_extra=hdr)
+    toas = load_event_TOAs(path)
+    ingest_barycentric(toas)
+    cm = m_true.compile(toas, subtract_mean=False)
+    ph = np.mod(np.asarray(cm.phase(cm.x0()).frac), 1.0)
+    keep = rng.uniform(size=len(ph)) < (
+        0.1 + np.exp(-0.5 * ((ph - 0.5) / 0.05) ** 2)
+    )
+    write_event_fits(path, {"TIME": met[keep]}, header_extra=hdr)
+    parfit = tmp_path / "fit.par"
+    parfit.write_text(PAR)
+    gauss = tmp_path / "t.gauss"
+    gauss.write_text(
+        "const = 0.5\nphas1 = 0.45\nfwhm1 = 0.16\nampl1 = 0.5\n"
+    )
+    out = str(tmp_path / "post.par")
+    assert main([
+        path, str(parfit), str(gauss), "--fit-template",
+        "--nsteps", "60", "--nwalkers", "12", "--outfile", out,
+        "--seed", "2", "--log-level", "ERROR",
+    ]) == 0
+    refit, errs = read_gauss(out + ".gauss")
+    assert errs is not None and np.all(np.isfinite(errs))
+    assert abs(refit.primitives[0].params[1] - 0.5) < 0.03
+    assert abs(refit.primitives[0].params[0] - 0.05) < 0.03
+
+
+def test_read_template_legacy_colon_format(tmp_path):
+    p = tmp_path / "legacy.txt"
+    p.write_text("# two peaks\n0.4:0.05:0.3\n0.2:0.02:0.7\n")
+    tmpl, errs = read_template(p)
+    assert errs is None
+    assert len(tmpl.primitives) == 2
+    np.testing.assert_allclose(tmpl.weights, [0.4, 0.2])
+    assert tmpl.primitives[1].params[1] == pytest.approx(0.7)
+
+
+def test_write_gauss_preserves_tiny_errors(tmp_path):
+    tmpl = LCTemplate([LCGaussian(width=0.04, loc=0.2)], weights=[0.5])
+    errs = np.array([0.01, 1e-3, 3e-7])  # tiny phase error
+    path = tmp_path / "tiny.gauss"
+    write_gauss(tmpl, path, errors=errs)
+    _, back = read_gauss(path)
+    assert back[-1] == pytest.approx(3e-7, rel=1e-3)  # not floored to 0

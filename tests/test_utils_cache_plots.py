@@ -187,8 +187,12 @@ def test_event_optimize_recovers_f0(tmp_path, capsys):
     par_fit.write_text(
         "PSR J1744-1134\nF0 245.42621968980 1\nPEPOCH 55000\nDM 3.138\n"
     )
+    # itemplate-convention .gauss file (templates/lcio.py):
+    # fwhm = width * 2 sqrt(2 ln 2) = 0.05 * 2.3548
     gauss = tmp_path / "template.gauss"
-    gauss.write_text("0.5:0.05:0.5\n")
+    gauss.write_text(
+        "const = 0.5\nphas1 = 0.5\nfwhm1 = 0.117741\nampl1 = 0.5\n"
+    )
     out = tmp_path / "post.par"
     assert main([
         path, str(par_fit), str(gauss), "--nsteps", "400",
