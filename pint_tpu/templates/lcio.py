@@ -87,11 +87,12 @@ def write_gauss(template: LCTemplate, path, errors=None):
         raise ValueError(".gauss files hold LCGaussian components only")
 
     def fmt(val, err):
+        # %.8f for values: a high-statistics phase fit localizes to
+        # few-1e-7, finer than %.6f quantization; %g for the error
+        # (%.6f would floor a few-1e-7 error to a claimed-exact 0)
         if err is None:
-            return f"{val:.6f}"
-        # %g for the error: %.6f would floor a few-1e-7 phase error
-        # from a high-statistics fit to a claimed-exact 0.000000
-        return f"{val:.6f} +/- {err:.4g}"
+            return f"{val:.8f}"
+        return f"{val:.8f} +/- {err:.4g}"
 
     e = None if errors is None else np.asarray(errors)
     lines = ["# pint_tpu template (itemplate .gauss convention)"]
@@ -114,13 +115,19 @@ def write_gauss(template: LCTemplate, path, errors=None):
 
 def read_prof(path):
     """Binned profile -> LCTemplate([LCBinnedProfile], [1 - const]);
-    const (unpulsed fraction) is estimated from the profile minimum."""
+    const (unpulsed fraction) is estimated from the profile minimum.
+    Baseline-subtracted profiles (values straddling zero) are handled
+    by splitting on the SHIFTED profile: the pulsed fraction is
+    pulsed_sum / (pulsed_sum + nbins * baseline-above-zero), never a
+    negative or blown-up weight."""
     raw = np.loadtxt(path)
     vals = raw[:, -1] if raw.ndim == 2 else raw
     base = float(vals.min())
     pulsed = vals - base
-    tot = float(vals.sum())
-    w = 1.0 if tot == 0 else float(pulsed.sum()) / tot
+    ps = float(pulsed.sum())
+    if ps <= 0:
+        raise ValueError(f"{path}: profile is constant (no pulse)")
+    w = ps / (ps + len(vals) * max(base, 0.0))
     return LCTemplate([LCBinnedProfile(pulsed + 1e-12)], weights=[w])
 
 
@@ -138,8 +145,6 @@ def read_template(path):
     'weight:width:loc' text -> Gaussian template; anything else ->
     binned .prof profile.  Returns (template, errors-or-None)."""
     path = str(path)
-    if path.endswith(".gauss"):
-        return read_gauss(path)
     first = ""
     with open(path) as f:
         for line in f:
@@ -147,6 +152,10 @@ def read_template(path):
             if line and not line.startswith("#"):
                 first = line
                 break
+    # content sniffing, not extension: 'const = ...' lines mean the
+    # itemplate convention whatever the file is called
+    if path.endswith(".gauss") or "=" in first:
+        return read_gauss(path)
     if ":" in first:
         prims, wts = [], []
         for line in open(path):
