@@ -26,8 +26,10 @@ class DesignMatrix:
                 f"{self.matrix.shape[1]} columns vs "
                 f"{len(self.params)} labels"
             )
-        self.quantity_blocks = quantity_blocks or [
-            ("toa", 0, self.matrix.shape[0])
+        blocks = quantity_blocks or [("toa", 0, self.matrix.shape[0])]
+        # normalized to tuples so equality checks are type-insensitive
+        self.quantity_blocks = [
+            (str(n), int(a), int(b)) for n, a, b in blocks
         ]
 
     @classmethod
@@ -53,18 +55,24 @@ class DesignMatrix:
         return self.matrix[:, self.params.index(param)]
 
     def block(self, quantity) -> np.ndarray:
-        for name, a, b in self.quantity_blocks:
-            if name == quantity:
-                return self.matrix[a:b]
-        raise KeyError(quantity)
+        """All rows labeled `quantity` (stacked when a combine placed
+        several same-named blocks)."""
+        parts = [
+            self.matrix[a:b] for name, a, b in self.quantity_blocks
+            if name == quantity
+        ]
+        if not parts:
+            raise KeyError(quantity)
+        return parts[0] if len(parts) == 1 else np.vstack(parts)
 
     @property
     def shape(self):
         return self.matrix.shape
 
-    def combine_by_param(self, other: "DesignMatrix") -> "DesignMatrix":
-        """Stack rows; shared params align, disjoint params zero-fill
-        (reference: combine_design_matrices_by_quantity)."""
+    def combine_by_quantity(self, other: "DesignMatrix") -> "DesignMatrix":
+        """Stack ROW blocks of different quantities (e.g. TOA rows over
+        DM rows); shared params align, disjoint params zero-fill
+        (reference: pint_matrix.combine_design_matrices_by_quantity)."""
         params = list(self.params) + [
             p for p in other.params if p not in self.params
         ]
@@ -78,6 +86,48 @@ class DesignMatrix:
             (name, a + n1, b + n1) for name, a, b in other.quantity_blocks
         ]
         return DesignMatrix(out, params, blocks)
+
+    def combine_by_param(self, other: "DesignMatrix") -> "DesignMatrix":
+        """Concatenate COLUMNS of additional parameters for the SAME
+        rows (reference: combine_design_matrices_by_param): row counts
+        and quantity blocks must match; duplicate params are an error.
+
+        NOTE: r1 briefly shipped ROW-stacking under this name; that
+        operation is combine_by_quantity (the reference's naming).
+        """
+        if self.matrix.shape[0] != other.matrix.shape[0]:
+            raise ValueError(
+                f"row mismatch: {self.matrix.shape[0]} vs "
+                f"{other.matrix.shape[0]}"
+            )
+        if self.quantity_blocks != other.quantity_blocks:
+            raise ValueError(
+                "quantity blocks differ: "
+                f"{self.quantity_blocks} vs {other.quantity_blocks}"
+            )
+        dup = set(self.params) & set(other.params)
+        if dup:
+            raise ValueError(f"duplicate params: {sorted(dup)}")
+        return DesignMatrix(
+            np.concatenate([self.matrix, other.matrix], axis=1),
+            self.params + other.params,
+            list(self.quantity_blocks),
+        )
+
+    def select_params(self, params) -> "DesignMatrix":
+        """Column submatrix in the given parameter order."""
+        idx = [self.params.index(p) for p in params]
+        return DesignMatrix(
+            self.matrix[:, idx], list(params), list(self.quantity_blocks)
+        )
+
+    def labels(self):
+        """((row labels), (column labels)) — the reference's
+        axis-label accessor shape."""
+        return (
+            tuple(self.quantity_blocks),
+            tuple(self.params),
+        )
 
     def __repr__(self):
         return (
@@ -110,3 +160,26 @@ class CovarianceMatrix:
         s = np.sqrt(np.diag(self.matrix))
         s = np.where(s == 0, 1.0, s)
         return self.matrix / np.outer(s, s)
+
+    def submatrix(self, params) -> "CovarianceMatrix":
+        """Parameter sub-block in the given order (reference:
+        pint_matrix get_label_matrix)."""
+        idx = [self.params.index(p) for p in params]
+        return CovarianceMatrix(
+            self.matrix[np.ix_(idx, idx)], list(params)
+        )
+
+    def combine_block_diag(self, other: "CovarianceMatrix"):
+        """Block-diagonal combination over DISJOINT parameter sets
+        (e.g. stacking per-pulsar covariances for PTA summaries)."""
+        dup = set(self.params) & set(other.params)
+        if dup:
+            raise ValueError(f"duplicate params: {sorted(dup)}")
+        p1, p2 = len(self.params), len(other.params)
+        out = np.zeros((p1 + p2, p1 + p2))
+        out[:p1, :p1] = self.matrix
+        out[p1:, p1:] = other.matrix
+        return CovarianceMatrix(out, self.params + other.params)
+
+    def __repr__(self):
+        return f"CovarianceMatrix({len(self.params)} params)"

@@ -1,0 +1,161 @@
+"""Per-component derivative-vs-finite-difference battery.
+
+The framework's design matrices are jacfwd of the phase kernel
+(CLAUDE.md invariant: never hand-written d_*_d_param).  This battery
+closes the r1 coverage gap (VERDICT weak-point 7): for each thin
+component family — chromatic, solar wind, wave, glitch, IFUNC, FD,
+troposphere, satellite-free topocentric astrometry — compare every
+free column of the design matrix against central finite differences of
+the residual vector (the reference's test_derivative_* pattern,
+src/pint/models tests)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_test_pulsar
+
+BASE = "PSR DERIV\nF0 312.25 1\nF1 -7e-16 1\nPEPOCH 55500\nDM 12.1 1\n"
+
+CONFIGS = {
+    "chromatic_cm": BASE + "CM 0.02 1\nCMIDX 4.1\n",
+    "wave": (
+        BASE + "WAVEEPOCH 55500\nWAVE_OM 0.006\n"
+        "WAVE1 1e-6 -2e-6\nWAVE2 3e-7 1e-7\n"
+    ),
+    "glitch": (
+        BASE + "GLEP_1 55480\nGLPH_1 0.01 1\nGLF0_1 1e-8 1\n"
+        "GLF1_1 -1e-16 1\nGLF0D_1 2e-8 1\nGLTD_1 40 1\n"
+    ),
+    "ifunc": (
+        BASE + "SIFUNC 2 0\nIFUNC1 55050 1e-6 1\n"
+        "IFUNC2 55500 -2e-6 1\nIFUNC3 55950 1e-6 1\n"
+    ),
+    "fd": BASE + "FD1 1e-5 1\nFD2 -3e-6 1\n",
+}
+
+_TOPO_BASE = (
+    "PSR DERIV\nRAJ 06:30:00 1\nDECJ 20:00:00 1\n"
+    "F0 312.25 1\nF1 -7e-16 1\nPEPOCH 55500\nDM 12.1 1\n"
+)
+TOPO_CONFIGS = {
+    "troposphere": _TOPO_BASE + "CORRECT_TROPOSPHERE Y\n",
+    # solar wind needs the astrometry direction + obs->Sun geometry
+    "solar_wind": _TOPO_BASE + "NE_SW 7.9 1\n",
+}
+
+
+def _fd_check(model, toas, rel=5e-5):
+    """Design columns vs central differences of time_residuals.  The
+    absolute floor is sized to the RESIDUAL scale (FD noise ~ eps *
+    |resid| / h), not to the derivative column — a genuinely-zero
+    column must not fail on jacfwd round-off."""
+    cm = model.compile(toas)
+    x0 = np.asarray(cm.x0())
+    M = np.asarray(cm.design_matrix(x0))
+
+    def resid(x):
+        return np.asarray(
+            cm.time_residuals(x, subtract_mean=False)
+        )
+
+    r_scale = max(np.max(np.abs(resid(x0))), 1e-9)
+    for j, name in enumerate(cm.free_names):
+        # parameter-scaled step: columns span ~30 orders of magnitude
+        col_norm = np.max(np.abs(M[:, j]))
+        h = 1e-7 / max(col_norm, 1e-12)
+        xp = x0.copy()
+        xp[j] += h
+        xm = x0.copy()
+        xm[j] -= h
+        fd = (resid(xp) - resid(xm)) / (2 * h)
+        scale = np.max(np.abs(fd))
+        err = np.max(np.abs(M[:, j] - fd))
+        assert err < rel * scale + 1e-13 * r_scale / h, (
+            f"{name}: jacfwd vs FD max err {err:.3e} "
+            f"(column scale {scale:.3e}, h {h:.3e})"
+        )
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_derivatives_vs_fd(name):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            CONFIGS[name], ntoa=80, start_mjd=55000.0, end_mjd=56000.0,
+            seed=13,
+        )
+        _fd_check(model, toas)
+
+
+@pytest.mark.parametrize("name", sorted(TOPO_CONFIGS))
+def test_derivatives_vs_fd_topocentric(name):
+    """Topocentric ingest (gbt): astrometry + troposphere columns."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            TOPO_CONFIGS[name], ntoa=60, start_mjd=55100.0,
+            end_mjd=55900.0, seed=14, obs="gbt",
+        )
+        _fd_check(model, toas)
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_component_fit_roundtrip(name):
+    """Perturb the component's free parameters by ~0.5 sigma-scale and
+    fit back: recovered within 5 sigma of truth (the cheap
+    make_test_pulsar round-trip the reference runs per component)."""
+    from pint_tpu.fitting import WLSFitter
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # 4 frequencies: chromatic components (CM nu^-4.1, FD log-nu
+        # polynomial) are exactly degenerate with DM at only 2
+        model, toas = make_test_pulsar(
+            CONFIGS[name], ntoa=120, start_mjd=55000.0,
+            end_mjd=56000.0, seed=15,
+            freqs=(1400.0, 800.0, 430.0, 2300.0),
+        )
+        truth = {
+            n: (
+                float(model.params[n].value.to_float())
+                if hasattr(model.params[n].value, "to_float")
+                else float(model.params[n].value)
+            )
+            for n in model.free_params
+        }
+        fit_model = get_model(CONFIGS[name])
+        # start OFF truth so convergence (not just the fixed point) is
+        # exercised.  Spin terms stay at truth (1e-3 of F0 is ~1e8
+        # sigma — outside any fitter's capture range); the component's
+        # own parameters get a 1% nudge, large vs their uncertainties
+        # but inside the phase-coherent linear regime.
+        for n in fit_model.free_params:
+            if n in ("F0", "F1", "F2"):
+                continue
+            # DM stays inside the phase-coherent capture range: 1% of
+            # DM 12 is ~0.3 cycles of chromatic phase at 700 MHz and
+            # re-numbers pulses; 0.1% (~0.03 cycles) does not
+            fac = 1.001 if n == "DM" else 1.01
+            p = fit_model.params[n]
+            v = p.value
+            v = (
+                float(v.to_float()) if hasattr(v, "to_float")
+                else float(v)
+            )
+            p.value = v * fac + (1e-8 if v == 0 else 0.0)
+        f = WLSFitter(toas, fit_model)
+        f.fit_toas(maxiter=4)
+        for n, tv in truth.items():
+            p = fit_model.params[n]
+            pv = p.value
+            pv = (
+                float(pv.to_float()) if hasattr(pv, "to_float")
+                else float(pv)
+            )
+            unc = p.uncertainty or 0.0
+            assert abs(pv - tv) < 5 * unc + abs(tv) * 1e-6 + 1e-12, (
+                f"{name}/{n}: {pv} vs {tv} (unc {unc})"
+            )

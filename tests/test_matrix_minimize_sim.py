@@ -54,18 +54,61 @@ def test_design_matrix_from_wideband_fitter():
     )
 
 
-def test_design_matrix_combine_by_param():
+def test_design_matrix_combine_by_quantity():
+    """Row-block stacking of different quantities (reference:
+    combine_design_matrices_by_quantity): shared params align,
+    disjoint params zero-fill."""
     from pint_tpu.matrix import DesignMatrix
 
     a = DesignMatrix(np.ones((3, 2)), ["F0", "DM"])
     b = DesignMatrix(2 * np.ones((2, 2)), ["DM", "PX"],
                      [("dm", 0, 2)])
-    c = a.combine_by_param(b)
+    c = a.combine_by_quantity(b)
     assert c.params == ["F0", "DM", "PX"]
     assert c.shape == (5, 3)
     np.testing.assert_array_equal(c.column("PX")[:3], 0.0)
     np.testing.assert_array_equal(c.column("F0")[3:], 0.0)
     assert c.block("dm").shape == (2, 3)
+    rows, cols = c.labels()
+    assert cols == ("F0", "DM", "PX")
+    assert [r[0] for r in rows] == ["toa", "dm"]
+
+
+def test_design_matrix_combine_by_param():
+    """Column concatenation for the same rows (reference:
+    combine_design_matrices_by_param): row/block agreement enforced,
+    duplicate params rejected."""
+    import pytest
+
+    from pint_tpu.matrix import DesignMatrix
+
+    a = DesignMatrix(np.ones((4, 2)), ["F0", "F1"])
+    b = DesignMatrix(3 * np.ones((4, 1)), ["DM"])
+    c = a.combine_by_param(b)
+    assert c.params == ["F0", "F1", "DM"]
+    assert c.shape == (4, 3)
+    np.testing.assert_array_equal(c.column("DM"), 3.0)
+    with pytest.raises(ValueError, match="row mismatch"):
+        a.combine_by_param(DesignMatrix(np.ones((3, 1)), ["PX"]))
+    with pytest.raises(ValueError, match="duplicate"):
+        a.combine_by_param(DesignMatrix(np.ones((4, 1)), ["F0"]))
+    sel = c.select_params(["DM", "F0"])
+    assert sel.params == ["DM", "F0"]
+    np.testing.assert_array_equal(sel.matrix[:, 0], 3.0)
+
+
+def test_covariance_submatrix_and_blockdiag():
+    from pint_tpu.matrix import CovarianceMatrix
+
+    c1 = CovarianceMatrix(np.array([[4.0, 1.0], [1.0, 9.0]]),
+                          ["F0", "F1"])
+    sub = c1.submatrix(["F1"])
+    assert sub.matrix.shape == (1, 1) and sub.matrix[0, 0] == 9.0
+    c2 = CovarianceMatrix(np.array([[16.0]]), ["DM"])
+    big = c1.combine_block_diag(c2)
+    assert big.params == ["F0", "F1", "DM"]
+    assert big.sigma("DM") == 4.0
+    assert big.matrix[0, 2] == 0.0
 
 
 def test_minimize_fitter_matches_wls():
