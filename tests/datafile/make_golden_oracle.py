@@ -35,6 +35,7 @@ _DATASETS = {
         ntoa=110, start_mjd=54700.0, end_mjd=55900.0, seed=4,
         wideband=True,
     ),
+    "golden5": dict(ntoa=100, start_mjd=54900.0, end_mjd=55900.0, seed=5),
 }
 
 

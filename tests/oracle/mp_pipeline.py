@@ -405,9 +405,14 @@ def ell1_delay(dt, nb_orbits, pars):
     nb = pars["NB"]
     d = dre * (1 - nb * drep + (nb * drep) ** 2
                + nb * nb * dre * drepp / 2)
-    if "M2" in pars and "SINI" in pars:
+    if "M2R" in pars and "SINI" in pars:
+        # Shapiro: m2r = TSUN*M2, or the orthometric resummation
+        # r = H3/STIGMA^3, s = 2 STIGMA/(1+STIGMA^2) (Freire&Wex 2010)
         arg = 1 - pars["SINI"] * s
-        d += -2 * mpf(TSUN) * pars["M2"] * log(arg)
+        d += -2 * pars["M2R"] * log(arg)
+    elif "H3_ONLY" in pars:
+        # third-harmonic-only approximation (Freire & Wex 2010 eq. 19)
+        d += -(mpf(4) / 3) * pars["H3_ONLY"] * sin(3 * phi)
     return d
 
 
@@ -525,6 +530,44 @@ class OraclePulsar:
             return toa["flags"].get(flag) == val
         return True  # bare value: applies to all
 
+    def _psr_dir(self, dt_pos):
+        """SSB->pulsar unit vector (ICRS) at dt_pos from POSEPOCH:
+        equatorial (RAJ/DECJ + PMRA/PMDEC) or ecliptic (ELONG/ELAT in
+        degrees + PMELONG/PMELAT, rotated by the IAU2006 J2000
+        obliquity — framework: AstrometryEcliptic._ecl_to_equ)."""
+        masyr = mpf(MAS_TO_RAD) / mpf(SECS_PER_JULIAN_YEAR)
+
+        def pm(key):
+            return (self._p(key) * masyr if key in self.par else mpf(0))
+
+        if "RAJ" in self.par:
+            ra = parse_hms(par_val(self.par, "RAJ"))
+            dec = parse_dms(par_val(self.par, "DECJ"))
+            pmra, pmdec = pm("PMRA"), pm("PMDEC")
+            if (pmra or pmdec) and "POSEPOCH" not in self.par:
+                raise ValueError("oracle needs POSEPOCH when PM is set")
+            # framework convention: dec(t) = dec0 + pmdec*dt;
+            # ra(t) = ra0 + pmra*dt/cos(dec0)  [PMRA = mu_a cos(dec)]
+            ra_t = ra + pmra * dt_pos / cos(dec)
+            dec_t = dec + pmdec * dt_pos
+            return np.array([
+                cos(dec_t) * cos(ra_t), cos(dec_t) * sin(ra_t),
+                sin(dec_t),
+            ])
+        lam = self._p("ELONG") * DEG
+        bet = self._p("ELAT") * DEG
+        pml, pmb = pm("PMELONG"), pm("PMELAT")
+        if (pml or pmb) and "POSEPOCH" not in self.par:
+            raise ValueError("oracle needs POSEPOCH when PM is set")
+        lam_t = lam + pml * dt_pos / cos(bet)
+        bet_t = bet + pmb * dt_pos
+        x = cos(bet_t) * cos(lam_t)
+        y = cos(bet_t) * sin(lam_t)
+        z = sin(bet_t)
+        eps = mpf("84381.406") * ARCSEC  # IAU2006 J2000 obliquity
+        ce, se = cos(eps), sin(eps)
+        return np.array([x, ce * y - se * z, se * y + ce * z])
+
     def _one_residual_raw(self, toa):
         # -- clock chain: no site clock data -> 0; UTC -> TT -----------
         day_utc, sec_utc = toa["day"], toa["frac"] * SPD
@@ -554,28 +597,12 @@ class OraclePulsar:
         sun_ls = sun_m / mpf(C)
 
         # -- astrometry: Roemer + parallax ------------------------------
-        ra = parse_hms(par_val(self.par, "RAJ"))
-        dec = parse_dms(par_val(self.par, "DECJ"))
         if "POSEPOCH" in self.par:
             pe_day, pe_sec = self._epoch("POSEPOCH")
             dt_pos = (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec)
         else:
             dt_pos = mpf(0)  # first-TOA fallback handled below
-        pmra = (self._p("PMRA") * mpf(MAS_TO_RAD)
-                / mpf(SECS_PER_JULIAN_YEAR)
-                if "PMRA" in self.par else mpf(0))
-        pmdec = (self._p("PMDEC") * mpf(MAS_TO_RAD)
-                 / mpf(SECS_PER_JULIAN_YEAR)
-                 if "PMDEC" in self.par else mpf(0))
-        if (pmra or pmdec) and "POSEPOCH" not in self.par:
-            raise ValueError("oracle needs POSEPOCH when PM is set")
-        # framework convention: dec(t) = dec0 + pmdec*dt;
-        # ra(t) = ra0 + pmra*dt/cos(dec0)  [PMRA = mu_alpha cos(dec)]
-        ra_t = ra + pmra * dt_pos / cos(dec)
-        dec_t = dec + pmdec * dt_pos
-        n = np.array([
-            cos(dec_t) * cos(ra_t), cos(dec_t) * sin(ra_t), sin(dec_t)
-        ])
+        n = self._psr_dir(dt_pos)
         rn = r_ls @ n
         delay = -rn
         if "PX" in self.par:
@@ -614,7 +641,7 @@ class OraclePulsar:
 
         # -- binary -----------------------------------------------------
         model = par_val(self.par, "BINARY")
-        if model in ("ELL1",):
+        if model in ("ELL1", "ELL1H"):
             tasc_day, tasc_sec = self._epoch("TASC")
             dt_b = (day_tdb - tasc_day) * SPD + (sec_tdb - tasc_sec) \
                 - delay
@@ -634,8 +661,22 @@ class OraclePulsar:
                 if k_ in self.par:
                     pars[pk] = self._p(k_)
             if "M2" in self.par and "SINI" in self.par:
-                pars["M2"] = self._p("M2")
+                pars["M2R"] = mpf(TSUN) * self._p("M2")
                 pars["SINI"] = self._p("SINI")
+            elif "H3" in self.par:
+                # the framework's three ELL1H parametrizations
+                # (pulsar_binary.py::BinaryELL1H._shapiro)
+                h3 = self._p("H3")
+                stig = (self._p("STIGMA") if "STIGMA" in self.par
+                        else self._p("STIG") if "STIG" in self.par
+                        else None)
+                if stig is None and "H4" in self.par:
+                    stig = self._p("H4") / h3
+                if stig is not None:
+                    pars["M2R"] = h3 / stig**3
+                    pars["SINI"] = 2 * stig / (1 + stig**2)
+                else:
+                    pars["H3_ONLY"] = h3
             delay += ell1_delay(dt_b, frac, pars)
         elif model in ("DD",):
             t0_day, t0_sec = self._epoch("T0")

@@ -7,11 +7,12 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Four golden datasets span the component matrix:
+Five golden datasets span the component matrix:
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
   golden4: ELL1 (M2/SINI Shapiro) + DMX, wideband DM measurements
+  golden5: ecliptic astrometry (ELONG/ELAT + PM) + ELL1H (H3/STIGMA)
 """
 
 import sys
@@ -44,7 +45,7 @@ def _framework_raw_residuals(stem):
 
 
 @pytest.mark.parametrize(
-    "stem", ["golden1", "golden2", "golden3", "golden4"]
+    "stem", ["golden1", "golden2", "golden3", "golden4", "golden5"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
