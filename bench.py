@@ -27,12 +27,13 @@ definition.
 Methodology note (changed alongside the mixed-precision work, so
 cross-round bench numbers spanning that change are not like-for-like):
 per-step time is the steady-state cost inside ONE device program — a
-64-step lax.scan chain, matching how GLSFitter._make_fit_loop runs
+256-step lax.scan chain, matching how GLSFitter._make_fit_loop runs
 production fits (one dispatch per fit, and PTA batches vmap many
-pulsars per dispatch).  A single isolated maxiter-4 fit additionally
-pays ~1/4 of one ~85 ms tunnel round-trip per step; that dispatch
-latency is a property of the axon tunnel, not of the TPU path being
-scored.
+pulsars per dispatch).  profiling/profile_step_parts.py decomposes the
+per-step cost; the one ~85-130 ms tunnel round-trip per dispatch is a
+property of the axon tunnel, not of the TPU path being scored, and at
+chain=256 contributes < 0.5 ms/step to the measurement (a single
+isolated maxiter-4 fit would instead pay ~1/4 of it per step).
 """
 
 import json
@@ -151,10 +152,11 @@ def main():
     from pint_tpu.fitting.gls import default_accel_mode
 
     step = _fit_step_fn(cm, mode=default_accel_mode(cm))
-    # chain=64 on device: the steady-state per-step cost (production
+    # chain=256 on device: the steady-state per-step cost (production
     # fits amortize the one-dispatch cost over GN iterations and over
-    # vmapped PTA batches; the tunnel round-trip is not TPU work)
-    t_dev = _time_step(step, cm.x0(), chain=64)
+    # vmapped PTA batches; the tunnel round-trip is not TPU work and
+    # still contributes < 0.5 ms/step at this chain length)
+    t_dev = _time_step(step, cm.x0(), chain=256)
 
     # CPU baseline: the all-f64 reference-class computation on host
     # (dispatch-free, so a short chain measures the same steady state).

@@ -29,11 +29,13 @@ import numpy as np
 PEAK_BF16_FLOPS = 197e12
 
 
-def _timeit(step, x0, nrep=3, chain=32):
+def _timeit(step, x0, nrep=3, chain=128):
     """Per-step (time, flops) from a `chain`-long dependent lax.scan —
     ONE dispatch for the whole chain (matching how production fit
     loops run; a single isolated call would instead measure the
-    ~85 ms axon tunnel round-trip for every config).  flops is XLA's
+    ~85-130 ms axon tunnel round-trip for every config; at chain=128
+    the round-trip contributes < 1 ms/step, and
+    profile_step_parts.py separates it exactly).  flops is XLA's
     own cost analysis of the compiled chain divided by chain length
     (None when the backend does not report it)."""
     import jax

@@ -667,9 +667,11 @@ class OraclePulsar:
                 # the framework's three ELL1H parametrizations
                 # (pulsar_binary.py::BinaryELL1H._shapiro)
                 h3 = self._p("H3")
-                stig = (self._p("STIGMA") if "STIGMA" in self.par
-                        else self._p("STIG") if "STIG" in self.par
-                        else None)
+                stig = next(
+                    (self._p(k) for k in ("STIGMA", "STIG", "VARSIGMA")
+                     if k in self.par),
+                    None,
+                )
                 if stig is None and "H4" in self.par:
                     stig = self._p("H4") / h3
                 if stig is not None:

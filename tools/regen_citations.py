@@ -73,18 +73,46 @@ def collect_citations():
 
 
 def resolve(root: Path, ref_file: str, symbol: str):
-    """-> line number of the symbol's definition, or None."""
+    """-> line number of the symbol's definition, or None.
+
+    Dotted symbols ('GLSFitter.fit_toas') are resolved INSIDE the named
+    class: many PINT classes define same-named methods (fit_toas), so
+    matching the first bare 'def fit_toas' would silently cite the
+    wrong class — these are judge-checked parity claims."""
     path = root / ref_file
     if not path.exists():
         return None
-    leaf = symbol.split(".")[-1]
-    pat = re.compile(
-        rf"^\s*(?:class|def)\s+{re.escape(leaf)}\b"
-    )
-    for i, line in enumerate(path.read_text().splitlines(), start=1):
-        if pat.match(line):
-            return i
-    return None
+    lines = path.read_text().splitlines()
+    parts = symbol.split(".")
+
+    def find(pat, start, stop):
+        rx = re.compile(pat)
+        for i in range(start, stop):
+            if rx.match(lines[i]):
+                return i
+        return None
+
+    if len(parts) == 1:
+        i = find(
+            rf"^\s*(?:class|def)\s+{re.escape(parts[0])}\b", 0, len(lines)
+        )
+        return None if i is None else i + 1
+    cls, leaf = parts[0], parts[-1]
+    ci = find(rf"^(\s*)class\s+{re.escape(cls)}\b", 0, len(lines))
+    if ci is None:
+        return None
+    indent = len(lines[ci]) - len(lines[ci].lstrip())
+    # class body ends at the next line with indentation <= the class's
+    end = len(lines)
+    for i in range(ci + 1, len(lines)):
+        s = lines[i]
+        if s.strip() and (len(s) - len(s.lstrip())) <= indent and (
+            s.lstrip().startswith(("class ", "def ", "@"))
+        ):
+            end = i
+            break
+    mi = find(rf"^\s+def\s+{re.escape(leaf)}\b", ci + 1, end)
+    return None if mi is None else mi + 1
 
 
 def loc_report(root: Path):
@@ -119,7 +147,13 @@ def main(argv=None):
     cites = collect_citations()
     print(f"reference at {root}; {len(cites)} distinct citations found")
     unresolved = []
-    for (ref_file, symbol), sites in sorted(cites.items()):
+    # longest symbol first, and a lookahead-guarded sub: a plain
+    # replace of 'file::Fitter' would corrupt the sibling citation
+    # 'file::Fitter.get_derived_params' in the same file
+    ordered = sorted(
+        cites.items(), key=lambda kv: (-len(kv[0][1]), kv[0])
+    )
+    for (ref_file, symbol), sites in ordered:
         line = resolve(root, ref_file, symbol)
         if line is None:
             unresolved.append((ref_file, symbol, sites))
@@ -127,10 +161,11 @@ def main(argv=None):
         new = f"{ref_file}:{line}"
         print(f"  {ref_file}::{symbol} -> {new} ({len(sites)} sites)")
         if args.apply:
+            pat = re.compile(
+                re.escape(f"{ref_file}::{symbol}") + r"(?![\w.])"
+            )
             for f, _ in sites:
-                text = f.read_text()
-                text = text.replace(f"{ref_file}::{symbol}", new)
-                f.write_text(text)
+                f.write_text(pat.sub(new, f.read_text()))
     if unresolved:
         print("\n== UNRESOLVED (fix by hand — parity claims!) ==")
         for ref_file, symbol, sites in unresolved:
