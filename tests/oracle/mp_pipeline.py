@@ -33,8 +33,9 @@ mp.dps = 40
 
 # -- published data tables + defining constants (imported as data) -------
 from pint_tpu.constants import (  # noqa: E402
-    AU_LIGHT_SEC, C, DM_CONST, GM_SUN, TSUN, SECS_PER_JULIAN_YEAR,
-    MAS_TO_RAD,
+    AU, AU_LIGHT_SEC, C, DM_CONST, GM_JUPITER, GM_NEPTUNE, GM_SATURN,
+    GM_SUN, GM_URANUS, GM_VENUS, MAS_TO_RAD, PC, SECS_PER_JULIAN_YEAR,
+    TSUN,
 )
 from pint_tpu.ephemeris.builtin import (  # noqa: E402
     _ELEMENTS, _EMRAT, _MASS_RATIO, AU_KM,
@@ -609,12 +610,47 @@ class OraclePulsar:
             px = self._p("PX") * mpf(MAS_TO_RAD)
             delay += px / (2 * mpf(AU_LIGHT_SEC)) * (r_ls @ r_ls - rn**2)
 
-        # -- solar-system Shapiro (Sun) ---------------------------------
-        rs = sqrt(sun_ls @ sun_ls)
-        rsn = sun_ls @ n
-        delay += -(2 * mpf(GM_SUN) / mpf(C) ** 3) * log(
-            (rs - rsn) / mpf(AU_LIGHT_SEC)
+        # -- solar-system Shapiro (Sun + optional planets) --------------
+        def shapiro(body_ls, gm):
+            rr = sqrt(body_ls @ body_ls)
+            rn_ = body_ls @ n
+            return -(2 * mpf(gm) / mpf(C) ** 3) * log(
+                (rr - rn_) / mpf(AU_LIGHT_SEC)
+            )
+
+        delay += shapiro(sun_ls, GM_SUN)
+        ps_tokens = self.par.get("PLANET_SHAPIRO")
+        # mirror the framework's s_to_bool truthiness; a bare line
+        # (no value) means True there too
+        planet_shapiro = ps_tokens is not None and (
+            not ps_tokens[0]
+            or ps_tokens[0][0].strip().upper() in
+            ("Y", "YES", "T", "TRUE", "1")
         )
+        if planet_shapiro:
+            for body, gm in (
+                ("venus", GM_VENUS), ("jupiter", GM_JUPITER),
+                ("saturn", GM_SATURN), ("uranus", GM_URANUS),
+                ("neptune", GM_NEPTUNE),
+            ):
+                p_ecl = sun_ssb_ecl_au(T2) + kepler_xyz_au(body, T2)
+                p_m = ecl_to_eq_j2000(p_ecl) * mpf(AU_KM) * 1000
+                delay += shapiro((p_m - ssb_obs_m) / mpf(C), gm)
+
+        # -- solar wind (spherical NE_SW model) -------------------------
+        if any(f"NE_SW{k}" in self.par for k in range(1, 6)):
+            raise NotImplementedError(
+                "oracle models constant NE_SW only (no NE_SW1.. Taylor)"
+            )
+        if "NE_SW" in self.par:
+            d_sun = sqrt(sun_ls @ sun_ls)
+            cos_e = (sun_ls @ n) / d_sun
+            theta = mp.acos(cos_e)
+            au_ls = mpf(AU) / mpf(C)
+            pc_ls = mpf(PC) / mpf(C)
+            col = (self._p("NE_SW") * au_ls * au_ls * (pi - theta)
+                   / (d_sun * sin(theta)))
+            delay += mpf(DM_CONST) * (col / pc_ls) / toa["freq"] ** 2
 
         # -- dispersion -------------------------------------------------
         dm = self._p("DM", mpf(0))
@@ -680,7 +716,7 @@ class OraclePulsar:
                 else:
                     pars["H3_ONLY"] = h3
             delay += ell1_delay(dt_b, frac, pars)
-        elif model in ("DD",):
+        elif model in ("DD", "DDK"):
             t0_day, t0_sec = self._epoch("T0")
             dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
             pb = self._p("PB") * SPD
@@ -702,6 +738,60 @@ class OraclePulsar:
                        "M2", "SINI"):
                 if k_ in self.par:
                     pars[k_] = self._p(k_)
+            if model == "DDK":
+                # Kopeikin 1995/1996 orientation coupling (framework:
+                # pulsar_binary.py::BinaryDDK._kopeikin): PM-driven
+                # secular drift of (a1, om, kin) + K96 annual orbital
+                # parallax from the SSB->obs vector projected on the
+                # sky basis at the reference position.
+                if "RAJ" not in self.par:
+                    raise NotImplementedError(
+                        "oracle DDK supports equatorial astrometry "
+                        "only (RAJ/DECJ + PMRA/PMDEC)"
+                    )
+                kin0 = self._p("KIN") * DEG
+                kom = self._p("KOM") * DEG
+                sk, ck = sin(kom), cos(kom)
+                sin_kin0 = sin(kin0)
+                cot_kin0 = cos(kin0) / sin_kin0
+                masyr = mpf(MAS_TO_RAD) / mpf(SECS_PER_JULIAN_YEAR)
+                pml = (self._p("PMRA") * masyr
+                       if "PMRA" in self.par else mpf(0))
+                pmb = (self._p("PMDEC") * masyr
+                       if "PMDEC" in self.par else mpf(0))
+                dkin = (-pml * sk + pmb * ck) * dt_b
+                dom = (pml * ck + pmb * sk) / sin_kin0 * dt_b
+                # framework scales the A1DOT-DRIFTED a1 (self._a1)
+                a1 = pars["A1"] + pars.pop("A1DOT", mpf(0)) * dt_b
+                a1_eff = a1 * (1 + cot_kin0 * dkin)
+                om_eff = pars["OM"] + dom
+                kin = kin0 + dkin
+                k96 = self.par.get("K96")
+                k96_on = k96 is None or not k96[0] or (
+                    k96[0][0].strip().upper() in
+                    ("Y", "YES", "T", "TRUE", "1")
+                )
+                if "PX" in self.par and k96_on:
+                    px = self._p("PX") * mpf(MAS_TO_RAD)
+                    d_ls = mpf(AU_LIGHT_SEC) / px
+                    ra = parse_hms(par_val(self.par, "RAJ"))
+                    dec = parse_dms(par_val(self.par, "DECJ"))
+                    east = np.array([-sin(ra), cos(ra), mpf(0)])
+                    north = np.array([
+                        -cos(ra) * sin(dec), -sin(ra) * sin(dec),
+                        cos(dec),
+                    ])
+                    di0 = r_ls @ east
+                    dj0 = r_ls @ north
+                    a1_eff += a1 / d_ls * cot_kin0 * (
+                        di0 * sk - dj0 * ck
+                    )
+                    om_eff -= (di0 * ck + dj0 * sk) / (d_ls * sin_kin0)
+                pars["A1"] = a1_eff
+                pars["OM"] = om_eff
+                pars["SINI"] = sin(kin)
+                if "M2" not in pars:
+                    pars["M2"] = mpf(0)
             delay += dd_delay(dt_b, frac, pars)
         elif model:
             raise NotImplementedError(f"oracle binary {model}")

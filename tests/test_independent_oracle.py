@@ -7,12 +7,14 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Five golden datasets span the component matrix:
+Six golden datasets span the component matrix:
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
   golden4: ELL1 (M2/SINI Shapiro) + DMX, wideband DM measurements
   golden5: ecliptic astrometry (ELONG/ELAT + PM) + ELL1H (H3/STIGMA)
+  golden6: DDK (Kopeikin PM+K96 coupling) + planetary Shapiro +
+           spherical solar wind
 """
 
 import sys
@@ -45,7 +47,8 @@ def _framework_raw_residuals(stem):
 
 
 @pytest.mark.parametrize(
-    "stem", ["golden1", "golden2", "golden3", "golden4", "golden5"]
+    "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
+             "golden6"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
