@@ -52,7 +52,9 @@ def _load(stem):
     return model, toas, np.load(DATADIR / f"{stem}_oracle.npz")
 
 
-@pytest.mark.parametrize("stem", ["golden1", "golden2"])
+@pytest.mark.parametrize(
+    "stem", ["golden1", "golden2", "golden5", "golden6"]
+)
 def test_onchip_residuals_vs_cpu_oracle(stem):
     model, toas, oracle = _load(stem)
     cm = model.compile(toas)
