@@ -16,10 +16,15 @@ residual oracles over tests/datafile/ (SURVEY.md §4): an external
 ns-level check the framework cannot fool by being self-consistent.
 
 Supported components (grown with the golden datasets): Spindown,
-AstrometryEquatorial (+PM, +PX), DispersionDM (+DMX), SolarSystemShapiro
-(Sun), BinaryELL1, BinaryDD, JUMP (flag masks), ScaleToaError
-(EFAC/EQUAD, for the weighted mean).  PLRedNoise affects fitting, not
-pre-fit residuals, and is ignored here.
+Astrometry equatorial + ecliptic (+PM, +PX), DispersionDM (+DMn, +DMX),
+SolarSystemShapiro (Sun + planets), spherical solar wind (constant
+NE_SW), BinaryELL1/ELL1H (all three orthometric Shapiro forms),
+BinaryDD, BinaryDDK (Kopeikin PM + K96 parallax coupling), BinaryBT,
+Glitch (incl. exponential recovery), Wave, IFunc (SIFUNC 2), JUMP
+(flag masks), ScaleToaError (EFAC/EQUAD, for the weighted mean).
+PLRedNoise/ECORR affect fitting, not pre-fit residuals, and are
+ignored here.  Unsupported configurations raise NotImplementedError
+rather than silently mismodeling.
 """
 
 from __future__ import annotations
@@ -793,6 +798,36 @@ class OraclePulsar:
                 if "M2" not in pars:
                     pars["M2"] = mpf(0)
             delay += dd_delay(dt_b, frac, pars)
+        elif model in ("BT",):
+            t0_day, t0_sec = self._epoch("T0")
+            dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
+            pb = self._p("PB") * SPD
+            pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
+            nbdt = dt_b / pb
+            orbits = nbdt - (nbdt**2) * pbdot / 2
+            frac = orbits - floor(orbits + mpf("0.5"))
+            nb = 2 * pi / pb * (1 - pbdot * nbdt)
+            M = 2 * pi * frac
+            e = self._p("ECC", mpf(0)) + (
+                self._p("EDOT", mpf(0)) or mpf(0)) * dt_b
+            om = (self._p("OM", mpf(0)) or mpf(0)) * DEG + (
+                (self._p("OMDOT", mpf(0)) or mpf(0)) * DEG
+                / mpf(SECS_PER_JULIAN_YEAR)) * dt_b
+            a1 = self._p("A1") + (
+                self._p("A1DOT", mpf(0)) or mpf(0)) * dt_b
+            gamma = self._p("GAMMA", mpf(0)) or mpf(0)
+            E = M + e * sin(M)
+            for _ in range(60):
+                dE = (E - e * sin(E) - M) / (1 - e * cos(E))
+                E = E - dE
+                if abs(dE) < mpf("1e-35"):
+                    break
+            alpha = a1 * sin(om)
+            beta = a1 * sqrt(1 - e * e) * cos(om)
+            dly = alpha * (cos(E) - e) + (beta + gamma) * sin(E)
+            ddot = nb * (-alpha * sin(E) + (beta + gamma) * cos(E)) \
+                / (1 - e * cos(E))
+            delay += dly * (1 - ddot)
         elif model:
             raise NotImplementedError(f"oracle binary {model}")
 
@@ -805,11 +840,83 @@ class OraclePulsar:
             coeffs.append(self._p(f"F{k}"))
             k += 1
         phase = taylor_phase(dt, coeffs)
-        # JUMP (PhaseJump convention): J seconds = -J*F0 cycles, F0 in
-        # f64 as the framework's kernel consumes it
+        f0_f64 = mpf(float(coeffs[0]))  # kernels consume F0 as f64
+        # JUMP (PhaseJump convention): J seconds = -J*F0 cycles
         for args in self.par.get("JUMP", []):
             if args[0].startswith("-") and self._mask_match(toa, args):
-                phase += -mpf(args[2]) * mpf(float(coeffs[0]))
+                phase += -mpf(args[2]) * f0_f64
+
+        # -- glitches (phase; dt includes the delay, models/glitch.py) --
+        # index sets may be gapped (the framework sorts whatever
+        # indices exist); scan the par keys, not a 1..n counter
+        for i in sorted(
+            int(k[5:]) for k in self.par
+            if k.startswith("GLEP_") and k[5:].isdigit()
+        ):
+            glep = self._p(f"GLEP_{i}")
+            dt_g = (day_tdb - glep) * SPD + sec_tdb - delay
+            if dt_g > 0:
+                ph = (self._p(f"GLPH_{i}", mpf(0)) or mpf(0))
+                ph += (self._p(f"GLF0_{i}", mpf(0)) or mpf(0)) * dt_g
+                ph += (self._p(f"GLF1_{i}", mpf(0)) or mpf(0)) \
+                    * dt_g**2 / 2
+                ph += (self._p(f"GLF2_{i}", mpf(0)) or mpf(0)) \
+                    * dt_g**3 / 6
+                td = self._p(f"GLTD_{i}", mpf(0)) or mpf(0)
+                if td != 0:
+                    td_s = td * SPD  # GLTD is in days
+                    f0d = self._p(f"GLF0D_{i}", mpf(0)) or mpf(0)
+                    ph += f0d * td_s * (1 - mp.exp(-dt_g / td_s))
+                phase += ph
+
+        # -- Wave (sinusoid seconds -> phase via F0, NO delay in arg) --
+        wave_ks = sorted(
+            int(k[4:]) for k in self.par
+            if k.startswith("WAVE") and k[4:].isdigit()
+        )
+        if "WAVE_OM" in self.par and wave_ks:
+            # framework defaults WAVEEPOCH to PEPOCH (models/wave.py)
+            epoch_key = (
+                "WAVEEPOCH" if "WAVEEPOCH" in self.par else "PEPOCH"
+            )
+            we_day, we_sec = self._epoch(epoch_key)
+            td_days = (day_tdb - we_day) + (sec_tdb - we_sec) / SPD
+            om_w = self._p("WAVE_OM")
+            wave = mpf(0)
+            for k in wave_ks:
+                a, b = (mpf(v) for v in self.par[f"WAVE{k}"][0][:2])
+                arg = k * om_w * td_days
+                wave += a * sin(arg) + b * cos(arg)
+            phase += -wave * f0_f64
+
+        # -- IFunc (linear interpolation of tabulated seconds) ----------
+        ifunc_ks = sorted(
+            int(k[5:]) for k in self.par
+            if k.startswith("IFUNC") and k[5:].isdigit()
+        )
+        if ifunc_ks:
+            nodes = []
+            for k in ifunc_ks:
+                t_ = self.par[f"IFUNC{k}"][0]
+                nodes.append((mpf(t_[0]), mpf(t_[1])))
+            nodes.sort()
+            t_mjd = mpf(day_tdb) + sec_tdb / SPD
+            mode = int(float(par_val(self.par, "SIFUNC", "2")))
+            if mode != 2:
+                raise NotImplementedError("oracle IFunc: SIFUNC 2 only")
+            # clamped linear interpolation (jnp.interp semantics)
+            if t_mjd <= nodes[0][0]:
+                val = nodes[0][1]
+            elif t_mjd >= nodes[-1][0]:
+                val = nodes[-1][1]
+            else:
+                for (x0_, y0_), (x1_, y1_) in zip(nodes, nodes[1:]):
+                    if x0_ <= t_mjd <= x1_:
+                        w = (t_mjd - x0_) / (x1_ - x0_)
+                        val = y0_ + w * (y1_ - y0_)
+                        break
+            phase += -val * f0_f64
+
         frac = phase - floor(phase + mpf("0.5"))
         f_inst = taylor_freq(
             (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec), coeffs

@@ -7,7 +7,7 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Six golden datasets span the component matrix:
+Seven golden datasets span the component matrix:
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
@@ -15,6 +15,8 @@ Six golden datasets span the component matrix:
   golden5: ecliptic astrometry (ELONG/ELAT + PM) + ELL1H (H3/STIGMA)
   golden6: DDK (Kopeikin PM+K96 coupling) + planetary Shapiro +
            spherical solar wind
+  golden7: BT binary + glitch (with exponential recovery) + Wave +
+           IFunc tabulated phase
 """
 
 import sys
@@ -48,7 +50,7 @@ def _framework_raw_residuals(stem):
 
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
-             "golden6"]
+             "golden6", "golden7"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
