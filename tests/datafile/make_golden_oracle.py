@@ -38,6 +38,7 @@ _DATASETS = {
     "golden5": dict(ntoa=100, start_mjd=54900.0, end_mjd=55900.0, seed=5),
     "golden6": dict(ntoa=110, start_mjd=54900.0, end_mjd=56100.0, seed=6),
     "golden7": dict(ntoa=120, start_mjd=54800.0, end_mjd=55900.0, seed=7),
+    "golden8": dict(ntoa=100, start_mjd=54800.0, end_mjd=55700.0, seed=8),
 }
 
 

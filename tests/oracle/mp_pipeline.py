@@ -721,11 +721,42 @@ class OraclePulsar:
                 else:
                     pars["H3_ONLY"] = h3
             delay += ell1_delay(dt_b, frac, pars)
-        elif model in ("DD", "DDK"):
+        elif model in ("DD", "DDK", "DDGR"):
             t0_day, t0_sec = self._epoch("T0")
             dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
             pb = self._p("PB") * SPD
-            pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
+            gr = None
+            if model == "DDGR":
+                # all PK parameters from GR (framework:
+                # binaries/dd.py::gr_pk_params); masses in seconds
+                mtot = mpf(TSUN) * self._p("MTOT")
+                m2 = mpf(TSUN) * self._p("M2")
+                m1 = mtot - m2
+                n_orb = 2 * pi / pb
+                e_ = self._p("ECC")
+                e2 = e_ * e_
+                mn23 = (mtot * n_orb) ** (mpf(2) / 3)
+                gr = {
+                    "k": 3 * mn23 / (1 - e2),
+                    "gamma": e_ / n_orb * mn23 * m2 * (m1 + 2 * m2)
+                    / mtot**2,
+                    "pbdot": -192 * pi / 5
+                    * (n_orb * mtot) ** (mpf(5) / 3)
+                    * (m1 * m2 / mtot**2)
+                    * (1 + mpf(73) / 24 * e2 + mpf(37) / 96 * e2 * e2)
+                    * (1 - e2) ** (mpf(-7) / 2),
+                    "dr": (3 * m1**2 + 6 * m1 * m2 + 2 * m2**2)
+                    / mtot**2 * mn23,
+                    "dth": (mpf("3.5") * m1**2 + 6 * m1 * m2
+                            + 2 * m2**2) / mtot**2 * mn23,
+                    "sini": self._p("A1") * n_orb ** (mpf(2) / 3)
+                    * mtot ** (mpf(2) / 3) / m2,
+                }
+            if gr is not None:
+                pbdot = gr["pbdot"] + (
+                    self._p("XPBDOT", mpf(0)) or mpf(0))
+            else:
+                pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
             nbdt = dt_b / pb
             orbits = nbdt - (nbdt**2) * pbdot / 2
             norb = floor(orbits + mpf("0.5"))
@@ -743,6 +774,15 @@ class OraclePulsar:
                        "M2", "SINI"):
                 if k_ in self.par:
                     pars[k_] = self._p(k_)
+            if gr is not None:
+                xomdot = (self._p("XOMDOT", mpf(0)) or mpf(0)) * DEG \
+                    / mpf(SECS_PER_JULIAN_YEAR)
+                pars["K"] = gr["k"] + xomdot / nb0
+                pars["GAMMA"] = gr["gamma"]
+                pars["DR"] = gr["dr"]
+                pars["DTH"] = gr["dth"]
+                pars["SINI"] = gr["sini"]
+                pars["M2"] = self._p("M2")
             if model == "DDK":
                 # Kopeikin 1995/1996 orientation coupling (framework:
                 # pulsar_binary.py::BinaryDDK._kopeikin): PM-driven

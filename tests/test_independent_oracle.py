@@ -7,7 +7,7 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Seven golden datasets span the component matrix:
+Eight golden datasets span the component matrix:
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
@@ -17,6 +17,8 @@ Seven golden datasets span the component matrix:
            spherical solar wind
   golden7: BT binary + glitch (with exponential recovery) + Wave +
            IFunc tabulated phase
+  golden8: DDGR (all post-Keplerian parameters from GR masses,
+           B1913+16-like e=0.617)
 """
 
 import sys
@@ -50,7 +52,7 @@ def _framework_raw_residuals(stem):
 
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
-             "golden6", "golden7"]
+             "golden6", "golden7", "golden8"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
