@@ -39,6 +39,9 @@ _DATASETS = {
     "golden6": dict(ntoa=110, start_mjd=54900.0, end_mjd=56100.0, seed=6),
     "golden7": dict(ntoa=120, start_mjd=54800.0, end_mjd=55900.0, seed=7),
     "golden8": dict(ntoa=100, start_mjd=54800.0, end_mjd=55700.0, seed=8),
+    "golden9": dict(ntoa=80, start_mjd=54700.0, end_mjd=55600.0, seed=9),
+    "golden10": dict(ntoa=80, start_mjd=54900.0, end_mjd=55800.0, seed=10),
+    "golden11": dict(ntoa=80, start_mjd=55000.0, end_mjd=55900.0, seed=11),
 }
 
 

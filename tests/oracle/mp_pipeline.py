@@ -682,7 +682,7 @@ class OraclePulsar:
 
         # -- binary -----------------------------------------------------
         model = par_val(self.par, "BINARY")
-        if model in ("ELL1", "ELL1H"):
+        if model in ("ELL1", "ELL1H", "ELL1K"):
             tasc_day, tasc_sec = self._epoch("TASC")
             dt_b = (day_tdb - tasc_day) * SPD + (sec_tdb - tasc_sec) \
                 - delay
@@ -701,6 +701,22 @@ class OraclePulsar:
                            ("EPS2DOT", "EPS2DOT")):
                 if k_ in self.par:
                     pars[pk] = self._p(k_)
+            if model == "ELL1K":
+                # explicit periastron advance + eccentricity rate
+                # (Susobhanan et al. 2018; framework:
+                # binaries/ell1.py::eps_at_t_k): rotate (eps1, eps2)
+                # by OMDOT*dt and scale |e| by (1 + LNEDOT*dt)
+                om0 = atan2(pars["EPS1"], pars["EPS2"])
+                e0 = sqrt(pars["EPS1"]**2 + pars["EPS2"]**2)
+                omdot_k = (self._p("OMDOT", mpf(0)) or mpf(0)) * DEG \
+                    / mpf(SECS_PER_JULIAN_YEAR)
+                lnedot = self._p("LNEDOT", mpf(0)) or mpf(0)
+                e_t = e0 * (1 + lnedot * dt_b)
+                om_t = om0 + omdot_k * dt_b
+                pars["EPS1"] = e_t * sin(om_t)
+                pars["EPS2"] = e_t * cos(om_t)
+                pars.pop("EPS1DOT", None)
+                pars.pop("EPS2DOT", None)
             if "M2" in self.par and "SINI" in self.par:
                 pars["M2R"] = mpf(TSUN) * self._p("M2")
                 pars["SINI"] = self._p("SINI")
@@ -721,11 +737,18 @@ class OraclePulsar:
                 else:
                     pars["H3_ONLY"] = h3
             delay += ell1_delay(dt_b, frac, pars)
-        elif model in ("DD", "DDK", "DDGR"):
+        elif model in ("DD", "DDK", "DDGR", "DDS", "DDH"):
             t0_day, t0_sec = self._epoch("T0")
             dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
             pb = self._p("PB") * SPD
             gr = None
+            if model == "DDGR" and "EDOT" in self.par:
+                # the framework evolves the PK params with e(t); the
+                # oracle holds them at e(T0) — refuse rather than
+                # silently model different physics
+                raise NotImplementedError(
+                    "oracle DDGR does not model EDOT-evolved PK params"
+                )
             if model == "DDGR":
                 # all PK parameters from GR (framework:
                 # binaries/dd.py::gr_pk_params); masses in seconds
@@ -783,6 +806,25 @@ class OraclePulsar:
                 pars["DTH"] = gr["dth"]
                 pars["SINI"] = gr["sini"]
                 pars["M2"] = self._p("M2")
+            if model == "DDS":
+                # SHAPMAX parametrization (framework: BinaryDDS._pk)
+                pars["SINI"] = 1 - mp.exp(-self._p("SHAPMAX"))
+            if model == "DDH":
+                # orthometric (Freire & Wex 2010; BinaryDDH._pk):
+                # dd_delay's Shapiro consumes m2r = TSUN*M2, so express
+                # r = H3/STIGMA^3 as an equivalent M2
+                h3 = self._p("H3")
+                stig = next(
+                    (self._p(k) for k in ("STIGMA", "STIG", "VARSIGMA")
+                     if k in self.par),
+                    None,
+                )
+                if stig is None:
+                    raise ValueError(
+                        "DDH par needs STIGMA (or STIG/VARSIGMA)"
+                    )
+                pars["M2"] = h3 / stig**3 / mpf(TSUN)
+                pars["SINI"] = 2 * stig / (1 + stig**2)
             if model == "DDK":
                 # Kopeikin 1995/1996 orientation coupling (framework:
                 # pulsar_binary.py::BinaryDDK._kopeikin): PM-driven
