@@ -1,6 +1,7 @@
 """Independent mpmath oracle for the full timing pipeline.
 
-A from-scratch 40-digit implementation of ingest -> delays -> phase ->
+A from-scratch high-precision (30-digit mpmath) implementation of
+ingest -> delays -> phase ->
 residuals, sharing NO evaluation code with the framework: every
 transformation (leap seconds, TT->TDB, precession/nutation/GAST,
 VSOP87/Kepler ephemeris, Roemer/Shapiro/dispersion/binary delays,
@@ -18,8 +19,9 @@ ns-level check the framework cannot fool by being self-consistent.
 Supported components (grown with the golden datasets): Spindown,
 Astrometry equatorial + ecliptic (+PM, +PX), DispersionDM (+DMn, +DMX),
 SolarSystemShapiro (Sun + planets), spherical solar wind (constant
-NE_SW), BinaryELL1/ELL1H (all three orthometric Shapiro forms),
-BinaryDD, BinaryDDK (Kopeikin PM + K96 parallax coupling), BinaryBT,
+NE_SW), BinaryELL1/ELL1H/ELL1k (all three orthometric Shapiro forms,
+OMDOT/LNEDOT rotation), BinaryDD/DDS/DDH, BinaryDDGR (GR PK from
+masses), BinaryDDK (Kopeikin PM + K96 parallax coupling), BinaryBT,
 Glitch (incl. exponential recovery), Wave, IFunc (SIFUNC 2), JUMP
 (flag masks), ScaleToaError (EFAC/EQUAD, for the weighted mean).
 PLRedNoise/ECORR affect fitting, not pre-fit residuals, and are
@@ -34,7 +36,23 @@ from fractions import Fraction
 import numpy as np
 from mpmath import mp, mpf, sin, cos, sqrt, log, atan2, floor, pi
 
-mp.dps = 40
+# 30 significant digits: ~1e-30 relative = ~1e-21 s on ~1e9 s
+# quantities — 12 orders beyond the <1 ns parity target; mpmath cost
+# grows with dps and the suite runs hundreds of TOAs through the full
+# pipeline.  Precision is scoped with mp.workdps around the oracle's
+# entry points (NOT a process-global mp.dps, which would silently
+# override other tests' contexts, e.g. test_dd's 50 digits).
+_DPS = 30
+
+
+def _with_dps(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        with mp.workdps(_DPS):
+            return fn(*a, **k)
+    return wrap
 
 # -- published data tables + defining constants (imported as data) -------
 from pint_tpu.constants import (  # noqa: E402
@@ -54,10 +72,13 @@ from pint_tpu.timebase.leapseconds import (  # noqa: E402
     _LEAP_MJDS, _LEAP_OFFSETS,
 )
 
-ARCSEC = pi / (180 * 3600)
-DEG = pi / 180
-TT_MINUS_TAI = mpf("32.184")
-SPD = mpf(86400)
+# module constants built at full working precision (mpf values keep
+# their creation precision regardless of the ambient context later)
+with mp.workdps(_DPS):
+    ARCSEC = pi / (180 * 3600)
+    DEG = pi / 180
+    TT_MINUS_TAI = mpf("32.184")
+    SPD = mpf(86400)
 
 
 # ========================= par / tim parsing ============================
@@ -354,6 +375,7 @@ def moon_geocentric_ecl_date_km(T):
     return np.array([r * cb * cl, r * cb * sl, r * sb])
 
 
+@_with_dps
 def earth_ssb_eq_km(T_cent):
     """SSB->geocenter, equatorial J2000, km (mirrors BuiltinEphemeris
     composition: Kepler Sun wobble + VSOP87 geocenter)."""
@@ -364,6 +386,7 @@ def earth_ssb_eq_km(T_cent):
     return (sun + earth_h) * mpf(AU_KM)
 
 
+@_with_dps
 def sun_ssb_eq_km(T_cent):
     return ecl_to_eq_j2000(sun_ssb_ecl_au(T_cent)) * mpf(AU_KM)
 
@@ -498,12 +521,21 @@ class OraclePulsar:
         v = par_val(self.par, key, default)
         return None if v is None else mpf(v)
 
+    def _stig(self):
+        """STIGMA under any of its aliases, or None."""
+        return next(
+            (self._p(k) for k in ("STIGMA", "STIG", "VARSIGMA")
+             if k in self.par),
+            None,
+        )
+
     def _epoch(self, key):
         """Par epoch (TDB) -> (day, sec)."""
         s = par_val(self.par, key)
         day_s, _, frac_s = s.partition(".")
         return int(day_s), mpf("0." + (frac_s or "0")) * SPD
 
+    @_with_dps
     def residuals(self):
         """Weighted-mean-subtracted time residuals (seconds, f64)."""
         raw, freqs, errs = [], [], []
@@ -574,6 +606,7 @@ class OraclePulsar:
         ce, se = cos(eps), sin(eps)
         return np.array([x, ce * y - se * z, se * y + ce * z])
 
+    @_with_dps
     def _one_residual_raw(self, toa):
         # -- clock chain: no site clock data -> 0; UTC -> TT -----------
         day_utc, sec_utc = toa["day"], toa["frac"] * SPD
@@ -724,11 +757,7 @@ class OraclePulsar:
                 # the framework's three ELL1H parametrizations
                 # (pulsar_binary.py::BinaryELL1H._shapiro)
                 h3 = self._p("H3")
-                stig = next(
-                    (self._p(k) for k in ("STIGMA", "STIG", "VARSIGMA")
-                     if k in self.par),
-                    None,
-                )
+                stig = self._stig()
                 if stig is None and "H4" in self.par:
                     stig = self._p("H4") / h3
                 if stig is not None:
@@ -814,11 +843,7 @@ class OraclePulsar:
                 # dd_delay's Shapiro consumes m2r = TSUN*M2, so express
                 # r = H3/STIGMA^3 as an equivalent M2
                 h3 = self._p("H3")
-                stig = next(
-                    (self._p(k) for k in ("STIGMA", "STIG", "VARSIGMA")
-                     if k in self.par),
-                    None,
-                )
+                stig = self._stig()
                 if stig is None:
                     raise ValueError(
                         "DDH par needs STIGMA (or STIG/VARSIGMA)"
