@@ -42,6 +42,7 @@ _DATASETS = {
     "golden9": dict(ntoa=80, start_mjd=54700.0, end_mjd=55600.0, seed=9),
     "golden10": dict(ntoa=80, start_mjd=54900.0, end_mjd=55800.0, seed=10),
     "golden11": dict(ntoa=80, start_mjd=55000.0, end_mjd=55900.0, seed=11),
+    "golden12": dict(ntoa=80, start_mjd=54950.0, end_mjd=55850.0, seed=12),
 }
 
 

@@ -21,7 +21,8 @@ Astrometry equatorial + ecliptic (+PM, +PX), DispersionDM (+DMn, +DMX),
 SolarSystemShapiro (Sun + planets), spherical solar wind (constant
 NE_SW), BinaryELL1/ELL1H/ELL1k (all three orthometric Shapiro forms,
 OMDOT/LNEDOT rotation), BinaryDD/DDS/DDH, BinaryDDGR (GR PK from
-masses), BinaryDDK (Kopeikin PM + K96 parallax coupling), BinaryBT,
+masses), BinaryDDK (Kopeikin PM + K96 parallax coupling), BinaryBT and
+BT_PIECEWISE (per-range T0X/A1X),
 Glitch (incl. exponential recovery), Wave, IFunc (SIFUNC 2), JUMP
 (flag masks), ScaleToaError (EFAC/EQUAD, for the weighted mean).
 PLRedNoise/ECORR affect fitting, not pre-fit residuals, and are
@@ -905,9 +906,38 @@ class OraclePulsar:
                 if "M2" not in pars:
                     pars["M2"] = mpf(0)
             delay += dd_delay(dt_b, frac, pars)
-        elif model in ("BT",):
+        elif model in ("BT", "BT_PIECEWISE"):
             t0_day, t0_sec = self._epoch("T0")
             dt_b = (day_tdb - t0_day) * SPD + (sec_tdb - t0_sec) - delay
+            a1_override = None
+            if model == "BT_PIECEWISE":
+                # per-range T0X/A1X overrides; range membership uses the
+                # RAW (UTC) TOA MJD, as the framework's extra_masks
+                # does.  Indices are normalized to ints: the framework
+                # folds any zero-padding to %04d (pulsar_binary.py
+                # prefix_index), so 'XR1_1' and 'XR2_0001' are one piece
+                pieces: dict[int, dict] = {}
+                for key in self.par:
+                    for pref in ("XR1_", "XR2_", "T0X_", "A1X_"):
+                        if key.startswith(pref) and \
+                                key[len(pref):].isdigit():
+                            pieces.setdefault(
+                                int(key[len(pref):]), {}
+                            )[pref] = key
+                mjd_utc = mpf(toa["day"]) + toa["frac"]
+                for i in sorted(pieces):
+                    pc = pieces[i]
+                    r1v = mpf(par_val(self.par, pc["XR1_"]))
+                    r2v = mpf(par_val(self.par, pc["XR2_"]))
+                    if not (r1v <= mjd_utc < r2v):
+                        continue
+                    if "T0X_" in pc:
+                        xd, xs = self._epoch(pc["T0X_"])
+                        dt_b = dt_b - (
+                            (xd - t0_day) * SPD + (xs - t0_sec)
+                        )
+                    if "A1X_" in pc:
+                        a1_override = self._p(pc["A1X_"])
             pb = self._p("PB") * SPD
             pbdot = self._p("PBDOT", mpf(0)) or mpf(0)
             nbdt = dt_b / pb
@@ -922,6 +952,9 @@ class OraclePulsar:
                 / mpf(SECS_PER_JULIAN_YEAR)) * dt_b
             a1 = self._p("A1") + (
                 self._p("A1DOT", mpf(0)) or mpf(0)) * dt_b
+            if a1_override is not None:
+                # framework adds m*(A1X - A1) ON TOP of the drifted a1
+                a1 = a1 + (a1_override - self._p("A1"))
             gamma = self._p("GAMMA", mpf(0)) or mpf(0)
             E = M + e * sin(M)
             for _ in range(60):

@@ -7,7 +7,7 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Eleven golden datasets span the component matrix:
+Twelve golden datasets span the component matrix:
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
@@ -22,6 +22,8 @@ Eleven golden datasets span the component matrix:
   golden9: ELL1k (explicit OMDOT/LNEDOT eccentricity rotation)
   golden10: DDS (SHAPMAX Shapiro parametrization, e=0.17)
   golden11: DDH (orthometric H3/STIGMA in the DD family)
+  golden12: BT_PIECEWISE (per-range T0X/A1X overrides) — with which
+            ALL TEN binary models are oracle-validated
 """
 
 import sys
@@ -56,7 +58,7 @@ def _framework_raw_residuals(stem):
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
              "golden6", "golden7", "golden8", "golden9", "golden10",
-             "golden11"]
+             "golden11", "golden12"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
