@@ -76,6 +76,15 @@ def set_eop_table(table: EOPTable | None):
     _table = table
 
 
+def reset_eop():
+    """Forget the loaded table AND the env-load/warn memos (tests;
+    $PINT_TPU_EOP changes)."""
+    global _table, _loaded_from_env, _warned
+    _table = None
+    _loaded_from_env = False
+    _warned = False
+
+
 def get_eop(mjd_utc):
     """(dut1_s, xp_rad, yp_rad) at mjd_utc, from the loaded table or the
     zero default."""

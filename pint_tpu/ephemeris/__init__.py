@@ -17,6 +17,13 @@ from pint_tpu.ephemeris.spk import SPK, jd_to_et, mjd_tdb_to_et  # noqa: F401
 _cache: dict = {}
 
 
+def reset_ephemeris_cache():
+    """Forget resolved kernels (tests; $PINT_TPU_EPHEM_DIR changes —
+    a cached warned-fallback BuiltinEphemeris would otherwise shadow a
+    kernel that becomes findable, and vice versa)."""
+    _cache.clear()
+
+
 def get_ephemeris(name: str = "builtin"):
     """Resolve an ephemeris by name ('builtin', 'de440', ...) or path.
 

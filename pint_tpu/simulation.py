@@ -24,25 +24,49 @@ def make_fake_toas_uniform(
     model: TimingModel,
     error_us: float = 1.0,
     freq_mhz=1400.0,
-    obs: str = "@",
+    obs="@",
     add_noise: bool = False,
     rng: Optional[np.random.Generator] = None,
     iterations: int = 3,
+    mjds=None,
 ) -> TOAs:
     """Uniformly spaced TOAs whose model phase is (near-)integer.
 
     For obs='@' the times are barycentric TDB (no ingest chain).  The
     inversion iterates: evaluate phase residual, shift each TOA by
     -resid/f; three passes land at machine-level integer phase.
+    obs may be a single code, a full per-TOA sequence (paired with the
+    given mjds, permuted together if they need sorting), or a short
+    pattern that cycles over the time-sorted grid; mjds (optional)
+    overrides the uniform grid with explicit epochs (e.g. to pin a TOA
+    onto a leap-second day).
     """
-    mjds = np.linspace(start_mjd, end_mjd, ntoa)
+    obs_list = None if isinstance(obs, str) else list(obs)
+    if mjds is None:
+        mjds = np.linspace(start_mjd, end_mjd, ntoa)
+    else:
+        mjds = np.asarray(mjds, dtype=np.float64)
+        ntoa = len(mjds)
+        order = np.argsort(mjds, kind="stable")
+        if obs_list is not None and len(obs_list) == ntoa:
+            obs_list = [obs_list[i] for i in order]  # keep the pairing
+        mjds = mjds[order]
     freq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoa,))
     t = TimeArray.from_mjd_float(mjds, scale="utc")
+    if obs_list is None:
+        obs_list = [obs] * ntoa
+    elif len(obs_list) != ntoa:
+        if len(obs_list) > ntoa:
+            raise ValueError(
+                f"obs sequence ({len(obs_list)}) longer than ntoa "
+                f"({ntoa}); pass exactly ntoa codes or a short pattern"
+            )
+        obs_list = [obs_list[i % len(obs_list)] for i in range(ntoa)]
     toas = TOAs(
         t,
         freq,
         np.full(ntoa, error_us),
-        [obs] * ntoa,
+        obs_list,
         [dict() for _ in range(ntoa)],
     )
     _ingest(toas, model)
@@ -111,22 +135,26 @@ def make_test_pulsar(
     jitter_us: float = 1.0,
     freqs=(1400.0, 800.0),
     flags=("L-wide", "S-wide"),
-    obs: str = "@",
+    obs="@",
     error_us: float = 1.0,
     iterations: int = 3,
+    mjds=None,
 ):
     """Simulated pulsar scaffold shared by benches, smoke runs, and
     tests: build the model, simulate TOAs cycling over observing
     frequencies, tag alternating receiver flags (for mask params), add
-    white jitter, ingest.  Returns (model, toas)."""
+    white jitter, ingest.  Returns (model, toas).  obs/mjds pass
+    through to make_fake_toas_uniform (per-TOA sites, explicit epochs)."""
     from pint_tpu.models.builder import get_model
 
     rng = np.random.default_rng(seed)
     model = get_model(par)
+    if mjds is not None:
+        ntoa = len(mjds)
     toas = make_fake_toas_uniform(
         start_mjd, end_mjd, ntoa, model, error_us=error_us,
         freq_mhz=np.resize(np.asarray(freqs, dtype=np.float64), ntoa),
-        obs=obs, iterations=iterations,
+        obs=obs, iterations=iterations, mjds=mjds,
     )
     for i, f in enumerate(toas.flags):
         f["f"] = flags[i % len(flags)]

@@ -66,4 +66,14 @@ def ingest_for_model(toas: TOAs, model, **kw) -> TOAs:
     kw.setdefault(
         "planets", bool(ps.value) if ps is not None else False
     )
+    # CLOCK card (reference: toa.py::get_TOAs include_bipm/bipm_version
+    # from model.CLOCK): "TT(BIPM2021)" -> that BIPM realization;
+    # "TT(TAI)" / "UTC(NIST)"-style -> plain TT(TAI).
+    clk = model.top_params.get("CLOCK")
+    clk_val = (clk.value or "").upper().replace(" ", "") if clk else ""
+    if clk_val.startswith("TT(BIPM"):
+        kw.setdefault("include_bipm", True)
+        kw.setdefault("bipm_version", clk_val[3:-1])
+    elif clk_val in ("TT(TAI)", "UTC(NIST)", "UTC"):
+        kw.setdefault("include_bipm", False)
     return ingest(toas, model=model, **kw)

@@ -43,7 +43,37 @@ _DATASETS = {
     "golden10": dict(ntoa=80, start_mjd=54900.0, end_mjd=55800.0, seed=10),
     "golden11": dict(ntoa=80, start_mjd=55000.0, end_mjd=55900.0, seed=11),
     "golden12": dict(ntoa=80, start_mjd=54950.0, end_mjd=55850.0, seed=12),
+    # golden13-15: full-ingest-chain sets (VERDICT r2 item 1) — site +
+    # gps2utc + BIPM clock files, nonzero EOP, multi-site (incl.
+    # geocenter 'coe'), SPK-kernel ephemeris, leap-second-day TOAs
+    # (54831/54832), and a barycentric '@' set.  Synthesized inside
+    # tests/ingest_env.golden_ingest_env().
+    "golden13": dict(
+        ntoa=90, start_mjd=54500.0, end_mjd=55900.0, seed=13,
+        obs=("gbt", "effelsberg", "coe"), ingest_env=True,
+        extra_mjds=(54831.37, 54832.21),
+    ),
+    "golden14": dict(
+        ntoa=90, start_mjd=54550.0, end_mjd=55850.0, seed=14,
+        obs=("gbt", "jodrell"), ingest_env=True,
+    ),
+    "golden15": dict(
+        ntoa=80, start_mjd=54700.0, end_mjd=55900.0, seed=15, obs="@",
+    ),
 }
+
+
+def _env(stem):
+    """golden_ingest_env() for the ingest-chain sets, else a no-op."""
+    import contextlib
+    import sys
+
+    if not _DATASETS[stem].get("ingest_env"):
+        return contextlib.nullcontext()
+    sys.path.insert(0, str(DATADIR.parent))
+    from ingest_env import golden_ingest_env
+
+    return golden_ingest_env()
 
 
 def regen_tim(stem: str):
@@ -53,12 +83,19 @@ def regen_tim(stem: str):
     from pint_tpu.simulation import make_test_pulsar
 
     cfg = _DATASETS[stem]
-    with warnings.catch_warnings():
+    mjds = None
+    if cfg.get("extra_mjds"):
+        mjds = np.concatenate([
+            np.linspace(cfg["start_mjd"], cfg["end_mjd"], cfg["ntoa"]),
+            cfg["extra_mjds"],
+        ])
+    with warnings.catch_warnings(), _env(stem):
         warnings.simplefilter("ignore")
         par_text = (DATADIR / f"{stem}.par").read_text()
         model, toas = make_test_pulsar(
             par_text, ntoa=cfg["ntoa"], start_mjd=cfg["start_mjd"],
-            end_mjd=cfg["end_mjd"], seed=cfg["seed"], obs="gbt",
+            end_mjd=cfg["end_mjd"], seed=cfg["seed"],
+            obs=cfg.get("obs", "gbt"), mjds=mjds,
         )
         if cfg.get("wideband"):
             cm = model.compile(toas)
@@ -78,7 +115,7 @@ def regen(stem: str):
     from pint_tpu.fitting.wideband import WidebandTOAFitter
     from pint_tpu.models.builder import get_model, get_model_and_toas
 
-    with warnings.catch_warnings():
+    with warnings.catch_warnings(), _env(stem):
         warnings.simplefilter("ignore")
         model, toas = get_model_and_toas(
             str(DATADIR / f"{stem}.par"), str(DATADIR / f"{stem}.tim")

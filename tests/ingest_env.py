@@ -1,0 +1,53 @@
+"""Shared environment context for the full-ingest-chain golden sets.
+
+golden13/14/15 are ingested through the committed synthetic clock files
+(site + gps2utc + BIPM), the nonzero Earth-orientation table, and the
+mini SPK kernel in tests/datafile/ — the chain the reference exercises
+via toa.py::TOAs.apply_clock_corrections + erfautils + real IERS data.
+This context points every $PINT_TPU_* search path at that data and
+resets the caches that memoize them, restoring everything on exit so
+the clock-less legacy sets keep their (warned) defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from pathlib import Path
+
+DATADIR = Path(__file__).parent / "datafile"
+INGEST_DIR = DATADIR / "ingest"
+
+#: stems that must be loaded inside golden_ingest_env()
+INGEST_STEMS = ("golden13", "golden14", "golden15")
+
+_ENV = {
+    "PINT_TPU_CLOCK_DIR": str(INGEST_DIR),
+    "PINT_TPU_EOP": str(INGEST_DIR / "finals_mini.all"),
+    "PINT_TPU_EPHEM_DIR": str(DATADIR),
+}
+
+
+@contextmanager
+def golden_ingest_env():
+    from pint_tpu.earth.eop import reset_eop
+    from pint_tpu.ephemeris import reset_ephemeris_cache
+    from pint_tpu.observatory import reset_registry
+
+    def _reset_all():
+        reset_registry()
+        reset_eop()
+        reset_ephemeris_cache()
+
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    _reset_all()
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _reset_all()

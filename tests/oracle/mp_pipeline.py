@@ -16,6 +16,15 @@ Reference parity: this plays the role of the reference's stored Tempo2
 residual oracles over tests/datafile/ (SURVEY.md §4): an external
 ns-level check the framework cannot fool by being self-consistent.
 
+Ingest chain (grown in r3 with golden13-15): site + gps2utc clock
+files and the TT(BIPM) realization (independent mpmath interpolation
+of the same tempo2 .clk data), Earth-orientation parameters (UT1-UTC
+in GAST, polar-motion W matrix; independent finals2000A parsing), SPK
+ephemerides (independent DAF reading + mpmath Chebyshev evaluation),
+and barycentric '@' TOAs.  With no $PINT_TPU_CLOCK_DIR/$PINT_TPU_EOP
+environment the chain degrades to the framework's warned defaults
+(zero clock, UT1=UTC, builtin analytic ephemeris).
+
 Supported components (grown with the golden datasets): Spindown,
 Astrometry equatorial + ecliptic (+PM, +PX), DispersionDM (+DMn, +DMX),
 SolarSystemShapiro (Sun + planets), spherical solar wind (constant
@@ -32,6 +41,8 @@ rather than silently mismodeling.
 
 from __future__ import annotations
 
+import os
+import struct
 from fractions import Fraction
 
 import numpy as np
@@ -139,6 +150,135 @@ def parse_dms(s):
     return sign * (
         abs(int(d)) * mpf(3600) + int(m) * 60 + mpf(sec)
     ) * ARCSEC
+
+
+# ============== ingest-chain data: clock files, EOP, SPK ================
+# Independent re-implementations of the interpolation / evaluation the
+# framework does in io/clock.py, earth/eop.py, and ephemeris/spk.py —
+# the files themselves are the shared data, the arithmetic is not.
+def parse_clk_mp(path):
+    """tempo2 .clk -> sorted [(mjd, corr_s)] as mpf."""
+    rows = []
+    for line in open(path):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        try:
+            rows.append((mpf(parts[0]), mpf(parts[1])))
+        except (ValueError, IndexError):
+            continue
+    rows.sort()
+    return rows
+
+
+def interp_clamped(rows, x):
+    """Linear interpolation, clamped at the ends (np.interp semantics)."""
+    if x <= rows[0][0]:
+        return rows[0][1]
+    if x >= rows[-1][0]:
+        return rows[-1][1]
+    for (x0, y0), (x1, y1) in zip(rows, rows[1:]):
+        if x0 <= x <= x1:
+            return y0 + (x - x0) / (x1 - x0) * (y1 - y0)
+    raise AssertionError("unreachable: rows sorted")
+
+
+def interp_zero_outside(rows, x):
+    """ClockFile.evaluate policy: zero beyond the tabulated span."""
+    if x < rows[0][0] or x > rows[-1][0]:
+        return mpf(0)
+    return interp_clamped(rows, x)
+
+
+def parse_finals_mp(path):
+    """IERS finals2000A fixed-width -> [(mjd, dut1_s, xp_rad, yp_rad)].
+
+    Same 1-indexed columns as earth/eop.py::parse_finals2000a: MJD 8-15,
+    PM-x 19-27 ("), PM-y 38-46 ("), UT1-UTC 59-68 (s).
+    """
+    rows = []
+    for line in open(path):
+        if len(line) < 68:
+            continue
+        try:
+            mjd = mpf(line[7:15].strip())
+            xp = mpf(line[18:27].strip()) * ARCSEC
+            yp = mpf(line[37:46].strip()) * ARCSEC
+            dut1 = mpf(line[58:68].strip())
+        except ValueError:
+            continue
+        rows.append((mjd, dut1, xp, yp))
+    rows.sort()
+    return rows
+
+
+class MpSpk:
+    """Minimal independent DAF/SPK type-2 reader + mpmath Chebyshev
+    evaluator (little-endian; (target, 0) segments — the mini kernel's
+    layout).  Coefficients are read with struct (byte decoding, not
+    arithmetic); position/velocity sums run in mpmath."""
+
+    def __init__(self, path):
+        data = open(path, "rb").read()
+        if data[:8] not in (b"DAF/SPK ", b"NAIF/DAF"):
+            raise ValueError(f"{path}: not DAF/SPK")
+        if not data[88:96].startswith(b"LTL-IEEE"):
+            raise NotImplementedError("oracle SPK: little-endian only")
+        nd, ni = struct.unpack("<ii", data[8:16])
+        if (nd, ni) != (2, 6):
+            raise ValueError("not an SPK summary format")
+        (fward,) = struct.unpack("<i", data[76:80])
+        ss = nd + (ni + 1) // 2
+        self.segs = {}
+        rec = fward
+        while rec > 0:
+            base = (rec - 1) * 1024
+            nxt, _prev, nsum = struct.unpack("<ddd", data[base:base + 24])
+            for k in range(int(nsum)):
+                off = base + 24 + k * ss * 8
+                ints = struct.unpack("<6i", data[off + 16:off + 40])
+                tg, ct, _fr, ty, ia, ib = ints
+                if ty != 2:
+                    raise NotImplementedError("oracle SPK: type 2 only")
+                nw = ib - ia + 1
+                words = struct.unpack(
+                    f"<{nw}d", data[(ia - 1) * 8:ib * 8]
+                )
+                init, intlen, rsize, n = words[-4:]
+                rsize, n = int(rsize), int(n)
+                ncomp = 1 if tg >= 1000000000 else 3
+                ncoef = (rsize - 2) // ncomp
+                recs = [
+                    words[i * rsize:(i + 1) * rsize] for i in range(n)
+                ]
+                self.segs[(tg, ct)] = (
+                    mpf(init), mpf(intlen), n, ncomp, ncoef, recs
+                )
+            rec = int(nxt)
+
+    def posvel_km(self, target, et):
+        """(pos_km[3], vel_km_s[3]) of target wrt SSB at ET seconds
+        past J2000 (mpf)."""
+        init, intlen, n, ncomp, ncoef, recs = self.segs[(target, 0)]
+        i = int(floor((et - init) / intlen))
+        i = min(max(i, 0), n - 1)
+        rec = recs[i]
+        mid, rad = mpf(rec[0]), mpf(rec[1])
+        tau = (et - mid) / rad
+        T = [mpf(1), tau]
+        U = [mpf(0), mpf(1)]
+        for k in range(2, ncoef):
+            T.append(2 * tau * T[k - 1] - T[k - 2])
+            U.append(2 * tau * U[k - 1] + 2 * T[k - 1] - U[k - 2])
+        pos, vel = [], []
+        for c in range(ncomp):
+            coef = rec[2 + c * ncoef:2 + (c + 1) * ncoef]
+            pos.append(sum(mpf(coef[k]) * T[k] for k in range(ncoef)))
+            vel.append(
+                sum(mpf(coef[k]) * U[k] for k in range(ncoef)) / rad
+            )
+        return np.array(pos), np.array(vel)
 
 
 # ========================= time scales ==================================
@@ -270,8 +410,9 @@ def gast(mjd_ut1_day, ut1_sec, T_tt):
     return gmst82(mjd_ut1_day, ut1_sec) + dpsi * cos(eps0 + deps) + ee_ct
 
 
-def itrf_to_gcrs_matrix(mjd_ut1_day, ut1_sec, T_tt):
-    """EOP-free chain (dut1 = xp = yp = 0, the no-data ingest default)."""
+def itrf_to_gcrs_matrix(mjd_ut1_day, ut1_sec, T_tt, xp=None, yp=None):
+    """Full chain incl. polar motion W = R1(-yp) R2(-xp); with no EOP
+    table dut1 = xp = yp = 0 (the no-data ingest default)."""
     B = bias_matrix()
     P = precession_matrix(T_tt)
     eps0 = mean_obliquity(T_tt)
@@ -279,6 +420,8 @@ def itrf_to_gcrs_matrix(mjd_ut1_day, ut1_sec, T_tt):
     N = r1(-(eps0 + deps)) @ r3(-dpsi) @ r1(eps0)
     theta = gast(mjd_ut1_day, ut1_sec, T_tt)
     M_c2t = r3(theta) @ N @ P @ B
+    if xp is not None and (xp or yp):
+        M_c2t = r1(-yp) @ r2(-xp) @ M_c2t
     return M_c2t.T
 
 
@@ -504,19 +647,129 @@ class OraclePulsar:
     def __init__(self, par_path, tim_path):
         self.par = parse_par(par_path)
         self.toas = parse_tim(tim_path)
-        from pint_tpu.observatory import get_observatory
+        from pint_tpu.observatory import TopoObs, get_observatory
 
+        bary_codes = {"@", "bat", "barycenter", "ssb"}
+        self.bary = all(
+            t["obs"].lower() in bary_codes for t in self.toas
+        )
         self.itrf = {}
+        self.site_clk = {}  # code -> clk rows or None
+        cdir = os.environ.get("PINT_TPU_CLOCK_DIR")
         for t in self.toas:
             code = t["obs"]
-            if code not in self.itrf:
-                loc = get_observatory(code).earth_location_itrf()
-                self.itrf[code] = (
-                    np.array([mpf(0)] * 3) if loc is None
-                    # mpf(float) is exact: the framework's f64 ITRF IS
-                    # the datum
-                    else np.array([mpf(float(v)) for v in loc])
+            if code in self.itrf:
+                continue
+            obs = get_observatory(code)
+            loc = obs.earth_location_itrf()
+            self.itrf[code] = (
+                np.array([mpf(0)] * 3) if loc is None
+                # mpf(float) is exact: the framework's f64 ITRF IS
+                # the datum
+                else np.array([mpf(float(v)) for v in loc])
+            )
+            # site clock chain applies to TopoObs only (geocenter /
+            # barycenter have none); missing file -> 0 (the framework
+            # warns and assumes UTC(site) == GPS)
+            self.site_clk[code] = None
+            if isinstance(obs, TopoObs) and cdir:
+                p = os.path.join(cdir, f"{obs.name}2gps.clk")
+                if os.path.exists(p):
+                    self.site_clk[code] = parse_clk_mp(p)
+        self.gps_clk = None
+        self.bipm_clk = None
+        if cdir:
+            p = os.path.join(cdir, "gps2utc.clk")
+            if os.path.exists(p):
+                self.gps_clk = parse_clk_mp(p)
+            # same normalization as toas/ingest.py::ingest_for_model
+            clock_card = (
+                (par_val(self.par, "CLOCK") or "")
+                .upper().replace(" ", "")
+            )
+            version = "BIPM2021"
+            include_bipm = True
+            if clock_card.startswith("TT(BIPM"):
+                version = clock_card[3:-1]
+            elif clock_card in ("TT(TAI)", "UTC(NIST)", "UTC"):
+                include_bipm = False
+            if include_bipm:
+                p = os.path.join(
+                    cdir, f"tai2tt_{version.lower()}.clk"
                 )
+                if os.path.exists(p):
+                    self.bipm_clk = parse_clk_mp(p)
+        self.eop = None
+        eop_path = os.environ.get("PINT_TPU_EOP")
+        if eop_path and os.path.exists(eop_path):
+            self.eop = parse_finals_mp(eop_path)
+        # ephemeris: par EPHEM card -> independent SPK evaluation; no
+        # card / 'builtin' -> the analytic VSOP87/Kepler theory above
+        self.spk = None
+        ephem = par_val(self.par, "EPHEM")
+        if ephem and ephem.lower() not in ("builtin", "none"):
+            edir = os.environ.get("PINT_TPU_EPHEM_DIR")
+            cands = [ephem]
+            if edir:
+                cands.append(
+                    os.path.join(edir, f"{ephem.lower()}.bsp")
+                )
+            cands.append(f"{ephem.lower()}.bsp")
+            for c in cands:
+                if os.path.exists(c):
+                    self.spk = MpSpk(c)
+                    break
+            else:
+                raise NotImplementedError(
+                    f"oracle: EPHEM {ephem} kernel not found "
+                    "(set $PINT_TPU_EPHEM_DIR); refusing the builtin "
+                    "fallback the framework would warn about"
+                )
+
+    def _clock_corr(self, code, raw_mjd):
+        """Site + GPS clock correction (seconds), evaluated at the raw
+        (pre-correction) UTC MJD like the framework's ingest."""
+        from pint_tpu.observatory import TopoObs, get_observatory
+
+        if not isinstance(get_observatory(code), TopoObs):
+            return mpf(0)  # special locations: no clock chain
+        corr = mpf(0)
+        if self.site_clk.get(code) is not None:
+            corr += interp_zero_outside(self.site_clk[code], raw_mjd)
+        if self.gps_clk is not None:
+            corr += interp_zero_outside(self.gps_clk, raw_mjd)
+        return corr
+
+    def _eop_at(self, raw_mjd):
+        """(dut1_s, xp_rad, yp_rad), linearly interpolated, clamped."""
+        if self.eop is None:
+            return mpf(0), mpf(0), mpf(0)
+        rows = self.eop
+        if raw_mjd <= rows[0][0]:
+            return rows[0][1:]
+        if raw_mjd >= rows[-1][0]:
+            return rows[-1][1:]
+        for a, b in zip(rows, rows[1:]):
+            if a[0] <= raw_mjd <= b[0]:
+                w = (raw_mjd - a[0]) / (b[0] - a[0])
+                return tuple(
+                    a[k] + w * (b[k] - a[k]) for k in (1, 2, 3)
+                )
+        raise AssertionError("unreachable: rows sorted")
+
+    def _earth_posvel_km(self, day_tdb, sec_tdb):
+        """SSB->geocenter (pos km, vel km/s), SPK or builtin."""
+        if self.spk is not None:
+            et = (day_tdb - mpf("51544.5")) * SPD + sec_tdb
+            return self.spk.posvel_km(399, et)
+        T = tt_centuries(day_tdb, sec_tdb)
+        return posvel(earth_ssb_eq_km, T)
+
+    def _sun_pos_km(self, day_tdb, sec_tdb):
+        if self.spk is not None:
+            et = (day_tdb - mpf("51544.5")) * SPD + sec_tdb
+            return self.spk.posvel_km(10, et)[0]
+        return sun_ssb_eq_km(tt_centuries(day_tdb, sec_tdb))
 
     def _p(self, key, default=None):
         v = par_val(self.par, key, default)
@@ -609,32 +862,51 @@ class OraclePulsar:
 
     @_with_dps
     def _one_residual_raw(self, toa):
-        # -- clock chain: no site clock data -> 0; UTC -> TT -----------
-        day_utc, sec_utc = toa["day"], toa["frac"] * SPD
-        day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
-        T_tt = tt_centuries(day_tt, sec_tt)
+        zero3 = np.array([mpf(0)] * 3)
+        if self.bary:
+            # barycentric '@' TOAs: arrival times ARE TDB at the SSB;
+            # no clock chain, zero geometry (ingest_barycentric)
+            day_tdb, sec_tdb = toa["day"], toa["frac"] * SPD
+            r_ls, sun_ls = zero3, None
+        else:
+            # -- clock chain: site + GPS at the raw UTC MJD ------------
+            raw_mjd = mpf(toa["day"]) + toa["frac"]
+            clk = self._clock_corr(toa["obs"], raw_mjd)
+            day_utc, sec_utc = norm_day_sec(
+                toa["day"], toa["frac"] * SPD + clk
+            )
+            day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
+            # TT(BIPM) realization, evaluated (like the framework) at
+            # the raw UTC MJD
+            if self.bipm_clk is not None:
+                day_tt, sec_tt = norm_day_sec(
+                    day_tt,
+                    sec_tt + interp_zero_outside(self.bipm_clk, raw_mjd),
+                )
+            T_tt = tt_centuries(day_tt, sec_tt)
 
-        # -- observatory GCRS (EOP-free; UT1 = UTC) --------------------
-        M = itrf_to_gcrs_matrix(day_utc, sec_utc, T_tt)
-        itrf = self.itrf[toa["obs"]]
-        obs_pos = M @ itrf  # meters
-        omega = np.array([mpf(0), mpf(0), OMEGA_EARTH])
-        obs_vel = M @ np.cross(omega, itrf)
+            # -- observatory GCRS (UT1 = UTC + dut1; polar motion) -----
+            dut1, xp, yp = self._eop_at(raw_mjd)
+            M = itrf_to_gcrs_matrix(
+                day_utc, sec_utc + dut1, T_tt, xp, yp
+            )
+            itrf = self.itrf[toa["obs"]]
+            obs_pos = M @ itrf  # meters
+            omega = np.array([mpf(0), mpf(0), OMEGA_EARTH])
+            obs_vel = M @ np.cross(omega, itrf)
 
-        # -- TT -> TDB: geocentric series + topocentric term -----------
-        day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
-        T1 = tt_centuries(day_tdb, sec_tdb)
-        _, evel_km = posvel(earth_ssb_eq_km, T1)
-        topo = (evel_km * 1000) @ obs_pos / mpf(C) ** 2
-        day_tdb, sec_tdb = norm_day_sec(day_tdb, sec_tdb + topo)
+            # -- TT -> TDB: geocentric series + topocentric term -------
+            day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
+            _, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
+            topo = (evel_km * 1000) @ obs_pos / mpf(C) ** 2
+            day_tdb, sec_tdb = norm_day_sec(day_tdb, sec_tdb + topo)
 
-        # -- SSB geometry ----------------------------------------------
-        T2 = tt_centuries(day_tdb, sec_tdb)
-        epos_km, evel_km = posvel(earth_ssb_eq_km, T2)
-        ssb_obs_m = epos_km * 1000 + obs_pos
-        sun_m = sun_ssb_eq_km(T2) * 1000 - ssb_obs_m
-        r_ls = ssb_obs_m / mpf(C)
-        sun_ls = sun_m / mpf(C)
+            # -- SSB geometry ------------------------------------------
+            epos_km, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
+            ssb_obs_m = epos_km * 1000 + obs_pos
+            sun_m = self._sun_pos_km(day_tdb, sec_tdb) * 1000 - ssb_obs_m
+            r_ls = ssb_obs_m / mpf(C)
+            sun_ls = sun_m / mpf(C)
 
         # -- astrometry: Roemer + parallax ------------------------------
         if "POSEPOCH" in self.par:
@@ -657,7 +929,8 @@ class OraclePulsar:
                 (rr - rn_) / mpf(AU_LIGHT_SEC)
             )
 
-        delay += shapiro(sun_ls, GM_SUN)
+        if sun_ls is not None:
+            delay += shapiro(sun_ls, GM_SUN)  # r=0 bary rows: skipped
         ps_tokens = self.par.get("PLANET_SHAPIRO")
         # mirror the framework's s_to_bool truthiness; a bare line
         # (no value) means True there too
@@ -666,7 +939,13 @@ class OraclePulsar:
             or ps_tokens[0][0].strip().upper() in
             ("Y", "YES", "T", "TRUE", "1")
         )
-        if planet_shapiro:
+        if planet_shapiro and not self.bary:
+            if self.spk is not None:
+                raise NotImplementedError(
+                    "oracle PLANET_SHAPIRO over an SPK kernel: the "
+                    "mini kernel carries no planets"
+                )
+            T2 = tt_centuries(day_tdb, sec_tdb)
             for body, gm in (
                 ("venus", GM_VENUS), ("jupiter", GM_JUPITER),
                 ("saturn", GM_SATURN), ("uranus", GM_URANUS),
@@ -680,6 +959,10 @@ class OraclePulsar:
         if any(f"NE_SW{k}" in self.par for k in range(1, 6)):
             raise NotImplementedError(
                 "oracle models constant NE_SW only (no NE_SW1.. Taylor)"
+            )
+        if "NE_SW" in self.par and self.bary:
+            raise NotImplementedError(
+                "oracle: NE_SW with barycentric TOAs is undefined"
             )
         if "NE_SW" in self.par:
             d_sun = sqrt(sun_ls @ sun_ls)
@@ -703,8 +986,12 @@ class OraclePulsar:
                 dm += (self._p(f"DM{k}")
                        / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt_dm**k / fact
                 k += 1
-        # DMX piecewise offsets
-        mjd_f = mpf(day_tdb) + sec_tdb / SPD
+        # DMX piecewise offsets; range membership uses the RAW (UTC)
+        # TOA MJD like the framework's static masks (dispersion.py::
+        # dmx_masks over toas.mjd_float()) and the reference's
+        # toa_select — NOT the TDB time (caught by the golden14
+        # boundary TOA sitting 1e-9 day before DMXR1 in UTC)
+        mjd_f = mpf(toa["day"]) + toa["frac"]
         for key in self.par:
             if key.startswith("DMX_"):
                 idx = key[4:]
