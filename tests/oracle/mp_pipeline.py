@@ -682,9 +682,11 @@ class OraclePulsar:
             p = os.path.join(cdir, "gps2utc.clk")
             if os.path.exists(p):
                 self.gps_clk = parse_clk_mp(p)
-            # same normalization as toas/ingest.py::ingest_for_model
+            # same normalization as toas/ingest.py::ingest_for_model;
+            # CLK is the framework's alias for CLOCK (timing_model.py)
             clock_card = (
-                (par_val(self.par, "CLOCK") or "")
+                (par_val(self.par, "CLOCK")
+                 or par_val(self.par, "CLK") or "")
                 .upper().replace(" ", "")
             )
             version = "BIPM2021"
@@ -772,8 +774,18 @@ class OraclePulsar:
         return sun_ssb_eq_km(tt_centuries(day_tdb, sec_tdb))
 
     def _p(self, key, default=None):
+        ov = getattr(self, "overrides", None)
+        if ov and key in ov:
+            return ov[key]
         v = par_val(self.par, key, default)
         return None if v is None else mpf(v)
+
+    def set_overrides(self, values: dict):
+        """Parameter overrides for the fit-level oracle (mp_fit.py):
+        {name: mpf} in par-file value units (RAJ/DECJ in radians —
+        their parsed representation).  Consulted by _p, _psr_dir, and
+        the JUMPn read; None/{} restores the par-file values."""
+        self.overrides = dict(values or {})
 
     def _stig(self):
         """STIGMA under any of its aliases, or None."""
@@ -816,10 +828,19 @@ class OraclePulsar:
 
     @staticmethod
     def _mask_match(toa, args):
-        """maskParameter selection: '-f L-wide <val>' style."""
+        """maskParameter selection: '-f L-wide <val>' style flag
+        masks, or a bare value applying to all TOAs.  The framework
+        also supports mjd/freq/tel keys (parameter.py::
+        maskParameter.select); the oracle refuses those rather than
+        silently applying the parameter to every TOA."""
         if args[0].startswith("-"):
             flag, val = args[0][1:], args[1]
             return toa["flags"].get(flag) == val
+        if args[0].lower() in ("mjd", "freq", "tel"):
+            raise NotImplementedError(
+                f"oracle mask selections support flag keys only, "
+                f"got {args[0]!r}"
+            )
         return True  # bare value: applies to all
 
     def _psr_dir(self, dt_pos):
@@ -832,9 +853,14 @@ class OraclePulsar:
         def pm(key):
             return (self._p(key) * masyr if key in self.par else mpf(0))
 
+        ov = getattr(self, "overrides", {})
         if "RAJ" in self.par:
-            ra = parse_hms(par_val(self.par, "RAJ"))
-            dec = parse_dms(par_val(self.par, "DECJ"))
+            ra = ov.get("RAJ", None)
+            if ra is None:
+                ra = parse_hms(par_val(self.par, "RAJ"))
+            dec = ov.get("DECJ", None)
+            if dec is None:
+                dec = parse_dms(par_val(self.par, "DECJ"))
             pmra, pmdec = pm("PMRA"), pm("PMDEC")
             if (pmra or pmdec) and "POSEPOCH" not in self.par:
                 raise ValueError("oracle needs POSEPOCH when PM is set")
@@ -861,52 +887,80 @@ class OraclePulsar:
         return np.array([x, ce * y - se * z, se * y + ce * z])
 
     @_with_dps
-    def _one_residual_raw(self, toa):
+    def _ingest_toa(self, toa):
+        """Parameter-independent ingest (clock -> TT -> TDB -> SSB
+        geometry) for one TOA, memoized: the fit-level oracle
+        (mp_fit.py) re-evaluates residuals under parameter
+        perturbations hundreds of times, and none of the perturbed
+        parameters can change these products (they depend only on the
+        TOA, the clock/EOP tables, and the ephemeris — exactly like
+        the framework's host-side ingest columns)."""
+        key = (toa["day"], str(toa["frac"]), toa["obs"])
+        cache = getattr(self, "_ingest_cache", None)
+        if cache is None:
+            cache = self._ingest_cache = {}
+        if key in cache:
+            return cache[key]
+        cache[key] = out = self._ingest_toa_uncached(toa)
+        return out
+
+    def _ingest_toa_uncached(self, toa):
         zero3 = np.array([mpf(0)] * 3)
         if self.bary:
             # barycentric '@' TOAs: arrival times ARE TDB at the SSB;
             # no clock chain, zero geometry (ingest_barycentric)
             day_tdb, sec_tdb = toa["day"], toa["frac"] * SPD
-            r_ls, sun_ls = zero3, None
-        else:
-            # -- clock chain: site + GPS at the raw UTC MJD ------------
-            raw_mjd = mpf(toa["day"]) + toa["frac"]
-            clk = self._clock_corr(toa["obs"], raw_mjd)
-            day_utc, sec_utc = norm_day_sec(
-                toa["day"], toa["frac"] * SPD + clk
+            return dict(
+                day_tdb=day_tdb, sec_tdb=sec_tdb, r_ls=zero3,
+                sun_ls=None, ssb_obs_m=None,
             )
-            day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
-            # TT(BIPM) realization, evaluated (like the framework) at
-            # the raw UTC MJD
-            if self.bipm_clk is not None:
-                day_tt, sec_tt = norm_day_sec(
-                    day_tt,
-                    sec_tt + interp_zero_outside(self.bipm_clk, raw_mjd),
-                )
-            T_tt = tt_centuries(day_tt, sec_tt)
-
-            # -- observatory GCRS (UT1 = UTC + dut1; polar motion) -----
-            dut1, xp, yp = self._eop_at(raw_mjd)
-            M = itrf_to_gcrs_matrix(
-                day_utc, sec_utc + dut1, T_tt, xp, yp
+        # -- clock chain: site + GPS at the raw UTC MJD ------------
+        raw_mjd = mpf(toa["day"]) + toa["frac"]
+        clk = self._clock_corr(toa["obs"], raw_mjd)
+        day_utc, sec_utc = norm_day_sec(
+            toa["day"], toa["frac"] * SPD + clk
+        )
+        day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
+        # TT(BIPM) realization, evaluated (like the framework) at
+        # the raw UTC MJD
+        if self.bipm_clk is not None:
+            day_tt, sec_tt = norm_day_sec(
+                day_tt,
+                sec_tt + interp_zero_outside(self.bipm_clk, raw_mjd),
             )
-            itrf = self.itrf[toa["obs"]]
-            obs_pos = M @ itrf  # meters
-            omega = np.array([mpf(0), mpf(0), OMEGA_EARTH])
-            obs_vel = M @ np.cross(omega, itrf)
+        T_tt = tt_centuries(day_tt, sec_tt)
 
-            # -- TT -> TDB: geocentric series + topocentric term -------
-            day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
-            _, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
-            topo = (evel_km * 1000) @ obs_pos / mpf(C) ** 2
-            day_tdb, sec_tdb = norm_day_sec(day_tdb, sec_tdb + topo)
+        # -- observatory GCRS (UT1 = UTC + dut1; polar motion) -----
+        dut1, xp, yp = self._eop_at(raw_mjd)
+        M = itrf_to_gcrs_matrix(
+            day_utc, sec_utc + dut1, T_tt, xp, yp
+        )
+        itrf = self.itrf[toa["obs"]]
+        obs_pos = M @ itrf  # meters
 
-            # -- SSB geometry ------------------------------------------
-            epos_km, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
-            ssb_obs_m = epos_km * 1000 + obs_pos
-            sun_m = self._sun_pos_km(day_tdb, sec_tdb) * 1000 - ssb_obs_m
-            r_ls = ssb_obs_m / mpf(C)
-            sun_ls = sun_m / mpf(C)
+        # -- TT -> TDB: geocentric series + topocentric term -------
+        day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
+        _, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
+        topo = (evel_km * 1000) @ obs_pos / mpf(C) ** 2
+        day_tdb, sec_tdb = norm_day_sec(day_tdb, sec_tdb + topo)
+
+        # -- SSB geometry ------------------------------------------
+        epos_km, evel_km = self._earth_posvel_km(day_tdb, sec_tdb)
+        ssb_obs_m = epos_km * 1000 + obs_pos
+        sun_m = self._sun_pos_km(day_tdb, sec_tdb) * 1000 - ssb_obs_m
+        r_ls = ssb_obs_m / mpf(C)
+        sun_ls = sun_m / mpf(C)
+        return dict(
+            day_tdb=day_tdb, sec_tdb=sec_tdb, r_ls=r_ls,
+            sun_ls=sun_ls, ssb_obs_m=ssb_obs_m,
+        )
+
+    @_with_dps
+    def _one_residual_raw(self, toa):
+        ing = self._ingest_toa(toa)
+        day_tdb, sec_tdb = ing["day_tdb"], ing["sec_tdb"]
+        r_ls, sun_ls = ing["r_ls"], ing["sun_ls"]
+        ssb_obs_m = ing["ssb_obs_m"]
 
         # -- astrometry: Roemer + parallax ------------------------------
         if "POSEPOCH" in self.par:
@@ -998,7 +1052,7 @@ class OraclePulsar:
                 r1v = mpf(par_val(self.par, f"DMXR1_{idx}"))
                 r2v = mpf(par_val(self.par, f"DMXR2_{idx}"))
                 if r1v <= mjd_f <= r2v:
-                    dm += mpf(par_val(self.par, key))
+                    dm += self._p(key)
         delay += mpf(DM_CONST) * dm / toa["freq"] ** 2
 
         # -- binary -----------------------------------------------------
@@ -1174,8 +1228,13 @@ class OraclePulsar:
                 if "PX" in self.par and k96_on:
                     px = self._p("PX") * mpf(MAS_TO_RAD)
                     d_ls = mpf(AU_LIGHT_SEC) / px
-                    ra = parse_hms(par_val(self.par, "RAJ"))
-                    dec = parse_dms(par_val(self.par, "DECJ"))
+                    ov = getattr(self, "overrides", {})
+                    ra = ov.get("RAJ", None)
+                    if ra is None:
+                        ra = parse_hms(par_val(self.par, "RAJ"))
+                    dec = ov.get("DECJ", None)
+                    if dec is None:
+                        dec = parse_dms(par_val(self.par, "DECJ"))
                     east = np.array([-sin(ra), cos(ra), mpf(0)])
                     north = np.array([
                         -cos(ra) * sin(dec), -sin(ra) * sin(dec),
@@ -1268,10 +1327,20 @@ class OraclePulsar:
             k += 1
         phase = taylor_phase(dt, coeffs)
         f0_f64 = mpf(float(coeffs[0]))  # kernels consume F0 as f64
-        # JUMP (PhaseJump convention): J seconds = -J*F0 cycles
-        for args in self.par.get("JUMP", []):
-            if args[0].startswith("-") and self._mask_match(toa, args):
-                phase += -mpf(args[2]) * f0_f64
+        # JUMP (PhaseJump convention): J seconds = -J*F0 cycles;
+        # JUMPn override names mirror the framework's maskParameter
+        # indexing (models/jump.py: 1-based line order)
+        for j_idx, args in enumerate(self.par.get("JUMP", []), start=1):
+            if not args[0].startswith("-"):
+                raise NotImplementedError(
+                    "oracle JUMP supports flag masks only, got "
+                    f"{' '.join(args)!r}"
+                )
+            if self._mask_match(toa, args):
+                jval = self._p(f"JUMP{j_idx}", None)
+                if jval is None:
+                    jval = mpf(args[2])
+                phase += -jval * f0_f64
 
         # -- glitches (phase; dt includes the delay, models/glitch.py) --
         # index sets may be gapped (the framework sorts whatever
