@@ -96,15 +96,24 @@ def _solve_normal_eqs(cinv_mult, r, M, normalized_cov=False):
                               normalized_cov)
 
 
+def woodbury_sigma(Ndiag, T, phi):
+    """(Ninv, TN = N^-1 T, Sigma = phi^-1 + T^T N^-1 T) — the ONE
+    assembly of the Woodbury inner system, shared by make_cinv_mult
+    and the Bayesian marginalized likelihood (which also needs Sigma's
+    Cholesky for ln det C)."""
+    Ninv = 1.0 / Ndiag
+    TN = T * Ninv[:, None]  # (n, k)
+    Sigma = jnp.diag(1.0 / phi) + T.T @ TN
+    return Ninv, TN, Sigma
+
+
 def make_cinv_mult(Ndiag, T, phi):
     """Build X -> C^-1 X for C = diag(Ndiag) + T diag(phi) T^T via the
     Woodbury identity.  The single shared implementation: the GLS
-    proposal, the downhill acceptance objective, and wideband all use
-    this builder so the factorization can never diverge between them."""
-    Ninv = 1.0 / Ndiag
-    # Sigma = phi^-1 + T^T N^-1 T  (k x k)
-    TN = T * Ninv[:, None]  # N^-1 T  (n,k)
-    Sigma = jnp.diag(1.0 / phi) + T.T @ TN
+    proposal, the downhill acceptance objective, wideband, and the
+    Bayesian likelihood all build on woodbury_sigma so the
+    factorization can never diverge between them."""
+    Ninv, TN, Sigma = woodbury_sigma(Ndiag, T, phi)
 
     def cinv_mult(X):
         NX = X * Ninv[:, None]
@@ -236,26 +245,40 @@ def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
 
     method='f64' (CPU default): explicit f64 Cholesky.
     method='mixed' (accelerator default): equilibrated f32 MXU Cholesky
-    + iterative refinement (ops/ffgram.py::chol_solve_ir, whose
-    refinement residuals use the split-f32 matmul above n=1024), one
-    factorization applied to [Mn | r] jointly — an emulated-f64 n x n
-    Cholesky is ~300x slower than f32 on TPU.  Same validated
-    tolerance class as the reduced-rank mixed paths
-    (_woodbury_mixed_tail)."""
+    + iterative refinement with the TRUE operator applied through its
+    Woodbury structure (ops/ffgram.py::woodbury_chol_solve_ir) — the
+    dense f64 covariance is never materialized, so n=16384 fits a
+    16 GB chip (~2 n^2 f32 bytes vs the ~6x dense-f64 route that
+    OOMed at 27 GB); an emulated-f64 n x n Cholesky is ~300x slower
+    than f32 on TPU.  Same validated tolerance class as the
+    reduced-rank mixed paths (_woodbury_mixed_tail)."""
     from pint_tpu.models.noise import dense_noise_cov
 
     if method is None:
         method = "f64" if jax.default_backend() == "cpu" else "mixed"
+    if method == "mixed" and T is not None:
+        from pint_tpu.ops.ffgram import (
+            matmul_split32, woodbury_chol_solve_ir,
+        )
+
+        norm = _column_norms(M)
+        Mn = M / norm[None, :]
+        X = jnp.concatenate([Mn, r[:, None]], axis=1)
+        CiX = woodbury_chol_solve_ir(Ndiag, T, phi, X)
+        # X^T C^-1 X on the MXU (an n x (p+1) emulated-f64 matmul
+        # would cost more than the factorization on TPU)
+        G = matmul_split32(X.T, CiX)
+        return _finish_normal_eqs(
+            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
+        )
     C = dense_noise_cov(Ndiag, T, phi)
-    if method == "mixed":
+    if method == "mixed":  # pure-white C: small/diagonal, dense is fine
         from pint_tpu.ops.ffgram import chol_solve_ir, matmul_split32
 
         norm = _column_norms(M)
         Mn = M / norm[None, :]
         X = jnp.concatenate([Mn, r[:, None]], axis=1)
         CiX = chol_solve_ir(C, X)
-        # X^T C^-1 X on the MXU (an n x (p+1) emulated-f64 matmul
-        # would cost more than the factorization on TPU)
         G = matmul_split32(X.T, CiX)
         return _finish_normal_eqs(
             G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov

@@ -1,0 +1,71 @@
+"""Mesh-scaling measurement for the sharded dense-covariance path.
+
+VERDICT r2 item 3: report blocked-Cholesky / full-cov GLS scaling vs
+mesh size.  Runs on the virtual CPU mesh (XLA_FLAGS device-count
+override) since multi-chip TPU hardware is unavailable; the virtual
+devices share host cores, so reported speedups are a LOWER bound on
+real-ICI scaling (thread-level parallelism + partitioning overheads,
+no real interconnect).  Artifact: one JSON line per (n, mesh) point.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python profiling/sharded_dense_scaling.py
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from pint_tpu.parallel.dense import sharded_gls_step_full_cov
+
+    n, p, k = 6144, 8, 40
+    rng = np.random.default_rng(0)
+    r = jnp.asarray(rng.normal(0, 1e-6, n))
+    M = jnp.asarray(rng.normal(size=(n, p)))
+    Nd = jnp.asarray(rng.uniform(0.5e-12, 2e-12, n))
+    T = jnp.asarray(rng.normal(size=(n, k)))
+    phi = jnp.asarray(1e-12 * np.arange(1, k + 1, dtype=float) ** -2.0)
+
+    devs = jax.devices()
+    for nmesh in (1, 2, 4, 8):
+        if nmesh > len(devs):
+            break
+        mesh = Mesh(np.array(devs[:nmesh]), ("toa",))
+        fn = jax.jit(
+            lambda *a: sharded_gls_step_full_cov(
+                mesh, *a, method="f64", block=768
+            )
+        )
+        out = fn(r, M, Nd, T, phi)
+        _ = np.asarray(out[0])
+        ts = []
+        for _i in range(3):
+            t0 = time.perf_counter()
+            out = fn(r, M, Nd, T, phi)
+            _ = np.asarray(out[0])
+            ts.append(time.perf_counter() - t0)
+        t = float(np.median(ts))
+        print(json.dumps({
+            "bench": "sharded_dense_full_cov_f64",
+            "n": n, "mesh_devices": nmesh, "block": 768,
+            "step_s": round(t, 3),
+            "model_tflops_per_s": round(n**3 / 3 / t / 1e12, 4),
+        }))
+
+
+if __name__ == "__main__":
+    main()
