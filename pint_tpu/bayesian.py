@@ -1,11 +1,20 @@
 """Bayesian timing: log-likelihood / log-prior / prior-transform.
 
-Reference parity: src/pint/bayesian.py::BayesianTiming — white-noise
-likelihood over the compiled residual kernels, per-parameter priors,
-prior transform for nested samplers.  TPU-first: lnpost is one jitted
-pure function of the delta vector x, so it vmaps across walkers — the
+Reference parity: src/pint/bayesian.py::BayesianTiming — likelihood
+over the compiled residual kernels, per-parameter priors, prior
+transform for nested samplers.  TPU-first: lnpost is one jitted pure
+function of the delta vector x, so it vmaps across walkers — the
 ensemble sampler in pint_tpu.sampler runs every walker in parallel on
 device (the reference hands single-point callables to emcee).
+
+Correlated noise (PL red / ECORR / ...) is marginalized analytically
+with the same Woodbury identity the GLS fitter factorizes through
+(fitting/gls.py): lnL = -1/2 [r^T C^-1 r + ln det C + n ln 2pi] with
+C = N + T phi T^T evaluated via a k x k Cholesky — never an n x n
+array, so the per-walker cost is O(n k) and the whole ensemble still
+vmaps.  Because phi/N come from the pdict, noise HYPER-parameters
+(TNREDAMP/TNREDGAM, EFAC/EQUAD) marked free in the par file are
+sampled too — the enterprise-class marginalized likelihood.
 
 The priors act on x (delta from the par-file reference values, internal
 units), matching the fitters' parameterization.
@@ -45,15 +54,41 @@ class BayesianTiming:
 
     # -- pieces -----------------------------------------------------------
     def lnlikelihood(self, x):
-        """Gaussian white-noise likelihood of the timing residuals
-        (jit/vmap-safe)."""
+        """Gaussian likelihood of the timing residuals (jit/vmap-safe).
+
+        White noise: diagonal.  Correlated noise: Woodbury-
+        marginalized — rCr = r N^-1 r - z^T z with z the k-vector
+        whitened through the Cholesky of Sigma = phi^-1 + T^T N^-1 T,
+        and ln det C = ln det N + ln det phi + ln det Sigma (matrix
+        determinant lemma).  Sigma comes from the fitters' shared
+        assembly (fitting/gls.py::woodbury_sigma) so sampler and
+        fitter can never disagree on the marginalization.
+        """
+        from pint_tpu.fitting.gls import woodbury_sigma
+
         r = self.cm.time_residuals(x)
         sig = self.cm.scaled_sigma(x)
-        return (
-            -0.5 * jnp.sum(jnp.square(r / sig))
-            - jnp.sum(jnp.log(sig))
-            - 0.5 * r.shape[-1] * jnp.log(2.0 * jnp.pi)
+        n = r.shape[-1]
+        if not self.cm.has_correlated_errors:
+            return (
+                -0.5 * jnp.sum(jnp.square(r / sig))
+                - jnp.sum(jnp.log(sig))
+                - 0.5 * n * jnp.log(2.0 * jnp.pi)
+            )
+        T, phi = self.cm.noise_basis_or_empty(x)
+        Ninv, _TN, Sigma = woodbury_sigma(jnp.square(sig), T, phi)
+        Ninv_r = r * Ninv
+        L = jnp.linalg.cholesky(Sigma)
+        z = jax.scipy.linalg.solve_triangular(
+            L, T.T @ Ninv_r, lower=True
         )
+        rCr = jnp.dot(r, Ninv_r) - jnp.dot(z, z)
+        logdet_C = (
+            2.0 * jnp.sum(jnp.log(sig))
+            + jnp.sum(jnp.log(phi))
+            + 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
+        )
+        return -0.5 * (rCr + logdet_C + n * jnp.log(2.0 * jnp.pi))
 
     def lnprior(self, x):
         """Sum of per-parameter log-priors; jax-traceable for the

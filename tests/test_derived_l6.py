@@ -351,3 +351,113 @@ def test_fitter_get_derived_params():
     assert "P0 = 0.01" in out
     assert "tau_c" in out and "B_surf" in out
     assert "mass function" in out
+
+
+# -- correlated-noise (Woodbury-marginalized) Bayesian --------------------
+def _mk(par, n):
+    from pint_tpu.simulation import make_test_pulsar
+
+    return make_test_pulsar(par, ntoa=n, start_mjd=54200,
+                            end_mjd=56200, seed=42)
+
+
+def test_correlated_lnlike_matches_dense():
+    """The Woodbury-marginalized lnlikelihood equals the dense
+    multivariate-normal evaluation (small n, exact formula)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.bayesian import BayesianTiming
+
+    par = (
+        "PSR LNL\nF0 101.3 1\nF1 -2e-15 1\nPEPOCH 55000\nDM 7.7 1\n"
+        "EFAC -f L-wide 1.2\nTNREDAMP -13.2\nTNREDGAM 3.1\nTNREDC 6\n"
+    )
+    m, toas = _mk(par, n=90)
+    bt = BayesianTiming(m, toas)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = rng.normal(0.0, 1.0, bt.nparams) * np.array(
+            [1e-10, 1e-18, 1e-5][: bt.nparams]
+        )
+        ln_w = float(bt.lnlikelihood(jnp.asarray(x)))
+        # dense reference
+        r = np.asarray(bt.cm.time_residuals(jnp.asarray(x)))
+        C = np.asarray(bt.cm.noise_covariance(jnp.asarray(x)))
+        sign, logdet = np.linalg.slogdet(C)
+        ln_dense = float(
+            -0.5 * (r @ np.linalg.solve(C, r) + logdet
+                    + len(r) * np.log(2 * np.pi))
+        )
+        assert ln_w == pytest.approx(ln_dense, rel=1e-10, abs=1e-6)
+
+
+def test_mcmc_correlated_noise_matches_gls_golden1():
+    """MCMC with the marginalized likelihood on golden1 (PL red noise,
+    TNREDC=10) recovers parameters consistent with the GLS fit
+    (VERDICT r2 item 6)."""
+    import warnings
+    from pathlib import Path
+
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.models.builder import get_model, get_model_and_toas
+    from pint_tpu.sampler import MCMCFitter
+
+    datadir = Path(__file__).parent / "datafile"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, toas = get_model_and_toas(
+            str(datadir / "golden1.par"), str(datadir / "golden1.tim")
+        )
+        g = GLSFitter(toas, get_model(str(datadir / "golden1.par")),
+                      fused=False)
+        g.fit_toas(maxiter=3)
+
+        mf = MCMCFitter(toas, get_model(str(datadir / "golden1.par")))
+        mf.fit_toas(nsteps=500, nwalkers=32, seed=3)
+    assert 0.05 < mf.acceptance < 0.95
+    samples = mf.get_posterior_samples()
+    for name in ("F0", "F1", "DM"):
+        i = mf.bt.param_names.index(name)
+        p = g.model.params[name]
+        sigma = float(p.uncertainty)
+        v_gls = p.value
+        v_gls = float(
+            v_gls.to_float() if hasattr(v_gls, "to_float") else v_gls
+        )
+        v_ref = mf.model.params[name]
+        v_mcmc = v_ref.value
+        v_mcmc = float(
+            v_mcmc.to_float() if hasattr(v_mcmc, "to_float") else v_mcmc
+        )
+        assert abs(v_mcmc - v_gls) < 5 * sigma, name
+        # marginalized posterior width ~ GLS uncertainty
+        assert np.std(samples[:, i]) * _scale(v_ref) == pytest.approx(
+            sigma, rel=0.6
+        ), name
+
+
+def _scale(p):
+    """x-space (internal) std -> par-unit std conversion factor."""
+    return 1.0 / p.scale_to_internal
+
+
+def test_free_noise_hyperparameter_sampled():
+    """A free TNREDAMP enters x and moves the marginalized likelihood
+    — noise hyper-parameter sampling works end to end."""
+    import jax.numpy as jnp
+
+    from pint_tpu.bayesian import BayesianTiming
+
+    par = (
+        "PSR HYP\nF0 88.8 1\nPEPOCH 55000\nDM 3.3\n"
+        "EFAC -f L-wide 1.1\nTNREDAMP -13.2 1\nTNREDGAM 3.5\nTNREDC 5\n"
+    )
+    m, toas = _mk(par, n=70)
+    bt = BayesianTiming(m, toas)
+    assert "TNREDAMP" in bt.param_names
+    i = bt.param_names.index("TNREDAMP")
+    x = np.zeros(bt.nparams)
+    l0 = float(bt.lnlikelihood(jnp.asarray(x)))
+    x[i] = 0.8  # TNREDAMP -13.2 -> -12.4
+    l1 = float(bt.lnlikelihood(jnp.asarray(x)))
+    assert np.isfinite(l0) and np.isfinite(l1) and l0 != l1
