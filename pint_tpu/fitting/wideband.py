@@ -194,7 +194,10 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
             Ndiag, T, phi = self._combined_noise(x)
             dx, cov, _, nbad = fn(r, M, Ndiag, T, phi,
                                   normalized_cov=True)
-            return dx[noffset:], cov, nbad
+            # predicted quadratic decrease (downhill.py convention)
+            Cir = make_cinv_mult(Ndiag, T, phi)(r[:, None])[:, 0]
+            pred = -jnp.dot(dx, M.T @ Cir)
+            return dx[noffset:], cov, nbad, pred
 
         return proposal
 
