@@ -53,12 +53,16 @@ def load_event_TOAs(
     errors_us: float = 0.0,
     weightcol: str = None,
     site: str = None,
+    energycol: str = None,
 ) -> TOAs:
     """Event FITS -> TOAs (one per photon).
 
     weightcol: photon-weight column; weights ride in each TOA's flags
     (key 'weight') so they stay aligned through the time sort and any
     later subsetting.
+    energycol: photon-energy column (e.g. Fermi 'ENERGY', MeV); stored
+    in the 'energy' flag the same way — consumed by energy-dependent
+    templates (templates/lceprimitives.py).
     site: observatory code override — pass the name registered via
     observatory.satellite.register_satellite to place the photons at
     the spacecraft (orbit-table geometry) instead of the defaults
@@ -76,6 +80,10 @@ def load_event_TOAs(
         np.asarray(hdu.column(weightcol), dtype=np.float64)
         if weightcol else None
     )
+    energies = (
+        np.asarray(hdu.column(energycol), dtype=np.float64)
+        if energycol else None
+    )
     if energy_range is not None and "PI" in [
         c.upper() for c in hdu.columns()
     ]:
@@ -85,6 +93,8 @@ def load_event_TOAs(
         met = met[keep]
         if weights is not None:
             weights = weights[keep]
+        if energies is not None:
+            energies = energies[keep]
     mjdref = _mjdref(hdr)
     timezero = float(hdr.get("TIMEZERO", 0.0))
     timesys = str(hdr.get("TIMESYS", "TT")).upper()
@@ -117,6 +127,9 @@ def load_event_TOAs(
     if weights is not None:
         for f, w in zip(flags, weights):
             f["weight"] = repr(float(w))
+    if energies is not None:
+        for f, e in zip(flags, energies):
+            f["energy"] = repr(float(e))
     toas = TOAs(
         t,
         np.full(n, np.inf),  # photons: infinite frequency (no DM)
@@ -131,6 +144,14 @@ def load_event_TOAs(
 def get_event_weights(toas: TOAs):
     """Per-photon weights from the 'weight' flags, or None."""
     vals = toas.get_flag_value("weight", None)
+    if any(v is None for v in vals):
+        return None
+    return np.array([float(v) for v in vals])
+
+
+def get_event_energies(toas: TOAs):
+    """Per-photon energies (MeV) from the 'energy' flags, or None."""
+    vals = toas.get_flag_value("energy", None)
     if any(v is None for v in vals):
         return None
     return np.array([float(v) for v in vals])

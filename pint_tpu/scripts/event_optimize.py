@@ -55,6 +55,11 @@ def main(argv=None):
     )
     ap.add_argument("--mission", default="generic")
     ap.add_argument("--weightcol", default=None)
+    ap.add_argument(
+        "--energycol", default=None,
+        help="photon-energy column (MeV; e.g. Fermi ENERGY) — feeds "
+        "energy-dependent template primitives during --fit-template",
+    )
     ap.add_argument("--nwalkers", type=int, default=32)
     ap.add_argument("--nsteps", type=int, default=500)
     ap.add_argument("--burnin", type=float, default=0.25)
@@ -72,7 +77,8 @@ def main(argv=None):
 
     model = get_model(args.parfile)
     toas = load_event_TOAs(
-        args.eventfile, mission=args.mission, weightcol=args.weightcol
+        args.eventfile, mission=args.mission, weightcol=args.weightcol,
+        energycol=args.energycol,
     )
     ingest_for_model(toas, model)
     cm = model.compile(toas, subtract_mean=False)
@@ -86,10 +92,20 @@ def main(argv=None):
     weights = get_event_weights(toas)
 
     if args.fit_template:
+        from pint_tpu.event_toas import get_event_energies
         from pint_tpu.templates import LCFitter, write_gauss
 
         phases = np.asarray(cm.phase(cm.x0()).frac) % 1.0
-        lcf = LCFitter(template, phases, weights=weights)
+        log10_ens = None
+        if template.is_energy_dependent:
+            en = get_event_energies(toas)
+            if en is None:
+                raise SystemExit(
+                    "energy-dependent template needs --energycol"
+                )
+            log10_ens = np.log10(en / 1000.0)  # MeV -> log10(E/GeV)
+        lcf = LCFitter(template, phases, weights=weights,
+                       log10_ens=log10_ens)
         ll = lcf.fit()
         errs = lcf.errors()
         log.info("template refit: loglike %.2f", ll)

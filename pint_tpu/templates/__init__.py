@@ -15,6 +15,7 @@ from pint_tpu.templates.lcprimitives import (  # noqa: F401
     LCLorentzian,
     LCVonMises,
 )
+from pint_tpu.templates.lceprimitives import LCEPrimitive  # noqa: F401
 from pint_tpu.templates.lctemplate import LCTemplate  # noqa: F401
 from pint_tpu.templates.lcfitters import LCFitter  # noqa: F401
 from pint_tpu.templates.lcio import (  # noqa: F401

@@ -16,17 +16,34 @@ from pint_tpu.templates.lctemplate import LCTemplate
 
 
 class LCFitter:
-    def __init__(self, template: LCTemplate, phases, weights=None):
+    def __init__(self, template: LCTemplate, phases, weights=None,
+                 log10_ens=None):
         self.template = template
         self.phases = jnp.asarray(np.asarray(phases, dtype=np.float64))
         self.weights = (
             None if weights is None
             else jnp.asarray(np.asarray(weights, dtype=np.float64))
         )
+        self.log10_ens = (
+            None if log10_ens is None
+            else jnp.asarray(np.asarray(log10_ens, dtype=np.float64))
+        )
+        if self.log10_ens is None and getattr(
+            template, "is_energy_dependent", False
+        ):
+            # without energies the slope parameters have exactly zero
+            # gradient: the fit would silently equal the energy-blind
+            # one and errors() would invert a singular Hessian
+            raise ValueError(
+                "template has energy-dependent primitives; pass "
+                "log10_ens (per-photon log10(E/GeV))"
+            )
 
     def loglikelihood(self, params=None):
         """Unbinned log-likelihood (weighted form: Kerr 2011 eq. 2)."""
-        f = self.template(self.phases, params=params)
+        f = self.template(
+            self.phases, params=params, log10_ens=self.log10_ens
+        )
         if self.weights is None:
             return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
         return jnp.sum(
@@ -58,7 +75,7 @@ class LCFitter:
         self.template.set_parameters(res.x)
         # wrap fitted locations into [0, 1)
         for p in self.template.primitives:
-            p.params[-1] = p.params[-1] % 1.0
+            p.wrap_loc()
         self.result = res
         return -float(res.fun)
 

@@ -34,6 +34,14 @@ class LCPrimitive:
         """L-BFGS-B bounds per parameter: positive width, free loc."""
         return [(1e-4, 0.5), (None, None)]
 
+    is_energy_dependent = False
+
+    def wrap_loc(self):
+        """Fold the fitted location into [0, 1) (the loc slot is the
+        LAST parameter for every base primitive; energy-dependent
+        wrappers override)."""
+        self.params[-1] = self.params[-1] % 1.0
+
     def __repr__(self):
         return (
             f"{type(self).__name__}(width={self.params[0]:.4f}, "
@@ -150,6 +158,14 @@ class LCBinnedProfile(LCPrimitive):
             raise ValueError("binned profile needs a 1-D array (>=4 bins)")
         if np.any(vals < 0):
             vals = vals - vals.min()  # raw profiles may ride a baseline
+        if not np.isfinite(vals).all() or vals.mean() <= 0:
+            # mirrors read_prof's 'profile is constant' guard for
+            # directly constructed profiles (ADVICE r2): an all-zero /
+            # constant-after-baseline profile would yield NaN/inf
+            raise ValueError(
+                "binned profile is empty or constant (zero mean after "
+                "baseline subtraction)"
+            )
         self.values = vals / vals.mean()  # unit mean = unit integral
         self.params = np.array([1.0, loc], dtype=np.float64)
 

@@ -38,9 +38,18 @@ class LCTemplate:
             p.params = vec[off:off + p.n_params].copy()
             off += p.n_params
 
-    def __call__(self, phases, params=None):
+    @property
+    def is_energy_dependent(self):
+        return any(
+            getattr(p, "is_energy_dependent", False)
+            for p in self.primitives
+        )
+
+    def __call__(self, phases, params=None, log10_ens=None):
         """Density at phases; jax-traceable when params is a jnp vector
-        in get_parameters() layout."""
+        in get_parameters() layout.  log10_ens (per-photon
+        log10(E/GeV)) feeds energy-dependent primitives
+        (lceprimitives.LCEPrimitive); others ignore it."""
         n = len(self.primitives)
         if params is None:
             params = self.get_parameters()
@@ -48,28 +57,55 @@ class LCTemplate:
         out = 1.0 - jnp.sum(w)
         off = n
         for i, p in enumerate(self.primitives):
+            kw = (
+                {"log10_ens": log10_ens}
+                if getattr(p, "is_energy_dependent", False) else {}
+            )
             out = out + w[i] * p(
-                phases, params=params[off:off + p.n_params]
+                phases, params=params[off:off + p.n_params], **kw
             )
             off += p.n_params
         return out
 
-    def random(self, n, rng=None):
-        """Draw photon phases from the template (for tests/simulation)."""
+    def random(self, n, rng=None, log10_ens=None):
+        """Draw photon phases from the template (for tests/simulation);
+        with log10_ens (length n), each photon is drawn from its own
+        energy's density."""
         rng = rng or np.random.default_rng()
-        phases = rng.uniform(size=n)
-        # rejection sample against the density
         params = self.get_parameters()
-        fmax = float(
-            np.max(np.asarray(self(np.linspace(0, 1, 2048), params)))
-        )
-        out = []
-        while len(out) < n:
-            cand = rng.uniform(size=2 * n)
-            f = np.asarray(self(cand, params))
-            keep = rng.uniform(size=2 * n) * fmax < f
-            out.extend(cand[keep].tolist())
-        return np.asarray(out[:n])
+        if log10_ens is None:
+            fmax = float(
+                np.max(np.asarray(self(np.linspace(0, 1, 2048), params)))
+            )
+            out = []
+            while len(out) < n:
+                cand = rng.uniform(size=2 * n)
+                f = np.asarray(self(cand, params))
+                keep = rng.uniform(size=2 * n) * fmax < f
+                out.extend(cand[keep].tolist())
+            return np.asarray(out[:n])
+        u = np.asarray(log10_ens, dtype=np.float64)
+        if u.shape != (n,):
+            raise ValueError("log10_ens must have length n")
+        grid = np.linspace(0, 1, 512)
+        # density envelope: widths/locations are monotone (clipped
+        # linear) in u, so the per-energy maximum over the whole u
+        # range is bounded by the grid evaluated at the two u
+        # endpoints — O(2*512) instead of an O(n*512) array
+        u_ends = np.array([u.min(), u.max()])
+        fmax = 1.1 * float(np.max(np.asarray(
+            self(grid[None, :], params, log10_ens=u_ends[:, None])
+        )))
+        phases = np.empty(n)
+        todo = np.ones(n, dtype=bool)
+        while todo.any():
+            idx = np.flatnonzero(todo)
+            cand = rng.uniform(size=len(idx))
+            f = np.asarray(self(cand, params, log10_ens=u[idx]))
+            keep = rng.uniform(size=len(idx)) * fmax < f
+            phases[idx[keep]] = cand[keep]
+            todo[idx[keep]] = False
+        return phases
 
     def __repr__(self):
         inner = ", ".join(
