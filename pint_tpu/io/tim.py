@@ -62,12 +62,22 @@ def read_tim_file(path, include_depth: int = 0, state: "_ParseState" = None):
     files and mutations inside them persist after return)."""
     if include_depth > 10:
         raise PintTpuError("INCLUDE nesting too deep")
-    path = Path(path)
     rows = {
         "mjd": [], "freq": [], "err": [], "obs": [], "flags": [],
         "time_offset": [],
     }
     state = state or _ParseState()
+    if hasattr(path, "read"):  # file-like (timedit buffers); INCLUDE
+        # inside an anonymous buffer raises in _parse_line (no base
+        # directory to resolve against)
+        f = path
+        path = Path("<buffer>")
+        for lineno, raw in enumerate(f, 1):
+            _parse_line(raw, state, rows, path, lineno, include_depth)
+            if state.ended:
+                break
+        return rows
+    path = Path(path)
     with open(path) as f:
         for lineno, raw in enumerate(f, 1):
             _parse_line(raw, state, rows, path, lineno, include_depth)
@@ -123,6 +133,11 @@ def _apply_command(head, tokens, state, rows, path, depth):
     elif head == "MODE":
         pass  # fit-mode hint, ignored (reference logs and ignores too)
     elif head == "INCLUDE":
+        if str(path) == "<buffer>":
+            raise PintTpuError(
+                "INCLUDE inside an anonymous tim buffer has no base "
+                "directory to resolve against"
+            )
         inc = Path(path).parent / tokens[1]
         sub = read_tim_file(inc, depth + 1, state=state)
         for k in rows:
@@ -275,8 +290,11 @@ def get_TOAs_from_tim(path) -> TOAs:
 
 
 def write_tim_file(path, toas: TOAs, name: str = "pint_tpu"):
-    """Write Tempo2-format tim file (reference: TOAs.write_TOA_file)."""
-    with open(path, "w") as f:
+    """Write Tempo2-format tim file (reference: TOAs.write_TOA_file);
+    ``path`` may be a path or a writable file object (timedit)."""
+    from pint_tpu.utils.misc import open_or_use
+
+    with open_or_use(path, "w") as f:
         f.write("FORMAT 1\n")
         mjds = toas.t.to_mjd_strings(ndigits=16)
         for i in range(len(toas)):

@@ -4,8 +4,12 @@ Reference parity: src/pint/pintk/ — a ~4000-LoC Tk GUI (plk residual
 canvas, par/tim editors).  Per SURVEY.md §7 the Tk GUI is out of scope;
 what IS in scope is its testable core, `pintk/pulsar.py::Pulsar` — the
 stateful wrapper the GUI drives: load par/tim, fit, delete/restore
-TOAs, add/remove jumps, random-model draws, undo.  That layer is here,
-headless, plus a minimal matplotlib front end (``plk()``) for
+TOAs, add/remove jumps, random-model draws, undo — plus the
+paredit/timedit EDITING surface (src/pint/pintk/paredit.py /
+timedit.py): get_par_text/edit_par and get_tim_text/edit_tim
+round-trip the session through user-edited text, re-ingesting when an
+edit changes the ingest options (EPHEM / CLOCK / PLANET_SHAPIRO).
+Headless here, plus a minimal matplotlib front end (``plk()``) for
 interactive use.
 """
 
@@ -66,15 +70,87 @@ class Pulsar:
         return chi2
 
     def undo_fit(self):
+        """Undo the last fit OR par edit.  If the undone edit had
+        changed an ingest-relevant card, the TOAs are re-ingested
+        under the restored model so model and geometry columns never
+        diverge."""
         if not self._fit_history:
             raise ValueError("nothing to undo")
+        old = self.model
         self.model = get_model(self._fit_history.pop())
         self.fitter = None
+        if any(
+            self._card(old, c) != self._card(self.model, c)
+            for c in self._INGEST_CARDS
+        ):
+            from pint_tpu.toas.ingest import ingest_for_model
+
+            ingest_for_model(self.all_toas, self.model)
 
     def reset_model(self):
         self.model = get_model(self._par_backup)
         self.fitter = None
         self._fit_history.clear()
+
+    # -- par/tim editing (paredit/timedit capability) --------------------
+    _INGEST_CARDS = ("EPHEM", "CLOCK", "PLANET_SHAPIRO")
+
+    @staticmethod
+    def _card(model, name):
+        p = model.top_params.get(name) or model.params.get(name)
+        return None if p is None else p.value
+
+    def get_par_text(self) -> str:
+        """Current model as par-file text (the paredit buffer)."""
+        return self.model.as_parfile()
+
+    def edit_par(self, text: str):
+        """Apply edited par text: rebuild the model (undo-able like a
+        fit) and recompute residuals.  If the edit changes an
+        ingest-relevant card (EPHEM/CLOCK/PLANET_SHAPIRO) the TOAs are
+        re-ingested under the new options — matching get_TOAs'
+        model-driven chain (reference: pintk/paredit.py apply)."""
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        old_model = self.model
+        pre = old_model.as_parfile()
+        new_model = get_model(text)
+        reingest = any(
+            self._card(old_model, c) != self._card(new_model, c)
+            for c in self._INGEST_CARDS
+        )
+        self.model = new_model
+        self._fit_history.append(pre)
+        self.fitter = None
+        if reingest:
+            ingest_for_model(self.all_toas, new_model)
+        return self.model
+
+    def get_tim_text(self) -> str:
+        """Current (non-deleted flags preserved) TOAs as tim text."""
+        import io as _io
+
+        from pint_tpu.io.tim import write_tim_file
+
+        buf = _io.StringIO()
+        write_tim_file(buf, self.all_toas)
+        return buf.getvalue()
+
+    def edit_tim(self, text: str):
+        """Apply edited tim text: reparse + re-ingest under the
+        current model; the deletion mask resets (TOA identity is not
+        preserved across an edit), matching pintk/timedit.py apply."""
+        import io as _io
+
+        from pint_tpu.io.tim import get_TOAs_from_tim
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        toas = get_TOAs_from_tim(_io.StringIO(text))
+        ingest_for_model(toas, self.model)
+        self.all_toas = toas
+        self.deleted = np.zeros(len(toas), dtype=bool)
+        self.fitter = None
+        return toas
 
     # -- jumps -----------------------------------------------------------
     def add_jump(self, indices) -> str:

@@ -121,3 +121,65 @@ def test_timing_model_convenience_api():
     np.testing.assert_allclose(dpdf0, dt, rtol=1e-6)
     with pytest.raises(Exception):
         m.d_phase_d_param(toas, "PX")
+
+
+def test_paredit_roundtrip_refit(session_files):
+    """paredit capability: edit par text -> apply -> refit -> the edit
+    survives as_parfile round-trips, and undo restores the pre-edit
+    model (reference: pintk/paredit.py)."""
+    from pint_tpu.pintk import Pulsar
+
+    par, tim = session_files
+    psr = Pulsar(par, tim)
+    chi2_0 = psr.fit()
+    text = psr.get_par_text()
+    assert "F0" in text and "DM" in text
+    # edit: perturb F0 and freeze DM
+    lines = []
+    for line in text.splitlines():
+        if line.startswith("F0"):
+            toks = line.split()
+            lines.append(f"F0 {float(toks[1]) + 2e-9:.19g} 1")
+        elif line.startswith("DM "):
+            toks = line.split()
+            lines.append(f"DM {toks[1]}")  # no fit flag -> frozen
+        else:
+            lines.append(line)
+    psr.edit_par("\n".join(lines))
+    assert psr.model.params["DM"].frozen
+    chi2_edit = float(psr.residuals().chi2)
+    assert chi2_edit > chi2_0 + 1.0  # the F0 bump must hurt
+    chi2_refit = psr.fit()
+    assert chi2_refit < chi2_edit
+    # the refit pulled F0 back (DM frozen stays put)
+    f0 = psr.model.params["F0"].value
+    f0 = float(f0.to_float() if hasattr(f0, "to_float") else f0)
+    assert abs(f0 - 245.4261196898081) < 5e-10
+    # undo twice: refit -> edited state; edit -> original model
+    psr.undo_fit()
+    assert psr.model.params["DM"].frozen
+    psr.undo_fit()
+    assert not psr.model.params["DM"].frozen
+
+
+def test_timedit_roundtrip(session_files):
+    """timedit capability: tim text round-trips through
+    get_tim_text/edit_tim; an edit that drops TOAs re-ingests and
+    refits cleanly (reference: pintk/timedit.py)."""
+    from pint_tpu.pintk import Pulsar
+
+    par, tim = session_files
+    psr = Pulsar(par, tim)
+    n0 = len(psr.all_toas)
+    text = psr.get_tim_text()
+    # round-trip identity: re-apply unchanged text
+    psr.edit_tim(text)
+    assert len(psr.all_toas) == n0
+    assert psr.get_tim_text() == text
+    # drop the outlier line (index 30) and refit
+    lines = text.splitlines()
+    del lines[31]  # line 0 is FORMAT 1
+    psr.edit_tim("\n".join(lines) + "\n")
+    assert len(psr.all_toas) == n0 - 1
+    chi2 = psr.fit()
+    assert np.isfinite(chi2)
