@@ -89,12 +89,18 @@ def _posvel(ephem, body: str, et):
     the dominant v^2/2 and GM_sun/r terms, and silently substituting
     the builtin there would defeat the point of supplying a DE kernel
     (the KeyError propagates instead)."""
-    try:
-        # name-keyed (BuiltinEphemeris); SPK raises KeyError ("no
-        # segment path") on a string target, TypeError on odd inputs
-        return ephem.ssb_posvel(body, et)
-    except (KeyError, TypeError):
-        pass  # retry with the NAIF id
+    from pint_tpu.ephemeris.spk import SPK
+
+    if isinstance(ephem, SPK):
+        # SPK kernels are NAIF-id keyed; skipping the name-keyed call
+        # (rather than catching its TypeError) keeps genuine TypeError
+        # bugs in name-keyed implementations visible (ADVICE r2)
+        pass
+    else:
+        try:
+            return ephem.ssb_posvel(body, et)
+        except KeyError:
+            pass  # retry with the NAIF id
     try:
         return ephem.ssb_posvel(_NAIF[body], et)
     except KeyError:

@@ -149,11 +149,25 @@ def tdb_minus_tt(tt_centuries_j2000, xp=np):
     an installed time ephemeris overrides the series on the host path.
     ``xp`` selects numpy or jax.numpy.
     """
-    if _time_ephemeris_fn is not None and xp is np:
-        et = np.asarray(tt_centuries_j2000, dtype=np.float64) * (
-            36525.0 * 86400.0
-        )
-        return _time_ephemeris_fn(et)
+    if _time_ephemeris_fn is not None:
+        if xp is not np:
+            # the host-only contract must be self-enforcing: a traced
+            # caller silently getting the analytic series while ingest
+            # uses the kernel would diverge without diagnosis
+            # (ADVICE r2)
+            import warnings
+
+            warnings.warn(
+                "tdb_minus_tt called with a non-numpy xp while a time "
+                "ephemeris is installed; the installed kernel applies "
+                "to the HOST path only — the traced path evaluates the "
+                "analytic series"
+            )
+        else:
+            et = np.asarray(tt_centuries_j2000, dtype=np.float64) * (
+                36525.0 * 86400.0
+            )
+            return _time_ephemeris_fn(et)
     t = xp.asarray(tt_centuries_j2000) / 10.0  # Julian millennia
     out = 0.0
     tk = 1.0
