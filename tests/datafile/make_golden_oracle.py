@@ -60,6 +60,14 @@ _DATASETS = {
     "golden15": dict(
         ntoa=80, start_mjd=54700.0, end_mjd=55900.0, seed=15, obs="@",
     ),
+    # golden16: troposphere in the e2e loop — a dec -45 source seen
+    # from gbt (lat +38: barely/below horizon, exercising the
+    # validity mask), parkes (southern: the Niell season phase flip),
+    # and effelsberg, through the full clock/EOP/SPK chain.
+    "golden16": dict(
+        ntoa=90, start_mjd=54500.0, end_mjd=55900.0, seed=16,
+        obs=("gbt", "parkes", "effelsberg"), ingest_env=True,
+    ),
 }
 
 

@@ -61,6 +61,10 @@ def write_clock_files():
         INGEST_DIR / "jodrell2gps.clk", "# UTC(jodrell) UTC(gps)",
         t, 0.4e-6 + site(1.1, 140.0, 2.4, 0.4),
     )
+    _write_clk(
+        INGEST_DIR / "parkes2gps.clk", "# UTC(parkes) UTC(gps)",
+        t, -1.1e-6 + site(0.9, 210.0, 4.1, 0.7),
+    )
     t30 = np.arange(MJD0, MJD1 + 1e-9, 30.0)
     _write_clk(
         INGEST_DIR / "gps2utc.clk", "# UTC(gps) UTC",

@@ -19,7 +19,7 @@ DATADIR = Path(__file__).parent / "datafile"
 INGEST_DIR = DATADIR / "ingest"
 
 #: stems that must be loaded inside golden_ingest_env()
-INGEST_STEMS = ("golden13", "golden14", "golden15")
+INGEST_STEMS = ("golden13", "golden14", "golden15", "golden16")
 
 _ENV = {
     "PINT_TPU_CLOCK_DIR": str(INGEST_DIR),

@@ -16,7 +16,9 @@ Reference parity: this plays the role of the reference's stored Tempo2
 residual oracles over tests/datafile/ (SURVEY.md §4): an external
 ns-level check the framework cannot fool by being self-consistent.
 
-Ingest chain (grown in r3 with golden13-15): site + gps2utc clock
+Ingest chain (grown in r3 with golden13-16): the Niell/Davis
+troposphere (hydrostatic + nominal wet, latitude/season mapping,
+horizon validity mask), plus: site + gps2utc clock
 files and the TT(BIPM) realization (independent mpmath interpolation
 of the same tempo2 .clk data), Earth-orientation parameters (UT1-UTC
 in GAST, polar-motion W matrix; independent finals2000A parsing), SPK
@@ -79,6 +81,9 @@ from pint_tpu.ephemeris.vsop87 import (  # noqa: E402
     _B_SERIES, _L_SERIES, _R_SERIES,
 )
 from pint_tpu.earth.rotation import _NUT_TERMS  # noqa: E402
+from pint_tpu.models.troposphere import (  # noqa: E402
+    _A_HT, _B_HT, _C_HT, _HYD_AMP, _HYD_AVG, _LAT_GRID, _WET, _ZWD_M,
+)
 from pint_tpu.ops.tdb import _FB_GROUPS  # noqa: E402
 from pint_tpu.timebase.leapseconds import (  # noqa: E402
     _LEAP_MJDS, _LEAP_OFFSETS,
@@ -426,6 +431,78 @@ def itrf_to_gcrs_matrix(mjd_ut1_day, ut1_sec, T_tt, xp=None, yp=None):
 
 
 OMEGA_EARTH = mpf("7.292115855306589e-5")
+
+
+def geodetic_mp(xyz):
+    """WGS84 geodetic (lat, lon, height) — Bowring one-iteration,
+    mirroring earth/rotation.py::itrf_to_geodetic exactly (the sub-mm
+    approximation error is shared data, not arithmetic to diverge on).
+    """
+    x, y, z = xyz
+    a = mpf(6378137)
+    f = 1 / mpf("298.257223563")
+    b = a * (1 - f)
+    e2 = f * (2 - f)
+    p = sqrt(x * x + y * y)
+    lon = atan2(y, x)
+    u = atan2(z * a, p * b)
+    ep2 = e2 / (1 - e2)
+    lat = atan2(
+        z + ep2 * b * sin(u) ** 3, p - e2 * a * cos(u) ** 3
+    )
+    N = a / sqrt(1 - e2 * sin(lat) ** 2)
+    h = p / cos(lat) - N
+    return lat, lon, h
+
+
+def _herring_mp(s, a, b, c):
+    top = 1 + a / (1 + b / (1 + c))
+    bot = s + a / (s + b / (s + c))
+    return top / bot
+
+
+def _niell_interp(table, abslat):
+    """Linear |lat| interpolation of a (5, 3) Niell table (clamped),
+    mirroring jnp.interp in models/troposphere.py::_interp_coeffs."""
+    out = []
+    for j in range(3):
+        rows = [
+            (mpf(_LAT_GRID[i]), mpf(table[i][j]))
+            for i in range(len(_LAT_GRID))
+        ]
+        out.append(interp_clamped(rows, abslat))
+    return out
+
+
+def troposphere_delay_mp(sin_e, lat, alt_m, doy):
+    """Niell-mapped hydrostatic + nominal wet delay (seconds),
+    independent mpmath arithmetic over the published Niell/Davis
+    coefficients (models/troposphere.py::TroposphereDelay).  sin_e <= 0
+    (source below horizon / geocenter rows) -> 0."""
+    if sin_e <= 0:
+        return mpf(0)
+    abslat = abs(lat)
+    a0, b0, c0 = _niell_interp(_HYD_AVG, abslat)
+    a1, b1, c1 = _niell_interp(_HYD_AMP, abslat)
+    season = cos(
+        2 * pi * (doy - 28) / mpf("365.25")
+        + (pi if lat < 0 else mpf(0))
+    )
+    mh = _herring_mp(
+        sin_e, a0 - a1 * season, b0 - b1 * season, c0 - c1 * season
+    )
+    mh += (1 / sin_e - _herring_mp(
+        sin_e, mpf(_A_HT), mpf(_B_HT), mpf(_C_HT)
+    )) * (alt_m / 1000)
+    aw, bw, cw = _niell_interp(_WET, abslat)
+    mw = _herring_mp(sin_e, aw, bw, cw)
+    p_hpa = mpf("1013.25") * (
+        1 - mpf("2.2557e-5") * alt_m
+    ) ** mpf("5.2568")
+    zhd = mpf("0.0022768") * p_hpa / (
+        1 - mpf("0.00266") * cos(2 * lat) - mpf("2.8e-7") * alt_m
+    )
+    return (zhd * mh + mpf(_ZWD_M) * mw) / mpf(C)
 
 
 # ========================= ephemeris ====================================
@@ -912,7 +989,7 @@ class OraclePulsar:
             day_tdb, sec_tdb = toa["day"], toa["frac"] * SPD
             return dict(
                 day_tdb=day_tdb, sec_tdb=sec_tdb, r_ls=zero3,
-                sun_ls=None, ssb_obs_m=None,
+                sun_ls=None, ssb_obs_m=None, trop=mpf(0),
             )
         # -- clock chain: site + GPS at the raw UTC MJD ------------
         raw_mjd = mpf(toa["day"]) + toa["frac"]
@@ -950,9 +1027,38 @@ class OraclePulsar:
         sun_m = self._sun_pos_km(day_tdb, sec_tdb) * 1000 - ssb_obs_m
         r_ls = ssb_obs_m / mpf(C)
         sun_ls = sun_m / mpf(C)
+        # troposphere (param-independent: static source direction at
+        # the par coordinates, as the framework's ingest computes it)
+        trop = mpf(0)
+        tokens = self.par.get("CORRECT_TROPOSPHERE")
+        trop_on = tokens is not None and (
+            not tokens[0]
+            or tokens[0][0].strip().upper() in
+            ("Y", "YES", "T", "TRUE", "1")
+        )
+        if trop_on and sqrt(itrf @ itrf) > mpf(1e6):
+            lat, lon, h = geodetic_mp(itrf)
+            normal_itrf = np.array([
+                cos(lat) * cos(lon), cos(lat) * sin(lon), sin(lat),
+            ])
+            normal_gcrs = M @ normal_itrf
+            if "RAJ" in self.par:
+                ra = parse_hms(par_val(self.par, "RAJ"))
+                dec = parse_dms(par_val(self.par, "DECJ"))
+                n_src = np.array([
+                    cos(dec) * cos(ra), cos(dec) * sin(ra), sin(dec),
+                ])
+            else:
+                raise NotImplementedError(
+                    "oracle troposphere: equatorial astrometry only"
+                )
+            sin_e = normal_gcrs @ n_src
+            doy = (mpf(toa["day"]) + toa["frac"] - 51544) % mpf("365.25")
+            trop = troposphere_delay_mp(sin_e, lat, h, doy)
+
         return dict(
             day_tdb=day_tdb, sec_tdb=sec_tdb, r_ls=r_ls,
-            sun_ls=sun_ls, ssb_obs_m=ssb_obs_m,
+            sun_ls=sun_ls, ssb_obs_m=ssb_obs_m, trop=trop,
         )
 
     @_with_dps
@@ -974,6 +1080,9 @@ class OraclePulsar:
         if "PX" in self.par:
             px = self._p("PX") * mpf(MAS_TO_RAD)
             delay += px / (2 * mpf(AU_LIGHT_SEC)) * (r_ls @ r_ls - rn**2)
+
+        # -- troposphere (ingest-static; DEFAULT_ORDER: pre-binary) -----
+        delay += ing["trop"]
 
         # -- solar-system Shapiro (Sun + optional planets) --------------
         def shapiro(body_ls, gm):

@@ -79,3 +79,28 @@ def test_kepler_elements_earth_bary_pin():
         assert abs(el0[0] - 0.72333566) < 1e-6
     else:
         assert abs(el0[0] - 1.00000261) < 1e-6
+
+
+def test_niell_troposphere_leading_rows():
+    """Niell (1996) mapping-function coefficients: the |lat|=15 deg
+    rows of the hydrostatic-average and wet tables, the height-
+    correction constants, and the (documented-choice) nominal zenith
+    wet delay — re-typed from the published tables."""
+    from pint_tpu.models.troposphere import (
+        _A_HT, _B_HT, _C_HT, _HYD_AMP, _HYD_AVG, _LAT_GRID, _WET,
+        _ZWD_M,
+    )
+
+    assert np.allclose(
+        np.rad2deg(_LAT_GRID), [15.0, 30.0, 45.0, 60.0, 75.0]
+    )
+    assert tuple(_HYD_AVG[0]) == (1.2769934e-3, 2.9153695e-3,
+                                  62.610505e-3)
+    # 15 deg has no seasonal amplitude in Niell 1996
+    assert tuple(_HYD_AMP[0]) == (0.0, 0.0, 0.0)
+    assert tuple(_HYD_AMP[2]) == (2.6523662e-5, 3.0160779e-5,
+                                  4.3497037e-5)
+    assert tuple(_WET[0]) == (5.8021897e-4, 1.4275268e-3,
+                              4.3472961e-2)
+    assert (_A_HT, _B_HT, _C_HT) == (2.53e-5, 5.49e-3, 1.14e-3)
+    assert _ZWD_M == 0.1
