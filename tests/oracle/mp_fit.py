@@ -1,10 +1,13 @@
 """Fit-level independent oracle: mpmath Gauss-Newton WLS / small-k
-Woodbury GLS over a golden dataset.
+Woodbury GLS / wideband joint fits over a golden dataset.
 
 VERDICT r2 item 2: the residual-level oracle (mp_pipeline.py) proves
 the forward model; this module closes the loop on FITTED parameter
 values, uncertainties, and chi2 — the quantities the reference
-cross-checks against libstempo/Tempo2 (SURVEY.md §4).
+cross-checks against libstempo/Tempo2 (SURVEY.md §4).  Covered noise
+bases: PL red (enterprise Fourier convention) and ECORR (epoch
+quantization); OracleWidebandFitter stacks the [TOA; DM] blocks with
+the TOA-only offset column.
 
 Everything downstream of the residual function is re-derived here in
 mpmath: the design matrix comes from central differences of the
@@ -146,33 +149,72 @@ class OracleFitter:
         return np.stack(cols, axis=1)
 
     def _noise_basis(self):
-        """(T (n,2k) basis, phi (2k,)) for PL red noise, rebuilt from
-        the enterprise convention (models/noise.py::fourier_basis /
-        powerlaw_phi): t = TDB seconds from the first TOA's day,
-        f_j = j/Tspan, phi_j = A^2/(12 pi^2) f_yr^(gamma-3)
-        f_j^(-gamma) / Tspan; columns [sin | cos]."""
+        """Combined correlated-noise basis (T (n,k), phi (k,)),
+        rebuilt independently:
+
+        - PL red noise (enterprise convention; models/noise.py::
+          fourier_basis / powerlaw_phi): t = TDB seconds from the
+          first TOA's day, f_j = j/Tspan, phi_j = A^2/(12 pi^2)
+          f_yr^(gamma-3) f_j^(-gamma) / Tspan; columns [sin | cos].
+        - ECORR: one unit column per observing epoch of each mask
+          selection (gap-based grouping over the raw UTC MJD, 10 s
+          gap — models/noise.py::quantize_epochs), weight =
+          (ECORR_us * 1e-6)^2.
+
+        Column order does not matter: only C = N + T phi T^T does.
+        """
+        bases, phis = [], []
+        for args in (
+            self.o.par.get("ECORR", []) + self.o.par.get("T2ECORR", [])
+        ):
+            val_s = mpf(args[-1]) * mpf("1e-6")
+            pairs = sorted(
+                (mpf(t["day"]) + t["frac"], i)
+                for i, t in enumerate(self.o.toas)
+                if self.o._mask_match(t, args)
+            )
+            if not pairs:
+                continue
+            epochs = [[pairs[0]]]
+            for m, i in pairs[1:]:
+                if (m - epochs[-1][-1][0]) * SPD > 10:
+                    epochs.append([(m, i)])
+                else:
+                    epochs[-1].append((m, i))
+            epochs = [[i for _m, i in ep] for ep in epochs]
+            n = len(self.o.toas)
+            for members in epochs:
+                col = np.array([mpf(0)] * n)
+                for i in members:
+                    col[i] = mpf(1)
+                bases.append(col)
+                phis.append(val_s * val_s)
         amp = par_val(self.o.par, "TNREDAMP")
-        if amp is None:
+        if amp is not None:
+            gam = mpf(par_val(self.o.par, "TNREDGAM"))
+            nharm = int(float(par_val(self.o.par, "TNREDC", "30")))
+            ing = [self.o._ingest_toa(t) for t in self.o.toas]
+            day0 = ing[0]["day_tdb"]
+            t = np.array([
+                (g["day_tdb"] - day0) * SPD + g["sec_tdb"] for g in ing
+            ])
+            tspan = max(t) - min(t)
+            f = np.array([mpf(j) / tspan for j in range(1, nharm + 1)])
+            arg = 2 * pi * t[:, None] * f[None, :]
+            F = np.concatenate(
+                [np.vectorize(sin)(arg), np.vectorize(cos)(arg)],
+                axis=1,
+            )
+            A = mpf(10) ** mpf(amp)
+            phi1 = (
+                A * A / (12 * pi * pi) * F_YR ** (gam - 3)
+                * np.array([fj ** (-gam) for fj in f]) / tspan
+            )
+            bases.extend(F.T)
+            phis.extend(np.concatenate([phi1, phi1]))
+        if not bases:
             return None
-        gam = mpf(par_val(self.o.par, "TNREDGAM"))
-        nharm = int(float(par_val(self.o.par, "TNREDC", "30")))
-        ing = [self.o._ingest_toa(t) for t in self.o.toas]
-        day0 = ing[0]["day_tdb"]
-        t = np.array([
-            (g["day_tdb"] - day0) * SPD + g["sec_tdb"] for g in ing
-        ])
-        tspan = max(t) - min(t)
-        f = np.array([mpf(j) / tspan for j in range(1, nharm + 1)])
-        arg = 2 * pi * t[:, None] * f[None, :]
-        T = np.concatenate(
-            [np.vectorize(sin)(arg), np.vectorize(cos)(arg)], axis=1
-        )
-        A = mpf(10) ** mpf(amp)
-        phi1 = (
-            A * A / (12 * pi * pi) * F_YR ** (gam - 3)
-            * np.array([fj ** (-gam) for fj in f]) / tspan
-        )
-        return T, np.concatenate([phi1, phi1])
+        return np.stack(bases, axis=1), np.array(phis)
 
     def _cinv_apply(self, X):
         """C^-1 X for C = diag(1/w) + T phi T^T (Woodbury), or the
@@ -183,6 +225,11 @@ class OracleFitter:
         S = _lu_solve_cols(self._Sigma_m, self._TN.T @ X)
         return w[:, None] * X - self._TN @ S
 
+    def _offset_column(self, n_rows):
+        """The implicit-offset design column (all ones; the wideband
+        subclass zeroes the DM block)."""
+        return np.full((n_rows, 1), mpf(1))
+
     def _solve(self, r, M):
         """One GN normal-equation solve with the implicit offset
         column: returns (dx incl. offset, cov, chi2 = rCr - dx.b).
@@ -191,7 +238,7 @@ class OracleFitter:
         30-digit LU needs the same conditioning trick the framework
         and the reference use)."""
         n, _ = M.shape
-        Mo = np.concatenate([np.full((n, 1), mpf(1)), M], axis=1)
+        Mo = np.concatenate([self._offset_column(n), M], axis=1)
         norm = np.array([
             mp.sqrt(sum(v * v for v in Mo[:, j]))
             for j in range(Mo.shape[1])
@@ -240,3 +287,71 @@ class OracleFitter:
             mean = (w * r).sum() / w.sum()
             rs = r - mean
             return (w * rs * rs).sum()
+
+
+class OracleWidebandFitter(OracleFitter):
+    """Joint [TOA; DM] Gauss-Newton, mirroring the framework's
+    wideband stacking (fitting/wideband.py::_WidebandKernels): rows =
+    [time residuals (raw); dm_meas - dm_model], Ndiag = [scaled TOA
+    variances; pp_dme^2], offset column 1 on TOA rows / 0 on DM rows
+    (a phase offset does not move DM), correlated bases act on the
+    TOA block only."""
+
+    def __init__(self, oracle: OraclePulsar, free_names):
+        # dm_value/dm_err here cover DM + DMn + DMX only; the
+        # framework additionally folds solar wind into dm_model and
+        # DMJUMP/DMEFAC/DMEQUAD into the DM block — refuse those
+        # rather than silently mismodeling (oracle policy)
+        for key in ("NE_SW", "DMJUMP", "DMEFAC", "DMEQUAD"):
+            if key in oracle.par:
+                raise NotImplementedError(
+                    f"wideband fit oracle does not model {key}"
+                )
+        super().__init__(oracle, free_names)
+        with mp.workdps(_DPS):
+            self.dm_meas = np.array([
+                mpf(t["flags"]["pp_dm"]) for t in oracle.toas
+            ])
+            dm_err = np.array([
+                mpf(t["flags"]["pp_dme"]) for t in oracle.toas
+            ])
+            self._weights = np.concatenate(
+                [self._weights, 1 / (dm_err * dm_err)]
+            )
+            if self._basis is not None:
+                T, phi = self._basis
+                nt = len(oracle.toas)
+                Tz = np.concatenate(
+                    [T, np.full((nt, T.shape[1]), mpf(0))], axis=0
+                )
+                self._basis = (Tz, phi)
+                TN = self._weights[:, None] * Tz
+                Sigma = (
+                    np.diag(np.array([1 / ph for ph in phi]))
+                    + Tz.T @ TN
+                )
+                self._TN = TN
+                self._Sigma_m = _mp_matrix(Sigma)
+
+    def _offset_column(self, n_rows):
+        nt = n_rows // 2
+        col = np.full((n_rows, 1), mpf(0))
+        col[:nt, 0] = mpf(1)
+        return col
+
+    def _residuals(self, x):
+        self.o.set_overrides(x)
+        try:
+            r_t = np.array([
+                self.o._one_residual_raw(t) for t in self.o.toas
+            ])
+            r_dm = np.array([
+                self.dm_meas[i] - self.o.dm_value(
+                    t, self.o._ingest_toa(t)["day_tdb"],
+                    self.o._ingest_toa(t)["sec_tdb"],
+                )
+                for i, t in enumerate(self.o.toas)
+            ])
+        finally:
+            self.o.set_overrides({})
+        return np.concatenate([r_t, r_dm])

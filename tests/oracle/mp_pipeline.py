@@ -1061,6 +1061,35 @@ class OraclePulsar:
             sun_ls=sun_ls, ssb_obs_m=ssb_obs_m, trop=trop,
         )
 
+    def dm_value(self, toa, day_tdb, sec_tdb):
+        """Model DM (pc/cm^3) at one TOA: DM + DMn Taylor (TDB from
+        DMEPOCH) + DMX offsets.  DMX range membership uses the RAW
+        (UTC) TOA MJD like the framework's static masks
+        (dispersion.py::dmx_masks over toas.mjd_float()) and the
+        reference's toa_select — NOT the TDB time (caught by the
+        golden14 boundary TOA sitting 1e-9 day before DMXR1 in UTC).
+        Also the wideband dm_model the fit oracle consumes."""
+        dm = self._p("DM", mpf(0))
+        if "DMEPOCH" in self.par:
+            de_day, de_sec = self._epoch("DMEPOCH")
+            dt_dm = (day_tdb - de_day) * SPD + (sec_tdb - de_sec)
+            k = 1
+            fact = mpf(1)
+            while f"DM{k}" in self.par:
+                fact *= k
+                dm += (self._p(f"DM{k}")
+                       / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt_dm**k / fact
+                k += 1
+        mjd_f = mpf(toa["day"]) + toa["frac"]
+        for key in self.par:
+            if key.startswith("DMX_"):
+                idx = key[4:]
+                r1v = mpf(par_val(self.par, f"DMXR1_{idx}"))
+                r2v = mpf(par_val(self.par, f"DMXR2_{idx}"))
+                if r1v <= mjd_f <= r2v:
+                    dm += self._p(key)
+        return dm
+
     @_with_dps
     def _one_residual_raw(self, toa):
         ing = self._ingest_toa(toa)
@@ -1138,31 +1167,10 @@ class OraclePulsar:
             delay += mpf(DM_CONST) * (col / pc_ls) / toa["freq"] ** 2
 
         # -- dispersion -------------------------------------------------
-        dm = self._p("DM", mpf(0))
-        if "DMEPOCH" in self.par:
-            de_day, de_sec = self._epoch("DMEPOCH")
-            dt_dm = (day_tdb - de_day) * SPD + (sec_tdb - de_sec)
-            k = 1
-            fact = mpf(1)
-            while f"DM{k}" in self.par:
-                fact *= k
-                dm += (self._p(f"DM{k}")
-                       / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt_dm**k / fact
-                k += 1
-        # DMX piecewise offsets; range membership uses the RAW (UTC)
-        # TOA MJD like the framework's static masks (dispersion.py::
-        # dmx_masks over toas.mjd_float()) and the reference's
-        # toa_select — NOT the TDB time (caught by the golden14
-        # boundary TOA sitting 1e-9 day before DMXR1 in UTC)
-        mjd_f = mpf(toa["day"]) + toa["frac"]
-        for key in self.par:
-            if key.startswith("DMX_"):
-                idx = key[4:]
-                r1v = mpf(par_val(self.par, f"DMXR1_{idx}"))
-                r2v = mpf(par_val(self.par, f"DMXR2_{idx}"))
-                if r1v <= mjd_f <= r2v:
-                    dm += self._p(key)
-        delay += mpf(DM_CONST) * dm / toa["freq"] ** 2
+        delay += (
+            mpf(DM_CONST) * self.dm_value(toa, day_tdb, sec_tdb)
+            / toa["freq"] ** 2
+        )
 
         # -- binary -----------------------------------------------------
         model = par_val(self.par, "BINARY")

@@ -38,12 +38,14 @@ def _fw_value_sigma(p):
     return v, float(p.uncertainty)
 
 
-def _run_case(stem, FitterCls, fitter_kw, env):
+def _run_case(stem, FitterCls, fitter_kw, env, oracle_cls=None):
     from oracle.mp_fit import OracleFitter
     from oracle.mp_pipeline import OraclePulsar
 
     from pint_tpu.models.builder import get_model_and_toas
 
+    if oracle_cls is None:
+        oracle_cls = OracleFitter
     par = str(DATADIR / f"{stem}.par")
     tim = str(DATADIR / f"{stem}.tim")
     with env:
@@ -53,7 +55,7 @@ def _run_case(stem, FitterCls, fitter_kw, env):
         f = FitterCls(toas, model, **fitter_kw)
         chi2_fw = f.fit_toas(maxiter=4)
         oracle = OraclePulsar(par, tim)
-    of = OracleFitter(oracle, f.cm.free_names)
+    of = oracle_cls(oracle, f.cm.free_names)
     values, sigmas, chi2_or = of.fit(niter=2)
     return f, chi2_fw, values, sigmas, float(chi2_or)
 
@@ -95,6 +97,45 @@ def test_gls_fit_vs_oracle_golden1():
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
         "golden1", GLSFitter, {"fused": False}, contextlib.nullcontext()
+    )
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
+
+
+def test_gls_fit_vs_oracle_golden3_ecorr():
+    """ECORR in the fit-level loop: golden3's EFAC/EQUAD/ECORR noise
+    (one unit basis column per observing epoch, weight ECORR^2) plus
+    DM1 Taylor dispersion — the epoch-quantization convention rebuilt
+    independently in mpmath."""
+    import contextlib
+
+    from pint_tpu.fitting import GLSFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden3", GLSFitter, {"fused": False}, contextlib.nullcontext()
+    )
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
+
+
+def test_wideband_fit_vs_oracle_golden4():
+    """Wideband joint [TOA; DM] fit vs the stacked mpmath Gauss-Newton
+    (golden4: ELL1 + DMX + wideband DM measurements).  Covers the
+    block stacking, the TOA-only offset column, and the DM-block
+    weighting — reference: fitter.py::WidebandTOAFitter."""
+    import contextlib
+
+    from oracle.mp_fit import OracleWidebandFitter
+
+    from pint_tpu.fitting.wideband import WidebandTOAFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden4", WidebandTOAFitter, {}, contextlib.nullcontext(),
+        oracle_cls=OracleWidebandFitter,
     )
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
