@@ -68,6 +68,15 @@ _DATASETS = {
         ntoa=90, start_mjd=54500.0, end_mjd=55900.0, seed=16,
         obs=("gbt", "parkes", "effelsberg"), ingest_env=True,
     ),
+    # golden17: the full wideband DM-block surface — DMJUMP offsets to
+    # the measurement scale (free), DMEFAC/DMEQUAD error rescaling —
+    # plus ECORR over CLUSTERED epochs (3 TOAs a few seconds apart per
+    # epoch, so the 10 s quantization actually groups; a uniform grid
+    # would make every epoch a singleton and ECORR == EQUAD).
+    "golden17": dict(
+        ntoa=102, start_mjd=54600.0, end_mjd=56000.0, seed=17,
+        wideband=True, cluster=(34, 3, 3.7),
+    ),
 }
 
 
@@ -97,6 +106,12 @@ def regen_tim(stem: str):
             np.linspace(cfg["start_mjd"], cfg["end_mjd"], cfg["ntoa"]),
             cfg["extra_mjds"],
         ])
+    if cfg.get("cluster"):
+        n_ep, per_ep, sep_s = cfg["cluster"]
+        base = np.linspace(cfg["start_mjd"], cfg["end_mjd"], n_ep)
+        mjds = (
+            base[:, None] + np.arange(per_ep)[None, :] * sep_s / 86400.0
+        ).ravel()
     with warnings.catch_warnings(), _env(stem):
         warnings.simplefilter("ignore")
         par_text = (DATADIR / f"{stem}.par").read_text()

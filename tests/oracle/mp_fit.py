@@ -45,6 +45,7 @@ _STEPS = {
     "PMRA": mpf("1e-4"), "PMDEC": mpf("1e-4"), "PX": mpf("1e-4"),
     "F0": mpf("1e-11"), "F1": mpf("1e-20"), "F2": mpf("1e-27"),
     "DM": mpf("1e-5"), "DMX": mpf("1e-5"), "JUMP": mpf("1e-7"),
+    "DMJUMP": mpf("1e-5"),
     "EPS": mpf("1e-9"), "PB": mpf("1e-9"), "A1": mpf("1e-7"),
 }
 
@@ -116,8 +117,14 @@ class OracleFitter:
             return parse_hms(par_val(self.o.par, "RAJ"))
         if name == "DECJ":
             return parse_dms(par_val(self.o.par, "DECJ"))
+        if name.startswith("DMJUMP") and name[6:].isdigit():
+            return self.o.mask_value(
+                self.o.par["DMJUMP"][int(name[6:]) - 1]
+            )
         if name.startswith("JUMP") and name[4:].isdigit():
-            return mpf(self.o.par["JUMP"][int(name[4:]) - 1][2])
+            return self.o.mask_value(
+                self.o.par["JUMP"][int(name[4:]) - 1]
+            )
         v = par_val(self.o.par, name)
         if v is None:
             raise KeyError(f"{name} not in par")
@@ -167,7 +174,7 @@ class OracleFitter:
         for args in (
             self.o.par.get("ECORR", []) + self.o.par.get("T2ECORR", [])
         ):
-            val_s = mpf(args[-1]) * mpf("1e-6")
+            val_s = self.o.mask_value(args) * mpf("1e-6")
             pairs = sorted(
                 (mpf(t["day"]) + t["frac"], i)
                 for i, t in enumerate(self.o.toas)
@@ -298,14 +305,13 @@ class OracleWidebandFitter(OracleFitter):
     TOA block only."""
 
     def __init__(self, oracle: OraclePulsar, free_names):
-        # dm_value/dm_err here cover DM + DMn + DMX only; the
-        # framework additionally folds solar wind into dm_model and
-        # DMJUMP/DMEFAC/DMEQUAD into the DM block — refuse those
-        # rather than silently mismodeling (oracle policy)
-        for key in ("NE_SW", "DMJUMP", "DMEFAC", "DMEQUAD"):
-            if key in oracle.par:
+        # the framework folds solar wind (any spelling/flavor) into
+        # dm_model too; refuse rather than silently mismodel
+        for key in oracle.par:
+            if key.startswith(("NE_SW", "NE1AU", "SOLARN0", "SWX")):
                 raise NotImplementedError(
-                    f"wideband fit oracle does not model {key}"
+                    f"wideband fit oracle does not model {key} in "
+                    "dm_model"
                 )
         super().__init__(oracle, free_names)
         with mp.workdps(_DPS):
@@ -313,25 +319,63 @@ class OracleWidebandFitter(OracleFitter):
                 mpf(t["flags"]["pp_dm"]) for t in oracle.toas
             ])
             dm_err = np.array([
-                mpf(t["flags"]["pp_dme"]) for t in oracle.toas
+                self._scaled_dm_err(t) for t in oracle.toas
             ])
             self._weights = np.concatenate(
                 [self._weights, 1 / (dm_err * dm_err)]
             )
             if self._basis is not None:
+                # stack zero rows for the DM block (correlated bases
+                # act on the TOA block only).  The zero rows add
+                # nothing to Sigma, so super().__init__'s _Sigma_m is
+                # already the stacked system's Sigma — only the basis
+                # and TN need the padding.
                 T, phi = self._basis
                 nt = len(oracle.toas)
-                Tz = np.concatenate(
-                    [T, np.full((nt, T.shape[1]), mpf(0))], axis=0
+                zeros = np.full((nt, T.shape[1]), mpf(0))
+                self._basis = (
+                    np.concatenate([T, zeros], axis=0), phi
                 )
-                self._basis = (Tz, phi)
-                TN = self._weights[:, None] * Tz
-                Sigma = (
-                    np.diag(np.array([1 / ph for ph in phi]))
-                    + Tz.T @ TN
+                self._TN = np.concatenate([self._TN, zeros], axis=0)
+
+    def weighted_chi2_at(self, x):
+        raise NotImplementedError(
+            "wideband chi2 has no single weighted mean (the offset "
+            "lives in the TOA block only); use fit()'s rCr - dx.b"
+        )
+
+    def _scaled_dm_err(self, toa):
+        """pp_dme rescaled by DMEFAC/DMEQUAD masks (models/noise.py::
+        ScaleDmError): efac * sqrt(err^2 + sum equad^2), efac composed
+        as prod(1 + (f - 1) mask)."""
+        err = mpf(toa["flags"]["pp_dme"])
+        eq2 = mpf(0)
+        for args in self.o.par.get("DMEQUAD", []):
+            if self.o._mask_match(toa, args):
+                eq2 += self.o.mask_value(args) ** 2
+        efac = mpf(1)
+        for args in self.o.par.get("DMEFAC", []):
+            if self.o._mask_match(toa, args):
+                efac *= 1 + (self.o.mask_value(args) - 1)
+        return efac * mp.sqrt(err * err + eq2)
+
+    def _dm_model_wb(self, toa):
+        """Measurement-scale model DM: dm_value MINUS the DMJUMP
+        offsets (dispersion.py::DispersionJump.dm_offset; DMJUMPn
+        override names mirror the framework's 1-based line order)."""
+        ing = self.o._ingest_toa(toa)
+        dm = self.o.dm_value(toa, ing["day_tdb"], ing["sec_tdb"])
+        for j, args in enumerate(self.o.par.get("DMJUMP", []), start=1):
+            if not args[0].startswith("-"):
+                raise NotImplementedError(
+                    "wideband oracle DMJUMP supports flag masks only"
                 )
-                self._TN = TN
-                self._Sigma_m = _mp_matrix(Sigma)
+            if self.o._mask_match(toa, args):
+                v = self.o._p(f"DMJUMP{j}", None)
+                if v is None:
+                    v = self.o.mask_value(args)
+                dm -= v
+        return dm
 
     def _offset_column(self, n_rows):
         nt = n_rows // 2
@@ -346,10 +390,7 @@ class OracleWidebandFitter(OracleFitter):
                 self.o._one_residual_raw(t) for t in self.o.toas
             ])
             r_dm = np.array([
-                self.dm_meas[i] - self.o.dm_value(
-                    t, self.o._ingest_toa(t)["day_tdb"],
-                    self.o._ingest_toa(t)["sec_tdb"],
-                )
+                self.dm_meas[i] - self._dm_model_wb(t)
                 for i, t in enumerate(self.o.toas)
             ])
         finally:

@@ -896,12 +896,20 @@ class OraclePulsar:
         for key in ("EQUAD", "T2EQUAD"):
             for args in self.par.get(key, []):
                 if self._mask_match(toa, args):
-                    sig = sqrt(sig**2 + (mpf(args[-1]) * mpf("1e-6"))**2)
+                    sig = sqrt(sig**2 + (self.mask_value(args) * mpf("1e-6"))**2)
         for key in ("EFAC", "T2EFAC"):
             for args in self.par.get(key, []):
                 if self._mask_match(toa, args):
-                    sig = mpf(args[-1]) * sig
+                    sig = self.mask_value(args) * sig
         return 1 / sig**2
+
+    @staticmethod
+    def mask_value(args):
+        """The VALUE token of a maskParameter par line: '-f L-wide
+        <val> [fitflag]' -> args[2]; a bare '<val> [fitflag]' line ->
+        args[0].  NEVER args[-1], which misreads a trailing fit flag
+        as the value."""
+        return mpf(args[2] if args[0].startswith("-") else args[0])
 
     @staticmethod
     def _mask_match(toa, args):
@@ -1456,7 +1464,7 @@ class OraclePulsar:
             if self._mask_match(toa, args):
                 jval = self._p(f"JUMP{j_idx}", None)
                 if jval is None:
-                    jval = mpf(args[2])
+                    jval = self.mask_value(args)
                 phase += -jval * f0_f64
 
         # -- glitches (phase; dt includes the delay, models/glitch.py) --

@@ -107,8 +107,10 @@ def test_gls_fit_vs_oracle_golden1():
 def test_gls_fit_vs_oracle_golden3_ecorr():
     """ECORR in the fit-level loop: golden3's EFAC/EQUAD/ECORR noise
     (one unit basis column per observing epoch, weight ECORR^2) plus
-    DM1 Taylor dispersion — the epoch-quantization convention rebuilt
-    independently in mpmath."""
+    DM1 Taylor dispersion.  NOTE: golden3's 14-day TOA spacing makes
+    every epoch a singleton (ECORR == per-TOA EQUAD here); the actual
+    GROUPING convention is exercised by golden17's clustered epochs
+    (test_wideband_fit_vs_oracle_golden17_dm_block)."""
     import contextlib
 
     from pint_tpu.fitting import GLSFitter
@@ -137,6 +139,31 @@ def test_wideband_fit_vs_oracle_golden4():
         "golden4", WidebandTOAFitter, {}, contextlib.nullcontext(),
         oracle_cls=OracleWidebandFitter,
     )
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
+
+
+def test_wideband_fit_vs_oracle_golden17_dm_block():
+    """The full wideband DM-block surface: a FREE DMJUMP (a column
+    living only in the DM rows of the stacked design), DMEFAC/DMEQUAD
+    error rescaling, and ECORR over genuinely CLUSTERED epochs (3 TOAs
+    seconds apart -> multi-member quantization columns, zero-padded
+    onto the stacked rows) — all rebuilt independently (reference:
+    dispersion.py::DispersionJump.dm_offset, noise ScaleDmError,
+    noise quantize_epochs)."""
+    import contextlib
+
+    from oracle.mp_fit import OracleWidebandFitter
+
+    from pint_tpu.fitting.wideband import WidebandTOAFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden17", WidebandTOAFitter, {}, contextlib.nullcontext(),
+        oracle_cls=OracleWidebandFitter,
+    )
+    assert "DMJUMP1" in f.cm.free_names
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
         value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
