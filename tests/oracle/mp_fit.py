@@ -47,10 +47,19 @@ _STEPS = {
     "DM": mpf("1e-5"), "DMX": mpf("1e-5"), "JUMP": mpf("1e-7"),
     "DMJUMP": mpf("1e-5"),
     "EPS": mpf("1e-9"), "PB": mpf("1e-9"), "A1": mpf("1e-7"),
+    # d resid/d ECC ~ a1 (s per unit e); d resid/d OM(deg) ~
+    # a1 e pi/180 — steps sized for ~1e-9..1e-7 s residual shifts
+    "ECC": mpf("1e-9"), "OM": mpf("1e-3"),
 }
 
 
 def _step_for(name):
+    if name in ("TASC", "T0", "PEPOCH", "POSEPOCH", "DMEPOCH"):
+        # epoch (MJD) parameters: the oracle's _epoch() reads the par
+        # string directly and has no override path
+        raise NotImplementedError(
+            f"fit oracle does not perturb epoch parameter {name}"
+        )
     if name in _STEPS:
         return _STEPS[name]
     # prefix fallback serves indexed families (DMX_0001, JUMP1, F0..F2)
