@@ -77,6 +77,11 @@ _DATASETS = {
         ntoa=102, start_mjd=54600.0, end_mjd=56000.0, seed=17,
         wideband=True, cluster=(34, 3, 3.7),
     ),
+    # golden18: PL DM (chromatic nu^-2) noise — the (1400/f)^2-scaled
+    # Fourier basis convention under the fit-level oracle.
+    "golden18": dict(
+        ntoa=90, start_mjd=54600.0, end_mjd=56000.0, seed=18,
+    ),
 }
 
 

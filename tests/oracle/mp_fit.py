@@ -205,10 +205,18 @@ class OracleFitter:
                     col[i] = mpf(1)
                 bases.append(col)
                 phis.append(val_s * val_s)
-        amp = par_val(self.o.par, "TNREDAMP")
-        if amp is not None:
-            gam = mpf(par_val(self.o.par, "TNREDGAM"))
-            nharm = int(float(par_val(self.o.par, "TNREDC", "30")))
+        # PL Fourier flavors: achromatic red (TNRED*) and chromatic
+        # nu^-2 DM noise (TNDM*, basis rows scaled by (1400/f_MHz)^2
+        # — models/noise.py::PLDMNoise)
+        for amp_key, gam_key, c_key, chrom_pow in (
+            ("TNREDAMP", "TNREDGAM", "TNREDC", 0),
+            ("TNDMAMP", "TNDMGAM", "TNDMC", 2),
+        ):
+            amp = par_val(self.o.par, amp_key)
+            if amp is None:
+                continue
+            gam = mpf(par_val(self.o.par, gam_key))
+            nharm = int(float(par_val(self.o.par, c_key, "30")))
             ing = [self.o._ingest_toa(t) for t in self.o.toas]
             day0 = ing[0]["day_tdb"]
             t = np.array([
@@ -221,6 +229,12 @@ class OracleFitter:
                 [np.vectorize(sin)(arg), np.vectorize(cos)(arg)],
                 axis=1,
             )
+            if chrom_pow:
+                chrom = np.array([
+                    (1400 / toa["freq"]) ** chrom_pow
+                    for toa in self.o.toas
+                ])
+                F = F * chrom[:, None]
             A = mpf(10) ** mpf(amp)
             phi1 = (
                 A * A / (12 * pi * pi) * F_YR ** (gam - 3)
