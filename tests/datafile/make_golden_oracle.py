@@ -82,6 +82,16 @@ _DATASETS = {
     "golden18": dict(
         ntoa=90, start_mjd=54600.0, end_mjd=56000.0, seed=18,
     ),
+    # golden19: the chromatic/explicit-sinusoid family — ChromaticCM
+    # Taylor (CMIDX 4) + WaveX + DMWaveX + CMWaveX.  THREE observing
+    # frequencies: with two, the offset/DM(nu^-2)/CM(nu^-4) design
+    # columns are exactly rank-deficient (any two-point chromatic
+    # signature is a combination of the other two) and fits of DM+CM
+    # are degenerate.
+    "golden19": dict(
+        ntoa=90, start_mjd=54600.0, end_mjd=56000.0, seed=19,
+        freqs=(1400.0, 800.0, 2300.0),
+    ),
 }
 
 
@@ -124,6 +134,7 @@ def regen_tim(stem: str):
             par_text, ntoa=cfg["ntoa"], start_mjd=cfg["start_mjd"],
             end_mjd=cfg["end_mjd"], seed=cfg["seed"],
             obs=cfg.get("obs", "gbt"), mjds=mjds,
+            freqs=cfg.get("freqs", (1400.0, 800.0)),
         )
         if cfg.get("wideband"):
             cm = model.compile(toas)

@@ -50,19 +50,29 @@ _STEPS = {
     # d resid/d ECC ~ a1 (s per unit e); d resid/d OM(deg) ~
     # a1 e pi/180 — steps sized for ~1e-9..1e-7 s residual shifts
     "ECC": mpf("1e-9"), "OM": mpf("1e-3"),
+    # linear-in-parameter columns: any step works; sized for clean
+    # |delta resid| ~ 1e-9 s
+    "CM": mpf("1"), "WXSIN": mpf("1e-8"), "WXCOS": mpf("1e-8"),
 }
 
 
 def _step_for(name):
-    if name in ("TASC", "T0", "PEPOCH", "POSEPOCH", "DMEPOCH"):
+    if name.endswith("EPOCH") or name in ("TASC", "T0"):
         # epoch (MJD) parameters: the oracle's _epoch() reads the par
-        # string directly and has no override path
+        # string directly and has no override path — a prefix-matched
+        # step would produce a silently-zero design column
         raise NotImplementedError(
             f"fit oracle does not perturb epoch parameter {name}"
         )
+    if name == "CMIDX" or "FREQ_" in name:
+        # nonlinear exponents / sinusoid frequencies: a prefix step
+        # (CM's, or none) would wrap phase like the refused rates
+        raise NotImplementedError(
+            f"no finite-difference step for {name}"
+        )
     if name in _STEPS:
         return _STEPS[name]
-    # prefix fallback serves indexed families (DMX_0001, JUMP1, F0..F2)
+    # prefix fallback serves indexed families (DMX_0001, JUMP1, CMk)
     # but must NOT hand a parent's step to rate parameters: A1DOT at
     # h=1e-7 perturbs the Roemer delay by ~10 light-seconds at the
     # span edges (wrapped, nonlinear garbage) — refuse instead
@@ -208,6 +218,7 @@ class OracleFitter:
         # PL Fourier flavors: achromatic red (TNRED*) and chromatic
         # nu^-2 DM noise (TNDM*, basis rows scaled by (1400/f_MHz)^2
         # — models/noise.py::PLDMNoise)
+        t = tspan = None  # time grid shared by both PL flavors
         for amp_key, gam_key, c_key, chrom_pow in (
             ("TNREDAMP", "TNREDGAM", "TNREDC", 0),
             ("TNDMAMP", "TNDMGAM", "TNDMC", 2),
@@ -217,12 +228,14 @@ class OracleFitter:
                 continue
             gam = mpf(par_val(self.o.par, gam_key))
             nharm = int(float(par_val(self.o.par, c_key, "30")))
-            ing = [self.o._ingest_toa(t) for t in self.o.toas]
-            day0 = ing[0]["day_tdb"]
-            t = np.array([
-                (g["day_tdb"] - day0) * SPD + g["sec_tdb"] for g in ing
-            ])
-            tspan = max(t) - min(t)
+            if t is None:
+                ing = [self.o._ingest_toa(t_) for t_ in self.o.toas]
+                day0 = ing[0]["day_tdb"]
+                t = np.array([
+                    (g["day_tdb"] - day0) * SPD + g["sec_tdb"]
+                    for g in ing
+                ])
+                tspan = max(t) - min(t)
             f = np.array([mpf(j) / tspan for j in range(1, nharm + 1)])
             arg = 2 * pi * t[:, None] * f[None, :]
             F = np.concatenate(

@@ -1069,6 +1069,57 @@ class OraclePulsar:
             sun_ls=sun_ls, ssb_obs_m=ssb_obs_m, trop=trop,
         )
 
+    def _wavex_sum(self, toa, day_tdb, sec_tdb, stem, factor):
+        """WaveX-family sinusoid delay (wave.py::WaveXBase): sum of
+        SIN/COS amplitudes at explicit frequencies (1/day) over TDB
+        days since <stem>EPOCH (default PEPOCH), times the chromatic
+        factor."""
+        fr = f"{stem}FREQ_"
+        idxs = sorted(
+            k[len(fr):] for k in self.par if k.startswith(fr)
+        )
+        if not idxs:
+            return mpf(0)
+        epoch_key = (
+            f"{stem}EPOCH" if f"{stem}EPOCH" in self.par else "PEPOCH"
+        )
+        e_day, e_sec = self._epoch(epoch_key)
+        td = (day_tdb - e_day) + (sec_tdb - e_sec) / SPD
+        out = mpf(0)
+        for sfx in idxs:
+            f_pd = self._p(f"{fr}{sfx}")
+            s = self._p(f"{stem}SIN_{sfx}", mpf(0)) or mpf(0)
+            c = self._p(f"{stem}COS_{sfx}", mpf(0)) or mpf(0)
+            arg = 2 * pi * f_pd * td
+            out += s * sin(arg) + c * cos(arg)
+        return out * factor
+
+    def _cmidx(self):
+        """Chromatic index: CMIDX under the framework spelling or the
+        reference aliases (chromatic.py); default 4."""
+        for key in ("CMIDX", "TNCHROMIDX"):
+            v = self._p(key, None)
+            if v is not None:
+                return v
+        return mpf(4)
+
+    def _taylor_par(self, base_key, epoch_key, day_tdb, sec_tdb):
+        """base + sum_k base_k/yr^k * dt^k/k! over TDB seconds from
+        epoch_key — the one Taylor convention shared by DM and CM
+        (dispersion.py / chromatic.py; internal /yr^k scaling)."""
+        out = self._p(base_key, mpf(0))
+        if epoch_key in self.par:
+            e_day, e_sec = self._epoch(epoch_key)
+            dt = (day_tdb - e_day) * SPD + (sec_tdb - e_sec)
+            k = 1
+            fact = mpf(1)
+            while f"{base_key}{k}" in self.par:
+                fact *= k
+                out += (self._p(f"{base_key}{k}")
+                        / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt**k / fact
+                k += 1
+        return out
+
     def dm_value(self, toa, day_tdb, sec_tdb):
         """Model DM (pc/cm^3) at one TOA: DM + DMn Taylor (TDB from
         DMEPOCH) + DMX offsets.  DMX range membership uses the RAW
@@ -1077,17 +1128,7 @@ class OraclePulsar:
         reference's toa_select — NOT the TDB time (caught by the
         golden14 boundary TOA sitting 1e-9 day before DMXR1 in UTC).
         Also the wideband dm_model the fit oracle consumes."""
-        dm = self._p("DM", mpf(0))
-        if "DMEPOCH" in self.par:
-            de_day, de_sec = self._epoch("DMEPOCH")
-            dt_dm = (day_tdb - de_day) * SPD + (sec_tdb - de_sec)
-            k = 1
-            fact = mpf(1)
-            while f"DM{k}" in self.par:
-                fact *= k
-                dm += (self._p(f"DM{k}")
-                       / mpf(SECS_PER_JULIAN_YEAR) ** k) * dt_dm**k / fact
-                k += 1
+        dm = self._taylor_par("DM", "DMEPOCH", day_tdb, sec_tdb)
         mjd_f = mpf(toa["day"]) + toa["frac"]
         for key in self.par:
             if key.startswith("DMX_"):
@@ -1179,6 +1220,24 @@ class OraclePulsar:
             mpf(DM_CONST) * self.dm_value(toa, day_tdb, sec_tdb)
             / toa["freq"] ** 2
         )
+
+        # -- chromatic CM Taylor (nu^-CMIDX; chromatic.py) --------------
+        if "CM" in self.par:
+            cm = self._taylor_par("CM", "CMEPOCH", day_tdb, sec_tdb)
+            delay += mpf(DM_CONST) * cm / toa["freq"] ** self._cmidx()
+
+        # -- DMWaveX / CMWaveX (explicit sinusoids, chromatic factors;
+        # wave.py; their DEFAULT_ORDER categories sit BEFORE the
+        # binary, unlike achromatic WaveX below) ------------------------
+        delay += self._wavex_sum(
+            toa, day_tdb, sec_tdb, "DMWX",
+            mpf(DM_CONST) / toa["freq"] ** 2,
+        )
+        if any(k.startswith("CMWXFREQ_") for k in self.par):
+            delay += self._wavex_sum(
+                toa, day_tdb, sec_tdb, "CMWX",
+                mpf(DM_CONST) / toa["freq"] ** self._cmidx(),
+            )
 
         # -- binary -----------------------------------------------------
         model = par_val(self.par, "BINARY")
@@ -1441,6 +1500,11 @@ class OraclePulsar:
             delay += dly * (1 - ddot)
         elif model:
             raise NotImplementedError(f"oracle binary {model}")
+
+        # -- achromatic WaveX (category 'wave': DEFAULT_ORDER places it
+        # AFTER the binary, so its delay is excluded from the binary's
+        # acc_delay but included in the spindown dt) --------------------
+        delay += self._wavex_sum(toa, day_tdb, sec_tdb, "WX", mpf(1))
 
         # -- spindown phase --------------------------------------------
         pe_day, pe_sec = self._epoch("PEPOCH")

@@ -238,3 +238,22 @@ def test_gls_fit_vs_oracle_golden18_pl_dm_noise():
         f, chi2_fw, values, sigmas, chi2_or,
         value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=5e-6,
     )
+
+
+def test_wls_fit_vs_oracle_golden19_chromatic_wavex():
+    """Chromatic CM Taylor + free WaveX sinusoid amplitudes in the
+    fit-level loop (golden19: CM/CMIDX=4 + WaveX + DMWaveX + CMWaveX;
+    free CM, WXSIN_0001, WXCOS_0001) — reference:
+    chromatic_model.py::ChromaticCM + the wavex families."""
+    from pint_tpu.fitting import WLSFitter
+
+    import contextlib
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden19", WLSFitter, {}, contextlib.nullcontext()
+    )
+    assert "CM" in f.cm.free_names and "WXSIN_0001" in f.cm.free_names
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
