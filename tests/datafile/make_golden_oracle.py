@@ -92,6 +92,20 @@ _DATASETS = {
         ntoa=90, start_mjd=54600.0, end_mjd=56000.0, seed=19,
         freqs=(1400.0, 800.0, 2300.0),
     ),
+    # golden20: FD + FD1JUMP (log-frequency profile evolution), SWX
+    # piecewise solar wind, and PiecewiseSpindown.  FOUR frequencies:
+    # offset/DM/FD1/FD2 are four constant-in-time frequency shapes,
+    # exactly rank-deficient over three distinct frequencies.
+    # ... and a period-3 receiver-flag pattern so the FD1JUMP mask
+    # decouples from frequency parity (a 2-flag cycle over a 4-freq
+    # cycle pins each receiver to two frequencies, and the five
+    # frequency-shape columns become rank-deficient over the four
+    # (freq, mask) cells).
+    "golden20": dict(
+        ntoa=92, start_mjd=54600.0, end_mjd=56000.0, seed=20,
+        freqs=(1400.0, 800.0, 2300.0, 600.0),
+        flags=("L-wide", "L-wide", "S-wide"),
+    ),
 }
 
 
@@ -135,6 +149,7 @@ def regen_tim(stem: str):
             end_mjd=cfg["end_mjd"], seed=cfg["seed"],
             obs=cfg.get("obs", "gbt"), mjds=mjds,
             freqs=cfg.get("freqs", (1400.0, 800.0)),
+            flags=cfg.get("flags", ("L-wide", "S-wide")),
         )
         if cfg.get("wideband"):
             cm = model.compile(toas)

@@ -53,6 +53,7 @@ _STEPS = {
     # linear-in-parameter columns: any step works; sized for clean
     # |delta resid| ~ 1e-9 s
     "CM": mpf("1"), "WXSIN": mpf("1e-8"), "WXCOS": mpf("1e-8"),
+    "FD": mpf("1e-8"),  # FDk and FDkJUMPj terms are seconds-scale
 }
 
 
@@ -136,6 +137,13 @@ class OracleFitter:
             return parse_hms(par_val(self.o.par, "RAJ"))
         if name == "DECJ":
             return parse_dms(par_val(self.o.par, "DECJ"))
+        import re
+
+        m = re.fullmatch(r"FD(\d)JUMP(\d+)", name)
+        if m:
+            return self.o.mask_value(
+                self.o.par[f"FD{m.group(1)}JUMP"][int(m.group(2)) - 1]
+            )
         if name.startswith("DMJUMP") and name[6:].isdigit():
             return self.o.mask_value(
                 self.o.par["DMJUMP"][int(name[6:]) - 1]

@@ -1205,15 +1205,43 @@ class OraclePulsar:
             raise NotImplementedError(
                 "oracle: NE_SW with barycentric TOAs is undefined"
             )
-        if "NE_SW" in self.par:
+        has_swx = any(k.startswith("SWXDM_") for k in self.par)
+        if has_swx and self.bary:
+            raise NotImplementedError(
+                "oracle: SWX with barycentric TOAs is undefined"
+            )
+        if "NE_SW" in self.par or has_swx:
             d_sun = sqrt(sun_ls @ sun_ls)
             cos_e = (sun_ls @ n) / d_sun
             theta = mp.acos(cos_e)
             au_ls = mpf(AU) / mpf(C)
             pc_ls = mpf(PC) / mpf(C)
-            col = (self._p("NE_SW") * au_ls * au_ls * (pi - theta)
-                   / (d_sun * sin(theta)))
-            delay += mpf(DM_CONST) * (col / pc_ls) / toa["freq"] ** 2
+            if "NE_SW" in self.par:
+                col = (self._p("NE_SW") * au_ls * au_ls * (pi - theta)
+                       / (d_sun * sin(theta)))
+                delay += (
+                    mpf(DM_CONST) * (col / pc_ls) / toa["freq"] ** 2
+                )
+            if has_swx:
+                # SWX (solar_wind.py::SolarWindDispersionX): dm =
+                # SWXDM_i * normalized profile (1 at quadrature/1 AU),
+                # range membership on the raw UTC MJD
+                prof = (
+                    au_ls * (pi - theta) / (d_sun * sin(theta))
+                ) / (pi / 2)
+                mjd_raw = mpf(toa["day"]) + toa["frac"]
+                dm_swx = mpf(0)
+                for key in self.par:
+                    if not key.startswith("SWXDM_"):
+                        continue
+                    idx = key[6:]
+                    r1v = mpf(par_val(self.par, f"SWXR1_{idx}"))
+                    r2v = mpf(par_val(self.par, f"SWXR2_{idx}"))
+                    if r1v <= mjd_raw < r2v:
+                        dm_swx += self._p(key)
+                delay += (
+                    mpf(DM_CONST) * dm_swx * prof / toa["freq"] ** 2
+                )
 
         # -- dispersion -------------------------------------------------
         delay += (
@@ -1226,13 +1254,44 @@ class OraclePulsar:
             cm = self._taylor_par("CM", "CMEPOCH", day_tdb, sec_tdb)
             delay += mpf(DM_CONST) * cm / toa["freq"] ** self._cmidx()
 
+        # -- FD / FDJUMP (log-frequency profile evolution;
+        # frequency_dependent.py: delay = sum FDk ln(nu/1GHz)^k).
+        # The framework sums ALL set FDk (no contiguity validate, so
+        # FD1+FD3 without FD2 is legal) — gather keys, don't stop at
+        # the first gap
+        lf = None
+        fd_ks = sorted(
+            int(key[2:]) for key in self.par
+            if key.startswith("FD") and key[2:].isdigit()
+        )
+        for k in fd_ks:
+            if lf is None:
+                lf = log(toa["freq"] / 1000)
+            delay += self._p(f"FD{k}") * lf**k
+        for order in range(1, 5):
+            for j, args in enumerate(
+                self.par.get(f"FD{order}JUMP", []), start=1
+            ):
+                if not args[0].startswith("-"):
+                    raise NotImplementedError(
+                        "oracle FDJUMP supports flag masks only"
+                    )
+                if self._mask_match(toa, args):
+                    if lf is None:
+                        lf = log(toa["freq"] / 1000)
+                    v = self._p(f"FD{order}JUMP{j}", None)
+                    if v is None:
+                        v = self.mask_value(args)
+                    delay += v * lf**order
+
         # -- DMWaveX / CMWaveX (explicit sinusoids, chromatic factors;
         # wave.py; their DEFAULT_ORDER categories sit BEFORE the
         # binary, unlike achromatic WaveX below) ------------------------
-        delay += self._wavex_sum(
-            toa, day_tdb, sec_tdb, "DMWX",
-            mpf(DM_CONST) / toa["freq"] ** 2,
-        )
+        if any(k.startswith("DMWXFREQ_") for k in self.par):
+            delay += self._wavex_sum(
+                toa, day_tdb, sec_tdb, "DMWX",
+                mpf(DM_CONST) / toa["freq"] ** 2,
+            )
         if any(k.startswith("CMWXFREQ_") for k in self.par):
             delay += self._wavex_sum(
                 toa, day_tdb, sec_tdb, "CMWX",
@@ -1553,6 +1612,34 @@ class OraclePulsar:
                     f0d = self._p(f"GLF0D_{i}", mpf(0)) or mpf(0)
                     ph += f0d * td_s * (1 - mp.exp(-dt_g / td_s))
                 phase += ph
+
+        # -- piecewise spindown (piecewise.py: per-range extra Taylor
+        # phase; range membership on the raw UTC MJD, dt from PWEP_i
+        # minus the total delay) ----------------------------------------
+        pw_idx = sorted(
+            int(k[5:]) for k in self.par
+            if k.startswith("PWEP_") and k[5:].isdigit()
+        )
+        if pw_idx:
+            mjd_raw = mpf(toa["day"]) + toa["frac"]
+            for i in pw_idx:
+                r1v = mpf(par_val(self.par, f"PWSTART_{i}"))
+                r2v = mpf(par_val(self.par, f"PWSTOP_{i}"))
+                if not (r1v <= mjd_raw < r2v):
+                    continue
+                ep_day, ep_sec = self._epoch(f"PWEP_{i}")
+                dt_pw = (
+                    (day_tdb - ep_day) * SPD + (sec_tdb - ep_sec)
+                    - delay
+                )
+                phase += (
+                    (self._p(f"PWPH_{i}", mpf(0)) or mpf(0))
+                    + (self._p(f"PWF0_{i}", mpf(0)) or mpf(0)) * dt_pw
+                    + (self._p(f"PWF1_{i}", mpf(0)) or mpf(0))
+                    * dt_pw**2 / 2
+                    + (self._p(f"PWF2_{i}", mpf(0)) or mpf(0))
+                    * dt_pw**3 / 6
+                )
 
         # -- Wave (sinusoid seconds -> phase via F0, NO delay in arg) --
         wave_ks = sorted(

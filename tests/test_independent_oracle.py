@@ -58,7 +58,8 @@ def _framework_raw_residuals(stem):
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
              "golden6", "golden7", "golden8", "golden9", "golden10",
-             "golden11", "golden12", "golden17", "golden18", "golden19"]
+             "golden11", "golden12", "golden17", "golden18", "golden19",
+             "golden20"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath

@@ -257,3 +257,22 @@ def test_wls_fit_vs_oracle_golden19_chromatic_wavex():
         f, chi2_fw, values, sigmas, chi2_or,
         value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
     )
+
+
+def test_wls_fit_vs_oracle_golden20_fd_swx_piecewise():
+    """FD log-frequency terms (free FD1/FD2 + a free FD1JUMP mask
+    column), SWX piecewise solar wind, and PiecewiseSpindown in the
+    loop (golden20; reference: frequency_dependent.py / fdjump.py,
+    solar_wind_dispersion.py::SolarWindDispersionX, piecewise.py)."""
+    import contextlib
+
+    from pint_tpu.fitting import WLSFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden20", WLSFitter, {}, contextlib.nullcontext()
+    )
+    assert "FD1JUMP1" in f.cm.free_names
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
