@@ -65,11 +65,13 @@ def _load(stem):
 
 
 # golden13/14 put the clock/EOP/SPK ingest chain on chip (VERDICT r2
-# weak 6): ingest is host-side but its products feed the device
-# geometry columns the axon pathology net must cover.
+# weak 6); golden16 adds the troposphere products, golden19/20 the
+# chromatic/WaveX/FD/SWX/piecewise kernels: ingest is host-side but
+# its products feed the device geometry columns and per-component
+# kernels the axon pathology net must cover.
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden5", "golden6", "golden13",
-             "golden14"]
+             "golden14", "golden16", "golden19", "golden20"]
 )
 def test_onchip_residuals_vs_cpu_oracle(stem):
     model, toas, oracle = _load(stem)
