@@ -54,6 +54,10 @@ _STEPS = {
     # |delta resid| ~ 1e-9 s
     "CM": mpf("1"), "WXSIN": mpf("1e-8"), "WXCOS": mpf("1e-8"),
     "FD": mpf("1e-8"),  # FDk and FDkJUMPj terms are seconds-scale
+    # glitch: phase (cycles), frequency step (Hz), fdot step (Hz/s) —
+    # dt_g spans ~<= 1e8 s, so these keep |delta phase| ~<= 1e-3 cycles
+    "GLPH_": mpf("1e-4"), "GLF0_": mpf("1e-11"),
+    "GLF1_": mpf("1e-19"), "GLF0D_": mpf("1e-11"),
 }
 
 
