@@ -122,3 +122,22 @@ class BayesianTiming:
 
     def lnposterior_jit(self):
         return jax.jit(self.lnposterior)
+
+    def sample_nested(self, nlive: int = 200, dlogz: float = 0.1,
+                      seed: int = 0, **kw):
+        """Nested sampling of the timing posterior: prior_transform +
+        the jitted vmapped lnlikelihood through the native sampler
+        (pint_tpu.nested; the reference feeds exactly these two
+        callables to nestle.sample).  Every prior must be proper
+        (improper uniforms have no prior transform)."""
+        from pint_tpu.nested import nested_sample
+
+        ll = jax.jit(jax.vmap(self.lnlikelihood))
+
+        def loglike_batch(X):
+            return np.asarray(ll(jnp.asarray(X)))
+
+        return nested_sample(
+            loglike_batch, self.prior_transform, self.nparams,
+            nlive=nlive, dlogz=dlogz, seed=seed, **kw,
+        )

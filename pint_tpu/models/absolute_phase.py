@@ -48,3 +48,35 @@ class AbsPhase(Component):
         return TOAs(
             t, np.array([float(frq)]), np.array([1.0]), [site], [dict()]
         )
+
+    def _tzr_config_key(self, model):
+        t = self.params["TZRMJD"].value
+        ps = model.params.get("PLANET_SHAPIRO")
+        return (
+            int(np.asarray(t.mjd_int).ravel()[0]),
+            float(np.asarray(t.sec.to_float()).ravel()[0]),
+            (self.params["TZRSITE"].value or "@").lower(),
+            self.params["TZRFRQ"].value,
+            model.top_params["EPHEM"].value,
+            (model.top_params.get("CLOCK").value
+             if model.top_params.get("CLOCK") else None),
+            bool(ps.value) if ps is not None else False,
+        )
+
+    def ingested_tzr_toas(self, model):
+        """TZR TOAs ingested through the model's chain, memoized by the
+        TZR/chain configuration (reference: get_TZR_toa's cache).
+        Built EAGERLY at model construction (models/builder.py) so the
+        clock/EOP/ephemeris environment in scope at build time is the
+        one the reference TOA uses — a later compile() in a different
+        environment would otherwise silently anchor the phase through
+        a different chain (caught by the golden22 oracle set)."""
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        key = self._tzr_config_key(model)
+        memo = getattr(self, "_tzr_memo", None)
+        if memo is None or memo[0] != key:
+            toas = self.make_tzr_toas()
+            ingest_for_model(toas, model)
+            self._tzr_memo = (key, toas)
+        return self._tzr_memo[1]

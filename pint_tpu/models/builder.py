@@ -156,6 +156,13 @@ class ModelBuilder:
             convert_tcb_tdb(model)
         model.setup()
         model.validate()
+        absph = model.components.get("AbsPhase")
+        if absph is not None and absph.params["TZRMJD"].value is not None:
+            # eager TZR ingest: the clock/EOP/ephemeris environment in
+            # scope NOW (model build) is the one the reference arrival
+            # must use; a later compile() elsewhere would silently
+            # anchor through a different chain (golden22 oracle set)
+            absph.ingested_tzr_toas(model)
         return model
 
     @staticmethod

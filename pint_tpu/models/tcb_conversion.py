@@ -52,10 +52,16 @@ def _dimension(name: str):
 def convert_tcb_tdb(model, backwards: bool = False):
     """Convert a model's parameters in place TCB->TDB (or TDB->TCB when
     backwards).  Epoch parameters route through TimeArray scale
-    conversion; dimensioned parameters scale by (1-L_B)^(-d)."""
-    from pint_tpu.models.parameter import MJDParameter
+    conversion; dimensioned parameters scale by (1-L_B)^(-d).
 
-    factor = 1.0 - L_B
+    The scale is computed and applied in double-double: the plain-f64
+    product (1-L_B)**d carries ~1e-16 relative rounding, which on F0
+    is a ~6 ns phase error over a 1300-day span — caught by the
+    golden23 TCB oracle set (tests/test_independent_oracle.py)."""
+    from pint_tpu.models.parameter import MJDParameter
+    from pint_tpu.timebase.hostdd import HostDD
+
+    one_minus = HostDD(1.0) - L_B
     for name, p in model.params.items():
         if p.value is None:
             continue
@@ -72,12 +78,15 @@ def convert_tcb_tdb(model, backwards: bool = False):
         d = _dimension(name)
         if not d:
             continue
-        scale = factor ** d if not backwards else factor ** (-d)
+        dd = d if not backwards else -d
+        scale = HostDD(1.0)
+        for _ in range(abs(dd)):
+            scale = scale * one_minus if dd > 0 else scale / one_minus
         iv = p.internal()
         if hasattr(iv, "to_float"):
             p.set_internal(iv * scale)
         else:
-            p.set_internal(float(iv) * scale)
+            p.set_internal(float(HostDD(float(iv)) * scale))
     units = model.top_params["UNITS"]
     units.value = "TDB" if not backwards else "TCB"
     return model

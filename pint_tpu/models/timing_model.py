@@ -193,12 +193,10 @@ class TimingModel:
         tzr_bundle = None
         absph = self.components.get("AbsPhase")
         if absph is not None and absph.params["TZRMJD"].value is not None:
-            from pint_tpu.toas.ingest import ingest_for_model
-
-            tzr_toas = absph.make_tzr_toas()
-            # the TZR TOA must go through the SAME ephemeris/options as
-            # the data TOAs or the absolute phase reference drifts
-            ingest_for_model(tzr_toas, self)
+            # ingested through the SAME ephemeris/options as the data
+            # TOAs, eagerly at build time and memoized on the component
+            # (absolute_phase.py::ingested_tzr_toas)
+            tzr_toas = absph.ingested_tzr_toas(self)
             tzr_bundle = make_bundle(tzr_toas, self._build_masks(tzr_toas))
         return CompiledModel(
             self, bundle, subtract_mean=subtract_mean, tzr_bundle=tzr_bundle
@@ -402,6 +400,17 @@ class CompiledModel:
                 return c.spin_frequency(pd, self.bundle)
         raise TimingModelError("no spindown component in model")
 
+    def absolute_phase(self, x, bundle: Optional[TOABundle] = None) -> Phase:
+        """Model phase with the TZR anchor subtracted when the model
+        carries AbsPhase (reference: TimingModel.phase(abs_phase=True))
+        — the phase photonphase/fermiphase/event_optimize/polycos
+        publish.  Without AbsPhase this is the raw model phase."""
+        ph = self.phase(x, bundle=bundle)
+        if self.tzr_bundle is not None:
+            tz = self.phase(x, bundle=self.tzr_bundle)
+            ph = ph - tz[0]  # Phase carry-normalized subtraction
+        return ph
+
     def phase_residuals(self, x):
         """Phase residuals in cycles (f64), no mean subtraction.
 
@@ -410,10 +419,7 @@ class CompiledModel:
         Residuals.calc_phase_resids); with 'nearest' tracking integer
         adds cancel by construction.
         """
-        ph = self.phase(x)
-        if self.tzr_bundle is not None:
-            tz = self.phase(x, bundle=self.tzr_bundle)
-            ph = ph - tz[0]  # Phase carry-normalized subtraction
+        ph = self.absolute_phase(x)
         if self.track_mode == "use_pulse_numbers":
             pn = self.bundle.pulse_number
             return (ph.int_ - pn + self.bundle.padd) + ph.frac

@@ -1,0 +1,167 @@
+"""Nested sampling over the jitted timing likelihood.
+
+Reference parity: bayesian.py::BayesianTiming.prior_transform is the
+reference's nestle/dynesty integration surface (its docs feed exactly
+this callable to ``nestle.sample``).  nestle is unavailable here by
+design, so this module is the native consumer: a single-bounding-
+ellipsoid rejection nested sampler (Skilling 2004; the 'single' method
+of nestle) with device-batched likelihood evaluation — candidates are
+proposed in the unit cube, mapped through prior_transform, and scored
+in vmapped batches so each iteration costs one device dispatch at
+most; accepted-but-unused candidates above the current likelihood
+threshold are pooled and reused while the threshold allows.
+
+Returns evidence (logz ± logzerr from the information H), the dead
+points with importance weights, and equal-weight posterior samples.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _bounding_ellipsoid(cubes, enlarge):
+    """(mean, L) with L the Cholesky factor of the covariance scaled to
+    contain every live point, inflated by ``enlarge``."""
+    d = cubes.shape[1]
+    mean = cubes.mean(axis=0)
+    dx = cubes - mean
+    cov = dx.T @ dx / max(1, len(cubes) - 1) + 1e-14 * np.eye(d)
+    cinv = np.linalg.inv(cov)
+    d2 = np.einsum("ij,jk,ik->i", dx, cinv, dx).max()
+    return mean, np.linalg.cholesky(cov * d2) * enlarge
+
+
+def _sample_ellipsoid(rng, mean, L, m):
+    d = len(mean)
+    z = rng.normal(size=(m, d))
+    z /= np.linalg.norm(z, axis=1, keepdims=True)
+    r = rng.uniform(size=(m, 1)) ** (1.0 / d)
+    return mean + (z * r) @ L.T
+
+
+def nested_sample(
+    loglike_batch,
+    prior_transform,
+    ndim: int,
+    nlive: int = 200,
+    batch: int = 128,
+    dlogz: float = 0.1,
+    max_iter: int = 200000,
+    enlarge: float = 1.25,
+    seed: int = 0,
+):
+    """Run single-ellipsoid nested sampling.
+
+    loglike_batch: (m, ndim) parameter array -> (m,) log-likelihoods
+      (wrap a jitted vmapped likelihood; called with full parameter
+      vectors from prior_transform).
+    prior_transform: unit-cube vector -> parameter vector (the
+      BayesianTiming.prior_transform contract).
+
+    Returns a dict with logz, logzerr, niter, ncall, samples
+    (equal-weight posterior), samples_raw, logwt, logl.
+    """
+    rng = np.random.default_rng(seed)
+    cubes = rng.uniform(size=(nlive, ndim))
+    X = np.stack([prior_transform(c) for c in cubes])
+    logl = np.array(loglike_batch(X), dtype=np.float64)  # writable copy
+    ncall = nlive
+
+    logz = -np.inf
+    h = 0.0
+    dead_x, dead_logl, dead_logwt = [], [], []
+    pool_c, pool_x, pool_l = (
+        np.empty((0, ndim)), np.empty((0, ndim)), np.empty(0)
+    )
+
+    it = 0
+    while it < max_iter:
+        # termination BEFORE recording the worst point: the remaining
+        # evidence is bounded by the max live logl over the current
+        # volume; checking here keeps the dead and live sets disjoint
+        # (recording then breaking would count the worst point twice —
+        # once with its shell weight, once in the live flush below)
+        logz_remain = float(logl.max()) - it / nlive
+        if (
+            np.isfinite(logz)
+            and np.logaddexp(logz, logz_remain) - logz < dlogz
+        ):
+            break
+        i_min = int(np.argmin(logl))
+        l_min = float(logl[i_min])
+        # shell volume between successive prior-volume shrinkages
+        lv0, lv1 = -it / nlive, -(it + 1) / nlive
+        logdvol = lv1 + np.log(np.expm1(lv0 - lv1))
+        logwt = l_min + logdvol
+        logz_new = np.logaddexp(logz, logwt)
+        prev = (
+            np.exp(logz - logz_new) * (h + logz)
+            if np.isfinite(logz) else 0.0
+        )
+        h = np.exp(logwt - logz_new) * l_min + prev - logz_new
+        logz = logz_new
+        dead_x.append(X[i_min].copy())
+        dead_logl.append(l_min)
+        dead_logwt.append(logwt)
+
+        # replacement: pool first (threshold only rises), else propose
+        keep = pool_l > l_min
+        pool_c, pool_x, pool_l = pool_c[keep], pool_x[keep], pool_l[keep]
+        while len(pool_l) == 0:
+            mean, L = _bounding_ellipsoid(cubes, enlarge)
+            cand = _sample_ellipsoid(rng, mean, L, batch)
+            ok = np.all((cand >= 0.0) & (cand < 1.0), axis=1)
+            cand = cand[ok]
+            if len(cand) == 0:
+                continue
+            cx = np.stack([prior_transform(c) for c in cand])
+            # pad to the fixed batch length so a jitted vectorized
+            # likelihood compiles ONCE (varying survivor counts would
+            # otherwise recompile per shape — r4 review)
+            npad = batch - len(cx)
+            cx_pad = (
+                np.concatenate([cx, np.repeat(cx[:1], npad, axis=0)])
+                if npad else cx
+            )
+            cl = np.asarray(
+                loglike_batch(cx_pad), dtype=np.float64
+            )[: len(cx)]
+            ncall += len(cand)
+            good = cl > l_min
+            pool_c, pool_x, pool_l = cand[good], cx[good], cl[good]
+        cubes[i_min] = pool_c[0]
+        X[i_min] = pool_x[0]
+        logl[i_min] = pool_l[0]
+        pool_c, pool_x, pool_l = pool_c[1:], pool_x[1:], pool_l[1:]
+        it += 1
+
+    # final live points: each carries 1/nlive of the remaining volume
+    logdvol = -it / nlive - np.log(nlive)
+    for j in range(nlive):
+        logwt = float(logl[j]) + logdvol
+        logz_new = np.logaddexp(logz, logwt)
+        prev = (
+            np.exp(logz - logz_new) * (h + logz)
+            if np.isfinite(logz) else 0.0
+        )
+        h = np.exp(logwt - logz_new) * float(logl[j]) + prev - logz_new
+        logz = logz_new
+        dead_x.append(X[j].copy())
+        dead_logl.append(float(logl[j]))
+        dead_logwt.append(logwt)
+
+    dead_x = np.stack(dead_x)
+    dead_logl = np.asarray(dead_logl)
+    dead_logwt = np.asarray(dead_logwt)
+    logzerr = float(np.sqrt(max(h, 0.0) / nlive))
+    # equal-weight posterior resampling
+    p = np.exp(dead_logwt - dead_logwt.max())
+    p /= p.sum()
+    neff = int(1.0 / np.sum(p * p))
+    idx = rng.choice(len(p), size=max(neff, 1), p=p)
+    return dict(
+        logz=float(logz), logzerr=logzerr, h=float(h), niter=it,
+        ncall=int(ncall), samples=dead_x[idx], samples_raw=dead_x,
+        logwt=dead_logwt, logl=dead_logl,
+    )

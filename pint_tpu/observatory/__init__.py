@@ -170,6 +170,13 @@ def bipm_correction(mjd_utc, version: str = "BIPM2021") -> np.ndarray:
             return ClockFile.from_tempo2(p, name=version).evaluate(
                 mjd, limits="none"
             )
+        # a clock environment exists but not this realization: the
+        # requested TT(BIPMxx) silently degrading to TT(TAI) is the
+        # ADVICE-r3 silent-intent-loss case — say so.
+        warnings.warn(
+            f"requested BIPM realization {version!r} but {p} does not "
+            "exist; using plain TT(TAI)"
+        )
     return np.zeros_like(mjd)
 
 
@@ -221,6 +228,23 @@ def get_observatory(name: str) -> Observatory:
     _build_registry()
     obs = _registry.get(str(name).lower())
     if obs is None:
+        # satellite auto-registration: an orbit product named after
+        # the site in $PINT_TPU_ORBIT_DIR makes the spacecraft usable
+        # directly from tim-file site columns (reference:
+        # observatory/satellite_obs.py::get_satellite_observatory,
+        # which builds the observatory from an FT2/orbit file on
+        # demand; the env-dir convention matches our clock/EOP/SPK
+        # search paths)
+        odir = os.environ.get("PINT_TPU_ORBIT_DIR")
+        if odir:
+            for ext in (".fits", ".orb"):
+                p = os.path.join(odir, f"{str(name).lower()}{ext}")
+                if os.path.exists(p):
+                    from pint_tpu.observatory.satellite import (
+                        register_satellite,
+                    )
+
+                    return register_satellite(str(name).lower(), p)
         raise UnknownObservatory(
             f"unknown observatory {name!r}; known: "
             f"{sorted(set(o.name for o in _registry.values()))}"

@@ -83,7 +83,11 @@ class Polycos:
         tmids = []
         for s in range(nseg):
             t0 = start_mjd + s * span_days
-            tmid = t0 + span_days / 2.0
+            # snap TMID to the tempo format's 11-decimal grid: an
+            # arbitrary fraction would lose ~1e-11 day in write/read,
+            # which the 60*DT*F0 ramp turns into ~3e-4 cycles of
+            # roundtrip phase error
+            tmid = round(t0 + span_days / 2.0, 11)
             tmids.append(tmid)
             mjds.append(tmid + u * span_days / 2.0)
         mjds = np.concatenate(mjds)
@@ -95,7 +99,7 @@ class Polycos:
         )
         ingest_for_model(toas, model)
         cm = model.compile(toas, subtract_mean=False)
-        ph = cm.phase(cm.x0())
+        ph = cm.absolute_phase(cm.x0())
         ph_int = np.asarray(ph.int_)
         ph_frac = np.asarray(ph.frac)
         f0 = float(
@@ -118,8 +122,21 @@ class Polycos:
                 (ph_int[sl] - rint) + (ph_frac[sl] - rfrac)
                 - 60.0 * dt_min * f0
             )
-            V = np.vander(dt_min, ncoeff, increasing=True)
-            coeffs, *_ = np.linalg.lstsq(V, resid, rcond=None)
+            # fit in the scaled variable u = dt/(span/2) in [-1, 1]
+            # with a Chebyshev basis, then convert to the monomial-in-
+            # dt_minutes coefficients the tempo format stores: a raw
+            # Vandermonde in dt_minutes (powers up to 30^11 ~ 2e16) is
+            # so ill-conditioned the lstsq left cycle-level errors on
+            # binary models — caught by the independent-oracle polyco
+            # check (test_derived_l6.py::test_polycos_vs_independent_oracle)
+            s_half = segment_minutes / 2.0
+            u_nodes = dt_min / s_half
+            cheb = np.polynomial.chebyshev.chebfit(
+                u_nodes, resid, ncoeff - 1
+            )
+            a = np.polynomial.chebyshev.cheb2poly(cheb)
+            a = np.pad(a, (0, ncoeff - len(a)))
+            coeffs = a / s_half ** np.arange(ncoeff)
             entries.append(PolycoEntry(
                 tmid_mjd=tmid, mjd_span_minutes=segment_minutes,
                 rphase_int=float(rint), rphase_frac=float(rfrac),

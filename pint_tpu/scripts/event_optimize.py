@@ -24,7 +24,10 @@ def build_lnpost(cm, template, weights=None):
     w = None if weights is None else jnp.asarray(weights)
 
     def lnpost(x):
-        phases = jnp.mod(cm.phase(x).frac, 1.0)
+        # TZR-anchored absolute phase: the template was fit to
+        # absolute phases, so the likelihood must score the same
+        # anchor or AbsPhase models bias the walk by the TZR fraction
+        phases = jnp.mod(cm.absolute_phase(x).frac, 1.0)
         f = template(phases, params=tpar)
         if w is None:
             return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
@@ -95,7 +98,7 @@ def main(argv=None):
         from pint_tpu.event_toas import get_event_energies
         from pint_tpu.templates import LCFitter, write_gauss
 
-        phases = np.asarray(cm.phase(cm.x0()).frac) % 1.0
+        phases = np.asarray(cm.absolute_phase(cm.x0()).frac) % 1.0
         log10_ens = None
         if template.is_energy_dependent:
             en = get_event_energies(toas)
@@ -119,7 +122,7 @@ def main(argv=None):
     import jax
 
     g = np.asarray(
-        jax.grad(lambda x: cm.phase(x).frac.mean())(cm.x0())
+        jax.grad(lambda x: cm.absolute_phase(x).frac.mean())(cm.x0())
     )
     scales = 0.05 / np.maximum(np.abs(g), 1e-30)
     chain, lnp, acc = run_ensemble(

@@ -42,7 +42,7 @@ def main(argv=None):
     log.info("loaded %d Fermi photons", len(toas))
     ingest_for_model(toas, model)
     cm = model.compile(toas, subtract_mean=False)
-    phases = np.mod(np.asarray(cm.phase(cm.x0()).frac), 1.0)
+    phases = np.mod(np.asarray(cm.absolute_phase(cm.x0()).frac), 1.0)
     h = hm(phases, weights=weights)
     print(f"Htest : {h:.2f}  ({h2sig(h):.2f} sigma)")
     if args.outfile:

@@ -39,7 +39,9 @@ def main(argv=None):
     log.info("loaded %d photons", len(toas))
     ingest_for_model(toas, model)
     cm = model.compile(toas, subtract_mean=False)
-    ph = cm.phase(cm.x0())
+    # TZR-anchored absolute phase (reference: photonphase uses
+    # model.phase(abs_phase=True) so PULSE_PHASE has the TZR zero)
+    ph = cm.absolute_phase(cm.x0())
     phases = np.mod(np.asarray(ph.frac), 1.0)
     h = hm(phases)
     print(f"Htest : {h:.2f}  ({h2sig(h):.2f} sigma)")

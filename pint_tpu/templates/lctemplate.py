@@ -88,20 +88,33 @@ class LCTemplate:
         if u.shape != (n,):
             raise ValueError("log10_ens must have length n")
         grid = np.linspace(0, 1, 512)
-        # density envelope: widths/locations are monotone (clipped
-        # linear) in u, so the per-energy maximum over the whole u
-        # range is bounded by the grid evaluated at the two u
-        # endpoints — O(2*512) instead of an O(n*512) array
-        u_ends = np.array([u.min(), u.max()])
-        fmax = 1.1 * float(np.max(np.asarray(
-            self(grid[None, :], params, log10_ens=u_ends[:, None])
-        )))
+        # density envelope at EVERY photon's energy (chunked so the
+        # working array stays (1024, 512)): an interior-energy
+        # superposition of drifting peaks can exceed any coarse-grid
+        # maximum (ADVICE r3 + r4 review); the phase grid plus the
+        # 1.1 margin and the in-loop rescale below cover what 512
+        # phase samples could still miss
+        fmax = 0.0
+        for lo in range(0, n, 1024):
+            u_chunk = u[lo:lo + 1024]
+            fmax = max(fmax, float(np.max(np.asarray(
+                self(grid[None, :], params, log10_ens=u_chunk[:, None])
+            ))))
+        fmax *= 1.1
         phases = np.empty(n)
         todo = np.ones(n, dtype=bool)
         while todo.any():
             idx = np.flatnonzero(todo)
             cand = rng.uniform(size=len(idx))
             f = np.asarray(self(cand, params, log10_ens=u[idx]))
+            f_hi = float(np.max(f, initial=0.0))
+            if f_hi > fmax:
+                # grid missed a sharper interior superposition: raise
+                # the envelope and restart (already-accepted draws
+                # under a too-low envelope would be biased)
+                fmax = 1.1 * f_hi
+                todo[:] = True
+                continue
             keep = rng.uniform(size=len(idx)) * fmax < f
             phases[idx[keep]] = cand[keep]
             todo[idx[keep]] = False

@@ -71,9 +71,20 @@ def ingest_for_model(toas: TOAs, model, **kw) -> TOAs:
     # "TT(TAI)" / "UTC(NIST)"-style -> plain TT(TAI).
     clk = model.top_params.get("CLOCK")
     clk_val = (clk.value or "").upper().replace(" ", "") if clk else ""
-    if clk_val.startswith("TT(BIPM"):
+    if (clk_val.startswith("TT(BIPM") and clk_val.endswith(")")
+            and clk_val[7:-1].isdigit()):
         kw.setdefault("include_bipm", True)
         kw.setdefault("bipm_version", clk_val[3:-1])
     elif clk_val in ("TT(TAI)", "UTC(NIST)", "UTC"):
         kw.setdefault("include_bipm", False)
+    elif clk_val:
+        # 'TT(BIPM)' with no year, 'UTC(obs)' realizations, typos: do
+        # not silently ignore the par file's timescale intent
+        # (ADVICE r3) — say what default is taking over.
+        import warnings
+
+        warnings.warn(
+            f"unrecognized CLOCK {clk.value!r} in par file; assuming "
+            "the default TT(BIPM2021) realization"
+        )
     return ingest(toas, model=model, **kw)

@@ -123,6 +123,16 @@ def config_3():
     return _gls_config(100_000, "config3 GLS 1e5 TOAs + red noise (north star)")
 
 
+def config_3b():
+    """The north-star system at 1e6 TOAs on one chip (VERDICT r3
+    item 3 / weak 5): the memory-lean Woodbury step's arrays are the
+    (n, k) basis and a handful of n-vectors, so PTA-scale n is a
+    bandwidth problem, not a memory wall.  chain=32: the per-step cost
+    is bandwidth-bound ~10s of ms."""
+    built = _gls_config(1_000_000, "config3b GLS 1e6 TOAs + red noise")
+    return built + (32,)
+
+
 def _wideband_config(ntoa, label):
     from pint_tpu.fitting.wideband import WidebandTOAFitter
     from pint_tpu.models.builder import get_model
@@ -184,7 +194,66 @@ def config_5(npsr: int = 45):
     )
 
 
-def config_7():
+def config_5b(npsr: int = 45, n: int = 2048):
+    """Batched dense PTA (VERDICT r3 item 2a): all 45 pulsars'
+    full-covariance GLS steps as ONE vmapped program — a (45, 2048,
+    2048) batched Cholesky + batched triangular solves, the natural
+    batched-GEMM MXU workload of a PTA full-cov analysis.  Same
+    x-jitter trick as config7 so the per-pulsar T phi T^T assembly is
+    legally hoisted while the factorization + solves stay in-loop;
+    model accounting is npsr * n^3/3."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import gls_step_full_cov
+    from pint_tpu.simulation import make_test_pulsar
+
+    rs, Ms, Nds, Ts, phis, x0s = [], [], [], [], [], []
+    for i in range(npsr):
+        par = (
+            f"PSR P{i}\nF0 {150 + 7 * i}.123 1\nF1 -3e-16 1\n"
+            f"PEPOCH 55000\nDM {5 + 1.3 * i:.1f} 1\nEFAC -f L-wide 1.1\n"
+            "TNREDAMP -13.5\nTNREDGAM 4.0\nTNREDC 15\n"
+        )
+        m, toas = make_test_pulsar(
+            par, ntoa=n, start_mjd=53000, end_mjd=57000,
+            seed=i, iterations=1,
+        )
+        cm = m.compile(toas)
+        x0 = cm.x0()
+        rs.append(cm.time_residuals(x0, subtract_mean=False))
+        Ms.append(design_with_offset(cm, x0))
+        Nds.append(jnp.square(cm.scaled_sigma(x0)))
+        T, phi = cm.noise_basis_or_empty(x0)
+        Ts.append(T)
+        phis.append(phi)
+        x0s.append(x0)
+    r = jnp.stack(rs)
+    M = jnp.stack(Ms)
+    Nd = jnp.stack(Nds)
+    T = jnp.stack(Ts)
+    phi = jnp.stack(phis)
+    X0 = jnp.stack(x0s)
+    method = "f64" if jax.default_backend() == "cpu" else "mixed"
+
+    one = lambda r_, M_, Nd_, T_, phi_: gls_step_full_cov(  # noqa: E731
+        r_, M_, Nd_, T_, phi_, method=method
+    )
+
+    def step(xs):
+        jitter = 1.0 + xs[:, :1] * 1e-18
+        dx, _, chi2, _ = jax.vmap(one)(r, M, Nd * jitter, T, phi)
+        return xs + dx[:, 1:], jnp.sum(chi2)
+
+    extras = {"model_flops_per_step": npsr * n**3 / 3}
+    return (
+        f"config5b PTA batched dense full-cov {npsr} x {n} [{method}]",
+        npsr * n, step, X0, 16, extras,
+    )
+
+
+def config_7(ntoa: int = 16384):
     """Dense full-covariance GLS at n=16384 — the compute-bound config
     (VERDICT r2 item 3): assembly (n^2 k GEMM) + f32 MXU Cholesky + IR
     solves dominate, so mfu_vs_bf16_peak reports real MXU utilization
@@ -212,7 +281,7 @@ def config_7():
         "TNREDAMP -13.8\nTNREDGAM 4.3\nTNREDC 30\n"
     )
     m, toas = make_test_pulsar(
-        par, ntoa=16384, start_mjd=53000, end_mjd=57000, iterations=1
+        par, ntoa=ntoa, start_mjd=53000, end_mjd=57000, iterations=1
     )
     import jax.numpy as jnp
 
@@ -237,13 +306,21 @@ def config_7():
     # O(n^2 p) IR/triangular solves.  model_flops counts n^3/3 — a
     # LOWER bound (XLA's cost analysis reports ~0 for the Cholesky
     # custom call, hence the separate field).
-    extras = {"model_flops_per_step": 16384**3 / 3}
+    extras = {"model_flops_per_step": ntoa**3 / 3}
     # chain=16: at a ~0.1 s step the tunnel round-trip is ~1% of a
     # 16-step chain, and 128 steps would take minutes per rep
+    chain = 16 if ntoa <= 16384 else 6
     return (
-        f"config7 dense full-cov GLS 16384 TOAs [{method}]",
-        16384, step, x0, 16, extras,
+        f"config7 dense full-cov GLS {ntoa} TOAs [{method}]",
+        ntoa, step, x0, chain, extras,
     )
+
+
+def config_7b():
+    """config7 at n=32768 f32 (~4.3 GB covariance + factor on the
+    16 GB chip) — VERDICT r3 item 2b: the FLOP-bound end at the
+    largest single-chip dense size."""
+    return config_7(ntoa=32768)
 
 
 def config_6():
@@ -278,11 +355,14 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="+",
-                    default=["1", "2", "3", "4", "4b", "5", "6", "7"])
+                    default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
+                             "6", "7", "7b"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
-                "4": config_4, "4b": config_4b, "5": config_5,
-                "6": config_6, "7": config_7}
+                "3b": config_3b, "4": config_4, "4b": config_4b,
+                "5": config_5, "5b": config_5b, "6": config_6,
+                "7": config_7, "7b": config_7b}
+    hbm_last_peak = 0
     for c in args.configs:
         built = builders[str(c)]()
         label, ntoa, step, x0 = built[:4]
@@ -309,6 +389,17 @@ def main():
             out["model_mfu_vs_bf16_peak"] = round(
                 mf / t_dev / PEAK_BF16_FLOPS, 4
             )
+        try:  # HBM high-water (absent on some backends/tunnels).
+            # peak_bytes_in_use is a PROCESS-lifetime high-water mark,
+            # so report it only when THIS config raised it — otherwise
+            # later small configs would echo an earlier config's peak.
+            stats = jax.local_devices()[0].memory_stats()
+            peak = (stats or {}).get("peak_bytes_in_use")
+            if peak is not None and peak > hbm_last_peak:
+                out["hbm_peak_gb"] = round(peak / 2**30, 2)
+                hbm_last_peak = peak
+        except Exception:
+            pass
         out.update(extras)
         print(json.dumps(out))
 

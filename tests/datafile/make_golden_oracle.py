@@ -106,6 +106,33 @@ _DATASETS = {
         freqs=(1400.0, 800.0, 2300.0, 600.0),
         flags=("L-wide", "L-wide", "S-wide"),
     ),
+    # golden21: SATELLITE observatory (VERDICT r3 missing 2 / item 1) —
+    # TOAs recorded at 'testsat', whose GCRS position comes from the
+    # committed orbit table ingest/testsat.fits via the not-a-knot
+    # spline ($PINT_TPU_ORBIT_DIR auto-registration).  2.3-day span
+    # inside the orbit product; the oracle re-reads the FITS table and
+    # re-solves the spline in mpmath.
+    "golden21": dict(
+        ntoa=60, start_mjd=55500.05, end_mjd=55502.35, seed=21,
+        obs="testsat", ingest_env=True,
+    ),
+    # golden22: TZR absolute-phase anchor (VERDICT r3 missing 3 /
+    # item 1) — TZRMJD/TZRSITE=gbt/TZRFRQ through the full clock/EOP/
+    # SPK chain: the TZR reference TOA is ingested like a data TOA on
+    # both sides and the residuals carry the TZR-anchored zero, so the
+    # oracle checks ABSOLUTE phase, not phase-mod-1.
+    "golden22": dict(
+        ntoa=90, start_mjd=54600.0, end_mjd=55890.0, seed=22,
+        obs=("gbt", "effelsberg"), ingest_env=True,
+    ),
+    # golden23: UNITS TCB (VERDICT r3 missing 4 / item 1) — the par is
+    # in TCB units; the framework converts parameters+epochs TCB->TDB
+    # at build (models/tcb_conversion.py), the oracle applies its own
+    # IAU-2006-B3 conversion in mpmath, and the full residual + fit
+    # loop checks the interaction with scaled F0/F1/DM/PB/A1.
+    "golden23": dict(
+        ntoa=100, start_mjd=54700.0, end_mjd=56100.0, seed=23,
+    ),
 }
 
 

@@ -97,7 +97,40 @@ def write_eop():
     (INGEST_DIR / "finals_mini.all").write_text("\n".join(lines) + "\n")
 
 
+def write_orbit_file():
+    """testsat.fits: a deterministic inclined circular LEO orbit table
+    (generic TIME + X/Y/Z layout, MET seconds from MJDREFI(TT) 55500,
+    60 s sampling over 2.5 days) for golden21 — the satellite-
+    observatory golden set.  Both the framework
+    (observatory/satellite.py spline) and the oracle
+    (mp_pipeline.py's own FITS parse + mp not-a-knot spline)
+    interpolate THIS table through separately written code."""
+    from pint_tpu.io.fits import write_event_fits
+
+    met = np.arange(0.0, 216000.0 + 1e-9, 60.0)
+    r_orb = 6.8e6  # m
+    period = 5550.0  # s
+    incl = np.deg2rad(51.6)
+    raan = np.deg2rad(40.0)
+    w = 2 * np.pi / period
+    x0 = r_orb * np.cos(w * met)
+    y0 = r_orb * np.sin(w * met)
+    # rotate orbital plane: inclination about x, then RAAN about z
+    y1 = y0 * np.cos(incl)
+    z1 = y0 * np.sin(incl)
+    x = x0 * np.cos(raan) - y1 * np.sin(raan)
+    y = x0 * np.sin(raan) + y1 * np.cos(raan)
+    write_event_fits(
+        INGEST_DIR / "testsat.fits",
+        {"TIME": met, "X": x, "Y": y, "Z": z1},
+        header_extra={"MJDREFI": 55500, "MJDREFF": 0.0,
+                      "TIMEZERO": 0.0, "TIMESYS": "TT"},
+        extname="ORBIT",
+    )
+
+
 if __name__ == "__main__":
     write_clock_files()
     write_eop()
+    write_orbit_file()
     print(f"wrote ingest data into {INGEST_DIR}")

@@ -19,12 +19,15 @@ DATADIR = Path(__file__).parent / "datafile"
 INGEST_DIR = DATADIR / "ingest"
 
 #: stems that must be loaded inside golden_ingest_env()
-INGEST_STEMS = ("golden13", "golden14", "golden15", "golden16")
+INGEST_STEMS = ("golden13", "golden14", "golden15", "golden16",
+                "golden21", "golden22")
 
 _ENV = {
     "PINT_TPU_CLOCK_DIR": str(INGEST_DIR),
     "PINT_TPU_EOP": str(INGEST_DIR / "finals_mini.all"),
     "PINT_TPU_EPHEM_DIR": str(DATADIR),
+    # satellite auto-registration (golden21's 'testsat' orbit table)
+    "PINT_TPU_ORBIT_DIR": str(INGEST_DIR),
 }
 
 

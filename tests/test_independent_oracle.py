@@ -7,7 +7,8 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Twelve golden datasets span the component matrix:
+Sixteen golden datasets span the component matrix here (golden13-16,
+the full-ingest-chain sets, run in tests/test_oracle_ingest.py):
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
   golden3: isolated + DM1/DM2 + EFAC/EQUAD/ECORR
@@ -24,6 +25,16 @@ Twelve golden datasets span the component matrix:
   golden11: DDH (orthometric H3/STIGMA in the DD family)
   golden12: BT_PIECEWISE (per-range T0X/A1X overrides) — with which
             ALL TEN binary models are oracle-validated
+  golden17: wideband DM block (free DMJUMP, DMEFAC/DMEQUAD,
+            clustered-epoch ECORR)
+  golden18: chromatic PL DM noise (TNDM* basis, alternating bands)
+  golden19: ChromaticCM + WaveX/DMWaveX/CMWaveX
+  golden20: FD/FDJUMP + SWX solar wind + PiecewiseSpindown
+  golden23: UNITS TCB (ELL1 + DM + astrometry) — the framework
+            converts TCB->TDB at build (models/tcb_conversion.py),
+            the oracle applies its own IAU-2006-B3 transform in mpmath
+            (golden21 satellite and golden22 TZR run in
+            tests/test_oracle_ingest.py with the chain environment)
 """
 
 import sys
@@ -59,30 +70,40 @@ def _framework_raw_residuals(stem):
     "stem", ["golden1", "golden2", "golden3", "golden4", "golden5",
              "golden6", "golden7", "golden8", "golden9", "golden10",
              "golden11", "golden12", "golden17", "golden18", "golden19",
-             "golden20"]
+             "golden20", "golden23"]
 )
 def test_independent_oracle_residuals(stem):
     """Raw (non-mean-subtracted) time residuals match the mpmath
     pipeline to < 1 ns at every TOA — phase is absolute mod 1, so this
     is an absolute end-to-end parity check, not a shape check."""
+    from oracle.cache import cached_oracle
     from oracle.mp_pipeline import OraclePulsar
 
     _, fw = _framework_raw_residuals(stem)
-    o = OraclePulsar(
-        str(DATADIR / f"{stem}.par"), str(DATADIR / f"{stem}.tim")
-    )
+    par, tim = DATADIR / f"{stem}.par", DATADIR / f"{stem}.tim"
+
     # EVERY TOA — the r2 stride-5 subsample missed range/mask-boundary
     # TOAs, exactly where per-TOA branch bugs live (VERDICT r2 weak 3;
     # the golden14 DMX edge and an mp-precision start-value bug were
-    # both caught by full coverage).  Accepted cost: the 12-set battery
-    # runs ~95 s instead of ~20 s.
-    raw = np.array([float(o._one_residual_raw(t)) for t in o.toas])
+    # both caught by full coverage).  r4: the oracle values are served
+    # from the content-hash cache (tests/oracle/cache.py) — identical
+    # arrays, recomputed automatically when oracle code or data change.
+    def compute():
+        o = OraclePulsar(str(par), str(tim))
+        return {"raw": np.array(
+            [float(o._one_residual_raw(t)) for t in o.toas]
+        )}
+
+    raw = cached_oracle(
+        f"{stem}_resid", [par.read_bytes(), tim.read_bytes()], compute
+    )["raw"]
     np.testing.assert_allclose(fw, raw, rtol=0, atol=1e-9)
 
 
 def test_independent_oracle_weighted_mean():
     """The EFAC/EQUAD-weighted mean subtraction matches too (full set,
     golden1)."""
+    from oracle.cache import cached_oracle
     from oracle.mp_pipeline import OraclePulsar
 
     from pint_tpu.models.builder import get_model_and_toas
@@ -94,10 +115,49 @@ def test_independent_oracle_weighted_mean():
         )
     cm = model.compile(toas)
     fw = np.asarray(cm.time_residuals(cm.x0()))
-    o = OraclePulsar(
-        str(DATADIR / "golden1.par"), str(DATADIR / "golden1.tim")
+    par, tim = DATADIR / "golden1.par", DATADIR / "golden1.tim"
+
+    def compute():
+        o = OraclePulsar(str(par), str(tim))
+        return {"resid": np.asarray(o.residuals(), dtype=np.float64)}
+
+    meansub = cached_oracle(
+        "golden1_resid_meansub",
+        [par.read_bytes(), tim.read_bytes()], compute,
+    )["resid"]
+    np.testing.assert_allclose(fw, meansub, rtol=0, atol=1e-9)
+
+
+def test_tcb_conversion_actually_matters():
+    """Reading golden23's par as if it were TDB (UNITS line dropped)
+    moves the residuals by ≫ the 1 ns parity bound — i.e. the TCB
+    parity test above cannot pass vacuously.  (The conversion scales
+    F0 by 1/(1-L_B) ~ 1.55e-8 relative: ~4e3 cycles over the span.)"""
+    from pint_tpu.models.builder import get_model_and_toas
+
+    par = (DATADIR / "golden23.par").read_text()
+    par_tdb = "\n".join(
+        line for line in par.splitlines() if not line.startswith("UNITS")
     )
-    np.testing.assert_allclose(fw, o.residuals(), rtol=0, atol=1e-9)
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".par", delete=False
+    ) as f:
+        f.write(par_tdb)
+        notcb = f.name
+
+    def resid(parfile):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model, toas = get_model_and_toas(
+                parfile, str(DATADIR / "golden23.tim")
+            )
+        cm = model.compile(toas)
+        return np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
+
+    d = resid(str(DATADIR / "golden23.par")) - resid(notcb)
+    assert np.abs(d).max() > 1e-5  # seconds — vs the 1e-9 parity bound
 
 
 def test_independent_oracle_wideband_dm():

@@ -66,12 +66,15 @@ def _load(stem):
 
 # golden13/14 put the clock/EOP/SPK ingest chain on chip (VERDICT r2
 # weak 6); golden16 adds the troposphere products, golden19/20 the
-# chromatic/WaveX/FD/SWX/piecewise kernels: ingest is host-side but
-# its products feed the device geometry columns and per-component
-# kernels the axon pathology net must cover.
+# chromatic/WaveX/FD/SWX/piecewise kernels, golden21/22/23 (r4) the
+# satellite orbit geometry, the TZR anchor subtraction, and the
+# TCB-converted parameter set: ingest is host-side but its products
+# feed the device geometry columns and per-component kernels the axon
+# pathology net must cover.
 @pytest.mark.parametrize(
     "stem", ["golden1", "golden2", "golden5", "golden6", "golden13",
-             "golden14", "golden16", "golden19", "golden20"]
+             "golden14", "golden16", "golden19", "golden20", "golden21",
+             "golden22", "golden23"]
 )
 def test_onchip_residuals_vs_cpu_oracle(stem):
     model, toas, oracle = _load(stem)
@@ -156,3 +159,51 @@ def test_onchip_downhill_no_spurious_warning():
         pv = p.value
         pv = float(pv.to_float()) if hasattr(pv, "to_float") else float(pv)
         assert abs(pv - v) < 0.3 * u + 1e-12, str(n)
+
+
+def test_onchip_measured_noise_floor_within_model_bounds():
+    """r4: the downhill chi2 noise floor is MEASURED per iteration from
+    the small-lambda ladder trials (fitting/downhill.py::
+    _chi2_noise_floor) instead of the r3 hard-coded delta_r=1e-7.
+    Measured structure of the axon backend (r4 probe experiments):
+    within one XLA program the emulated-f64 chi2 error is SMOOTH in x,
+    so differential scatter at trial scale is tiny (~3e-7 chi2 units
+    on golden1), while evaluating through a DIFFERENT program (scalar
+    vs vmapped) shifts chi2 by a decorrelated absolute offset
+    (~1.6e-5 here) — and the ABSOLUTE delta_r=1e-7 model
+    6*delta_r*sqrt(sum (r_i/sigma_i^2)^2) (~5.8 here) is a far upper
+    bound that r3 wrongly used as the floor itself, silently loosening
+    the acceptance tolerance by 7 orders.  Bounds asserted: the
+    measured differential floor must stay below BOTH the absolute
+    model bound and the acceptance tolerance it guards (1e-2), and the
+    cross-program offset must stay below the absolute bound (if either
+    inflates to the model scale, accept/reject is broken)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting import DownhillGLSFitter
+    from pint_tpu.models.builder import get_model
+
+    model, toas, _ = _load("golden1")
+    f = DownhillGLSFitter(toas, get_model(str(DATADIR / "golden1.par")))
+    f.fit_toas()
+    measured = f.last_noise_floor
+    x0 = f.cm.x0()
+    r = np.asarray(f.cm.time_residuals(x0))
+    w = 1.0 / np.square(np.asarray(f.cm.scaled_sigma(x0)))
+    model_floor = 6.0 * 1e-7 * float(np.sqrt(np.sum((r * w) ** 2)))
+    assert model_floor > 0
+    assert measured < min(model_floor, 1e-2), (
+        f"measured floor {measured:.3g} vs absolute model bound "
+        f"{model_floor:.3g}"
+    )
+    # cross-program absolute offset: scalar vs 2-wide vmapped program
+    chi2_of = f._make_chi2()
+    c_scalar = float(jax.jit(chi2_of)(x0))
+    c_vmap = float(
+        jax.jit(lambda x: jax.vmap(chi2_of)(jnp.stack([x, x])))(x0)[0]
+    )
+    assert abs(c_scalar - c_vmap) < model_floor, (
+        f"cross-program chi2 offset {abs(c_scalar - c_vmap):.3g} "
+        f"exceeds the absolute model bound {model_floor:.3g}"
+    )

@@ -38,8 +38,13 @@ def _fw_value_sigma(p):
     return v, float(p.uncertainty)
 
 
-def _run_case(stem, FitterCls, fitter_kw, env, oracle_cls=None,
-              par=None, tim=None):
+def _run_case(stem, FitterCls, fitter_kw, env_factory, oracle_cls=None,
+              par=None, tim=None, cache_name=None):
+    """env_factory is a CALLABLE returning a fresh context (so the
+    cache's compute closure can re-enter the ingest environment on a
+    miss).  cache_name keys the committed oracle cache
+    (tests/oracle/cache.py) and must be unique per case."""
+    from oracle.cache import cached_oracle, ingest_env_parts
     from oracle.mp_fit import OracleFitter
     from oracle.mp_pipeline import OraclePulsar
 
@@ -49,16 +54,35 @@ def _run_case(stem, FitterCls, fitter_kw, env, oracle_cls=None,
         oracle_cls = OracleFitter
     par = par or str(DATADIR / f"{stem}.par")
     tim = tim or str(DATADIR / f"{stem}.tim")
-    with env:
+    with env_factory():
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             model, toas = get_model_and_toas(par, tim)
         f = FitterCls(toas, model, **fitter_kw)
         chi2_fw = f.fit_toas(maxiter=4)
-        oracle = OraclePulsar(par, tim)
-    of = oracle_cls(oracle, f.cm.free_names)
-    values, sigmas, chi2_or = of.fit(niter=2)
-    return f, chi2_fw, values, sigmas, float(chi2_or)
+    free_names = list(f.cm.free_names)
+
+    def compute():
+        with env_factory():
+            oracle = OraclePulsar(par, tim)
+            of = oracle_cls(oracle, free_names)
+            v, s, c2 = of.fit(niter=2)
+        return {
+            "values": np.array([float(v[n]) for n in free_names]),
+            "sigmas": np.array([float(s[n]) for n in free_names]),
+            "chi2": np.float64(c2),
+        }
+
+    out = cached_oracle(
+        cache_name or f"{stem}_fit_{oracle_cls.__name__}",
+        [Path(par).read_bytes(), Path(tim).read_bytes(),
+         oracle_cls.__name__, ",".join(free_names), "niter=2",
+         *ingest_env_parts()],
+        compute,
+    )
+    values = dict(zip(free_names, out["values"]))
+    sigmas = dict(zip(free_names, out["sigmas"]))
+    return f, chi2_fw, values, sigmas, float(out["chi2"])
 
 
 def _assert_fit_parity(f, chi2_fw, values, sigmas, chi2_or,
@@ -80,7 +104,7 @@ def test_wls_fit_vs_oracle_golden13():
     from pint_tpu.fitting import WLSFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden13", WLSFitter, {}, golden_ingest_env()
+        "golden13", WLSFitter, {}, golden_ingest_env
     )
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
@@ -97,7 +121,7 @@ def test_gls_fit_vs_oracle_golden1():
     from pint_tpu.fitting import GLSFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden1", GLSFitter, {"fused": False}, contextlib.nullcontext()
+        "golden1", GLSFitter, {"fused": False}, contextlib.nullcontext
     )
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
@@ -117,7 +141,7 @@ def test_gls_fit_vs_oracle_golden3_ecorr():
     from pint_tpu.fitting import GLSFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden3", GLSFitter, {"fused": False}, contextlib.nullcontext()
+        "golden3", GLSFitter, {"fused": False}, contextlib.nullcontext
     )
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
@@ -137,7 +161,7 @@ def test_wideband_fit_vs_oracle_golden4():
     from pint_tpu.fitting.wideband import WidebandTOAFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden4", WidebandTOAFitter, {}, contextlib.nullcontext(),
+        "golden4", WidebandTOAFitter, {}, contextlib.nullcontext,
         oracle_cls=OracleWidebandFitter,
     )
     _assert_fit_parity(
@@ -161,13 +185,53 @@ def test_wideband_fit_vs_oracle_golden17_dm_block():
     from pint_tpu.fitting.wideband import WidebandTOAFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden17", WidebandTOAFitter, {}, contextlib.nullcontext(),
+        "golden17", WidebandTOAFitter, {}, contextlib.nullcontext,
         oracle_cls=OracleWidebandFitter,
     )
     assert "DMJUMP1" in f.cm.free_names
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
         value_tol_sigma=1e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
+
+
+def test_wls_fit_vs_oracle_golden22_tzr():
+    """TZR-anchored fit through the full ingest chain (golden22: ELL1
+    + free RAJ/F0/F1/DM/PB/A1 + TZRMJD@gbt): both sides fit the
+    anchored residuals — the oracle recomputes its TZR reference phase
+    under every central-difference perturbation, mirroring the
+    framework's phase(x, tzr_bundle) (models/absolute_phase.py::
+    get_TZR_toa parity at the fit level)."""
+    from pint_tpu.fitting import WLSFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden22", WLSFitter, {}, golden_ingest_env
+    )
+    assert "PB" in f.cm.free_names
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=2e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
+    )
+
+
+def test_wls_fit_vs_oracle_golden23_tcb():
+    """UNITS TCB at the fit level (golden23: free RAJ/F0/F1/DM/PB/A1):
+    the framework fits the TCB->TDB-converted model
+    (models/tcb_conversion.py, double-double scale); the oracle
+    converts with its own IAU-2006-B3 mpmath transform — fitted
+    values, uncertainties, and chi2 must agree in the TDB domain.
+    The r4 oracle caught a real bug here: the f64 (1-L_B)**d scale
+    was a ~6 ns phase error over the span."""
+    import contextlib
+
+    from pint_tpu.fitting import WLSFitter
+
+    f, chi2_fw, values, sigmas, chi2_or = _run_case(
+        "golden23", WLSFitter, {}, contextlib.nullcontext
+    )
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, chi2_or,
+        value_tol_sigma=2e-3, sigma_rtol=1e-5, chi2_rtol=1e-6,
     )
 
 
@@ -182,11 +246,11 @@ def test_fit_with_free_binary_parameters(stem, binary_free, tmp_path):
     design columns for PB/A1/ECC/OM/EPS1/EPS2 come from jacfwd THROUGH
     the Kepler solve and the ELL1/DD delay expansions; the oracle
     differentiates its own independent mpmath binary models by central
-    differences.  Agreement of fitted values AND uncertainties to
-    1e-3 sigma / 1e-5 validates the hardest derivatives in the
-    framework (CLAUDE.md invariant: derivatives are jacfwd, never
-    hand-written).  Value tolerance 2e-3 sigma (binary iterates
-    converge a shade slower than the linear sets)."""
+    differences.  Agreement of fitted values to 2e-3 sigma (binary
+    iterates converge a shade slower than the linear sets) and of
+    uncertainties to 1e-5 relative validates the hardest derivatives
+    in the framework (CLAUDE.md invariant: derivatives are jacfwd,
+    never hand-written)."""
     import contextlib
 
     from pint_tpu.fitting import GLSFitter
@@ -203,8 +267,8 @@ def test_fit_with_free_binary_parameters(stem, binary_free, tmp_path):
     par.write_text("\n".join(lines) + "\n")
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        stem, GLSFitter, {"fused": False}, contextlib.nullcontext(),
-        par=str(par),
+        stem, GLSFitter, {"fused": False}, contextlib.nullcontext,
+        par=str(par), cache_name=f"{stem}_fit_binfree",
     )
     for name in binary_free:
         assert name in f.cm.free_names
@@ -232,7 +296,7 @@ def test_gls_fit_vs_oracle_golden18_pl_dm_noise():
     from pint_tpu.fitting import GLSFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden18", GLSFitter, {"fused": False}, contextlib.nullcontext()
+        "golden18", GLSFitter, {"fused": False}, contextlib.nullcontext
     )
     _assert_fit_parity(
         f, chi2_fw, values, sigmas, chi2_or,
@@ -250,7 +314,7 @@ def test_wls_fit_vs_oracle_golden19_chromatic_wavex():
     import contextlib
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden19", WLSFitter, {}, contextlib.nullcontext()
+        "golden19", WLSFitter, {}, contextlib.nullcontext
     )
     assert "CM" in f.cm.free_names and "WXSIN_0001" in f.cm.free_names
     _assert_fit_parity(
@@ -269,7 +333,7 @@ def test_wls_fit_vs_oracle_golden20_fd_swx_piecewise():
     from pint_tpu.fitting import WLSFitter
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden20", WLSFitter, {}, contextlib.nullcontext()
+        "golden20", WLSFitter, {}, contextlib.nullcontext
     )
     assert "FD1JUMP1" in f.cm.free_names
     _assert_fit_parity(
@@ -306,8 +370,8 @@ def test_fit_with_free_glitch_parameters(tmp_path):
     par.write_text("\n".join(lines) + "\n")
 
     f, chi2_fw, values, sigmas, chi2_or = _run_case(
-        "golden7", GLSFitter, {"fused": False}, contextlib.nullcontext(),
-        par=str(par),
+        "golden7", GLSFitter, {"fused": False}, contextlib.nullcontext,
+        par=str(par), cache_name="golden7_fit_glfree",
     )
     for name in glitch_free:
         assert name in f.cm.free_names

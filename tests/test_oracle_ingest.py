@@ -51,8 +51,6 @@ def _chain_warnings_are_errors():
 
 @pytest.fixture(scope="module", params=INGEST_STEMS)
 def ingest_case(request):
-    from oracle.mp_pipeline import OraclePulsar
-
     from pint_tpu.models.builder import get_model_and_toas
 
     stem = request.param
@@ -64,22 +62,36 @@ def ingest_case(request):
             )
         finally:
             ctx.__exit__(None, None, None)
-        oracle = OraclePulsar(
-            str(DATADIR / f"{stem}.par"), str(DATADIR / f"{stem}.tim")
-        )
-    return stem, model, toas, oracle
+    return stem, model, toas
 
 
 def test_ingest_chain_oracle_residuals(ingest_case):
     """Raw residuals match the independent oracle at EVERY TOA to <1 ns
     — clock chain, EOP rotation, and SPK ephemeris all applied by both
-    sides through separately written code."""
-    stem, model, toas, oracle = ingest_case
+    sides through separately written code.  The oracle values come from
+    the content-hash cache (tests/oracle/cache.py) whose key includes
+    every committed clock/EOP/SPK file, so a change to the chain data
+    or the oracle recomputes automatically."""
+    from oracle.cache import cached_oracle, ingest_env_parts
+    from oracle.mp_pipeline import OraclePulsar
+
+    stem, model, toas = ingest_case
     cm = model.compile(toas)
     fw = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
-    raw = np.array(
-        [float(oracle._one_residual_raw(t)) for t in oracle.toas]
-    )
+    par, tim = DATADIR / f"{stem}.par", DATADIR / f"{stem}.tim"
+
+    def compute():
+        with golden_ingest_env():
+            oracle = OraclePulsar(str(par), str(tim))
+            return {"raw": np.array(
+                [float(oracle._one_residual_raw(t)) for t in oracle.toas]
+            )}
+
+    raw = cached_oracle(
+        f"{stem}_resid",
+        [par.read_bytes(), tim.read_bytes(), *ingest_env_parts()],
+        compute,
+    )["raw"]
     np.testing.assert_allclose(fw, raw, rtol=0, atol=1e-9)
 
 
@@ -152,6 +164,66 @@ def test_dmx_boundary_coverage():
     for lo, hi in ((54550.0, 55000.0), (55400.0, 55860.0)):
         assert (mjds < lo).sum() or (mjds > hi).sum()
         assert ((mjds >= lo) & (mjds <= hi)).sum() > 5
+
+
+def test_satellite_geometry_feeds_full_amplitude():
+    """golden21's observatory positions come from the orbit-table
+    spline at full LEO amplitude (|obs - geocenter| = 6.8e6 m ≈ 23 ms
+    of light time ≫ the 1 ns parity bound), so the satellite path in
+    the oracle parity test above is non-vacuous."""
+    from pint_tpu.ephemeris import get_ephemeris, mjd_tdb_to_et
+    from pint_tpu.models.builder import get_model_and_toas
+
+    with golden_ingest_env(), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = get_model_and_toas(
+            str(DATADIR / "golden21.par"), str(DATADIR / "golden21.tim")
+        )
+        eph = get_ephemeris("mini_vsop87")
+        et = mjd_tdb_to_et(toas.t_tdb.mjd_int, toas.t_tdb.sec.to_float())
+        epos_km, _ = eph.ssb_posvel(399, et)
+    r = np.linalg.norm(toas.ssb_obs_pos - epos_km * 1000.0, axis=-1)
+    np.testing.assert_allclose(r, 6.8e6, rtol=1e-3)
+
+
+def test_tzr_anchor_actually_matters():
+    """golden22 with the TZR cards removed: residuals shift by a
+    NON-integer phase offset ≫ 1 ns — the parity test above therefore
+    checks the TZR-anchored absolute zero, not phase-mod-1 shape."""
+    from pint_tpu.models.builder import get_model_and_toas
+
+    par = (DATADIR / "golden22.par").read_text()
+    par_notzr = "\n".join(
+        line for line in par.splitlines() if not line.startswith("TZR")
+    )
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".par", delete=False
+    ) as f:
+        f.write(par_notzr)
+        notzr = f.name
+
+    def resid(parfile):
+        with golden_ingest_env(), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model, toas = get_model_and_toas(
+                parfile, str(DATADIR / "golden22.tim")
+            )
+        cm = model.compile(toas)
+        return np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
+
+    d = resid(str(DATADIR / "golden22.par")) - resid(notzr)
+    # the anchor is a common-mode NON-integer phase shift: folded to
+    # cycles it is the same value at every TOA ('nearest' rounding can
+    # relabel individual TOAs by whole cycles, which folding removes),
+    # far above the 1 ns parity bound
+    f0 = 317.37894317821
+    dc = d * f0
+    folded = dc - np.round(dc)
+    assert np.abs(folded).max() > 1e-3          # non-integer shift
+    assert np.abs(folded - folded[0]).max() < 1e-6  # common mode
+    assert np.abs(folded[0]) / f0 > 1e-7        # >> 1 ns in seconds
 
 
 def test_troposphere_branch_coverage():
