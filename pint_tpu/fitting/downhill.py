@@ -123,8 +123,14 @@ class DownhillFitter(Fitter):
         probe_lams = [
             s for s in (5e-4, 2.5e-4, 1.25e-4, 6.25e-5)
             if s < min_lambda
-        ] or [min_lambda * 0.5, min_lambda * 0.25,
-              min_lambda * 0.125, min_lambda * 0.0625]
+        ]
+        if len(probe_lams) < 4:
+            # a PARTIALLY-surviving fixed list (min_lambda in
+            # (6.25e-5, 5e-4]) would leave the line fit under-
+            # determined and _chi2_noise_floor silently 0 — scale the
+            # whole probe set down instead
+            probe_lams = [min_lambda * f
+                          for f in (0.5, 0.25, 0.125, 0.0625)]
         # measure from the dedicated probes + the lambda=0 baseline
         # ONLY: ladder trials up to ~8e-3 carry a true quadratic term
         # ~pred*lambda^2 whose deviation from the fitted line would

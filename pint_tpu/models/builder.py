@@ -161,8 +161,20 @@ class ModelBuilder:
             # eager TZR ingest: the clock/EOP/ephemeris environment in
             # scope NOW (model build) is the one the reference arrival
             # must use; a later compile() elsewhere would silently
-            # anchor through a different chain (golden22 oracle set)
-            absph.ingested_tzr_toas(model)
+            # anchor through a different chain (golden22 oracle set).
+            # A failure (unresolvable TZRSITE, orbit dir unset) must
+            # NOT break parse-only workflows (par read-modify-write,
+            # tcb2tdb): warn and let compile() raise if it still can't
+            # ingest then.
+            try:
+                absph.ingested_tzr_toas(model)
+            except Exception as e:
+                warnings.warn(
+                    f"TZR reference arrival could not be ingested at "
+                    f"model build ({e}); phase anchoring is deferred "
+                    "to compile() under the environment in scope then",
+                    UserWarning,
+                )
         return model
 
     @staticmethod

@@ -66,6 +66,10 @@ def nested_sample(
     cubes = rng.uniform(size=(nlive, ndim))
     X = np.stack([prior_transform(c) for c in cubes])
     logl = np.array(loglike_batch(X), dtype=np.float64)  # writable copy
+    # NaN likelihoods (overflowed residuals at extreme prior draws)
+    # are treated as impossible, exactly like -inf; they then die
+    # first and carry zero weight (see the logwt guard below)
+    logl[np.isnan(logl)] = -np.inf
     ncall = nlive
 
     logz = -np.inf
@@ -94,13 +98,16 @@ def nested_sample(
         lv0, lv1 = -it / nlive, -(it + 1) / nlive
         logdvol = lv1 + np.log(np.expm1(lv0 - lv1))
         logwt = l_min + logdvol
-        logz_new = np.logaddexp(logz, logwt)
-        prev = (
-            np.exp(logz - logz_new) * (h + logz)
-            if np.isfinite(logz) else 0.0
-        )
-        h = np.exp(logwt - logz_new) * l_min + prev - logz_new
-        logz = logz_new
+        if np.isfinite(logwt):
+            logz_new = np.logaddexp(logz, logwt)
+            prev = (
+                np.exp(logz - logz_new) * (h + logz)
+                if np.isfinite(logz) else 0.0
+            )
+            h = np.exp(logwt - logz_new) * l_min + prev - logz_new
+            logz = logz_new
+        # else: an impossible point (l_min = -inf) carries zero
+        # weight — updating H through it would make logzerr NaN
         dead_x.append(X[i_min].copy())
         dead_logl.append(l_min)
         dead_logwt.append(logwt)
@@ -108,9 +115,24 @@ def nested_sample(
         # replacement: pool first (threshold only rises), else propose
         keep = pool_l > l_min
         pool_c, pool_x, pool_l = pool_c[keep], pool_x[keep], pool_l[keep]
+        rounds = 0
+        ell = None  # live set is invariant until a replacement lands
         while len(pool_l) == 0:
-            mean, L = _bounding_ellipsoid(cubes, enlarge)
-            cand = _sample_ellipsoid(rng, mean, L, batch)
+            rounds += 1
+            if rounds > 1000:
+                # likelihood plateau (or an all-impossible start): no
+                # candidate can exceed l_min, so the rejection loop
+                # would spin forever — fail loudly with the state
+                raise RuntimeError(
+                    f"nested_sample: no candidate exceeded logl="
+                    f"{l_min!r} after {rounds - 1} proposal rounds "
+                    f"({(rounds - 1) * batch} draws) at iteration "
+                    f"{it}; the likelihood is flat (or -inf) over "
+                    "the sampled region"
+                )
+            if ell is None:
+                ell = _bounding_ellipsoid(cubes, enlarge)
+            cand = _sample_ellipsoid(rng, *ell, batch)
             ok = np.all((cand >= 0.0) & (cand < 1.0), axis=1)
             cand = cand[ok]
             if len(cand) == 0:
@@ -127,7 +149,7 @@ def nested_sample(
             cl = np.asarray(
                 loglike_batch(cx_pad), dtype=np.float64
             )[: len(cx)]
-            ncall += len(cand)
+            ncall += len(cx_pad)  # padded rows are evaluated too
             good = cl > l_min
             pool_c, pool_x, pool_l = cand[good], cx[good], cl[good]
         cubes[i_min] = pool_c[0]
@@ -140,13 +162,15 @@ def nested_sample(
     logdvol = -it / nlive - np.log(nlive)
     for j in range(nlive):
         logwt = float(logl[j]) + logdvol
-        logz_new = np.logaddexp(logz, logwt)
-        prev = (
-            np.exp(logz - logz_new) * (h + logz)
-            if np.isfinite(logz) else 0.0
-        )
-        h = np.exp(logwt - logz_new) * float(logl[j]) + prev - logz_new
-        logz = logz_new
+        if np.isfinite(logwt):
+            logz_new = np.logaddexp(logz, logwt)
+            prev = (
+                np.exp(logz - logz_new) * (h + logz)
+                if np.isfinite(logz) else 0.0
+            )
+            h = (np.exp(logwt - logz_new) * float(logl[j])
+                 + prev - logz_new)
+            logz = logz_new
         dead_x.append(X[j].copy())
         dead_logl.append(float(logl[j]))
         dead_logwt.append(logwt)

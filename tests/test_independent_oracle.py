@@ -7,7 +7,7 @@ with no shared evaluation code — the stand-in for the reference's
 stored Tempo2 oracles (tests/datafile/ pattern, SURVEY.md §4) that a
 framework bug cannot fool by being self-consistent.
 
-Sixteen golden datasets span the component matrix here (golden13-16,
+Seventeen golden datasets span the component matrix here (golden13-16,
 the full-ingest-chain sets, run in tests/test_oracle_ingest.py):
   golden1: ELL1 binary + DM + EFAC + PL red noise
   golden2: DD binary (OMDOT/GAMMA/M2/SINI) + PM + PX + DMX + JUMP
@@ -128,7 +128,7 @@ def test_independent_oracle_weighted_mean():
     np.testing.assert_allclose(fw, meansub, rtol=0, atol=1e-9)
 
 
-def test_tcb_conversion_actually_matters():
+def test_tcb_conversion_actually_matters(tmp_path):
     """Reading golden23's par as if it were TDB (UNITS line dropped)
     moves the residuals by ≫ the 1 ns parity bound — i.e. the TCB
     parity test above cannot pass vacuously.  (The conversion scales
@@ -139,13 +139,8 @@ def test_tcb_conversion_actually_matters():
     par_tdb = "\n".join(
         line for line in par.splitlines() if not line.startswith("UNITS")
     )
-    import tempfile
-
-    with tempfile.NamedTemporaryFile(
-        "w", suffix=".par", delete=False
-    ) as f:
-        f.write(par_tdb)
-        notcb = f.name
+    notcb = str(tmp_path / "golden23_notcb.par")
+    (tmp_path / "golden23_notcb.par").write_text(par_tdb)
 
     def resid(parfile):
         with warnings.catch_warnings():

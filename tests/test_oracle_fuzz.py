@@ -195,6 +195,32 @@ def _cases():
     return out
 
 
+FIT_CASES_PER_ROUND = 2
+
+
+def _mark_fit_flags(par_text, rng):
+    """Promote a random supported subset of the drawn parameters to
+    free-for-fit, and strip free flags the fit oracle cannot step
+    (ELONG/ELAT have no central-difference step — mp_fit._STEPS)."""
+    out = []
+    for ln in par_text.splitlines():
+        key = ln.split()[0]
+        if key in ("ELONG", "ELAT") and ln.rstrip().endswith(" 1"):
+            ln = ln.rstrip()[:-2].rstrip()
+        elif key in ("PB", "A1"):
+            ln = ln + " 1"
+        elif key in ("EPS1", "EPS2", "ECC", "OM", "JUMP") \
+                and rng.random() < 0.5:
+            ln = ln + " 1"
+        out.append(ln)
+    return "\n".join(out) + "\n"
+
+
+def _fit_cases():
+    return [(seed, case) for seed in FUZZ_SEEDS
+            for case in range(FIT_CASES_PER_ROUND)]
+
+
 @pytest.mark.parametrize("seed,case", _cases())
 def test_oracle_fuzz_composition(seed, case, tmp_path):
     from oracle.mp_pipeline import OraclePulsar
@@ -228,4 +254,54 @@ def test_oracle_fuzz_composition(seed, case, tmp_path):
     np.testing.assert_allclose(
         fw, raw, rtol=0, atol=1e-9,
         err_msg=f"seed={seed} case={case}\n{par_text}",
+    )
+
+
+@pytest.mark.parametrize("seed,case", _fit_cases())
+def test_oracle_fuzz_fit(seed, case, tmp_path):
+    """FIT-level fuzz: a random composition with a random free-parameter
+    subset (spin + astrometry + DM + binary Keplerians + JUMP) through
+    the mpmath Gauss-Newton oracle — jacfwd design columns (including
+    through the Kepler solve of whatever binary was drawn) vs central
+    differences of the oracle's own residuals, on compositions nobody
+    hand-picked.  Never cached.  Reference parity:
+    src/pint/fitter.py::WLSFitter.fit_toas."""
+    from oracle.mp_fit import OracleFitter
+    from oracle.mp_pipeline import OraclePulsar
+    from test_oracle_fit import _assert_fit_parity
+
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.simulation import make_test_pulsar
+
+    rng = np.random.default_rng([seed, 1000 + case])
+    par_text = None
+    while par_text is None:
+        par_text = _fix_constraints(_draw_par(rng), rng)
+    par_text = _mark_fit_flags(par_text, rng)
+    par = tmp_path / "fuzzfit.par"
+    tim = tmp_path / "fuzzfit.tim"
+    par.write_text(par_text)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            par_text, ntoa=45, start_mjd=54600.0, end_mjd=55400.0,
+            seed=seed * 100 + 50 + case, obs="gbt",
+            freqs=(1400.0, 800.0, 2300.0),
+            flags=("L-wide", "S-wide"),
+        )
+        write_tim_file(tim, toas)
+        model, toas = get_model_and_toas(str(par), str(tim))
+        f = WLSFitter(toas, model)
+        chi2_fw = f.fit_toas(maxiter=4)
+    free_names = list(f.cm.free_names)
+    oracle = OraclePulsar(str(par), str(tim))
+    of = OracleFitter(oracle, free_names)
+    v, s, c2 = of.fit(niter=2)
+    values = {n: float(v[n]) for n in free_names}
+    sigmas = {n: float(s[n]) for n in free_names}
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, float(c2),
+        value_tol_sigma=3e-3, sigma_rtol=3e-5, chi2_rtol=1e-5,
     )

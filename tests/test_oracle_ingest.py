@@ -186,7 +186,7 @@ def test_satellite_geometry_feeds_full_amplitude():
     np.testing.assert_allclose(r, 6.8e6, rtol=1e-3)
 
 
-def test_tzr_anchor_actually_matters():
+def test_tzr_anchor_actually_matters(tmp_path):
     """golden22 with the TZR cards removed: residuals shift by a
     NON-integer phase offset ≫ 1 ns — the parity test above therefore
     checks the TZR-anchored absolute zero, not phase-mod-1 shape."""
@@ -196,13 +196,8 @@ def test_tzr_anchor_actually_matters():
     par_notzr = "\n".join(
         line for line in par.splitlines() if not line.startswith("TZR")
     )
-    import tempfile
-
-    with tempfile.NamedTemporaryFile(
-        "w", suffix=".par", delete=False
-    ) as f:
-        f.write(par_notzr)
-        notzr = f.name
+    notzr = str(tmp_path / "golden22_notzr.par")
+    Path(notzr).write_text(par_notzr)
 
     def resid(parfile):
         with golden_ingest_env(), warnings.catch_warnings():
@@ -218,7 +213,8 @@ def test_tzr_anchor_actually_matters():
     # cycles it is the same value at every TOA ('nearest' rounding can
     # relabel individual TOAs by whole cycles, which folding removes),
     # far above the 1 ns parity bound
-    f0 = 317.37894317821
+    f0 = next(float(ln.split()[1]) for ln in par.splitlines()
+              if ln.split() and ln.split()[0] == "F0")
     dc = d * f0
     folded = dc - np.round(dc)
     assert np.abs(folded).max() > 1e-3          # non-integer shift
