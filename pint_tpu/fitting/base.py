@@ -37,7 +37,8 @@ def design_with_offset(cm, x):
     return jnp.concatenate([ones, M], axis=1)
 
 
-def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
+def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2,
+                       cm=None):
     """The whole Gauss-Newton iteration as ONE device program
     (lax.scan), so a fit costs a single dispatch instead of `maxiter`
     host round-trips (~85 ms each through the axon tunnel).  Semantics
@@ -86,7 +87,6 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
             (nbad, bad & ~done),
         )
 
-    @jax.jit
     def fit_loop(x0):
         init = (
             x0,
@@ -100,7 +100,10 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2):
         )
         return x, chi2, cov, conv, nbads, bads
 
-    return fit_loop
+    # with a CompiledModel in hand, the TOA bundle rides as a runtime
+    # argument (cm.jit) so the lowered module is O(1) in ntoa — a plain
+    # jit would bake ~240 HLO bytes/TOA of bundle literals
+    return cm.jit(fit_loop) if cm is not None else jax.jit(fit_loop)
 
 
 class Fitter:

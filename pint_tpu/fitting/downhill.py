@@ -141,7 +141,7 @@ class DownhillFitter(Fitter):
         )
         all_lams = np.asarray(lams + probe_lams + [0.0])
         lams_arr = jnp.asarray(all_lams)
-        chi2_ladder = jax.jit(
+        chi2_ladder = self.cm.jit(
             lambda x, dx: jax.vmap(chi2_of)(
                 x[None, :] + lams_arr[:, None] * dx[None, :]
             )
@@ -238,7 +238,7 @@ class DownhillWLSFitter(DownhillFitter):
     def _make_proposal(self):
         cm, noffset = self.cm, self._noffset
 
-        @jax.jit
+        @cm.jit
         def proposal(x):
             r = cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
@@ -252,7 +252,7 @@ class DownhillWLSFitter(DownhillFitter):
 
     def _make_chi2(self):
         # cm.chi2 profiles the offset exactly via weighted-mean subtraction
-        return jax.jit(self.cm.chi2)
+        return self.cm.jit(self.cm.chi2)
 
 
 class DownhillGLSFitter(DownhillFitter):
@@ -281,7 +281,7 @@ class DownhillGLSFitter(DownhillFitter):
         else:
             step = gls_step_woodbury
 
-        @jax.jit
+        @cm.jit
         def proposal(x):
             r = cm.time_residuals(x, subtract_mean=False)
             M = self._design_with_offset(x)
@@ -298,7 +298,7 @@ class DownhillGLSFitter(DownhillFitter):
     def _make_chi2(self):
         cm = self.cm
 
-        @jax.jit
+        @cm.jit
         def chi2(x):
             r = cm.time_residuals(x, subtract_mean=False)
             Ndiag, T, phi = self._noise(x)

@@ -414,7 +414,8 @@ class GLSFitter(Fitter):
             return x + dx[no:], cov, chi2, nbad.astype(jnp.int32)
 
         return make_scan_fit_loop(
-            live_step, p, maxiter, tol_chi2, lambda x0: jnp.asarray(jnp.inf)
+            live_step, p, maxiter, tol_chi2,
+            lambda x0: jnp.asarray(jnp.inf), cm=self.cm,
         )
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float | None = None) -> float:

@@ -112,7 +112,7 @@ class WLSFitter(Fitter):
             return x_new, cov, self.cm.chi2(x_new), nbad.astype(jnp.int32)
 
         return make_scan_fit_loop(
-            live_step, p, maxiter, tol_chi2, self.cm.chi2
+            live_step, p, maxiter, tol_chi2, self.cm.chi2, cm=self.cm
         )
 
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:

@@ -18,6 +18,8 @@ from __future__ import annotations
 import warnings
 from typing import Union
 
+from pint_tpu.exceptions import PintTpuError
+
 # import the component zoo so the registry is populated
 import pint_tpu.models.absolute_phase  # noqa: F401
 import pint_tpu.models.astrometry  # noqa: F401
@@ -168,7 +170,12 @@ class ModelBuilder:
             # ingest then.
             try:
                 absph.ingested_tzr_toas(model)
-            except Exception as e:
+            except (PintTpuError, FileNotFoundError, OSError) as e:
+                # only ENVIRONMENT-resolution failures (unknown site,
+                # missing orbit/clock/ephemeris files) defer; anything
+                # else is a real ingest bug and must propagate — a
+                # swallowed one would let compile() anchor the phase
+                # through a different chain, the golden22 bug class
                 warnings.warn(
                     f"TZR reference arrival could not be ingested at "
                     f"model build ({e}); phase anchoring is deferred "

@@ -29,7 +29,7 @@ import numpy as np
 PEAK_BF16_FLOPS = 197e12
 
 
-def _timeit(step, x0, nrep=3, chain=128):
+def _timeit(step, x0, nrep=3, chain=128, jit_wrap=None):
     """Per-step (time, flops) from a `chain`-long dependent lax.scan —
     ONE dispatch for the whole chain (matching how production fit
     loops run; a single isolated call would instead measure the
@@ -40,13 +40,18 @@ def _timeit(step, x0, nrep=3, chain=128):
     (None when the backend does not report it)."""
     import jax
 
-    @jax.jit
-    def run(x):
+    def run_fn(x):
         def body(c, _):
             x2, chi2 = step(c)
             return x2, chi2
 
         return jax.lax.scan(body, x, None, length=chain)
+
+    # jit_wrap=cm.jit threads the TOA bundle through the whole chained
+    # program as a runtime argument — at 1e6 TOAs a plain jit would
+    # bake ~240 MB of bundle literals into the module and break the
+    # remote-compile transport (r4, config3b)
+    run = (jit_wrap or jax.jit)(run_fn)
 
     compiled = run.lower(x0).compile()
     flops = None
@@ -83,7 +88,7 @@ def _fitter_step_fn(fitter):
         dx, _, chi2, _ = step(x)
         return x + dx[no:], chi2
 
-    return jax.jit(fit_step), mode
+    return fit_step, mode  # unjitted: _timeit wraps via cm.jit
 
 
 def config_1():
@@ -95,7 +100,8 @@ def config_1():
                                end_mjd=54200)
     fitter = GLSFitter(toas, m)
     step, mode = _fitter_step_fn(fitter)
-    return f"config1 WLS ~60 TOAs [{mode}]", 62, step, fitter.cm.x0()
+    return (f"config1 WLS ~60 TOAs [{mode}]", 62, step, fitter.cm.x0(),
+            128, {"jit_wrap": fitter.cm.jit})
 
 
 def _gls_config(ntoa, label):
@@ -112,7 +118,8 @@ def _gls_config(ntoa, label):
     )
     fitter = GLSFitter(toas, m)
     step, mode = _fitter_step_fn(fitter)
-    return f"{label} [{mode}]", ntoa, step, fitter.cm.x0()
+    return (f"{label} [{mode}]", ntoa, step, fitter.cm.x0(),
+            128, {"jit_wrap": fitter.cm.jit})
 
 
 def config_2():
@@ -128,9 +135,13 @@ def config_3b():
     item 3 / weak 5): the memory-lean Woodbury step's arrays are the
     (n, k) basis and a handful of n-vectors, so PTA-scale n is a
     bandwidth problem, not a memory wall.  chain=32: the per-step cost
-    is bandwidth-bound ~10s of ms."""
-    built = _gls_config(1_000_000, "config3b GLS 1e6 TOAs + red noise")
-    return built + (32,)
+    is bandwidth-bound ~10s of ms.  Bundle-as-argument compilation
+    (cm.jit) is what makes this config COMPILABLE at all: baked-
+    literal lowering is ~240 MB of HLO here."""
+    label, ntoa, step, x0, _, extras = _gls_config(
+        1_000_000, "config3b GLS 1e6 TOAs + red noise"
+    )
+    return label, ntoa, step, x0, 32, extras
 
 
 def _wideband_config(ntoa, label):
@@ -149,7 +160,8 @@ def _wideband_config(ntoa, label):
         f["pp_dme"] = "2e-4"
     fitter = WidebandTOAFitter(toas, get_model(par))
     step, mode = _fitter_step_fn(fitter)
-    return f"{label} [{mode}]", ntoa, step, fitter.cm.x0()
+    return (f"{label} [{mode}]", ntoa, step, fitter.cm.x0(),
+            128, {"jit_wrap": fitter.cm.jit})
 
 
 def config_4():
@@ -345,7 +357,8 @@ def config_6():
         # emulated-f64 full reduction
         return x + 0.0 * frac[0], jnp.sum(frac.astype(jnp.float32))
 
-    return "config6 photon phase 1e6 events", n, step, cm.x0()
+    return ("config6 photon phase 1e6 events", n, step, cm.x0(),
+            128, {"jit_wrap": cm.jit})
 
 
 def main():
@@ -367,8 +380,9 @@ def main():
         built = builders[str(c)]()
         label, ntoa, step, x0 = built[:4]
         chain = built[4] if len(built) > 4 else 128
-        extras = built[5] if len(built) > 5 else {}
-        t_dev, flops = _timeit(step, x0, chain=chain)
+        extras = dict(built[5]) if len(built) > 5 else {}
+        jit_wrap = extras.pop("jit_wrap", None)
+        t_dev, flops = _timeit(step, x0, chain=chain, jit_wrap=jit_wrap)
         out = {
             "config": label,
             "backend": jax.default_backend(),

@@ -139,8 +139,9 @@ def test_tcb_conversion_actually_matters(tmp_path):
     par_tdb = "\n".join(
         line for line in par.splitlines() if not line.startswith("UNITS")
     )
-    notcb = str(tmp_path / "golden23_notcb.par")
-    (tmp_path / "golden23_notcb.par").write_text(par_tdb)
+    p = tmp_path / "golden23_notcb.par"
+    p.write_text(par_tdb)
+    notcb = str(p)
 
     def resid(parfile):
         with warnings.catch_warnings():

@@ -213,6 +213,15 @@ def _mark_fit_flags(par_text, rng):
                 and rng.random() < 0.5:
             ln = ln + " 1"
         out.append(ln)
+    # correlated noise -> the GLS fit oracle (Woodbury C = N+T phi T^T
+    # rebuilt independently in mpmath): PL red and/or ECORR, drawn on
+    # top of whatever white noise the composition already has
+    if rng.random() < 0.4:
+        out.append(f"TNREDAMP {rng.uniform(-14.0, -12.8):.3f}")
+        out.append(f"TNREDGAM {rng.uniform(1.5, 5.0):.3f}")
+        out.append(f"TNREDC {rng.integers(3, 6)}")
+    if rng.random() < 0.3:
+        out.append(f"ECORR -f L-wide {rng.uniform(0.1, 0.9):.3f}")
     return "\n".join(out) + "\n"
 
 
@@ -264,13 +273,15 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
     the mpmath Gauss-Newton oracle — jacfwd design columns (including
     through the Kepler solve of whatever binary was drawn) vs central
     differences of the oracle's own residuals, on compositions nobody
-    hand-picked.  Never cached.  Reference parity:
-    src/pint/fitter.py::WLSFitter.fit_toas."""
+    hand-picked.  Compositions that draw correlated noise (PL red /
+    ECORR) run through GLSFitter against the oracle's independent
+    mpmath Woodbury.  Never cached.  Reference parity:
+    src/pint/fitter.py::WLSFitter/GLSFitter.fit_toas."""
     from oracle.mp_fit import OracleFitter
     from oracle.mp_pipeline import OraclePulsar
     from test_oracle_fit import _assert_fit_parity
 
-    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.fitting import GLSFitter, WLSFitter
     from pint_tpu.io.tim import write_tim_file
     from pint_tpu.models.builder import get_model_and_toas
     from pint_tpu.simulation import make_test_pulsar
@@ -293,7 +304,11 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
         )
         write_tim_file(tim, toas)
         model, toas = get_model_and_toas(str(par), str(tim))
-        f = WLSFitter(toas, model)
+        correlated = ("TNREDAMP" in par_text) or ("ECORR" in par_text)
+        if correlated:
+            f = GLSFitter(toas, model, fused=False)
+        else:
+            f = WLSFitter(toas, model)
         chi2_fw = f.fit_toas(maxiter=4)
     free_names = list(f.cm.free_names)
     oracle = OraclePulsar(str(par), str(tim))
