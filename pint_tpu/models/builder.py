@@ -170,9 +170,12 @@ class ModelBuilder:
             # ingest then.
             try:
                 absph.ingested_tzr_toas(model)
-            except (PintTpuError, FileNotFoundError, OSError) as e:
-                # only ENVIRONMENT-resolution failures (unknown site,
-                # missing orbit/clock/ephemeris files) defer; anything
+            except (PintTpuError, OSError, ValueError, KeyError) as e:
+                # only ENVIRONMENT-resolution failures defer: unknown
+                # site / missing files (PintTpuError, OSError), and
+                # malformed or incomplete data files (the SPK reader
+                # raises ValueError for a non-DAF file and KeyError
+                # for a missing target->SSB segment path).  Anything
                 # else is a real ingest bug and must propagate — a
                 # swallowed one would let compile() anchor the phase
                 # through a different chain, the golden22 bug class

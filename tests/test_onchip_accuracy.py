@@ -137,6 +137,40 @@ DM    12.345              1
     assert dDM < 5.0 * float(f.model.DM.uncertainty) + 1e-12
 
 
+def test_onchip_full_cov_blocked_matches_woodbury():
+    """The dense full-cov mixed path at n >= 2048 uses the blocked
+    f32 Cholesky as the IR preconditioner on accelerators
+    (fitting/gls.py; CPU pytest can never reach that gate) — the
+    fitted answer must match the independent Woodbury factorization
+    of the same model to the documented mixed-precision class."""
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR OC\nF0 300.0 1\nF1 -1e-14 1\nPEPOCH 55000\nDM 10 1\n"
+        "EFAC -f L-wide 1.1\nTNREDAMP -13.5\nTNREDGAM 3.7\nTNREDC 5\n"
+    )
+
+    def fit(full_cov):
+        m, toas = make_test_pulsar(
+            par, ntoa=2048, start_mjd=55000.0, end_mjd=56000.0,
+            iterations=1, seed=3,
+        )
+        f = GLSFitter(toas, m, full_cov=full_cov)
+        return f, f.fit_toas()
+
+    fd, chi2_dense = fit(True)   # blocked-preconditioner IR path
+    fw, chi2_wood = fit(False)   # Woodbury path
+    assert np.isfinite(chi2_dense)
+    assert chi2_dense == pytest.approx(chi2_wood, rel=3e-3)
+    for n in fw.cm.free_names:
+        a, b = fd.model.params[n].value, fw.model.params[n].value
+        fa = float(a.to_float()) if hasattr(a, "to_float") else float(a)
+        fb = float(b.to_float()) if hasattr(b, "to_float") else float(b)
+        s = float(fw.model.params[n].uncertainty)
+        assert abs(fa - fb) < 0.05 * s + 1e-15, (n, fa, fb, s)
+
+
 def test_onchip_downhill_no_spurious_warning():
     """Downhill on emulated f64: the chi2 lambda ladder is noise-
     limited near convergence, and r2's accept/reject fired a spurious
