@@ -108,10 +108,13 @@ def _fit_step_fn(cm, mode: str = "f64"):
         dx, cov, chi2, _ = step(r, M, Ndiag, T, phi)
         return x + dx[no:], chi2
 
-    return jax.jit(fit_step)
+    # CompiledModel.jit: baked-constant lowering at this (1e5) size,
+    # argument-fed above 2e5 TOAs (docs/parallelism.md 'Compile
+    # discipline' — the threshold trade-off is measured there)
+    return cm.jit(fit_step)
 
 
-def _time_step(step, x0, nrep=5, chain=16, data_args=()):
+def _time_step(step, x0, nrep=5, chain=16, data_args=(), jit_wrap=None):
     """Median time per fit step, measured as ONE device program of
     `chain` DEPENDENT steps (lax.scan, x feeding forward — exactly how
     GLSFitter._make_fit_loop runs a production fit), so the whole
@@ -125,16 +128,29 @@ def _time_step(step, x0, nrep=5, chain=16, data_args=()):
     value exists, silently shrinking measured times."""
     import jax
 
-    @jax.jit
-    def run_chain(x, *data):
+    def _run(x, *data):
         def body(c, _):
             x2, chi2 = step(*data, c) if data else step(c)
             return x2, chi2
 
         return jax.lax.scan(body, x, None, length=chain)
 
+    # jit_wrap=cm.jit threads the bundle through the whole chained
+    # program as a runtime argument (an inner cm.jit under a plain
+    # outer jit would re-bake the bundle as constants)
+    run_chain = (jit_wrap or jax.jit)(_run)
+
     x, c = run_chain(x0, *data_args)  # warmup/compile
     _ = np.asarray(x)
+    # refuse to publish a timing of garbage: NaN chains time exactly
+    # like correct ones on TPU (run_benchmarks.py gained the same gate
+    # in r4 when device-computed phi flushed to zero)
+    if not (np.all(np.isfinite(np.asarray(x)))
+            and np.all(np.isfinite(np.asarray(c)[-1:]))):
+        raise RuntimeError(
+            "bench step produced non-finite state/chi2 — refusing to "
+            "time it"
+        )
     ts = []
     for _ in range(nrep):
         t0 = time.perf_counter()
@@ -160,7 +176,7 @@ def main():
     # fits amortize the one-dispatch cost over GN iterations and over
     # vmapped PTA batches; the tunnel round-trip is not TPU work and
     # still contributes < 0.5 ms/step at this chain length)
-    t_dev = _time_step(step, cm.x0(), chain=256)
+    t_dev = _time_step(step, cm.x0(), chain=256, jit_wrap=cm.jit)
 
     # CPU baseline: the all-f64 reference-class computation on host
     # (dispatch-free, so a short chain measures the same steady state).

@@ -121,7 +121,9 @@ class BayesianTiming:
         ])
 
     def lnposterior_jit(self):
-        return jax.jit(self.lnposterior)
+        # bundle rides as a runtime argument (CompiledModel.jit): the
+        # lowered module stays O(1) in ntoa for event-scale datasets
+        return self.cm.jit(self.lnposterior)
 
     def sample_nested(self, nlive: int = 200, dlogz: float = 0.1,
                       seed: int = 0, **kw):
@@ -132,7 +134,7 @@ class BayesianTiming:
         (improper uniforms have no prior transform)."""
         from pint_tpu.nested import nested_sample
 
-        ll = jax.jit(jax.vmap(self.lnlikelihood))
+        ll = self.cm.jit(jax.vmap(self.lnlikelihood))
 
         def loglike_batch(X):
             return np.asarray(ll(jnp.asarray(X)))

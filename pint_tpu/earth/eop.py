@@ -63,7 +63,9 @@ def parse_finals2000a(path) -> EOPTable:
             xps.append(xp * ARCSEC)
             yps.append(yp * ARCSEC)
     if not mjds:
-        raise ValueError(f"no EOP rows parsed from {path}")
+        from pint_tpu.exceptions import DataFileError
+
+        raise DataFileError(f"no EOP rows parsed from {path}")
     return EOPTable(mjds, duts, xps, yps, name=os.path.basename(str(path)))
 
 

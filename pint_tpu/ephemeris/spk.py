@@ -21,6 +21,11 @@ from typing import NamedTuple
 
 import numpy as np
 
+from pint_tpu.exceptions import (
+    EphemerisFormatError,
+    EphemerisSegmentError,
+)
+
 RECLEN = 1024
 J2000_JD = 2451545.0
 S_PER_DAY = 86400.0
@@ -63,18 +68,33 @@ class SPK:
         with open(path, "rb") as f:
             data = f.read()
         if data[:8] not in (b"DAF/SPK ", b"NAIF/DAF"):
-            raise ValueError(f"{path}: not a DAF/SPK file ({data[:8]!r})")
+            raise EphemerisFormatError(f"{path}: not a DAF/SPK file ({data[:8]!r})")
+        try:
+            return cls._parse(data, path)
+        except EphemerisFormatError:
+            raise
+        except (ValueError, struct.error, IndexError) as e:
+            # truncated/corrupt files surface as bare numpy/struct
+            # errors (frombuffer size, short unpack) — classify them
+            # so env-sensitive consumers can tell data problems from
+            # code bugs
+            raise EphemerisFormatError(
+                f"{path}: truncated or malformed DAF/SPK ({e})"
+            ) from e
+
+    @classmethod
+    def _parse(cls, data, path) -> "SPK":
         locfmt = data[88:96]
         if locfmt.startswith(b"BIG-IEEE"):
             endian = ">"
         elif locfmt.startswith(b"LTL-IEEE"):
             endian = "<"
         else:
-            raise ValueError(f"unsupported DAF binary format {locfmt!r}")
+            raise EphemerisFormatError(f"unsupported DAF binary format {locfmt!r}")
         nd, ni = struct.unpack(endian + "ii", data[8:16])
         fward, bward, free = struct.unpack(endian + "iii", data[76:88])
         if (nd, ni) != (2, 6):
-            raise ValueError(f"not an SPK summary format: ND={nd} NI={ni}")
+            raise EphemerisFormatError(f"not an SPK summary format: ND={nd} NI={ni}")
         words = np.frombuffer(data, dtype=endian + "f8")
         ss = nd + (ni + 1) // 2  # summary size in doubles
         segments = []
@@ -134,7 +154,7 @@ class SPK:
                 done |= sel
         if not done.all():
             spans = [(s.start_et, s.stop_et) for s in segs]
-            raise ValueError(
+            raise EphemerisFormatError(
                 f"{int((~done).sum())} epochs outside all SPK segments "
                 f"for target {segs[0].target}: spans {spans}"
             )
@@ -147,7 +167,7 @@ class SPK:
         seconds past J2000 (TDB).  et: scalar or (n,)."""
         segs = self.pairs.get((target, center))
         if not segs:
-            raise KeyError(
+            raise EphemerisSegmentError(
                 f"no segment {target}<-{center} in {self.name}; "
                 f"available: {sorted(self.pairs)}"
             )
@@ -165,7 +185,7 @@ class SPK:
                 c for (t, c) in self.pairs if t == body
             )
             if not centers:
-                raise KeyError(f"no segment path {target} -> SSB")
+                raise EphemerisSegmentError(f"no segment path {target} -> SSB")
             center = centers[0]  # 0 first, then inner barycenters
             p, v = self._eval_pair(self.pairs[(body, center)], et)
             pos = p if pos is None else pos + p
@@ -173,7 +193,7 @@ class SPK:
             body = center
             hops += 1
             if hops > 10:
-                raise ValueError("segment chain does not reach SSB")
+                raise EphemerisFormatError("segment chain does not reach SSB")
         return pos, vel
 
     @property
@@ -190,7 +210,7 @@ def _eval_type23(seg: Segment, et: np.ndarray):
     # 1 s of slack absorbs roundoff at the segment edges
     bad = (et < seg.init - 1.0) | (et > end + 1.0)
     if np.any(bad):
-        raise ValueError(
+        raise EphemerisFormatError(
             f"{int(bad.sum())} epochs outside SPK segment coverage "
             f"[{seg.init}, {end}] s past J2000 "
             f"(target {seg.target} <- {seg.center})"
@@ -264,7 +284,7 @@ def write_spk_type2(
         if ncomp not in (1, 3) or (
             ncomp == 1 and sd["target"] < 1000000000
         ):
-            raise ValueError(
+            raise EphemerisFormatError(
                 "type 2 segments have 3 components (1 only for "
                 "time-ephemeris targets >= 1000000000)"
             )

@@ -32,6 +32,29 @@ class ClockCorrectionOutOfRange(PintTpuError):
     """A TOA falls outside the span of the observatory clock file."""
 
 
+class DataFileError(PintTpuError, ValueError):
+    """Malformed runtime data file (EOP tables, clock files, ...).
+    Also a ValueError so pre-r4 except clauses keep working; being a
+    PintTpuError lets environment-sensitive consumers (the TZR
+    build-time ingest) classify it as deferrable."""
+
+
+class EphemerisError(PintTpuError):
+    """Ephemeris file/segment problems (reference: jplephem errors)."""
+
+
+class EphemerisFormatError(EphemerisError, ValueError):
+    """Malformed/unsupported SPK/DAF file.  Also a ValueError so
+    pre-r4 callers' except clauses keep working."""
+
+
+class EphemerisSegmentError(EphemerisError, KeyError):
+    """Missing target/center segment or chain to the SSB.  Also a
+    KeyError: the ephemeris fallback policy
+    (ephemeris/time_ephemeris.py::_posvel) catches KeyError to retry
+    with NAIF ids / the builtin theory."""
+
+
 class UnknownObservatory(PintTpuError):
     """Observatory name not found in the registry."""
 

@@ -264,21 +264,15 @@ def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
         norm = _column_norms(M)
         Mn = M / norm[None, :]
         X = jnp.concatenate([Mn, r[:, None]], axis=1)
-        # large n: the right-looking blocked kernel beats XLA's native
-        # f32 Cholesky on TPU (23.3 vs 16.9 TF/s at n=16384, b=1024;
-        # vmapped 45 x 2048 batched: 2.59 vs 2.16 TF/s at b=256 —
-        # profiling/cholesky_sweep.py + r4 batched sweep); block ~n/8
-        # keeps the sequential panel chain short at small n while
-        # b=1024 saturates the trailing GEMMs at large n.  Below ~2k
-        # the panel overhead dominates and native wins
-        chol = None
-        n_rows = r.shape[0]
-        if n_rows >= 2048 and jax.default_backend() != "cpu":
-            from pint_tpu.parallel.dense import blocked_cholesky
-
-            blk = min(1024, max(256, n_rows // 8))
-            chol = lambda A32: blocked_cholesky(A32, block=blk)  # noqa: E731
-        CiX = woodbury_chol_solve_ir(Ndiag, T, phi, X, cholesky=chol)
+        # single-device factorization: XLA's native f32 Cholesky.
+        # The blocked kernel (parallel/dense.py) exists for the MESH-
+        # SHARDED path; single-device it only beat native (23 vs 15
+        # TF/s, r4) when its trailing GEMM ran at the TPU default
+        # bf16-pass precision — which loses the Schur cancellation on
+        # real red-noise covariances and NaNs the factor.  With the
+        # required precision=HIGHEST it measures 11.2 TF/s vs
+        # native's 15.4 (cholesky_sweep, n=16384), so native stays.
+        CiX = woodbury_chol_solve_ir(Ndiag, T, phi, X)
         # X^T C^-1 X on the MXU (an n x (p+1) emulated-f64 matmul
         # would cost more than the factorization on TPU)
         G = matmul_split32(X.T, CiX)

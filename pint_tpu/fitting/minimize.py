@@ -31,10 +31,10 @@ class MinimizeFitter(Fitter):
         self.method = method
 
     def fit_toas(self, maxiter: int = 2000) -> float:
-        chi2 = jax.jit(self.cm.chi2)
+        chi2 = self.cm.jit(self.cm.chi2)
         kw = {}
         if self.method not in ("Powell", "Nelder-Mead"):
-            grad = jax.jit(jax.grad(self.cm.chi2))
+            grad = self.cm.jit(jax.grad(self.cm.chi2))
             kw["jac"] = lambda v: np.asarray(grad(np.asarray(v)))
         res = minimize(
             lambda v: float(chi2(np.asarray(v))),

@@ -187,7 +187,7 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
         else:
             fn = gls_step_woodbury
 
-        @jax.jit
+        @self.cm.jit
         def proposal(x):
             r = self._combined_residuals(x)
             M = self._combined_design(x)
@@ -204,7 +204,7 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
     def _make_chi2(self):
         n = self.cm.bundle.ntoa
 
-        @jax.jit
+        @self.cm.jit
         def chi2(x):
             r = self._combined_residuals(x)
             Ndiag, T, phi = self._combined_noise(x)

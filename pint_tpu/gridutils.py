@@ -96,7 +96,7 @@ def _chi2_points(cm, gidx, pts, refit, n_refit_iter):
                 x = x + free_mask_j * dx[no:]
         return cm.chi2(x)
 
-    return np.asarray(jax.jit(jax.vmap(chi2_at))(jnp.asarray(pts)))
+    return np.asarray(cm.jit(jax.vmap(chi2_at))(jnp.asarray(pts)))
 
 
 def grid_chisq_derived(

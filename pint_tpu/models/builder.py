@@ -170,15 +170,14 @@ class ModelBuilder:
             # ingest then.
             try:
                 absph.ingested_tzr_toas(model)
-            except (PintTpuError, OSError, ValueError, KeyError) as e:
+            except (PintTpuError, OSError) as e:
                 # only ENVIRONMENT-resolution failures defer: unknown
-                # site / missing files (PintTpuError, OSError), and
-                # malformed or incomplete data files (the SPK reader
-                # raises ValueError for a non-DAF file and KeyError
-                # for a missing target->SSB segment path).  Anything
-                # else is a real ingest bug and must propagate — a
-                # swallowed one would let compile() anchor the phase
-                # through a different chain, the golden22 bug class
+                # site, missing files, malformed/incomplete data files
+                # (the SPK reader raises EphemerisFormat/SegmentError,
+                # both PintTpuError subclasses).  Anything else is a
+                # real ingest bug and must propagate — a swallowed one
+                # would let compile() anchor the phase through a
+                # different chain, the golden22 bug class
                 warnings.warn(
                     f"TZR reference arrival could not be ingested at "
                     f"model build ({e}); phase anchoring is deferred "

@@ -32,6 +32,9 @@ from pint_tpu.models.parameter import floatParameter, maskParameter
 from pint_tpu.ops.scalarmath import power_p
 
 F_YR = 1.0 / SECS_PER_JULIAN_YEAR
+#: log10 of the static power-law constant f_yr^-3 / (12 pi^2), folded
+#: into the amplitude exponent so no tiny intermediate is ever formed
+_LOG10_PL_K = math.log10(F_YR ** -3.0 / (12.0 * math.pi * math.pi))
 
 # TOAs closer than this are one observing epoch for ECORR quantization
 ECORR_EPOCH_GAP_S = 10.0
@@ -275,15 +278,24 @@ def host_fourier_basis(toas, nharm: int) -> np.ndarray:
 
 def powerlaw_phi(f, tspan, log10_amp, gamma):
     """Power-law PSD weights phi_j (s^2), enterprise convention:
-    phi_j = A^2/(12 pi^2) f_yr^(gamma-3) f_j^(-gamma) / Tspan."""
+    phi_j = A^2/(12 pi^2) f_yr^(gamma-3) f_j^(-gamma) / Tspan.
+
+    Evaluation order matters on accelerators whose emulated f64 keeps
+    only the f32 EXPONENT range (axon): the naive grouping
+    A^2 * f_yr^(gamma-3) hits ~4e-38 for PTA-class parameters
+    (A=10^-13.8, gamma=4.3) and flushes to ZERO, NaN-ing the whole
+    Woodbury solve through 1/phi — silently fine on CPU, where this
+    used to be constant-folded in IEEE f64 before bundles became jit
+    arguments (r4).  The amplitude factor is therefore formed in LOG
+    space with the large static constant f_yr^-3/(12 pi^2) folded in
+    (A^2 alone underflows at log10_amp <= -19, within sampler prior
+    ranges), and the result is floored at 1e-30 s^2 — physically inert
+    ((1e-15 s)^2 vs ns-scale residuals) but keeps 1/phi finite."""
     # power_p on the scalar parameters (0-d pow takes axon's f32 scalar
     # path, ops/scalarmath.py); f is per-harmonic, so plain ** is fine
-    amp = power_p(10.0, log10_amp)
-    return (
-        amp * amp / (12.0 * math.pi * math.pi)
-        * power_p(F_YR, gamma - 3.0)
-        * f ** (-gamma)
-        / tspan
+    amp2_k = power_p(10.0, 2.0 * log10_amp + _LOG10_PL_K)
+    return jnp.maximum(
+        amp2_k * (f / F_YR) ** (-gamma) / tspan, 1e-30
     )
 
 

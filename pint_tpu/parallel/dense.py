@@ -76,8 +76,17 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa"):
         col_blocks.append((Ld, pan))
         if j + block < npad:
             pan = _constrain(mesh, pan, P(axis, None))
-            # the O((n-j)^2 b) trailing GEMM — sharded, static shapes
-            A = A[block:, block:] - pan @ pan.T
+            # the O((n-j)^2 b) trailing GEMM — sharded, static shapes.
+            # precision=HIGHEST is load-bearing: the TPU default matmul
+            # (bf16 passes) loses ~1e-3 relative in pan@pan.T, and the
+            # Schur cancellation 1 - ||pan_row||^2 then goes NEGATIVE
+            # on real red-noise covariances (unit-diagonal + rank-k
+            # with ||W||_F^2 ~ 1e4) — sqrt(neg) NaNs the next diagonal
+            # block.  XLA's native Cholesky pins its internal GEMMs the
+            # same way (r4: zero-phi test matrices never exposed this).
+            A = A[block:, block:] - jnp.matmul(
+                pan, pan.T, precision=jax.lax.Precision.HIGHEST
+            )
             A = _constrain(mesh, A, P(axis, None))
     L = jnp.zeros((npad, npad), C.dtype)
     for k, (Ld, pan) in enumerate(col_blocks):

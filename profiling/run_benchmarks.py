@@ -63,10 +63,20 @@ def _timeit(step, x0, nrep=3, chain=128, jit_wrap=None):
             flops = float(ca["flops"]) / chain
     except Exception:
         pass
-    x, _ = run(x0)
-    _ = np.asarray(x)  # host copy: the only reliable sync over the
-    ts = []            # axon tunnel (block_until_ready returns early)
-    for _ in range(nrep):
+    x, chi2s = run(x0)
+    # CORRECTNESS gate before any timing is recorded: a NaN-producing
+    # step times exactly like a correct one on TPU (no traps), so an
+    # unchecked harness can publish rows that measured garbage (r4:
+    # device-computed power-law phi flushed to zero at axon's f32
+    # exponent range and NaN-ed the 1e6 GLS chain)
+    if not (np.all(np.isfinite(np.asarray(x)))
+            and np.all(np.isfinite(np.asarray(chi2s)[-1:]))):
+        raise RuntimeError(
+            "benchmark step produced non-finite state/chi2 — refusing "
+            "to time it"
+        )
+    ts = []            # host copy: the only reliable sync over the
+    for _ in range(nrep):  # axon tunnel (block_until_ready is early)
         t0 = time.perf_counter()
         x, _ = run(x0)
         _ = np.asarray(x)

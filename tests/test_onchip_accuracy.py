@@ -138,9 +138,11 @@ DM    12.345              1
 
 
 def test_onchip_full_cov_blocked_matches_woodbury():
-    """The dense full-cov mixed path at n >= 2048 uses the blocked
-    f32 Cholesky as the IR preconditioner on accelerators
-    (fitting/gls.py; CPU pytest can never reach that gate) — the
+    """The dense full-cov mixed path (equilibrated f32 Cholesky + f64
+    IR, with a REAL correlated covariance — r4: zero-phi test data hid
+    a bf16-precision NaN in the blocked kernel, and the device-
+    computed power-law phi itself flushed to zero before the
+    evaluation-order fix in models/noise.py::powerlaw_phi) — the
     fitted answer must match the independent Woodbury factorization
     of the same model to the documented mixed-precision class."""
     from pint_tpu.fitting import GLSFitter
