@@ -54,6 +54,9 @@ class EphemerisSegmentError(EphemerisError, KeyError):
     (ephemeris/time_ephemeris.py::_posvel) catches KeyError to retry
     with NAIF ids / the builtin theory."""
 
+    # KeyError.__str__ repr-quotes the message; keep plain formatting
+    __str__ = Exception.__str__
+
 
 class UnknownObservatory(PintTpuError):
     """Observatory name not found in the registry."""

@@ -344,7 +344,27 @@ class CompiledModel:
         import functools
 
         if self.bundle.ntoa <= 200_000:
-            return jax.jit(fn)
+            # baked-constant lowering — but keyed by bundle IDENTITY,
+            # so an in-place bundle swap re-traces against the new
+            # data instead of silently serving the old dataset from
+            # jit's shape-keyed cache (the same-shape data-swap
+            # contract of docs/parallelism.md, kept by re-bake here
+            # and by argument-feeding above the threshold)
+            baked: dict = {}
+
+            @functools.wraps(fn)
+            def rebaking(*args):
+                key = (id(self.bundle), id(self.tzr_bundle))
+                if key not in baked:
+                    baked.clear()  # old bundles are dead; free them
+                    # fresh closure: jax's global trace cache keys on
+                    # function identity, so jit(fn) again would serve
+                    # the OLD bundle's baked trace
+                    baked[key] = jax.jit(lambda *a: fn(*a))
+                return baked[key](*args)
+
+            rebaking.lower = lambda *args: jax.jit(fn).lower(*args)
+            return rebaking
 
         @jax.jit
         def inner(bundles, args):
