@@ -1,7 +1,7 @@
 """Bayesian timing analysis: posterior + evidence with the native
-nested sampler, and an MCMC cross-check — the reference's bayesian.py
-workflow (its docs feed `BayesianTiming.prior_transform` to
-nestle.sample; here the same two callables drive pint_tpu.nested).
+nested sampler, cross-checked against the WLS fit — the reference's
+bayesian.py workflow (its docs feed `BayesianTiming.prior_transform`
+to nestle.sample; here the same two callables drive pint_tpu.nested).
 
 Run: python examples/bayesian_nested_evidence.py
 """

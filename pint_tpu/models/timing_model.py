@@ -344,26 +344,33 @@ class CompiledModel:
         import functools
 
         if self.bundle.ntoa <= 200_000:
-            # baked-constant lowering — but keyed by bundle IDENTITY,
-            # so an in-place bundle swap re-traces against the new
-            # data instead of silently serving the old dataset from
-            # jit's shape-keyed cache (the same-shape data-swap
-            # contract of docs/parallelism.md, kept by re-bake here
-            # and by argument-feeding above the threshold)
-            baked: dict = {}
+            # baked-constant lowering — but pinned to the bundle
+            # OBJECTS, so an in-place bundle swap re-traces against
+            # the new data instead of silently serving the old
+            # dataset from jit's shape-keyed cache (the same-shape
+            # data-swap contract of docs/parallelism.md, kept by
+            # re-bake here and by argument-feeding above the
+            # threshold).  The cache holds STRONG references and
+            # compares with `is` — bare id() keys can false-hit after
+            # GC address reuse.
+            baked: list = []  # [bundle, tzr_bundle, jitted]
+
+            def _jitted():
+                if (not baked or baked[0] is not self.bundle
+                        or baked[1] is not self.tzr_bundle):
+                    # fresh closure each re-bake: jax's trace cache
+                    # keys on function identity, so jit(fn) again
+                    # would serve the OLD bundle's baked trace
+                    baked[:] = [self.bundle, self.tzr_bundle,
+                                jax.jit(lambda *a: fn(*a))]
+                return baked[2]
 
             @functools.wraps(fn)
             def rebaking(*args):
-                key = (id(self.bundle), id(self.tzr_bundle))
-                if key not in baked:
-                    baked.clear()  # old bundles are dead; free them
-                    # fresh closure: jax's global trace cache keys on
-                    # function identity, so jit(fn) again would serve
-                    # the OLD bundle's baked trace
-                    baked[key] = jax.jit(lambda *a: fn(*a))
-                return baked[key](*args)
+                return _jitted()(*args)
 
-            rebaking.lower = lambda *args: jax.jit(fn).lower(*args)
+            # AOT hook: lower against the CURRENT bundles
+            rebaking.lower = lambda *args: _jitted().lower(*args)
             return rebaking
 
         @jax.jit

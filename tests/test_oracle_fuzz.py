@@ -225,39 +225,82 @@ def _mark_fit_flags(par_text, rng):
     return "\n".join(out) + "\n"
 
 
+#: shared simulation geometry for every fuzz composition
+_SIM_KW = dict(ntoa=45, start_mjd=54600.0, end_mjd=55400.0, obs="gbt",
+               freqs=(1400.0, 800.0, 2300.0), flags=("L-wide", "S-wide"))
+#: shared fit-parity tolerances (slightly wider than the golden sets:
+#: each round brings fresh unvetted compositions)
+_FIT_TOL = dict(value_tol_sigma=3e-3, sigma_rtol=3e-5, chi2_rtol=1e-5)
+
+
+def _compose_pulsar(rng, tmp_path, sim_seed, stem="fuzz", strip=(),
+                    mark_fit=False, extra_lines=(), wideband=False):
+    """Draw a composition, simulate it, round-trip par/tim through
+    disk, and reload — the scaffold shared by all fuzz tests.
+    Returns (par_path, tim_path, par_text, model, toas)."""
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.simulation import make_test_pulsar
+
+    par_text = None
+    while par_text is None:
+        par_text = _fix_constraints(_draw_par(rng), rng)
+    if strip:
+        par_text = "\n".join(
+            ln for ln in par_text.splitlines()
+            if not ln.startswith(tuple(strip))
+        ) + "\n"
+    if mark_fit:
+        par_text = _mark_fit_flags(par_text, rng)
+    if extra_lines:
+        par_text = (par_text.rstrip("\n") + "\n"
+                    + "\n".join(extra_lines) + "\n")
+    par = tmp_path / f"{stem}.par"
+    tim = tmp_path / f"{stem}.tim"
+    par.write_text(par_text)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            par_text, seed=sim_seed, **_SIM_KW
+        )
+        if wideband:
+            # the golden17 recipe: measurement-scale model DM + noise
+            cm = model.compile(toas)
+            dm_model = np.asarray(cm.dm_model(cm.x0()))
+            dm_sigma = 2e-4
+            dm_meas = dm_model + rng.normal(0.0, dm_sigma, len(toas))
+            for i, fl in enumerate(toas.flags):
+                fl["pp_dm"] = f"{dm_meas[i]:.10f}"
+                fl["pp_dme"] = f"{dm_sigma:.2e}"
+        write_tim_file(tim, toas)
+        model, toas = get_model_and_toas(str(par), str(tim))
+    return str(par), str(tim), par_text, model, toas
+
+
 def _fit_cases():
     return [(seed, case) for seed in FUZZ_SEEDS
             for case in range(FIT_CASES_PER_ROUND)]
+
+
+WB_CASES_PER_ROUND = 1
+
+
+def _wb_cases():
+    return [(seed, case) for seed in FUZZ_SEEDS
+            for case in range(WB_CASES_PER_ROUND)]
 
 
 @pytest.mark.parametrize("seed,case", _cases())
 def test_oracle_fuzz_composition(seed, case, tmp_path):
     from oracle.mp_pipeline import OraclePulsar
 
-    from pint_tpu.io.tim import write_tim_file
-    from pint_tpu.models.builder import get_model_and_toas
-    from pint_tpu.simulation import make_test_pulsar
-
     rng = np.random.default_rng([seed, case])
-    par_text = None
-    while par_text is None:
-        par_text = _fix_constraints(_draw_par(rng), rng)
-    par = tmp_path / "fuzz.par"
-    tim = tmp_path / "fuzz.tim"
-    par.write_text(par_text)
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        model, toas = make_test_pulsar(
-            par_text, ntoa=45, start_mjd=54600.0, end_mjd=55400.0,
-            seed=seed * 100 + case, obs="gbt",
-            freqs=(1400.0, 800.0, 2300.0),
-            flags=("L-wide", "S-wide"),
-        )
-        write_tim_file(tim, toas)
-        model, toas = get_model_and_toas(str(par), str(tim))
+    par, tim, par_text, model, toas = _compose_pulsar(
+        rng, tmp_path, sim_seed=seed * 100 + case
+    )
     cm = model.compile(toas)
     fw = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
-    o = OraclePulsar(str(par), str(tim))
+    o = OraclePulsar(par, tim)
     raw = np.array([float(o._one_residual_raw(t)) for t in o.toas])
     assert np.all(np.isfinite(fw))
     np.testing.assert_allclose(
@@ -282,28 +325,14 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
     from test_oracle_fit import _assert_fit_parity
 
     from pint_tpu.fitting import GLSFitter, WLSFitter
-    from pint_tpu.io.tim import write_tim_file
-    from pint_tpu.models.builder import get_model_and_toas
-    from pint_tpu.simulation import make_test_pulsar
 
     rng = np.random.default_rng([seed, 1000 + case])
-    par_text = None
-    while par_text is None:
-        par_text = _fix_constraints(_draw_par(rng), rng)
-    par_text = _mark_fit_flags(par_text, rng)
-    par = tmp_path / "fuzzfit.par"
-    tim = tmp_path / "fuzzfit.tim"
-    par.write_text(par_text)
+    par, tim, par_text, model, toas = _compose_pulsar(
+        rng, tmp_path, sim_seed=seed * 100 + 50 + case, stem="fuzzfit",
+        mark_fit=True,
+    )
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        model, toas = make_test_pulsar(
-            par_text, ntoa=45, start_mjd=54600.0, end_mjd=55400.0,
-            seed=seed * 100 + 50 + case, obs="gbt",
-            freqs=(1400.0, 800.0, 2300.0),
-            flags=("L-wide", "S-wide"),
-        )
-        write_tim_file(tim, toas)
-        model, toas = get_model_and_toas(str(par), str(tim))
         correlated = ("TNREDAMP" in par_text) or ("ECORR" in par_text)
         if correlated:
             f = GLSFitter(toas, model, fused=False)
@@ -311,12 +340,49 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
             f = WLSFitter(toas, model)
         chi2_fw = f.fit_toas(maxiter=4)
     free_names = list(f.cm.free_names)
-    oracle = OraclePulsar(str(par), str(tim))
+    oracle = OraclePulsar(par, tim)
     of = OracleFitter(oracle, free_names)
     v, s, c2 = of.fit(niter=2)
     values = {n: float(v[n]) for n in free_names}
     sigmas = {n: float(s[n]) for n in free_names}
-    _assert_fit_parity(
-        f, chi2_fw, values, sigmas, float(c2),
-        value_tol_sigma=3e-3, sigma_rtol=3e-5, chi2_rtol=1e-5,
+    _assert_fit_parity(f, chi2_fw, values, sigmas, float(c2), **_FIT_TOL)
+
+
+@pytest.mark.parametrize("seed,case", _wb_cases())
+def test_oracle_fuzz_wideband_fit(seed, case, tmp_path):
+    """WIDEBAND fit-level fuzz: a random composition with synthesized
+    per-TOA DM measurements (the golden17 recipe: model dm + noise ->
+    -pp_dm/-pp_dme flags), a free DMJUMP and random DMEFAC/DMEQUAD,
+    through the joint [TOA; DM] mpmath Gauss-Newton
+    (oracle.mp_fit.OracleWidebandFitter).  NE_SW is stripped (the
+    wideband oracle refuses solar wind in dm_model by design).
+    Reference parity: src/pint/fitter.py::WidebandTOAFitter."""
+    from oracle.mp_fit import OracleWidebandFitter
+    from oracle.mp_pipeline import OraclePulsar
+    from test_oracle_fit import _assert_fit_parity
+
+    from pint_tpu.fitting.wideband import WidebandTOAFitter
+
+    rng = np.random.default_rng([seed, 2000 + case])
+    extra = [f"DMJUMP -f L-wide {rng.normal(0, 2e-3):.4e} 1"]
+    if rng.random() < 0.5:
+        extra.append(f"DMEFAC -f S-wide {rng.uniform(0.8, 1.4):.3f}")
+    if rng.random() < 0.5:
+        extra.append(f"DMEQUAD -f L-wide {rng.uniform(1e-5, 2e-4):.3e}")
+    par, tim, par_text, model, toas = _compose_pulsar(
+        rng, tmp_path, sim_seed=seed * 100 + 70 + case, stem="fuzzwb",
+        strip=("NE_SW",), mark_fit=True, extra_lines=extra,
+        wideband=True,
     )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f = WidebandTOAFitter(toas, model)
+        chi2_fw = f.fit_toas(maxiter=4)
+    free_names = list(f.cm.free_names)
+    assert any(n.startswith("DMJUMP") for n in free_names)
+    oracle = OraclePulsar(par, tim)
+    of = OracleWidebandFitter(oracle, free_names)
+    v, s, c2 = of.fit(niter=2)
+    values = {n: float(v[n]) for n in free_names}
+    sigmas = {n: float(s[n]) for n in free_names}
+    _assert_fit_parity(f, chi2_fw, values, sigmas, float(c2), **_FIT_TOL)
