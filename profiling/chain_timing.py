@@ -1,0 +1,48 @@
+"""Shared chained-scan timer for the profiling scripts.
+
+Times fn as ONE device program of `chain` dependent steps (lax.scan),
+amortizing the ~85 ms axon dispatch round-trip to <1% — the same
+discipline as bench.py/_timeit.
+"""
+
+import time
+
+import numpy as np
+
+
+def chain_time(fn, x0, chain=192, nrep=3, jit_wrap=None,
+               reduce_output=False):
+    """Median seconds per step of fn chained `chain` deep.
+
+    jit_wrap: pass cm.jit so the TOA bundle rides as a runtime
+    argument — at 1e6 TOAs baked bundle literals are a ~240 MB module
+    that breaks the remote-compile transport (r4).
+    reduce_output=True feeds an f32 full reduction of the output back
+    into the carry (forces the WHOLE output to be computed);
+    the default feeds one element (enough when the output is a dense
+    per-TOA array whose lanes cannot be dead-code-eliminated
+    independently, and avoids the ~3 ms/step emulated-f64 reduction).
+    """
+    import jax
+
+    def _run(x):
+        def body(c, _):
+            out = fn(c)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            if reduce_output:
+                dep = jax.numpy.sum(leaf.astype(jax.numpy.float32))
+                return c + 0.0 * dep.astype(c.dtype), None
+            return (
+                c + 0.0 * leaf.ravel()[0].astype(c.dtype), None
+            )
+
+        return jax.lax.scan(body, x, None, length=chain)[0]
+
+    run = (jit_wrap or jax.jit)(_run)
+    run(x0).block_until_ready()
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        run(x0).block_until_ready()
+        ts.append((time.perf_counter() - t0) / chain)
+    return float(np.median(ts))

@@ -5,33 +5,12 @@ Usage: python profiling/profile_solve_parts.py [ntoa]
 """
 
 import sys
-import time
+from pathlib import Path
 
 import numpy as np
 
-
-def _chain_time(fn, x0, chain=192, nrep=3):
-    import jax
-
-    @jax.jit
-    def run(x):
-        def body(c, _):
-            out = fn(c)
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            # f32 full reduction: forces the whole output without the
-            # ~3 ms/step cost of an emulated-f64 reduction
-            dep = jax.numpy.sum(leaf.astype(jax.numpy.float32))
-            return c + 0.0 * dep.astype(c.dtype), None
-
-        return jax.lax.scan(body, x, None, length=chain)[0]
-
-    run(x0).block_until_ready()
-    ts = []
-    for _ in range(nrep):
-        t0 = time.perf_counter()
-        run(x0).block_until_ready()
-        ts.append((time.perf_counter() - t0) / chain)
-    return float(np.median(ts))
+sys.path.insert(0, str(Path(__file__).parent))
+from chain_timing import chain_time  # noqa: E402
 
 
 def main():
@@ -90,7 +69,7 @@ def main():
     }
     print(f"backend={jax.default_backend()} ntoa={ntoa} p={p} k={k}")
     for name, fn in parts.items():
-        t = _chain_time(fn, cm.x0())
+        t = chain_time(fn, cm.x0(), reduce_output=True)
         print(f"{name:<22}: {t*1e3:8.3f} ms")
 
 

@@ -6,34 +6,12 @@ Usage: python profiling/profile_step_parts.py [ntoa]
 """
 
 import sys
-import time
+from pathlib import Path
 
 import numpy as np
 
-
-def _chain_time(fn, x0, chain=192, nrep=3):
-    import jax
-
-    @jax.jit
-    def run(x):
-        def body(c, _):
-            out = fn(c)
-            # feed ONE element of the output back so steps are
-            # dependent (a full f64-emulated reduction here would cost
-            # ~3 ms/step on TPU and swamp the part being measured)
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            return c + 0.0 * leaf.ravel()[0].astype(c.dtype), None
-
-        return jax.lax.scan(body, x, None, length=chain)[0]
-
-    out = run(x0)
-    out.block_until_ready()
-    ts = []
-    for _ in range(nrep):
-        t0 = time.perf_counter()
-        run(x0).block_until_ready()
-        ts.append((time.perf_counter() - t0) / chain)
-    return float(np.median(ts))
+sys.path.insert(0, str(Path(__file__).parent))
+from chain_timing import chain_time  # noqa: E402
 
 
 def main():
@@ -81,13 +59,24 @@ def main():
     T0, PHI = cm.noise_basis_or_empty(x0)
 
     print(f"backend={jax.default_backend()} ntoa={ntoa}")
-    t_full = _chain_time(full, x0)
+    t_full = chain_time(full, x0, jit_wrap=cm.jit)
     print(f"full step          : {t_full*1e3:8.3f} ms")
+    t_parts = 0.0
     for name, fn in parts.items():
-        t = _chain_time(fn, x0)
+        t = chain_time(fn, x0, jit_wrap=cm.jit)
+        t_parts += t
         print(f"{name:<19}: {t*1e3:8.3f} ms  ({100*t/t_full:5.1f}%)")
-    t = _chain_time(solve_only, x0)
-    print(f"{'woodbury solve':<19}: {t*1e3:8.3f} ms  ({100*t/t_full:5.1f}%)")
+    if ntoa <= 200_000:
+        t = chain_time(solve_only, x0, jit_wrap=cm.jit)
+        print(f"{'woodbury solve':<19}: {t*1e3:8.3f} ms  "
+              f"({100*t/t_full:5.1f}%)")
+    else:
+        # solve_only bakes its PRECOMPUTED operands (R, M0, T0) as
+        # literals — at 1e6 TOAs that is a transport-breaking module;
+        # report the solve share as full minus the measured parts
+        t = t_full - t_parts
+        print(f"{'woodbury solve':<19}: {t*1e3:8.3f} ms  "
+              f"({100*t/t_full:5.1f}%)  [full minus parts]")
 
 
 if __name__ == "__main__":
