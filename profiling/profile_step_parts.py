@@ -74,9 +74,12 @@ def main():
         # solve_only bakes its PRECOMPUTED operands (R, M0, T0) as
         # literals — at 1e6 TOAs that is a transport-breaking module;
         # report the solve share as full minus the measured parts
-        t = t_full - t_parts
+        t = max(t_full - t_parts, 0.0)
+        note = "[full minus parts]"
+        if t_full < t_parts:
+            note += "  (parts sum exceeds full-step median; clamped)"
         print(f"{'woodbury solve':<19}: {t*1e3:8.3f} ms  "
-              f"({100*t/t_full:5.1f}%)  [full minus parts]")
+              f"({100*t/t_full:5.1f}%)  {note}")
 
 
 if __name__ == "__main__":

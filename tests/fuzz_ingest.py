@@ -1,0 +1,296 @@
+"""Randomized full-ingest-chain environments for the oracle fuzzer.
+
+VERDICT r4 item 1 (missing 2): the compositional fuzzer ran only the
+simplified ingest — every random composition saw the same warned-about
+clock-less, EOP-less environment, so the clock/EOP/SPK/observatory/
+satellite interaction surface was covered by exactly four hand-built
+golden sets.  This module makes the ENVIRONMENT part of the draw:
+
+- a random observatory subset (2-4 topocentric sites from the built-in
+  registry pool) with a fresh tempo2-format clock file per site —
+  random offset, seasonal amplitude/period/phase, linear drift,
+  sampling cadence, and (half the time) a contiguous GAP the
+  interpolation must cross;
+- a random GPS->UTC steering file and a random TT(BIPMxxxx)
+  realization (or TT(TAI), in which case no BIPM file exists and the
+  par says so — silent degradation is a test failure, not a warning);
+- a random nonzero IERS finals2000A table (Chandler-scale polar
+  motion, annual UT1-UTC wobble, the real 2009-01-01 leap jump when
+  the span covers it);
+- a random ephemeris route: the analytic builtin theory, or a freshly
+  WRITTEN type-2 SPK kernel (random record length + Chebyshev degree)
+  that both the framework DAF reader and the oracle's independent
+  mpmath reader must then evaluate identically;
+- occasionally a satellite observatory whose random circular orbit
+  table is written through io.fits and re-read + re-splined by both
+  sides.
+
+Everything lands in a per-test tmp dir; ``fuzz_ingest_env`` points the
+$PINT_TPU_* search paths there and resets the observatory/EOP/
+ephemeris caches, exactly like tests/ingest_env.py does for the golden
+sets.  Chain warnings are escalated to errors inside the load, so a
+composition that silently falls back to the no-clock/no-EOP path
+FAILS instead of quietly testing less (the blanket filters the r4
+VERDICT objected to are gone).
+
+Reference parity: toa.py::TOAs.apply_clock_corrections/compute_TDBs/
+compute_posvels breadth, observatory/global_clock_corrections.py,
+solar_system_ephemerides.py over .bsp kernels, satellite_obs.py.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from contextlib import contextmanager
+from pathlib import Path
+
+import numpy as np
+
+DATADIR = Path(__file__).parent / "datafile"
+
+#: sites the environment draw may pick (all in the built-in registry;
+#: kept to well-separated telescopes so multi-site geometry actually
+#: varies)
+SITE_POOL = (
+    "gbt", "effelsberg", "jodrell", "parkes", "arecibo", "nancay",
+    "wsrt", "meerkat", "hartrao", "chime",
+)
+
+#: the silent-fallback warnings that must FAIL a full-ingest fuzz case
+CHAIN_WARNINGS = (
+    "no site clock file",
+    "no Earth-orientation table",
+    ".*ephemeris kernel.*not found.*",
+    "clock file .* outside",
+    "requested BIPM realization",
+)
+
+
+def _write_clk(path, header, mjds, corr_s):
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for m, c in zip(mjds, corr_s):
+            f.write(f"{m:.6f} {c:.12e}\n")
+
+
+def _random_clock_series(rng, t, us_offset, us_amp, ns_drift):
+    offset = rng.uniform(-us_offset, us_offset) * 1e-6
+    amp = rng.uniform(0.1 * us_amp, us_amp) * 1e-6
+    period = rng.uniform(90.0, 420.0)
+    phase = rng.uniform(0.0, 2 * np.pi)
+    drift = rng.uniform(-ns_drift, ns_drift) * 1e-9
+    return (
+        offset
+        + amp * np.sin(2 * np.pi * (t - t[0]) / period + phase)
+        + drift * (t - t[0])
+    )
+
+
+def draw_ingest_env(rng, dest: Path, start_mjd: float, end_mjd: float):
+    """Write a random ingest environment into ``dest``; return a dict:
+    ``env`` ($PINT_TPU_* values), ``sites`` (drawn site codes),
+    ``par_lines`` (EPHEM/CLOCK cards the composition must carry),
+    ``sat`` (None or (code, mjd_lo, mjd_hi) for the satellite window).
+    """
+    dest = Path(dest)
+    dest.mkdir(exist_ok=True)
+    lo, hi = start_mjd - 60.0, end_mjd + 60.0
+
+    # -- site clock chains ------------------------------------------------
+    n_sites = int(rng.integers(2, 5))
+    sites = list(rng.choice(SITE_POOL, size=n_sites, replace=False))
+    for site in sites:
+        cadence = rng.uniform(10.0, 40.0)
+        t = np.arange(lo, hi + 1e-9, cadence)
+        corr = _random_clock_series(
+            rng, t, us_offset=3.0, us_amp=1.5, ns_drift=1.5
+        )
+        if rng.random() < 0.5 and len(t) > 20:
+            # a contiguous gap both interpolators must bridge the
+            # same way (linear across the hole)
+            g0 = int(rng.integers(5, len(t) - 10))
+            g1 = g0 + int(rng.integers(2, 6))
+            keep = np.ones(len(t), bool)
+            keep[g0:g1] = False
+            t, corr = t[keep], corr[keep]
+        _write_clk(
+            dest / f"{site}2gps.clk", f"# UTC({site}) UTC(gps)", t, corr
+        )
+    t30 = np.arange(lo, hi + 1e-9, rng.uniform(20.0, 45.0))
+    _write_clk(
+        dest / "gps2utc.clk", "# UTC(gps) UTC",
+        t30, _random_clock_series(rng, t30, 0.01, 0.004, 0.02),
+    )
+
+    # -- TT realization ---------------------------------------------------
+    par_lines = []
+    if rng.random() < 0.8:
+        version = f"BIPM20{rng.integers(18, 24):02d}"
+        _write_clk(
+            dest / f"tai2tt_{version.lower()}.clk",
+            f"# TT(TAI) TT({version})",
+            t30,
+            27.6e-6
+            + rng.uniform(0.5, 2.0) * 1e-9 * (t30 - t30[0])
+            + _random_clock_series(rng, t30, 0.02, 0.01, 0.0),
+        )
+        par_lines.append(f"CLOCK TT({version})")
+    else:
+        par_lines.append("CLOCK TT(TAI)")
+
+    # -- Earth orientation ------------------------------------------------
+    leap = 54832.0
+    xp_a = rng.uniform(0.05, 0.25)
+    yp_a = rng.uniform(0.05, 0.25)
+    dut_a = rng.uniform(0.005, 0.04)
+    dut_slope = rng.uniform(-8e-4, -4e-4)
+    ph = rng.uniform(0, 2 * np.pi, size=3)
+    lines = []
+    for mjd in np.arange(lo, hi + 0.5, 1.0):
+        xp = 0.08 + xp_a * np.sin(2 * np.pi * (mjd - lo) / 433.0 + ph[0])
+        yp = 0.30 + yp_a * np.cos(2 * np.pi * (mjd - lo) / 433.0 + ph[1])
+        base = (
+            dut_slope * (mjd - leap)
+            + dut_a * np.sin(2 * np.pi * (mjd - lo) / 365.25 + ph[2])
+        )
+        dut1 = base + (0.4 if mjd >= leap else -0.6)
+        lines.append(
+            f"{'':7s}{mjd:8.2f}{'':3s}{xp:9.6f}{'':10s}{yp:9.6f}"
+            f"{'':12s}{dut1:10.7f}"
+        )
+    (dest / "finals_fuzz.all").write_text("\n".join(lines) + "\n")
+
+    # -- ephemeris route --------------------------------------------------
+    if rng.random() < 0.65:
+        _write_fuzz_spk(rng, dest / "fuzzspk.bsp", lo, hi)
+        par_lines.append("EPHEM fuzzspk")
+    # else: no EPHEM card -> analytic builtin theory on both sides
+
+    # -- optional satellite observatory -----------------------------------
+    sat = None
+    if rng.random() < 0.3:
+        sat = _write_fuzz_orbit(rng, dest, start_mjd, end_mjd)
+
+    env = {
+        "PINT_TPU_CLOCK_DIR": str(dest),
+        "PINT_TPU_EOP": str(dest / "finals_fuzz.all"),
+        "PINT_TPU_EPHEM_DIR": str(dest),
+        "PINT_TPU_ORBIT_DIR": str(dest),
+    }
+    return {"env": env, "sites": sites, "par_lines": par_lines,
+            "sat": sat}
+
+
+def _write_fuzz_spk(rng, path, mjd_lo, mjd_hi):
+    """A freshly fit type-2 SPK at random granularity.  Parity does not
+    depend on fit quality (both sides evaluate the SAME records), but
+    simulation re-uses the kernel, so keep the fit sane."""
+    from pint_tpu.ephemeris.builtin import BuiltinEphemeris
+    from pint_tpu.ephemeris.spk import (
+        S_PER_DAY, chebyshev_fit_records, write_spk_type2,
+    )
+
+    eph = BuiltinEphemeris()
+    days_per_record = rng.uniform(4.0, 12.0)
+    degree = int(rng.integers(10, 15))
+    et0 = (mjd_lo - 51544.5) * S_PER_DAY
+    et1 = (mjd_hi - 51544.5) * S_PER_DAY
+    n_rec = max(int(round((mjd_hi - mjd_lo) / days_per_record)), 2)
+    intlen = (et1 - et0) / n_rec
+    segments = []
+    # earth/sun/moon plus the PLANET_SHAPIRO barycenters — unlike the
+    # committed mini kernel, fuzz kernels carry planets so random
+    # compositions can put planetary Shapiro THROUGH the SPK route
+    bodies = (
+        (399, "earth"), (10, "sun"), (301, "moon"), (2, "venus"),
+        (5, "jupiter"), (6, "saturn"), (7, "uranus"), (8, "neptune"),
+    )
+    for target, body in bodies:
+        coeffs = chebyshev_fit_records(
+            lambda ts, b=body: eph.ssb_pos(b, ts),
+            et0, et1, n_rec, degree,
+        )
+        segments.append({
+            "target": target, "center": 0, "frame": 1,
+            "init": et0, "intlen": intlen, "coeffs": coeffs,
+        })
+    write_spk_type2(path, segments, ifname="pint_tpu fuzz kernel")
+
+
+def _write_fuzz_orbit(rng, dest, start_mjd, end_mjd):
+    """A random inclined circular orbit table ('fuzzsat') somewhere
+    inside the observing span; returns (code, mjd_lo, mjd_hi) of the
+    usable TOA window."""
+    from pint_tpu.io.fits import write_event_fits
+
+    mjdref = float(int(rng.uniform(start_mjd + 5.0, end_mjd - 8.0)))
+    met = np.arange(0.0, 3.0 * 86400.0 + 1e-9, rng.uniform(45.0, 90.0))
+    r_orb = rng.uniform(6.6e6, 7.3e6)
+    # Kepler circular period for the drawn radius (GM_earth)
+    period = 2 * np.pi * np.sqrt(r_orb**3 / 3.986004418e14)
+    incl = np.deg2rad(rng.uniform(15.0, 85.0))
+    raan = np.deg2rad(rng.uniform(0.0, 360.0))
+    w = 2 * np.pi / period
+    x0 = r_orb * np.cos(w * met)
+    y0 = r_orb * np.sin(w * met)
+    y1 = y0 * np.cos(incl)
+    z1 = y0 * np.sin(incl)
+    x = x0 * np.cos(raan) - y1 * np.sin(raan)
+    y = x0 * np.sin(raan) + y1 * np.cos(raan)
+    write_event_fits(
+        dest / "fuzzsat.fits",
+        {"TIME": met, "X": x, "Y": y, "Z": z1},
+        header_extra={"MJDREFI": int(mjdref), "MJDREFF": 0.0,
+                      "TIMEZERO": 0.0, "TIMESYS": "TT"},
+        extname="ORBIT",
+    )
+    return ("fuzzsat", mjdref + 0.05, mjdref + 2.9)
+
+
+@contextmanager
+def fuzz_ingest_env(env: dict):
+    """Point the $PINT_TPU_* search paths at a drawn environment and
+    reset every cache that memoizes them (the golden_ingest_env
+    pattern, parameterized)."""
+    from pint_tpu.earth.eop import reset_eop
+    from pint_tpu.ephemeris import reset_ephemeris_cache
+    from pint_tpu.observatory import reset_registry
+
+    def _reset_all():
+        reset_registry()
+        reset_eop()
+        reset_ephemeris_cache()
+
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    _reset_all()
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _reset_all()
+
+
+def chain_errors_into():
+    """Escalate exactly the silent-fallback chain warnings to errors
+    INSIDE an already-active ``warnings.catch_warnings`` block (filters
+    are LIFO, so these override an earlier ``simplefilter('ignore')``).
+
+    Must wrap the SIMULATION load as well as the reload: the EOP and
+    ephemeris fallbacks warn once and memoize (earth/eop.py,
+    ephemeris/__init__.py), so only the first load in the env context
+    would ever re-emit them."""
+    for msg in CHAIN_WARNINGS:
+        warnings.filterwarnings("error", message=msg)
+
+
+def env_parts(dest: Path) -> list[bytes]:
+    """Cache-key material: every file of the drawn environment."""
+    from oracle.cache import dir_parts
+
+    return dir_parts(dest)

@@ -11,7 +11,6 @@ the clock-less legacy sets keep their (warned) defaults.
 
 from __future__ import annotations
 
-import os
 from contextlib import contextmanager
 from pathlib import Path
 
@@ -33,24 +32,9 @@ _ENV = {
 
 @contextmanager
 def golden_ingest_env():
-    from pint_tpu.earth.eop import reset_eop
-    from pint_tpu.ephemeris import reset_ephemeris_cache
-    from pint_tpu.observatory import reset_registry
+    # the set-env/reset-caches/restore dance lives in ONE place
+    # (fuzz_ingest.fuzz_ingest_env); this is the golden instantiation
+    from fuzz_ingest import fuzz_ingest_env
 
-    def _reset_all():
-        reset_registry()
-        reset_eop()
-        reset_ephemeris_cache()
-
-    old = {k: os.environ.get(k) for k in _ENV}
-    os.environ.update(_ENV)
-    _reset_all()
-    try:
+    with fuzz_ingest_env(_ENV):
         yield
-    finally:
-        for k, v in old.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-        _reset_all()

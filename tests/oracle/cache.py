@@ -12,8 +12,9 @@ recomputes in-place (slow path) and rewrites the committed cache file.
 Cache files live in tests/datafile/oracle_cache/*.npz and are
 committed, so a fresh checkout runs the whole battery fast.  Force a
 global recompute with PINT_TPU_ORACLE_RECOMPUTE=1 (CI mode for oracle
--code changes; also exercised by
-tests/test_oracle_fuzz.py which never caches).
+-code changes).  tests/test_oracle_fuzz.py rides the same cache for
+its deterministic prior-round seeds while its current-round seed
+always recomputes live.
 
 The assertion side of every test is untouched — the cached arrays are
 bit-identical to a fresh mpmath run (np.float64 round-trips exactly
@@ -54,15 +55,24 @@ _SOURCES = (
 )
 
 
+def dir_parts(path) -> list[bytes]:
+    """(name, bytes) key material for every file in a directory —
+    shared by the golden ingest env below and the fuzz-drawn envs
+    (tests/fuzz_ingest.py::env_parts)."""
+    parts = []
+    path = Path(path)
+    if path.is_dir():
+        for p in sorted(path.iterdir()):
+            if p.is_file():
+                parts.append(p.name.encode())
+                parts.append(p.read_bytes())
+    return parts
+
+
 def ingest_env_parts() -> list[bytes]:
     """Key material for the golden13-16 ingest environment: every
     committed clock/EOP file plus the SPK kernels the oracle can load."""
-    parts = []
-    ingest_dir = DATADIR / "ingest"
-    if ingest_dir.is_dir():
-        for p in sorted(ingest_dir.iterdir()):
-            parts.append(p.name.encode())
-            parts.append(p.read_bytes())
+    parts = dir_parts(DATADIR / "ingest")
     for p in sorted(DATADIR.glob("*.bsp")):
         parts.append(p.name.encode())
         parts.append(p.read_bytes())

@@ -1467,10 +1467,15 @@ class OraclePulsar:
             ("Y", "YES", "T", "TRUE", "1")
         )
         if planet_shapiro and not self.bary:
-            if self.spk is not None:
+            planet_ids = {"venus": 2, "jupiter": 5, "saturn": 6,
+                          "uranus": 7, "neptune": 8}
+            spk_has_planets = self.spk is not None and all(
+                (t, 0) in self.spk.segs for t in planet_ids.values()
+            )
+            if self.spk is not None and not spk_has_planets:
                 raise NotImplementedError(
-                    "oracle PLANET_SHAPIRO over an SPK kernel: the "
-                    "mini kernel carries no planets"
+                    "oracle PLANET_SHAPIRO over an SPK kernel without "
+                    "planet-barycenter segments (the mini kernel)"
                 )
             T2 = tt_centuries(day_tdb, sec_tdb)
             for body, gm in (
@@ -1478,8 +1483,18 @@ class OraclePulsar:
                 ("saturn", GM_SATURN), ("uranus", GM_URANUS),
                 ("neptune", GM_NEPTUNE),
             ):
-                p_ecl = sun_ssb_ecl_au(T2) + kepler_xyz_au(body, T2)
-                p_m = ecl_to_eq_j2000(p_ecl) * mpf(AU_KM) * 1000
+                if spk_has_planets:
+                    # independent Chebyshev evaluation of the SAME
+                    # kernel the framework reads (fuzz kernels carry
+                    # barycenter segments 2/5/6/7/8)
+                    et = (day_tdb - mpf("51544.5")) * SPD + sec_tdb
+                    p_km, _ = self.spk.posvel_km(
+                        planet_ids[body], et
+                    )
+                    p_m = p_km * 1000
+                else:
+                    p_ecl = sun_ssb_ecl_au(T2) + kepler_xyz_au(body, T2)
+                    p_m = ecl_to_eq_j2000(p_ecl) * mpf(AU_KM) * 1000
                 delay += shapiro((p_m - ssb_obs_m) / mpf(C), gm)
 
         # -- solar wind (spherical NE_SW model) -------------------------

@@ -3,15 +3,36 @@
 The 23 golden sets are hand-composed; this battery draws RANDOM
 component subsets (astrometry flavor x binary model x dispersion/
 chromatic set x noise x jumps/glitch/wave/piecewise) with random
-in-range parameters, synthesizes a par/tim pair, and runs the full
-mpmath residual oracle at every TOA — hunting the cross-component
-interaction bugs a fixed matrix cannot enumerate.  Never cached: each
-composition recomputes from scratch.
+in-range parameters, AND (r5) a random full-ingest environment —
+clock chains with gaps, nonzero EOP, freshly written SPK kernels,
+multi-site + satellite observatories (tests/fuzz_ingest.py) —
+synthesizes a par/tim pair, and runs the full mpmath residual oracle
+at every TOA — hunting the cross-component and chain-interaction bugs
+a fixed matrix cannot enumerate.
 
-Seeds: FUZZ_SEEDS accumulates one entry per build round, so every past
-round's compositions stay in the suite as regressions while each new
-round adds five fresh ones.  A failure reproduces exactly from
-(seed, case) — copy the printed par into a golden set when triaging.
+Seeds: FUZZ_SEEDS accumulates one entry per build round; each new
+round adds fresh compositions while past seeds stay in the suite.  A
+failure reproduces exactly from (seed, case) — copy the printed par
+into a golden set when triaging.  Honesty note on "regression": the
+prior seeds' PARAMETER draws are kept byte-identical (the env is drawn
+from an independent rng stream), but the r5 scaffold upgrade itself
+changed what those seeds exercise — every composition now carries a
+drawn ingest environment, so the exact clock-less par/tim artifacts
+r1-r4 ran are superseded (the clock-less simplified-ingest path keeps
+its own dedicated coverage in test_independent_oracle.py).
+
+Caching (r5, VERDICT r4 weak 6): PAST-round seeds are deterministic —
+identical par/tim/env bytes every run — so their oracle outputs go
+through the committed content-hash cache (oracle.cache) exactly like
+the golden battery; any change to the draw code, the oracle sources,
+or a shared coefficient table changes the key and forces a fresh
+mpmath run.  Only the CURRENT round's seed (the last FUZZ_SEEDS entry)
+always recomputes live, so each round lands with its new compositions
+verified by a fresh mpmath pass.  ``PINT_TPU_ORACLE_RECOMPUTE=1``
+forces everything live; on multi-core hosts the live per-TOA loop
+additionally fans out over processes (oracle.pmap — this box is
+1-core, where it stays serial and the cache is what bounds
+wall-clock).
 """
 
 import sys
@@ -23,13 +44,19 @@ import pytest
 
 sys.path.insert(0, str(Path(__file__).parent))
 
-pytestmark = pytest.mark.filterwarnings(
-    "ignore:no site clock file", "ignore:no Earth-orientation table"
+from fuzz_ingest import (  # noqa: E402
+    chain_errors_into, draw_ingest_env, env_parts, fuzz_ingest_env,
 )
+
+# NOTE r5: the module-level blanket filters for "no site clock file" /
+# "no Earth-orientation table" are GONE (VERDICT r4 item 1): every
+# composition now draws a randomized full ingest environment
+# (fuzz_ingest.draw_ingest_env) and the chain warnings are escalated
+# to ERRORS inside the load, so a silent fallback fails the test.
 
 #: one seed per build round (append, never edit — regression history;
 #: r4 ran two sessions and contributed two)
-FUZZ_SEEDS = [2604, 3107]
+FUZZ_SEEDS = [2604, 3107, 4181]
 
 CASES_PER_ROUND = 5
 
@@ -196,6 +223,19 @@ def _cases():
     return out
 
 
+def _maybe_cached(seed, name, par, tim, env_dir, extra, compute):
+    """Prior-round seeds ride the committed oracle cache; the current
+    round's seed always recomputes live (key material is only built on
+    the cached branch)."""
+    from oracle.cache import cached_oracle
+
+    if seed == FUZZ_SEEDS[-1]:
+        return compute()
+    parts = [Path(par).read_bytes(), Path(tim).read_bytes(),
+             *env_parts(env_dir), *extra]
+    return cached_oracle(name, parts, compute)
+
+
 FIT_CASES_PER_ROUND = 2
 
 
@@ -205,6 +245,9 @@ def _mark_fit_flags(par_text, rng):
     (ELONG/ELAT have no central-difference step — mp_fit._STEPS)."""
     out = []
     for ln in par_text.splitlines():
+        if not ln.split():
+            out.append(ln)
+            continue
         key = ln.split()[0]
         if key in ("ELONG", "ELAT") and ln.rstrip().endswith(" 1"):
             ln = ln.rstrip()[:-2].rstrip()
@@ -234,10 +277,39 @@ _SIM_KW = dict(ntoa=45, start_mjd=54600.0, end_mjd=55400.0, obs="gbt",
 _FIT_TOL = dict(value_tol_sigma=3e-3, sigma_rtol=3e-5, chi2_rtol=1e-5)
 
 
+def _draw_env(rng, tmp_path):
+    """Draw the randomized full-ingest environment for a composition,
+    plus the environment-dependent par cards (TZR anchor, planetary
+    Shapiro, troposphere) that need the drawn sites."""
+    ing = draw_ingest_env(
+        rng, tmp_path / "env", _SIM_KW["start_mjd"], _SIM_KW["end_mjd"]
+    )
+    extra = list(ing["par_lines"])
+    if rng.random() < 0.4:
+        extra.append("PLANET_SHAPIRO Y")
+    if ing["sat"] is None and rng.random() < 0.35:
+        extra.append("CORRECT_TROPOSPHERE Y")
+    if rng.random() < 0.3:
+        site = ing["sites"][int(rng.integers(len(ing["sites"])))]
+        extra.append(
+            f"TZRMJD {rng.uniform(_SIM_KW['start_mjd'] + 30, _SIM_KW['end_mjd'] - 30):.8f}"
+        )
+        extra.append(f"TZRSITE {site}")
+        extra.append("TZRFRQ 1400.0")
+    ing["par_lines"] = extra
+    return ing
+
+
 def _compose_pulsar(rng, tmp_path, sim_seed, stem="fuzz", strip=(),
-                    mark_fit=False, extra_lines=(), wideband=False):
+                    mark_fit=False, extra_lines=(), wideband=False,
+                    ingest=None):
     """Draw a composition, simulate it, round-trip par/tim through
-    disk, and reload — the scaffold shared by all fuzz tests.
+    disk, and reload — the scaffold shared by all fuzz tests.  With
+    ``ingest`` (a fuzz_ingest.draw_ingest_env dict) the simulation and
+    the reload both run inside the drawn clock/EOP/SPK/observatory
+    environment, TOAs cycle over the drawn sites (plus the satellite
+    window when one was drawn), and the chain silent-fallback warnings
+    are escalated to errors during the reload.
     Returns (par_path, tim_path, par_text, model, toas)."""
     from pint_tpu.io.tim import write_tim_file
     from pint_tpu.models.builder import get_model_and_toas
@@ -246,6 +318,34 @@ def _compose_pulsar(rng, tmp_path, sim_seed, stem="fuzz", strip=(),
     par_text = None
     while par_text is None:
         par_text = _fix_constraints(_draw_par(rng), rng)
+    sim_kw = dict(_SIM_KW)
+    env_ctx = None
+    if ingest is not None:
+        extra_lines = list(extra_lines) + [
+            ln for ln in ingest["par_lines"]
+            # the oracle's troposphere supports equatorial astrometry
+            # only (mp_pipeline.py raises on ELONG/ELAT sources)
+            if not (ln.startswith("CORRECT_TROPOSPHERE")
+                    and "RAJ " not in par_text)
+        ]
+        if ingest["sat"] is not None:
+            # solar wind through a satellite line of sight is outside
+            # the oracle's supported surface — drop it for sat draws
+            strip = tuple(strip) + ("NE_SW",)
+            code, s_lo, s_hi = ingest["sat"]
+            n_sat = 6
+            n_grid = sim_kw["ntoa"] - n_sat
+            mjds = np.concatenate([
+                np.linspace(sim_kw["start_mjd"], sim_kw["end_mjd"],
+                            n_grid),
+                np.linspace(s_lo, s_hi, n_sat),
+            ])
+            obs = [ingest["sites"][i % len(ingest["sites"])]
+                   for i in range(n_grid)] + [code] * n_sat
+            sim_kw.update(mjds=mjds, obs=obs)
+        else:
+            sim_kw.update(obs=tuple(ingest["sites"]))
+        env_ctx = fuzz_ingest_env(ingest["env"])
     if strip:
         par_text = "\n".join(
             ln for ln in par_text.splitlines()
@@ -259,22 +359,37 @@ def _compose_pulsar(rng, tmp_path, sim_seed, stem="fuzz", strip=(),
     par = tmp_path / f"{stem}.par"
     tim = tmp_path / f"{stem}.tim"
     par.write_text(par_text)
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        model, toas = make_test_pulsar(
-            par_text, seed=sim_seed, **_SIM_KW
-        )
-        if wideband:
-            # the golden17 recipe: measurement-scale model DM + noise
-            cm = model.compile(toas)
-            dm_model = np.asarray(cm.dm_model(cm.x0()))
-            dm_sigma = 2e-4
-            dm_meas = dm_model + rng.normal(0.0, dm_sigma, len(toas))
-            for i, fl in enumerate(toas.flags):
-                fl["pp_dm"] = f"{dm_meas[i]:.10f}"
-                fl["pp_dme"] = f"{dm_sigma:.2e}"
-        write_tim_file(tim, toas)
-        model, toas = get_model_and_toas(str(par), str(tim))
+    if env_ctx is not None:
+        env_ctx.__enter__()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            if ingest is not None:
+                # the EOP/ephemeris fallbacks warn ONCE per env (then
+                # memoize): the escalation must cover this first load,
+                # not just the reload below
+                chain_errors_into()
+            model, toas = make_test_pulsar(
+                par_text, seed=sim_seed, **sim_kw
+            )
+            if wideband:
+                # golden17 recipe: measurement-scale model DM + noise
+                cm = model.compile(toas)
+                dm_model = np.asarray(cm.dm_model(cm.x0()))
+                dm_sigma = 2e-4
+                dm_meas = dm_model + rng.normal(0.0, dm_sigma, len(toas))
+                for i, fl in enumerate(toas.flags):
+                    fl["pp_dm"] = f"{dm_meas[i]:.10f}"
+                    fl["pp_dme"] = f"{dm_sigma:.2e}"
+            write_tim_file(tim, toas)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            if ingest is not None:
+                chain_errors_into()
+            model, toas = get_model_and_toas(str(par), str(tim))
+    finally:
+        if env_ctx is not None:
+            env_ctx.__exit__(None, None, None)
     return str(par), str(tim), par_text, model, toas
 
 
@@ -293,16 +408,26 @@ def _wb_cases():
 
 @pytest.mark.parametrize("seed,case", _cases())
 def test_oracle_fuzz_composition(seed, case, tmp_path):
-    from oracle.mp_pipeline import OraclePulsar
-
     rng = np.random.default_rng([seed, case])
+    # independent stream for the env draw: the composition stream must
+    # stay byte-identical to the rounds that froze these seeds
+    ing = _draw_env(np.random.default_rng([seed, 5000 + case]), tmp_path)
     par, tim, par_text, model, toas = _compose_pulsar(
-        rng, tmp_path, sim_seed=seed * 100 + case
+        rng, tmp_path, sim_seed=seed * 100 + case, ingest=ing
     )
     cm = model.compile(toas)
     fw = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
-    o = OraclePulsar(par, tim)
-    raw = np.array([float(o._one_residual_raw(t)) for t in o.toas])
+
+    def compute():
+        from oracle.pmap import oracle_raw_residuals
+
+        with fuzz_ingest_env(ing["env"]):
+            return {"raw": oracle_raw_residuals(par, tim)}
+
+    raw = _maybe_cached(
+        seed, f"fuzz_res_{seed}_{case}", par, tim, tmp_path / "env",
+        [], compute,
+    )["raw"]
     assert np.all(np.isfinite(fw))
     np.testing.assert_allclose(
         fw, raw, rtol=0, atol=1e-9,
@@ -319,7 +444,8 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
     differences of the oracle's own residuals, on compositions nobody
     hand-picked.  Compositions that draw correlated noise (PL red /
     ECORR) run through GLSFitter against the oracle's independent
-    mpmath Woodbury.  Never cached.  Reference parity:
+    mpmath Woodbury.  Current-round seed live, prior seeds cached
+    (module docstring).  Reference parity:
     src/pint/fitter.py::WLSFitter/GLSFitter.fit_toas."""
     from oracle.mp_fit import OracleFitter
     from oracle.mp_pipeline import OraclePulsar
@@ -328,9 +454,10 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
     from pint_tpu.fitting import GLSFitter, WLSFitter
 
     rng = np.random.default_rng([seed, 1000 + case])
+    ing = _draw_env(np.random.default_rng([seed, 6000 + case]), tmp_path)
     par, tim, par_text, model, toas = _compose_pulsar(
         rng, tmp_path, sim_seed=seed * 100 + 50 + case, stem="fuzzfit",
-        mark_fit=True,
+        mark_fit=True, ingest=ing,
     )
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -341,12 +468,27 @@ def test_oracle_fuzz_fit(seed, case, tmp_path):
             f = WLSFitter(toas, model)
         chi2_fw = f.fit_toas(maxiter=4)
     free_names = list(f.cm.free_names)
-    oracle = OraclePulsar(par, tim)
-    of = OracleFitter(oracle, free_names)
-    v, s, c2 = of.fit(niter=2)
-    values = {n: float(v[n]) for n in free_names}
-    sigmas = {n: float(s[n]) for n in free_names}
-    _assert_fit_parity(f, chi2_fw, values, sigmas, float(c2), **_FIT_TOL)
+
+    def compute():
+        with fuzz_ingest_env(ing["env"]):
+            oracle = OraclePulsar(par, tim)
+            of = OracleFitter(oracle, free_names)
+            v, s, c2 = of.fit(niter=2)
+        return {
+            "values": np.array([float(v[n]) for n in free_names]),
+            "sigmas": np.array([float(s[n]) for n in free_names]),
+            "chi2": np.float64(c2),
+        }
+
+    out = _maybe_cached(
+        seed, f"fuzz_fit_{seed}_{case}", par, tim, tmp_path / "env",
+        [",".join(free_names), "niter=2"], compute,
+    )
+    values = dict(zip(free_names, out["values"]))
+    sigmas = dict(zip(free_names, out["sigmas"]))
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, float(out["chi2"]), **_FIT_TOL
+    )
 
 
 @pytest.mark.parametrize("seed,case", _wb_cases())
@@ -365,6 +507,7 @@ def test_oracle_fuzz_wideband_fit(seed, case, tmp_path):
     from pint_tpu.fitting.wideband import WidebandTOAFitter
 
     rng = np.random.default_rng([seed, 2000 + case])
+    ing = _draw_env(np.random.default_rng([seed, 7000 + case]), tmp_path)
     extra = [f"DMJUMP -f L-wide {rng.normal(0, 2e-3):.4e} 1"]
     if rng.random() < 0.5:
         extra.append(f"DMEFAC -f S-wide {rng.uniform(0.8, 1.4):.3f}")
@@ -373,7 +516,7 @@ def test_oracle_fuzz_wideband_fit(seed, case, tmp_path):
     par, tim, par_text, model, toas = _compose_pulsar(
         rng, tmp_path, sim_seed=seed * 100 + 70 + case, stem="fuzzwb",
         strip=("NE_SW",), mark_fit=True, extra_lines=extra,
-        wideband=True,
+        wideband=True, ingest=ing,
     )
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -381,9 +524,24 @@ def test_oracle_fuzz_wideband_fit(seed, case, tmp_path):
         chi2_fw = f.fit_toas(maxiter=4)
     free_names = list(f.cm.free_names)
     assert any(n.startswith("DMJUMP") for n in free_names)
-    oracle = OraclePulsar(par, tim)
-    of = OracleWidebandFitter(oracle, free_names)
-    v, s, c2 = of.fit(niter=2)
-    values = {n: float(v[n]) for n in free_names}
-    sigmas = {n: float(s[n]) for n in free_names}
-    _assert_fit_parity(f, chi2_fw, values, sigmas, float(c2), **_FIT_TOL)
+
+    def compute():
+        with fuzz_ingest_env(ing["env"]):
+            oracle = OraclePulsar(par, tim)
+            of = OracleWidebandFitter(oracle, free_names)
+            v, s, c2 = of.fit(niter=2)
+        return {
+            "values": np.array([float(v[n]) for n in free_names]),
+            "sigmas": np.array([float(s[n]) for n in free_names]),
+            "chi2": np.float64(c2),
+        }
+
+    out = _maybe_cached(
+        seed, f"fuzz_wb_{seed}_{case}", par, tim, tmp_path / "env",
+        [",".join(free_names), "niter=2"], compute,
+    )
+    values = dict(zip(free_names, out["values"]))
+    sigmas = dict(zip(free_names, out["sigmas"]))
+    _assert_fit_parity(
+        f, chi2_fw, values, sigmas, float(out["chi2"]), **_FIT_TOL
+    )
