@@ -159,7 +159,20 @@ def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
     # diagonal by construction of D
     W = (T * jnp.sqrt(phi)[None, :] * dinv[:, None]).astype(jnp.float32)
     n = Ndiag.shape[0]
-    Ceq32 = (W @ W.T).at[jnp.arange(n), jnp.arange(n)].set(1.0)
+    # diagonal overwrite as a fusable where (broadcasted-iota mask):
+    # an .at[diag].set scatter makes XLA materialize a second n^2
+    # copy of the Gram (~1 GB / ~10 ms of HBM traffic at n=16384,
+    # measured r5).  Above 16384 the scatter stays: with the iota
+    # formulation in the step graph the remote-compile service never
+    # returned at n=32768 (>45 min; the r4 scatter form compiled and
+    # ran there), so the fusion win is taken only where compile is
+    # known-good.
+    if n <= 16384:
+        ii = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        jj = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        Ceq32 = jnp.where(ii == jj, jnp.float32(1.0), W @ W.T)
+    else:
+        Ceq32 = (W @ W.T).at[jnp.arange(n), jnp.arange(n)].set(1.0)
     L32 = cholesky(Ceq32)
 
     def solve32(R):

@@ -38,7 +38,9 @@ def _constrain(mesh, x, spec):
     )
 
 
-def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa"):
+def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa",
+                     precision: str = "highest", panel: str = "solve",
+                     diag_bump: float = 0.0):
     """Lower Cholesky factor of SPD C (n, n), any n.
 
     Right-looking blocked algorithm with a PYTHON-UNROLLED outer loop:
@@ -53,10 +55,39 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa"):
     over `axis` and the update GEMM runs partitioned.  dtype follows C
     (f32 for the mixed path).
 
+    precision ('highest'|'high') sets the trailing-GEMM matmul passes
+    on TPU.  'highest' (6-pass bf16 emulation) is the safe default:
+    a single bf16 pass loses ~1e-3 relative in pan@pan.T and the Schur
+    cancellation 1 - ||pan_row||^2 then goes NEGATIVE on real
+    red-noise covariances (unit-diagonal + rank-k with ||W||_F^2 ~
+    1e4) — sqrt(neg) NaNs the next diagonal block; XLA's native
+    Cholesky pins its internal GEMMs the same way.  'high' (3-pass
+    bf16x3, ~f32 fidelity: measured factor residual 7e-6 vs 2e-7 rel
+    on the red-noise operand, profiling/cholesky_variants.py) is for
+    PRECONDITIONER use where f64 iterative refinement with the true
+    operator sits on top — see fast_cholesky32.
+
+    panel ('solve'|'inv') picks the panel computation: XLA's
+    sequential triangular solve, or a GEMM against the explicitly
+    inverted b x b diagonal block (O(n b^2) at MXU rate instead of the
+    solve's serial critical path; the inverse of a well-conditioned
+    equilibrated diagonal block is stable at f32).
+
+    diag_bump adds a ridge to every diagonal entry, applied PER
+    DIAGONAL BLOCK at factor time — algebraically identical to
+    factorizing C + bump*I (earlier Schur updates never touch a later
+    block's ridge) but O(b) per block instead of an O(n^2) full-matrix
+    scatter, which XLA materializes as a copy of the whole operand
+    (~11 ms of pure HBM traffic at n=16384 — measured r5).
+
     n that is not a block multiple is zero-padded with a unit diagonal
     (the padded factor is block-diagonal [L, I], so slicing back to
     (n, n) is exact) — arbitrary real TOA counts work without a
     caller-side padding recipe (ADVICE r2; VERDICT r2 weak 5)."""
+    prec = {
+        "highest": jax.lax.Precision.HIGHEST,
+        "high": jax.lax.Precision.HIGH,
+    }[precision]
     n = C.shape[0]
     pad = (-n) % block
     if pad:
@@ -67,25 +98,32 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa"):
     npad = n + pad
     A = C
     col_blocks = []
+    eye = jnp.eye(block, dtype=C.dtype)
+    bump = (
+        jnp.asarray(diag_bump, C.dtype) * jnp.eye(block, dtype=C.dtype)
+        if diag_bump else None
+    )
     for j in range(0, npad, block):
         A = _constrain(mesh, A, P(axis, None))
-        Ld = jnp.linalg.cholesky(A[:block, :block])  # replicated
-        pan = jax.scipy.linalg.solve_triangular(
-            Ld, A[block:, :block].T, lower=True
-        ).T
+        D = A[:block, :block]
+        if bump is not None:
+            D = D + bump
+        Ld = jnp.linalg.cholesky(D)  # replicated
+        if panel == "inv":
+            Ldinv = jax.scipy.linalg.solve_triangular(
+                Ld, eye, lower=True
+            )
+            pan = jnp.matmul(A[block:, :block], Ldinv.T, precision=prec)
+        else:
+            pan = jax.scipy.linalg.solve_triangular(
+                Ld, A[block:, :block].T, lower=True
+            ).T
         col_blocks.append((Ld, pan))
         if j + block < npad:
             pan = _constrain(mesh, pan, P(axis, None))
-            # the O((n-j)^2 b) trailing GEMM — sharded, static shapes.
-            # precision=HIGHEST is load-bearing: the TPU default matmul
-            # (bf16 passes) loses ~1e-3 relative in pan@pan.T, and the
-            # Schur cancellation 1 - ||pan_row||^2 then goes NEGATIVE
-            # on real red-noise covariances (unit-diagonal + rank-k
-            # with ||W||_F^2 ~ 1e4) — sqrt(neg) NaNs the next diagonal
-            # block.  XLA's native Cholesky pins its internal GEMMs the
-            # same way (r4: zero-phi test matrices never exposed this).
+            # the O((n-j)^2 b) trailing GEMM — sharded, static shapes
             A = A[block:, block:] - jnp.matmul(
-                pan, pan.T, precision=jax.lax.Precision.HIGHEST
+                pan, pan.T, precision=prec
             )
             A = _constrain(mesh, A, P(axis, None))
     L = jnp.zeros((npad, npad), C.dtype)
@@ -95,6 +133,56 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa"):
         if pan.shape[0]:
             L = L.at[j + block:, j:j + block].set(pan)
     return L[:n, :n]
+
+
+def fast_cholesky32(Aeq32, block: int = 512, ridge: float = 3e-5):
+    """MXU-rate f32 Cholesky of an EQUILIBRATED (unit-diagonal) SPD
+    operand, for preconditioner use only — the r5 answer to VERDICT r4
+    weak 2.
+
+    Measured on-chip at n=16384 on the real red-noise-conditioned
+    operand, with the 85 ms tunnel round-trip amortized over a 16-deep
+    dependent chain (the r3/r4 sweeps' chain=4 left ~21 ms of tunnel
+    latency in EVERY per-step number, uniformly deflating them —
+    profiling/cholesky_sweep.py): this configuration factorizes at
+    22.6 TF/s vs XLA's native custom call at 19.6 — the trailing GEMM
+    (where all n^3/3 FLOPs live) runs 3-pass bf16x3 ('high') instead
+    of 6-pass, and block=512 keeps the O(n^2 b) panel solves small.
+    Variants measured and rejected on the same operand (r5): panel-by
+    -inverse at HIGH NaNs (Ldinv's large entries amplify the 3-pass
+    error into the Schur cancellation, from the last diagonal block
+    outward); 1-pass DEFAULT NaNs outright; blocks 256 (17.2 TF/s)
+    and 1024 (22.2) bracket the 512 optimum; panel-by-inverse with a
+    HIGHEST pan-GEMM ties (22.5) with more failure surface.  The cost
+    is factor accuracy (~7e-6 vs ~2e-7 relative residual), IRRELEVANT
+    for the chol_solve_ir/woodbury_chol_solve_ir preconditioner role: the
+    refinement residual applies the TRUE f64 operator, so the refined
+    solution converges to the exact solve.  At the production refine=2
+    the refined step was probed INDISTINGUISHABLE from the native
+    factor's (on-chip n=8192 red-noise operands; the on-chip accuracy
+    net pins the full 8192-16384 selection window) — an extra pass is
+    available headroom at O(n^2 p), two orders below the
+    factorization, should a future operand class need it.
+
+    `ridge` bumps the unit diagonal before factorizing (applied per
+    diagonal block inside the kernel — a full-matrix diagonal scatter
+    would copy the whole n^2 operand): the 3-pass Schur error (~1e-5
+    absolute on an equilibrated operand) could drive a genuinely tiny
+    trailing pivot negative and NaN the factor; a few-x-error ridge
+    removes that failure class entirely and is, again, only a
+    preconditioner perturbation.  Do NOT use this for a direct
+    (non-refined) factorization — blocked_cholesky(precision=
+    'highest') or the native call are the accuracy-bearing routes.
+
+    The outer loop is python-unrolled, so n/block is compile-time HLO
+    size: past 32 blocks the remote-compile cost explodes (n=32768 at
+    b=512 = 64 unrolled trailing updates).  block grows to keep the
+    unroll depth <= 32; the b=1024 rate (22.2 TF/s) is within 2% of
+    the b=512 optimum anyway."""
+    while Aeq32.shape[0] > 32 * block:
+        block *= 2
+    return blocked_cholesky(Aeq32, block=block, precision="high",
+                            panel="solve", diag_bump=ridge)
 
 
 def sharded_chol_solve_ir(C, B, block: int = 512, mesh=None,

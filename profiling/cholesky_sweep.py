@@ -1,6 +1,15 @@
-"""f32 Cholesky sweep: XLA-native vs the sharded-capable blocked kernel
-(parallel/dense.py::blocked_cholesky) across block sizes — the VERDICT
-r3 weak-2 measurement.  n^3/3 model accounting; one JSON line each.
+"""f32 Cholesky sweep: XLA-native vs the blocked kernels
+(parallel/dense.py::blocked_cholesky / fast_cholesky32) across block
+sizes — the VERDICT r3 weak-2 / r4 item-2 measurement.  n^3/3 model
+accounting; one JSON line each.
+
+r5 correction: the chain was raised 4 -> 16.  Per-step times divide
+the wall clock of a chained dependent scan by the chain length, and
+the ~85 ms tunnel round-trip is part of that wall clock — at chain=4
+every per-step number carried ~21 ms of tunnel latency, uniformly
+DEFLATING all r3/r4 TF/s figures (native measured "15.4" then; 19.6
+with the latency amortized).  Cross-round comparisons must use
+same-chain numbers.
 
     python profiling/cholesky_sweep.py [--n 16384 32768]
 """
@@ -12,7 +21,7 @@ import time
 import numpy as np
 
 
-def _time_op(fn, arg, nrep=3, chain=4):
+def _time_op(fn, arg, nrep=3, chain=16):
     import jax
 
     @jax.jit
@@ -57,6 +66,19 @@ def main():
         t = _time_op(jnp.linalg.cholesky, C)
         print(json.dumps({
             "kernel": "xla_native", "n": n,
+            "ms": round(t * 1e3, 1),
+            "model_tflops_per_s": round(flops / t / 1e12, 2),
+        }))
+        from pint_tpu.parallel.dense import fast_cholesky32
+
+        # the equilibrated-operand preconditioner route (r5): the
+        # sweep operand has diagonal ~n, so normalize it first the way
+        # the IR recipe would
+        d = jnp.sqrt(jnp.diagonal(C))
+        Ceq = (C / jnp.outer(d, d)).astype(jnp.float32)
+        t = _time_op(fast_cholesky32, Ceq)
+        print(json.dumps({
+            "kernel": "fast_cholesky32_b512", "n": n,
             "ms": round(t * 1e3, 1),
             "model_tflops_per_s": round(flops / t / 1e12, 2),
         }))
