@@ -148,9 +148,11 @@ class Fitter:
     def _finish_scan_fit(self, result, warn_msg: str, fail_msg: str):
         """Shared host tail of a make_scan_fit_loop run: emit one
         DegeneracyWarning per degenerate iteration, raise on non-finite
-        chi2, record convergence, commit, and drop compiled loops
-        (cm.commit() rebased cm.ref, which the loops baked in as
-        constants; the cache still serves retries after a raise)."""
+        chi2, record convergence, commit.  The compiled loops SURVIVE
+        the commit (r5): cm.commit() rebases only the numeric
+        references, which ride every cm.jit call as runtime arguments
+        — a refit costs one dispatch, not a ~30 s recompile
+        (profiling/profile_fit_wall.py)."""
         x, chi2, cov, conv, nbads, bads = result
         nbads = np.asarray(nbads)
         for nb in nbads[nbads > 0]:
@@ -159,7 +161,6 @@ class Fitter:
             raise ConvergenceFailure(fail_msg)
         self.converged = bool(conv)
         chi2 = self._finalize(x, cov, float(chi2))
-        self._fit_loops.clear()
         return chi2
 
     def _finalize(self, x, cov, chi2: float):

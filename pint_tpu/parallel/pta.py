@@ -26,8 +26,6 @@ from pint_tpu.fitting.gls import (
     gls_step_woodbury,
     gls_step_woodbury_mixed,
 )
-from pint_tpu.ops.dd import DD
-from pint_tpu.timebase.hostdd import HostDD
 from pint_tpu.toas.bundle import TOABundle
 
 # padded TOAs get this uncertainty (us): weight ~ 1e-48 of a real TOA
@@ -56,27 +54,12 @@ def pad_bundle_to(bundle: TOABundle, n: int) -> TOABundle:
 def _device_ref(cm):
     """Split a CompiledModel's host reference values into (numeric
     device pytree, static host dict).  The numeric part is what differs
-    per pulsar and gets stacked/vmapped; strings/bools stay static."""
-    num, static = {}, {}
-    for n, v in cm.ref.items():
-        if isinstance(v, HostDD):
-            num[n] = DD(jnp.float64(float(v.hi)), jnp.float64(float(v.lo)))
-        elif (
-            isinstance(v, tuple) and len(v) == 2
-            and isinstance(v[1], HostDD)
-        ):
-            day, sec = v
-            num[n] = (
-                jnp.float64(float(day)),
-                DD(jnp.float64(float(sec.hi)), jnp.float64(float(sec.lo))),
-            )
-        elif isinstance(v, tuple):
-            num[n] = tuple(jnp.float64(float(e)) for e in v)
-        elif isinstance(v, (float, int)) and not isinstance(v, bool):
-            num[n] = jnp.float64(v)
-        else:
-            static[n] = v
-    return num, static
+    per pulsar and gets stacked/vmapped; strings/bools stay static.
+    One splitter serves both this and the single-model runtime-ref
+    arguments (models/timing_model.py::split_ref_runtime)."""
+    from pint_tpu.models.timing_model import split_ref_runtime
+
+    return split_ref_runtime(cm.ref)
 
 
 class PTABatch:

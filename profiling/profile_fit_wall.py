@@ -1,0 +1,78 @@
+"""Wall-clock phase breakdown of a full production ``fit_toas`` —
+the VERDICT r4 weak-4 measurement (the 1e6-TOA product path).
+
+The bench metric is the in-scan step; the product a user runs is
+``GLSFitter.fit_toas`` whose wall time adds host ingest, bundle
+build + host->device transfer, compile, and the post-fit finalize
+(host covariance unnorm + residual refresh).  This harness times each
+phase separately, then a WARM refit (same fitter, cached loop) and a
+DATA-SWAP refit (same shapes, new bundle — the re-bake/transport
+contract), which is what an iterating user actually pays per fit.
+
+    python profiling/profile_fit_wall.py [ntoa ...]
+"""
+
+import json
+import sys
+import time
+
+
+def run(ntoa):
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    sys.path.insert(0, ".")
+    from bench import _build
+
+    t0 = time.perf_counter()
+    model, toas, _cm = _build(ntoa)
+    t_build = time.perf_counter() - t0
+
+    from pint_tpu.fitting import GLSFitter
+
+    t0 = time.perf_counter()
+    f = GLSFitter(toas, model)
+    t_ctor = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    chi2 = f.fit_toas()
+    t_first = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    chi2b = f.fit_toas()
+    t_warm = time.perf_counter() - t0
+
+    # data-swap refit: same shapes, new TOA jitter (the re-bake /
+    # argument-transport contract — docs/parallelism.md)
+    import numpy as np
+
+    from pint_tpu.toas.bundle import make_bundle
+
+    rng = np.random.default_rng(7)
+    toas.t = toas.t.add_seconds(rng.normal(0.0, 1e-7, len(toas)))
+    t0 = time.perf_counter()
+    f.cm.bundle = make_bundle(
+        toas, masks=None
+    )._replace(masks=f.cm.bundle.masks)
+    t_rebundle = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chi2c = f.fit_toas()
+    t_swap = time.perf_counter() - t0
+
+    print(json.dumps({
+        "ntoa": ntoa,
+        "build_ingest_s": round(t_build, 2),
+        "fitter_ctor_s": round(t_ctor, 2),
+        "first_fit_s": round(t_first, 2),
+        "warm_refit_s": round(t_warm, 2),
+        "rebundle_s": round(t_rebundle, 2),
+        "swap_refit_s": round(t_swap, 2),
+        "chi2": round(float(chi2), 3),
+        "chi2_warm": round(float(chi2b), 3),
+        "chi2_swap": round(float(chi2c), 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    for n in [int(a) for a in (sys.argv[1:] or ["100000", "1000000"])]:
+        run(n)
