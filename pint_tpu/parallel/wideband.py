@@ -1,0 +1,84 @@
+"""Mesh-sharded wideband GLS: the stacked [TOA; DM] system over the
+TOA-sharding axis (VERDICT r4 missing 3).
+
+The wideband fitter (fitting/wideband.py::WidebandTOAFitter, reference
+src/pint/fitter.py::WidebandTOAFitter + pint_matrix.py combination)
+solves a Woodbury system whose rows are the 2n stacked [TOA residual;
+DM residual] equations: diagonal white part [sigma_toa^2;
+sigma_dm^2], correlated bases acting on the TOA block only (zero DM
+rows), one design matrix from jacfwd of the combined residual kernel.
+
+Structurally that IS the system parallel/gls.py already shards — the
+per-shard Gram partial sums decompose over ANY row partition, so the
+DM block simply rides the same axis: stack, pad the row count to the
+mesh divisor with ~infinite-variance rows (weight ~0: they drop out of
+every N^-1-weighted sum), and delegate.  The f64 and mixed paths both
+come along for free, with the same collective pattern (O((k+p)^2)
+bytes per step, n-independent) and the same precision contracts as
+narrowband.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.parallel.gls import (
+    place_gls_operands, sharded_gls_step, sharded_gls_step_mixed,
+)
+
+#: variance of a padding row: large enough that its weight vanishes in
+#: every sum, small enough that 1/x stays far from the emulated-f64
+#: overflow cliff (CLAUDE.md: f32 exponent range on axon)
+_PAD_VAR = 1e30
+
+
+def stack_wideband_operands(r_t, r_dm, M_t, M_dm, Nd_t, Nd_dm, T, phi,
+                            multiple: int = 1):
+    """Stack per-block operands into the (2n[+pad], ...) system the
+    sharded steps consume.  T gets zero DM rows (correlated noise acts
+    on TOAs only — fitting/wideband.py::_combined_basis).  Pad rows
+    (to make the row count divisible by the mesh axis) carry zero
+    residual/design and ~infinite variance."""
+    r = jnp.concatenate([r_t, r_dm])
+    M = jnp.concatenate([M_t, M_dm], axis=0)
+    Nd = jnp.concatenate([Nd_t, Nd_dm])
+    k = T.shape[1]
+    T2 = jnp.concatenate(
+        [T, jnp.zeros((r_dm.shape[0], k), T.dtype)], axis=0
+    )
+    n2 = r.shape[0]
+    pad = (-n2) % multiple
+    if pad:
+        r = jnp.concatenate([r, jnp.zeros(pad, r.dtype)])
+        M = jnp.concatenate(
+            [M, jnp.zeros((pad, M.shape[1]), M.dtype)], axis=0
+        )
+        Nd = jnp.concatenate(
+            [Nd, jnp.full(pad, _PAD_VAR, Nd.dtype)]
+        )
+        T2 = jnp.concatenate(
+            [T2, jnp.zeros((pad, k), T2.dtype)], axis=0
+        )
+    return r, M, Nd, T2, phi
+
+
+def sharded_wideband_step(mesh, r, M, Ndiag, T, phi,
+                          axis: str = "toa", method: str = "f64",
+                          normalized_cov=False):
+    """One sharded wideband GLS step on pre-stacked operands (see
+    stack_wideband_operands; row count must divide the mesh axis).
+    method 'f64' | 'mixed' — the same two production paths as
+    narrowband, byte-identical collective structure."""
+    step = {"f64": sharded_gls_step, "mixed": sharded_gls_step_mixed}[
+        method
+    ]
+    return step(mesh, r, M, Ndiag, T, phi, axis=axis,
+                normalized_cov=normalized_cov)
+
+
+def place_wideband_operands(mesh, r, M, Ndiag, T, phi,
+                            axis: str = "toa"):
+    """Device-put pre-stacked wideband operands with the row axis
+    sharded — identical placement contract to the narrowband helper."""
+    return place_gls_operands(mesh, r, M, Ndiag, T, phi, axis=axis)

@@ -155,12 +155,22 @@ def config_3b():
 
 
 def _wideband_config(ntoa, label):
+    """r5 (VERDICT r4 missing 3): the wideband par carries PL red
+    noise, so on accelerators the fitter's auto-selected step is the
+    MIXED general-basis MXU path over the stacked [TOA; DM] system —
+    the ladder row label shows the mode actually run, and the builder
+    cross-checks the mixed step's chi2 against the f64 step on the
+    same operands (extras carry the relative difference).  r1-r4 rows
+    ran a white-noise wideband model whose step resolved to [f64];
+    per-TOA trend comparisons across that boundary carry the mode
+    change."""
     from pint_tpu.fitting.wideband import WidebandTOAFitter
     from pint_tpu.models.builder import get_model
     from pint_tpu.simulation import make_test_pulsar
 
     par = (
         "PSR C4\nF0 205.53 1\nF1 -4.3e-16 1\nPEPOCH 55000\nDM 4.33 1\n"
+        "EFAC -f L-wide 1.1\nTNREDAMP -13.6\nTNREDGAM 3.9\nTNREDC 15\n"
     )
     rng = np.random.default_rng(0)
     m, toas = make_test_pulsar(par, ntoa=ntoa, start_mjd=53000,
@@ -170,8 +180,20 @@ def _wideband_config(ntoa, label):
         f["pp_dme"] = "2e-4"
     fitter = WidebandTOAFitter(toas, get_model(par))
     step, mode = _fitter_step_fn(fitter)
+    extras = {"jit_wrap": fitter.cm.jit}
+    if mode != "f64":
+        # prove the accelerator mode matches f64 on this exact system
+        chi2_m = float(fitter.cm.jit(
+            lambda x: fitter._make_step(mode)(x)[2]
+        )(fitter.cm.x0()))
+        chi2_f = float(fitter.cm.jit(
+            lambda x: fitter._make_step("f64")(x)[2]
+        )(fitter.cm.x0()))
+        rel = abs(chi2_m - chi2_f) / abs(chi2_f)
+        assert rel < 3e-3, (chi2_m, chi2_f)
+        extras["chi2_mixed_vs_f64_rel"] = round(rel, 9)
     return (f"{label} [{mode}]", ntoa, step, fitter.cm.x0(),
-            128, {"jit_wrap": fitter.cm.jit})
+            128, extras)
 
 
 def config_4():
@@ -315,12 +337,38 @@ def config_7(ntoa: int = 16384):
     T, phi = cm.noise_basis_or_empty(x0)
     method = "f64" if jax.default_backend() == "cpu" else "mixed"
 
+    # operands ride as RUNTIME ARGUMENTS via the swap-cell jit below:
+    # closed-over device arrays become compile-request constants, and
+    # at this scale (T alone is ~16 MB f64 at n=32768) the remote
+    # compile service stopped returning in r5 — same transport failure
+    # class as baked bundles, same cure as cm.jit
+    cell = {"ops": (r, M, Nd, T, phi)}
+
     def step(x):
+        r_, M_, Nd_, T_, phi_ = cell["ops"]
         jitter = 1.0 + x[0] * 1e-18  # ties C to x: defeats hoisting
         dx, _, chi2, _ = gls_step_full_cov(
-            r, M, Nd * jitter, T, phi, method=method
+            r_, M_, Nd_ * jitter, T_, phi_, method=method
         )
         return x + dx[1:], chi2
+
+    def jit_wrap(fn):
+        import jax as _jax
+
+        @_jax.jit
+        def inner(ops, *a):
+            saved = cell["ops"]
+            cell["ops"] = ops
+            try:
+                return fn(*a)
+            finally:
+                cell["ops"] = saved
+
+        def wrapped(*a):
+            return inner(cell["ops"], *a)
+
+        wrapped.lower = lambda *a: inner.lower(cell["ops"], *a)
+        return wrapped
 
     # What stays in-loop after XLA's (legal) invariant hoisting: the
     # diagonal scaling of the n^2 k assembly GEMM commutes out, so the
@@ -328,7 +376,8 @@ def config_7(ntoa: int = 16384):
     # O(n^2 p) IR/triangular solves.  model_flops counts n^3/3 — a
     # LOWER bound (XLA's cost analysis reports ~0 for the Cholesky
     # custom call, hence the separate field).
-    extras = {"model_flops_per_step": ntoa**3 / 3}
+    extras = {"model_flops_per_step": ntoa**3 / 3,
+              "jit_wrap": jit_wrap}
     # chain=16: at a ~0.1 s step the tunnel round-trip is ~1% of a
     # 16-step chain, and 128 steps would take minutes per rep
     chain = 16 if ntoa <= 16384 else 6
@@ -341,7 +390,10 @@ def config_7(ntoa: int = 16384):
 def config_7b():
     """config7 at n=32768 f32 (~4.3 GB covariance + factor on the
     16 GB chip) — VERDICT r3 item 2b: the FLOP-bound end at the
-    largest single-chip dense size."""
+    largest single-chip dense size.  The step's operands ride as
+    runtime arguments (config_7's swap-cell jit_wrap): closed-over
+    operand constants at this n stopped compiling in useful time on
+    the remote-compile tunnel (r5)."""
     return config_7(ntoa=32768)
 
 

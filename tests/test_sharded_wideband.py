@@ -1,0 +1,150 @@
+"""Sharded wideband [TOA; DM] GLS vs the single-device paths on the
+virtual 8-device CPU mesh (VERDICT r4 missing 3 / item 3).
+
+The stacked wideband system decomposes over row shards exactly like
+the narrowband Woodbury system; these tests pin (a) exact f64
+agreement with gls_step_woodbury on the same stacked operands, (b) the
+mixed path within its narrowband contract, (c) the padding recipe
+(2n not divisible by the mesh) changing nothing, (d) collectives
+staying O((k+p)^2) — no row-axis-sized all-reduces.
+Reference parity: src/pint/fitter.py::WidebandTOAFitter,
+pint_matrix.py combination.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.fitting.base import design_with_offset  # noqa: F401
+from pint_tpu.fitting.gls import (
+    gls_step_woodbury, gls_step_woodbury_mixed,
+)
+from pint_tpu.fitting.wideband import WidebandTOAFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.parallel.mesh import make_mesh
+from pint_tpu.parallel.wideband import (
+    place_wideband_operands, sharded_wideband_step,
+    stack_wideband_operands,
+)
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas.ingest import ingest_barycentric
+
+PAR = """
+PSR              J0000+0000
+F0               245.1               1
+F1               -4.0e-16            1
+PEPOCH           55000
+DM               19.3                1
+EFAC -f L-wide 1.2
+TNREDAMP         -13.4
+TNREDGAM         3.1
+TNREDC           5
+"""
+
+
+def _wb_operands(n):
+    rng = np.random.default_rng(7)
+    m = get_model(PAR)
+    toas = make_fake_toas_uniform(
+        54500, 56500, n, m, error_us=1.0,
+        freq_mhz=np.where(np.arange(n) % 2, 1400.0, 800.0),
+    )
+    toas.t = toas.t.add_seconds(rng.normal(0, 1e-6, n))
+    dm_meas = 19.3 + rng.normal(0, 2e-4, n)
+    for i, f in enumerate(toas.flags):
+        f["pp_dm"] = f"{dm_meas[i]:.10f}"
+        f["pp_dme"] = "2e-04"
+        f["f"] = "L-wide" if i % 2 else "S-wide"
+    ingest_barycentric(toas)
+    f = WidebandTOAFitter(toas, m)
+    import jax.numpy as jnp
+
+    x = f.cm.x0()
+    r_t = f.cm.time_residuals(x, subtract_mean=False)
+    r_dm = f.cm.dm_residuals(x)
+    M2n = f._combined_design(x)
+    n_ = f.cm.bundle.ntoa
+    M_t, M_dm = M2n[:n_], M2n[n_:]
+    Nd_t = jnp.square(f.cm.scaled_sigma(x))
+    Nd_dm = jnp.square(f.cm.scaled_dm_sigma(x))
+    T, phi = f.cm.noise_basis_or_empty(x)
+    assert T.shape[1] > 0  # the correlated basis must be real here
+    return r_t, r_dm, M_t, M_dm, Nd_t, Nd_dm, T, phi
+
+
+@pytest.fixture(scope="module")
+def operands60():
+    return _wb_operands(60)  # 2n = 120 = 8 * 15: no padding needed
+
+
+def test_sharded_wideband_f64_matches_unsharded(operands60):
+    stacked = stack_wideband_operands(*operands60, multiple=8)
+    dx0, cov0, chi0, nb0 = jax.jit(gls_step_woodbury)(*stacked)
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_wideband_operands(mesh, *stacked)
+    dx1, cov1, chi1, nb1 = jax.jit(
+        lambda *a: sharded_wideband_step(mesh, *a, method="f64")
+    )(*args)
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=1e-10, atol=1e-30
+    )
+    np.testing.assert_allclose(
+        np.asarray(cov1), np.asarray(cov0), rtol=1e-8
+    )
+    assert float(chi1) == pytest.approx(float(chi0), rel=1e-10)
+    assert int(nb1) == int(nb0)
+
+
+def test_sharded_wideband_mixed_matches_f64(operands60):
+    stacked = stack_wideband_operands(*operands60, multiple=8)
+    dx0, _, chi0, _ = jax.jit(gls_step_woodbury)(*stacked)
+    dxm, _, chim, _ = jax.jit(gls_step_woodbury_mixed)(*stacked)
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_wideband_operands(mesh, *stacked)
+    dx1, _, chi1, _ = jax.jit(
+        lambda *a: sharded_wideband_step(mesh, *a, method="mixed")
+    )(*args)
+    # sharded mixed vs single-device mixed: same arithmetic class
+    scale = np.max(np.abs(np.asarray(dxm)))
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dxm), rtol=2e-3, atol=2e-6 * scale
+    )
+    assert float(chi1) == pytest.approx(float(chim), rel=1e-6)
+    # and both sit inside the documented mixed-vs-f64 contract
+    assert float(chi1) == pytest.approx(float(chi0), rel=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=2e-3,
+        atol=2e-3 * np.max(np.abs(np.asarray(dx0))) + 1e-30,
+    )
+
+
+def test_sharded_wideband_padding_is_inert():
+    """2n = 124 pads to 128: the four ~infinite-variance rows must not
+    move the answer (vs the same system solved unsharded, unpadded)."""
+    ops = _wb_operands(62)
+    unpadded = stack_wideband_operands(*ops, multiple=1)
+    dx0, cov0, chi0, _ = jax.jit(gls_step_woodbury)(*unpadded)
+    padded = stack_wideband_operands(*ops, multiple=8)
+    assert padded[0].shape[0] == 128
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_wideband_operands(mesh, *padded)
+    dx1, cov1, chi1, _ = jax.jit(
+        lambda *a: sharded_wideband_step(mesh, *a, method="f64")
+    )(*args)
+    np.testing.assert_allclose(
+        np.asarray(dx1), np.asarray(dx0), rtol=1e-9, atol=1e-30
+    )
+    assert float(chi1) == pytest.approx(float(chi0), rel=1e-9)
+
+
+def test_sharded_wideband_collective_bytes_independent_of_n(operands60):
+    stacked = stack_wideband_operands(*operands60, multiple=8)
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_wideband_operands(mesh, *stacked)
+    hlo = jax.jit(
+        lambda *a: sharded_wideband_step(mesh, *a, method="f64")
+    ).lower(*args).compile().as_text()
+    n2 = stacked[0].shape[0]
+    for line in hlo.splitlines():
+        if "all-reduce" in line and "f64[" in line:
+            assert f"f64[{n2}" not in line, line
