@@ -19,13 +19,24 @@ from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
 
+#: QR acceptance: min|R_ii|/max|R_ii| above this takes the QR answer;
+#: below it (near-exact degeneracy) the thresholded-eigh gram path
+#: zeroes the bad directions and reports them.  Measured r5 cliff
+#: placement, docs/precision.md: on-chip QR holds ~cond * 1e-13 out to
+#: cond ~1e10, while the gram route silently loses ALL accuracy past
+#: cond ~1e3-1e4 (emulated-f64 eigh is only ~f32-grade and the Gram
+#: squares the condition number).
+_QR_DIAG_RTOL = 1e-8
+
+
 def default_wls_method() -> str:
     """The backend-dependent WLS solve policy: the reference's
-    column-scaled 'svd' lstsq on CPU, the thresholded-eigh 'gram'
-    normal equations on accelerators (axon's emulated-f64 SVD NaNs).
+    column-scaled 'svd' lstsq on CPU; on accelerators (axon's
+    emulated-f64 SVD NaNs) 'qr' — a Householder-QR least squares with
+    a thresholded-eigh gram FALLBACK for near-exact degeneracy.
     Single source of truth for _wls_step and every fitter that names
     the method in a DegeneracyWarning."""
-    return "svd" if jax.default_backend() == "cpu" else "gram"
+    return "svd" if jax.default_backend() == "cpu" else "qr"
 
 
 def _wls_step(r, M, w, threshold=None, method=None,
@@ -38,18 +49,28 @@ def _wls_step(r, M, w, threshold=None, method=None,
     (fitter.py::WLSFitter).
 
     method='svd' (CPU default) is the reference's column-scaled SVD
-    lstsq.  method='gram' (accelerator default) solves the p x p
-    normal equations by thresholded eigh instead: the axon TPU's
-    emulated-f64 SVD returns NaNs (and a native-f32 SVD would cost the
-    full conditioning), while eigh is exact to emulated-f64 — the same
-    factorization the GLS tail uses.  The Gram squares the condition
-    number, which column normalization keeps benign for timing design
-    matrices (p ~ 10-100); the eigenvalue cut is eps*max(n,p)*lam_max —
-    the Gram's own roundoff floor (the GLS-tail convention,
-    gls.py::_finish_normal_eqs), NOT the square of the SVD cut (which
-    sits far below that floor and would never fire): it zeroes
-    directions with s/s0 below sqrt(eps*max(n,p)) — ~4e-7 at n=600,
-    ~4.7e-6 at n=1e5 — exactly those whose Gram content is roundoff.
+    lstsq.  method='qr' (accelerator default, r5) factorizes the
+    column-normalized weighted design directly: on-chip QR +
+    triangular solve measure near-IEEE accuracy (relerr ~ cond *
+    1e-13 on a synthetic ladder out to cond 1e10 —
+    tests/test_onchip_accuracy.py::test_onchip_wls_conditioning_*),
+    because Householder reflections never square the condition
+    number.  When diag(R) reveals a near-exact degeneracy (ratio
+    below _QR_DIAG_RTOL) the step takes the 'gram' answer instead,
+    which zeroes the degenerate directions and counts them (the
+    reference's SVD-cut semantics).  The fallback rides a
+    jax.lax.cond, so the full-rank common case never executes the
+    O(n p^2) Gram product + eigh at runtime.
+
+    method='gram' solves the p x p normal equations by thresholded
+    eigh (the r2-r4 accelerator default, kept for the fallback and for
+    comparison): the Gram SQUARES the condition number and axon's
+    emulated-f64 eigh is only ~f32-grade, so this route silently
+    degrades from cond ~1e3 — the r5 measurement that made 'qr' the
+    default.  Its eigenvalue cut is eps*max(n,p)*lam_max — the Gram's
+    own roundoff floor (the GLS-tail convention,
+    gls.py::_finish_normal_eqs): it zeroes directions with s/s0 below
+    sqrt(eps*max(n,p)) — ~4e-7 at n=600, ~4.7e-6 at n=1e5.
     """
     from pint_tpu.fitting.gls import _column_norms, _eigh_threshold_solve
 
@@ -67,6 +88,27 @@ def _wls_step(r, M, w, threshold=None, method=None,
         threshold = jnp.finfo(jnp.float64).eps * max(A.shape)
     if method == "gram":
         dx, covn, nbad = _eigh_threshold_solve(A.T @ A, A.T @ b, threshold)
+    elif method == "qr":
+        Q, R = jnp.linalg.qr(A)
+        diag = jnp.abs(jnp.diagonal(R))
+        rank_ok = diag.min() > _QR_DIAG_RTOL * diag.max()
+
+        def qr_solve(_):
+            Rinv = jax.scipy.linalg.solve_triangular(
+                R, jnp.eye(A.shape[1], dtype=A.dtype), lower=False
+            )
+            dx = Rinv @ (Q.T @ b)
+            return dx, Rinv @ Rinv.T, jnp.asarray(0, jnp.int64)
+
+        def gram_fallback(_):
+            dx, covn, nbad = _eigh_threshold_solve(
+                A.T @ A, A.T @ b, threshold
+            )
+            return dx, covn, nbad.astype(jnp.int64)
+
+        dx, covn, nbad = jax.lax.cond(
+            rank_ok, qr_solve, gram_fallback, None
+        )
     else:
         U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
         bad = s < threshold * s[0]
