@@ -67,20 +67,64 @@ class LCTemplate:
             off += p.n_params
         return out
 
+    def _rand_jitted(self, branch, fn):
+        """Per-instance cache of the sampler's jitted callables:
+        random() is called per-realization in simulation sweeps, and
+        re-jitting a fresh lambda each call would recompile the
+        template density every time (jit's own cache keys on function
+        identity).  One wrapper per branch suffices — jax.jit caches
+        per input shape internally.  Parameters ride as ARGUMENTS so
+        the cached executable stays valid after a fit moves them, and
+        the key carries the primitive STRUCTURE (types + param
+        layout): a same-shape primitive swap must re-trace, not
+        silently serve the old template's density."""
+        sig = tuple(
+            (type(p).__name__, len(p.params)) for p in self.primitives
+        )
+        key = (branch, sig)
+        cache = getattr(self, "_rand_jit_cache", None)
+        if cache is None:
+            cache = self._rand_jit_cache = {}
+        if key not in cache:
+            import jax
+
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
     def random(self, n, rng=None, log10_ens=None):
         """Draw photon phases from the template (for tests/simulation);
         with log10_ens (length n), each photon is drawn from its own
-        energy's density."""
+        energy's density.
+
+        The per-round density evaluation is JITTED at a fixed shape
+        (r5): a rejection sampler makes dozens of rounds, and an eager
+        template call is a chain of hundreds of small dispatches —
+        ~0.9 s/round for a 6000-photon energy-dependent draw, ~55 s
+        total where the jitted version takes under a second.  Both
+        branches share the envelope contract: a 1.1 margin over a
+        grid-estimated maximum, plus an in-loop rescale-and-RESTART
+        when any computed density exceeds it (draws accepted under a
+        too-low envelope are biased and must be discarded)."""
         rng = rng or np.random.default_rng()
-        params = self.get_parameters()
+        if n == 0:
+            return np.empty(0)
+        params = jnp.asarray(self.get_parameters())
         if log10_ens is None:
-            fmax = float(
-                np.max(np.asarray(self(np.linspace(0, 1, 2048), params)))
-            )
+            density = self._rand_jitted("noe", lambda c, p: self(c, p))
+            fmax = 1.1 * float(np.max(np.asarray(
+                density(jnp.linspace(0.0, 1.0, 2048), params)
+            )))
             out = []
             while len(out) < n:
                 cand = rng.uniform(size=2 * n)
-                f = np.asarray(self(cand, params))
+                f = np.asarray(density(jnp.asarray(cand), params))
+                f_hi = float(np.max(f, initial=0.0))
+                if f_hi > fmax:
+                    # a peak narrower than the 2048-point grid spacing
+                    # slipped the estimate: raise and restart
+                    fmax = 1.1 * f_hi
+                    out = []
+                    continue
                 keep = rng.uniform(size=2 * n) * fmax < f
                 out.extend(cand[keep].tolist())
             return np.asarray(out[:n])
@@ -94,30 +138,46 @@ class LCTemplate:
         # maximum (ADVICE r3 + r4 review); the phase grid plus the
         # 1.1 margin and the in-loop rescale below cover what 512
         # phase samples could still miss
-        fmax = 0.0
+        env = self._rand_jitted(
+            "env", lambda uu, p: jnp.max(
+                self(grid[None, :], p, log10_ens=uu[:, None])
+            )
+        )
+        # device-scalar accumulation: a float() per chunk would force
+        # ceil(n/1024) serialized dispatch round-trips (~85 ms each on
+        # the tunnel); one conversion at the end lets them pipeline
+        chunk_maxes = []
         for lo in range(0, n, 1024):
             u_chunk = u[lo:lo + 1024]
-            fmax = max(fmax, float(np.max(np.asarray(
-                self(grid[None, :], params, log10_ens=u_chunk[:, None])
-            ))))
-        fmax *= 1.1
+            if len(u_chunk) < 1024:  # pad: one compiled shape
+                u_chunk = np.concatenate(
+                    [u_chunk, np.full(1024 - len(u_chunk), u_chunk[-1])]
+                )
+            chunk_maxes.append(env(jnp.asarray(u_chunk), params))
+        fmax = 1.1 * float(jnp.max(jnp.stack(chunk_maxes)))
+        # fixed-shape rounds: evaluate ALL n candidates each round and
+        # fill only the still-pending slots — one compiled density
+        # serves every round (a per-round shape would recompile)
+        density = self._rand_jitted(
+            "en", lambda c, uu, p: self(c, p, log10_ens=uu)
+        )
+        u_dev = jnp.asarray(u)
         phases = np.empty(n)
         todo = np.ones(n, dtype=bool)
         while todo.any():
-            idx = np.flatnonzero(todo)
-            cand = rng.uniform(size=len(idx))
-            f = np.asarray(self(cand, params, log10_ens=u[idx]))
+            cand = rng.uniform(size=n)
+            f = np.asarray(density(jnp.asarray(cand), u_dev, params))
+            # envelope check over ALL slots: a completed slot whose
+            # fresh density exceeds fmax is evidence its earlier
+            # acceptance ran under a too-low envelope — restart
             f_hi = float(np.max(f, initial=0.0))
             if f_hi > fmax:
-                # grid missed a sharper interior superposition: raise
-                # the envelope and restart (already-accepted draws
-                # under a too-low envelope would be biased)
                 fmax = 1.1 * f_hi
                 todo[:] = True
                 continue
-            keep = rng.uniform(size=len(idx)) * fmax < f
-            phases[idx[keep]] = cand[keep]
-            todo[idx[keep]] = False
+            keep = todo & (rng.uniform(size=n) * fmax < f)
+            phases[keep] = cand[keep]
+            todo[keep] = False
         return phases
 
     def __repr__(self):
