@@ -75,12 +75,22 @@ class LCTemplate:
         identity).  One wrapper per branch suffices — jax.jit caches
         per input shape internally.  Parameters ride as ARGUMENTS so
         the cached executable stays valid after a fit moves them, and
-        the key carries the primitive STRUCTURE (types + param
-        layout): a same-shape primitive swap must re-trace, not
-        silently serve the old template's density."""
-        sig = tuple(
-            (type(p).__name__, len(p.params)) for p in self.primitives
-        )
+        the key carries the primitive STRUCTURE — type, param layout,
+        any wrapped base primitive's type, and a digest of non-param
+        state like a binned profile's table — so a same-shape
+        primitive swap (or an in-place base/table change) re-traces
+        instead of silently serving the old template's density."""
+        def psig(p):
+            parts = [type(p).__name__, len(p.params)]
+            base = getattr(p, "base", None)
+            if base is not None:
+                parts.append(psig(base))
+            vals = getattr(p, "values", None)
+            if vals is not None:  # binned-profile table = traced const
+                parts.append(hash(np.asarray(vals).tobytes()))
+            return tuple(parts)
+
+        sig = tuple(psig(p) for p in self.primitives)
         key = (branch, sig)
         cache = getattr(self, "_rand_jit_cache", None)
         if cache is None:
@@ -106,8 +116,16 @@ class LCTemplate:
         when any computed density exceeds it (draws accepted under a
         too-low envelope are biased and must be discarded)."""
         rng = rng or np.random.default_rng()
+        if log10_ens is not None:
+            u = np.asarray(log10_ens, dtype=np.float64)
+            if u.shape != (n,):
+                raise ValueError("log10_ens must have length n")
         if n == 0:
             return np.empty(0)
+        # candidate batches are padded to a 1024 multiple so sweeps
+        # with varying photon counts reuse ONE compiled density per
+        # branch instead of retracing at every distinct n
+        n_pad = -(-n // 1024) * 1024
         params = jnp.asarray(self.get_parameters())
         if log10_ens is None:
             density = self._rand_jitted("noe", lambda c, p: self(c, p))
@@ -116,7 +134,7 @@ class LCTemplate:
             )))
             out = []
             while len(out) < n:
-                cand = rng.uniform(size=2 * n)
+                cand = rng.uniform(size=2 * n_pad)
                 f = np.asarray(density(jnp.asarray(cand), params))
                 f_hi = float(np.max(f, initial=0.0))
                 if f_hi > fmax:
@@ -125,12 +143,9 @@ class LCTemplate:
                     fmax = 1.1 * f_hi
                     out = []
                     continue
-                keep = rng.uniform(size=2 * n) * fmax < f
+                keep = rng.uniform(size=2 * n_pad) * fmax < f
                 out.extend(cand[keep].tolist())
             return np.asarray(out[:n])
-        u = np.asarray(log10_ens, dtype=np.float64)
-        if u.shape != (n,):
-            raise ValueError("log10_ens must have length n")
         grid = np.linspace(0, 1, 512)
         # density envelope at EVERY photon's energy (chunked so the
         # working array stays (1024, 512)): an interior-energy
@@ -161,12 +176,17 @@ class LCTemplate:
         density = self._rand_jitted(
             "en", lambda c, uu, p: self(c, p, log10_ens=uu)
         )
-        u_dev = jnp.asarray(u)
+        u_dev = jnp.asarray(
+            np.concatenate([u, np.full(n_pad - n, u[-1])])
+        )
         phases = np.empty(n)
         todo = np.ones(n, dtype=bool)
         while todo.any():
-            cand = rng.uniform(size=n)
-            f = np.asarray(density(jnp.asarray(cand), u_dev, params))
+            cand = rng.uniform(size=n_pad)
+            f = np.asarray(
+                density(jnp.asarray(cand), u_dev, params)
+            )[:n]
+            cand = cand[:n]
             # envelope check over ALL slots: a completed slot whose
             # fresh density exceeds fmax is evidence its earlier
             # acceptance ran under a too-low envelope — restart

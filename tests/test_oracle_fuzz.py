@@ -296,6 +296,11 @@ def _draw_env(rng, tmp_path):
         )
         extra.append(f"TZRSITE {site}")
         extra.append("TZRFRQ 1400.0")
+    # UNITS TCB is decided here but APPLIED in _compose_pulsar, gated
+    # on the drawn composition staying inside the oracle's strict TCB
+    # conversion surface (OraclePulsar._TCB_OK refuses anything it has
+    # no dimension convention for, by design)
+    ing["want_tcb"] = rng.random() < 0.2
     ing["par_lines"] = extra
     return ing
 
@@ -356,6 +361,45 @@ def _compose_pulsar(rng, tmp_path, sim_seed, stem="fuzz", strip=(),
     if extra_lines:
         par_text = (par_text.rstrip("\n") + "\n"
                     + "\n".join(extra_lines) + "\n")
+    if ingest is not None and ingest.get("want_tcb"):
+        # UNITS TCB compositions are RESTRICTED to the conversion
+        # surface both sides own a dimension convention for
+        # (OraclePulsar._TCB_OK is strict by design — it refuses keys
+        # rather than silently leaving a TCB-sensitive family
+        # unconverted): unsupported lines are stripped, and if any
+        # binary parameter falls outside the surface the whole binary
+        # block goes (a DDK without KIN is not a model).  This keeps
+        # TCB coverage GUARANTEED on ~1-in-5 compositions (spin +
+        # astrometry + DM + allowlisted binaries + white noise through
+        # the full drawn ingest environment), vs golden23's single
+        # hand-built set before r5.
+        import re
+
+        from oracle.mp_pipeline import OraclePulsar
+
+        def ok(k):
+            return (k in OraclePulsar._TCB_OK
+                    or re.fullmatch(r"F\d+", k))
+
+        lines = [ln for ln in par_text.splitlines() if ln.split()]
+        keys = [ln.split()[0] for ln in lines]
+        binary_block = {
+            "BINARY", "PB", "A1", "T0", "TASC", "EPS1", "EPS2",
+            "ECC", "OM", "OMDOT", "GAMMA", "M2", "MTOT", "SINI",
+            "H3", "STIGMA", "SHAPMAX", "KIN", "KOM", "LNEDOT",
+            "EDOT", "PBDOT", "A1DOT",
+        }
+        if "ELONG" not in keys:  # stripping ecliptic astrometry would
+            # leave NO astrometry at all — those compositions keep
+            # their full surface and skip TCB instead
+            drop_binary = any(
+                k in binary_block and not ok(k) for k in keys
+            )
+            kept = [
+                ln for ln, k in zip(lines, keys)
+                if ok(k) and not (drop_binary and k in binary_block)
+            ]
+            par_text = "\n".join(kept) + "\nUNITS TCB\n"
     par = tmp_path / f"{stem}.par"
     tim = tmp_path / f"{stem}.tim"
     par.write_text(par_text)
@@ -508,6 +552,8 @@ def test_oracle_fuzz_wideband_fit(seed, case, tmp_path):
 
     rng = np.random.default_rng([seed, 2000 + case])
     ing = _draw_env(np.random.default_rng([seed, 7000 + case]), tmp_path)
+    ing["want_tcb"] = False  # DMJUMP/DMEFAC/DMEQUAD are outside the
+    # TCB conversion surface, and the test asserts a free DMJUMP
     extra = [f"DMJUMP -f L-wide {rng.normal(0, 2e-3):.4e} 1"]
     if rng.random() < 0.5:
         extra.append(f"DMEFAC -f S-wide {rng.uniform(0.8, 1.4):.3f}")
