@@ -20,7 +20,6 @@ narrowband.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from pint_tpu.parallel.gls import (

@@ -15,7 +15,6 @@ import jax
 import numpy as np
 import pytest
 
-from pint_tpu.fitting.base import design_with_offset  # noqa: F401
 from pint_tpu.fitting.gls import (
     gls_step_woodbury, gls_step_woodbury_mixed,
 )
