@@ -90,6 +90,79 @@ class InvalidModelParameters(PintTpuError):
     """A proposed step produced non-finite / unphysical parameters."""
 
 
+class PintTpuNumericsError(ConvergenceFailure):
+    """A device computation produced non-finite values (NaN/Inf).
+
+    Raised by the shared finite-state validator
+    (runtime/guard.py::validate_finite) with a ``diagnosis`` mapping
+    the symptom onto the known emulated-f64 hazard taxonomy
+    (docs/precision.md / docs/robustness.md): exponent-range overflow,
+    subnormal flush, scalar-transcendental path.  Subclasses
+    ConvergenceFailure so pre-existing except clauses around fitters
+    keep working."""
+
+    def __init__(self, msg, diagnosis=None):
+        self.diagnosis = diagnosis
+        super().__init__(msg)
+
+
+class GuardTimeout(PintTpuError):
+    """A guarded compile/dispatch exceeded its watchdog timeout
+    (runtime/guard.py) — the axon tunnel can wedge silently, so this is
+    detected by a host-side watchdog thread, not by the transport."""
+
+    def __init__(self, site="", timeout=None, msg=None):
+        self.site = site
+        self.timeout = timeout
+        super().__init__(
+            msg
+            or f"guarded call at {site or 'unknown site'} exceeded its "
+            f"{timeout}s watchdog (wedged compile/dispatch?)"
+        )
+
+
+class TransportRejection(PintTpuError):
+    """The remote-compile/dispatch transport rejected the request
+    deterministically (HTTP 413 class: payload too large).  Never
+    retried with the same payload — the fallback ladder re-lowers
+    instead (argument-fed operands / next rung)."""
+
+
+class TransientDispatchError(PintTpuError):
+    """A transient transport failure (injected by runtime/faults.py;
+    real tunnel errors arrive as foreign exception types and are
+    classified by runtime/guard.py::classify_error)."""
+
+
+class RetriesExhausted(PintTpuError):
+    """Bounded retries of a transient failure were exhausted."""
+
+    def __init__(self, site="", attempts=0, last=None):
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+        super().__init__(
+            f"guarded call at {site or 'unknown site'} still failing "
+            f"after {attempts} attempts (last: {last!r})"
+        )
+
+
+class LadderExhausted(ConvergenceFailure):
+    """Every rung of the degradation ladder (runtime/fallback.py)
+    tripped the guard.  ``history`` records (rung_name, error) pairs in
+    the order attempted — no rung ever returned a silently-wrong
+    result; they all failed loudly."""
+
+    def __init__(self, site="", history=()):
+        self.site = site
+        self.history = tuple(history)
+        rungs = "; ".join(f"{n}: {e}" for n, e in self.history)
+        super().__init__(
+            f"fallback ladder exhausted at {site or 'unknown site'} "
+            f"({len(self.history)} rungs tried: {rungs})"
+        )
+
+
 class CorrelatedErrors(PintTpuError):
     """Model has correlated noise but the fitter cannot handle it."""
 
@@ -106,6 +179,11 @@ class DegeneracyWarning(UserWarning):
 
 class ConvergenceWarning(UserWarning):
     """A fitter stopped without meeting its convergence tolerance."""
+
+
+class GuardTripWarning(UserWarning):
+    """The device-execution guard tripped on a fallback-ladder rung and
+    the computation was re-dispatched on the next rung."""
 
 
 class PropertyAttributeError(PintTpuError):

@@ -15,9 +15,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
+from pint_tpu.exceptions import DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.residuals import Residuals
+from pint_tpu.runtime.guard import ensure_scan_finite
 from pint_tpu.toas.toas import TOAs
 
 
@@ -121,6 +122,9 @@ class Fitter:
         # compiled scan fit loops, keyed per-fitter (mode/maxiter/tol);
         # here so _finish_scan_fit is self-contained for any subclass
         self._fit_loops: dict = {}
+        # which fallback-ladder rung served the last fit
+        # (runtime/fallback.py::GuardReport; None before any fit)
+        self.guard_report = None
 
     @property
     def _noffset(self):
@@ -157,8 +161,13 @@ class Fitter:
         nbads = np.asarray(nbads)
         for nb in nbads[nbads > 0]:
             warnings.warn(f"{int(nb)} {warn_msg}", DegeneracyWarning)
-        if np.any(np.asarray(bads)):
-            raise ConvergenceFailure(fail_msg)
+        # the SHARED non-finite refusal (runtime/guard.py): a NaN fit
+        # raises a diagnosed PintTpuNumericsError (a ConvergenceFailure
+        # subclass) instead of committing garbage.  When the fit came
+        # through the fallback ladder this has already passed once per
+        # rung; here it is the safety net for direct callers.
+        ensure_scan_finite(result, fail_msg,
+                           site=f"fit:{type(self).__name__}")
         self.converged = bool(conv)
         chi2 = self._finalize(x, cov, float(chi2))
         return chi2

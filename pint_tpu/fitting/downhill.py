@@ -23,7 +23,9 @@ import numpy as np
 from pint_tpu.exceptions import (
     ConvergenceWarning,
     DegeneracyWarning,
+    GuardTripWarning,
     InvalidModelParameters,
+    PintTpuNumericsError,
 )
 from pint_tpu.fitting.base import Fitter
 from pint_tpu.fitting.gls import (
@@ -34,6 +36,7 @@ from pint_tpu.fitting.gls import (
     make_cinv_mult,
 )
 from pint_tpu.fitting.wls import _wls_step
+from pint_tpu.runtime.guard import validate_finite
 
 
 class DownhillFitter(Fitter):
@@ -44,11 +47,43 @@ class DownhillFitter(Fitter):
     method = "downhill"
 
     # subclasses override ------------------------------------------------
-    def _make_proposal(self):
+    def _make_proposal(self, force_f64: bool = False):
+        """Proposal kernel; ``force_f64=True`` is the guard's fallback
+        rung — the all-f64 step path on subclasses whose native
+        proposal is mixed-precision (a no-op re-dispatch otherwise)."""
         raise NotImplementedError
 
     def _make_chi2(self):
         raise NotImplementedError
+
+    def _guarded_proposal(self, proposal, x, fell_back: bool):
+        """Dispatch + validate one proposal (runtime/guard.py shared
+        validator).  A non-finite proposal falls back ONCE to the
+        all-f64 step (the downhill sibling of the fit-loop ladder in
+        runtime/fallback.py — the chi2 acceptance ladder downstream
+        still gates every step, so no injected or real fault can slip
+        a wrong step through silently).  Returns
+        (dx, cov, nbad, pred, proposal, fell_back)."""
+        site = f"downhill:{type(self).__name__}/proposal"
+        dx, cov, nbad, pred = proposal(x)
+        try:
+            validate_finite({"dx": dx, "pred": pred}, site=site,
+                            what="downhill proposal")
+        except PintTpuNumericsError:
+            if fell_back:
+                raise
+            warnings.warn(
+                "downhill proposal produced non-finite values; "
+                "falling back to the all-f64 proposal step",
+                GuardTripWarning,
+            )
+            proposal = self._make_proposal(force_f64=True)
+            fell_back = True
+            dx, cov, nbad, pred = proposal(x)
+            validate_finite({"dx": dx, "pred": pred},
+                            site=site + "/rung:f64",
+                            what="downhill proposal")
+        return dx, cov, nbad, pred, proposal, fell_back
 
     # --------------------------------------------------------------------
     @staticmethod
@@ -157,8 +192,11 @@ class DownhillFitter(Fitter):
         self.converged = False
         self.last_noise_floor = 0.0
         step_problem = False
+        fell_back = False
         for it in range(maxiter):
-            dx, cov, nbad, pred = proposal(x)
+            dx, cov, nbad, pred, proposal, fell_back = (
+                self._guarded_proposal(proposal, x, fell_back)
+            )
             if int(nbad):
                 warnings.warn(
                     f"{int(nbad)} degenerate directions zeroed in downhill "
@@ -169,6 +207,15 @@ class DownhillFitter(Fitter):
             c_tries = c_all[: len(lams)]
             # same-program baseline at the current x (see ladder note)
             chi2 = float(c_all[-1])
+            if not np.isfinite(chi2):
+                # trial lambdas may legally overshoot into NaN, but a
+                # non-finite BASELINE means the accepted state itself
+                # is poisoned — refuse with the shared diagnosis
+                validate_finite(
+                    {"chi2_baseline": chi2},
+                    site=f"downhill:{type(self).__name__}/baseline",
+                    what="downhill chi2 baseline",
+                )
             # floor re-measured from THIS ladder at THIS x, so the
             # tolerance tracks the shrinking residuals (ADVICE r3)
             noise_floor = self._chi2_noise_floor(
@@ -222,6 +269,13 @@ class DownhillFitter(Fitter):
         # covariance at the FINAL accepted state (the loop's cov is one
         # Gauss-Newton step stale for x-dependent sigmas/designs)
         _, cov, _, _ = proposal(x)
+        from pint_tpu.runtime.fallback import GuardReport
+
+        self.guard_report = GuardReport(
+            site=f"downhill:{type(self).__name__}",
+            rung="f64-fallback" if fell_back else "native",
+            rung_index=1 if fell_back else 0,
+        )
         return self._finalize(x, cov, float(chi2))
 
 
@@ -235,7 +289,9 @@ class DownhillWLSFitter(DownhillFitter):
 
             raise CorrelatedErrors(model)
 
-    def _make_proposal(self):
+    def _make_proposal(self, force_f64: bool = False):
+        # force_f64 is a no-op here: the WLS QR/SVD step is already the
+        # f64 path, so the guard's fallback is a clean re-dispatch
         cm, noffset = self.cm, self._noffset
 
         @cm.jit
@@ -269,12 +325,16 @@ class DownhillGLSFitter(DownhillFitter):
         T, phi = self.cm.noise_basis_or_empty(x)
         return Ndiag, T, phi
 
-    def _make_proposal(self):
+    def _make_proposal(self, force_f64: bool = False):
         cm, noffset, full_cov = self.cm, self._noffset, self.full_cov
         # proposal DIRECTION quality is all that matters here (the
         # vmapped chi2 ladder still gates acceptance), so the
-        # accelerator mixed path applies (GLSFitter's policy)
-        if full_cov:
+        # accelerator mixed path applies (GLSFitter's policy);
+        # force_f64 is the guard's fallback rung — the all-f64
+        # reduced-rank Woodbury step
+        if force_f64:
+            step = gls_step_woodbury
+        elif full_cov:
             step = gls_step_full_cov
         elif default_accel_mode(cm) == "mixed":
             step = gls_step_woodbury_mixed

@@ -330,6 +330,11 @@ class GLSFitter(Fitter):
     fused=True opts into the Pallas streaming-basis path (see
     gls_step_woodbury_fourier's accuracy note), fused='mixed' forces
     the mixed path on any backend (used by cross-path tests).
+
+    fit_toas dispatches the compiled scan loop through the runtime
+    degradation ladder (runtime/fallback.py: native mode -> all-f64 ->
+    CPU re-dispatch); ``self.guard_report`` records which rung served
+    the result and what tripped on the way down.
     """
 
     def __init__(self, toas: TOAs, model: TimingModel,
@@ -459,11 +464,27 @@ class GLSFitter(Fitter):
                      or jax.default_backend() == "cpu")
             )
             tol_chi2 = 1e-10 if exact else 3e-6
-        key = (mode, maxiter, tol_chi2)
-        if key not in self._fit_loops:
-            self._fit_loops[key] = self._make_fit_loop(*key)
+        from pint_tpu.runtime.fallback import run_fit_ladder
+
+        def make_loop(rung_mode):
+            # rung modes: the native mode first, then the all-f64
+            # reduced-rank Woodbury path ('f64' — also the f64 rung for
+            # full_cov fits: algebraically the same C = N + T phi T^T
+            # model through a hazard-free factorization), then the
+            # 'cpu' rung re-dispatching the f64 loop under the
+            # ladder-device pin (runtime/fallback.py).
+            key = (rung_mode, maxiter, tol_chi2)
+            if key not in self._fit_loops:
+                self._fit_loops[key] = self._make_fit_loop(*key)
+            return self._fit_loops[key]
+
+        result, self.guard_report = run_fit_ladder(
+            self.cm, mode, make_loop,
+            site=f"fit:{type(self).__name__}",
+            fail_msg="non-finite chi2 during GLS fit",
+        )
         return self._finish_scan_fit(
-            self._fit_loops[key](self.cm.x0()),
+            result,
             "degenerate normal-equation directions zeroed in GLS solve",
             "non-finite chi2 during GLS fit",
         )

@@ -176,11 +176,15 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
         super().__init__(toas, model)
         self.full_cov = full_cov
 
-    def _make_proposal(self):
+    def _make_proposal(self, force_f64: bool = False):
         noffset, full_cov = self._noffset, self.full_cov
         # accelerator mixed proposals, as in DownhillGLSFitter (the
-        # chi2 ladder still gates acceptance)
-        if full_cov:
+        # chi2 ladder still gates acceptance); force_f64 is the
+        # guard's fallback rung (all-f64 Woodbury over the stacked
+        # [TOA; DM] system)
+        if force_f64:
+            fn = gls_step_woodbury
+        elif full_cov:
             fn = gls_step_full_cov
         elif default_accel_mode(self.cm) == "mixed":
             fn = gls_step_woodbury_mixed

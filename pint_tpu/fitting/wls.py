@@ -162,14 +162,31 @@ class WLSFitter(Fitter):
             from pint_tpu.exceptions import CorrelatedErrors
 
             raise CorrelatedErrors(self.model)
-        key = (maxiter, tol_chi2)
-        if key not in self._fit_loops:
-            self._fit_loops[key] = self._make_fit_loop(*key)
+        from pint_tpu.runtime.fallback import run_fit_ladder
+
+        def make_loop(rung_mode):
+            # the WLS solve method is resolved inside _make_fit_loop
+            # (QR on accelerators, SVD on CPU) and IS already the f64
+            # path, so every rung reuses the same loop; the final
+            # 'cpu' rung re-dispatches it under the ladder-device pin
+            # (IEEE f64 on accelerator backends; a clean re-dispatch
+            # on CPU ones).
+            key = (maxiter, tol_chi2)
+            if key not in self._fit_loops:
+                self._fit_loops[key] = self._make_fit_loop(*key)
+            return self._fit_loops[key]
+
+        result, self.guard_report = run_fit_ladder(
+            self.cm, default_wls_method(), make_loop,
+            site=f"fit:{type(self).__name__}",
+            fail_msg="non-finite chi2 during WLS fit",
+            f64_rung=False,
+        )
         # parameter covariance comes back in free_names order (offset
         # row/col dropped in _finalize, matching the reference's
         # parameter_covariance_matrix without Offset)
         return self._finish_scan_fit(
-            self._fit_loops[key](self.cm.x0()),
+            result,
             "degenerate design-matrix directions zeroed in WLS solve "
             f"(method={self._wls_method}; threshold is backend-dependent"
             " — see docs/precision.md)",

@@ -410,9 +410,22 @@ class CompiledModel:
         modules long before the 200k default — the n=32768 dense step
         (~16 MB of baked literals) stopped compiling in useful time on
         the axon tunnel in r5 while its argument-fed form compiles in
-        seconds."""
+        seconds.
+
+        Every wrapper returned here is the framework's dispatch
+        chokepoint, so it carries the device-execution guard
+        (runtime/guard.py::dispatch_guard): watchdog timeouts for
+        wedged remote compiles, bounded retries for transient
+        transport errors, and the fault-injection hooks — one wrap
+        covers every fitter, bench, and profiling dispatch.  Calls
+        made inside another trace (vmap/jit) bypass the guard and
+        inline as before."""
         import functools
         import os
+
+        from pint_tpu.runtime.guard import dispatch_guard
+
+        site = f"cm.jit:{getattr(fn, '__name__', 'fn')}"
 
         threshold = int(
             os.environ.get("PINT_TPU_BAKE_THRESHOLD", "200000")
@@ -470,7 +483,7 @@ class CompiledModel:
             rebaking.lower = lambda *args: _jitted().lower(
                 self._ref_runtime(), *args
             )
-            return rebaking
+            return dispatch_guard(rebaking, site)
 
         @jax.jit
         def inner(bundles, refnum, args):
@@ -492,7 +505,7 @@ class CompiledModel:
         wrapped.lower = lambda *args: inner.lower(
             (self.bundle, self.tzr_bundle), self._ref_runtime(), args
         )
-        return wrapped
+        return dispatch_guard(wrapped, site)
 
     # -- pdict construction (inside trace) --------------------------------
     def _pdict(self, x):

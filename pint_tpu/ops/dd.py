@@ -41,6 +41,43 @@ def _ob(x):
     return jax.lax.optimization_barrier(x)
 
 
+# -- compat: optimization_barrier transform rules -------------------------
+# Some jax versions ship optimization_barrier with no vmap/JVP/transpose
+# registrations, which breaks every transformed path through DD math
+# (the vmapped downhill chi2 ladder, PTA batching, jacfwd fallbacks
+# that reach a non-custom-jvp EFT).  The barrier is semantically the
+# identity, so the missing rules are mechanical: batch by passing
+# operands through, differentiate by barriering the tangents,
+# transpose by passing cotangents back.  Registered only when absent
+# (newer jax versions define these upstream).
+def _register_ob_transform_rules():
+    from jax.interpreters import ad, batching
+
+    p = jax.lax.optimization_barrier_p
+
+    if p not in batching.primitive_batchers:
+        def _ob_batcher(batched_args, batch_dims, **params):
+            return p.bind(*batched_args, **params), batch_dims
+
+        batching.primitive_batchers[p] = _ob_batcher
+
+    if p not in ad.primitive_jvps:
+        def _ob_jvp(primals, tangents):
+            tangents = [ad.instantiate_zeros(t) for t in tangents]
+            return p.bind(*primals), p.bind(*tangents)
+
+        ad.primitive_jvps[p] = _ob_jvp
+
+    if p not in ad.primitive_transposes:
+        def _ob_transpose(cts, *primals):
+            return cts
+
+        ad.primitive_transposes[p] = _ob_transpose
+
+
+_register_ob_transform_rules()
+
+
 def _two_sum(a, b):
     """s + err == a + b exactly, s = fl(a+b)."""
     s = _ob(a + b)
@@ -117,6 +154,33 @@ def _dd_mul_core_jvp(primals, tangents):
     out = _dd_mul_core(*primals)
     tahi, talo, tbhi, tblo = tangents
     t = (ahi + alo) * (tbhi + tblo) + (bhi + blo) * (tahi + talo)
+    t = jnp.broadcast_to(t, jnp.shape(out[0]))
+    return out, (t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def _dd_div_core(ahi, alo, bhi, blo):
+    # three-step long division (the classic dd_real algorithm): each
+    # partial quotient is the f64 quotient of the running remainder,
+    # computed with the exact EFT sub/mul cores above
+    a, b = DD(ahi, alo), DD(bhi, blo)
+    q1 = ahi / bhi
+    r = a - b * q1
+    q2 = r.hi / bhi
+    r = r - b * q2
+    q3 = r.hi / bhi
+    s, e = _quick_two_sum(q1, q2)
+    return _quick_two_sum(s, e + q3)
+
+
+@_dd_div_core.defjvp
+def _dd_div_core_jvp(primals, tangents):
+    ahi, alo, bhi, blo = primals
+    out = _dd_div_core(*primals)
+    tahi, talo, tbhi, tblo = tangents
+    b = bhi + blo
+    q = out[0] + out[1]
+    t = ((tahi + talo) - q * (tbhi + tblo)) / b
     t = jnp.broadcast_to(t, jnp.shape(out[0]))
     return out, (t, jnp.zeros_like(t))
 
@@ -245,13 +309,7 @@ class DD(NamedTuple):
     def __truediv__(self, other) -> "DD":
         if not isinstance(other, DD):
             other = DD.from_float(other)
-        q1 = self.hi / other.hi
-        r = self - other * q1
-        q2 = r.hi / other.hi
-        r = r - other * q2
-        q3 = r.hi / other.hi
-        s, e = _quick_two_sum(q1, q2)
-        return DD(*_quick_two_sum(s, e + q3))
+        return DD(*_dd_div_core(self.hi, self.lo, other.hi, other.lo))
 
     def __rtruediv__(self, other) -> "DD":
         return DD.from_float(other) / self

@@ -31,6 +31,14 @@ import numpy as np
 
 from jax.experimental import pallas as pl
 
+# jax.enable_x64 moved out of jax.experimental in later releases;
+# accept either home so the x64-off trace context works across the
+# versions this repo meets (CLAUDE.md: Mosaic cannot legalize the
+# int64 grid indices global x64 mode would produce)
+_enable_x64 = getattr(jax, "enable_x64", None)
+if _enable_x64 is None:  # pre-move jax
+    from jax.experimental import enable_x64 as _enable_x64
+
 _TWO_PI = 2.0 * math.pi
 
 
@@ -88,7 +96,13 @@ def fourier_gram(t, freqs, w, X, block: int = 8192):
     Traced under enable_x64(False): Mosaic cannot legalize the int64
     grid indices that global x64 mode would produce.
     """
-    with jax.enable_x64(False):
+    # cast BEFORE entering the x64-off context: inside it some jax
+    # versions elide the f64->f32 convert (target and operand dtypes
+    # canonicalize equal), leaving raw-f64 operands in f32 ops
+    t, freqs, w, X = (
+        a.astype(jnp.float32) for a in (t, freqs, w, X)
+    )
+    with _enable_x64(False):
         return _fourier_gram_32(t, freqs, w, X, block)
 
 
@@ -137,7 +151,13 @@ def _fourier_gram_32(t, freqs, w, X, block):
     # padded harmonic rows are zero (sin(0 * t) = 0 rows cross terms...
     # cos rows of padded harmonics are 1-rows, but they only land in
     # the padded index range, which is sliced away here)
-    idx = np.concatenate([np.arange(k), k_pad + np.arange(k)])
+    # int32 indices: this slice still traces under enable_x64(False),
+    # where i64 gather indices fail stablehlo verification on some
+    # jax versions (mixed i64/i32 bounds compare)
+    idx = np.concatenate(
+        [np.arange(k, dtype=np.int32),
+         np.int32(k_pad) + np.arange(k, dtype=np.int32)]
+    )
     return sig[np.ix_(idx, idx)], twx[idx, :p]
 
 
@@ -159,7 +179,9 @@ def _apply_kernel(t_ref, z_ref, f_ref, y_ref):
 def fourier_apply(t, freqs, z, block: int = 8192):
     """y (n, m) = T z for T = [sin | cos] basis, without materializing
     T; z (2k, m)."""
-    with jax.enable_x64(False):
+    # pre-context f32 cast: see fourier_gram
+    t, freqs, z = (a.astype(jnp.float32) for a in (t, freqs, z))
+    with _enable_x64(False):
         return _fourier_apply_32(t, freqs, z, block)
 
 

@@ -42,7 +42,10 @@ def sharded_gls_step(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     unnormalize cov = covn/outer(norm, norm) on the HOST — stiff-column
     variances underflow on device (fitting/gls.py::_finish_normal_eqs).
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.x-alias jax: experimental home
+        from jax.experimental.shard_map import shard_map
 
     def local_blocks(r_s, M_s, Nd_s, T_s):
         """Per-shard partial sums; psum makes them global."""
@@ -100,7 +103,10 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     (_woodbury_mixed_tail; chunk-level f64 accumulation happens within
     each shard, and the cross-shard psum is f64).
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.x-alias jax: experimental home
+        from jax.experimental.shard_map import shard_map
 
     from pint_tpu.fitting.gls import _column_norms
     from pint_tpu.fitting.gls import _woodbury_mixed_tail
@@ -128,6 +134,54 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     sig_tt, twx, G_XX = sm(r, Mn, Ndiag, T)
     return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
                                 normalized_cov)
+
+
+def guarded_sharded_gls_step(mesh, r, M, Ndiag, T, phi,
+                             axis: str = "toa", normalized_cov=False):
+    """One sharded GLS step dispatched under the device-execution
+    guard (runtime/): watchdog + transient-retry at the dispatch
+    (runtime/guard.py::dispatch_guard), post-step finite validation,
+    and a two-rung degradation ladder mixed -> f64 on accelerator
+    meshes (a sharded step cannot leave its mesh, so there is no CPU
+    rung here; on CPU meshes the second rung is a clean re-dispatch of
+    the f64 collective path).  Returns ((dx, cov, chi2, nbad),
+    GuardReport)."""
+    from pint_tpu.runtime.fallback import run_ladder
+    from pint_tpu.runtime.guard import dispatch_guard, validate_finite
+
+    def make_thunk(step_fn, name):
+        fn = dispatch_guard(
+            jax.jit(
+                lambda *ops: step_fn(
+                    mesh, *ops, axis=axis, normalized_cov=normalized_cov
+                )
+            ),
+            site=f"parallel.gls:{name}",
+        )
+
+        def thunk(rung_site):
+            return fn(r, M, Ndiag, T, phi)
+
+        return thunk
+
+    if jax.default_backend() != "cpu":
+        rungs = [
+            ("tpu-mixed", make_thunk(sharded_gls_step_mixed, "mixed")),
+            ("tpu-f64", make_thunk(sharded_gls_step, "f64")),
+        ]
+    else:
+        rungs = [
+            ("cpu-f64", make_thunk(sharded_gls_step, "f64")),
+            ("cpu-f64-retry", make_thunk(sharded_gls_step, "f64b")),
+        ]
+
+    def validate(out, rung_site):
+        dx, _cov, chi2, _nbad = out
+        validate_finite({"dx": dx, "chi2": chi2}, site=rung_site,
+                        what="sharded GLS step")
+
+    return run_ladder(rungs, site="parallel.gls.step",
+                      validate=validate)
 
 
 def place_gls_operands(mesh, r, M, Ndiag, T, phi, axis: str = "toa"):

@@ -1,0 +1,40 @@
+"""Device-execution guard for the axon dispatch path.
+
+Every documented failure mode of the remote TPU tunnel — wedged
+compiles, HTTP 413 transport rejections, transient dispatch errors,
+emulated-f64 NaN/flush hazards — is detected, retried, and degraded
+here instead of by per-call-site workarounds:
+
+- :mod:`pint_tpu.runtime.guard` — the ``guarded_call`` supervisor
+  (thread-based watchdog, bounded retries with backoff+jitter) and the
+  SHARED finite-state validator with a structured emulated-f64 hazard
+  diagnosis; ``CompiledModel.jit`` wraps every dispatch in it.
+- :mod:`pint_tpu.runtime.fallback` — the TPU-mixed -> TPU-f64 -> CPU
+  degradation ladder; fitters run their compiled scan loops through it
+  and record which rung served the result (``fitter.guard_report``).
+- :mod:`pint_tpu.runtime.faults` — deterministic fault injection
+  (``$PINT_TPU_FAULTS`` / ``faults.inject``) so the whole ladder is
+  testable on the CPU mesh where none of these faults occur naturally.
+
+Design notes and the failure taxonomy live in docs/robustness.md.
+"""
+
+from pint_tpu.runtime import faults  # noqa: F401
+from pint_tpu.runtime.fallback import (  # noqa: F401
+    GuardReport,
+    fit_rungs,
+    run_fit_ladder,
+    run_ladder,
+)
+from pint_tpu.runtime.guard import (  # noqa: F401
+    STATS,
+    GuardConfig,
+    NumericsDiagnosis,
+    configured,
+    diagnose_nonfinite,
+    disabled,
+    dispatch_guard,
+    ensure_scan_finite,
+    guarded_call,
+    validate_finite,
+)

@@ -1,0 +1,159 @@
+"""Degradation ladder: TPU-mixed -> TPU-f64 -> CPU.
+
+When the guard trips on a dispatch (watchdog timeout, transport
+rejection, retries exhausted, or a diagnosed non-finite result), the
+ladder re-dispatches the SAME step on the next rung and records which
+rung finally served the result:
+
+1. the production accelerator mode (mixed-precision f32 MXU Grams —
+   fitting/gls.py::default_accel_mode),
+2. the all-f64 XLA path on the same backend (slower — emulated f64 —
+   but avoids every f32-Gram/eigh hazard and most transport weight),
+3. a CPU re-dispatch pinned via the guard's ladder-device context
+   (IEEE f64: the rung of last resort; on accelerator backends this
+   recompiles the same program for host CPU — uncommitted operands
+   follow the pin, explicitly device-committed bundles keep their
+   placement).
+
+On a CPU backend the ladder degenerates to [cpu-<mode>, cpu]: the
+final rung is a clean re-dispatch of the same IEEE-f64 program on an
+explicitly pinned device — still worth one rung (a transient fault or
+an injected one clears), and it is what lets the CPU test suite
+exercise the full fall-through deterministically
+(tests/test_runtime_guard.py).
+
+No rung ever returns a silently-wrong result: every rung's output goes
+through the shared finite validator before it is accepted, and an
+exhausted ladder raises :class:`LadderExhausted` carrying the full
+(rung, error) history.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    GuardTripWarning,
+    LadderExhausted,
+    PintTpuNumericsError,
+    RetriesExhausted,
+    TransportRejection,
+)
+from pint_tpu.runtime import guard
+
+#: guard trips that drop a rung; anything else (shape errors, user
+#: bugs) propagates immediately — degrading can't fix a wrong program.
+TRIP_ERRORS = (
+    GuardTimeout,
+    TransportRejection,
+    RetriesExhausted,
+    PintTpuNumericsError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardReport:
+    """Which rung served a laddered computation, and what tripped on
+    the way down.  ``history`` is ((rung_name, 'ExcType: msg'), ...)
+    for the rungs that failed before ``rung`` succeeded."""
+
+    site: str
+    rung: str
+    rung_index: int
+    history: tuple = ()
+
+    @property
+    def fell_back(self) -> bool:
+        return self.rung_index > 0
+
+    def to_dict(self) -> dict:
+        return {
+            "site": self.site,
+            "rung": self.rung,
+            "rung_index": self.rung_index,
+            "history": [list(h) for h in self.history],
+        }
+
+
+def run_ladder(rungs, site: str, validate=None):
+    """Try ``rungs`` = [(name, thunk), ...] in order.
+
+    ``thunk(rung_site)`` performs the dispatch (its inner cm.jit /
+    jax.jit wrapper carries the watchdog+retry guard — the ladder adds
+    no second supervision layer); ``validate(result, rung_site)``
+    raises PintTpuNumericsError to reject a rung's output.  Returns
+    (result, GuardReport).  Raises LadderExhausted when every rung
+    trips."""
+    history = []
+    for i, (name, thunk) in enumerate(rungs):
+        rung_site = f"{site}/rung:{name}"
+        try:
+            out = thunk(rung_site)
+            if validate is not None:
+                validate(out, rung_site)
+            return out, GuardReport(
+                site=site, rung=name, rung_index=i,
+                history=tuple(history),
+            )
+        except TRIP_ERRORS as e:
+            history.append((name, f"{type(e).__name__}: {e}"))
+            guard.STATS.bump("fallbacks")
+            if i + 1 < len(rungs):
+                warnings.warn(
+                    f"guard tripped on rung {name!r} at {site} "
+                    f"({type(e).__name__}); falling back to rung "
+                    f"{rungs[i + 1][0]!r}",
+                    GuardTripWarning,
+                )
+    raise LadderExhausted(site, history)
+
+
+def fit_rungs(mode: str, backend: str | None = None,
+              f64_rung: bool = True):
+    """The rung sequence [(name, rung_mode, pin_cpu), ...] for a fit of
+    the given native mode.  ``f64_rung=False`` skips the intermediate
+    all-f64 rung (WLS: its one solve method IS already the f64 path)."""
+    backend = backend or jax.default_backend()
+    seq = [(f"{backend}-{mode}", mode, False)]
+    if f64_rung and mode != "f64":
+        seq.append((f"{backend}-f64", "f64", False))
+    seq.append(("cpu", "f64" if f64_rung else mode, True))
+    return seq
+
+
+def run_fit_ladder(cm, mode: str, make_loop, site: str, fail_msg: str,
+                   f64_rung: bool = True):
+    """Run a compiled scan fit loop down the degradation ladder.
+
+    ``make_loop(rung_mode)`` returns the compiled loop for a rung's
+    mode (fitters cache these per (mode, maxiter, tol)); the CPU rung
+    reuses the f64 loop under the guard's ladder-device pin, which
+    recompiles it for host CPU (jax's default_device is part of the
+    jit key).  Validation is the shared scan-result check — the same
+    refusal production fit_toas applies — so a rung that froze on
+    non-finite chi2, or whose final state is NaN, drops through."""
+
+    def build(rmode, pin):
+        def thunk(rung_site):
+            loop = make_loop(rmode)
+            if pin:
+                with guard.ladder_device(jax.devices("cpu")[0]):
+                    return loop(cm.x0())
+            return loop(cm.x0())
+
+        return thunk
+
+    rungs = [
+        (name, build(rmode, pin))
+        for name, rmode, pin in fit_rungs(mode, f64_rung=f64_rung)
+    ]
+    return run_ladder(
+        rungs, site,
+        validate=lambda res, s: guard.ensure_scan_finite(
+            res, fail_msg, s
+        ),
+    )

@@ -1,0 +1,220 @@
+"""Deterministic fault injection for the device-execution guard.
+
+None of the axon failure modes the guard defends against — wedged
+remote compiles, HTTP 413 transport rejections, transient tunnel
+errors, emulated-f64 NaN outputs — occur naturally on the CPU test
+mesh, so the watchdog/retry/fallback ladder would otherwise ship
+untested.  This module simulates them deterministically at the guard's
+own hook points (tests/test_runtime_guard.py exercises the whole
+ladder on CPU with it).
+
+Activation
+----------
+- Env var ``PINT_TPU_FAULTS`` (read per guarded call, so test runners
+  can set it per-process), or
+- the :func:`inject` context manager (the test API — scoped, and
+  leftover un-fired counts are discarded on exit).
+
+Spec grammar (documented in docs/robustness.md)::
+
+    spec    := entry ("," entry)*
+    entry   := kind [":" count] ["@" site_substring]
+    kind    := "hang" | "413" | "transient" | "nan"
+
+Each entry arms ``count`` firings (default 1; ``inf`` = unlimited) of
+one fault kind, optionally restricted to guard sites whose name
+contains ``site_substring``.  Examples::
+
+    PINT_TPU_FAULTS="hang:1"            # first compile/dispatch wedges
+    PINT_TPU_FAULTS="transient:2@cm.jit"  # two tunnel errors, then clean
+    PINT_TPU_FAULTS="nan:inf@rung:tpu-mixed"  # the mixed rung always NaNs
+
+Fault semantics (each maps to one real axon failure mode):
+
+- ``hang``      — sleep ``hang_seconds`` inside the guarded attempt
+                  (simulated wedged remote compile; the watchdog must
+                  trip, CLAUDE.md's >40 min n=32768 case).
+- ``413``       — raise :class:`TransportRejection` before the dispatch
+                  (simulated oversized compile request; deterministic,
+                  so the guard must NOT retry — it falls back instead).
+- ``transient`` — raise :class:`TransientDispatchError` before the
+                  dispatch (simulated connection reset; retried with
+                  backoff on the same rung).
+- ``nan``       — poison the values passing through the shared finite
+                  validator with NaN (simulated emulated-f64 NaN step).
+                  Corruption only ever produces NaN — loud by
+                  construction — never a silently-wrong finite value.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.exceptions import (
+    PintTpuError,
+    TransientDispatchError,
+    TransportRejection,
+)
+
+KINDS = ("hang", "413", "transient", "nan")
+
+_DEFAULT_HANG_SECONDS = 30.0
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Entry:
+    kind: str
+    remaining: float  # inf = unlimited
+    site: str | None = None  # substring filter on the guard site name
+
+    def matches(self, kind: str, site: str) -> bool:
+        return (
+            self.kind == kind
+            and self.remaining > 0
+            and (self.site is None or self.site in site)
+        )
+
+
+@dataclass
+class FaultPlan:
+    """A parsed fault spec: an ordered list of armed fault entries."""
+
+    entries: list = field(default_factory=list)
+    hang_seconds: float = _DEFAULT_HANG_SECONDS
+    fired: list = field(default_factory=list)  # (kind, site) log
+
+    @classmethod
+    def parse(cls, spec: str, hang_seconds: float | None = None):
+        if hang_seconds is None:
+            hang_seconds = float(
+                os.environ.get(
+                    "PINT_TPU_FAULT_HANG_SECONDS", _DEFAULT_HANG_SECONDS
+                )
+            )
+        entries = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            body, _, site = raw.partition("@")
+            kind, _, count = body.partition(":")
+            kind = kind.strip()
+            if kind not in KINDS:
+                raise PintTpuError(
+                    f"unknown fault kind {kind!r} in PINT_TPU_FAULTS "
+                    f"spec {spec!r} (known: {', '.join(KINDS)})"
+                )
+            n = 1.0 if not count else (
+                float("inf") if count.strip() == "inf"
+                else float(int(count))
+            )
+            entries.append(_Entry(kind, n, site.strip() or None))
+        return cls(entries=entries, hang_seconds=hang_seconds)
+
+    def take(self, kind: str, site: str) -> bool:
+        """Consume one firing of ``kind`` at ``site`` if armed."""
+        for e in self.entries:
+            if e.matches(kind, site):
+                e.remaining -= 1
+                self.fired.append((kind, site))
+                return True
+        return False
+
+    def remaining(self, kind: str | None = None) -> float:
+        return sum(
+            e.remaining for e in self.entries
+            if kind is None or e.kind == kind
+        )
+
+
+# context-manager plans (test API); the env plan is cached separately
+_plans: list[FaultPlan] = []
+_env_cache: tuple[str, FaultPlan | None] = ("", None)
+
+
+def _env_plan() -> FaultPlan | None:
+    """The plan armed by $PINT_TPU_FAULTS, re-parsed when the env var
+    changes (so monkeypatched specs take effect mid-process)."""
+    global _env_cache
+    spec = os.environ.get("PINT_TPU_FAULTS", "")
+    if spec != _env_cache[0]:
+        _env_cache = (spec, FaultPlan.parse(spec) if spec else None)
+    return _env_cache[1]
+
+
+def _all_plans():
+    env = _env_plan()
+    return (_plans + [env]) if env is not None else list(_plans)
+
+
+def active() -> bool:
+    """True when any fault is still armed (guards use this to decide
+    whether the fault hooks need consulting at all)."""
+    return any(p.remaining() > 0 for p in _all_plans())
+
+
+def _take(kind: str, site: str) -> FaultPlan | None:
+    """Consume one firing of ``kind``; innermost context plan wins."""
+    with _lock:
+        for plan in reversed(_all_plans()):
+            if plan.take(kind, site):
+                return plan
+    return None
+
+
+@contextlib.contextmanager
+def inject(spec: str, hang_seconds: float | None = None):
+    """Arm a fault plan for the duration of the with-block (test API).
+
+    >>> with faults.inject("nan:1"):
+    ...     fitter.fit_toas()   # first rung NaNs, ladder recovers
+    """
+    plan = FaultPlan.parse(spec, hang_seconds=hang_seconds)
+    _plans.append(plan)
+    try:
+        yield plan
+    finally:
+        _plans.remove(plan)
+
+
+# -- hook points (called by runtime/guard.py) ----------------------------
+def maybe_hang(site: str) -> None:
+    """Simulated wedged compile: block inside the guarded attempt for
+    ``hang_seconds`` (long past any test watchdog), then continue."""
+    plan = _take("hang", site)
+    if plan is not None:
+        time.sleep(plan.hang_seconds)
+
+
+def maybe_raise(site: str) -> None:
+    """Simulated transport failures, raised before the dispatch runs."""
+    if _take("413", site) is not None:
+        raise TransportRejection(
+            f"injected fault at {site}: HTTP 413 request entity too "
+            "large (simulated oversized compile payload)"
+        )
+    if _take("transient", site) is not None:
+        raise TransientDispatchError(
+            f"injected fault at {site}: connection reset by peer "
+            "(simulated transient tunnel error)"
+        )
+
+
+def corrupt(mats: dict, site: str) -> dict:
+    """Simulated emulated-f64 NaN step: replace the validator's view of
+    the results with NaN (the originals are untouched — the validator
+    refuses the poisoned copy loudly, never returning it)."""
+    if _take("nan", site) is None:
+        return mats
+    return {
+        name: np.full(np.shape(a), np.nan, dtype=np.float64)
+        for name, a in mats.items()
+    }

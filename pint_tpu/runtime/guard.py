@@ -1,0 +1,537 @@
+"""Device-execution guard: watchdog, bounded retries, finite-state
+validation with a structured emulated-f64 hazard diagnosis.
+
+Every jitted dispatch in the framework funnels through
+``CompiledModel.jit`` (models/timing_model.py), which wraps its
+host-callable in :func:`dispatch_guard`.  The guard supervises each
+compile/dispatch with:
+
+- a **thread-based watchdog** — the axon remote-compile tunnel can
+  wedge silently (>40 min with ~zero CPU on the n=32768 dense step,
+  r5), so the attempt runs in a worker thread and is abandoned when the
+  timeout passes.  The first attempt per (wrapper, ladder device) uses
+  the compile timeout; warm dispatches use the (shorter) dispatch
+  timeout.
+- **bounded retries with exponential backoff + jitter** for transient
+  transport errors (connection resets, 5xx).  Deterministic transport
+  rejections (HTTP 413 payload-too-large) are never retried with the
+  same payload — they propagate so the fallback ladder
+  (runtime/fallback.py) can re-lower instead.
+- **post-step finite validation** (:func:`validate_finite`) — the
+  shared non-finite refusal that profiling/run_benchmarks.py::_timeit
+  pioneered in r4, promoted here so production ``fit_toas`` gets it
+  too: a NaN/Inf result raises a diagnosed
+  :class:`PintTpuNumericsError` mapping the symptom onto the known
+  emulated-f64 hazard taxonomy (docs/precision.md, docs/robustness.md)
+  instead of returning garbage.
+
+Fault injection (runtime/faults.py) hooks into the attempt and the
+validator so the whole ladder is exercised deterministically on the
+CPU test mesh, where none of these faults occur naturally.
+
+Defaults keep the guard essentially free on CPU backends (no watchdog
+thread; the inline path costs ~1 us per dispatch) and arm the watchdog
+on accelerators; ``$PINT_TPU_GUARD=off`` disables everything, and
+``$PINT_TPU_GUARD_{COMPILE_TIMEOUT,DISPATCH_TIMEOUT,RETRIES}``
+override individual knobs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import os
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    PintTpuError,
+    PintTpuNumericsError,
+    RetriesExhausted,
+    TransientDispatchError,
+    TransportRejection,
+)
+from pint_tpu.runtime import faults
+
+_UNSET = object()
+
+
+# -- configuration -------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Watchdog/retry policy for guarded compiles and dispatches.
+
+    ``None`` timeouts disable the watchdog (the attempt runs inline on
+    the calling thread — the CPU default, where the tunnel failure
+    modes don't exist and a per-dispatch thread would be pure
+    overhead)."""
+
+    compile_timeout: float | None = None  # first call per device
+    dispatch_timeout: float | None = None  # warm calls
+    max_retries: int = 2  # RE-tries of transient failures/timeouts
+    backoff_base: float = 0.25  # seconds; doubles per retry
+    backoff_max: float = 8.0
+    jitter: float = 0.5  # uniform [0, jitter) fraction added
+
+    @classmethod
+    def from_env(cls) -> "GuardConfig":
+        """Backend-dependent defaults + $PINT_TPU_GUARD_* overrides.
+
+        Accelerator defaults: compile watchdog 2400 s (the observed
+        axon wedge class sat past 40 min; a legit n=32768 kernel
+        compile is ~42 s), dispatch watchdog 900 s."""
+        env = os.environ.get
+
+        def _t(name, default):
+            v = env(name)
+            if v is None:
+                return default
+            v = float(v)
+            return None if v <= 0 else v
+
+        accel = jax.default_backend() != "cpu"
+        return cls(
+            compile_timeout=_t(
+                "PINT_TPU_GUARD_COMPILE_TIMEOUT", 2400.0 if accel else None
+            ),
+            dispatch_timeout=_t(
+                "PINT_TPU_GUARD_DISPATCH_TIMEOUT", 900.0 if accel else None
+            ),
+            max_retries=int(env("PINT_TPU_GUARD_RETRIES", "2")),
+        )
+
+
+_config_cache: GuardConfig | None = None
+_override: GuardConfig | None = None
+_disabled_depth = 0
+_ladder_dev = None  # device pin set by the fallback ladder's CPU rung
+
+
+def current_config() -> GuardConfig:
+    global _config_cache
+    if _override is not None:
+        return _override
+    if _config_cache is None:
+        _config_cache = GuardConfig.from_env()
+    return _config_cache
+
+
+@contextlib.contextmanager
+def configured(**kw):
+    """Override guard-config fields for the with-block (test/bench API):
+    ``with guard.configured(dispatch_timeout=0.1, max_retries=0): ...``"""
+    global _override
+    prev = _override
+    _override = dataclasses.replace(prev or current_config(), **kw)
+    try:
+        yield _override
+    finally:
+        _override = prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Bypass the guard entirely (used by bench.py's overhead probe)."""
+    global _disabled_depth
+    _disabled_depth += 1
+    try:
+        yield
+    finally:
+        _disabled_depth -= 1
+
+
+@contextlib.contextmanager
+def ladder_device(device):
+    """Pin guarded dispatches to ``device`` for the with-block.
+
+    jax's ``default_device`` context is THREAD-LOCAL (and part of the
+    jit cache key), so the fallback ladder cannot simply wrap a rung in
+    ``jax.default_device(...)`` — the watchdog runs the dispatch in a
+    worker thread that would not see it.  The guard instead re-enters
+    the context inside whichever thread executes the attempt."""
+    global _ladder_dev
+    prev = _ladder_dev
+    _ladder_dev = device
+    try:
+        yield
+    finally:
+        _ladder_dev = prev
+
+
+def _device_ctx():
+    return (
+        jax.default_device(_ladder_dev)
+        if _ladder_dev is not None
+        else contextlib.nullcontext()
+    )
+
+
+# -- stats (bench.py's guard block reads these) --------------------------
+class GuardStats:
+    """Process-wide guard counters; thread-safe, resettable."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.dispatches = 0
+            self.guarded = 0
+            self.retries = 0
+            self.timeouts = 0
+            self.transport_rejections = 0
+            self.numerics_errors = 0
+            self.fallbacks = 0
+            self.last_watchdog_margin_s = None
+            self.min_watchdog_margin_frac = None
+
+    def bump(self, name, n=1):
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def note_margin(self, margin_s, timeout_s):
+        with self._lock:
+            self.last_watchdog_margin_s = float(margin_s)
+            frac = float(margin_s) / float(timeout_s)
+            if (self.min_watchdog_margin_frac is None
+                    or frac < self.min_watchdog_margin_frac):
+                self.min_watchdog_margin_frac = frac
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "guarded": self.guarded,
+                "retries": self.retries,
+                "timeouts": self.timeouts,
+                "transport_rejections": self.transport_rejections,
+                "numerics_errors": self.numerics_errors,
+                "fallbacks": self.fallbacks,
+                "watchdog_margin_s": self.last_watchdog_margin_s,
+                "watchdog_margin_frac": self.min_watchdog_margin_frac,
+            }
+
+
+STATS = GuardStats()
+
+
+# -- error classification ------------------------------------------------
+_TRANSIENT_MARKERS = (
+    "connection reset", "connection refused", "connection aborted",
+    "broken pipe", "temporarily unavailable", "deadline exceeded",
+    "unavailable", "timed out", "timeout", "transient",
+    "502", "503", "504",
+)
+_REJECTION_MARKERS = (
+    "413", "payload too large", "request entity too large",
+    "message length", "exceeds maximum",
+)
+
+
+def classify_error(e: BaseException) -> str:
+    """'rejection' (deterministic transport refusal — fall back, never
+    retry), 'transient' (retry with backoff), or 'fatal' (propagate).
+    Real tunnel errors arrive as foreign exception types, so beyond our
+    own types this is marker-based on the message text."""
+    if isinstance(e, TransportRejection):
+        return "rejection"
+    if isinstance(e, TransientDispatchError):
+        return "transient"
+    if isinstance(e, PintTpuError):
+        return "fatal"  # our own semantics, not transport weather
+    text = f"{type(e).__name__} {e}".lower()
+    if any(m in text for m in _REJECTION_MARKERS):
+        return "rejection"
+    if isinstance(e, (ConnectionError, TimeoutError)) or any(
+        m in text for m in _TRANSIENT_MARKERS
+    ):
+        return "transient"
+    return "fatal"
+
+
+# -- the supervisor ------------------------------------------------------
+def _attempt(fn, args, site, timeout):
+    """One supervised attempt: fault hooks + optional watchdog thread.
+
+    With a timeout, the attempt runs in a daemon worker (join with
+    timeout; a wedged attempt is abandoned, not killed — Python cannot
+    interrupt a thread blocked in a C extension).  The ladder-device
+    pin is re-entered inside the executing thread (see ladder_device).
+    """
+    if not timeout:
+        with _device_ctx():
+            faults.maybe_hang(site)
+            faults.maybe_raise(site)
+            return fn(*args)
+
+    cell = {}
+
+    def work():
+        try:
+            with _device_ctx():
+                faults.maybe_hang(site)
+                faults.maybe_raise(site)
+                cell["ok"] = fn(*args)
+        except BaseException as e:  # re-raised on the caller thread
+            cell["err"] = e
+
+    t = threading.Thread(
+        target=work, daemon=True, name=f"pint-tpu-guard {site}"
+    )
+    t0 = time.monotonic()
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise GuardTimeout(site=site, timeout=timeout)
+    STATS.note_margin(timeout - (time.monotonic() - t0), timeout)
+    if "err" in cell:
+        raise cell["err"]
+    return cell["ok"]
+
+
+def guarded_call(fn, args=(), site="", config=None, timeout=_UNSET,
+                 is_compile=False):
+    """Run ``fn(*args)`` under the guard: watchdog + bounded retries.
+
+    Raises GuardTimeout (watchdog exhausted), TransportRejection
+    (deterministic — immediately), RetriesExhausted (transient failures
+    past max_retries), or the original error (fatal class).  The
+    fallback ladder catches exactly these to drop a rung."""
+    cfg = config or current_config()
+    if timeout is _UNSET:
+        timeout = cfg.compile_timeout if is_compile else cfg.dispatch_timeout
+    attempts = max(0, int(cfg.max_retries)) + 1
+    delay = cfg.backoff_base
+    for attempt in range(1, attempts + 1):
+        try:
+            return _attempt(fn, args, site, timeout)
+        except GuardTimeout:
+            STATS.bump("timeouts")
+            if attempt == attempts:
+                raise
+        except Exception as e:
+            kind = classify_error(e)
+            if kind == "rejection":
+                STATS.bump("transport_rejections")
+                if isinstance(e, TransportRejection):
+                    raise
+                raise TransportRejection(str(e)) from e
+            if kind != "transient":
+                raise
+            if attempt == attempts:
+                raise RetriesExhausted(site, attempt, e) from e
+        STATS.bump("retries")
+        time.sleep(
+            min(delay, cfg.backoff_max)
+            * (1.0 + cfg.jitter * random.random())
+        )
+        delay *= 2.0
+
+
+def _host_side(args) -> bool:
+    """False inside a jax trace (vmap/jit/grad) — the guard must never
+    interpose there: threads break thread-local trace state, and inner
+    cm.jit calls under an outer trace simply inline."""
+    try:
+        if not jax.core.trace_state_clean():
+            return False
+    except Exception:
+        pass
+    return not any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(args)
+    )
+
+
+def dispatch_guard(fn, site: str):
+    """Wrap a host-callable jitted function so every call runs under
+    guarded_call.  The compile-vs-dispatch timeout choice tracks the
+    first call per (wrapper, ladder device) — a rung falling to the CPU
+    device pays a fresh compile and gets the compile watchdog again.
+    Preserves the ``.lower`` AOT hook (profiling/bench)."""
+    compiled_for: set = set()
+
+    @functools.wraps(fn)
+    def guarded(*args):
+        if not _host_side(args):
+            return fn(*args)  # inlining under an outer trace
+        STATS.bump("dispatches")
+        if (_disabled_depth > 0
+                or os.environ.get("PINT_TPU_GUARD") == "off"):
+            with _device_ctx():  # the ladder pin still applies
+                return fn(*args)
+        STATS.bump("guarded")
+        devkey = None if _ladder_dev is None else str(_ladder_dev)
+        out = guarded_call(
+            fn, args, site=site, is_compile=devkey not in compiled_for
+        )
+        compiled_for.add(devkey)
+        return out
+
+    if hasattr(fn, "lower"):
+        guarded.lower = fn.lower
+    return guarded
+
+
+# -- the shared finite-state validator (satellite: promoted from
+# profiling/run_benchmarks.py::_timeit's r4 refusal) ---------------------
+@dataclasses.dataclass(frozen=True)
+class NumericsDiagnosis:
+    """Structured mapping of a non-finite symptom onto the emulated-f64
+    hazard taxonomy (docs/precision.md; full table in
+    docs/robustness.md)."""
+
+    hazard: str  # taxonomy key
+    evidence: str  # what the values showed
+    hint: str  # which known fix applies
+    backend: str
+
+    @property
+    def summary(self) -> str:
+        return (
+            f"hazard={self.hazard} [{self.evidence}] — {self.hint} "
+            f"(backend={self.backend}; taxonomy: docs/robustness.md)"
+        )
+
+
+#: f32 exponent-range limits that axon's f32-pair emulated f64 inherits
+#: (docs/precision.md): squaring past ~1.8e19 overflows, products below
+#: ~1.2e-38 flush to zero, 1/x of x < ~1e-38 overflows.
+F32_RANGE_MAX = 3.4e38
+F32_SQUARE_CEILING = 1.8e19
+F32_FLUSH_FLOOR = 1.2e-38
+
+
+def diagnose_nonfinite(mats: dict) -> NumericsDiagnosis:
+    """Best-effort hazard classification from the materialized values.
+
+    Heuristic by construction — the NaN has already destroyed most of
+    the evidence — but each branch names the one known failure class
+    whose signature matches, so the operator starts at the right
+    gotcha instead of bisecting device code."""
+    backend = jax.default_backend()
+    finite_abs = []
+    n_inf = n_nan = 0
+    bad_all_scalar = True
+    for a in mats.values():
+        a = np.asarray(a, dtype=np.float64)
+        n_inf += int(np.sum(np.isinf(a)))
+        n_nan += int(np.sum(np.isnan(a)))
+        if not np.all(np.isfinite(a)) and a.ndim > 0:
+            bad_all_scalar = False
+        f = np.abs(a[np.isfinite(a)])
+        if f.size:
+            finite_abs.append(f)
+    fmax = max((float(f.max()) for f in finite_abs), default=0.0)
+    nonzero_min = min(
+        (float(f[f > 0].min()) for f in finite_abs if np.any(f > 0)),
+        default=np.inf,
+    )
+    cpu_note = (
+        "NOTE: this backend is CPU (IEEE f64) — the emulated-f64 "
+        "hazards below do not apply there; suspect a genuine "
+        "model/data problem (zero TOA errors, singular system) or an "
+        "injected fault.  "
+        if backend == "cpu" else ""
+    )
+    if n_inf or fmax > F32_SQUARE_CEILING:
+        return NumericsDiagnosis(
+            "exponent-range-overflow",
+            f"{n_inf} inf, max finite |value| {fmax:.3g} "
+            f"(f32-range square ceiling ~{F32_SQUARE_CEILING:.1e})",
+            cpu_note + "emulated f64 keeps the f32 EXPONENT range: "
+            "|max|-prescale before sums of squares "
+            "(fitting/gls.py::_column_norms) and keep weighted design "
+            f"columns |M*sqrt(w)| under ~{F32_RANGE_MAX:.1e} "
+            "(docs/precision.md weighted-design ceiling)",
+            backend,
+        )
+    if nonzero_min < 1e-30:
+        return NumericsDiagnosis(
+            "subnormal-flush",
+            f"smallest nonzero finite |value| {nonzero_min:.3g} "
+            f"(flush floor ~{F32_FLUSH_FLOOR:.1e})",
+            cpu_note + "products of tiny factors flush to ZERO below "
+            "~1.2e-38 and 1/x of x<~1e-38 overflows: form such "
+            "products in log space (models/noise.py::powerlaw_phi) "
+            "and keep degenerate weights >= 1e-30 "
+            "(noise_basis_or_empty)",
+            backend,
+        )
+    if bad_all_scalar and n_nan:
+        return NumericsDiagnosis(
+            "scalar-transcendental-path",
+            f"{n_nan} NaN confined to 0-d values",
+            cpu_note + "0-d transcendentals take axon's f32-accurate "
+            "scalar path (usually ~2e-8 error, not NaN, but domain "
+            "edges differ): route scalar parameters through "
+            "ops/scalarmath.py (sin_p/cos_p/...; "
+            "tools/lint_scalarmath.py catches this statically)",
+            backend,
+        )
+    return NumericsDiagnosis(
+        "unknown",
+        f"{n_nan} NaN / {n_inf} inf with unremarkable finite values",
+        cpu_note + "no known emulated-f64 signature matches; check "
+        "the model inputs (zero/negative uncertainties, empty mask "
+        "selections) and docs/robustness.md",
+        backend,
+    )
+
+
+def validate_finite(values: dict, site: str = "",
+                    what: str = "device step") -> dict:
+    """The SHARED non-finite refusal: materialize ``values`` (a dict of
+    name -> array-like; None entries skipped), refuse NaN/Inf with a
+    diagnosed PintTpuNumericsError.  Every consumer — production
+    fit_toas (fitting/base.py::Fitter._finish_scan_fit), the fallback
+    ladder, bench.py, profiling/run_benchmarks.py::_timeit — calls this
+    one function, so a NaN can never be timed, committed, or published
+    from any of them.  Fault injection poisons a COPY here (nan kind);
+    the poisoned copy is refused, never returned."""
+    mats = {
+        name: np.asarray(v)
+        for name, v in values.items()
+        if v is not None
+    }
+    mats = faults.corrupt(mats, site)
+    bad = [n for n, a in mats.items() if not np.all(np.isfinite(a))]
+    if bad:
+        diag = diagnose_nonfinite(mats)
+        STATS.bump("numerics_errors")
+        raise PintTpuNumericsError(
+            f"{what} produced non-finite values ({', '.join(bad)}) at "
+            f"{site or 'unknown site'}: {diag.summary}",
+            diagnosis=diag,
+        )
+    return mats
+
+
+def ensure_scan_finite(result, fail_msg: str, site: str = ""):
+    """Validate a make_scan_fit_loop result tuple: the scan freezes on
+    a non-finite chi2 and reports per-iteration flags, so a flagged
+    iteration is refused here with the shared diagnosis, and the final
+    state/chi2 get the plain finite check."""
+    x, chi2, cov, conv, nbads, bads = result
+    bads = np.asarray(bads)
+    if bads.any():
+        first = int(np.flatnonzero(bads)[0])
+        # the scan kept the last-good state, so the poisoned values are
+        # gone — diagnose from what survived, flagging the iteration
+        diag = diagnose_nonfinite({"x": np.asarray(x)})
+        STATS.bump("numerics_errors")
+        raise PintTpuNumericsError(
+            f"{fail_msg} (chi2 went non-finite at iteration {first}; "
+            f"the scan froze on the last finite state) at "
+            f"{site or 'unknown site'}: {diag.summary}",
+            diagnosis=diag,
+        )
+    validate_finite({"x": x, "chi2": chi2}, site=site, what="fit loop")
+    return result

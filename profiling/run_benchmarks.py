@@ -68,13 +68,17 @@ def _timeit(step, x0, nrep=3, chain=128, jit_wrap=None):
     # step times exactly like a correct one on TPU (no traps), so an
     # unchecked harness can publish rows that measured garbage (r4:
     # device-computed power-law phi flushed to zero at axon's f32
-    # exponent range and NaN-ed the 1e6 GLS chain)
-    if not (np.all(np.isfinite(np.asarray(x)))
-            and np.all(np.isfinite(np.asarray(chi2s)[-1:]))):
-        raise RuntimeError(
-            "benchmark step produced non-finite state/chi2 — refusing "
-            "to time it"
-        )
+    # exponent range and NaN-ed the 1e6 GLS chain).  This gate is now
+    # the SHARED validator (runtime/guard.py::validate_finite — the
+    # refusal that started here was promoted there so production
+    # fit_toas gets it too); it raises a diagnosed PintTpuNumericsError
+    # naming the emulated-f64 hazard class.
+    from pint_tpu.runtime.guard import validate_finite
+
+    validate_finite(
+        {"state": np.asarray(x), "chi2": np.asarray(chi2s)[-1:]},
+        site="profiling:chain", what="benchmark step chain",
+    )
     ts = []            # host copy: the only reliable sync over the
     for _ in range(nrep):  # axon tunnel (block_until_ready is early)
         t0 = time.perf_counter()

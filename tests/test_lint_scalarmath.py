@@ -1,0 +1,64 @@
+"""Tier-1 wiring for tools/lint_scalarmath.py: the codebase must stay
+free of direct jnp transcendentals on scalar model parameters (the
+axon 0-d f32-accuracy hazard, ops/scalarmath.py / docs/precision.md —
+invisible on the CPU mesh, so a static check is the only tier-1
+guard), and the linter itself must keep catching the known patterns.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_scalarmath import lint_paths, lint_source  # noqa: E402
+
+
+def test_codebase_is_clean():
+    findings = lint_paths([REPO / "pint_tpu"])
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_linter_catches_known_patterns():
+    bad = (
+        "import jax.numpy as jnp\n"
+        "def kernel(self, pdict, bundle):\n"
+        "    amp = jnp.power(10.0, pdict['TNREDAMP'])\n"
+        "    kom = pdict['KOM']\n"
+        "    s = jnp.sin(2.0 * kom)\n"
+        "    e = jnp.exp(-self.val(pdict, 'SHAPMAX'))\n"
+        "    a2 = jnp.arctan2(pdict['EPS1'], pdict['EPS2'])\n"
+        "    return amp, s, e, a2\n"
+    )
+    findings = lint_source(bad, "bad.py")
+    assert {(f.lineno, f.func) for f in findings} == {
+        (3, "power"), (5, "sin"), (6, "exp"), (7, "arctan2"),
+    }
+
+
+def test_linter_allows_array_math_and_pragma():
+    ok = (
+        "import jax.numpy as jnp\n"
+        "def kernel(self, pdict, bundle):\n"
+        "    kin0 = pdict['KIN']\n"
+        "    kin = kin0 + bundle.dt     # broadcast to rank 1\n"
+        "    v = jnp.sin(kin)\n"
+        "    arg = bundle.t * bundle.freqs\n"
+        "    basis = jnp.cos(arg)\n"
+        "    sup = jnp.log(pdict['X'])  # lint: scalar-ok\n"
+        "    return v, basis, sup\n"
+    )
+    assert lint_source(ok, "ok.py") == []
+
+
+def test_linter_tracks_closures():
+    bad = (
+        "import jax.numpy as jnp\n"
+        "def outer(pdict):\n"
+        "    gamma = pdict['TNREDGAM']\n"
+        "    def inner(f):\n"
+        "        return jnp.power(f, gamma)\n"
+        "    return inner\n"
+    )
+    findings = lint_source(bad, "closure.py")
+    assert [(f.lineno, f.func) for f in findings] == [(5, "power")]

@@ -1,0 +1,546 @@
+"""Fault-injection suite for the device-execution guard (runtime/).
+
+None of the axon failure modes — wedged compiles, 413 transport
+rejections, transient tunnel errors, emulated-f64 NaN steps — occur on
+the CPU mesh, so every guard behavior is exercised here through
+runtime/faults.py injection.  The acceptance contract: a simulated
+wedged compile trips the watchdog and is retried; a simulated NaN step
+is diagnosed and falls to the next ladder rung; an exhausted ladder
+raises a structured exception carrying the rung history — and NO
+injected fault ever produces a silent wrong result (every recovered
+fit below must match the clean fit bit-for-bit on this mesh, and
+every unrecoverable one must raise).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    GuardTripWarning,
+    LadderExhausted,
+    PintTpuError,
+    PintTpuNumericsError,
+    RetriesExhausted,
+    TransientDispatchError,
+    TransportRejection,
+)
+from pint_tpu.runtime import faults
+from pint_tpu.runtime import guard
+from pint_tpu.runtime.fallback import fit_rungs, run_ladder
+from pint_tpu.simulation import make_test_pulsar
+
+PAR_WHITE = (
+    "PSR G1\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+)
+PAR_RED = PAR_WHITE + (
+    "EFAC -f L-wide 1.3\nTNREDAMP -13.1\nTNREDGAM 3.3\nTNREDC 6\n"
+)
+
+# fast guard policy for tests: no real watchdog unless a test arms
+# one, and millisecond backoff so retries don't stall the suite
+FAST = dict(backoff_base=0.001, backoff_max=0.002, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_stats():
+    guard.STATS.reset()
+    yield
+    assert not faults.active(), "a test leaked an armed fault plan"
+
+
+# -- fault-plan grammar ---------------------------------------------------
+def test_fault_spec_grammar():
+    plan = faults.FaultPlan.parse(
+        "hang:2@cm.jit, 413, transient:inf, nan:3@rung:cpu"
+    )
+    assert [(e.kind, e.remaining, e.site) for e in plan.entries] == [
+        ("hang", 2.0, "cm.jit"),
+        ("413", 1.0, None),
+        ("transient", float("inf"), None),
+        ("nan", 3.0, "rung:cpu"),
+    ]
+    assert plan.take("413", "anywhere")
+    assert not plan.take("413", "anywhere")  # count exhausted
+    assert plan.take("hang", "cm.jit:loop")
+    assert not plan.take("hang", "elsewhere")  # site filter
+    assert plan.fired == [("413", "anywhere"), ("hang", "cm.jit:loop")]
+
+
+def test_fault_spec_rejects_unknown_kind():
+    with pytest.raises(PintTpuError, match="unknown fault kind"):
+        faults.FaultPlan.parse("segfault:1")
+
+
+def test_inject_scope_discards_leftovers():
+    with faults.inject("413:5"):
+        assert faults.active()
+    assert not faults.active()
+
+
+def test_env_var_activation(monkeypatch):
+    calls = []
+    monkeypatch.setenv("PINT_TPU_FAULTS", "transient:1")
+    with guard.configured(**FAST):
+        out = guard.guarded_call(
+            lambda: calls.append(1) or "ok", site="envtest"
+        )
+    assert out == "ok" and len(calls) == 1
+    assert guard.STATS.retries == 1
+    monkeypatch.setenv("PINT_TPU_FAULTS", "")
+
+
+# -- error classification -------------------------------------------------
+def test_classify_foreign_errors():
+    # real tunnel errors arrive as foreign types: marker-based class
+    assert guard.classify_error(
+        RuntimeError("Connection reset by peer")
+    ) == "transient"
+    assert guard.classify_error(
+        RuntimeError("HTTP 413: request entity too large")
+    ) == "rejection"
+    assert guard.classify_error(ValueError("bad shape")) == "fatal"
+    assert guard.classify_error(TransientDispatchError("x")) == "transient"
+    assert guard.classify_error(TransportRejection("x")) == "rejection"
+    # our own semantic errors are never transport weather
+    assert guard.classify_error(PintTpuNumericsError("nan")) == "fatal"
+
+
+# -- guarded_call: retries ------------------------------------------------
+def test_transient_faults_are_retried():
+    with guard.configured(max_retries=2, **FAST):
+        with faults.inject("transient:2"):
+            assert guard.guarded_call(lambda: 42, site="t") == 42
+    assert guard.STATS.retries == 2
+
+
+def test_retries_exhausted_raises_structured():
+    with guard.configured(max_retries=1, **FAST):
+        with faults.inject("transient:inf"):
+            with pytest.raises(RetriesExhausted) as ei:
+                guard.guarded_call(lambda: 42, site="deadline")
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, TransientDispatchError)
+
+
+def test_rejection_never_retried():
+    with guard.configured(max_retries=5, **FAST):
+        with faults.inject("413:1"):
+            with pytest.raises(TransportRejection):
+                guard.guarded_call(lambda: 42, site="big")
+    assert guard.STATS.retries == 0  # deterministic: zero retries
+    assert guard.STATS.transport_rejections == 1
+
+
+# -- guarded_call: watchdog ----------------------------------------------
+def test_watchdog_trips_then_retry_recovers():
+    """Simulated wedged compile: the first attempt hangs far past the
+    watchdog; the retry (fault exhausted) succeeds."""
+    with guard.configured(dispatch_timeout=0.25, max_retries=1, **FAST):
+        with faults.inject("hang:1", hang_seconds=2.0):
+            assert guard.guarded_call(lambda: "alive", site="wedge") \
+                == "alive"
+    assert guard.STATS.timeouts == 1
+    assert guard.STATS.retries == 1
+    # the successful attempt recorded its watchdog margin
+    assert guard.STATS.last_watchdog_margin_s is not None
+    assert 0.0 < guard.STATS.last_watchdog_margin_s <= 0.25
+
+
+def test_watchdog_exhausted_raises():
+    with guard.configured(dispatch_timeout=0.2, max_retries=1, **FAST):
+        with faults.inject("hang:inf", hang_seconds=1.5):
+            with pytest.raises(GuardTimeout) as ei:
+                guard.guarded_call(lambda: 1, site="wedge2")
+    assert ei.value.timeout == 0.2
+    assert "wedge2" in str(ei.value)
+    assert guard.STATS.timeouts == 2  # initial + 1 retry
+
+
+def test_no_watchdog_thread_on_cpu_defaults(monkeypatch):
+    """The CPU default config runs attempts inline (no per-dispatch
+    thread) — the guard must be essentially free where the tunnel
+    failure modes don't exist."""
+    monkeypatch.delenv("PINT_TPU_GUARD_DISPATCH_TIMEOUT", raising=False)
+    cfg = guard.GuardConfig.from_env()
+    assert jax.default_backend() == "cpu"
+    assert cfg.compile_timeout is None and cfg.dispatch_timeout is None
+    import threading
+
+    main = threading.current_thread()
+    seen = []
+    guard.guarded_call(
+        lambda: seen.append(threading.current_thread()), site="inline",
+        config=cfg,
+    )
+    assert seen == [main]
+
+
+# -- the finite validator + diagnosis ------------------------------------
+def test_validate_finite_passes_clean_values():
+    out = guard.validate_finite(
+        {"x": np.ones(3), "chi2": 2.5, "skip": None}, site="ok"
+    )
+    assert set(out) == {"x", "chi2"}
+
+
+def test_validate_finite_refuses_nan_with_diagnosis():
+    with pytest.raises(PintTpuNumericsError) as ei:
+        guard.validate_finite(
+            {"x": np.array([1.0, np.nan])}, site="s", what="unit step"
+        )
+    assert ei.value.diagnosis is not None
+    assert "docs/robustness.md" in str(ei.value)
+    assert guard.STATS.numerics_errors == 1
+
+
+def test_diagnosis_exponent_range_overflow():
+    d = guard.diagnose_nonfinite(
+        {"g": np.array([np.inf, 1e25]), "c": np.array([np.nan])}
+    )
+    assert d.hazard == "exponent-range-overflow"
+    assert "prescale" in d.hint
+
+
+def test_diagnosis_subnormal_flush():
+    d = guard.diagnose_nonfinite(
+        {"phi": np.array([4e-38, 0.0, np.nan])}
+    )
+    assert d.hazard == "subnormal-flush"
+    assert "log space" in d.hint
+
+
+def test_diagnosis_scalar_transcendental():
+    d = guard.diagnose_nonfinite(
+        {"roemer": np.float64(np.nan), "ok": np.ones(4)}
+    )
+    assert d.hazard == "scalar-transcendental-path"
+    assert "scalarmath" in d.hint
+
+
+def test_injected_nan_poisons_only_the_validators_copy():
+    vals = {"x": np.ones(4)}
+    with faults.inject("nan:1"):
+        with pytest.raises(PintTpuNumericsError):
+            guard.validate_finite(vals, site="copytest")
+    # the caller's array is untouched: refused loudly, never corrupted
+    np.testing.assert_array_equal(vals["x"], np.ones(4))
+
+
+# -- the degradation ladder ----------------------------------------------
+def test_fit_rungs_shapes():
+    assert [r[:2] for r in fit_rungs("mixed", backend="tpu")] == [
+        ("tpu-mixed", "mixed"), ("tpu-f64", "f64"), ("cpu", "f64")
+    ]
+    assert [r[:2] for r in fit_rungs("f64", backend="cpu")] == [
+        ("cpu-f64", "f64"), ("cpu", "f64")
+    ]
+    # WLS: its one solve method IS the f64 path — no middle rung
+    assert [r[:2] for r in fit_rungs("qr", backend="tpu",
+                                     f64_rung=False)] == [
+        ("tpu-qr", "qr"), ("cpu", "qr")
+    ]
+
+
+def test_ladder_falls_through_and_records_history():
+    served = []
+
+    def rung(name, fail):
+        def thunk(site):
+            served.append(name)
+            if fail:
+                raise PintTpuNumericsError(f"{name} went NaN")
+            return name
+
+        return (name, thunk)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out, report = run_ladder(
+            [rung("a", True), rung("b", True), rung("c", False)],
+            site="unit",
+        )
+    assert out == "c" and served == ["a", "b", "c"]
+    assert report.rung == "c" and report.rung_index == 2
+    assert report.fell_back
+    assert [h[0] for h in report.history] == ["a", "b"]
+    assert all("PintTpuNumericsError" in h[1] for h in report.history)
+    assert [wi.category for wi in w] == [GuardTripWarning] * 2
+    assert guard.STATS.fallbacks == 2
+
+
+def test_ladder_exhausted_is_structured():
+    def boom(site):
+        raise GuardTimeout(site=site, timeout=1.0)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", GuardTripWarning)
+        with pytest.raises(LadderExhausted) as ei:
+            run_ladder([("a", boom), ("b", boom)], site="allfail")
+    assert [h[0] for h in ei.value.history] == ["a", "b"]
+    assert "GuardTimeout" in ei.value.history[0][1]
+
+
+def test_ladder_propagates_fatal_errors_immediately():
+    """A wrong program (shape error, user bug) must NOT walk the
+    ladder — degrading can't fix it, and retrying hides it."""
+    calls = []
+
+    def bad(site):
+        calls.append(site)
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        run_ladder([("a", bad), ("b", bad)], site="fatal")
+    assert len(calls) == 1
+
+
+# -- end-to-end: fitters on the CPU mesh ---------------------------------
+@pytest.fixture(scope="module")
+def gls_pulsar():
+    m, toas = make_test_pulsar(PAR_RED, ntoa=64, seed=9)
+    return m, toas
+
+
+def _clean_gls_fit(gls_pulsar):
+    from pint_tpu.fitting.gls import GLSFitter
+
+    m, toas = gls_pulsar
+    f = GLSFitter(toas, m)
+    chi2 = f.fit_toas()
+    return f, chi2
+
+
+def test_gls_fit_nan_falls_back_identical(gls_pulsar):
+    """Simulated emulated-f64 NaN on the first rung: the fit must land
+    on the next rung with the clean result (same f64 program, same
+    device class; the 8-thread CPU mesh reduces nondeterministically at
+    ~1e-15 relative, so 'identical' is 1e-12 here) — the
+    loud-or-identical contract."""
+    from pint_tpu.fitting.gls import GLSFitter
+
+    f0, chi0 = _clean_gls_fit(gls_pulsar)
+    m, toas = gls_pulsar
+    f1 = GLSFitter(toas, m)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with faults.inject("nan:1@rung:cpu-f64"):
+            chi1 = f1.fit_toas()
+    assert any(wi.category is GuardTripWarning for wi in w)
+    assert f1.guard_report.fell_back
+    assert f1.guard_report.rung == "cpu"
+    assert f1.guard_report.history[0][0] == "cpu-f64"
+    assert "PintTpuNumericsError" in f1.guard_report.history[0][1]
+    assert chi1 == pytest.approx(chi0, rel=1e-12)
+    np.testing.assert_allclose(
+        f1.parameter_covariance_matrix,
+        f0.parameter_covariance_matrix, rtol=1e-9,
+    )
+
+
+def test_gls_fit_ladder_exhausted_raises(gls_pulsar):
+    from pint_tpu.fitting.gls import GLSFitter
+
+    m, toas = gls_pulsar
+    f = GLSFitter(toas, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", GuardTripWarning)
+        with faults.inject("nan:inf@rung:"):
+            with pytest.raises(LadderExhausted) as ei:
+                f.fit_toas()
+    assert len(ei.value.history) == 2  # cpu-f64 then cpu, both refused
+    assert f.chi2 is None  # nothing committed
+
+
+def test_gls_fit_transient_retried_on_first_rung(gls_pulsar):
+    from pint_tpu.fitting.gls import GLSFitter
+
+    f0, chi0 = _clean_gls_fit(gls_pulsar)
+    m, toas = gls_pulsar
+    f = GLSFitter(toas, m)
+    with guard.configured(max_retries=2, **FAST):
+        with faults.inject("transient:1@cm.jit"):
+            chi1 = f.fit_toas()
+    assert guard.STATS.retries == 1
+    assert not f.guard_report.fell_back  # recovered on the same rung
+    assert chi1 == pytest.approx(chi0, rel=1e-12)
+
+
+def test_gls_fit_wedged_dispatch_falls_to_next_rung(gls_pulsar):
+    """Watchdog inside a real fit: the first rung's dispatch wedges
+    (simulated), times out, and the ladder serves the identical result
+    from the next rung."""
+    from pint_tpu.fitting.gls import GLSFitter
+
+    f0, chi0 = _clean_gls_fit(gls_pulsar)
+    m, toas = gls_pulsar
+    f = GLSFitter(toas, m)
+    # the fallback rung pays a REAL recompile for the pinned CPU
+    # device, so the watchdog must clear that (~1-2 s here) while the
+    # injected hang must overrun it
+    with guard.configured(compile_timeout=8.0, dispatch_timeout=8.0,
+                          max_retries=0, **FAST):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", GuardTripWarning)
+            with faults.inject("hang:1@cm.jit", hang_seconds=40.0):
+                chi1 = f.fit_toas()
+    assert f.guard_report.fell_back
+    assert "GuardTimeout" in f.guard_report.history[0][1]
+    assert chi1 == pytest.approx(chi0, rel=1e-12)
+
+
+def test_wls_fit_nan_falls_back(gls_pulsar):
+    from pint_tpu.fitting.wls import WLSFitter
+
+    m, toas = make_test_pulsar(PAR_WHITE, ntoa=48, seed=3)
+    f0 = WLSFitter(toas, m)
+    chi0 = f0.fit_toas()
+    f1 = WLSFitter(toas, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", GuardTripWarning)
+        with faults.inject("nan:1@rung:cpu-svd"):
+            chi1 = f1.fit_toas()
+    assert f1.guard_report.fell_back and f1.guard_report.rung == "cpu"
+    assert chi1 == pytest.approx(chi0, rel=1e-12)
+
+
+def test_downhill_proposal_nan_falls_back_to_f64(gls_pulsar):
+    from pint_tpu.fitting.downhill import DownhillGLSFitter
+
+    m, toas = gls_pulsar
+    f0 = DownhillGLSFitter(toas, m)
+    chi0 = f0.fit_toas()
+    assert f0.guard_report.rung == "native"
+    f1 = DownhillGLSFitter(toas, m)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with faults.inject("nan:1@downhill"):
+            chi1 = f1.fit_toas()
+    assert any(wi.category is GuardTripWarning for wi in w)
+    assert f1.guard_report.rung == "f64-fallback"
+    assert chi1 == pytest.approx(chi0, rel=1e-12)
+
+
+def test_sharded_step_guarded_ladder():
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.parallel.gls import (
+        guarded_sharded_gls_step,
+        place_gls_operands,
+        sharded_gls_step,
+    )
+    from pint_tpu.parallel.mesh import make_mesh
+
+    m, toas = make_test_pulsar(PAR_RED, ntoa=64, seed=9)
+    cm = m.compile(toas)
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Nd = np.square(np.asarray(cm.scaled_sigma(x)))
+    T, phi = cm.noise_basis_or_empty(x)
+    mesh = make_mesh(n_pulsar_shards=1)
+    args = place_gls_operands(mesh, r, M, Nd, T, phi)
+    ref = jax.jit(lambda *a: sharded_gls_step(mesh, *a))(*args)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", GuardTripWarning)
+        with faults.inject("nan:1@parallel.gls.step/rung:cpu-f64"):
+            (dx, cov, chi2, nb), report = guarded_sharded_gls_step(
+                mesh, *args
+            )
+    assert report.fell_back and report.rung == "cpu-f64-retry"
+    np.testing.assert_array_equal(np.asarray(dx), np.asarray(ref[0]))
+    assert float(chi2) == float(ref[2])
+
+
+# -- production fit_toas refuses silent NaN (promoted validator) ---------
+def test_fit_toas_refuses_nan_with_diagnosis(gls_pulsar):
+    """The satellite contract: a NaN fit raises a DIAGNOSED
+    PintTpuNumericsError from production fit_toas — zero TOA errors
+    make the weights infinite and the whole solve non-finite, which
+    used to surface as a bare ConvergenceFailure."""
+    import copy
+
+    from pint_tpu.fitting.gls import GLSFitter
+
+    m, toas = gls_pulsar
+    bad_toas = copy.copy(toas)
+    bad_toas.error_us = np.full_like(toas.error_us, np.nan)
+    fbad = GLSFitter(bad_toas, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(LadderExhausted) as ei:
+            fbad.fit_toas()
+    # every rung refused with the shared diagnosis — never garbage
+    assert len(ei.value.history) == 2
+    assert all(
+        "PintTpuNumericsError" in h[1] for h in ei.value.history
+    )
+    assert fbad.chi2 is None  # nothing committed
+
+
+# -- checkpoint resume after a mid-fit guard trip ------------------------
+def test_checkpoint_resume_after_guard_trip(tmp_path, gls_pulsar):
+    """A fit that survived a mid-fit guard trip (NaN on the first
+    rung, served by the fallback rung) must checkpoint and resume
+    bit-identically to the clean fit."""
+    from pint_tpu.checkpoint import load_fit, save_fit
+    from pint_tpu.fitting.gls import GLSFitter
+
+    m, toas = gls_pulsar
+    clean = GLSFitter(toas, m)
+    clean.fit_toas()
+    save_fit(tmp_path / "clean.npz", clean)
+
+    tripped = GLSFitter(toas, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", GuardTripWarning)
+        with faults.inject("nan:1@rung:cpu-f64"):
+            tripped.fit_toas()
+    assert tripped.guard_report.fell_back
+    save_fit(tmp_path / "tripped.npz", tripped)
+
+    # the resume state is BIT-identical to the fit the ladder served
+    b = load_fit(tmp_path / "tripped.npz")
+    assert b["chi2"] == tripped.chi2
+    np.testing.assert_array_equal(
+        b["cov"], tripped.parameter_covariance_matrix
+    )
+    assert b["model"].as_parfile() == tripped.model.as_parfile()
+    # and matches the clean fit to the mesh's reduction determinism
+    a = load_fit(tmp_path / "clean.npz")
+    assert b["chi2"] == pytest.approx(a["chi2"], rel=1e-12)
+    assert b["free_names"] == a["free_names"]
+    assert b["converged"] == a["converged"]
+    np.testing.assert_allclose(b["cov"], a["cov"], rtol=1e-9)
+    # and the resumed model refits to the same answer with no faults
+    resumed = GLSFitter(toas, b["model"])
+    chi2_resumed = resumed.fit_toas()
+    assert not resumed.guard_report.fell_back
+    assert chi2_resumed == pytest.approx(a["chi2"], rel=1e-9)
+
+
+# -- stats surface (bench.py's guard block reads this) -------------------
+def test_stats_snapshot_keys():
+    snap = guard.STATS.snapshot()
+    assert set(snap) == {
+        "dispatches", "guarded", "retries", "timeouts",
+        "transport_rejections", "numerics_errors", "fallbacks",
+        "watchdog_margin_s", "watchdog_margin_frac",
+    }
+
+
+def test_guard_disabled_context(gls_pulsar):
+    """bench.py's overhead probe path: inside guard.disabled() the
+    dispatch runs unguarded (faults don't fire, counters untouched)."""
+    with faults.inject("transient:1"):
+        with guard.disabled():
+            assert guard.guarded_call is not None
+            # a dispatch_guard-wrapped fn must bypass the supervisor
+            wrapped = guard.dispatch_guard(lambda v: v + 1, "bypass")
+            assert wrapped(1) == 2
+        assert guard.STATS.guarded == 0
+        # the armed fault is still pending outside the block; drain it
+        with guard.configured(max_retries=1, **FAST):
+            assert guard.guarded_call(lambda: 7, site="drain") == 7
