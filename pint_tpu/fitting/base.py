@@ -17,9 +17,26 @@ import numpy as np
 
 from pint_tpu.exceptions import DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.obs.trace import TRACER
 from pint_tpu.residuals import Residuals
 from pint_tpu.runtime.guard import ensure_scan_finite
 from pint_tpu.toas.toas import TOAs
+
+
+def record_fit(fit_fn):
+    """Decorator for every fitter's ``fit_toas``: runs the body under
+    the fit-level flight-recorder span (Fitter._fit_obs_span) so one
+    fit produces a complete fit > rung > compile/dispatch > fence span
+    tree, plus the always-on fit counter and per-fit log-dedup
+    reset."""
+    import functools
+
+    @functools.wraps(fit_fn)
+    def wrapped(self, *args, **kwargs):
+        with self._fit_obs_span():
+            return fit_fn(self, *args, **kwargs)
+
+    return wrapped
 
 
 def noffset(cm) -> int:
@@ -104,7 +121,11 @@ def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2,
     # with a CompiledModel in hand, the TOA bundle rides as a runtime
     # argument (cm.jit) so the lowered module is O(1) in ntoa — a plain
     # jit would bake ~240 HLO bytes/TOA of bundle literals
-    return cm.jit(fit_loop) if cm is not None else jax.jit(fit_loop)
+    # the cm=None branch serves harness-level unit tests only (no
+    # CompiledModel, no device data to meter)
+    if cm is not None:
+        return cm.jit(fit_loop)
+    return jax.jit(fit_loop)  # lint: obs-ok (test-only, no cm)
 
 
 class Fitter:
@@ -125,6 +146,33 @@ class Fitter:
         # which fallback-ladder rung served the last fit
         # (runtime/fallback.py::GuardReport; None before any fit)
         self.guard_report = None
+
+    def _fit_obs_span(self):
+        """Open the fit-level flight-recorder span (every fit_toas
+        wraps its body in this — the 'fit' root the dispatch/compile/
+        fence spans nest under), bump the fit counter, and reset the
+        log-dedup filter so each fit's warnings print once per FIT,
+        not once per process."""
+        from pint_tpu import logging as plog
+        from pint_tpu.obs import metrics as obs_metrics
+
+        plog.reset_dedup()
+        obs_metrics.counter("fit.count", help="fit_toas calls").inc()
+        return TRACER.span(
+            f"fit:{type(self).__name__}", "fit",
+            free_params=len(self.cm.free_names),
+            ntoa=self.cm.bundle.ntoa,
+        )
+
+    def flight_report(self) -> str:
+        """Human post-mortem of the recorded flight (sibling of
+        ``guard_report``): top spans, recompiles, bytes to device,
+        rung history.  Metrics are always on; span detail appears when
+        the recorder is enabled (obs.trace.enable() /
+        $PINT_TPU_TRACE=1).  See docs/observability.md."""
+        from pint_tpu.obs.export import flight_report
+
+        return flight_report(guard_report=self.guard_report)
 
     @property
     def _noffset(self):
@@ -157,6 +205,10 @@ class Fitter:
         references, which ride every cm.jit call as runtime arguments
         — a refit costs one dispatch, not a ~30 s recompile
         (profiling/profile_fit_wall.py)."""
+        # explicit device fence: the scan result is an async pytree —
+        # without this, host code below could time/commit values that
+        # do not exist yet (the fence is a recorded span when tracing)
+        result = TRACER.fence(result, name="fit-result")
         x, chi2, cov, conv, nbads, bads = result
         nbads = np.asarray(nbads)
         for nb in nbads[nbads > 0]:

@@ -27,7 +27,7 @@ from pint_tpu.exceptions import (
     InvalidModelParameters,
     PintTpuNumericsError,
 )
-from pint_tpu.fitting.base import Fitter
+from pint_tpu.fitting.base import Fitter, record_fit
 from pint_tpu.fitting.gls import (
     default_accel_mode,
     gls_step_full_cov,
@@ -119,6 +119,7 @@ class DownhillFitter(Fitter):
         resid = cs - np.polyval(coef, ls)
         return 6.0 * float(np.sqrt(np.sum(resid**2) / (len(ls) - 2)))
 
+    @record_fit
     def fit_toas(
         self,
         maxiter: int = 20,

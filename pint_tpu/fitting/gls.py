@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.fitting.base import Fitter, make_scan_fit_loop
+from pint_tpu.fitting.base import Fitter, make_scan_fit_loop, record_fit
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
@@ -449,6 +449,7 @@ class GLSFitter(Fitter):
             lambda x0: jnp.asarray(jnp.inf), cm=self.cm,
         )
 
+    @record_fit
     def fit_toas(self, maxiter: int = 4, tol_chi2: float | None = None) -> float:
         mode = self._step_mode()
         if tol_chi2 is None:

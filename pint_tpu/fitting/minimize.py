@@ -14,7 +14,7 @@ import jax
 import numpy as np
 from scipy.optimize import minimize
 
-from pint_tpu.fitting.base import Fitter
+from pint_tpu.fitting.base import Fitter, record_fit
 
 
 class MinimizeFitter(Fitter):
@@ -30,6 +30,7 @@ class MinimizeFitter(Fitter):
             raise CorrelatedErrors(model)
         self.method = method
 
+    @record_fit
     def fit_toas(self, maxiter: int = 2000) -> float:
         chi2 = self.cm.jit(self.cm.chi2)
         kw = {}

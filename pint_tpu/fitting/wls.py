@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.fitting.base import Fitter, make_scan_fit_loop
+from pint_tpu.fitting.base import Fitter, make_scan_fit_loop, record_fit
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
@@ -157,6 +157,7 @@ class WLSFitter(Fitter):
             live_step, p, maxiter, tol_chi2, self.cm.chi2, cm=self.cm
         )
 
+    @record_fit
     def fit_toas(self, maxiter: int = 4, tol_chi2: float = 1e-10) -> float:
         if self.cm.has_correlated_errors:
             from pint_tpu.exceptions import CorrelatedErrors

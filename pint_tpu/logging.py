@@ -5,32 +5,77 @@ filters so repeated per-TOA warnings print once; here stdlib logging
 (loguru is not a dependency) with the same surface: ``setup(level)``,
 level control for scripts, and a dedup filter keyed on (logger,
 message-prefix).
+
+PR 2 (observability): the dedup memory is BOUNDED (LRU — the old
+unbounded ``_seen`` set grew forever in a long-lived service) and
+resettable per fit (:func:`reset_dedup`, called by every fitter's
+``fit_toas`` via ``Fitter._fit_obs_span``), and every record that
+passes the filter is stamped with the active flight-recorder span id
+and attached to that span (pint_tpu/obs/trace.py), so a trace carries
+the warnings emitted while each span was open.  :func:`structured`
+emits records with a machine-readable ``extra`` field dict.
 """
 
 from __future__ import annotations
 
 import logging as _logging
 import sys
+from collections import OrderedDict
 
 _LOGGER_NAME = "pint_tpu"
+
+#: default dedup-memory bound: big enough that one fit's distinct
+#: warnings never evict each other, small enough to be irrelevant to a
+#: week-long service's footprint
+_DEDUP_MAXSIZE = 4096
 
 
 class DedupFilter(_logging.Filter):
     """Pass each distinct message prefix only once (reference parity:
-    the loguru dedup filters for clock/ephemeris warnings)."""
+    the loguru dedup filters for clock/ephemeris warnings).
 
-    def __init__(self, prefix_len: int = 60):
+    The seen-set is a bounded LRU (``maxsize``; the pre-PR-2 version
+    grew without bound) and :meth:`reset` clears it — fitters reset
+    between fits so a recurring condition is reported once per FIT
+    rather than once per process lifetime."""
+
+    def __init__(self, prefix_len: int = 60,
+                 maxsize: int = _DEDUP_MAXSIZE):
         super().__init__()
         self.prefix_len = prefix_len
-        self._seen: set = set()
+        self.maxsize = maxsize
+        self._seen: OrderedDict = OrderedDict()
 
     def filter(self, record):
         key = (record.name, record.levelno,
                record.getMessage()[: self.prefix_len])
         if key in self._seen:
+            self._seen.move_to_end(key)
             return False
-        self._seen.add(key)
+        self._seen[key] = None
+        while len(self._seen) > self.maxsize:
+            self._seen.popitem(last=False)
+        self._annotate(record)
         return True
+
+    def reset(self):
+        """Forget all seen prefixes (called between fits)."""
+        self._seen.clear()
+
+    @staticmethod
+    def _annotate(record):
+        """Stamp the record with the active flight-recorder span and
+        attach it there (no-ops when tracing is off)."""
+        try:  # lazy: logging must import before/without obs
+            from pint_tpu.obs.trace import TRACER
+
+            record.span_id = TRACER.current_span_id()
+            TRACER.attach_log(
+                record.levelname, record.getMessage(),
+                getattr(record, "pint_tpu_fields", None),
+            )
+        except Exception:
+            record.span_id = None
 
 
 def setup(level: str = "INFO", dedup: bool = True, stream=None):
@@ -54,3 +99,20 @@ def get_logger(name: str = ""):
     return _logging.getLogger(
         f"{_LOGGER_NAME}.{name}" if name else _LOGGER_NAME
     )
+
+
+def reset_dedup():
+    """Reset every DedupFilter hanging off the pint_tpu logger tree —
+    the between-fits hook (Fitter._fit_obs_span)."""
+    logger = _logging.getLogger(_LOGGER_NAME)
+    for h in logger.handlers:
+        for f in h.filters:
+            if isinstance(f, DedupFilter):
+                f.reset()
+
+
+def structured(logger, level, msg, **fields):
+    """Emit a structured record: ``fields`` ride the record as the
+    ``pint_tpu_fields`` extra dict (machine-readable — obs spans
+    attach them verbatim; a JSON log formatter can serialize them)."""
+    logger.log(level, msg, extra={"pint_tpu_fields": fields})

@@ -423,9 +423,27 @@ class CompiledModel:
         import functools
         import os
 
+        from pint_tpu import obs as _obs
         from pint_tpu.runtime.guard import dispatch_guard
 
         site = f"cm.jit:{getattr(fn, '__name__', 'fn')}"
+
+        # flight-recorder hooks (pint_tpu/obs): `noted` replaces fn in
+        # the traced position, so its host side effect fires exactly
+        # once per XLA (re)trace — an exact compile/recompile counter
+        # (jax executes the Python body only on jit cache miss).  A
+        # retrace past the wrapper's first is a RECOMPILE: bundle
+        # swap, ladder-device pin, or a shape change — and must never
+        # happen on a commit()-then-refit (the r5 one-dispatch
+        # invariant bench.py's obs block asserts).
+        ntraces = [0]
+
+        def noted(*a):
+            _obs.note_trace(site, retrace=ntraces[0] > 0)
+            ntraces[0] += 1
+            return fn(*a)
+
+        _const_bytes = [None]  # operand bytes constant per wrapper
 
         threshold = int(
             os.environ.get("PINT_TPU_BAKE_THRESHOLD", "200000")
@@ -465,18 +483,32 @@ class CompiledModel:
                         # each other's fresh compiles.
                         jax.clear_caches()
                         self._cleared_for = self.bundle
+                        _obs.TRACER.event(
+                            "cache-clear", "compile", site=site
+                        )
                     # fresh closure each re-bake: jax's trace cache
                     # keys on function identity, so jit(fn) again
                     # would serve the OLD bundle's baked trace
                     baked[:] = [
                         self.bundle, self.tzr_bundle,
                         jax.jit(lambda refnum, *a:
-                                self._ref_swap_call(fn, refnum, a)),
+                                self._ref_swap_call(noted, refnum, a)),
                     ]
+                    # baked-literal transport pressure (near-413
+                    # early warning; pint_tpu/obs/__init__.py)
+                    _obs.note_baked_module(
+                        site, self.bundle.ntoa,
+                        (self.bundle, self.tzr_bundle),
+                    )
                 return baked[2]
 
             @functools.wraps(fn)
             def rebaking(*args):
+                if _const_bytes[0] is None:
+                    _const_bytes[0] = _obs.trace.nbytes_of(
+                        self._ref_runtime()
+                    )
+                _obs.note_transfer(site, _const_bytes[0], args)
                 return _jitted()(self._ref_runtime(), *args)
 
             # AOT hook: lower against the CURRENT bundles/refs
@@ -490,12 +522,22 @@ class CompiledModel:
             old = (self.bundle, self.tzr_bundle)
             self.bundle, self.tzr_bundle = bundles
             try:
-                return self._ref_swap_call(fn, refnum, args)
+                return self._ref_swap_call(noted, refnum, args)
             finally:
                 self.bundle, self.tzr_bundle = old
 
         @functools.wraps(fn)
         def wrapped(*args):
+            if _const_bytes[0] is None:
+                # the bundle/ref operands ride EVERY call; their byte
+                # total is shape-constant per wrapper (the same-shape
+                # data-swap contract), so one tree walk amortizes over
+                # all dispatches
+                _const_bytes[0] = _obs.trace.nbytes_of(
+                    ((self.bundle, self.tzr_bundle),
+                     self._ref_runtime())
+                )
+            _obs.note_transfer(site, _const_bytes[0], args)
             return inner(
                 (self.bundle, self.tzr_bundle), self._ref_runtime(),
                 args,

@@ -1,0 +1,130 @@
+"""pint_tpu.obs — the dispatch flight recorder (PR 2).
+
+Three layers (each its own module) plus the chokepoint helpers below:
+
+- :mod:`pint_tpu.obs.trace` — nested thread-safe spans with monotonic
+  clocks and explicit device fencing (off by default; ~free when off).
+- :mod:`pint_tpu.obs.metrics` — always-on counters/gauges/histograms;
+  ``snapshot()`` subsumes the old ``GuardStats.snapshot()``.
+- :mod:`pint_tpu.obs.export` — Perfetto/Chrome-trace JSON, bench.py's
+  one-line summary, and the human ``flight_report``.
+
+The helpers here are the accounting hooks the compile chokepoint
+(models/timing_model.py::CompiledModel.jit) calls: they record XLA
+(re)traces, baked-module transport pressure, and operand bytes.  They
+live in obs so the chokepoint stays one import away from the recorder
+and tools/lint_obs.py can statically verify the wiring.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pint_tpu.obs import metrics, trace
+from pint_tpu.obs.trace import TRACER
+
+__all__ = [
+    "metrics",
+    "trace",
+    "TRACER",
+    "note_trace",
+    "note_baked_module",
+    "note_transfer",
+]
+
+# pre-register the canonical metrics so every snapshot() carries the
+# full key set (a counter that never fired reads 0, not KeyError —
+# bench JSON and dashboards need stable schemas)
+for _name, _unit in (
+    ("dispatch.count", ""),
+    ("dispatch.guarded", ""),
+    ("compile.traces", ""),
+    ("compile.recompiles", ""),
+    ("transfer.bytes_to_device", "bytes"),
+    ("transport.near_413", ""),
+    ("fit.count", ""),
+    ("ingest.count", ""),
+    ("ingest.toas", "TOAs"),
+):
+    metrics.counter(_name, unit=_unit)
+del _name, _unit
+
+#: the axon remote-compile transport rejects requests around this size
+#: (HTTP 413 measured at ~256 MB, r5); a baked module whose literal
+#: estimate crosses NEAR_413_FRACTION of it bumps transport.near_413.
+TRANSPORT_LIMIT_BYTES = int(
+    os.environ.get("PINT_TPU_TRANSPORT_LIMIT_BYTES", str(256 * 2**20))
+)
+NEAR_413_FRACTION = 0.25
+
+#: measured floor for baked-literal HLO text per TOA (CLAUDE.md /
+#: docs/parallelism.md: ~240 bytes/TOA at bench configs; the n=32768
+#: dense step measured ~488) — the estimate below takes the max of
+#: this floor and the bundle's actual numeric bytes.
+HLO_BYTES_PER_TOA = 240.0
+
+
+def note_trace(site: str, retrace: bool):
+    """Called from INSIDE a jitted function's Python body, which jax
+    executes exactly once per XLA (re)trace — so this host side effect
+    is an exact compile counter.  ``retrace=True`` marks a trace
+    beyond the wrapper's first: a RECOMPILE (bundle swap, ladder
+    device pin, shape change).  Recompiles must stay 0 across a refit
+    loop (the r5 "refits are one dispatch" invariant; bench.py and
+    tests/test_obs.py gate on it)."""
+    metrics.counter("compile.traces", help="XLA (re)traces").inc()
+    if retrace:
+        metrics.counter(
+            "compile.recompiles",
+            help="re-traces of an existing wrapper",
+        ).inc()
+        TRACER.event("recompile", "compile", site=site)
+
+
+def note_baked_module(site: str, ntoa: int, bundle=None):
+    """Record transport pressure of a baked-constant lowering: the
+    bundle columns become HLO literals, and the remote-compile
+    transport 413s near TRANSPORT_LIMIT_BYTES (r5).  The default
+    bake/argue cutover (2e5 TOAs) keeps baked modules far from the
+    limit; a raised $PINT_TPU_BAKE_THRESHOLD is how one sneaks up on
+    it — this near-miss counter is the early warning."""
+    est = HLO_BYTES_PER_TOA * max(int(ntoa), 0)
+    if bundle is not None:
+        est = max(est, float(trace.nbytes_of(bundle)))
+    metrics.gauge(
+        "transport.baked_bytes_est", unit="bytes",
+        help="estimated baked-literal HLO bytes of the last module",
+    ).set(est)
+    if est >= NEAR_413_FRACTION * TRANSPORT_LIMIT_BYTES:
+        metrics.counter(
+            "transport.near_413",
+            help="baked modules near the 413 transport limit",
+        ).inc()
+        TRACER.event(
+            "near-413", "transport", site=site, ntoa=int(ntoa),
+            est_bytes=est, limit_bytes=TRANSPORT_LIMIT_BYTES,
+        )
+
+
+def note_transfer(site: str, const_bytes: int, args) -> None:
+    """Account operand bytes riding a dispatch as runtime arguments
+    (argument-fed lowerings ship the whole bundle per call; baked ones
+    only the delta vector).  ``const_bytes`` is the precomputed size
+    of per-wrapper-constant operands (bundle + reference pytree) so
+    the per-dispatch cost is one small tree walk over ``args``."""
+    import jax
+
+    try:
+        # inlined under an outer trace (vmap/jit): no host dispatch
+        # happens here, so counting operand bytes would double-book
+        # the outer dispatch's transfer
+        if not jax.core.trace_state_clean():
+            return
+    except Exception:
+        pass
+    nb = const_bytes + trace.nbytes_of(args)
+    metrics.counter(
+        "transfer.bytes_to_device", unit="bytes",
+        help="operand bytes shipped as runtime arguments",
+    ).inc(nb)
+    TRACER.annotate(bytes_to_device=nb, site=site)

@@ -1,0 +1,237 @@
+"""Exporters for the dispatch flight recorder.
+
+Three consumers, three shapes (reference parity: none — the reference
+framework has no trace surface; this complements
+``profiler.device_trace``'s XLA-internal profile with the framework's
+own host-side span view, which survives the axon tunnel where the
+on-chip profiler often cannot run):
+
+- :func:`to_chrome_trace` / :func:`write_chrome_trace` — Chrome Trace
+  Event Format JSON, loadable in Perfetto (https://ui.perfetto.dev)
+  and ``chrome://tracing``; :func:`load_chrome_trace` round-trips it
+  back into Span/Event objects (tools/traceview.py and the exporter
+  tests build on this).
+- :func:`summary` — a small flat dict (recompiles, bytes to device,
+  max span) merged into bench.py's single JSON line.
+- :func:`flight_report` — the human post-mortem attached to every
+  fitter (``Fitter.flight_report()``, sibling of PR 1's
+  ``guard_report``): top spans, recompiles, bytes, rung history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+
+from pint_tpu.obs import metrics as _metrics
+from pint_tpu.obs import trace as _trace
+from pint_tpu.obs.trace import Event, Span
+
+
+def to_chrome_trace(spans=None, events=None, tracer=None) -> dict:
+    """Chrome Trace Event Format dict (Perfetto-loadable).
+
+    Spans become complete ('X') events with microsecond timestamps on
+    the perf_counter timebase; instant events become 'i' markers; the
+    full metrics snapshot rides in ``otherData`` so one file carries
+    both signals."""
+    tracer = tracer or _trace.TRACER
+    spans = tracer.spans() if spans is None else spans
+    events = tracer.events() if events is None else events
+    pid = os.getpid()
+    out = []
+    for sp in spans:
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        out.append({
+            "ph": "X",
+            "name": sp.name,
+            "cat": sp.cat,
+            "ts": sp.t0 * 1e6,
+            "dur": (t1 - sp.t0) * 1e6,
+            "pid": pid,
+            "tid": sp.thread,
+            "args": {
+                "span_id": sp.span_id,
+                "parent_id": sp.parent_id,
+                **sp.attrs,
+            },
+        })
+    for ev in events:
+        out.append({
+            "ph": "i",
+            "s": "t",  # thread-scoped instant
+            "name": ev.name,
+            "cat": ev.cat,
+            "ts": ev.t * 1e6,
+            "pid": pid,
+            "tid": ev.thread,
+            "args": {"parent_id": ev.parent_id, **ev.attrs},
+        })
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "metrics": _metrics.snapshot(),
+            "dropped": tracer.dropped,
+        },
+    }
+
+
+def write_chrome_trace(path: str, spans=None, events=None,
+                       tracer=None) -> str:
+    """Serialize the trace to ``path``; returns the path."""
+    doc = to_chrome_trace(spans=spans, events=events, tracer=tracer)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def load_chrome_trace(source) -> tuple[list, list]:
+    """Round-trip a Chrome-trace dict / JSON file path back into
+    ``(spans, events)`` — the reconstruction tools/traceview.py and
+    the exporter tests run on."""
+    if isinstance(source, str):
+        with open(source) as f:
+            doc = json.load(f)
+    else:
+        doc = source
+    spans, events = [], []
+    for rec in doc.get("traceEvents", []):
+        args = dict(rec.get("args", {}))
+        if rec.get("ph") == "X":
+            t0 = rec["ts"] / 1e6
+            spans.append(Span(
+                name=rec["name"],
+                cat=rec.get("cat", "host"),
+                t0=t0,
+                t1=t0 + rec.get("dur", 0.0) / 1e6,
+                span_id=args.pop("span_id", None),
+                parent_id=args.pop("parent_id", None),
+                thread=rec.get("tid", 0),
+                attrs=args,
+            ))
+        elif rec.get("ph") == "i":
+            events.append(Event(
+                name=rec["name"],
+                cat=rec.get("cat", "event"),
+                t=rec["ts"] / 1e6,
+                parent_id=args.pop("parent_id", None),
+                thread=rec.get("tid", 0),
+                attrs=args,
+            ))
+    return spans, events
+
+
+def _by_name(spans):
+    """Aggregate spans by name: (total_s, count, max_s), descending."""
+    agg = defaultdict(lambda: [0.0, 0, 0.0])
+    for sp in spans:
+        a = agg[f"{sp.cat}:{sp.name}"]
+        a[0] += sp.dur_s
+        a[1] += 1
+        a[2] = max(a[2], sp.dur_s)
+    return sorted(agg.items(), key=lambda kv: kv[1][0], reverse=True)
+
+
+def summary(tracer=None) -> dict:
+    """The one-line telemetry dict bench.py folds into its JSON output
+    next to the guard block: dispatch/recompile counts, bytes to
+    device, and the largest recorded span."""
+    tracer = tracer or _trace.TRACER
+    snap = _metrics.snapshot()
+    spans = tracer.spans()
+    max_span = max(spans, key=lambda sp: sp.dur_s, default=None)
+    return {
+        "dispatches": snap.get("dispatch.count", 0),
+        "recompiles": snap.get("compile.recompiles", 0),
+        "traces": snap.get("compile.traces", 0),
+        "bytes_to_device": snap.get("transfer.bytes_to_device", 0),
+        "near_413": snap.get("transport.near_413", 0),
+        "spans": len(spans),
+        "max_span_ms": (
+            None if max_span is None
+            else round(max_span.dur_s * 1e3, 3)
+        ),
+        "max_span": None if max_span is None else max_span.name,
+    }
+
+
+def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
+    """Human-readable post-mortem of the recorded flight.
+
+    Works with tracing disabled too (metrics are always on): the span
+    section then just points at how to enable the recorder."""
+    tracer = tracer or _trace.TRACER
+    snap = _metrics.snapshot()
+    spans = tracer.spans()
+    events = tracer.events()
+    lines = ["== flight report =="]
+
+    if guard_report is not None:
+        lines.append(
+            f"served by rung {guard_report.rung!r} "
+            f"(index {guard_report.rung_index}) at {guard_report.site}"
+        )
+        for rung, err in guard_report.history:
+            lines.append(f"  tripped {rung!r}: {err}")
+
+    lines.append(
+        "dispatches={d} (guarded {g})  traces={t}  recompiles={r}  "
+        "bytes_to_device={b}".format(
+            d=snap.get("dispatch.count", 0),
+            g=snap.get("dispatch.guarded", 0),
+            t=snap.get("compile.traces", 0),
+            r=snap.get("compile.recompiles", 0),
+            b=snap.get("transfer.bytes_to_device", 0),
+        )
+    )
+    guard_bits = {
+        k.split(".", 1)[1]: v
+        for k, v in snap.items()
+        if k.startswith("guard.") and v not in (0, None)
+    }
+    if guard_bits:
+        lines.append(
+            "guard: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(guard_bits.items())
+            )
+        )
+    if snap.get("transport.near_413", 0):
+        lines.append(
+            f"transport: {snap['transport.near_413']} baked module(s) "
+            "neared the ~256 MB 413 limit (lower "
+            "$PINT_TPU_BAKE_THRESHOLD; docs/observability.md)"
+        )
+
+    if not spans:
+        lines.append(
+            "no spans recorded — enable the recorder with "
+            "pint_tpu.obs.trace.enable() or PINT_TPU_TRACE=1"
+        )
+    else:
+        lines.append(
+            f"{len(spans)} spans"
+            + (f" ({tracer.dropped} dropped)" if tracer.dropped else "")
+        )
+        lines.append(
+            f"  {'span':<40}{'calls':>7}{'total s':>10}{'max ms':>10}"
+        )
+        for name, (tot, n, mx) in _by_name(spans)[:top]:
+            lines.append(
+                f"  {name:<40}{n:>7}{tot:>10.3f}{mx * 1e3:>10.2f}"
+            )
+
+    interesting = [
+        ev for ev in events
+        if ev.cat in ("compile", "guard", "transport")
+        or ev.name in ("recompile", "fallback", "near-413")
+    ]
+    if interesting:
+        lines.append("events:")
+        for ev in interesting[-top:]:
+            attrs = " ".join(
+                f"{k}={v}" for k, v in ev.attrs.items()
+            )
+            lines.append(f"  {ev.name} [{ev.cat}] {attrs}".rstrip())
+    return "\n".join(lines)

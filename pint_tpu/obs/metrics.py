@@ -1,0 +1,238 @@
+"""Metrics registry: counters / gauges / histograms for the axon path.
+
+Reference parity: none — the reference framework has no metrics
+surface; this is TPU-service infrastructure (ROADMAP north-star:
+"fast as the hardware allows" requires knowing where time and bytes
+go).  Unlike the tracer (pint_tpu.obs.trace), metrics are ALWAYS on:
+each is a lock-guarded scalar whose update costs are the same order as
+the pre-obs ``GuardStats`` counters they subsume — the per-dispatch
+cost stays inside the <2% guard budget bench.py asserts.
+
+``snapshot()`` is the canonical telemetry read; it subsumes and
+deprecates the bespoke ``runtime/guard.py::GuardStats.snapshot()``
+(which is now a thin adapter over this registry, kept for its existing
+consumers).  Canonical metric names and units are documented in
+docs/observability.md:
+
+==============================  =======  ==============================
+name                            kind     meaning
+==============================  =======  ==============================
+dispatch.count                  counter  host calls through a dispatch
+                                         chokepoint (cm.jit wrappers,
+                                         guarded sharded steps)
+dispatch.guarded                counter  ...of which ran under the
+                                         guard supervisor
+compile.traces                  counter  XLA (re)traces observed at the
+                                         cm.jit chokepoint
+compile.recompiles              counter  traces beyond the first per
+                                         wrapper — MUST stay 0 across a
+                                         refit loop (the r5 "refits are
+                                         one dispatch" invariant;
+                                         bench.py gates on it)
+transfer.bytes_to_device        counter  operand bytes shipped as
+                                         runtime arguments per dispatch
+transport.baked_bytes_est       gauge    estimated baked-literal HLO
+                                         bytes of the last
+                                         baked-lowering module
+transport.near_413              counter  baked modules whose estimate
+                                         crossed the near-miss fraction
+                                         of the transport's ~256 MB
+                                         413 limit (a raised
+                                         $PINT_TPU_BAKE_THRESHOLD is
+                                         how you get here)
+guard.retries                   counter  transient-failure retries
+guard.timeouts                  counter  watchdog expirations
+guard.transport_rejections      counter  deterministic 413-class
+                                         refusals
+guard.numerics_errors           counter  diagnosed non-finite refusals
+guard.fallbacks                 counter  ladder rung drops
+guard.watchdog_margin_s         gauge    last margin before timeout
+guard.watchdog_margin_frac_min  gauge    min margin/timeout fraction
+fallback.rung                   gauge    rung index that served the
+                                         last laddered computation
+fit.count                       counter  fit_toas invocations
+ingest.count / ingest.toas      counter  ingest calls / TOAs ingested
+==============================  =======  ==============================
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+
+class Counter:
+    """Monotonic (between resets) thread-safe counter."""
+
+    def __init__(self, name: str, unit: str = "", help: str = ""):
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-value gauge; ``None`` means never set since reset."""
+
+    def __init__(self, name: str, unit: str = "", help: str = ""):
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = None
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def set_min(self, v):
+        """Keep the minimum of the current value and ``v``."""
+        with self._lock:
+            if self._value is None or v < self._value:
+                self._value = v
+
+    def reset(self):
+        with self._lock:
+            self._value = None
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max + log2 buckets) — enough
+    to spot a bimodal dispatch-latency distribution (warm ~85 ms
+    tunnel round-trips vs multi-second compiles) without keeping
+    samples."""
+
+    def __init__(self, name: str, unit: str = "", help: str = ""):
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+            self._buckets: dict[int, int] = {}
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+            b = (
+                -1074  # subnormal floor bucket
+                if v <= 0.0
+                else int(math.floor(math.log2(v)))
+            )
+            self._buckets[b] = self._buckets.get(b, 0) + 1
+
+    @property
+    def value(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "mean": (
+                    self._sum / self._count if self._count else None
+                ),
+                "buckets_log2": dict(sorted(self._buckets.items())),
+            }
+
+
+class MetricsRegistry:
+    """Get-or-create registry; one flat namespace of dotted names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, cls, name: str, unit: str, help: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, unit, help)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, unit: str = "", help: str = "") -> Counter:
+        return self._get(Counter, name, unit, help)
+
+    def gauge(self, name: str, unit: str = "", help: str = "") -> Gauge:
+        return self._get(Gauge, name, unit, help)
+
+    def histogram(self, name: str, unit: str = "",
+                  help: str = "") -> Histogram:
+        return self._get(Histogram, name, unit, help)
+
+    def snapshot(self) -> dict:
+        """All metric values keyed by canonical name — the telemetry
+        read that subsumes GuardStats.snapshot() (bench.py's obs block
+        and Fitter.flight_report consume this)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.value for name, m in items}
+
+    def reset(self, prefix: str = ""):
+        """Reset metrics whose name starts with ``prefix`` (all, by
+        default) — between bench phases / test cases."""
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if name.startswith(prefix):
+                m.reset()
+
+
+#: the process-wide registry every chokepoint bumps
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, unit: str = "", help: str = "") -> Counter:
+    return REGISTRY.counter(name, unit, help)
+
+
+def gauge(name: str, unit: str = "", help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, unit, help)
+
+
+def histogram(name: str, unit: str = "", help: str = "") -> Histogram:
+    return REGISTRY.histogram(name, unit, help)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def reset(prefix: str = ""):
+    REGISTRY.reset(prefix)

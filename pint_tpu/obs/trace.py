@@ -1,0 +1,360 @@
+"""Dispatch flight recorder: nested, thread-safe wall-clock spans.
+
+Reference parity: the reference framework (src/pint/logging.py plus
+ad-hoc cProfile scripts) has no structured tracing; this module is the
+TPU-first replacement.  Every hard-won axon fact in CLAUDE.md — the
+~85 ms tunnel round-trip, silent recompiles on bundle swaps, HTTP 413
+rejections near 256 MB, fallback-ladder rungs — was discovered by
+one-off timing scripts after something went wrong.  The tracer makes
+those signals first-class: the dispatch chokepoints
+(models/timing_model.py::CompiledModel.jit via
+runtime/guard.py::dispatch_guard), the guard supervisor
+(guarded_call), the fallback ladder, every fitter's ``fit_toas`` and
+the TOA ingest pipeline all record spans here, so *where the time and
+bytes go* across compile -> transfer -> dispatch -> fence is a
+recorded artifact (export via pint_tpu.obs.export, CLI summary via
+tools/traceview.py) instead of archaeology.
+
+Design constraints:
+
+- **off by default, ~free when off**: ``Tracer.span`` returns a shared
+  no-op handle after ONE attribute check when ``enabled`` is False —
+  no allocation, no lock, no clock read.  The chokepoints sit on the
+  per-dispatch hot path whose total guard budget is <2% of the
+  north-star chain dispatch (bench.py asserts it); tracing must not
+  move that needle when off.  Enable with :func:`enable`, the scoped
+  :func:`tracing` context manager, or ``$PINT_TPU_TRACE=1``.
+- **monotonic clocks**: all timestamps are ``time.perf_counter()`` —
+  never wall-clock, which steps under NTP.
+- **explicit device fencing**: jax dispatch is ASYNC — a span closed
+  without fencing records dispatch latency, not compute.
+  :meth:`Tracer.fence` block_until_ready's every array leaf of an
+  arbitrary pytree (the shared :func:`fence_pytree`, which also fixes
+  profiler.py::PhaseTimer's fence for nested containers) inside a
+  ``fence``-category span, so the time the host spent *waiting on the
+  device* is itself visible in the trace.
+- **thread-safe**: the guard's watchdog runs attempts in worker
+  threads (runtime/guard.py::_attempt); the span stack is thread-local
+  and :meth:`Tracer.under` re-parents a worker thread's spans beneath
+  the caller's attempt span.
+
+Span taxonomy (category strings; full table in docs/observability.md):
+``fit`` > ``rung`` > ``compile``/``dispatch`` > ``attempt`` >
+``fence``/``validate``, plus ``ingest``, ``transfer``, ``phase``
+(profiler.py::PhaseTimer) and instant events ``recompile``, ``retry``,
+``watchdog-timeout``, ``transport-rejection``, ``fallback``,
+``numerics-error``, ``near-413``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    """One completed (or open) wall-clock interval."""
+
+    name: str
+    cat: str  # taxonomy category (module docstring)
+    t0: float  # perf_counter seconds
+    span_id: int
+    parent_id: int | None
+    thread: int
+    attrs: dict = field(default_factory=dict)
+    t1: float | None = None
+
+    @property
+    def dur_s(self) -> float:
+        return (self.t1 if self.t1 is not None else self.t0) - self.t0
+
+
+@dataclass
+class Event:
+    """An instant (zero-duration) marker: recompile, retry, fallback."""
+
+    name: str
+    cat: str
+    t: float
+    parent_id: int | None
+    thread: int
+    attrs: dict = field(default_factory=dict)
+
+
+def nbytes_of(value) -> int:
+    """Total device/host bytes of every array leaf of a pytree (leaves
+    without ``.nbytes`` — scalars, strings — count zero)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(value):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def fence_pytree(value):
+    """block_until_ready EVERY device-array leaf of an arbitrary
+    pytree (nested dicts/tuples/namedtuples/registered nodes).
+
+    The shared fence used by :meth:`Tracer.fence` and
+    profiler.py::PhaseTimer (whose pre-obs ``_Phase.fence`` only
+    fenced leaves it could reach by hand).  ``jax.block_until_ready``
+    tree-maps over the whole structure; the manual fallback covers jax
+    versions without it and non-pytree objects carrying arrays in
+    attributes is out of scope (register them as pytrees instead)."""
+    import jax
+
+    try:
+        jax.block_until_ready(value)
+    except Exception:
+        for leaf in jax.tree_util.tree_leaves(value):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+    return value
+
+
+class _NoopHandle:
+    """The shared disabled-path span handle: every method a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopHandle()
+
+
+class _SpanHandle:
+    """Context manager closing one span; ``set(**attrs)`` annotates."""
+
+    __slots__ = ("_tracer", "sp")
+
+    def __init__(self, tracer: "Tracer", sp: Span):
+        self._tracer = tracer
+        self.sp = sp
+
+    def __enter__(self):
+        return self
+
+    def set(self, **attrs):
+        self.sp.attrs.update(attrs)
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        sp = self.sp
+        sp.t1 = time.perf_counter()
+        if etype is not None:
+            sp.attrs.setdefault(
+                "error", f"{etype.__name__}: {evalue}"
+            )
+        tr = self._tracer
+        stack = tr._stack()
+        # pop by identity: robust to mispaired exits across re-entrant
+        # guard retries
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is sp:
+                del stack[i]
+                break
+        tr._record_span(sp)
+        return False
+
+
+class Tracer:
+    """Thread-safe span/event recorder with a bounded buffer.
+
+    ``capacity`` bounds the finished-span and event buffers; past it,
+    new records are counted in ``dropped`` instead of silently growing
+    (a week-long service run must not OOM on its own telemetry)."""
+
+    def __init__(self, capacity: int = 200_000):
+        self.enabled = False
+        self.capacity = capacity
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._events: list[Event] = []
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+
+    # -- span stack (thread-local) ---------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_span(self) -> Span | None:
+        st = getattr(self._tls, "stack", None)
+        return st[-1] if st else None
+
+    def current_span_id(self) -> int | None:
+        sp = self.current_span()
+        return None if sp is None else sp.span_id
+
+    @contextlib.contextmanager
+    def under(self, span: "Span | _SpanHandle | None"):
+        """Re-parent this THREAD's spans beneath ``span`` for the
+        with-block — used by the guard's watchdog worker so attempt
+        internals nest under the caller thread's attempt span."""
+        if not self.enabled or span is None:
+            yield
+            return
+        if isinstance(span, _SpanHandle):
+            span = span.sp
+        if not isinstance(span, Span):
+            # a no-op handle (tracing toggled between span() and here):
+            # never seed the stack with something lacking span_id
+            yield
+            return
+        stack = self._stack()
+        stack.append(span)
+        try:
+            yield
+        finally:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is span:
+                    del stack[i]
+                    break
+
+    # -- recording -------------------------------------------------------
+    def _record_span(self, sp: Span):
+        with self._lock:
+            if len(self._spans) < self.capacity:
+                self._spans.append(sp)
+            else:
+                self.dropped += 1
+
+    def span(self, name: str, cat: str = "host", **attrs):
+        """Open a span; use as a context manager.  The disabled path is
+        ONE attribute check returning a shared no-op handle."""
+        if not self.enabled:
+            return _NOOP
+        stack = self._stack()
+        sp = Span(
+            name=name,
+            cat=cat,
+            t0=time.perf_counter(),
+            span_id=next(self._ids),
+            parent_id=stack[-1].span_id if stack else None,
+            thread=threading.get_ident(),
+            attrs=dict(attrs),
+        )
+        stack.append(sp)
+        return _SpanHandle(self, sp)
+
+    def event(self, name: str, cat: str = "event", **attrs):
+        """Record an instant event under the current span (no-op when
+        disabled — counters for always-on accounting live in
+        pint_tpu.obs.metrics, not here)."""
+        if not self.enabled:
+            return
+        ev = Event(
+            name=name,
+            cat=cat,
+            t=time.perf_counter(),
+            parent_id=self.current_span_id(),
+            thread=threading.get_ident(),
+            attrs=dict(attrs),
+        )
+        with self._lock:
+            if len(self._events) < self.capacity:
+                self._events.append(ev)
+            else:
+                self.dropped += 1
+
+    def annotate(self, **attrs):
+        """Attach attributes to the current span, if any."""
+        if not self.enabled:
+            return
+        sp = self.current_span()
+        if sp is not None:
+            sp.attrs.update(attrs)
+
+    def attach_log(self, level: str, message: str, fields=None):
+        """Attach a structured log record to the current span (called
+        by pint_tpu.logging's dedup filter on every record it passes,
+        so a span carries the warnings emitted while it was open)."""
+        if not self.enabled:
+            return
+        sp = self.current_span()
+        if sp is not None:
+            entry = {"level": level, "message": message}
+            if fields:
+                entry["fields"] = dict(fields)
+            sp.attrs.setdefault("logs", []).append(entry)
+
+    def fence(self, value, name: str = "fence", **attrs):
+        """block_until_ready every array leaf of ``value`` inside a
+        ``fence`` span (async dispatch must never be timed as complete
+        without this); fences even when tracing is disabled so callers
+        can rely on the synchronization semantics."""
+        if not self.enabled:
+            return fence_pytree(value)
+        with self.span(name, "fence", bytes=nbytes_of(value), **attrs):
+            return fence_pytree(value)
+
+    # -- introspection / lifecycle ---------------------------------------
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def events(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self._events.clear()
+            self.dropped = 0
+
+
+#: the process-wide tracer every chokepoint records into
+TRACER = Tracer()
+
+if os.environ.get("PINT_TPU_TRACE", "") not in ("", "0", "off"):
+    TRACER.enabled = True
+
+
+def enable():
+    TRACER.enabled = True
+
+
+def disable():
+    TRACER.enabled = False
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def current_span_id() -> int | None:
+    return TRACER.current_span_id()
+
+
+@contextlib.contextmanager
+def tracing(clear: bool = False):
+    """Scoped enablement: ``with tracing(): fitter.fit_toas()`` records
+    the fit; ``clear=True`` starts from an empty buffer."""
+    if clear:
+        TRACER.clear()
+    prev = TRACER.enabled
+    TRACER.enabled = True
+    try:
+        yield TRACER
+    finally:
+        TRACER.enabled = prev

@@ -1,6 +1,17 @@
 """Profiling hooks (reference parity: SURVEY.md §5 — the reference has
 manual cProfile scripts; the TPU equivalent is jax.profiler traces plus
 lightweight per-phase wall timers).
+
+PR 2 (observability): ``PhaseTimer`` is now a veneer over the dispatch
+flight recorder's span core (pint_tpu/obs/trace.py) — each phase opens
+a ``phase``-category span on the global tracer (when the recorder is
+enabled), so ad-hoc profiling blocks land in the same Perfetto export
+as the framework's own compile/dispatch/fence spans, and the fence
+uses the SHARED :func:`pint_tpu.obs.trace.fence_pytree`, which
+block_until_ready's every array leaf of an arbitrary pytree (the old
+``_Phase._wait`` missed leaves inside containers jax couldn't flatten
+by hand — ISSUE 2 satellite fix).  The local totals/report() surface
+is unchanged (tests/test_property_checkpoint.py uses it).
 """
 
 from __future__ import annotations
@@ -11,6 +22,8 @@ from collections import defaultdict
 
 import jax
 
+from pint_tpu.obs.trace import TRACER, fence_pytree
+
 
 @contextlib.contextmanager
 def device_trace(logdir: str):
@@ -18,6 +31,10 @@ def device_trace(logdir: str):
 
         with device_trace("/tmp/trace"):
             fitter.fit_toas()
+
+    Complements pint_tpu.obs.export's host-side span trace: this is
+    the XLA-internal view (per-op device timelines), which often
+    cannot run through the axon tunnel — the obs spans always can.
     """
     jax.profiler.start_trace(logdir)
     try:
@@ -28,9 +45,10 @@ def device_trace(logdir: str):
 
 class _Phase:
     """Handle yielded by PhaseTimer: register the block's result with
-    .fence(value) so EVERY device leaf is block_until_ready'd before the
-    clock stops (jax dispatch is async — without a fence the timer
-    records dispatch latency, not compute)."""
+    .fence(value) so EVERY device leaf is block_until_ready'd before
+    the clock stops (jax dispatch is async — without a fence the timer
+    records dispatch latency, not compute).  Arbitrary pytrees fence
+    correctly (shared obs.trace.fence_pytree)."""
 
     def __init__(self):
         self._fences = []
@@ -40,10 +58,7 @@ class _Phase:
         return value
 
     def _wait(self):
-        for v in self._fences:
-            for leaf in jax.tree_util.tree_leaves(v):
-                if hasattr(leaf, "block_until_ready"):
-                    leaf.block_until_ready()
+        fence_pytree(self._fences)
 
 
 class PhaseTimer:
@@ -53,6 +68,10 @@ class PhaseTimer:
         with timer("fit") as ph:
             result = ph.fence(step(x))   # all leaves synced at exit
         print(timer.report())
+
+    Built on the flight-recorder span core: when the recorder is on
+    (obs.trace.enable() / $PINT_TPU_TRACE=1) each phase is also a
+    ``phase`` span in the global trace.
     """
 
     def __init__(self):
@@ -63,12 +82,13 @@ class PhaseTimer:
     def __call__(self, name: str):
         ph = _Phase()
         t0 = time.perf_counter()
-        try:
-            yield ph
-        finally:
-            ph._wait()
-            self.totals[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+        with TRACER.span(name, "phase"):
+            try:
+                yield ph
+            finally:
+                ph._wait()
+                self.totals[name] += time.perf_counter() - t0
+                self.counts[name] += 1
 
     def report(self) -> str:
         lines = [f"{'phase':<24}{'calls':>7}{'total s':>12}{'mean ms':>12}"]

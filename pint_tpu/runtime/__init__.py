@@ -17,6 +17,13 @@ here instead of by per-call-site workarounds:
   testable on the CPU mesh where none of these faults occur naturally.
 
 Design notes and the failure taxonomy live in docs/robustness.md.
+
+Observability (PR 2): every guard action is recorded by the dispatch
+flight recorder — spans for dispatches/attempts/rungs, events for
+retries/timeouts/fallbacks, and the counters now live in the obs
+metrics registry (``pint_tpu.obs.metrics.snapshot()`` is the canonical
+read; ``STATS`` is a compatibility adapter over it).  See
+docs/observability.md.
 """
 
 from pint_tpu.runtime import faults  # noqa: F401

@@ -43,6 +43,8 @@ from pint_tpu.exceptions import (
     RetriesExhausted,
     TransportRejection,
 )
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
 from pint_tpu.runtime import guard
 
 #: guard trips that drop a rung; anything else (shape errors, user
@@ -92,9 +94,18 @@ def run_ladder(rungs, site: str, validate=None):
     for i, (name, thunk) in enumerate(rungs):
         rung_site = f"{site}/rung:{name}"
         try:
-            out = thunk(rung_site)
-            if validate is not None:
-                validate(out, rung_site)
+            # each rung is a span: a trace of a degraded run shows the
+            # failed rungs' wall time alongside the serving rung's
+            with TRACER.span(
+                f"rung:{name}", "rung", site=site, rung_index=i
+            ):
+                out = thunk(rung_site)
+                if validate is not None:
+                    validate(out, rung_site)
+            obs_metrics.gauge(
+                "fallback.rung",
+                help="rung index that served the last ladder",
+            ).set(i)
             return out, GuardReport(
                 site=site, rung=name, rung_index=i,
                 history=tuple(history),
@@ -102,6 +113,13 @@ def run_ladder(rungs, site: str, validate=None):
         except TRIP_ERRORS as e:
             history.append((name, f"{type(e).__name__}: {e}"))
             guard.STATS.bump("fallbacks")
+            TRACER.event(
+                "fallback", "guard", site=site, rung=name,
+                error=f"{type(e).__name__}: {e}",
+                next_rung=(
+                    rungs[i + 1][0] if i + 1 < len(rungs) else None
+                ),
+            )
             if i + 1 < len(rungs):
                 warnings.warn(
                     f"guard tripped on rung {name!r} at {site} "

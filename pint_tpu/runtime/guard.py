@@ -57,6 +57,8 @@ from pint_tpu.exceptions import (
     TransientDispatchError,
     TransportRejection,
 )
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
 from pint_tpu.runtime import faults
 
 _UNSET = object()
@@ -174,49 +176,68 @@ def _device_ctx():
 
 # -- stats (bench.py's guard block reads these) --------------------------
 class GuardStats:
-    """Process-wide guard counters; thread-safe, resettable."""
+    """DEPRECATED adapter: the guard counters now live in the obs
+    metrics registry (pint_tpu/obs/metrics.py — PR 2's flight
+    recorder), where ``obs.metrics.snapshot()`` is the canonical
+    telemetry read.  This shim keeps every existing consumer working
+    (bench.py's guard block, tests/test_runtime_guard.py, the attr
+    reads like ``STATS.retries``) by delegating to the SAME registry
+    counters, so the two surfaces can never disagree."""
+
+    #: legacy attribute -> canonical metric name
+    _MAP = {
+        "dispatches": "dispatch.count",
+        "guarded": "dispatch.guarded",
+        "retries": "guard.retries",
+        "timeouts": "guard.timeouts",
+        "transport_rejections": "guard.transport_rejections",
+        "numerics_errors": "guard.numerics_errors",
+        "fallbacks": "guard.fallbacks",
+    }
+    _MARGIN_S = "guard.watchdog_margin_s"
+    _MARGIN_FRAC = "guard.watchdog_margin_frac_min"
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.reset()
+        # pre-resolve the counters off the hot path (bump() runs per
+        # dispatch inside the <2% guard budget)
+        self._counters = {
+            attr: obs_metrics.counter(name)
+            for attr, name in self._MAP.items()
+        }
+        self._margin_s = obs_metrics.gauge(self._MARGIN_S, unit="s")
+        self._margin_frac = obs_metrics.gauge(self._MARGIN_FRAC)
 
     def reset(self):
-        with self._lock:
-            self.dispatches = 0
-            self.guarded = 0
-            self.retries = 0
-            self.timeouts = 0
-            self.transport_rejections = 0
-            self.numerics_errors = 0
-            self.fallbacks = 0
-            self.last_watchdog_margin_s = None
-            self.min_watchdog_margin_frac = None
+        for c in self._counters.values():
+            c.reset()
+        self._margin_s.reset()
+        self._margin_frac.reset()
 
     def bump(self, name, n=1):
-        with self._lock:
-            setattr(self, name, getattr(self, name) + n)
+        self._counters[name].inc(n)
 
     def note_margin(self, margin_s, timeout_s):
-        with self._lock:
-            self.last_watchdog_margin_s = float(margin_s)
-            frac = float(margin_s) / float(timeout_s)
-            if (self.min_watchdog_margin_frac is None
-                    or frac < self.min_watchdog_margin_frac):
-                self.min_watchdog_margin_frac = frac
+        self._margin_s.set(float(margin_s))
+        self._margin_frac.set_min(float(margin_s) / float(timeout_s))
+
+    def __getattr__(self, name):
+        # legacy counter/gauge attribute reads (STATS.retries, ...)
+        counters = object.__getattribute__(self, "_counters")
+        if name in counters:
+            return counters[name].value
+        if name == "last_watchdog_margin_s":
+            return object.__getattribute__(self, "_margin_s").value
+        if name == "min_watchdog_margin_frac":
+            return object.__getattribute__(self, "_margin_frac").value
+        raise AttributeError(name)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "dispatches": self.dispatches,
-                "guarded": self.guarded,
-                "retries": self.retries,
-                "timeouts": self.timeouts,
-                "transport_rejections": self.transport_rejections,
-                "numerics_errors": self.numerics_errors,
-                "fallbacks": self.fallbacks,
-                "watchdog_margin_s": self.last_watchdog_margin_s,
-                "watchdog_margin_frac": self.min_watchdog_margin_frac,
-            }
+        """DEPRECATED: prefer pint_tpu.obs.metrics.snapshot() (the
+        superset).  Kept byte-compatible for existing consumers."""
+        out = {attr: c.value for attr, c in self._counters.items()}
+        out["watchdog_margin_s"] = self._margin_s.value
+        out["watchdog_margin_frac"] = self._margin_frac.value
+        return out
 
 
 STATS = GuardStats()
@@ -257,13 +278,16 @@ def classify_error(e: BaseException) -> str:
 
 
 # -- the supervisor ------------------------------------------------------
-def _attempt(fn, args, site, timeout):
+def _attempt(fn, args, site, timeout, obs_span=None):
     """One supervised attempt: fault hooks + optional watchdog thread.
 
     With a timeout, the attempt runs in a daemon worker (join with
     timeout; a wedged attempt is abandoned, not killed — Python cannot
     interrupt a thread blocked in a C extension).  The ladder-device
     pin is re-entered inside the executing thread (see ladder_device).
+    ``obs_span`` is the caller's attempt span: spans opened inside the
+    worker thread re-parent beneath it (TRACER.under), and the
+    watchdog margin is attached to it on success.
     """
     if not timeout:
         with _device_ctx():
@@ -275,7 +299,7 @@ def _attempt(fn, args, site, timeout):
 
     def work():
         try:
-            with _device_ctx():
+            with TRACER.under(obs_span), _device_ctx():
                 faults.maybe_hang(site)
                 faults.maybe_raise(site)
                 cell["ok"] = fn(*args)
@@ -290,7 +314,10 @@ def _attempt(fn, args, site, timeout):
     t.join(timeout)
     if t.is_alive():
         raise GuardTimeout(site=site, timeout=timeout)
-    STATS.note_margin(timeout - (time.monotonic() - t0), timeout)
+    margin = timeout - (time.monotonic() - t0)
+    STATS.note_margin(margin, timeout)
+    if obs_span is not None:
+        obs_span.set(watchdog_margin_s=round(margin, 4))
     if "err" in cell:
         raise cell["err"]
     return cell["ok"]
@@ -310,16 +337,31 @@ def guarded_call(fn, args=(), site="", config=None, timeout=_UNSET,
     attempts = max(0, int(cfg.max_retries)) + 1
     delay = cfg.backoff_base
     for attempt in range(1, attempts + 1):
+        # span per attempt (recorder off: shared no-op handle), so the
+        # trace shows each retry's wall time and watchdog margin
+        h = TRACER.span(
+            "attempt", "attempt", site=site, n=attempt,
+            timeout_s=timeout, is_compile=bool(is_compile),
+        )
         try:
-            return _attempt(fn, args, site, timeout)
+            with h:
+                return _attempt(fn, args, site, timeout, obs_span=h)
         except GuardTimeout:
             STATS.bump("timeouts")
+            TRACER.event(
+                "watchdog-timeout", "guard", site=site,
+                timeout_s=timeout, attempt=attempt,
+            )
             if attempt == attempts:
                 raise
         except Exception as e:
             kind = classify_error(e)
             if kind == "rejection":
                 STATS.bump("transport_rejections")
+                TRACER.event(
+                    "transport-rejection", "guard", site=site,
+                    error=f"{type(e).__name__}: {e}",
+                )
                 if isinstance(e, TransportRejection):
                     raise
                 raise TransportRejection(str(e)) from e
@@ -328,6 +370,7 @@ def guarded_call(fn, args=(), site="", config=None, timeout=_UNSET,
             if attempt == attempts:
                 raise RetriesExhausted(site, attempt, e) from e
         STATS.bump("retries")
+        TRACER.event("retry", "guard", site=site, attempt=attempt)
         time.sleep(
             min(delay, cfg.backoff_max)
             * (1.0 + cfg.jitter * random.random())
@@ -363,17 +406,25 @@ def dispatch_guard(fn, site: str):
         if not _host_side(args):
             return fn(*args)  # inlining under an outer trace
         STATS.bump("dispatches")
-        if (_disabled_depth > 0
-                or os.environ.get("PINT_TPU_GUARD") == "off"):
-            with _device_ctx():  # the ladder pin still applies
-                return fn(*args)
-        STATS.bump("guarded")
         devkey = None if _ladder_dev is None else str(_ladder_dev)
-        out = guarded_call(
-            fn, args, site=site, is_compile=devkey not in compiled_for
+        first = devkey not in compiled_for
+        # flight-recorder span: 'compile' on the wrapper's first call
+        # per ladder device (trace + XLA compile + run), 'dispatch' on
+        # warm calls — the distinct-category contract tests/bench and
+        # docs/observability.md rely on.  Off path: one attr check.
+        h = TRACER.span(
+            site, "compile" if first else "dispatch", site=site
         )
-        compiled_for.add(devkey)
-        return out
+        with h:
+            if (_disabled_depth > 0
+                    or os.environ.get("PINT_TPU_GUARD") == "off"):
+                h.set(guarded=False)
+                with _device_ctx():  # the ladder pin still applies
+                    return fn(*args)
+            STATS.bump("guarded")
+            out = guarded_call(fn, args, site=site, is_compile=first)
+            compiled_for.add(devkey)
+            return out
 
     if hasattr(fn, "lower"):
         guarded.lower = fn.lower
@@ -496,22 +547,30 @@ def validate_finite(values: dict, site: str = "",
     one function, so a NaN can never be timed, committed, or published
     from any of them.  Fault injection poisons a COPY here (nan kind);
     the poisoned copy is refused, never returned."""
-    mats = {
-        name: np.asarray(v)
-        for name, v in values.items()
-        if v is not None
-    }
-    mats = faults.corrupt(mats, site)
-    bad = [n for n, a in mats.items() if not np.all(np.isfinite(a))]
-    if bad:
-        diag = diagnose_nonfinite(mats)
-        STATS.bump("numerics_errors")
-        raise PintTpuNumericsError(
-            f"{what} produced non-finite values ({', '.join(bad)}) at "
-            f"{site or 'unknown site'}: {diag.summary}",
-            diagnosis=diag,
-        )
-    return mats
+    # materialization IS the device fence here (np.asarray blocks on
+    # the value) — recorded as a validate-category span so the wait
+    # shows up in the flight trace
+    with TRACER.span("validate", "validate", site=site, what=what):
+        mats = {
+            name: np.asarray(v)
+            for name, v in values.items()
+            if v is not None
+        }
+        mats = faults.corrupt(mats, site)
+        bad = [n for n, a in mats.items() if not np.all(np.isfinite(a))]
+        if bad:
+            diag = diagnose_nonfinite(mats)
+            STATS.bump("numerics_errors")
+            TRACER.event(
+                "numerics-error", "guard", site=site,
+                hazard=diag.hazard, what=what,
+            )
+            raise PintTpuNumericsError(
+                f"{what} produced non-finite values ({', '.join(bad)}) "
+                f"at {site or 'unknown site'}: {diag.summary}",
+                diagnosis=diag,
+            )
+        return mats
 
 
 def ensure_scan_finite(result, fail_msg: str, site: str = ""):
@@ -527,6 +586,10 @@ def ensure_scan_finite(result, fail_msg: str, site: str = ""):
         # gone — diagnose from what survived, flagging the iteration
         diag = diagnose_nonfinite({"x": np.asarray(x)})
         STATS.bump("numerics_errors")
+        TRACER.event(
+            "numerics-error", "guard", site=site, hazard=diag.hazard,
+            what="fit loop (frozen scan)",
+        )
         raise PintTpuNumericsError(
             f"{fail_msg} (chi2 went non-finite at iteration {first}; "
             f"the scan froze on the last finite state) at "

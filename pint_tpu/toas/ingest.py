@@ -25,33 +25,58 @@ BARY_SITES = {"@", "bat", "barycenter", "ssb"}
 
 
 def ingest_barycentric(toas: TOAs) -> TOAs:
-    """Site-'@' ingest: times are TDB at the barycenter; zero geometry."""
-    bad = [o for o in toas.obs if o.lower() not in BARY_SITES]
-    if bad:
-        raise PintTpuError(
-            f"ingest_barycentric: non-barycentric sites {sorted(set(bad))}"
-        )
-    toas.t_tdb = TimeArray(toas.t.mjd_int, toas.t.sec, "tdb")
-    n = len(toas)
-    toas.clock_corr_s = np.zeros(n)
-    toas.ssb_obs_pos = np.zeros((n, 3))
-    toas.ssb_obs_vel = np.zeros((n, 3))
-    toas.obs_sun_pos = np.zeros((n, 3))
-    return toas
+    """Site-'@' ingest: times are TDB at the barycenter; zero geometry.
+
+    Spanned separately from :func:`ingest` because simulation
+    scaffolding (make_test_pulsar) calls it directly."""
+    from pint_tpu.obs.trace import TRACER
+
+    with TRACER.span(
+        "ingest:barycentric", "ingest", ntoa=len(toas)
+    ):
+        bad = [o for o in toas.obs if o.lower() not in BARY_SITES]
+        if bad:
+            raise PintTpuError(
+                "ingest_barycentric: non-barycentric sites "
+                f"{sorted(set(bad))}"
+            )
+        toas.t_tdb = TimeArray(toas.t.mjd_int, toas.t.sec, "tdb")
+        n = len(toas)
+        toas.clock_corr_s = np.zeros(n)
+        toas.ssb_obs_pos = np.zeros((n, 3))
+        toas.ssb_obs_vel = np.zeros((n, 3))
+        toas.obs_sun_pos = np.zeros((n, 3))
+        return toas
 
 
 def ingest(toas: TOAs, ephem: str = "builtin", planets: bool = False,
            include_bipm: bool = True, bipm_version: str = "BIPM2021",
            limits: str = "warn", model=None) -> TOAs:
-    """Full observatory ingest (clock chain -> TDB -> posvels)."""
-    if all(o.lower() in BARY_SITES for o in toas.obs):
-        return ingest_barycentric(toas)
-    from pint_tpu.toas.ingest_topo import ingest_topocentric
+    """Full observatory ingest (clock chain -> TDB -> posvels).
 
-    return ingest_topocentric(
-        toas, ephem=ephem, planets=planets, include_bipm=include_bipm,
-        bipm_version=bipm_version, limits=limits, model=model,
-    )
+    Runs under an ``ingest``-category flight-recorder span
+    (pint_tpu/obs): host ingest is a fixed per-dataset cost that a
+    trace should show next to the compile/dispatch spans it feeds."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.obs.trace import TRACER
+
+    obs_metrics.counter("ingest.count", help="ingest calls").inc()
+    obs_metrics.counter(
+        "ingest.toas", unit="TOAs", help="TOAs ingested"
+    ).inc(len(toas))
+    with TRACER.span(
+        "ingest", "ingest", ntoa=len(toas), ephem=ephem,
+        planets=bool(planets),
+    ):
+        if all(o.lower() in BARY_SITES for o in toas.obs):
+            return ingest_barycentric(toas)
+        from pint_tpu.toas.ingest_topo import ingest_topocentric
+
+        return ingest_topocentric(
+            toas, ephem=ephem, planets=planets,
+            include_bipm=include_bipm, bipm_version=bipm_version,
+            limits=limits, model=model,
+        )
 
 
 def ingest_for_model(toas: TOAs, model, **kw) -> TOAs:

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import mpmath as mp
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from hypothesis_compat import given, settings, st
 
 from pint_tpu.ops.dd import DD, dd_abs, dd_sqrt, dd_where
 from pint_tpu.ops.phase import Phase

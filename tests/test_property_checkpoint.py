@@ -4,8 +4,7 @@ checkpoint/resume and profiler smoke tests."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from hypothesis_compat import given, settings, st
 
 from pint_tpu.simulation import make_test_pulsar
 from pint_tpu.timebase.hostdd import HostDD

@@ -4,7 +4,7 @@ behavior (tests/test_precision.py-style hypothesis round-trips)."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from hypothesis_compat import given, settings, st
 
 from pint_tpu.exceptions import PintTpuError
 from pint_tpu.timebase import HostDD, TimeArray, tai_minus_utc

@@ -1,0 +1,226 @@
+"""Static check for dispatch paths that bypass the flight recorder.
+
+PR 2's observability contract: every host-side device dispatch in the
+framework routes through an instrumented chokepoint —
+``CompiledModel.jit`` (models/timing_model.py, which counts XLA
+(re)traces and operand bytes) wrapping ``dispatch_guard``
+(runtime/guard.py, which opens the compile/dispatch spans), or
+``dispatch_guard`` directly for non-model programs (parallel/gls.py).
+A NEW code path that calls bare ``jax.jit`` for a host dispatch would
+silently vanish from traces, the recompile gate, and the guard — the
+exact blindness this PR exists to remove — and nothing at runtime can
+notice the absence.  Like tools/lint_scalarmath.py for the scalar
+-transcendental hazard, this linter catches it at review time instead.
+
+Rules (syntactic, like the scalarmath linter):
+
+1. any ``jax.jit`` reference (call, decorator, ``functools.partial``
+   argument) in ``pint_tpu/`` is flagged UNLESS it is
+
+   - inside ``models/timing_model.py`` (the instrumented chokepoint
+     itself),
+   - under ``ops/`` (kernel-level jits that inline under cm.jit —
+     their host-callable use is test-only),
+   - under ``templates/`` (host-scale photon-template mini-fits, a
+     CPU path with no axon dispatch),
+   - lexically wrapped in a ``dispatch_guard(...)`` call (the
+     parallel/gls.py idiom), or
+   - suppressed with ``# lint: obs-ok`` on the line (justify in an
+     adjacent comment).
+
+2. chokepoint meta-checks — the instrumentation itself must stay
+   wired: ``dispatch_guard`` must open recorder spans
+   (``TRACER.span``), ``CompiledModel.jit`` must route through
+   ``dispatch_guard`` and count traces (``note_trace``), and every
+   ``fit_toas`` defined under ``pint_tpu/fitting/`` must carry the
+   ``@record_fit`` span decorator.
+
+Run: ``python tools/lint_obs.py [paths...]`` (default: pint_tpu/).
+Exit status 1 when findings exist.  Wired into tier-1 as
+tests/test_lint_obs.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+SUPPRESS_PRAGMA = "lint: obs-ok"
+
+#: path parts that exempt a file from rule 1 (rationale in docstring)
+ALLOWED_FILES = {"timing_model.py"}
+ALLOWED_DIRS = {"ops", "templates"}
+
+
+class _Finding:
+    def __init__(self, path, lineno, detail):
+        self.path = path
+        self.lineno = lineno
+        self.detail = detail
+
+    def __str__(self):
+        return f"{self.path}:{self.lineno}: {self.detail}"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _guarded_jit_nodes(tree) -> set:
+    """ids of jax.jit Attribute nodes lexically inside a
+    dispatch_guard(...) call — those route through the recorder."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name != "dispatch_guard":
+            continue
+        for sub in ast.walk(node):
+            if _is_jax_jit(sub):
+                out.add(id(sub))
+    return out
+
+
+def lint_source(source: str, path: str = "<string>") -> list:
+    """Rule 1 over one module's source; returns findings."""
+    p = Path(path)
+    if p.name in ALLOWED_FILES or ALLOWED_DIRS & set(p.parts):
+        return []
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    guarded = _guarded_jit_nodes(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not _is_jax_jit(node) or id(node) in guarded:
+            continue
+        line = (
+            lines[node.lineno - 1]
+            if node.lineno - 1 < len(lines) else ""
+        )
+        if SUPPRESS_PRAGMA in line:
+            continue
+        findings.append(_Finding(
+            path, node.lineno,
+            "bare jax.jit dispatch path bypasses the flight recorder "
+            "— route through CompiledModel.jit or wrap in "
+            "dispatch_guard(...) (runtime/guard.py) so spans/metrics/"
+            "watchdog cover it; suppress with '# lint: obs-ok' only "
+            "for non-dispatch uses (docs/observability.md)",
+        ))
+    return sorted(findings, key=lambda f: f.lineno)
+
+
+def _fn_source_has(tree, source, qualname: str, needles) -> list:
+    """Missing ``needles`` in the named (possibly nested/method)
+    function's source segment; [] when all present."""
+    parts = qualname.split(".")
+
+    def find(body, names):
+        for node in body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)
+            ) and node.name == names[0]:
+                if len(names) == 1:
+                    return node
+                return find(node.body, names[1:])
+        return None
+
+    node = find(tree.body, parts)
+    if node is None:
+        return [f"function {qualname} not found"]
+    seg = ast.get_source_segment(source, node) or ""
+    return [f"{qualname} no longer contains {n!r}" for n in needles
+            if n not in seg]
+
+
+def check_chokepoints(pkg_root) -> list:
+    """Rule 2: the instrumented chokepoints stay instrumented."""
+    pkg_root = Path(pkg_root)
+    findings = []
+
+    guard_py = pkg_root / "runtime" / "guard.py"
+    src = guard_py.read_text()
+    for miss in _fn_source_has(
+        ast.parse(src), src, "dispatch_guard", ("TRACER.span",)
+    ):
+        findings.append(_Finding(
+            str(guard_py), 1,
+            f"{miss} — the dispatch chokepoint must open flight-"
+            "recorder spans",
+        ))
+
+    tm_py = pkg_root / "models" / "timing_model.py"
+    src = tm_py.read_text()
+    for miss in _fn_source_has(
+        ast.parse(src), src, "CompiledModel.jit",
+        ("dispatch_guard(", "note_trace("),
+    ):
+        findings.append(_Finding(
+            str(tm_py), 1,
+            f"{miss} — cm.jit must stay guarded and count (re)traces",
+        ))
+
+    for py in sorted((pkg_root / "fitting").rglob("*.py")):
+        src = py.read_text()
+        for node in ast.walk(ast.parse(src)):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "fit_toas"
+            ):
+                deco = {
+                    d.id if isinstance(d, ast.Name)
+                    else d.attr if isinstance(d, ast.Attribute)
+                    else None
+                    for d in node.decorator_list
+                }
+                if "record_fit" not in deco:
+                    findings.append(_Finding(
+                        str(py), node.lineno,
+                        "fit_toas without @record_fit — every fitter "
+                        "fit must open the fit-level span "
+                        "(fitting/base.py::record_fit)",
+                    ))
+    return findings
+
+
+def lint_paths(paths) -> list:
+    findings = []
+    for root in paths:
+        root = Path(root)
+        files = (
+            [root] if root.is_file() else sorted(root.rglob("*.py"))
+        )
+        for py in files:
+            findings.extend(lint_source(py.read_text(), str(py)))
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pkg = Path(__file__).resolve().parent.parent / "pint_tpu"
+    paths = argv or [pkg]
+    findings = lint_paths(paths)
+    if not argv:
+        findings += check_chokepoints(pkg)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} obs-bypass finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

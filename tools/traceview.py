@@ -1,0 +1,139 @@
+"""Summarize a recorded flight trace from the command line.
+
+Reads a Chrome-trace/Perfetto JSON written by
+``pint_tpu.obs.export.write_chrome_trace`` (or bench/test runs with
+``$PINT_TPU_TRACE=1``) and prints the post-mortem a human wants before
+opening Perfetto: top spans by total wall time, compile/recompile
+events, bytes to device, guard activity, and the fallback-ladder rung
+history.
+
+Run::
+
+    python tools/traceview.py trace.json [--top 15] [--cat dispatch]
+
+See docs/observability.md for the capture workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+# importable both as a repo script and with tools/ on sys.path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pint_tpu.obs.export import load_chrome_trace  # noqa: E402
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def summarize(path: str, top: int = 15, cat: str | None = None) -> str:
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    spans, events = load_chrome_trace(doc)
+    metrics = doc.get("otherData", {}).get("metrics", {})
+    dropped = doc.get("otherData", {}).get("dropped", 0)
+    if cat:
+        spans = [sp for sp in spans if sp.cat == cat]
+
+    lines = [f"== {path} =="]
+    if spans:
+        t_lo = min(sp.t0 for sp in spans)
+        t_hi = max(sp.t1 for sp in spans)
+        lines.append(
+            f"{len(spans)} spans, {len(events)} events over "
+            f"{t_hi - t_lo:.3f} s"
+            + (f" ({dropped} dropped)" if dropped else "")
+        )
+    else:
+        lines.append(f"no spans ({len(events)} events)")
+
+    # -- top spans aggregated by (cat, name) -----------------------------
+    agg = defaultdict(lambda: [0.0, 0, 0.0])
+    for sp in spans:
+        a = agg[f"{sp.cat}:{sp.name}"]
+        a[0] += sp.dur_s
+        a[1] += 1
+        a[2] = max(a[2], sp.dur_s)
+    if agg:
+        lines.append(
+            f"{'span':<44}{'calls':>7}{'total s':>10}{'max ms':>10}"
+        )
+        ranked = sorted(
+            agg.items(), key=lambda kv: kv[1][0], reverse=True
+        )
+        for name, (tot, n, mx) in ranked[:top]:
+            lines.append(
+                f"{name:<44}{n:>7}{tot:>10.3f}{mx * 1e3:>10.2f}"
+            )
+
+    # -- compiles / recompiles -------------------------------------------
+    recompiles = [ev for ev in events if ev.name == "recompile"]
+    lines.append(
+        f"traces={metrics.get('compile.traces', '?')}  "
+        f"recompiles={metrics.get('compile.recompiles', '?')}"
+        + (
+            " — recompile sites: " + ", ".join(
+                sorted({str(ev.attrs.get("site")) for ev in recompiles})
+            )
+            if recompiles else ""
+        )
+    )
+
+    # -- bytes ------------------------------------------------------------
+    lines.append(
+        "bytes to device: "
+        + _fmt_bytes(metrics.get("transfer.bytes_to_device", 0))
+        + (
+            f"  near-413 baked modules: {metrics['transport.near_413']}"
+            if metrics.get("transport.near_413") else ""
+        )
+    )
+
+    # -- guard / rung history --------------------------------------------
+    guard_evs = [ev for ev in events if ev.cat == "guard"]
+    if guard_evs:
+        lines.append("guard events:")
+        for ev in guard_evs:
+            attrs = " ".join(f"{k}={v}" for k, v in ev.attrs.items())
+            lines.append(f"  {ev.name}: {attrs}")
+    rungs = [sp for sp in spans if sp.cat == "rung"]
+    if rungs:
+        lines.append("rung history (ladder spans, in order):")
+        for sp in sorted(rungs, key=lambda s: s.t0):
+            err = sp.attrs.get("error")
+            lines.append(
+                f"  {sp.name} ({sp.dur_s * 1e3:.1f} ms)"
+                + (f" TRIPPED: {err}" if err else " served")
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a pint_tpu flight-recorder trace "
+        "(Chrome-trace JSON)."
+    )
+    ap.add_argument("trace", help="path to the trace JSON")
+    ap.add_argument("--top", type=int, default=15,
+                    help="rows in the top-spans table")
+    ap.add_argument("--cat", default=None,
+                    help="only spans of this category")
+    args = ap.parse_args(argv)
+    print(summarize(args.trace, top=args.top, cat=args.cat))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
