@@ -293,7 +293,24 @@ def main():
     jax.config.update("jax_enable_x64", True)
 
     ntoa = 100_000
+    # cold-path telemetry (r6): the driver-tracked bench line now
+    # carries the build/ingest wall next to the warm-step metric, plus
+    # the persistent-compile-cache state, so cold-start regressions
+    # are guarded like throughput ones (the full phase breakdown —
+    # swap refits, time-to-first-fit — lives in
+    # profiling/profile_fit_wall.py's cold_path JSON block).
+    from pint_tpu.runtime import compile_cache
+
+    _cache_entries0 = compile_cache.entry_count()
+    _t0 = time.perf_counter()
     model, toas, cm = _build(ntoa)
+    cold_block = {
+        "build_ingest_s": round(time.perf_counter() - _t0, 2),
+        "ingest_toas_per_s": round(
+            ntoa / (time.perf_counter() - _t0), 1
+        ),
+        "compile_cache_dir": compile_cache.cache_dir(),
+    }
 
     # device path: the production accelerator mode (GLSFitter 'auto')
     from pint_tpu.fitting.gls import default_accel_mode
@@ -373,6 +390,15 @@ def main():
                 "vs_baseline": round(t_cpu / t_dev, 3),
                 "guard": guard_block,
                 "obs": obs_block,
+                "cold": {
+                    **cold_block,
+                    # executables persisted by THIS run: >0 on a cold
+                    # disk, 0 on a fully warm one (every compile
+                    # served from the cache)
+                    "compile_cache_new_entries": (
+                        compile_cache.entry_count() - _cache_entries0
+                    ),
+                },
             }
         )
     )

@@ -21,4 +21,13 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+# persistent XLA compilation cache (runtime/compile_cache.py): a warm
+# process start reuses the previous run's compiled executables instead
+# of paying the 32-43 s remote first-fit compile again.  Best-effort:
+# opt out with PINT_TPU_COMPILE_CACHE=0; failures downgrade to jax's
+# normal in-memory-only behavior.
+from pint_tpu.runtime import compile_cache as _compile_cache
+
+_compile_cache.enable()
+
 __all__ = ["__version__"]

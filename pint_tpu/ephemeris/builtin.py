@@ -159,22 +159,33 @@ class BuiltinEphemeris:
     def _sun_ssb_au(self, t_cent):
         """Sun wrt SSB (AU, ecliptic): -sum(m_i r_i)/(1 + sum m_i).
 
-        Memoized on the last epoch array: every body evaluation routes
+        Memoized on the epoch array: every body evaluation routes
         through the Sun wobble, so the TDB-integrand's 9-body potential
         loop (time_ephemeris.tdb_rate) would otherwise redo the 8
-        Kepler solves per body on the same grid."""
+        Kepler solves per body on the same grid.  A small KEYED dict
+        (not a single slot, r6): the chunked parallel ingest evaluates
+        several epoch grids concurrently, and a last-value slot
+        thrashes across chunks — each worker's grid evicting the
+        others' — costing the cross-body reuse serial ingest enjoys.
+        Plain dict ops are atomic under the GIL; a lost duplicate
+        insert is a benign recompute, never a wrong value."""
         t_cent = np.asarray(t_cent, dtype=np.float64)
         key = (t_cent.shape, t_cent.tobytes())
-        cached = getattr(self, "_sun_memo", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        memo = getattr(self, "_sun_memo_map", None)
+        if memo is None:
+            memo = self._sun_memo_map = {}
+        cached = memo.get(key)
+        if cached is not None:
+            return cached
         num = 0.0
         msum = 0.0
         for nm, mr in _MASS_RATIO.items():
             num = num + mr * _kepler_xyz(nm, t_cent)
             msum += mr
         out = -num / (1.0 + msum)
-        self._sun_memo = (key, out)
+        if len(memo) >= 32:  # one entry per live chunk grid, bounded
+            memo.clear()
+        memo[key] = out
         return out
 
     def _pos_au_ecl(self, body, t_cent):

@@ -61,6 +61,11 @@ class SPK:
         self.pairs: dict[tuple[int, int], list[Segment]] = {}
         for s in segments:
             self.pairs.setdefault((s.target, s.center), []).append(s)
+        # target -> [(body, center), ...] hops to the SSB, resolved once
+        # per kernel (r6 cold-path hoist: ssb_posvel used to re-walk the
+        # pair graph on every call — a per-chunk cost under the chunked
+        # ingest of toas/ingest_topo.py)
+        self._ssb_chains: dict[int, list[tuple[int, int]]] = {}
 
     # -- loading ----------------------------------------------------------
     @classmethod
@@ -173,12 +178,16 @@ class SPK:
             )
         return self._eval_pair(segs, np.asarray(et, dtype=np.float64))
 
-    def ssb_posvel(self, target: int, et):
-        """Chain segments to the SSB (center 0): km, km/s."""
-        et = np.asarray(et, dtype=np.float64)
-        pos, vel = None, None
+    def ssb_chain(self, target: int) -> list[tuple[int, int]]:
+        """The (body, center) hops from ``target`` to the SSB, resolved
+        once per kernel and memoized (called by ssb_posvel on every
+        evaluation; prewarmed by ingest's IngestPlan so chunk workers
+        share the routed chain)."""
+        chain = self._ssb_chains.get(target)
+        if chain is not None:
+            return chain
+        chain = []
         body = target
-        hops = 0
         while body != 0:
             # prefer the pair whose center leads toward the SSB directly
             centers = sorted(
@@ -187,13 +196,21 @@ class SPK:
             if not centers:
                 raise EphemerisSegmentError(f"no segment path {target} -> SSB")
             center = centers[0]  # 0 first, then inner barycenters
+            chain.append((body, center))
+            body = center
+            if len(chain) > 10:
+                raise EphemerisFormatError("segment chain does not reach SSB")
+        self._ssb_chains[target] = chain
+        return chain
+
+    def ssb_posvel(self, target: int, et):
+        """Chain segments to the SSB (center 0): km, km/s."""
+        et = np.asarray(et, dtype=np.float64)
+        pos, vel = None, None
+        for body, center in self.ssb_chain(target):
             p, v = self._eval_pair(self.pairs[(body, center)], et)
             pos = p if pos is None else pos + p
             vel = v if vel is None else vel + v
-            body = center
-            hops += 1
-            if hops > 10:
-                raise EphemerisFormatError("segment chain does not reach SSB")
         return pos, vel
 
     @property

@@ -28,6 +28,21 @@ from pint_tpu.toas.toas import TOAs
 #: squares the condition number).
 _QR_DIAG_RTOL = 1e-8
 
+#: Ceiling on the cheap triangular condition ESTIMATE of R (ADVICE
+#: r5): |R_ii| ratios alone under-reveal rank for unpivoted QR (a
+#: matrix can be numerically singular with benign diagonals — the
+#: classic Kahan example), so the gate is backed by a LINPACK-style
+#: one-solve estimate (growth of R^-1 @ 1).  Set well PAST the
+#: cond ~1e10 ladder QR is validated on
+#: (tests/test_onchip_accuracy.py::test_onchip_wls_conditioning_*):
+#: at cond >= 1e13 the QR answer's relerr (~cond * 1e-13) reaches
+#: O(1), so routing to the gram fallback — which ZEROES the
+#: degenerate directions and reports them, the reference SVD-cut
+#: semantics — loses nothing and regains a bounded answer.  Between
+#: ~1e10 and this ceiling QR still beats gram by orders of magnitude,
+#: so a mid-band handoff would be a net accuracy LOSS.
+_QR_COND_MAX = 1e13
+
 
 def default_wls_method() -> str:
     """The backend-dependent WLS solve policy: the reference's
@@ -55,12 +70,20 @@ def _wls_step(r, M, w, threshold=None, method=None,
     1e-13 on a synthetic ladder out to cond 1e10 —
     tests/test_onchip_accuracy.py::test_onchip_wls_conditioning_*),
     because Householder reflections never square the condition
-    number.  When diag(R) reveals a near-exact degeneracy (ratio
-    below _QR_DIAG_RTOL) the step takes the 'gram' answer instead,
-    which zeroes the degenerate directions and counts them (the
-    reference's SVD-cut semantics).  The fallback rides a
-    jax.lax.cond, so the full-rank common case never executes the
-    O(n p^2) Gram product + eigh at runtime.
+    number.  The step takes the 'gram' answer instead — which zeroes
+    the degenerate directions and counts them (the reference's
+    SVD-cut semantics) — when the factor looks rank-deficient:
+    diag(R) ratio below _QR_DIAG_RTOL, OR a cheap
+    one-triangular-solve condition estimate above _QR_COND_MAX (r6;
+    unpivoted QR's diagonal is NOT a reliable rank revealer on its
+    own — Kahan-type matrices keep benign |R_ii| while R^-1
+    explodes, which the solve-growth estimate catches).  The
+    fallback rides a jax.lax.cond, so the full-rank common case
+    never executes the O(n p^2) Gram product + eigh at runtime.
+    UNDERDETERMINED systems (n < p: R is non-square, no triangular
+    solve exists) route to 'gram' statically — shapes are known at
+    trace time (r6; previously a shape error deep inside
+    solve_triangular).
 
     method='gram' solves the p x p normal equations by thresholded
     eigh (the r2-r4 accelerator default, kept for the fallback and for
@@ -86,12 +109,31 @@ def _wls_step(r, M, w, threshold=None, method=None,
     A = (M / norm[None, :]) * sw[:, None]
     if threshold is None:
         threshold = jnp.finfo(jnp.float64).eps * max(A.shape)
+    if method == "qr" and A.shape[0] < A.shape[1]:
+        # underdetermined: reduced QR yields R (n, p) non-square —
+        # there is no triangular solve; the thresholded-eigh gram
+        # path handles the rank-deficient normal equations (ADVICE r5)
+        method = "gram"
     if method == "gram":
         dx, covn, nbad = _eigh_threshold_solve(A.T @ A, A.T @ b, threshold)
     elif method == "qr":
         Q, R = jnp.linalg.qr(A)
         diag = jnp.abs(jnp.diagonal(R))
-        rank_ok = diag.min() > _QR_DIAG_RTOL * diag.max()
+        diag_ok = diag.min() > _QR_DIAG_RTOL * diag.max()
+        # cheap condition estimate (one triangular solve): the growth
+        # of z = R^-1 @ 1 lower-bounds ||R^-1||; with unit-norm
+        # columns ||R|| <= sqrt(p), so max|z| * max|R_ii| ~ cond(R).
+        # Non-finite growth (exact singularity overflowed the solve)
+        # also fails the gate.
+        z = jax.scipy.linalg.solve_triangular(
+            R, jnp.ones((A.shape[1],), dtype=A.dtype), lower=False
+        )
+        cond_est = jnp.max(jnp.abs(z)) * diag.max()
+        rank_ok = (
+            diag_ok
+            & jnp.isfinite(cond_est)
+            & (cond_est < _QR_COND_MAX)
+        )
 
         def qr_solve(_):
             Rinv = jax.scipy.linalg.solve_triangular(

@@ -283,9 +283,19 @@ def _append_toa(rows, sat, freq, err, site, flags, state):
 
 
 def get_TOAs_from_tim(path) -> TOAs:
-    """Parse a tim file into a TOAs container (no ingest computations)."""
-    rows = read_tim_file(path)
-    toas = build_toas_from_rows(rows)
+    """Parse a tim file into a TOAs container (no ingest computations).
+
+    Recorded as an ``ingest:parse`` cold-path span (r6): the per-line
+    loop is the one ingest stage that CANNOT chunk across workers —
+    tim commands are stateful in row order (EFAC/TIME/SKIP carry into
+    later rows, INCLUDE splices files) — so it shows up separately in
+    a trace next to the parallelizable column stages."""
+    from pint_tpu.obs.trace import TRACER
+
+    with TRACER.span("ingest:parse", "ingest"):
+        rows = read_tim_file(path)
+        toas = build_toas_from_rows(rows)
+        TRACER.annotate(ntoa=len(toas))
     return toas
 
 

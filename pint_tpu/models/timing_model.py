@@ -282,9 +282,30 @@ def split_ref_runtime(ref: dict):
     PTA batch stacks per pulsar; strings/bools stay static (they shape
     the trace).  Shared by CompiledModel.jit (single model — the
     numeric part rides every call as runtime arguments) and
-    parallel/pta.py::_device_ref (vmapped per-pulsar stacks)."""
+    parallel/pta.py::_device_ref (vmapped per-pulsar stacks).
+
+    CONTRACT (ADVICE r5): every numeric ref must be VALUE-like — a
+    quantity kernels consume through ``_pdict`` as an f64 operand.
+    Anything that shapes the trace (harmonic counts, basis sizes, mask
+    selections, array indices) must NOT live in the ref dict's numeric
+    leaves: after the coercion below it arrives in kernels as an f64
+    TRACER, and ``int(tracer)`` / shape use raises deep inside jax with
+    no hint of which parameter leaked.  Components therefore read
+    shape-like parameters straight from the host Parameter (the
+    TNREDC pattern: ``self.params["TNREDC"].value`` in
+    models/noise.py), which never enters this split.  The assert
+    rejects the tell-tale case — a bare Python/numpy integer ref —
+    loudly at split time instead.
+    """
     num, static = {}, {}
     for n, v in ref.items():
+        if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+            raise TimingModelError(
+                f"reference value for {n!r} is a bare integer ({v!r}): "
+                "numeric refs must be value-like f64 quantities, never "
+                "static counts/indices/shapes (those must stay host "
+                "Parameters — see split_ref_runtime's contract)"
+            )
         if isinstance(v, HostDD):
             num[n] = DD(jnp.float64(float(v.hi)), jnp.float64(float(v.lo)))
         elif (
@@ -448,44 +469,101 @@ class CompiledModel:
         threshold = int(
             os.environ.get("PINT_TPU_BAKE_THRESHOLD", "200000")
         )
+
+        @jax.jit
+        def inner(bundles, refnum, args):
+            old = (self.bundle, self.tzr_bundle)
+            self.bundle, self.tzr_bundle = bundles
+            try:
+                return self._ref_swap_call(noted, refnum, args)
+            finally:
+                self.bundle, self.tzr_bundle = old
+
+        _arg_bytes = [None]
+
+        def argfed_call(args):
+            """Argument-fed dispatch: bundles + refs ride as runtime
+            operands, so any same-shape dataset reuses the compiled
+            module (the >threshold default, and the adaptive swap
+            target below it)."""
+            if _arg_bytes[0] is None:
+                # the bundle/ref operands ride EVERY call; their byte
+                # total is shape-constant per wrapper (the same-shape
+                # data-swap contract), so one tree walk amortizes over
+                # all dispatches
+                _arg_bytes[0] = _obs.trace.nbytes_of(
+                    ((self.bundle, self.tzr_bundle),
+                     self._ref_runtime())
+                )
+            _obs.note_transfer(site, _arg_bytes[0], args)
+            return inner(
+                (self.bundle, self.tzr_bundle), self._ref_runtime(),
+                args,
+            )
+
         if self.bundle.ntoa <= threshold:
             # baked-constant lowering — but pinned to the bundle
-            # OBJECTS, so an in-place bundle swap re-traces against
-            # the new data instead of silently serving the old
-            # dataset from jit's shape-keyed cache (the same-shape
-            # data-swap contract of docs/parallelism.md, kept by
-            # re-bake here and by argument-feeding above the
-            # threshold).  The cache holds STRONG references and
-            # compares with `is` — bare id() keys can false-hit after
-            # GC address reuse.
-            baked: list = []  # [bundle, tzr_bundle, jitted]
+            # OBJECTS, so an in-place bundle swap never silently
+            # serves the old dataset from jit's shape-keyed cache
+            # (the same-shape data-swap contract of
+            # docs/parallelism.md).  The cache holds STRONG
+            # references and compares with `is` — bare id() keys can
+            # false-hit after GC address reuse.
+            #
+            # ADAPTIVE CUTOVER (r6): the FIRST same-shape bundle swap
+            # switches this wrapper permanently to the argument-fed
+            # path instead of re-baking.  A re-bake pays a full
+            # remote recompile of a literal-heavy module PER SWAP
+            # (~35 s at 1e5 TOAs, profiling/profile_fit_wall.py);
+            # once data starts swapping, baking's per-step advantage
+            # (+22% via scan-LICM constant folding, r4) can never
+            # amortize that, while the argument-fed module compiles
+            # once — often straight from the persistent compile cache
+            # (runtime/compile_cache.py) — and then serves every
+            # subsequent swap for pure transfer+dispatch, like the
+            # >threshold path always has.  A DIFFERENT-shape swap
+            # re-bakes as before (an argument-fed module would also
+            # recompile, and below the threshold baked is faster).
+            # PINT_TPU_ADAPTIVE_SWAP=0 restores unconditional
+            # re-bake.
+            baked: list = []  # [bundle, tzr_bundle, jitted, shape_sig]
+            mode = ["baked"]
+            adaptive = (
+                os.environ.get("PINT_TPU_ADAPTIVE_SWAP", "1") != "0"
+            )
+
+            def _shape_sig(pair):
+                return (
+                    jax.tree_util.tree_structure(pair),
+                    tuple(
+                        (getattr(l, "shape", ()), getattr(l, "dtype", None))
+                        for l in jax.tree_util.tree_leaves(pair)
+                    ),
+                )
+
+            def _clear_for_retrace():
+                # jax's initial-style jaxpr caches (lax.scan bodies
+                # etc.) key on the CLOSURE IDENTITY of fn's inner
+                # functions + avals, and their cached entries hold
+                # the PREVIOUS trace's ref tracers as consts —
+                # re-tracing the same closures would resurrect them
+                # (UnexpectedTracerError; r5, found converting refs
+                # to runtime args).  The clear is process-global (jax
+                # offers nothing finer); _cleared_for dedups it per
+                # swapped bundle so this model's OWN lazily
+                # re-tracing wrappers don't cascade-discard each
+                # other's fresh compiles.
+                if baked and self._cleared_for is not self.bundle:
+                    jax.clear_caches()
+                    self._cleared_for = self.bundle
+                    _obs.TRACER.event(
+                        "cache-clear", "compile", site=site
+                    )
 
             def _jitted():
                 if (not baked or baked[0] is not self.bundle
                         or baked[1] is not self.tzr_bundle):
-                    if baked and self._cleared_for is not self.bundle:
-                        # RE-bake (bundle object swapped): jax's
-                        # initial-style jaxpr caches (lax.scan bodies
-                        # etc.) key on the CLOSURE IDENTITY of fn's
-                        # inner functions + avals, and their cached
-                        # entries hold the PREVIOUS trace's ref
-                        # tracers as consts — re-tracing the same
-                        # closures would resurrect them
-                        # (UnexpectedTracerError; r5, found converting
-                        # refs to runtime args).  The clear is
-                        # process-global (jax offers nothing finer):
-                        # other models' compiled fns recompile on
-                        # next use — correctness is unaffected, and a
-                        # data swap always paid full recompiles in
-                        # r4 too.  _cleared_for dedups the clear per
-                        # swapped bundle so this model's OWN lazily
-                        # re-baking wrappers don't cascade-discard
-                        # each other's fresh compiles.
-                        jax.clear_caches()
-                        self._cleared_for = self.bundle
-                        _obs.TRACER.event(
-                            "cache-clear", "compile", site=site
-                        )
+                    _clear_for_retrace()
                     # fresh closure each re-bake: jax's trace cache
                     # keys on function identity, so jit(fn) again
                     # would serve the OLD bundle's baked trace
@@ -493,6 +571,7 @@ class CompiledModel:
                         self.bundle, self.tzr_bundle,
                         jax.jit(lambda refnum, *a:
                                 self._ref_swap_call(noted, refnum, a)),
+                        _shape_sig((self.bundle, self.tzr_bundle)),
                     ]
                     # baked-literal transport pressure (near-413
                     # early warning; pint_tpu/obs/__init__.py)
@@ -504,6 +583,21 @@ class CompiledModel:
 
             @functools.wraps(fn)
             def rebaking(*args):
+                if (
+                    mode[0] == "baked" and adaptive and baked
+                    and (baked[0] is not self.bundle
+                         or baked[1] is not self.tzr_bundle)
+                    and _shape_sig((self.bundle, self.tzr_bundle))
+                    == baked[3]
+                ):
+                    _clear_for_retrace()
+                    mode[0] = "args"
+                    _obs.TRACER.event(
+                        "swap-to-args", "compile", site=site,
+                        ntoa=self.bundle.ntoa,
+                    )
+                if mode[0] == "args":
+                    return argfed_call(args)
                 if _const_bytes[0] is None:
                     _const_bytes[0] = _obs.trace.nbytes_of(
                         self._ref_runtime()
@@ -511,37 +605,20 @@ class CompiledModel:
                 _obs.note_transfer(site, _const_bytes[0], args)
                 return _jitted()(self._ref_runtime(), *args)
 
-            # AOT hook: lower against the CURRENT bundles/refs
-            rebaking.lower = lambda *args: _jitted().lower(
-                self._ref_runtime(), *args
+            # AOT hook: lower against the CURRENT bundles/refs + mode
+            rebaking.lower = lambda *args: (
+                inner.lower(
+                    (self.bundle, self.tzr_bundle),
+                    self._ref_runtime(), args,
+                )
+                if mode[0] == "args"
+                else _jitted().lower(self._ref_runtime(), *args)
             )
             return dispatch_guard(rebaking, site)
 
-        @jax.jit
-        def inner(bundles, refnum, args):
-            old = (self.bundle, self.tzr_bundle)
-            self.bundle, self.tzr_bundle = bundles
-            try:
-                return self._ref_swap_call(noted, refnum, args)
-            finally:
-                self.bundle, self.tzr_bundle = old
-
         @functools.wraps(fn)
         def wrapped(*args):
-            if _const_bytes[0] is None:
-                # the bundle/ref operands ride EVERY call; their byte
-                # total is shape-constant per wrapper (the same-shape
-                # data-swap contract), so one tree walk amortizes over
-                # all dispatches
-                _const_bytes[0] = _obs.trace.nbytes_of(
-                    ((self.bundle, self.tzr_bundle),
-                     self._ref_runtime())
-                )
-            _obs.note_transfer(site, _const_bytes[0], args)
-            return inner(
-                (self.bundle, self.tzr_bundle), self._ref_runtime(),
-                args,
-            )
+            return argfed_call(args)
 
         # AOT hooks (profiling/bench): lower with the CURRENT state
         wrapped.lower = lambda *args: inner.lower(

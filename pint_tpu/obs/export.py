@@ -154,6 +154,17 @@ def summary(tracer=None) -> dict:
             else round(max_span.dur_s * 1e3, 3)
         ),
         "max_span": None if max_span is None else max_span.name,
+        # cold-path counters (r6): ingest-cache outcomes + parallel
+        # -ingest degradations, so the driver-tracked line shows the
+        # cache/pool behaving (zeros when the run never ingested from
+        # files — e.g. the synthetic bench)
+        "ingest_cache_hits": snap.get("ingest.cache.hits", 0),
+        "ingest_cache_incremental": snap.get(
+            "ingest.cache.incremental", 0
+        ),
+        "ingest_parallel_degrades": snap.get(
+            "ingest.parallel.degrades", 0
+        ),
     }
 
 

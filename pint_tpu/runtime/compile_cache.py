@@ -1,0 +1,117 @@
+"""Persistent XLA compilation cache: warm process starts skip the
+first-fit compile.
+
+The cold-path profile (profiling/profile_fit_wall.py) shows
+``first_fit_compile_s`` of ~32-43 s through the remote-compile tunnel —
+paid again by EVERY process start even though the lowered module is
+byte-identical run to run (the cm.jit argument-fed split makes it O(1)
+in the data, so the cache key is stable across datasets of one shape).
+jax ships a persistent on-disk executable cache; this module turns it
+on for the framework with safe defaults and an escape hatch.
+
+Env contract (documented in docs/performance.md):
+  * ``PINT_TPU_COMPILE_CACHE=0``       — disable entirely.
+  * ``PINT_TPU_COMPILE_CACHE_DIR``     — cache directory (default
+    ``~/.cache/pint_tpu/xla-cache``).
+  * ``PINT_TPU_COMPILE_CACHE_MIN_S``   — minimum compile seconds for an
+    executable to be persisted (default 0.2; the axon tunnel makes
+    every real kernel cost far more, while trivial test kernels stay
+    out of the cache).
+
+Enabling is best-effort: a read-only filesystem, an unknown jax flag,
+or a PJRT backend that cannot serialize executables must never break a
+fit — failures downgrade to a one-time warning and the in-memory-only
+behavior jax always had.  Cache-dir writes are keyed by jax/jaxlib
+version and backend internally (jax's own cache-key machinery), so one
+directory serves CPU test meshes and the TPU tunnel side by side.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from pathlib import Path
+from typing import Optional
+
+_state = {"enabled": False, "dir": None, "tried": False}
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when disabled."""
+    return _state["dir"] if _state["enabled"] else None
+
+
+def enable(directory: Optional[str] = None) -> Optional[str]:
+    """Turn on jax's persistent compilation cache (idempotent).
+
+    Returns the cache directory in use, or None when disabled by env /
+    unsupported.  Called once at ``import pint_tpu`` — early, so every
+    backend client created afterwards sees the config."""
+    if _state["tried"] and directory is None:
+        return cache_dir()
+    _state["tried"] = True
+    if os.environ.get("PINT_TPU_COMPILE_CACHE", "1") == "0":
+        return None
+    d = (
+        directory
+        or os.environ.get("PINT_TPU_COMPILE_CACHE_DIR")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "pint_tpu", "xla-cache"
+        )
+    )
+    try:
+        Path(d).mkdir(parents=True, exist_ok=True)
+        probe = Path(d) / ".writable"
+        probe.touch()
+        probe.unlink()
+    except OSError as e:
+        warnings.warn(
+            f"persistent compile cache disabled: {d!r} not writable "
+            f"({e})"
+        )
+        return None
+    import jax
+
+    min_s = float(os.environ.get("PINT_TPU_COMPILE_CACHE_MIN_S", "0.2"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_s
+        )
+        # cache every size: the axon tunnel round-trip dwarfs any
+        # deserialization cost, and small modules are the common case
+        # below the bake threshold
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # unknown flag on this jax: stay in-memory
+        warnings.warn(f"persistent compile cache unavailable: {e}")
+        return None
+    # jax pins its cache singleton to the directory of the FIRST
+    # cached compile; after a config update the singleton must reset
+    # or a mid-process redirect (tests) keeps writing to the old
+    # directory.  Private API, so strictly best-effort; a no-op when
+    # nothing has compiled yet (the import-time call).
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _state["enabled"] = True
+    _state["dir"] = d
+    return d
+
+
+def entry_count() -> int:
+    """Number of persisted executables in the active cache directory
+    (0 when disabled) — the observability hook bench/tests use to
+    assert writes and hits without reaching into jax internals."""
+    d = cache_dir()
+    if d is None:
+        return 0
+    try:
+        return sum(
+            1 for p in os.scandir(d)
+            if p.is_file() and not p.name.startswith(".")
+        )
+    except OSError:
+        return 0

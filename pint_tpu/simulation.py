@@ -76,14 +76,36 @@ def make_fake_toas_uniform(
     return toas
 
 
+def _sim_cpu_device():
+    """Device pin for the simulation's eager residual sweeps.
+
+    The phase inversion below evaluates ``cm.time_residuals`` EAGERLY
+    (op by op, no jit).  On the axon tunnel every eager op is a ~85 ms
+    round-trip, so the sweep cost ~70 s of pure dispatch latency
+    REGARDLESS of ntoa — the fixed `build_ingest_s` floor the r6
+    cold-path profile flagged (profiling/profile_fit_wall.py).  Host
+    scaffolding belongs on the host: pinned to CPU the same sweep is
+    numpy-speed AND exact IEEE f64 (the tunnel's f32-pair emulation is
+    not), so simulated TOAs can only get more accurate.  Device fits of
+    the simulated data still run on the default backend — only this
+    host-side construction is pinned.
+    """
+    import jax
+
+    return jax.default_device(jax.devices("cpu")[0])
+
+
 def _invert_to_integer_phase(toas: TOAs, model: TimingModel, iterations):
     """Shift arrival times until the model phase is (near-)integer."""
-    for _ in range(iterations):
-        cm = model.compile(toas, subtract_mean=False)
-        cm.track_mode = "nearest"
-        resid = np.asarray(cm.time_residuals(cm.x0(), subtract_mean=False))
-        toas.t = toas.t.add_seconds(-resid)
-        _ingest(toas, model)
+    with _sim_cpu_device():
+        for _ in range(iterations):
+            cm = model.compile(toas, subtract_mean=False)
+            cm.track_mode = "nearest"
+            resid = np.asarray(
+                cm.time_residuals(cm.x0(), subtract_mean=False)
+            )
+            toas.t = toas.t.add_seconds(-resid)
+            _ingest(toas, model)
 
 
 def _add_white_noise(toas: TOAs, model: TimingModel, rng):

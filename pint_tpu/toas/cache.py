@@ -1,10 +1,29 @@
-"""TOA ingest cache: skip the clock/TDB/posvel pipeline on reload.
+"""Persistent TOA ingest cache: skip the clock/TDB/posvel pipeline on
+reload, and re-ingest only the appended tail when a tim file grows.
 
 Reference parity: src/pint/toa.py get_TOAs(usepickle=True) — the
 reference writes <tim>.pickle.gz keyed by a content hash.  Here the
 ingested columns are saved as a .npz next to the tim file (or in
-$PINT_TPU_CACHE_DIR), keyed on the tim bytes + ingest options hash;
-double-double columns round-trip exactly (hi/lo pairs).
+$PINT_TPU_CACHE_DIR), double-double columns round-tripping exactly
+(hi/lo pairs).
+
+Cache key (r6): three independent components, each invalidating on its
+own axis —
+  * ``content_hash``  — sha256 of the tim file bytes (data changed);
+  * ``options_key``   — ingest options incl. the model's par-file text
+    (ephemeris/BIPM/planets choices changed);
+  * baked into ``options_key``: the npz ``_FORMAT_VERSION`` and
+    ingest_topo.INGEST_CODE_VERSION (the ingest numerics changed —
+    bumping either orphan-invalidates every existing cache file).
+
+Append-incremental reuse: observation runs APPEND TOAs — the common
+"new day of data" reload shares every earlier row bit-for-bit.  When
+the content hash misses but the options key matches and the cached
+rows are exactly a prefix of the new tim rows (arrival times, freqs,
+errors, sites, flags all equal), only the tail is ingested and the
+columns are stitched.  This is exact because the ingest chain is a
+pure per-TOA map (see ingest_topo's chunking contract) — proven
+bit-identical in tests/test_ingest_parallel.py.
 """
 
 from __future__ import annotations
@@ -18,9 +37,14 @@ import numpy as np
 from pint_tpu.timebase.hostdd import HostDD
 from pint_tpu.timebase.times import TimeArray
 from pint_tpu.toas.toas import TOAs
-from pint_tpu.utils import compute_hash
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+
+#: per-TOA derived columns persisted alongside the raw rows
+_DERIVED_COLS = (
+    "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
+    "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
+)
 
 
 def _cache_path(tim_path) -> Path:
@@ -31,33 +55,47 @@ def _cache_path(tim_path) -> Path:
     return p.with_name(p.name + ".ingest.npz")
 
 
-def _options_key(tim_path, **options) -> str:
+def _options_key(**options) -> str:
+    """Hash of everything except the tim content: ingest options + the
+    npz format version + the ingest-chain code version."""
+    from pint_tpu.toas.ingest_topo import INGEST_CODE_VERSION
+    from pint_tpu.utils import compute_hash
+
     return compute_hash(
-        tim_path, _FORMAT_VERSION, sorted(options.items())
+        _FORMAT_VERSION, INGEST_CODE_VERSION, sorted(options.items())
     )
 
 
+def _content_hash(tim_path) -> str:
+    from pint_tpu.utils import compute_hash
+
+    return compute_hash(tim_path)
+
+
+def _flag_reprs(toas: TOAs) -> np.ndarray:
+    return np.array([repr(sorted(f.items())) for f in toas.flags])
+
+
 def save_cache(toas: TOAs, tim_path, **options):
-    """Write the ingested TOA columns keyed on tim content + options."""
+    """Write the ingested TOA columns keyed on tim content + options +
+    code version."""
     arrs = {
-        "key": np.array(_options_key(tim_path, **options)),
+        "options_key": np.array(_options_key(**options)),
+        "content_hash": np.array(_content_hash(tim_path)),
         "t_day": toas.t.mjd_int, "t_hi": toas.t.sec.hi,
         "t_lo": toas.t.sec.lo, "t_scale": np.array(toas.t.scale),
         "freq": toas.freq, "error_us": toas.error_us,
         "obs": np.array(toas.obs),
-        "flags": np.array(
-            [repr(sorted(f.items())) for f in toas.flags]
-        ),
+        "flags": _flag_reprs(toas),
     }
+    if toas.ephem is not None:
+        arrs["ephem"] = np.array(toas.ephem)
     if toas.t_tdb is not None:
         arrs.update(
             tdb_day=toas.t_tdb.mjd_int, tdb_hi=toas.t_tdb.sec.hi,
             tdb_lo=toas.t_tdb.sec.lo,
         )
-    for col in (
-        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
-        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
-    ):
+    for col in _DERIVED_COLS:
         v = getattr(toas, col)
         if v is not None:
             arrs[col] = v
@@ -66,17 +104,7 @@ def save_cache(toas: TOAs, tim_path, **options):
     np.savez_compressed(_cache_path(tim_path), **arrs)
 
 
-def load_cache(tim_path, **options) -> Optional[TOAs]:
-    """Ingested TOAs from cache, or None on miss/stale key."""
-    path = _cache_path(tim_path)
-    if not path.exists():
-        return None
-    try:
-        z = np.load(path, allow_pickle=False)
-    except (OSError, ValueError):
-        return None
-    if str(z["key"]) != _options_key(tim_path, **options):
-        return None
+def _toas_from_npz(z) -> TOAs:
     import ast
 
     flags = [
@@ -90,16 +118,85 @@ def load_cache(tim_path, **options) -> Optional[TOAs]:
         toas.t_tdb = TimeArray(
             z["tdb_day"], HostDD(z["tdb_hi"], z["tdb_lo"]), "tdb"
         )
-    for col in (
-        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
-        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
-    ):
+    for col in _DERIVED_COLS:
         if col in z:
             setattr(toas, col, z[col])
     for name in z.files:
         if name.startswith("planet:"):
             toas.obs_planet_pos[name.split(":", 1)[1]] = z[name]
+    if "ephem" in z:
+        toas.ephem = str(z["ephem"])
     return toas
+
+
+def _load_npz(tim_path, **options):
+    """The cache npz when it exists and its options/version key
+    matches; None otherwise (content hash NOT checked here)."""
+    path = _cache_path(tim_path)
+    if not path.exists():
+        return None
+    try:
+        z = np.load(path, allow_pickle=False)
+    except (OSError, ValueError):
+        return None
+    key = "options_key" if "options_key" in z.files else "key"
+    if str(z[key]) != _options_key(**options):
+        return None
+    return z
+
+
+def load_cache(tim_path, **options) -> Optional[TOAs]:
+    """Ingested TOAs from cache, or None on miss/stale key (content,
+    options, or code version changed)."""
+    z = _load_npz(tim_path, **options)
+    if z is None or "content_hash" not in z.files:
+        return None
+    if str(z["content_hash"]) != _content_hash(tim_path):
+        return None
+    return _toas_from_npz(z)
+
+
+def _prefix_rows_match(cached: TOAs, new: TOAs) -> bool:
+    """True when the cached rows are exactly the first len(cached) raw
+    rows of the new tim parse (times, freqs, errors, sites, flags)."""
+    nc = len(cached)
+    if nc == 0 or nc > len(new):
+        return False
+    head = new[:nc]
+    return (
+        cached.t.scale == head.t.scale
+        and np.array_equal(cached.t.mjd_int, head.t.mjd_int)
+        and np.array_equal(cached.t.sec.hi, head.t.sec.hi)
+        and np.array_equal(cached.t.sec.lo, head.t.sec.lo)
+        and np.array_equal(cached.freq, head.freq)
+        and np.array_equal(cached.error_us, head.error_us)
+        and cached.obs == head.obs
+        and cached.flags == head.flags
+    )
+
+
+def _stitch_columns(full: TOAs, prefix: TOAs, tail: TOAs):
+    """Copy ingested columns onto ``full`` by concatenating the cached
+    prefix with the freshly-ingested tail, preserving ROW ORDER (no
+    re-sort: the stitched table must be bitwise the full-ingest one)."""
+    full.t_tdb = TimeArray(
+        np.concatenate([prefix.t_tdb.mjd_int, tail.t_tdb.mjd_int]),
+        HostDD(
+            np.concatenate([prefix.t_tdb.sec.hi, tail.t_tdb.sec.hi]),
+            np.concatenate([prefix.t_tdb.sec.lo, tail.t_tdb.sec.lo]),
+        ),
+        "tdb",
+    )
+    for col in _DERIVED_COLS:
+        a, b = getattr(prefix, col), getattr(tail, col)
+        if a is not None and b is not None:
+            setattr(full, col, np.concatenate([a, b]))
+    for body in tail.obs_planet_pos:
+        if body in prefix.obs_planet_pos:
+            full.obs_planet_pos[body] = np.concatenate(
+                [prefix.obs_planet_pos[body], tail.obs_planet_pos[body]]
+            )
+    full.ephem = tail.ephem if tail.ephem is not None else prefix.ephem
 
 
 def get_TOAs(
@@ -109,22 +206,71 @@ def get_TOAs(
     **ingest_kw,
 ) -> TOAs:
     """tim file -> ingested TOAs, with optional caching (the
-    reference's get_TOAs(usepickle=...) surface)."""
-    from pint_tpu.io.tim import get_TOAs_from_tim
+    reference's get_TOAs(usepickle=...) surface).
+
+    With ``usepickle=True``: an exact cache hit (content + options +
+    code version) skips ingest entirely; a grown tim file whose old
+    rows are an unchanged prefix re-ingests ONLY the appended tail;
+    anything else re-ingests in full and refreshes the cache.
+    Outcomes land on the metrics registry (``ingest.cache.*``) and the
+    flight recorder."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.obs.trace import TRACER
     from pint_tpu.toas.ingest import ingest, ingest_for_model
 
     opts = dict(ingest_kw)
     if model is not None:
         opts["model_par"] = model.as_parfile()
+
+    def _ingest(t):
+        if model is not None:
+            return ingest_for_model(t, model, **ingest_kw)
+        return ingest(t, **ingest_kw)
+
+    cached_prefix = None
     if usepickle:
-        cached = load_cache(tim_path, **opts)
+        with TRACER.span("ingest:cache-load", "ingest"):
+            cached = load_cache(tim_path, **opts)
         if cached is not None:
+            obs_metrics.counter(
+                "ingest.cache.hits", help="full ingest-cache hits"
+            ).inc()
             return cached
+        z = _load_npz(tim_path, **opts)
+        if z is not None and "content_hash" in z.files:
+            cached_prefix = _toas_from_npz(z)
+
+    from pint_tpu.io.tim import get_TOAs_from_tim
+
     toas = get_TOAs_from_tim(tim_path)
-    if model is not None:
-        ingest_for_model(toas, model, **ingest_kw)
-    else:
-        ingest(toas, **ingest_kw)
+    if (
+        cached_prefix is not None
+        and cached_prefix.t_tdb is not None
+        and _prefix_rows_match(cached_prefix, toas)
+    ):
+        nc = len(cached_prefix)
+        with TRACER.span(
+            "ingest:incremental", "ingest",
+            ntoa=len(toas), cached=nc, tail=len(toas) - nc,
+        ):
+            tail = _ingest(toas[nc:])
+            _stitch_columns(toas, cached_prefix, tail)
+        obs_metrics.counter(
+            "ingest.cache.incremental",
+            help="ingest-cache prefix reuses (tail-only ingest)",
+        ).inc()
+        obs_metrics.counter(
+            "ingest.cache.rows_reused", unit="TOAs",
+            help="TOA rows served from the ingest cache",
+        ).inc(nc)
+        save_cache(toas, tim_path, **opts)
+        return toas
+
+    if usepickle:
+        obs_metrics.counter(
+            "ingest.cache.misses", help="ingest-cache misses"
+        ).inc()
+    toas = _ingest(toas)
     if usepickle:
         save_cache(toas, tim_path, **opts)
     return toas

@@ -1,0 +1,162 @@
+"""Cold-path guards (r6): persistent compile cache + adaptive
+no-rebake data swaps.
+
+CPU-mesh versions of the tunnel behaviors profile_fit_wall.py tracks:
+(1) the persistent XLA compilation cache persists executables and is
+HIT on a second in-process build of the same fit program (fresh
+Python function identities, so jax's in-memory jit cache cannot serve
+it); (2) a same-shape bundle swap below the bake threshold switches
+cm.jit to the argument-fed module once, after which further swaps
+dispatch with ZERO retraces — while still serving the swapped data,
+not the baked snapshot.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+PAR = (
+    "PSR J0000+0000\nF0 100.0 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+    "DM 10.0 1\nEFAC -f L-wide 1.1\n"
+    "TNREDAMP -13.5\nTNREDGAM 3.7\nTNREDC 8\n"
+)
+
+
+def _fitter(ntoa=500, seed=4):
+    from pint_tpu.fitting.gls import GLSFitter
+    from pint_tpu.simulation import make_test_pulsar
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            PAR, ntoa=ntoa, start_mjd=55000.0, end_mjd=56000.0,
+            seed=seed, iterations=1,
+        )
+    return GLSFitter(toas, model), toas
+
+
+def _swap(f, toas, rng):
+    """Same-shape data swap: jitter, re-ingest (t_tdb must move),
+    rebundle — the profile_fit_wall contract."""
+    from pint_tpu.toas.bundle import make_bundle
+    from pint_tpu.toas.ingest import ingest_barycentric
+
+    toas.t = toas.t.add_seconds(rng.normal(0.0, 2e-6, len(toas)))
+    ingest_barycentric(toas)
+    f.cm.bundle = make_bundle(
+        toas, masks=None
+    )._replace(masks=f.cm.bundle.masks)
+
+
+def test_persistent_compile_cache_hit_on_second_build(
+    tmp_path, monkeypatch
+):
+    """Second in-process build of the same fit program: the persistent
+    cache directory gains entries on the first build and serves the
+    second without new writes (a disk hit — fresh model/fitter objects
+    defeat the in-memory jit cache)."""
+    from pint_tpu.runtime import compile_cache
+
+    monkeypatch.setenv("PINT_TPU_COMPILE_CACHE_MIN_S", "0")
+    monkeypatch.delenv("PINT_TPU_COMPILE_CACHE", raising=False)
+    assert compile_cache.enable(directory=str(tmp_path)) == str(
+        tmp_path
+    )
+    try:
+        f1, _ = _fitter()
+        chi1 = f1.fit_toas(maxiter=2)
+        n1 = compile_cache.entry_count()
+        assert n1 > 0, "first build persisted no executables"
+
+        f2, _ = _fitter()  # fresh objects: in-memory caches miss
+        chi2 = f2.fit_toas(maxiter=2)
+        n2 = compile_cache.entry_count()
+        assert n2 == n1, (
+            f"second build wrote {n2 - n1} new cache entries — the "
+            "persistent compile cache missed"
+        )
+        np.testing.assert_allclose(float(chi1), float(chi2), rtol=1e-12)
+    finally:
+        # restore the session-default cache dir for later tests
+        compile_cache._state["tried"] = False
+        compile_cache._state["enabled"] = False
+        compile_cache._state["dir"] = None
+        compile_cache.enable()
+
+
+def test_adaptive_swap_steady_state_zero_retrace(monkeypatch):
+    """Below the bake threshold, swap #1 converts the wrapper to
+    argument-fed (bounded retraces), and swap #2 refits with ZERO XLA
+    retraces — the no-rebake steady state — while chi2 tracks the
+    swapped data."""
+    from pint_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv("PINT_TPU_ADAPTIVE_SWAP", "1")
+    f, toas = _fitter()
+    rng = np.random.default_rng(7)
+    chi0 = f.fit_toas(maxiter=1)
+    # touch the post-fit residual surface from the start: its cm.jit
+    # wrappers are created lazily, and each wrapper converts to the
+    # argument-fed path on the FIRST swap it observes — the steady
+    # -state window below must only contain wrappers that have already
+    # lived through a swap
+    _ = f.resids.chi2
+
+    _swap(f, toas, rng)
+    chi1 = f.fit_toas(maxiter=1)
+    _ = f.resids.chi2
+
+    traces_before = obs_metrics.counter("compile.traces").value
+    _swap(f, toas, rng)
+    chi2 = f.fit_toas(maxiter=1)
+    # also touch the post-fit residual surface (it shares the cm.jit
+    # wrappers and must ride the argument-fed path too)
+    _ = f.resids.chi2
+    retraces = (
+        obs_metrics.counter("compile.traces").value - traces_before
+    )
+    assert retraces == 0, (
+        f"steady-state data swap retraced {retraces} time(s) — the "
+        "adaptive argument-feed cutover is not holding"
+    )
+    # the swapped data must actually be served: 2 us of added jitter
+    # on 1 us errors moves chi2 far outside roundoff
+    assert abs(float(chi1) - float(chi0)) > 1.0
+    assert abs(float(chi2) - float(chi1)) > 1.0
+
+
+def test_adaptive_swap_matches_rebake_answers(monkeypatch):
+    """The argument-fed swap path computes the same answers as the
+    legacy re-bake path on identical swap sequences."""
+    def run(flag):
+        monkeypatch.setenv("PINT_TPU_ADAPTIVE_SWAP", flag)
+        f, toas = _fitter()
+        rng = np.random.default_rng(11)
+        out = [float(f.fit_toas(maxiter=1))]
+        for _ in range(2):
+            _swap(f, toas, rng)
+            out.append(float(f.fit_toas(maxiter=1)))
+        return out
+
+    np.testing.assert_allclose(run("1"), run("0"), rtol=1e-12)
+
+
+def test_different_shape_swap_still_rebakes(monkeypatch):
+    """A DIFFERENT-shape bundle swap keeps the re-bake semantics (the
+    argument-fed module would recompile anyway; baked is faster below
+    the threshold) and serves the new shape correctly."""
+    from pint_tpu.toas.bundle import make_bundle
+
+    monkeypatch.setenv("PINT_TPU_ADAPTIVE_SWAP", "1")
+    f, toas = _fitter(ntoa=300)
+    f.fit_toas(maxiter=1)
+    short = toas[:200]
+    f.cm.bundle = make_bundle(short, masks=None)._replace(
+        masks={k: v[:200] for k, v in f.cm.bundle.masks.items()}
+    )
+    f.toas = short
+    f.resids_init = f.resids = f._make_resids()
+    chi = f.fit_toas(maxiter=1)
+    assert np.isfinite(float(chi))
+    assert f.cm.bundle.ntoa == 200

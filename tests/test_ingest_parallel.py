@@ -1,0 +1,272 @@
+"""Chunked/parallel ingest + persistent ingest-cache correctness (r6).
+
+The cold-path contract (ISSUE 3): parallel chunked ingest must be
+BIT-identical to the serial chain — on the full-ingest-chain golden
+sets, not just synthetic data — the worker pool must degrade to serial
+cleanly (crash / PINT_TPU_INGEST_WORKERS=0), and the ingest cache must
+invalidate on each key axis (tim content, ingest options, ingest-code
+version) while append-incremental reuse recomputes ONLY the appended
+tail.
+"""
+
+import sys
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+DATADIR = Path(__file__).parent / "datafile"
+sys.path.insert(0, str(Path(__file__).parent))
+
+from ingest_env import INGEST_STEMS, golden_ingest_env  # noqa: E402
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:no site clock file", "ignore:no Earth-orientation table"
+)
+
+PAR_TOPO = """
+PSR J1744-1134
+F0 245.4261196898081 1
+F1 -5.38e-16 1
+PEPOCH 55000
+DM 3.1380 1
+RAJ 17:44:29.403209 1
+DECJ -11:34:54.68067 1
+"""
+
+
+def _ingest_columns(toas):
+    """Every derived ingest column as plain arrays (for bitwise
+    comparison)."""
+    cols = {
+        "tdb_day": toas.t_tdb.mjd_int,
+        "tdb_hi": toas.t_tdb.sec.hi,
+        "tdb_lo": toas.t_tdb.sec.lo,
+    }
+    for c in (
+        "clock_corr_s", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos",
+        "obs_lat_rad", "obs_alt_m", "obs_elevation_rad",
+    ):
+        v = getattr(toas, c)
+        if v is not None:
+            cols[c] = v
+    for body, v in toas.obs_planet_pos.items():
+        cols[f"planet:{body}"] = v
+    return cols
+
+
+def _assert_bit_identical(a, b):
+    ca, cb = _ingest_columns(a), _ingest_columns(b)
+    assert set(ca) == set(cb)
+    for k in ca:
+        np.testing.assert_array_equal(ca[k], cb[k], err_msg=k)
+
+
+def _force_parallel(monkeypatch, workers, min_toas=4):
+    from pint_tpu.toas import ingest_topo
+
+    monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", str(workers))
+    monkeypatch.setattr(ingest_topo, "_MIN_PARALLEL_TOAS", min_toas)
+
+
+@pytest.mark.parametrize("stem", INGEST_STEMS)
+def test_parallel_bit_identical_on_golden_sets(stem, monkeypatch):
+    """Chunked ingest through the REAL chain (clock files, EOP table,
+    SPK kernel, satellite orbit) equals serial ingest bitwise."""
+    from pint_tpu.io.tim import get_TOAs_from_tim
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    with golden_ingest_env(), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(str(DATADIR / f"{stem}.par"))
+
+        monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", "0")
+        serial = ingest_for_model(
+            get_TOAs_from_tim(str(DATADIR / f"{stem}.tim")), model
+        )
+        _force_parallel(monkeypatch, workers=4)
+        parallel = ingest_for_model(
+            get_TOAs_from_tim(str(DATADIR / f"{stem}.tim")), model
+        )
+    if serial.t_tdb is None:
+        pytest.skip(f"{stem}: barycentric (no topocentric chain)")
+    _assert_bit_identical(serial, parallel)
+
+
+def _make_topo(ntoa=600, obs="geocenter"):
+    from pint_tpu.simulation import make_test_pulsar
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = make_test_pulsar(
+            PAR_TOPO, ntoa=ntoa, start_mjd=55000.0, end_mjd=55600.0,
+            obs=obs, iterations=1,
+        )
+    return model, toas
+
+
+def test_parallel_bit_identical_multisite(monkeypatch):
+    """Uneven chunks over a multi-site synthetic set (two ground
+    stations cycling) equal the serial pass bitwise."""
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    model, toas = _make_topo(ntoa=601, obs=["gbt", "parkes"])
+    monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", "0")
+    serial = ingest_for_model(toas[:], model)
+    _force_parallel(monkeypatch, workers=5)
+    parallel = ingest_for_model(toas[:], model)
+    _assert_bit_identical(serial, parallel)
+
+
+def test_worker_crash_degrades_to_serial(monkeypatch):
+    """A crashing chunk worker degrades to one clean serial pass whose
+    answer equals plain serial ingest (and the degrade is counted)."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.toas import ingest_topo
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    model, toas = _make_topo()
+    monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", "0")
+    serial = ingest_for_model(toas[:], model)
+
+    real = ingest_topo._compute_chunk
+
+    def crashing(plan, t, obs, lo, hi, chunk):
+        if chunk > 0:
+            raise RuntimeError("injected ingest worker crash")
+        return real(plan, t, obs, lo, hi, chunk)
+
+    _force_parallel(monkeypatch, workers=4)
+    monkeypatch.setattr(ingest_topo, "_compute_chunk", crashing)
+    before = obs_metrics.counter("ingest.parallel.degrades").value
+    with pytest.warns(UserWarning, match="recomputing serially"):
+        degraded = ingest_for_model(toas[:], model)
+    assert (
+        obs_metrics.counter("ingest.parallel.degrades").value
+        == before + 1
+    )
+    _assert_bit_identical(serial, degraded)
+
+
+def test_workers_env_zero_is_serial(monkeypatch):
+    """PINT_TPU_INGEST_WORKERS=0 runs the single-chunk path (no pool)
+    — the escape hatch must not change results either."""
+    from pint_tpu.toas import ingest_topo
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    model, toas = _make_topo(ntoa=200)
+
+    def no_pool(*a, **k):  # the pool must not be entered at all
+        raise AssertionError("thread pool used despite WORKERS=0")
+
+    monkeypatch.setattr(ingest_topo, "_run_parallel", no_pool)
+    monkeypatch.setattr(ingest_topo, "_MIN_PARALLEL_TOAS", 4)
+    monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", "0")
+    out = ingest_for_model(toas[:], model)
+    assert out.t_tdb is not None
+
+
+# ---------------------------------------------------------------------- #
+# persistent ingest cache
+
+
+def _write_tim(path, toas):
+    from pint_tpu.io.tim import write_tim_file
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        write_tim_file(str(path), toas)
+
+
+def test_cache_invalidates_on_each_key_axis(tmp_path, monkeypatch):
+    from pint_tpu.toas import cache as tcache
+    from pint_tpu.toas import ingest_topo
+
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    model, toas = _make_topo(ntoa=40)
+    tim = tmp_path / "k.tim"
+    _write_tim(tim, toas)
+
+    t1 = tcache.get_TOAs(str(tim), model=model, usepickle=True)
+    assert tcache.load_cache(
+        str(tim), model_par=model.as_parfile()
+    ) is not None
+
+    # axis 1: tim content (a changed row is NOT a pure append)
+    _write_tim(tim, toas[: len(toas) - 1])
+    assert tcache.load_cache(
+        str(tim), model_par=model.as_parfile()
+    ) is None
+    _write_tim(tim, toas)
+    # axis 2: ingest options (here: the model par text)
+    assert tcache.load_cache(str(tim), model_par="PSR FAKE\n") is None
+    # axis 3: ingest-code version
+    monkeypatch.setattr(
+        ingest_topo, "INGEST_CODE_VERSION", "ingest-r999"
+    )
+    assert tcache.load_cache(
+        str(tim), model_par=model.as_parfile()
+    ) is None
+    monkeypatch.undo()
+    # ... and the unperturbed key still hits, bit-identically
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    t2 = tcache.get_TOAs(str(tim), model=model, usepickle=True)
+    _assert_bit_identical(t1, t2)
+
+
+def test_append_incremental_reingests_only_tail(tmp_path, monkeypatch):
+    """Appending TOAs to a cached tim re-ingests ONLY the tail, and
+    the stitched table is bit-identical to a full fresh ingest."""
+    import pint_tpu.toas.ingest as ingest_mod
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.toas import cache as tcache
+
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    model, toas = _make_topo(ntoa=60)
+    tim = tmp_path / "a.tim"
+    _write_tim(tim, toas[:45])
+    tcache.get_TOAs(str(tim), model=model, usepickle=True)
+
+    ingested_sizes = []
+    real = ingest_mod.ingest_for_model
+
+    def spying(t, m, **kw):
+        ingested_sizes.append(len(t))
+        return real(t, m, **kw)
+
+    monkeypatch.setattr(ingest_mod, "ingest_for_model", spying)
+    _write_tim(tim, toas)  # same 45 rows + 15 appended
+    inc_before = obs_metrics.counter("ingest.cache.incremental").value
+    got = tcache.get_TOAs(str(tim), model=model, usepickle=True)
+    assert ingested_sizes == [15]  # tail only, never the prefix
+    assert (
+        obs_metrics.counter("ingest.cache.incremental").value
+        == inc_before + 1
+    )
+
+    monkeypatch.setattr(ingest_mod, "ingest_for_model", real)
+    fresh = tcache.get_TOAs(str(tim), model=model, usepickle=False)
+    _assert_bit_identical(got, fresh)
+    # the refreshed cache now full-hits on the grown file
+    hits = obs_metrics.counter("ingest.cache.hits").value
+    again = tcache.get_TOAs(str(tim), model=model, usepickle=True)
+    assert obs_metrics.counter("ingest.cache.hits").value == hits + 1
+    _assert_bit_identical(again, fresh)
+
+
+def test_shrunk_or_edited_file_falls_back_to_full(tmp_path, monkeypatch):
+    from pint_tpu.toas import cache as tcache
+
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    model, toas = _make_topo(ntoa=30)
+    tim = tmp_path / "s.tim"
+    _write_tim(tim, toas)
+    tcache.get_TOAs(str(tim), model=model, usepickle=True)
+
+    _write_tim(tim, toas[:20])  # shrunk: cached rows are NOT a prefix
+    got = tcache.get_TOAs(str(tim), model=model, usepickle=True)
+    assert len(got) == 20
+    fresh = tcache.get_TOAs(str(tim), model=model, usepickle=False)
+    _assert_bit_identical(got, fresh)

@@ -301,8 +301,11 @@ def test_onchip_full_cov_blocked_matches_woodbury():
 def test_onchip_full_cov_fast_cholesky_matches_woodbury():
     """The large-n dense full-cov mixed step routes through
     parallel/dense.py::fast_cholesky32 (3-pass-bf16 trailing GEMM +
-    panel-by-inverse + preconditioner ridge; n >= 8192 threshold in
-    fitting/gls.py::gls_step_full_cov).  CPU tests CANNOT see this:
+    triangular-solve panels + preconditioner ridge; n >= 8192
+    threshold in fitting/gls.py::gls_step_full_cov — the
+    panel-by-inverse variant was REJECTED in r5: Ldinv's large
+    entries amplify the 3-pass error into the Schur cancellation and
+    NaN, see fast_cholesky32's docstring).  CPU tests CANNOT see this:
     matmul precision flags are TPU-only, so the ~30x looser factor
     exists only on chip.  The refined step must still match the
     independent f64 Woodbury step on the same operands — proving the
