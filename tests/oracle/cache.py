@@ -52,6 +52,10 @@ _SOURCES = (
     # coordinate fix must invalidate the cache
     _REPO / "pint_tpu" / "observatory" / "__init__.py",
     _REPO / "pint_tpu" / "observatory" / "satellite.py",
+    # precision scoping of the parallel/serial oracle map affects the
+    # computed values (ambient dps of pool workers), so it is key
+    # material too (r6)
+    _ORACLE_DIR / "pmap.py",
 )
 
 
@@ -105,7 +109,19 @@ def cached_oracle(name: str, extra_parts, compute) -> dict:
         with np.load(path, allow_pickle=False) as z:
             if str(z["key"]) == key:
                 return {k: z[k] for k in z.files if k != "key"}
-    out = compute()
+    # pin the AMBIENT mpmath precision for the whole recompute (r6):
+    # the oracle scopes its own entry points with workdps(_DPS), but
+    # any arithmetic that slips outside those scopes runs at whatever
+    # dps the process happens to hold — test_dd.py's 50 digits used to
+    # leak in and shift rebaked values by ~4e-12 s vs a pristine bake.
+    # Cached values must be a pure function of the keyed inputs, so
+    # the bake chokepoint fixes the ambient regardless of suite order.
+    from mpmath import mp
+
+    from oracle.mp_pipeline import _DPS
+
+    with mp.workdps(_DPS):
+        out = compute()
     assert "key" not in out
     CACHE_DIR.mkdir(exist_ok=True)
     np.savez(path, key=np.str_(key), **out)

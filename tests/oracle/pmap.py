@@ -40,26 +40,45 @@ def _init_worker(par_path, tim_path, env):
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
     os.environ.update(env)
-    from oracle.mp_pipeline import OraclePulsar
+    from mpmath import mp
 
-    _G["oracle"] = OraclePulsar(par_path, tim_path)
+    from oracle.mp_pipeline import _DPS, OraclePulsar
+
+    with mp.workdps(_DPS):
+        _G["oracle"] = OraclePulsar(par_path, tim_path)
 
 
 def _one_raw(i):
+    # pin the worker's AMBIENT precision: spawn children start at
+    # mpmath's default 15 digits while a serial run inherits the
+    # caller's ambient — without this scope the pool and serial paths
+    # could disagree at ~1e-12 s wherever oracle arithmetic escapes
+    # the mp_pipeline entry-point scopes (r6; same hazard class as
+    # test_dd's old process-global dps mutation)
+    from mpmath import mp
+
+    from oracle.mp_pipeline import _DPS
+
     o = _G["oracle"]
-    return float(o._one_residual_raw(o.toas[i]))
+    with mp.workdps(_DPS):
+        return float(o._one_residual_raw(o.toas[i]))
 
 
 def oracle_raw_residuals(par_path, tim_path) -> np.ndarray:
     """Every-TOA raw (un-meaned) oracle residuals, parallel when the
     host has cores to spare.  Call inside the ingest env context — the
     relevant ``$PINT_TPU_*`` variables are forwarded to the workers."""
-    from oracle.mp_pipeline import OraclePulsar, parse_tim
+    from mpmath import mp
+
+    from oracle.mp_pipeline import _DPS, OraclePulsar, parse_tim
 
     n = _procs()
     if n <= 1:
-        o = OraclePulsar(par_path, tim_path)
-        return np.array([float(o._one_residual_raw(t)) for t in o.toas])
+        with mp.workdps(_DPS):
+            o = OraclePulsar(par_path, tim_path)
+            return np.array(
+                [float(o._one_residual_raw(t)) for t in o.toas]
+            )
     from multiprocessing import get_context
 
     env = {k: v for k, v in os.environ.items()

@@ -23,7 +23,19 @@ from pint_tpu.ops.taylor import (
     taylor_horner_deriv_dd,
 )
 
-mp.mp.dps = 50
+# 50 working digits for every DD-vs-mpmath comparison — SCOPED per
+# test via the autouse fixture below, never a process-global
+# `mp.mp.dps = 50`: a module-level mutation leaks into every test
+# collected after this file, and ambient-precision-sensitive oracle
+# arithmetic then bakes ~4e-12 s shifts into the committed oracle
+# caches when a source edit forces an in-suite rebake (found r6).
+_DD_DPS = 50
+
+
+@pytest.fixture(autouse=True)
+def _scoped_dd_dps():
+    with mp.workdps(_DD_DPS):
+        yield
 
 # Magnitudes bounded away from the subnormal range: XLA flushes f64
 # subnormals to zero (FTZ), which breaks EFT exactness at ~1e-308 — far
