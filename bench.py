@@ -287,6 +287,101 @@ def _obs_block():
     return out
 
 
+def _serve_block():
+    """Serving telemetry for BENCH_*.json (ISSUE 4 — pint_tpu/serve):
+    a mixed-size fleet of same-composition pulsars served as fits,
+    scored two ways.  SERIAL is one-request-at-a-time dispatch
+    (max_batch=1, one in flight) — what every pre-serve caller did by
+    hand; ASYNC is the production engine (dynamic batching + >=4
+    batches in flight hiding the ~85 ms tunnel round-trip).  Gates:
+    steady-state traffic must cause ZERO XLA retraces (mixed TOA
+    counts all land in one power-of-two bucket), and on accelerators
+    the async engine must sustain >= 3x the serial throughput — both
+    are ISSUE 4 acceptance criteria, enforced here so the driver
+    tracks them per round like the guard/obs invariants."""
+    import jax
+
+    from pint_tpu.exceptions import PintTpuError
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    npsr, rounds = 8, 4
+    pulsars = []
+    for i in range(npsr):
+        par = (
+            f"PSR S{i}\nF0 {120 + 11 * i}.25 1\nF1 -2e-15 1\n"
+            f"PEPOCH 55000\nDM {4 + 1.7 * i:.2f} 1\n"
+        )
+        m, toas = make_test_pulsar(
+            par, ntoa=160 + 12 * i,  # mixed sizes, one 256 bucket
+            start_mjd=54000.0, end_mjd=56000.0, seed=i, iterations=1,
+        )
+        pulsars.append((m.as_parfile(), toas))
+    total_toas = sum(len(t) for _, t in pulsars)
+
+    def requests():
+        return [
+            FitRequest(par=p, toas=t, maxiter=2) for p, t in pulsars
+        ]
+
+    # serial baseline: submit -> wait -> next, one dispatch each
+    with TimingEngine(max_batch=1, max_wait_ms=0.0, inflight=1) as eng:
+        eng.submit(requests()[0]).result(timeout=3600)  # warm cap-1
+        t0 = time.perf_counter()
+        for r in requests():
+            eng.submit(r).result(timeout=3600)
+        serial_rps = npsr / (time.perf_counter() - t0)
+
+    eng = TimingEngine(max_batch=npsr, max_wait_ms=5.0, inflight=4)
+    try:
+        for f in eng.submit_many(requests()):  # warm the cap-8 kernel
+            f.result(timeout=3600)
+        eng.reset_stats()  # scope stats to the steady-state window
+        traces0 = obs_metrics.counter("compile.traces").value
+        t0 = time.perf_counter()
+        futs = []
+        for _ in range(rounds):
+            futs += eng.submit_many(requests())
+        for f in futs:
+            f.result(timeout=3600)
+        wall = time.perf_counter() - t0
+        retraces = (
+            obs_metrics.counter("compile.traces").value - traces0
+        )
+        st = eng.stats()
+    finally:
+        eng.close()
+    rps = npsr * rounds / wall
+    speedup = rps / serial_rps
+    if retraces:
+        raise PintTpuError(
+            f"{retraces} XLA retrace(s) across steady-state serving of "
+            "mixed-size requests within one bucket — the serve "
+            "zero-retrace invariant is broken (shape buckets / batch "
+            "capacities must make every steady-state dispatch "
+            "shape-stable; docs/serving.md)"
+        )
+    if jax.default_backend() != "cpu" and speedup < 3.0:
+        raise PintTpuError(
+            f"async serving sustained only {speedup:.2f}x the serial "
+            "one-request-at-a-time throughput (>= 3x required on "
+            "accelerators: batching + pipelining must amortize the "
+            "~85 ms tunnel round-trip; docs/serving.md)"
+        )
+    return {
+        "requests_per_s": round(rps, 2),
+        "toas_per_s": round(rps * total_toas / npsr, 1),
+        "p50_ms": st["p50_ms"],
+        "p99_ms": st["p99_ms"],
+        "batch_occupancy": st["batch_occupancy_mean"],
+        "sheds": st["shed"] + st["rejected"],
+        "serial_requests_per_s": round(serial_rps, 2),
+        "speedup_vs_serial": round(speedup, 2),
+        "steady_retraces": retraces,
+    }
+
+
 def main():
     import jax
 
@@ -325,6 +420,7 @@ def main():
 
     guard_block = _guard_block(cm, step, mode, t_dev)
     obs_block = _obs_block()
+    serve_block = _serve_block()
 
     # CPU baseline: the all-f64 reference-class computation on host
     # (dispatch-free, so a short chain measures the same steady state).
@@ -390,6 +486,7 @@ def main():
                 "vs_baseline": round(t_cpu / t_dev, 3),
                 "guard": guard_block,
                 "obs": obs_block,
+                "serve": serve_block,
                 "cold": {
                     **cold_block,
                     # executables persisted by THIS run: >0 on a cold

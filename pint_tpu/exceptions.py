@@ -173,6 +173,22 @@ class CorrelatedErrors(PintTpuError):
         )
 
 
+class RequestRejected(PintTpuError):
+    """Typed load-shed rejection from the serving engine
+    (serve/engine.py).  The backpressure contract of docs/serving.md:
+    an overloaded engine REFUSES work loudly — a bounded-queue
+    rejection, a missed per-request deadline, or a shutdown — and
+    never hangs, OOMs, or silently drops a request.  ``reason`` is one
+    of ``'queue-full'``, ``'deadline'``, ``'shutdown'``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(
+            f"request rejected ({reason})"
+            + (f": {detail}" if detail else "")
+        )
+
+
 class DegeneracyWarning(UserWarning):
     """Design matrix is degenerate; some parameters are unconstrained."""
 

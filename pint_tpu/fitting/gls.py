@@ -19,7 +19,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.fitting.base import Fitter, make_scan_fit_loop, record_fit
+from pint_tpu.fitting.base import (
+    Fitter,
+    design_with_offset,
+    make_scan_fit_loop,
+    noffset,
+    record_fit,
+)
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.toas.toas import TOAs
 
@@ -235,6 +241,44 @@ def default_accel_mode(cm) -> str:
     if jax.default_backend() == "cpu":
         return "f64"
     return "mixed" if cm.has_correlated_errors else "f64"
+
+
+def gauss_newton_step(cm, x, mode: str):
+    """One reduced-rank GLS Gauss-Newton step evaluated on a
+    CompiledModel's CURRENT bundle/reference state:
+    ``-> (x_new, (covn, norm), chi2, nbad)`` with the covariance kept
+    NORMALIZED (including the implicit-offset row — callers slice and
+    unnormalize; see _finish_normal_eqs on why raw variances must not
+    form on device).
+
+    The single shared step assembly for every consumer that swaps
+    per-pulsar bundles/refs into a prototype model before calling —
+    the PTA batch (parallel/pta.py::PTABatch.fit_step) and the serving
+    engine's batched fit kernels (serve/session.py::build_fit_kernel)
+    — so the residual/design/whitening recipe can never diverge from
+    GLSFitter's own ``_step_inputs``.
+
+    mode: 'mixed' (f32 MXU Woodbury Grams — the accelerator policy of
+    default_accel_mode) or 'f64' (exact; the CPU/white-noise policy).
+    """
+    from pint_tpu.exceptions import PintTpuError
+
+    if mode not in ("mixed", "f64"):
+        raise PintTpuError(
+            f"unknown GLS step mode {mode!r}: expected 'mixed' or 'f64'"
+        )
+    step = (
+        gls_step_woodbury_mixed if mode == "mixed" else gls_step_woodbury
+    )
+    no = noffset(cm)
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Ndiag = jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    dx, (covn, nrm), chi2, nbad = step(
+        r, M, Ndiag, T, phi, normalized_cov=True
+    )
+    return x + dx[no:], (covn, nrm), chi2, nbad
 
 
 def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,

@@ -45,9 +45,23 @@ for _name, _unit in (
     ("fit.count", ""),
     ("ingest.count", ""),
     ("ingest.toas", "TOAs"),
+    # serving engine (pint_tpu/serve — PR 4); histograms/gauges below
+    ("serve.requests", ""),
+    ("serve.completed", ""),
+    ("serve.shed", ""),
+    ("serve.rejected", ""),
+    ("serve.batches", ""),
+    ("serve.session.hits", ""),
+    ("serve.session.misses", ""),
+    ("serve.session.evictions", ""),
+    ("serve.polyco.hits", ""),
+    ("serve.polyco.misses", ""),
 ):
     metrics.counter(_name, unit=_unit)
 del _name, _unit
+metrics.histogram("serve.batch_occupancy")
+metrics.histogram("serve.latency_ms", unit="ms")
+metrics.gauge("serve.queue_depth")
 
 #: the axon remote-compile transport rejects requests around this size
 #: (HTTP 413 measured at ~256 MB, r5); a baked module whose literal

@@ -52,6 +52,19 @@ fallback.rung                   gauge    rung index that served the
                                          last laddered computation
 fit.count                       counter  fit_toas invocations
 ingest.count / ingest.toas      counter  ingest calls / TOAs ingested
+serve.requests                  counter  submissions to the serving
+                                         engine (pint_tpu/serve)
+serve.completed                 counter  ...resolved successfully
+serve.shed                      counter  deadline sheds (typed
+                                         RequestRejected)
+serve.rejected                  counter  bounded-queue rejections
+serve.batches                   counter  dispatched micro-batches
+serve.batch_occupancy           histo    live requests per batch
+serve.latency_ms                histo    submit->result wall time
+serve.queue_depth               gauge    admission-queue depth
+serve.session.hits/misses/      counter  session LRU traffic
+  evictions
+serve.polyco.hits/misses        counter  per-session polyco spans
 ==============================  =======  ==============================
 """
 

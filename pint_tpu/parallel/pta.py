@@ -20,15 +20,36 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.exceptions import PintTpuError
-from pint_tpu.fitting.base import design_with_offset, noffset
-from pint_tpu.fitting.gls import (
-    default_accel_mode,
-    gls_step_woodbury,
-    gls_step_woodbury_mixed,
-)
+from pint_tpu.fitting.base import noffset
+from pint_tpu.fitting.gls import default_accel_mode, gauss_newton_step
 from pint_tpu.toas.bundle import TOABundle
 
-# padded TOAs get this uncertainty (us): weight ~ 1e-48 of a real TOA
+#: Uncertainty assigned to padded TOAs (microseconds).  The value must
+#: thread the emulated-f64 hazard window of docs/precision.md on BOTH
+#: sides (regression-tested in tests/test_pta_batch.py::
+#: test_pad_error_emulated_f64_headroom; axon's f32-pair f64 keeps the
+#: f32 EXPONENT range):
+#:
+#: * big enough that pad rows are statistically invisible: the pad
+#:   weight 1/(1e18 us)^2 = 1e-24 s^-2 is ~1e-36 of a 1-us real TOA's
+#:   1e12 s^-2 — fit perturbations land ~25 decades below f64
+#:   roundoff;
+#: * small enough that nothing overflows or flushes on device:
+#:   - Ndiag entry sigma^2 = (1e12 s)^2 = 1e24 stays ~14 decades under
+#:     the f32-range ceiling 3.4e38 (sigma itself under the ~1.8e19
+#:     square ceiling of runtime/guard.py::F32_SQUARE_CEILING);
+#:   - the Woodbury whitening forms 1/sigma^2 = 1e-24, ~14 decades
+#:     above the ~1.2e-38 flush-to-zero floor (and safely above the
+#:     1/x-overflow floor ~1e-38 — cf. noise_basis_or_empty's 1e-30
+#:     degenerate weight, chosen against the same hazard);
+#:   - padded weighted design columns |M·sqrt(w)|: pad rows repeat the
+#:     last real TOA, so |M| <= ~1e17 (the F4+ spindown-column scale of
+#:     the weighted-design assembly ceiling) times sqrt(w)=1e-12 is
+#:     ~1e5 — far under the |M·sqrt(w)| ~3.4e38 assembly ceiling.
+#:
+#: Raising this past ~1e19 starts eating the sigma^2 headroom on
+#: device; lowering it below ~1e9 starts giving pad rows measurable
+#: (>1e-18 relative) statistical weight.  1e18 sits mid-window.
 PAD_ERROR_US = 1e18
 
 
@@ -190,24 +211,16 @@ class PTABatch:
                 f"unknown PTA fit mode {mode!r}: expected 'mixed' or "
                 "'f64'"
             )
-        gls_step = (
-            gls_step_woodbury_mixed if mode == "mixed"
-            else gls_step_woodbury
-        )
 
         def single(cm, x):
-            r = cm.time_residuals(x, subtract_mean=False)
-            M = design_with_offset(cm, x)
-            Ndiag = jnp.square(cm.scaled_sigma(x))
-            T, phi = cm.noise_basis_or_empty(x)
-            # covariance stays NORMALIZED on device ((covn, norm) —
-            # raw variances of stiff columns underflow f32-range
-            # emulated f64; see fitting/gls.py::_finish_normal_eqs);
+            # the shared step assembly (fitting/gls.py::
+            # gauss_newton_step — also the serving engine's batched
+            # kernel body); covariance stays NORMALIZED on device
+            # ((covn, norm) — raw variances of stiff columns underflow
+            # f32-range emulated f64, see gls.py::_finish_normal_eqs);
             # fit() unnormalizes on the host
-            dx, (covn, nrm), chi2, _ = gls_step(
-                r, M, Ndiag, T, phi, normalized_cov=True
-            )
-            return x + dx[no:], chi2, (covn[no:, no:], nrm[no:])
+            xn, (covn, nrm), chi2, _ = gauss_newton_step(cm, x, mode)
+            return xn, chi2, (covn[no:, no:], nrm[no:])
 
         call = self._with_state(single)
         return jax.vmap(call)(self.bundle, self.ref, xs)

@@ -146,26 +146,68 @@ class Polycos:
         return cls(entries)
 
     # -- evaluation -------------------------------------------------------
+    #: span-membership slack in minutes: the tempo format's 11-decimal
+    #: TMID snap (see generate) moves segment centers by up to ~5e-12
+    #: day, so an epoch exactly on a segment edge can sit ~1e-9 min
+    #: outside the nominal +-span/2 window; 1e-6 min (60 us) accepts
+    #: those without letting genuinely uncovered epochs through.
+    _SPAN_SLACK_MIN = 1e-6
+
     def _entry_for(self, mjd):
         for e in self.entries:
-            if abs(mjd - e.tmid_mjd) * 1440.0 <= e.mjd_span_minutes / 2 + 1e-9:
+            if abs(mjd - e.tmid_mjd) * 1440.0 <= (
+                e.mjd_span_minutes / 2 + self._SPAN_SLACK_MIN
+            ):
                 return e
         raise PintTpuError(f"MJD {mjd} outside polyco span")
 
+    def _entry_indices(self, mjds) -> np.ndarray:
+        """Vectorized segment lookup: nearest-tmid via searchsorted,
+        then a span check — O((n + m) log m) instead of the O(n m)
+        per-epoch linear scan (the serving engine's phase-predict hot
+        path polls thousands of epochs per request;
+        serve/engine.py::_predict)."""
+        order = np.argsort([e.tmid_mjd for e in self.entries],
+                           kind="stable")
+        tmids = np.array(
+            [self.entries[i].tmid_mjd for i in order]
+        )
+        pos = np.searchsorted(tmids, mjds)
+        lo = np.clip(pos - 1, 0, len(tmids) - 1)
+        hi = np.clip(pos, 0, len(tmids) - 1)
+        nearest = np.where(
+            np.abs(mjds - tmids[lo]) <= np.abs(mjds - tmids[hi]),
+            lo, hi,
+        )
+        idx = order[nearest]
+        for i, m in zip(np.atleast_1d(idx), np.atleast_1d(mjds)):
+            e = self.entries[int(i)]
+            if abs(m - e.tmid_mjd) * 1440.0 > (
+                e.mjd_span_minutes / 2 + self._SPAN_SLACK_MIN
+            ):
+                raise PintTpuError(f"MJD {m} outside polyco span")
+        return idx
+
     def eval_abs_phase(self, mjds):
         mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
+        idx = self._entry_indices(mjds)
         ints = np.empty_like(mjds)
         fracs = np.empty_like(mjds)
-        for i, m in enumerate(mjds):
-            e = self._entry_for(m)
-            ints[i], fracs[i] = e.abs_phase(m)
+        for i in np.unique(idx):
+            sel = idx == i
+            ints[sel], fracs[sel] = self.entries[int(i)].abs_phase(
+                mjds[sel]
+            )
         return ints, fracs
 
     def eval_spin_freq(self, mjds):
         mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
-        return np.array([
-            self._entry_for(m).spin_freq(m) for m in mjds
-        ])
+        idx = self._entry_indices(mjds)
+        out = np.empty_like(mjds)
+        for i in np.unique(idx):
+            sel = idx == i
+            out[sel] = self.entries[int(i)].spin_freq(mjds[sel])
+        return out
 
     # -- tempo polyco.dat format ------------------------------------------
     def write(self, path):

@@ -1,0 +1,61 @@
+"""pint_tpu.serve — the timing-as-a-service engine (ISSUE 4).
+
+Four layers, each its own module:
+
+- :mod:`pint_tpu.serve.api` — typed request/response records for the
+  three core operations (residuals, WLS/GLS fit, polyco
+  phase-predict) with per-request deadlines and priorities;
+- :mod:`pint_tpu.serve.session` — the LRU session cache of compiled
+  models keyed by (par-content hash, accel mode, shape bucket),
+  warm-started from the persistent compile/ingest caches;
+- :mod:`pint_tpu.serve.batcher` — the shape-bucketed dynamic
+  micro-batcher (power-of-two TOA buckets + batch capacities: zero
+  XLA retraces at steady state);
+- :mod:`pint_tpu.serve.engine` — the async dispatch pipeline (bounded
+  queue, load-shedding backpressure, >1 batch in flight across the
+  ~85 ms axon tunnel round-trip).
+
+Quick start::
+
+    from pint_tpu.serve import FitRequest, TimingEngine
+
+    with TimingEngine() as engine:
+        fut = engine.submit(FitRequest(par=par_text, toas=toas))
+        response = fut.result()       # FitResponse
+
+Semantics, bucket policy, and the backpressure contract are in
+docs/serving.md; env knobs are ``PINT_TPU_SERVE_*``.
+"""
+
+from pint_tpu.exceptions import RequestRejected
+from pint_tpu.serve.api import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    FitRequest,
+    FitResponse,
+    PredictRequest,
+    PredictResponse,
+    Request,
+    ResidualsRequest,
+    ResidualsResponse,
+)
+from pint_tpu.serve.engine import TimingEngine
+from pint_tpu.serve.session import SessionCache, shape_bucket
+
+__all__ = [
+    "PRIORITY_HIGH",
+    "PRIORITY_LOW",
+    "PRIORITY_NORMAL",
+    "FitRequest",
+    "FitResponse",
+    "PredictRequest",
+    "PredictResponse",
+    "Request",
+    "RequestRejected",
+    "ResidualsRequest",
+    "ResidualsResponse",
+    "SessionCache",
+    "TimingEngine",
+    "shape_bucket",
+]

@@ -1,0 +1,185 @@
+"""Typed request/response records for the timing-as-a-service engine.
+
+Reference parity: none — the reference framework (mhvk/PINT) is a
+library, not a service; this is the request-facing surface of the
+ROADMAP's "serving heavy traffic" north star.  Three core operations:
+
+- :class:`ResidualsRequest` -> :class:`ResidualsResponse` — time
+  residuals + chi2 of a par-file model against a TOA set;
+- :class:`FitRequest` -> :class:`FitResponse` — an iterated WLS/GLS
+  fit (the GLS Gauss-Newton scan loop, which equals WLS for
+  white-noise models) returning fitted deltas, uncertainties, and a
+  fitted par file;
+- :class:`PredictRequest` -> :class:`PredictResponse` — polyco-backed
+  absolute-phase / spin-frequency prediction at arbitrary epochs (the
+  online-folding workload).
+
+Every request carries a **deadline** (seconds the caller is willing to
+wait; requests still queued past it are shed with a typed
+:class:`~pint_tpu.exceptions.RequestRejected`, never silently served
+late) and a **priority** (lower = flushed first when multiple batches
+are ready).  Submission is ``TimingEngine.submit(request) -> Future``
+(serve/engine.py); batching/bucketing is invisible to the caller
+except through the response's provenance fields (bucket, batch size).
+
+Requests are frozen records: the engine never mutates them, and a
+request object can be re-submitted (a fresh ``request_id`` names each
+logical submission — build a new record for a new id).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import ClassVar, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError, RequestRejected  # noqa: F401
+# re-exported: RequestRejected is part of the serve API surface
+
+#: flush-ordering priorities (lower flushes first)
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+
+def _new_request_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+@dataclass(frozen=True)
+class Request:
+    """Common request envelope.
+
+    par: par-file text (str) or a TimingModel (hashed via as_parfile).
+    toas: an (optionally pre-ingested) TOAs table; the engine ingests
+        through toas.ingest.ingest_for_model when ``t_tdb`` is absent.
+    deadline_s: wall-clock budget from submission; ``None`` = no
+        deadline.
+    priority: PRIORITY_* flush ordering.
+    """
+
+    par: object
+    toas: object = None
+    deadline_s: Optional[float] = None
+    priority: int = PRIORITY_NORMAL
+    request_id: str = field(default_factory=_new_request_id)
+
+    op: ClassVar[str] = "?"
+
+    def validate(self):
+        if self.par is None:
+            raise PintTpuError(f"{type(self).__name__} needs a par")
+        if self.toas is None:
+            raise PintTpuError(
+                f"{type(self).__name__} needs a TOAs table"
+            )
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise PintTpuError(
+                f"negative deadline {self.deadline_s!r}"
+            )
+
+
+@dataclass(frozen=True)
+class ResidualsRequest(Request):
+    """Time residuals (s) + chi2 of the par-file model (x = 0)."""
+
+    subtract_mean: bool = True
+
+    op: ClassVar[str] = "residuals"
+
+
+@dataclass(frozen=True)
+class FitRequest(Request):
+    """Iterated Gauss-Newton fit of the model's free parameters.
+
+    method: 'auto' / 'gls' run the production GLS scan loop (equal to
+        WLS when the model has no correlated noise); 'wls' asserts the
+        model IS white-noise (a typed error otherwise — the serving
+        engine never silently drops a correlated basis the way a
+        reference WLS fit would).
+    tol_chi2: convergence tolerance; None = the GLSFitter policy
+        (1e-10 exact-f64, 3e-6 mixed-precision).
+    """
+
+    method: str = "auto"
+    maxiter: int = 4
+    tol_chi2: Optional[float] = None
+
+    op: ClassVar[str] = "fit"
+
+    def validate(self):
+        super().validate()
+        if self.method not in ("auto", "gls", "wls"):
+            raise PintTpuError(
+                f"unknown fit method {self.method!r}: expected "
+                "'auto', 'gls', or 'wls'"
+            )
+        if self.maxiter < 1:
+            raise PintTpuError("FitRequest needs maxiter >= 1")
+
+
+@dataclass(frozen=True)
+class PredictRequest(Request):
+    """Absolute phase + spin frequency at UTC MJDs via cached polycos
+    (pint_tpu.polycos) — the phase-prediction operation online folders
+    poll at high rate.  No TOAs: the polyco span is generated from the
+    requested epochs and cached per session."""
+
+    mjds: object = None  # (n,) UTC MJDs
+    obs: str = "@"
+    obsfreq_mhz: float = 1400.0
+    segment_minutes: float = 60.0
+    ncoeff: int = 12
+
+    op: ClassVar[str] = "predict"
+
+    def validate(self):
+        if self.par is None:
+            raise PintTpuError("PredictRequest needs a par")
+        if self.mjds is None or np.size(self.mjds) == 0:
+            raise PintTpuError("PredictRequest needs at least one MJD")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise PintTpuError(
+                f"negative deadline {self.deadline_s!r}"
+            )
+
+
+# -- responses -----------------------------------------------------------
+@dataclass
+class ResidualsResponse:
+    request_id: str
+    ntoa: int
+    residuals_s: np.ndarray  # (ntoa,) — pad rows already sliced off
+    chi2: float
+    bucket: int  # TOA-axis shape bucket that served the request
+    batch_size: int  # live requests stacked in the serving batch
+    wall_ms: float  # submit -> result wall time
+
+
+@dataclass
+class FitResponse:
+    request_id: str
+    names: tuple  # free-parameter names, delta/uncertainty order
+    deltas: np.ndarray  # fitted deltas, internal units
+    uncertainties: np.ndarray  # 1-sigma, internal units
+    chi2: float
+    converged: bool
+    method: str  # effective method actually run ('gls')
+    mode: str  # accelerator step mode ('mixed' | 'f64')
+    fitted_par: str  # par-file text with fitted values committed
+    ntoa: int
+    bucket: int
+    batch_size: int
+    wall_ms: float
+
+
+@dataclass
+class PredictResponse:
+    request_id: str
+    phase_int: np.ndarray  # integer cycles at each MJD
+    phase_frac: np.ndarray  # fractional cycles
+    spin_freq_hz: np.ndarray
+    cached: bool  # True when the polyco span was already generated
+    wall_ms: float

@@ -1,0 +1,142 @@
+"""Shape-bucketed dynamic micro-batcher for the serving engine.
+
+Reference parity: none — TPU-service infrastructure.  Pending requests
+accumulate in groups keyed by (operation, composition key, shape
+bucket, op parameters); a group flushes when it reaches the max batch
+size or when its oldest member has waited ``max_wait`` (the classic
+dynamic-batching contract: bounded added latency, amortized ~85 ms
+axon dispatches).  Stacking is HOST-side numpy throughout — each
+request's padded bundle/reference pytree is np.stack'ed on a leading
+batch axis and crosses to the device as ONE set of runtime arguments
+per dispatch (see toas/bundle.py::make_bundle as_numpy).
+
+Two shape axes are quantized so steady-state serving never retraces:
+
+- the TOA axis pads to the session's power-of-two bucket
+  (serve/session.py::shape_bucket) with statistically-invisible TOAs
+  (parallel/pta.py::PAD_ERROR_US — the emulated-f64 headroom analysis
+  lives on that constant);
+- the batch axis pads to a power-of-two *capacity*
+  (:func:`capacity_for`) by repeating the first live request, so at
+  most log2(max_batch)+1 capacities exist per group key.
+
+The Batcher itself is a pure data structure (no threads, no clocks of
+its own) driven by the engine's collector loop — which keeps flush
+policy deterministic and unit-testable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import tree_util
+
+from pint_tpu.parallel.pta import PAD_ERROR_US
+from pint_tpu.toas.bundle import TOABundle
+
+
+def capacity_for(nlive: int, max_batch: int) -> int:
+    """Batch-axis capacity: next power of two >= nlive, capped by the
+    (power-of-two-rounded) max batch size."""
+    cap = 1
+    while cap < min(nlive, max_batch):
+        cap <<= 1
+    return cap
+
+
+def pad_bundle_np(bundle: TOABundle, n: int) -> TOABundle:
+    """Host-numpy sibling of parallel/pta.py::pad_bundle_to: pad the
+    TOA axis to ``n`` by repeating the last TOA with PAD_ERROR_US
+    uncertainty (zero statistical weight)."""
+    cur = bundle.ntoa
+    if cur == n:
+        return bundle
+    if cur > n:
+        raise ValueError(f"cannot pad {cur} TOAs down to {n}")
+    pad = n - cur
+
+    def padleaf(x):
+        if isinstance(x, np.ndarray) and x.ndim >= 1 and \
+                x.shape[0] == cur:
+            return np.concatenate(
+                [x, np.repeat(x[-1:], pad, axis=0)], axis=0
+            )
+        return x
+
+    out = tree_util.tree_map(padleaf, bundle)
+    return out._replace(
+        error_us=np.concatenate([
+            np.asarray(bundle.error_us), np.full(pad, PAD_ERROR_US),
+        ])
+    )
+
+
+def stack_trees(trees: list):
+    """np.stack every leaf of structurally-identical pytrees on a new
+    leading batch axis (bundles, reference pytrees, state vectors)."""
+    return tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
+    )
+
+
+class MicroBatch:
+    """One flushable group of same-composition pending requests."""
+
+    __slots__ = ("key", "items", "t_oldest", "priority")
+
+    def __init__(self, key):
+        self.key = key
+        self.items: list = []
+        self.t_oldest: float | None = None
+        self.priority: int = 10**9
+
+    def add(self, item, now: float, priority: int):
+        self.items.append(item)
+        if self.t_oldest is None:
+            self.t_oldest = now
+        self.priority = min(self.priority, priority)
+
+    def __len__(self):
+        return len(self.items)
+
+
+class Batcher:
+    """Group accumulator with full-batch and max-wait flush triggers."""
+
+    def __init__(self, max_batch: int, max_wait_s: float):
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = float(max_wait_s)
+        self._groups: dict = {}
+
+    def __len__(self):
+        return sum(len(g) for g in self._groups.values())
+
+    def empty(self) -> bool:
+        return not self._groups
+
+    def add(self, key, item, now: float, priority: int):
+        """Queue one request; returns the group when it just filled to
+        max_batch (popped — flush it now), else None."""
+        g = self._groups.get(key)
+        if g is None:
+            g = self._groups[key] = MicroBatch(key)
+        g.add(item, now, priority)
+        if len(g) >= self.max_batch:
+            return self._groups.pop(key)
+        return None
+
+    def take_due(self, now: float, take_all: bool = False) -> list:
+        """Pop groups whose oldest member has waited max_wait (all
+        groups when ``take_all`` — engine shutdown drain)."""
+        due = [
+            k for k, g in self._groups.items()
+            if take_all or now - g.t_oldest >= self.max_wait_s
+        ]
+        return [self._groups.pop(k) for k in due]
+
+    def next_wait_s(self, now: float):
+        """Seconds until the earliest pending group becomes due, or
+        None when nothing is pending (the collector's wait timeout)."""
+        if not self._groups:
+            return None
+        oldest = min(g.t_oldest for g in self._groups.values())
+        return max(0.0, oldest + self.max_wait_s - now)

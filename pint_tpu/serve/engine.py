@@ -1,0 +1,531 @@
+"""TimingEngine: the async request-serving pipeline.
+
+Reference parity: none — this is the request-facing subsystem of the
+ROADMAP's "serving heavy traffic" north star, composed from the PR 1-3
+substrate: every device call routes through the guarded dispatch
+chokepoint (serve/session.py::traced_jit -> runtime/guard.py), every
+stage is span/metric-instrumented (pint_tpu.obs), and compiled state
+is cached at three levels (session LRU -> in-process kernel cache ->
+persistent XLA compile cache).
+
+Pipeline (three stages, two threads + the callers'):
+
+1. **submit** (caller thread): bounded admission queue.  A full queue
+   rejects IMMEDIATELY with a typed RequestRejected('queue-full') —
+   load shedding by refusal, never by OOM or hang.
+2. **collector** (one thread): drains the queue, resolves sessions
+   (serve/session.py), pads/buckets each request, accumulates
+   micro-batches (serve/batcher.py), and flushes full or overdue
+   groups: shed expired deadlines, stack operands host-side, dispatch
+   the guarded batched kernel.  jax dispatch is ASYNC — the call
+   returns promptly with pending device arrays, so the collector moves
+   on to assemble the NEXT batch while the device (and the ~85 ms axon
+   tunnel round-trip) works on the previous ones.
+3. **fencer** (one thread): materializes results (np.asarray — the
+   only reliable sync over the tunnel), slices off padding, validates
+   finiteness, resolves futures.
+
+A bounded in-flight semaphore (``inflight``) caps how many dispatched
+batches may be awaiting the fence; when the device falls behind, the
+collector blocks on it, the admission queue fills, and new submissions
+shed — backpressure propagates to the edge as typed rejections.
+
+All engine/serving knobs have ``PINT_TPU_SERVE_*`` env defaults
+(documented in docs/serving.md): MAX_QUEUE, MAX_BATCH, MAX_WAIT_MS,
+INFLIGHT, SESSIONS, MIN_BUCKET.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+from jax import tree_util
+
+from pint_tpu.exceptions import PintTpuError, RequestRejected
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime.guard import validate_finite
+from pint_tpu.serve import batcher as bmod
+from pint_tpu.serve import session as smod
+from pint_tpu.fitting.base import noffset
+
+
+class _Pending:
+    """One admitted request flowing through the pipeline."""
+
+    __slots__ = ("req", "future", "t_submit", "session", "bundle")
+
+    def __init__(self, req, future, t_submit):
+        self.req = req
+        self.future = future
+        self.t_submit = t_submit
+        self.session = None
+        self.bundle = None  # padded host-numpy TOABundle
+
+
+class TimingEngine:
+    """Session-cached, shape-bucketed, async timing service."""
+
+    def __init__(self, *, max_queue=None, max_batch=None,
+                 max_wait_ms=None, inflight=None, min_bucket=None,
+                 max_sessions=None):
+        env = os.environ.get
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else env("PINT_TPU_SERVE_MAX_QUEUE", "256")
+        )
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else env("PINT_TPU_SERVE_MAX_BATCH", "16")
+        )
+        wait_ms = float(
+            max_wait_ms if max_wait_ms is not None
+            else env("PINT_TPU_SERVE_MAX_WAIT_MS", "5.0")
+        )
+        self.max_wait_s = wait_ms / 1e3
+        self.inflight = int(
+            inflight if inflight is not None
+            else env("PINT_TPU_SERVE_INFLIGHT", "4")
+        )
+        self.min_bucket = min_bucket
+        self.sessions = smod.SessionCache(max_sessions)
+        self._kernels: dict = {}  # (group key, capacity) -> callable
+        self._queue: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._batcher = bmod.Batcher(self.max_batch, self.max_wait_s)
+        self._fence_q: queue.Queue = queue.Queue()
+        self._sem = threading.BoundedSemaphore(max(1, self.inflight))
+        self._stop = False
+        self._latencies = collections.deque(maxlen=4096)
+        self._lat_lock = threading.Lock()
+        m = obs_metrics
+        self._m_requests = m.counter("serve.requests")
+        self._m_completed = m.counter("serve.completed")
+        self._m_shed = m.counter("serve.shed")
+        self._m_rejected = m.counter("serve.rejected")
+        self._m_batches = m.counter("serve.batches")
+        self._m_occupancy = m.histogram("serve.batch_occupancy")
+        self._m_latency = m.histogram("serve.latency_ms", unit="ms")
+        self._m_depth = m.gauge("serve.queue_depth")
+        self._collector = threading.Thread(
+            target=self._collect_loop, daemon=True,
+            name="pint-tpu-serve collector",
+        )
+        self._fencer = threading.Thread(
+            target=self._fence_loop, daemon=True,
+            name="pint-tpu-serve fencer",
+        )
+        self._collector.start()
+        self._fencer.start()
+
+    # -- the request-facing edge ------------------------------------------
+    def submit(self, request) -> Future:
+        """Enqueue one request; returns a Future resolving to the
+        op-matched response record (serve/api.py) or raising the
+        typed failure (RequestRejected on shed/rejection, a diagnosed
+        PintTpuNumericsError on non-finite device results, guard
+        errors on exhausted dispatch supervision)."""
+        fut: Future = Future()
+        self._m_requests.inc()
+        with TRACER.span(
+            "serve:submit", "serve", op=request.op,
+            request_id=request.request_id,
+        ):
+            with self._cond:
+                if self._stop:
+                    fut.set_exception(RequestRejected(
+                        "shutdown", "engine is closed"
+                    ))
+                    return fut
+                if len(self._queue) >= self.max_queue:
+                    self._m_rejected.inc()
+                    TRACER.event(
+                        "shed", "serve", reason="queue-full",
+                        op=request.op,
+                    )
+                    fut.set_exception(RequestRejected(
+                        "queue-full",
+                        f"{len(self._queue)} queued >= "
+                        f"max_queue={self.max_queue}",
+                    ))
+                    return fut
+                self._queue.append(
+                    _Pending(request, fut, time.monotonic())
+                )
+                self._m_depth.set(len(self._queue))
+                self._cond.notify()
+        return fut
+
+    def submit_many(self, requests) -> list:
+        return [self.submit(r) for r in requests]
+
+    # -- stage 2: collector ------------------------------------------------
+    def _collect_loop(self):
+        while True:
+            with self._cond:
+                if not self._queue and not self._stop:
+                    self._cond.wait(
+                        self._batcher.next_wait_s(time.monotonic())
+                    )
+                drained = list(self._queue)
+                self._queue.clear()
+                self._m_depth.set(0)
+                stopping = self._stop
+            ready = []
+            for p in drained:
+                full = self._admit(p)
+                if full is not None:
+                    ready.append(full)
+            ready += self._batcher.take_due(
+                time.monotonic(), take_all=stopping
+            )
+            for batch in sorted(ready, key=lambda b: b.priority):
+                self._flush(batch)
+            if stopping:
+                with self._cond:
+                    if not self._queue and self._batcher.empty():
+                        break
+        self._fence_q.put(None)  # FIFO: after all in-flight batches
+
+    def _admit(self, p: _Pending):
+        """Resolve session + bucket for one drained request; returns a
+        full group ready to flush, or None."""
+        req = p.req
+        try:
+            req.validate()
+            if req.op == "predict":
+                self._predict(p)
+                return None
+            sess = self.sessions.get_or_create(
+                req.par, req.toas, self.min_bucket
+            )
+            p.session = sess
+            if req.op == "fit":
+                if req.method == "wls" and sess.cm.has_correlated_errors:
+                    raise PintTpuError(
+                        "FitRequest(method='wls') on a model with "
+                        "correlated noise — use 'gls'/'auto' (the "
+                        "serving engine refuses to silently drop the "
+                        "noise basis)"
+                    )
+                tol = req.tol_chi2
+                if tol is None:
+                    tol = 1e-10 if sess.mode == "f64" else 3e-6
+                key = (
+                    "fit", sess.composition, sess.bucket, sess.mode,
+                    int(req.maxiter), float(tol),
+                )
+            elif req.op == "residuals":
+                key = (
+                    "residuals", sess.composition, sess.bucket,
+                    bool(req.subtract_mean),
+                )
+            else:
+                raise PintTpuError(f"unknown serve op {req.op!r}")
+            from pint_tpu.toas.bundle import make_bundle
+            from pint_tpu.toas.ingest import ingest_for_model
+
+            if req.toas.t_tdb is None:
+                ingest_for_model(req.toas, sess.model)
+            nb = make_bundle(
+                req.toas, sess.model._build_masks(req.toas),
+                as_numpy=True,
+            )
+            p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
+            return self._batcher.add(
+                key, p, time.monotonic(), req.priority
+            )
+        except BaseException as e:  # per-request failure, not fatal
+            if not p.future.done():
+                p.future.set_exception(
+                    e if isinstance(e, Exception)
+                    else PintTpuError(f"admit failed: {e!r}")
+                )
+            return None
+
+    def _predict(self, p: _Pending):
+        """Polyco phase prediction: generated+cached per session span,
+        evaluated host-side (pint_tpu/polycos.py) — no device batch."""
+        from pint_tpu.serve.api import PredictResponse
+
+        req = p.req
+        if self._expired(p):
+            return
+        with TRACER.span("serve:predict", "serve", n=np.size(req.mjds)):
+            text = smod.par_text(req.par)
+            phash = smod.par_content_hash(text)
+            sess = self._predict_session(text, phash)
+            pc, cached = sess.polycos_for(req)
+            mjds = np.atleast_1d(np.asarray(req.mjds, dtype=np.float64))
+            ints, fracs = pc.eval_abs_phase(mjds)
+            freq = pc.eval_spin_freq(mjds)
+        p.future.set_result(PredictResponse(
+            request_id=req.request_id, phase_int=ints,
+            phase_frac=fracs, spin_freq_hz=freq, cached=cached,
+            wall_ms=(time.monotonic() - p.t_submit) * 1e3,
+        ))
+        self._m_completed.inc()
+        self._note_latency(p)
+
+    def _predict_session(self, text: str, phash: str):
+        """Model-only session for polyco prediction (no TOAs): cached
+        in the same LRU under a predict-specific key."""
+        key = (phash, "predict")
+        with self.sessions._lock:
+            s = self.sessions._sessions.get(key)
+            if s is not None:
+                self.sessions._sessions.move_to_end(key)
+                self.sessions._hits.inc()
+                return s
+        self.sessions._misses.inc()
+        s = _PredictSession(text)
+        with self.sessions._lock:
+            self.sessions._sessions[key] = s
+        return s
+
+    def _expired(self, p: _Pending) -> bool:
+        dl = p.req.deadline_s
+        if dl is None:
+            return False
+        waited = time.monotonic() - p.t_submit
+        if waited < dl:
+            return False
+        self._m_shed.inc()
+        TRACER.event(
+            "shed", "serve", reason="deadline", op=p.req.op,
+            waited_s=round(waited, 4),
+        )
+        p.future.set_exception(RequestRejected(
+            "deadline",
+            f"waited {waited:.3f}s >= deadline {dl}s",
+        ))
+        return True
+
+    def _flush(self, batch):
+        """The flush chokepoint: shed expired members, stack operands,
+        dispatch the guarded batched kernel, hand off to the fencer."""
+        live = [p for p in batch.items if not self._expired(p)]
+        if not live:
+            return
+        with TRACER.span(
+            "serve:flush", "serve", op=batch.key[0], n=len(live),
+            bucket=live[0].session.bucket,
+        ):
+            try:
+                kernel, ops = self._assemble(batch.key, live)
+            except BaseException as e:
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            e if isinstance(e, Exception)
+                            else PintTpuError(f"assembly failed: {e!r}")
+                        )
+                return
+            self._m_batches.inc()
+            self._m_occupancy.observe(len(live))
+            # backpressure: at most `inflight` dispatched batches may
+            # await the fence; blocking here fills the admission queue
+            # and sheds at the edge instead of accumulating device work
+            self._sem.acquire()
+            try:
+                out = kernel(*ops)  # async guarded device dispatch
+            except BaseException as e:
+                self._sem.release()
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            e if isinstance(e, Exception)
+                            else PintTpuError(f"dispatch failed: {e!r}")
+                        )
+                return
+            self._fence_q.put((batch.key, live, out))
+
+    def _assemble(self, key, live):
+        sess = live[0].session
+        cap = bmod.capacity_for(len(live), self.max_batch)
+        pad = cap - len(live)
+        bundles = [p.bundle for p in live] + [live[0].bundle] * pad
+        refs = [p.session.refnum for p in live] \
+            + [live[0].session.refnum] * pad
+        bstack = bmod.stack_trees(bundles)
+        rstack = bmod.stack_trees(refs)
+        xs = np.zeros((cap, sess.cm.nfree))
+        kernel = self._kernel_for(key, sess, cap)
+        return kernel, (bstack, rstack, xs)
+
+    def _kernel_for(self, key, sess, cap):
+        kkey = (key, cap)
+        k = self._kernels.get(kkey)
+        if k is None:
+            site = f"serve:{key[0]}:b{sess.bucket}x{cap}"
+            if key[0] == "fit":
+                _, _, _, mode, maxiter, tol = key
+                k = smod.build_fit_kernel(
+                    sess, mode, maxiter, tol, site
+                )
+            else:
+                k = smod.build_residuals_kernel(sess, key[3], site)
+            self._kernels[kkey] = k
+        return k
+
+    # -- stage 3: fencer ---------------------------------------------------
+    def _fence_loop(self):
+        while True:
+            item = self._fence_q.get()
+            if item is None:
+                break
+            key, live, out = item
+            try:
+                with TRACER.span(
+                    "serve:fence", "serve", op=key[0], n=len(live)
+                ):
+                    mats = tree_util.tree_map(np.asarray, out)
+            except BaseException as e:
+                self._sem.release()
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            e if isinstance(e, Exception)
+                            else PintTpuError(f"fence failed: {e!r}")
+                        )
+                continue
+            self._sem.release()
+            t_done = time.monotonic()
+            for i, p in enumerate(live):
+                try:
+                    resp = self._response(
+                        key, p, i, mats, len(live), t_done
+                    )
+                    p.future.set_result(resp)
+                    self._m_completed.inc()
+                    self._note_latency(p, t_done)
+                except Exception as e:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+
+    def _response(self, key, p, i, mats, nlive, t_done):
+        from pint_tpu.serve.api import FitResponse, ResidualsResponse
+
+        req, sess = p.req, p.session
+        ntoa = len(req.toas)
+        wall_ms = (t_done - p.t_submit) * 1e3
+        site = f"serve:{key[0]}"
+        if key[0] == "residuals":
+            resid, chi2 = mats
+            validate_finite(
+                {"residuals": resid[i][:ntoa], "chi2": chi2[i]},
+                site=site, what="served residuals",
+            )
+            return ResidualsResponse(
+                request_id=req.request_id, ntoa=ntoa,
+                residuals_s=resid[i][:ntoa], chi2=float(chi2[i]),
+                bucket=sess.bucket, batch_size=nlive, wall_ms=wall_ms,
+            )
+        # fit: the make_scan_fit_loop result tuple, batched
+        x, chi2, (covn, nrm), conv, _nbads, bads = mats
+        if np.asarray(bads)[i].any():
+            # reuse the shared refusal for the poisoned row
+            validate_finite(
+                {"chi2": np.asarray([np.nan])}, site=site,
+                what="served fit (scan froze on non-finite chi2)",
+            )
+        validate_finite(
+            {"x": x[i], "chi2": chi2[i]}, site=site, what="served fit",
+        )
+        no = noffset(sess.cm)
+        # unnormalize in HOST IEEE f64 (Fitter._unnorm_cov rationale)
+        cov = (
+            np.asarray(covn[i])
+            / np.outer(np.asarray(nrm[i]), np.asarray(nrm[i]))
+        )[no:, no:]
+        sigmas = np.sqrt(np.diag(cov))
+        fitted = sess.commit_clone(x[i], sigmas)
+        return FitResponse(
+            request_id=req.request_id,
+            names=tuple(sess.cm.free_names),
+            deltas=np.asarray(x[i]), uncertainties=sigmas,
+            chi2=float(chi2[i]), converged=bool(conv[i]),
+            method="gls", mode=key[3], fitted_par=fitted.as_parfile(),
+            ntoa=ntoa, bucket=sess.bucket, batch_size=nlive,
+            wall_ms=wall_ms,
+        )
+
+    def _note_latency(self, p, t_done=None):
+        lat_ms = ((t_done or time.monotonic()) - p.t_submit) * 1e3
+        self._m_latency.observe(lat_ms)
+        with self._lat_lock:
+            self._latencies.append(lat_ms)
+
+    # -- stats / lifecycle -------------------------------------------------
+    def stats(self) -> dict:
+        """One-look serving telemetry (bench.py's serve block and the
+        offered-load ladder publish this)."""
+        with self._lat_lock:
+            lats = sorted(self._latencies)
+
+        def pct(q):
+            if not lats:
+                return None
+            return round(lats[min(len(lats) - 1, int(q * len(lats)))], 3)
+
+        occ = self._m_occupancy.value
+        return {
+            "requests": self._m_requests.value,
+            "completed": self._m_completed.value,
+            "shed": self._m_shed.value,
+            "rejected": self._m_rejected.value,
+            "batches": self._m_batches.value,
+            "batch_occupancy_mean": (
+                None if not occ["count"]
+                else round(occ["sum"] / occ["count"], 3)
+            ),
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "sessions": len(self.sessions),
+            "kernels": len(self._kernels),
+        }
+
+    def reset_stats(self):
+        """Scope stats() to a fresh measurement window (bench rungs /
+        offered-load sweeps): clears the latency reservoir and zeroes
+        the serve.* metric namespace.  Compiled kernels and sessions
+        are untouched — this resets observation, not state."""
+        with self._lat_lock:
+            self._latencies.clear()
+        obs_metrics.reset("serve.")
+
+    def close(self, timeout: float = 120.0):
+        """Drain and stop: queued work is flushed (deadlines still
+        honored), then both pipeline threads join."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._collector.join(timeout)
+        self._fencer.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _PredictSession:
+    """Minimal model-only session for polyco prediction requests."""
+
+    _POLYCO_CACHE = smod.Session._POLYCO_CACHE
+    polycos_for = smod.Session.polycos_for
+
+    def __init__(self, text: str):
+        from pint_tpu.models.builder import get_model
+
+        self.par = text
+        self.model = get_model(text)
+        self._polycos = collections.OrderedDict()

@@ -1,0 +1,377 @@
+"""Session cache: compiled-model serving sessions, keyed and bucketed.
+
+Reference parity: none — TPU-service infrastructure.  A *session* is
+everything request execution needs that does not change per request
+for one par file: the parsed TimingModel, a prototype CompiledModel
+(trace scaffolding only — request data always rides as runtime
+arguments), the split reference pytree, the composition key that
+decides which requests may stack on the vmapped pulsar axis, and a
+small polyco cache for phase prediction.
+
+Sessions are LRU-cached keyed by **(par-content hash, accel mode,
+shape bucket)** (the accel mode is a derived axis — fixed per backend
+per par — recorded in the key for observability; pulse-number and
+wideband structure flags ride along because they change the traced
+kernel).  A *shape bucket* is the TOA axis padded up to a power of
+two (:func:`shape_bucket`): every request whose TOA count lands in
+the same bucket shares one set of compiled kernels, so steady-state
+serving of mixed sizes causes ZERO XLA retraces (the acceptance gate
+tests/test_serve.py and bench.py's serve block read off the PR 2
+``compile.recompiles`` counter).
+
+Warm starts: a cold session costs a host-side ``get_model`` +
+``model.compile`` (cheap) plus one XLA compile per kernel — which the
+persistent compile cache (runtime/compile_cache.py, on by default)
+serves from disk for previously-seen (composition, bucket, capacity)
+shapes, and file-backed TOA loads hit the persistent ingest cache
+(toas/cache.py).  A cold process therefore re-opens sessions at
+cache-hit cost, not at the ~35 s bake the pre-r6 cold path paid.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import obs as _obs
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.fitting.base import make_scan_fit_loop, noffset
+from pint_tpu.fitting.gls import default_accel_mode, gauss_newton_step
+from pint_tpu.models.timing_model import split_ref_runtime
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime.guard import dispatch_guard
+from pint_tpu.timebase.hostdd import HostDD
+from pint_tpu.utils import compute_hash
+
+
+def shape_bucket(n: int, min_bucket: int | None = None) -> int:
+    """TOA-axis bucket: the next power of two >= max(n, min_bucket).
+
+    Power-of-two buckets bound the retrace surface to log2(n_max)
+    distinct shapes while wasting at most 2x padding (padded TOAs are
+    statistically invisible — parallel/pta.py::PAD_ERROR_US).
+    ``$PINT_TPU_SERVE_MIN_BUCKET`` (default 64) floors the bucket so
+    tiny requests coalesce instead of fragmenting the kernel cache."""
+    if min_bucket is None:
+        min_bucket = int(
+            os.environ.get("PINT_TPU_SERVE_MIN_BUCKET", "64")
+        )
+    if n < 1:
+        raise PintTpuError(f"cannot bucket {n} TOAs")
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def par_text(par) -> str:
+    """Canonical par-file text of a request's ``par`` field."""
+    return par if isinstance(par, str) else par.as_parfile()
+
+
+def par_content_hash(par) -> str:
+    return compute_hash(par_text(par))[:16]
+
+
+def composition_key(cm, static_ref, phash: str) -> tuple:
+    """Hashable structural fingerprint deciding which sessions'
+    requests may stack on the vmapped pulsar axis (the PTABatch
+    compatibility rules, precomputed): identical component stacks,
+    free-parameter layouts, mask/noise-basis column structure, static
+    (string/bool) references, and numeric-reference pytree structure.
+    Models carrying a TZR anchor fold the par hash in — the TZR bundle
+    is trace scaffolding of the prototype, so such sessions only batch
+    with themselves."""
+    T, phi = jax.eval_shape(
+        cm.noise_basis_or_empty, jnp.zeros(cm.nfree)
+    )
+    num, _ = split_ref_runtime(cm.ref)
+    key = (
+        tuple(type(c).__name__ for c in cm.model._ordered_components()),
+        tuple(cm.free_names),
+        cm.track_mode,
+        bool(cm.subtract_mean),
+        tuple(sorted(
+            (k, tuple(v.shape[1:])) for k, v in cm.bundle.masks.items()
+        )),
+        tuple(sorted(static_ref.items())),
+        jax.tree_util.tree_structure(num),
+        (tuple(T.shape[1:]), tuple(phi.shape)),
+        cm.bundle.dm_meas is not None,
+        tuple(sorted(cm.bundle.obs_planet_pos_ls)),
+    )
+    if cm.tzr_bundle is not None:
+        key += (("tzr", phash),)
+    return key
+
+
+class Session:
+    """One (par content, accel mode, shape bucket) serving session."""
+
+    def __init__(self, text: str, toas, bucket: int, phash: str):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.parallel.pta import pad_bundle_to
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        self.par = text
+        self.par_hash = phash
+        self.bucket = bucket
+        model = get_model(text)
+        if toas.t_tdb is None:
+            ingest_for_model(toas, model)
+        self.model = model
+        cm = model.compile(toas)
+        if cm.bundle.ntoa > bucket:
+            raise PintTpuError(
+                f"{cm.bundle.ntoa} TOAs exceed session bucket {bucket}"
+            )
+        # the prototype's own bundle is trace scaffolding only (request
+        # data rides as runtime arguments), padded to the bucket so any
+        # shape read off it is consistent with the kernels' argument
+        # shapes
+        cm.bundle = pad_bundle_to(cm.bundle, bucket)
+        self.cm = cm
+        self.mode = default_accel_mode(cm)
+        num, static = split_ref_runtime(cm.ref)
+        # host-numpy reference stack: the batcher np.stack's these per
+        # flush (scalars — cheap), shipping them with the batch instead
+        # of one device put per leaf per request
+        self.refnum = jax.tree_util.tree_map(np.asarray, num)
+        self.static_ref = static
+        self.composition = composition_key(cm, static, phash)
+        self._polycos: OrderedDict = OrderedDict()  # span key -> Polycos
+
+    # -- phase prediction (host-evaluated polycos) ------------------------
+    _POLYCO_CACHE = 8  # spans kept per session
+
+    def polycos_for(self, req):
+        """Polycos covering the request's epochs, cached per (obs,
+        freq, segmentation, span) — generation compiles and evaluates
+        the model once per span; evaluation afterwards is host numpy
+        (microseconds per epoch).  Returns (polycos, cached)."""
+        from pint_tpu.polycos import Polycos
+
+        mjds = np.atleast_1d(np.asarray(req.mjds, dtype=np.float64))
+        span_days = req.segment_minutes / 1440.0
+        # segment-aligned span so nearby requests share one generation
+        start = np.floor(mjds.min() / span_days) * span_days
+        end = mjds.max() + 1e-9
+        key = (
+            req.obs, float(req.obsfreq_mhz),
+            float(req.segment_minutes), int(req.ncoeff),
+            round(float(start), 9),
+            int(np.ceil((end - start) / span_days)),
+        )
+        cached = key in self._polycos
+        if cached:
+            self._polycos.move_to_end(key)
+            _obs.metrics.counter("serve.polyco.hits").inc()
+        else:
+            _obs.metrics.counter("serve.polyco.misses").inc()
+            with TRACER.span(
+                "serve:polyco-generate", "serve", obs=req.obs,
+                nseg=key[-1],
+            ):
+                # generation runs EAGER model evaluations — pin them to
+                # host CPU (exact IEEE f64, numpy speed) instead of
+                # paying ~85 ms per op through the axon tunnel; the
+                # simulation scaffolding precedent
+                # (simulation._sim_cpu_device, PR 3)
+                with jax.default_device(jax.devices("cpu")[0]):
+                    self._polycos[key] = Polycos.generate(
+                        self.model, float(start), float(end),
+                        obs=req.obs,
+                        segment_minutes=req.segment_minutes,
+                        ncoeff=req.ncoeff,
+                        obsfreq_mhz=req.obsfreq_mhz,
+                    )
+            while len(self._polycos) > self._POLYCO_CACHE:
+                self._polycos.popitem(last=False)
+        return self._polycos[key], cached
+
+    # -- fitted-model materialization -------------------------------------
+    def commit_clone(self, deltas, uncertainties):
+        """Fitted deltas folded into a FRESH model parsed from the
+        session par (the session's shared model is never mutated —
+        requests are independent).  Mirrors CompiledModel.commit's
+        internal-units rebase exactly (models/timing_model.py)."""
+        from pint_tpu.models.builder import get_model
+
+        m = get_model(self.par)
+        for n, dx, u in zip(
+            self.cm.free_names, np.asarray(deltas),
+            np.asarray(uncertainties),
+        ):
+            p = m.params[n]
+            ref = p.internal()
+            if isinstance(ref, tuple):
+                p.add_internal_delta(float(dx))
+            elif isinstance(ref, HostDD):
+                p.set_internal(ref + float(dx))
+            else:
+                p.set_internal(float(ref) + float(dx))
+            p.set_internal_uncertainty(float(u))
+        return m
+
+
+# -- the serve dispatch chokepoint ---------------------------------------
+def traced_jit(fn, site: str):
+    """serve's dispatch chokepoint: ``jax.jit`` + exact XLA (re)trace
+    accounting + operand-byte metering + the device-execution guard —
+    the ``CompiledModel.jit`` contract for kernels whose operands
+    (stacked padded bundles, stacked refs, batched state) already ride
+    as runtime arguments.  ``noted`` runs once per XLA (re)trace (jax
+    executes the Python body only on jit cache miss), so the PR 2
+    ``compile.traces``/``compile.recompiles`` counters are exact here
+    too — a retrace past the first is a bucketing bug."""
+    ntraces = [0]
+
+    def noted(*args):
+        _obs.note_trace(site, retrace=ntraces[0] > 0)
+        ntraces[0] += 1
+        return fn(*args)
+
+    guarded = dispatch_guard(jax.jit(noted), site)
+
+    def dispatch(*args):
+        _obs.note_transfer(site, 0, args)
+        return guarded(*args)
+
+    return dispatch
+
+
+def _with_swapped(proto, static_ref, fn):
+    """Run ``fn(proto, *args)`` with a per-request bundle + numeric
+    reference swapped into the prototype at trace time — the serving
+    sibling of parallel/pta.py::PTABatch._with_state (the kernels read
+    both off the instance; under vmap the swap installs batched
+    tracers)."""
+
+    def call(bundle, refnum, *args):
+        saved_b, saved_r = proto.bundle, proto.ref
+        proto.bundle = bundle
+        proto.ref = {**static_ref, **refnum}
+        try:
+            return fn(proto, *args)
+        finally:
+            proto.bundle, proto.ref = saved_b, saved_r
+
+    return call
+
+
+def build_residuals_kernel(session: Session, subtract_mean: bool,
+                           site: str):
+    """Batched residuals kernel: (bundle_stack, ref_stack, xs (B, p))
+    -> (residuals (B, bucket), chi2 (B,))."""
+    call = _with_swapped(
+        session.cm, session.static_ref,
+        lambda cm, x: (
+            cm.time_residuals(x, subtract_mean=subtract_mean),
+            cm.chi2(x),
+        ),
+    )
+
+    def run(bundles, refs, xs):
+        return jax.vmap(call)(bundles, refs, xs)
+
+    return traced_jit(run, site)
+
+
+def build_fit_kernel(session: Session, mode: str, maxiter: int,
+                     tol_chi2: float, site: str):
+    """Batched fit kernel: every request's whole Gauss-Newton
+    iteration runs as ONE vmapped lax.scan program (the
+    make_scan_fit_loop semantics GLSFitter uses, over the shared
+    fitting/gls.py::gauss_newton_step), so a serving batch costs a
+    single dispatch regardless of batch size or maxiter."""
+    proto = session.cm
+    p = proto.nfree + noffset(proto)
+
+    def one(cm, x0):
+        def live_step(x):
+            xn, cov, chi2, nbad = gauss_newton_step(cm, x, mode)
+            return xn, cov, chi2, nbad.astype(jnp.int32)
+
+        loop = make_scan_fit_loop(
+            live_step, p, maxiter, tol_chi2,
+            lambda _x: jnp.asarray(jnp.inf), cm=None,
+        )
+        return loop(x0)
+
+    call = _with_swapped(proto, session.static_ref, one)
+
+    def run(bundles, refs, xs0):
+        return jax.vmap(call)(bundles, refs, xs0)
+
+    return traced_jit(run, site)
+
+
+class SessionCache:
+    """Thread-safe LRU of serving sessions.
+
+    Capacity via ``$PINT_TPU_SERVE_SESSIONS`` (default 32); eviction
+    drops the least-recently-served par/bucket (its kernels fall out
+    of the engine's kernel cache with it, but the persistent compile
+    cache keeps the XLA executables, so re-admission is a disk hit)."""
+
+    def __init__(self, max_sessions: int | None = None):
+        if max_sessions is None:
+            max_sessions = int(
+                os.environ.get("PINT_TPU_SERVE_SESSIONS", "32")
+            )
+        self.max_sessions = max(1, int(max_sessions))
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict = OrderedDict()
+        self._hits = _obs.metrics.counter("serve.session.hits")
+        self._misses = _obs.metrics.counter("serve.session.misses")
+        self._evictions = _obs.metrics.counter("serve.session.evictions")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._sessions)
+
+    def key_for(self, par, toas, min_bucket=None) -> tuple:
+        """(par hash, bucket, pulse-number/wideband structure flags) —
+        the accel mode joins after build (it is derived from par +
+        backend, both fixed for a given key)."""
+        return (
+            par_content_hash(par),
+            shape_bucket(len(toas), min_bucket),
+            toas.get_pulse_numbers() is not None,
+            toas.is_wideband(),
+        )
+
+    def get_or_create(self, par, toas, min_bucket=None) -> Session:
+        key = self.key_for(par, toas, min_bucket)
+        with self._lock:
+            s = self._sessions.get(key)
+            if s is not None:
+                self._sessions.move_to_end(key)
+                self._hits.inc()
+                return s
+        # build outside the lock (host model parse/compile; the single
+        # collector thread is the only writer, so a duplicate build
+        # race costs at most one redundant session)
+        self._misses.inc()
+        with TRACER.span(
+            "serve:session-build", "serve", bucket=key[1],
+            par_hash=key[0],
+        ):
+            s = Session(par_text(par), toas, key[1], key[0])
+        evicted = []
+        with self._lock:
+            self._sessions[key] = s
+            self._sessions.move_to_end(key)
+            while len(self._sessions) > self.max_sessions:
+                evicted.append(self._sessions.popitem(last=False))
+        for k, _old in evicted:
+            self._evictions.inc()
+            TRACER.event(
+                "session-evict", "serve", par_hash=k[0], bucket=k[1]
+            )
+        return s

@@ -63,12 +63,20 @@ class TOABundle(NamedTuple):
 def make_bundle(
     toas,
     masks: Optional[dict] = None,
+    as_numpy: bool = False,
 ) -> TOABundle:
     """Host -> device: build the bundle from an ingested TOAs table.
 
     Requires toas.t_tdb (from pint_tpu.toas.ingest); position columns
     default to zeros (barycentric data, site '@').
+
+    as_numpy=True keeps every column a HOST numpy array: the serving
+    batcher (serve/batcher.py) pads and stacks many request bundles on
+    a leading batch axis before anything crosses to the device, and
+    per-leaf jnp placement here would cost one axon transfer per leaf
+    per request instead of one bulk transfer per dispatched batch.
     """
+    xp = np if as_numpy else jnp
     n = len(toas)
     if toas.t_tdb is None:
         raise ValueError(
@@ -94,21 +102,21 @@ def make_bundle(
     wb = toas.is_wideband()
     dm_meas, dm_err = toas.get_dm_measurements() if wb else (None, None)
     return TOABundle(
-        tdb_day=jnp.asarray(toas.t_tdb.mjd_int, dtype=jnp.float64),
+        tdb_day=xp.asarray(toas.t_tdb.mjd_int, dtype=xp.float64),
         tdb_sec=DD(
-            jnp.asarray(toas.t_tdb.sec.hi), jnp.asarray(toas.t_tdb.sec.lo)
+            xp.asarray(toas.t_tdb.sec.hi), xp.asarray(toas.t_tdb.sec.lo)
         ),
-        freq_mhz=jnp.asarray(toas.freq),
-        error_us=jnp.asarray(toas.error_us),
-        ssb_obs_pos_ls=jnp.asarray(pos / C),
-        ssb_obs_vel_c=jnp.asarray(vel / C),
-        obs_sun_pos_ls=jnp.asarray(sun / C),
+        freq_mhz=xp.asarray(toas.freq),
+        error_us=xp.asarray(toas.error_us),
+        ssb_obs_pos_ls=xp.asarray(pos / C),
+        ssb_obs_vel_c=xp.asarray(vel / C),
+        obs_sun_pos_ls=xp.asarray(sun / C),
         obs_planet_pos_ls={
-            k: jnp.asarray(v / C) for k, v in toas.obs_planet_pos.items()
+            k: xp.asarray(v / C) for k, v in toas.obs_planet_pos.items()
         },
-        pulse_number=jnp.asarray(pn),
-        padd=jnp.asarray(padd),
-        dm_meas=None if dm_meas is None else jnp.asarray(dm_meas),
-        dm_err=None if dm_err is None else jnp.asarray(dm_err),
-        masks={k: jnp.asarray(v, dtype=jnp.float64) for k, v in (masks or {}).items()},
+        pulse_number=xp.asarray(pn),
+        padd=xp.asarray(padd),
+        dm_meas=None if dm_meas is None else xp.asarray(dm_meas),
+        dm_err=None if dm_err is None else xp.asarray(dm_err),
+        masks={k: xp.asarray(v, dtype=xp.float64) for k, v in (masks or {}).items()},
     )

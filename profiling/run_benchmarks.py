@@ -435,7 +435,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="+",
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
-                             "6", "7", "7b"])
+                             "6", "7", "7b", "serve"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -443,6 +443,19 @@ def main():
                 "7": config_7, "7b": config_7b}
     hbm_last_peak = 0
     for c in args.configs:
+        if str(c) == "serve":
+            # offered-load ladder for the serving engine (ISSUE 4):
+            # one row per rung, including the shedding rung past the
+            # admission-queue bound (profiling/serve_offered_load.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from serve_offered_load import sweep
+
+            for row in sweep():
+                print(json.dumps(row))
+            continue
         built = builders[str(c)]()
         label, ntoa, step, x0 = built[:4]
         chain = built[4] if len(built) > 4 else 128

@@ -1,0 +1,123 @@
+"""Offered-load ladder for the serving engine (pint_tpu/serve).
+
+Drives the TimingEngine open-loop at increasing offered request counts
+over a fixed same-composition pulsar fleet and reports, per rung,
+achieved throughput, latency percentiles, batch occupancy, and shed
+counts — the serving-capacity trajectory future BENCH_r*/LADDER_r*
+rounds track next to the fit-step ladder.  The top rung offers more
+than the admission queue holds, so the shedding behavior (typed
+rejections, not hangs — docs/serving.md's backpressure contract) is
+exercised and reported, not just the happy path.
+
+Usage: ``python profiling/serve_offered_load.py`` (one JSON line per
+rung), or via ``python profiling/run_benchmarks.py --configs serve``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def build_fleet(npsr: int = 8):
+    from pint_tpu.simulation import make_test_pulsar
+
+    pulsars = []
+    for i in range(npsr):
+        par = (
+            f"PSR L{i}\nF0 {140 + 9 * i}.75 1\nF1 -1.6e-15 1\n"
+            f"PEPOCH 55000\nDM {3 + 2.1 * i:.2f} 1\n"
+        )
+        m, toas = make_test_pulsar(
+            par, ntoa=150 + 13 * i,  # mixed sizes, one 256 bucket
+            start_mjd=54000.0, end_mjd=56000.0, seed=i, iterations=1,
+        )
+        pulsars.append((m.as_parfile(), toas))
+    return pulsars
+
+
+def sweep(loads=(8, 32, 128), npsr: int = 8, max_queue: int = 64,
+          maxiter: int = 2):
+    """Yield one result row per offered-load rung."""
+    import jax
+
+    from pint_tpu.exceptions import RequestRejected
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+
+    pulsars = build_fleet(npsr)
+    engine = TimingEngine(
+        max_batch=16, inflight=4, max_wait_ms=5.0,
+        max_queue=max_queue,
+    )
+    try:
+        # warm the kernel set across the batch-capacity ladder (1, 2,
+        # 4, ... max_batch) so rung rows measure steady-state serving,
+        # not XLA compiles — tail batches of any size then reuse a
+        # warmed capacity
+        wave = 1
+        while wave <= 16:
+            warm = [
+                engine.submit(FitRequest(
+                    par=pulsars[i % npsr][0],
+                    toas=pulsars[i % npsr][1], maxiter=maxiter,
+                ))
+                for i in range(wave)
+            ]
+            for f in warm:
+                f.result(timeout=3600)
+            wave <<= 1
+        for offered in loads:
+            engine.reset_stats()
+            traces0 = obs_metrics.counter("compile.traces").value
+            t0 = time.perf_counter()
+            futs = [
+                engine.submit(FitRequest(
+                    par=pulsars[i % npsr][0],
+                    toas=pulsars[i % npsr][1],
+                    maxiter=maxiter,
+                ))
+                for i in range(offered)
+            ]
+            completed = rejected = failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=3600)
+                    completed += 1
+                except RequestRejected:
+                    rejected += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            st = engine.stats()
+            yield {
+                "config": f"serve offered={offered} fits "
+                          f"({npsr} pulsars, 256 bucket)",
+                "backend": jax.default_backend(),
+                "offered": offered,
+                "completed": completed,
+                "shed": rejected,
+                "failed": failed,
+                "achieved_rps": round(completed / wall, 2),
+                "p50_ms": st["p50_ms"],
+                "p99_ms": st["p99_ms"],
+                "batch_occupancy": st["batch_occupancy_mean"],
+                "retraces": (
+                    obs_metrics.counter("compile.traces").value
+                    - traces0
+                ),
+            }
+    finally:
+        engine.close()
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    for row in sweep():
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

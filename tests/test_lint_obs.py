@@ -56,6 +56,49 @@ def test_linter_allows_guarded_pragma_and_ops():
     assert lint_source(kernel, "pint_tpu/ops/newkernel.py") == []
 
 
+def test_linter_flags_uninstrumented_serve_chokepoints(tmp_path):
+    """Rule 3: serve's submit/flush must span, and traced_jit (the
+    serve dispatch chokepoint) must stay guarded + trace-counted."""
+    pkg = tmp_path / "pint_tpu"
+    (pkg / "fitting").mkdir(parents=True)
+    (pkg / "runtime").mkdir()
+    (pkg / "models").mkdir()
+    (pkg / "serve").mkdir()
+    (pkg / "runtime" / "guard.py").write_text(
+        "def dispatch_guard(fn, site):\n"
+        "    h = TRACER.span(site, 'dispatch')\n"
+        "    return fn\n"
+    )
+    (pkg / "models" / "timing_model.py").write_text(
+        "class CompiledModel:\n"
+        "    def jit(self, fn):\n"
+        "        note_trace(1)\n"
+        "        return dispatch_guard(fn, 'x')\n"
+    )
+    # submit lost its span; traced_jit lost the guard + trace counter
+    (pkg / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def submit(self, request):\n"
+        "        return request\n"
+        "    def _flush(self, batch):\n"
+        "        with TRACER.span('serve:flush', 'serve'):\n"
+        "            pass\n"
+    )
+    (pkg / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site):\n"
+        "    return fn\n"
+    )
+    findings = [str(f) for f in check_chokepoints(pkg)]
+    assert any("TimingEngine.submit" in f for f in findings)
+    assert not any("TimingEngine._flush" in f for f in findings)
+    assert any(
+        "traced_jit" in f and "dispatch_guard" in f for f in findings
+    )
+    assert any(
+        "traced_jit" in f and "note_trace" in f for f in findings
+    )
+
+
 def test_linter_flags_undecorated_fit_toas(tmp_path):
     pkg = tmp_path / "pint_tpu"
     (pkg / "fitting").mkdir(parents=True)

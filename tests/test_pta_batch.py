@@ -94,6 +94,72 @@ def test_pta_batch_mixed_mode_matches_f64(pulsars):
     assert np.all(np.abs(np.asarray(xs_m - xs_f)) < 5e-2 * sig)
 
 
+def test_pad_error_emulated_f64_headroom():
+    """Regression-pin PAD_ERROR_US=1e18 against the emulated-f64
+    hazard taxonomy (the docstring analysis on the constant itself):
+    the pad weight must survive the flush-to-zero floor with wide
+    margin, and every padded intermediate must sit far below the
+    f32-exponent-range ceilings axon's f32-pair f64 inherits."""
+    from pint_tpu.parallel.pta import PAD_ERROR_US
+    from pint_tpu.runtime.guard import (
+        F32_FLUSH_FLOOR,
+        F32_RANGE_MAX,
+        F32_SQUARE_CEILING,
+    )
+
+    sigma_s = PAD_ERROR_US * 1e-6
+    w_pad = 1.0 / sigma_s**2
+    # Ndiag entry sigma^2 stays far under the exponent-range ceiling
+    # (>= 1e6 margin), and sigma itself under the square ceiling
+    assert sigma_s**2 < F32_RANGE_MAX / 1e6
+    assert sigma_s < F32_SQUARE_CEILING / 1e6
+    # the Woodbury whitening's 1/sigma^2 survives the flush floor by
+    # >= 1e6, so it cannot silently zero (docs/precision.md)
+    assert w_pad > 1e6 * F32_FLUSH_FLOOR
+    # whitened design columns of pad rows: |M|*sqrt(w) with the F4+
+    # spindown-column scale stays under the assembly ceiling
+    assert 1e17 * np.sqrt(w_pad) < F32_RANGE_MAX / 1e6
+    # statistical invisibility: pad weight is ~1e-36 of a 1-us TOA
+    w_real = 1.0 / (1e-6) ** 2
+    assert w_pad / w_real < 1e-30
+
+
+def test_padded_fit_matches_unpadded(pulsars):
+    """Padding the TOA axis (the PTA batch / serving-bucket transform)
+    must not perturb a fit: same data padded to a larger bucket gives
+    the same fitted parameters and chi2.  Runs on whatever backend
+    conftest selects — under PINT_TPU_TEST_BACKEND=tpu this is the
+    on-device guard that PAD_ERROR_US actually threads the emulated
+    -f64 window (a flushed pad weight or overflowed pad row NaNs the
+    whole fit there while CPU stays clean)."""
+    import jax
+
+    from pint_tpu.parallel.pta import pad_bundle_to
+
+    m, toas = pulsars[1]  # 48 TOAs -> pad to 96
+    par = m.as_parfile()
+    f_ref = GLSFitter(toas, get_model(par))
+    f_ref.fit_toas(maxiter=3)
+    f_pad = GLSFitter(toas, get_model(par))
+    f_pad.cm.bundle = pad_bundle_to(f_pad.cm.bundle, 96)
+    f_pad.fit_toas(maxiter=3)
+    # pad rows carry ~1e-36 relative weight: on CPU (IEEE f64) the two
+    # fits agree to roundoff; on the emulated-f64 accelerator compare
+    # within a small fraction of the quoted uncertainties
+    tight = jax.default_backend() == "cpu"
+    assert f_pad.chi2 == pytest.approx(
+        f_ref.chi2, rel=1e-9 if tight else 1e-3
+    )
+    sig = np.sqrt(np.diag(f_ref.parameter_covariance_matrix))
+    for i, n in enumerate(f_ref.cm.free_names):
+        a = f_ref.model.params[n].value
+        b = f_pad.model.params[n].value
+        fa = float(a.to_float()) if hasattr(a, "to_float") else float(a)
+        fb = float(b.to_float()) if hasattr(b, "to_float") else float(b)
+        tol = (1e-6 if tight else 0.2) * sig[i]
+        assert abs(fa - fb) < tol + 1e-30, n
+
+
 def test_pta_batch_rejects_mismatched_layouts(pulsars):
     from pint_tpu.exceptions import PintTpuError
 

@@ -35,6 +35,14 @@ Rules (syntactic, like the scalarmath linter):
    ``fit_toas`` defined under ``pint_tpu/fitting/`` must carry the
    ``@record_fit`` span decorator.
 
+3. serving chokepoints (PR 4) — the serve pipeline's hot points must
+   stay span-instrumented and guarded: ``TimingEngine.submit`` and
+   ``TimingEngine._flush`` (serve/engine.py) must open recorder spans,
+   and ``traced_jit`` (serve/session.py — serve's dispatch chokepoint)
+   must route through ``dispatch_guard`` and count XLA (re)traces via
+   ``note_trace``.  Rule 1 already forbids bare ``jax.jit`` anywhere
+   under ``serve/``.
+
 Run: ``python tools/lint_obs.py [paths...]`` (default: pint_tpu/).
 Exit status 1 when findings exist.  Wired into tier-1 as
 tests/test_lint_obs.py.
@@ -171,6 +179,29 @@ def check_chokepoints(pkg_root) -> list:
             str(tm_py), 1,
             f"{miss} — cm.jit must stay guarded and count (re)traces",
         ))
+
+    # rule 3: serve chokepoints (skipped for synthetic packages that
+    # predate / omit the serving subsystem — unit-test fixtures)
+    serve_checks = (
+        ("serve/engine.py", "TimingEngine.submit", ("TRACER.span",),
+         "the serving admission edge must open recorder spans"),
+        ("serve/engine.py", "TimingEngine._flush", ("TRACER.span",),
+         "the serving flush chokepoint must open recorder spans"),
+        ("serve/session.py", "traced_jit",
+         ("dispatch_guard(", "note_trace("),
+         "serve's dispatch chokepoint must stay guarded and count "
+         "(re)traces"),
+    )
+    if (pkg_root / "serve").is_dir():
+        for rel, qual, needles, why in serve_checks:
+            path = pkg_root / rel
+            src = path.read_text()
+            for miss in _fn_source_has(
+                ast.parse(src), src, qual, needles
+            ):
+                findings.append(_Finding(
+                    str(path), 1, f"{miss} — {why}",
+                ))
 
     for py in sorted((pkg_root / "fitting").rglob("*.py")):
         src = py.read_text()
