@@ -298,7 +298,18 @@ def _serve_block():
     counts all land in one power-of-two bucket), and on accelerators
     the async engine must sustain >= 3x the serial throughput — both
     are ISSUE 4 acceptance criteria, enforced here so the driver
-    tracks them per round like the guard/obs invariants."""
+    tracks them per round like the guard/obs invariants.
+
+    ISSUE 5 adds the FABRIC figures: the per-replica occupancy
+    breakdown of the async engine, and a replica-scaling probe (the
+    same offered load through a 1-replica and a 4-replica fabric,
+    inflight=1 so the router's spill policy replicates the hot
+    session group across the pool).  Gates: zero steady-state
+    RECOMPILES per replica in both rungs (each replica's session
+    compiles at most once per (composition, bucket, capacity) — a
+    spill's first compile is a fresh wrapper, not a retrace), and on
+    accelerators the 4-replica aggregate throughput must reach >= 2x
+    the single-replica rung."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -354,6 +365,63 @@ def _serve_block():
         eng.close()
     rps = npsr * rounds / wall
     speedup = rps / serial_rps
+
+    # replica-scaling probe (ISSUE 5): same offered load, 1 vs 4
+    # replicas; inflight=1 saturates the routed replica so the hot
+    # group spills across the pool during the warm bursts
+    def _replica_rung(nrep):
+        reng = TimingEngine(
+            max_batch=4, max_wait_ms=2.0, inflight=1, replicas=nrep,
+            affinity=nrep, max_queue=256,
+        )
+        try:
+            for _ in range(2):  # warm + spill + per-replica compiles
+                for f in reng.submit_many(requests() * rounds):
+                    f.result(timeout=3600)
+            reng.reset_stats()
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            t0 = time.perf_counter()
+            futs = []
+            for _ in range(rounds):
+                futs += reng.submit_many(requests())
+            for f in futs:
+                f.result(timeout=3600)
+            rung_wall = time.perf_counter() - t0
+            recompiles = (
+                obs_metrics.counter("compile.recompiles").value - rec0
+            )
+            fab = reng.stats()["fabric"]
+            occ = {
+                tag: rs["batches"]
+                for tag, rs in fab["per_replica"].items()
+                if rs["batches"]
+            }
+            return npsr * rounds / rung_wall, recompiles, occ, fab
+        finally:
+            reng.close()
+
+    r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
+    r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
+    scaling = r4_rps / r1_rps
+    if r1_rec or r4_rec:
+        raise PintTpuError(
+            f"{r1_rec}+{r4_rec} steady-state XLA recompile(s) across "
+            "the replica-scaling rungs — a fabric replica retraced an "
+            "existing kernel (each replica must compile at most once "
+            "per (composition, bucket, capacity); docs/serving.md)"
+        )
+    # the scaling gate needs real devices to scale across: a 1-device
+    # host clamps the "4-replica" pool to one replica (serving_devices)
+    # and the criterion is unmeasurable there
+    if (jax.default_backend() != "cpu"
+            and r4_fab["replicas"] >= 2 and scaling < 2.0):
+        raise PintTpuError(
+            f"{r4_fab['replicas']}-replica fabric sustained only "
+            f"{scaling:.2f}x the single-replica throughput at the "
+            "same offered load (>= 2x required on accelerators: the "
+            "router must spread a saturated session group across the "
+            "pool; docs/serving.md)"
+        )
     if retraces:
         raise PintTpuError(
             f"{retraces} XLA retrace(s) across steady-state serving of "
@@ -379,6 +447,20 @@ def _serve_block():
         "serial_requests_per_s": round(serial_rps, 2),
         "speedup_vs_serial": round(speedup, 2),
         "steady_retraces": retraces,
+        "replicas": st["fabric"]["replicas"],
+        "replica_occupancy": {
+            tag: rs["batches"]
+            for tag, rs in st["fabric"]["per_replica"].items()
+            if rs["batches"]
+        },
+        "replica_scaling": {
+            "replicas_1_rps": round(r1_rps, 2),
+            "replicas_4_rps": round(r4_rps, 2),
+            "scaling_x": round(scaling, 2),
+            "r4_occupancy": r4_occ,
+            "r4_spills": r4_fab["spills"],
+            "steady_recompiles": r1_rec + r4_rec,
+        },
     }
 
 

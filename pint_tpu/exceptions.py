@@ -179,7 +179,9 @@ class RequestRejected(PintTpuError):
     an overloaded engine REFUSES work loudly — a bounded-queue
     rejection, a missed per-request deadline, or a shutdown — and
     never hangs, OOMs, or silently drops a request.  ``reason`` is one
-    of ``'queue-full'``, ``'deadline'``, ``'shutdown'``."""
+    of ``'queue-full'``, ``'deadline'``, ``'shutdown'``, or
+    ``'no-replica'`` (the serving fabric had no live replica left to
+    take the batch — every candidate quarantined or drained)."""
 
     def __init__(self, reason: str, detail: str = ""):
         self.reason = reason

@@ -56,6 +56,17 @@ for _name, _unit in (
     ("serve.session.evictions", ""),
     ("serve.polyco.hits", ""),
     ("serve.polyco.misses", ""),
+    # serving fabric (pint_tpu/serve/fabric — PR 5): routing,
+    # placement spills, replica health transitions, canary probes
+    ("serve.fabric.routes", ""),
+    ("serve.fabric.reroutes", ""),
+    ("serve.fabric.spills", ""),
+    ("serve.fabric.failures", ""),
+    ("serve.fabric.degraded", ""),
+    ("serve.fabric.quarantines", ""),
+    ("serve.fabric.readmits", ""),
+    ("serve.fabric.probes", ""),
+    ("serve.fabric.no_replica", ""),
 ):
     metrics.counter(_name, unit=_unit)
 del _name, _unit

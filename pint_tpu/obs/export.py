@@ -214,6 +214,34 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
             "neared the ~256 MB 413 limit (lower "
             "$PINT_TPU_BAKE_THRESHOLD; docs/observability.md)"
         )
+    fabric_bits = {
+        k.split(".", 2)[2]: v
+        for k, v in snap.items()
+        if k.startswith("serve.fabric.") and v not in (0, None)
+    }
+    if fabric_bits:
+        lines.append(
+            "fabric: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(fabric_bits.items())
+            )
+        )
+    replica_bits = sorted(
+        (k.split(".")[2], k.split(".", 3)[3], v)
+        for k, v in snap.items()
+        if k.startswith("serve.replica.") and v not in (None, 0)
+    )
+    if replica_bits:
+        per = defaultdict(list)
+        for rid, field, v in replica_bits:
+            per[rid].append(f"{field}={v}")
+        lines.append(
+            "replicas: " + "  ".join(
+                f"r{rid}[{' '.join(fields)}]"
+                for rid, fields in sorted(
+                    per.items(), key=lambda kv: int(kv[0])
+                )
+            )
+        )
 
     if not spans:
         lines.append(
@@ -235,7 +263,7 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
 
     interesting = [
         ev for ev in events
-        if ev.cat in ("compile", "guard", "transport")
+        if ev.cat in ("compile", "guard", "transport", "fabric")
         or ev.name in ("recompile", "fallback", "near-413")
     ]
     if interesting:

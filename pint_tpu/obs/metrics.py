@@ -65,6 +65,19 @@ serve.queue_depth               gauge    admission-queue depth
 serve.session.hits/misses/      counter  session LRU traffic
   evictions
 serve.polyco.hits/misses        counter  per-session polyco spans
+serve.fabric.routes/reroutes    counter  routing decisions / failed
+                                         -batch re-routes
+serve.fabric.spills             counter  affinity-set growth under
+                                         saturation
+serve.fabric.failures           counter  guard-class batch failures
+serve.fabric.degraded/          counter  replica health transitions
+  quarantines/readmits
+serve.fabric.probes             counter  canary dispatches
+serve.fabric.no_replica         counter  typed sheds with no live
+                                         replica to route to
+serve.replica.N.batches         counter  batches served by replica N
+serve.replica.N.outstanding     gauge    queued+inflight batches
+serve.replica.N.state           gauge    health-state string
 ==============================  =======  ==============================
 """
 

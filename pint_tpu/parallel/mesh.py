@@ -93,6 +93,20 @@ def initialize_distributed(
     return jax.process_index()
 
 
+def serving_devices(n: Optional[int] = None) -> list:
+    """Devices backing the serving fabric's replica pool
+    (serve/fabric/pool.py): the default backend's local devices — the
+    tests' virtual 8-device CPU mesh (conftest's XLA_FLAGS) and the
+    axon TPU slice both surface here, so the fabric exercises real
+    multi-device placement without hardware.  ``n`` clamps the pool
+    width to the first n devices (never below 1, never above what
+    exists); None/0 = all."""
+    devs = list(jax.local_devices())
+    if n:
+        devs = devs[: max(1, min(int(n), len(devs)))]
+    return devs
+
+
 def make_mesh(
     n_toa_shards: Optional[int] = None,
     n_pulsar_shards: int = 1,

@@ -156,6 +156,7 @@ class ResidualsResponse:
     bucket: int  # TOA-axis shape bucket that served the request
     batch_size: int  # live requests stacked in the serving batch
     wall_ms: float  # submit -> result wall time
+    replica: str = ""  # fabric replica tag ('r3') that ran the batch
 
 
 @dataclass
@@ -173,6 +174,7 @@ class FitResponse:
     bucket: int
     batch_size: int
     wall_ms: float
+    replica: str = ""  # fabric replica tag that ran the batch
 
 
 @dataclass

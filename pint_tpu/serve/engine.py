@@ -8,7 +8,7 @@ stage is span/metric-instrumented (pint_tpu.obs), and compiled state
 is cached at three levels (session LRU -> in-process kernel cache ->
 persistent XLA compile cache).
 
-Pipeline (three stages, two threads + the callers'):
+Pipeline (fabric-aware since ISSUE 5):
 
 1. **submit** (caller thread): bounded admission queue.  A full queue
    rejects IMMEDIATELY with a typed RequestRejected('queue-full') —
@@ -16,36 +16,38 @@ Pipeline (three stages, two threads + the callers'):
 2. **collector** (one thread): drains the queue, resolves sessions
    (serve/session.py), pads/buckets each request, accumulates
    micro-batches (serve/batcher.py), and flushes full or overdue
-   groups: shed expired deadlines, stack operands host-side, dispatch
-   the guarded batched kernel.  jax dispatch is ASYNC — the call
-   returns promptly with pending device arrays, so the collector moves
-   on to assemble the NEXT batch while the device (and the ~85 ms axon
-   tunnel round-trip) works on the previous ones.
-3. **fencer** (one thread): materializes results (np.asarray — the
-   only reliable sync over the tunnel), slices off padding, validates
-   finiteness, resolves futures.
+   groups: shed expired deadlines, stack operands host-side, then
+   ROUTE the assembled batch onto a replica (serve/fabric/router.py
+   affinity placement + least-outstanding-work among live replicas).
+3. **replicas** (serve/fabric/replica.py — one per serving device,
+   each with a dispatcher + fencer thread and its own bounded
+   inflight pipeline): device_put the stacked operands, dispatch the
+   guarded per-replica kernel asynchronously, materialize results
+   (np.asarray — the only reliable sync over the tunnel), batch-level
+   finite validation, then resolve futures through the engine's
+   serialized finisher.  A replica whose guard trips (watchdog/NaN)
+   degrades and eventually quarantines; its work re-routes to
+   surviving replicas and the pool's canary probe re-admits it.
 
-A bounded in-flight semaphore (``inflight``) caps how many dispatched
-batches may be awaiting the fence; when the device falls behind, the
-collector blocks on it, the admission queue fills, and new submissions
-shed — backpressure propagates to the edge as typed rejections.
+Backpressure: each replica caps queued+inflight batches; when the
+routed replica's queue is full the collector blocks, the admission
+queue fills, and new submissions shed — typed rejections at the edge.
 
 All engine/serving knobs have ``PINT_TPU_SERVE_*`` env defaults
 (documented in docs/serving.md): MAX_QUEUE, MAX_BATCH, MAX_WAIT_MS,
-INFLIGHT, SESSIONS, MIN_BUCKET.
+INFLIGHT, SESSIONS, MIN_BUCKET, REPLICAS, AFFINITY, QUARANTINE_N,
+PROBE_MS.
 """
 
 from __future__ import annotations
 
 import collections
 import os
-import queue
 import threading
 import time
 from concurrent.futures import Future
 
 import numpy as np
-from jax import tree_util
 
 from pint_tpu.exceptions import PintTpuError, RequestRejected
 from pint_tpu.obs import metrics as obs_metrics
@@ -53,6 +55,7 @@ from pint_tpu.obs.trace import TRACER
 from pint_tpu.runtime.guard import validate_finite
 from pint_tpu.serve import batcher as bmod
 from pint_tpu.serve import session as smod
+from pint_tpu.serve.fabric import BatchWork, ReplicaPool, Router
 from pint_tpu.fitting.base import noffset
 
 
@@ -74,7 +77,8 @@ class TimingEngine:
 
     def __init__(self, *, max_queue=None, max_batch=None,
                  max_wait_ms=None, inflight=None, min_bucket=None,
-                 max_sessions=None):
+                 max_sessions=None, replicas=None, affinity=None,
+                 quarantine_n=None, probe_ms=None):
         env = os.environ.get
         self.max_queue = int(
             max_queue if max_queue is not None
@@ -95,15 +99,32 @@ class TimingEngine:
         )
         self.min_bucket = min_bucket
         self.sessions = smod.SessionCache(max_sessions)
-        self._kernels: dict = {}  # (group key, capacity) -> callable
         self._queue: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._batcher = bmod.Batcher(self.max_batch, self.max_wait_s)
-        self._fence_q: queue.Queue = queue.Queue()
-        self._sem = threading.BoundedSemaphore(max(1, self.inflight))
         self._stop = False
         self._latencies = collections.deque(maxlen=4096)
         self._lat_lock = threading.Lock()
+        # host response assembly (model parse, par text) is serialized
+        # across replica fence threads — it is light next to the device
+        # work and not audited for concurrent use
+        self._finish_lock = threading.Lock()
+        # the multi-device fabric: one executor per serving device +
+        # the affinity router (serve/fabric/)
+        self.pool = ReplicaPool(
+            replicas=replicas,
+            inflight=max(1, self.inflight),
+            quarantine_n=quarantine_n,
+            probe_interval_s=(
+                None if probe_ms is None else float(probe_ms) / 1e3
+            ),
+            requeue=self._requeue,
+            finisher=self._finish_batch,
+            validator=self._validate_batch,
+        )
+        if affinity is None:
+            affinity = int(env("PINT_TPU_SERVE_AFFINITY", "0"))
+        self.router = Router(self.pool, affinity=affinity or None)
         m = obs_metrics
         self._m_requests = m.counter("serve.requests")
         self._m_completed = m.counter("serve.completed")
@@ -117,12 +138,7 @@ class TimingEngine:
             target=self._collect_loop, daemon=True,
             name="pint-tpu-serve collector",
         )
-        self._fencer = threading.Thread(
-            target=self._fence_loop, daemon=True,
-            name="pint-tpu-serve fencer",
-        )
         self._collector.start()
-        self._fencer.start()
 
     # -- the request-facing edge ------------------------------------------
     def submit(self, request) -> Future:
@@ -182,16 +198,25 @@ class TimingEngine:
                 full = self._admit(p)
                 if full is not None:
                     ready.append(full)
-            ready += self._batcher.take_due(
-                time.monotonic(), take_all=stopping
-            )
+            # a slow admit (cold session build) lets co-wave requests
+            # pile up in the admission queue past their group's
+            # max-wait; drain them into their groups before expiring
+            # partial ones, or one slow build splits a wave into
+            # fragment batches (each fragment a fresh capacity =
+            # avoidable compiles).  Under sustained load groups flush
+            # FULL via _admit, so due-flush only needs the idle edge.
+            with self._cond:
+                draining_more = bool(self._queue) and not stopping
+            if not draining_more:
+                ready += self._batcher.take_due(
+                    time.monotonic(), take_all=stopping
+                )
             for batch in sorted(ready, key=lambda b: b.priority):
                 self._flush(batch)
             if stopping:
                 with self._cond:
                     if not self._queue and self._batcher.empty():
                         break
-        self._fence_q.put(None)  # FIFO: after all in-flight batches
 
     def _admit(self, p: _Pending):
         """Resolve session + bucket for one drained request; returns a
@@ -309,7 +334,7 @@ class TimingEngine:
 
     def _flush(self, batch):
         """The flush chokepoint: shed expired members, stack operands,
-        dispatch the guarded batched kernel, hand off to the fencer."""
+        route the assembled batch onto a fabric replica."""
         live = [p for p in batch.items if not self._expired(p)]
         if not live:
             return
@@ -318,7 +343,7 @@ class TimingEngine:
             bucket=live[0].session.bucket,
         ):
             try:
-                kernel, ops = self._assemble(batch.key, live)
+                work = self._assemble(batch.key, live)
             except BaseException as e:
                 for p in live:
                     if not p.future.done():
@@ -329,24 +354,9 @@ class TimingEngine:
                 return
             self._m_batches.inc()
             self._m_occupancy.observe(len(live))
-            # backpressure: at most `inflight` dispatched batches may
-            # await the fence; blocking here fills the admission queue
-            # and sheds at the edge instead of accumulating device work
-            self._sem.acquire()
-            try:
-                out = kernel(*ops)  # async guarded device dispatch
-            except BaseException as e:
-                self._sem.release()
-                for p in live:
-                    if not p.future.done():
-                        p.future.set_exception(
-                            e if isinstance(e, Exception)
-                            else PintTpuError(f"dispatch failed: {e!r}")
-                        )
-                return
-            self._fence_q.put((batch.key, live, out))
+            self._dispatch(work)
 
-    def _assemble(self, key, live):
+    def _assemble(self, key, live) -> BatchWork:
         sess = live[0].session
         cap = bmod.capacity_for(len(live), self.max_batch)
         pad = cap - len(live)
@@ -356,51 +366,86 @@ class TimingEngine:
         bstack = bmod.stack_trees(bundles)
         rstack = bmod.stack_trees(refs)
         xs = np.zeros((cap, sess.cm.nfree))
-        kernel = self._kernel_for(key, sess, cap)
-        return kernel, (bstack, rstack, xs)
+        return BatchWork(key, live, (bstack, rstack, xs), sess, cap)
 
-    def _kernel_for(self, key, sess, cap):
-        kkey = (key, cap)
-        k = self._kernels.get(kkey)
-        if k is None:
-            site = f"serve:{key[0]}:b{sess.bucket}x{cap}"
-            if key[0] == "fit":
-                _, _, _, mode, maxiter, tol = key
-                k = smod.build_fit_kernel(
-                    sess, mode, maxiter, tol, site
-                )
-            else:
-                k = smod.build_residuals_kernel(sess, key[3], site)
-            self._kernels[kkey] = k
-        return k
-
-    # -- stage 3: fencer ---------------------------------------------------
-    def _fence_loop(self):
+    def _dispatch(self, work: BatchWork):
+        """Route one assembled batch (backpressure: when the routed
+        replica's queue is full this blocks, the admission queue fills
+        and new submissions shed at the edge).  A replica that stops
+        accepting mid-wait (quarantine/drain) is excluded and the
+        batch re-routes; with no usable replica left, the batch sheds
+        typed — never hangs."""
         while True:
-            item = self._fence_q.get()
-            if item is None:
+            rep = self.router.route(work, exclude=work.excluded)
+            if rep is None:
+                reason = "shutdown" if self._stop else "no-replica"
+                work.shed(
+                    reason, "no live replica available for the batch"
+                )
+                return
+            if rep.submit(work, block=True):
+                return
+            work.excluded.add(rep.rid)
+
+    def _requeue(self, work: BatchWork, source):
+        """Fabric callback: re-route a batch its replica could not
+        serve (quarantine flush or guard-class batch failure).  Runs
+        on replica pipeline threads, so target submission never
+        blocks (force=True); exhausted candidates resolve the member
+        futures with the original typed error (or shed typed when the
+        batch was never attempted)."""
+        obs_metrics.counter("serve.fabric.reroutes").inc()
+        TRACER.event(
+            "reroute", "fabric", frm=source.tag, op=work.key[0],
+            n=len(work.live),
+        )
+        while True:
+            rep = self.router.route(work, exclude=work.excluded)
+            if rep is None:
                 break
-            key, live, out = item
-            try:
-                with TRACER.span(
-                    "serve:fence", "serve", op=key[0], n=len(live)
-                ):
-                    mats = tree_util.tree_map(np.asarray, out)
-            except BaseException as e:
-                self._sem.release()
-                for p in live:
-                    if not p.future.done():
-                        p.future.set_exception(
-                            e if isinstance(e, Exception)
-                            else PintTpuError(f"fence failed: {e!r}")
-                        )
-                continue
-            self._sem.release()
-            t_done = time.monotonic()
-            for i, p in enumerate(live):
+            if rep.submit(work, block=False, force=True):
+                return
+            work.excluded.add(rep.rid)
+        if work.last_error is not None:
+            work.fail(work.last_error)
+        else:
+            work.shed(
+                "shutdown" if self._stop else "no-replica",
+                "no surviving replica for the re-routed batch",
+            )
+
+    # -- stage 3: fabric callbacks (replica fence threads) ----------------
+    def _validate_batch(self, work: BatchWork, mats, tag: str):
+        """Batch-level finite gate with a REPLICA-TAGGED site: a
+        non-finite device output (or an injected ``nan`` fault pinned
+        to the replica) raises here, marking the replica's health and
+        re-routing the whole batch to a surviving replica — instead of
+        quietly poisoning member futures on a sick device.  Row-level
+        divergence of an individual fit (the scan's per-row freeze
+        flags) stays a per-request failure in :meth:`_response`."""
+        site = f"serve:{work.key[0]}@{tag}"
+        if work.key[0] == "residuals":
+            resid, chi2 = mats
+            validate_finite(
+                {"residuals": resid, "chi2": chi2}, site=site,
+                what="served batch (residuals)",
+            )
+        else:
+            x, chi2, _cov, _conv, _nbads, _bads = mats
+            validate_finite(
+                {"x": x, "chi2": chi2}, site=site,
+                what="served batch (fit)",
+            )
+
+    def _finish_batch(self, work: BatchWork, mats, replica):
+        """Resolve every member future of a fenced, validated batch."""
+        t_done = time.monotonic()
+        with self._finish_lock:
+            for i, p in enumerate(work.live):
                 try:
                     resp = self._response(
-                        key, p, i, mats, len(live), t_done
+                        work.key, p, i, mats, len(work.live), t_done,
+                        replica.tag,
                     )
                     p.future.set_result(resp)
                     self._m_completed.inc()
@@ -409,7 +454,7 @@ class TimingEngine:
                     if not p.future.done():
                         p.future.set_exception(e)
 
-    def _response(self, key, p, i, mats, nlive, t_done):
+    def _response(self, key, p, i, mats, nlive, t_done, rtag=""):
         from pint_tpu.serve.api import FitResponse, ResidualsResponse
 
         req, sess = p.req, p.session
@@ -426,6 +471,7 @@ class TimingEngine:
                 request_id=req.request_id, ntoa=ntoa,
                 residuals_s=resid[i][:ntoa], chi2=float(chi2[i]),
                 bucket=sess.bucket, batch_size=nlive, wall_ms=wall_ms,
+                replica=rtag,
             )
         # fit: the make_scan_fit_loop result tuple, batched
         x, chi2, (covn, nrm), conv, _nbads, bads = mats
@@ -453,7 +499,7 @@ class TimingEngine:
             chi2=float(chi2[i]), converged=bool(conv[i]),
             method="gls", mode=key[3], fitted_par=fitted.as_parfile(),
             ntoa=ntoa, bucket=sess.bucket, batch_size=nlive,
-            wall_ms=wall_ms,
+            wall_ms=wall_ms, replica=rtag,
         )
 
     def _note_latency(self, p, t_done=None):
@@ -475,6 +521,8 @@ class TimingEngine:
             return round(lats[min(len(lats) - 1, int(q * len(lats)))], 3)
 
         occ = self._m_occupancy.value
+        mc = obs_metrics.counter
+        per_replica = self.pool.stats()
         return {
             "requests": self._m_requests.value,
             "completed": self._m_completed.value,
@@ -488,7 +536,21 @@ class TimingEngine:
             "p50_ms": pct(0.50),
             "p99_ms": pct(0.99),
             "sessions": len(self.sessions),
-            "kernels": len(self._kernels),
+            "kernels": sum(
+                r["kernels"] for r in per_replica.values()
+            ),
+            "fabric": {
+                "replicas": self.pool.size,
+                "live": len(self.pool.live),
+                "routes": mc("serve.fabric.routes").value,
+                "reroutes": mc("serve.fabric.reroutes").value,
+                "spills": mc("serve.fabric.spills").value,
+                "quarantines": mc("serve.fabric.quarantines").value,
+                "readmits": mc("serve.fabric.readmits").value,
+                "probes": mc("serve.fabric.probes").value,
+                **self.router.stats(),
+                "per_replica": per_replica,
+            },
         }
 
     def reset_stats(self):
@@ -501,13 +563,15 @@ class TimingEngine:
         obs_metrics.reset("serve.")
 
     def close(self, timeout: float = 120.0):
-        """Drain and stop: queued work is flushed (deadlines still
-        honored), then both pipeline threads join."""
+        """Drain and stop: queued work is flushed onto the fabric
+        (deadlines still honored), the collector joins, then the
+        replica pool drains — in-flight batches fence and queued work
+        completes or sheds as typed RequestRejected('shutdown')."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
         self._collector.join(timeout)
-        self._fencer.join(timeout)
+        self.pool.drain(timeout)
 
     def __enter__(self):
         return self

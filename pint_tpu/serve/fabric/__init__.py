@@ -1,0 +1,54 @@
+"""pint_tpu.serve.fabric — the multi-device serving fabric (ISSUE 5).
+
+Reference parity: none — TPU-service infrastructure.  The r7 engine
+hid the ~85 ms axon tunnel with inflight pipelining but dispatched
+every batch to the default device; this package is the layer every
+production inference stack puts between the batcher and the chips
+(the Orca/vLLM shape: per-replica queues fed by a load-aware router,
+not one global dispatch loop):
+
+- :mod:`pint_tpu.serve.fabric.replica` — a per-device executor that
+  owns its device's compiled kernels, its own bounded inflight
+  pipeline, and a health state machine (LIVE → DEGRADED →
+  QUARANTINED → DRAINED) driven by the runtime/guard.py outcomes;
+- :mod:`pint_tpu.serve.fabric.router` — session→replica placement
+  with affinity (a group compiles once per replica it lands on; hot
+  groups spill to more devices under saturation, cold ones stay on
+  one) and least-outstanding-work routing among live replicas;
+- :mod:`pint_tpu.serve.fabric.pool` — device discovery (the tests'
+  virtual 8-device CPU mesh and the axon TPU slice both surface
+  through parallel/mesh.py::serving_devices), the background canary
+  prober that re-admits quarantined replicas, and graceful
+  drain-on-shutdown.
+
+Env knobs: ``PINT_TPU_SERVE_REPLICAS`` (pool width; 0 = all local
+devices), ``PINT_TPU_SERVE_AFFINITY`` (max replicas per session
+group; 0 = pool width), ``PINT_TPU_SERVE_QUARANTINE_N`` (consecutive
+failures before quarantine), ``PINT_TPU_SERVE_PROBE_MS`` (canary
+probe cadence).  Semantics in docs/serving.md; the per-replica span/
+metric taxonomy in docs/observability.md.
+"""
+
+from pint_tpu.serve.fabric.pool import ReplicaPool
+from pint_tpu.serve.fabric.replica import (
+    DEGRADED,
+    DRAINED,
+    LIVE,
+    QUARANTINED,
+    BatchWork,
+    Replica,
+    health_kind,
+)
+from pint_tpu.serve.fabric.router import Router
+
+__all__ = [
+    "BatchWork",
+    "DEGRADED",
+    "DRAINED",
+    "LIVE",
+    "QUARANTINED",
+    "Replica",
+    "ReplicaPool",
+    "Router",
+    "health_kind",
+]

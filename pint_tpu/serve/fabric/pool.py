@@ -1,0 +1,146 @@
+"""Replica pool: device discovery, the canary prober, graceful drain.
+
+Reference parity: none — TPU-service infrastructure.  The pool turns
+the backend's local devices (parallel/mesh.py::serving_devices — the
+tests' virtual 8-device CPU mesh and the axon TPU slice both surface
+there) into one :class:`~pint_tpu.serve.fabric.replica.Replica` per
+device, runs the background probe loop that re-admits quarantined
+replicas once their canary dispatch answers sanely, and owns the
+drain-on-shutdown contract: in-flight batches fence, queued requests
+complete or shed as typed ``RequestRejected(reason='shutdown')`` —
+never hang.
+
+Env knobs (constructor kwargs override):
+
+- ``PINT_TPU_SERVE_REPLICAS`` — pool width (0/unset = every local
+  device);
+- ``PINT_TPU_SERVE_QUARANTINE_N`` — consecutive guard-class failures
+  before a replica quarantines (default 3);
+- ``PINT_TPU_SERVE_PROBE_MS`` — canary probe cadence for quarantined
+  replicas (default 500 ms).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.parallel.mesh import serving_devices
+from pint_tpu.serve.fabric.replica import (
+    DEGRADED,
+    LIVE,
+    QUARANTINED,
+    Replica,
+)
+
+
+class ReplicaPool:
+    """One replica per serving device + the canary prober thread."""
+
+    def __init__(self, *, replicas: int | None = None, inflight: int,
+                 quarantine_n: int | None = None,
+                 probe_interval_s: float | None = None,
+                 requeue=None, finisher=None, validator=None):
+        env = os.environ.get
+        if replicas is None:
+            replicas = int(env("PINT_TPU_SERVE_REPLICAS", "0"))
+        if quarantine_n is None:
+            quarantine_n = int(env("PINT_TPU_SERVE_QUARANTINE_N", "3"))
+        if probe_interval_s is None:
+            probe_interval_s = (
+                float(env("PINT_TPU_SERVE_PROBE_MS", "500")) / 1e3
+            )
+        self.probe_interval_s = max(0.01, float(probe_interval_s))
+        devices = serving_devices(replicas or None)
+        self.replicas = [
+            Replica(
+                i, d, inflight=inflight, quarantine_n=quarantine_n,
+                requeue=requeue, finisher=finisher,
+                validator=validator,
+            )
+            for i, d in enumerate(devices)
+        ]
+        self._cond = threading.Condition()
+        self._stop = False
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="pint-tpu-fabric prober",
+        )
+        self._prober.start()
+
+    @property
+    def size(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def live(self) -> list:
+        """Replicas currently accepting routed work."""
+        return [
+            r for r in self.replicas
+            if r.state in (LIVE, DEGRADED) and not r.draining
+        ]
+
+    def replica(self, rid: int) -> Replica:
+        return self.replicas[rid]
+
+    # -- the canary prober -------------------------------------------------
+    def _probe_loop(self):
+        """Every ``probe_interval_s``, canary-dispatch each unhealthy
+        replica (the canary runs the guarded chokepoints with the
+        replica-tagged site, so the fault that tripped it keeps
+        failing until it actually clears):
+
+        - QUARANTINED + passing canary -> re-admitted;
+        - DEGRADED replicas are probed too, and the canary outcome
+          counts as a success/failure toward the health machine —
+          without this, a degraded replica that the router (rightly)
+          avoids while LIVE peers exist would never see traffic again
+          and park in DEGRADED forever instead of converging to LIVE
+          or QUARANTINED."""
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                self._cond.wait(self.probe_interval_s)
+                if self._stop:
+                    return
+            for r in self.replicas:
+                if r.draining:
+                    continue
+                state = r.state
+                if state == QUARANTINED:
+                    if r.probe():
+                        r.readmit()
+                        TRACER.event(
+                            "readmit", "fabric", replica=r.tag
+                        )
+                elif state == DEGRADED:
+                    if r.probe():
+                        r.note_success()
+                    else:
+                        r.note_failure("probe")
+
+    # -- stats / lifecycle -------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            r.tag: {
+                "state": r.state,
+                "outstanding": r.outstanding,
+                "batches": r.batches_done,
+                "failures": r.failures,
+                "kernels": r.kernel_count,
+                "device": str(r.device),
+            }
+            for r in self.replicas
+        }
+
+    def drain(self, timeout: float = 120.0):
+        """Stop the prober, then drain every replica (queued work
+        completes or sheds typed; threads join)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._prober.join(5.0)
+        for r in self.replicas:
+            r.drain(timeout)

@@ -1,0 +1,459 @@
+"""Per-device replica executor for the serving fabric.
+
+Reference parity: none — TPU-service infrastructure.  A *replica* is
+one device's share of the serving engine: it owns the compiled-kernel
+cache for every session group routed to it (each replica compiles its
+OWN executables — jax specializes a jit wrapper per committed operand
+device, so sharing wrappers across devices would retrace on every
+hop), a bounded work queue + inflight semaphore forming its private
+dispatch pipeline (dispatcher thread: device_put + async guarded
+dispatch; fencer thread: materialize + validate + resolve), and a
+health state machine driven by the runtime/guard.py outcomes:
+
+``LIVE → DEGRADED``
+    one guard-class failure (watchdog trip / retries exhausted /
+    non-finite validation) degrades the replica — it keeps serving,
+    but the router prefers LIVE peers, and the pool's prober canaries
+    it so an avoided replica still converges to LIVE (canary passes)
+    or QUARANTINED (canary failures accumulate) instead of parking
+    DEGRADED forever;
+``DEGRADED → QUARANTINED``
+    ``quarantine_n`` CONSECUTIVE failures quarantine it: queued work
+    is re-routed to surviving replicas, new routing skips it, and the
+    pool's background canary probe (a small guarded dispatch on the
+    same device, so injected/real faults keep failing it) re-admits
+    it once the device answers sanely again;
+``→ DRAINED``
+    terminal shutdown state: in-flight batches fence, queued work
+    completes (or sheds as typed RequestRejected) — never hangs.
+
+Failure handling is per BATCH: a failed batch re-routes to another
+replica (its ``excluded`` set grows, so the bounce is bounded by the
+pool width); only when no candidate remains do the member futures see
+the original typed error.  Deterministic failures (transport 413s,
+model errors) are the request's own fault — they fail the futures
+immediately and never damage replica health.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    PintTpuError,
+    PintTpuNumericsError,
+    RequestRejected,
+    RetriesExhausted,
+    TransientDispatchError,
+)
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime.guard import dispatch_guard, validate_finite
+
+#: health states (docs/serving.md state diagram)
+LIVE = "LIVE"
+DEGRADED = "DEGRADED"
+QUARANTINED = "QUARANTINED"
+DRAINED = "DRAINED"
+
+
+def health_kind(e: BaseException) -> str | None:
+    """Classify a batch failure for the health machine: 'watchdog'
+    (wedged/flaky transport — the guard already retried transients),
+    'nan' (non-finite device output), or None (deterministic — the
+    request's own fault, e.g. a 413 payload rejection or a model
+    error: fail the futures, leave replica health alone)."""
+    if isinstance(e, (GuardTimeout, RetriesExhausted,
+                      TransientDispatchError)):
+        return "watchdog"
+    if isinstance(e, PintTpuNumericsError):
+        return "nan"
+    return None
+
+
+class BatchWork:
+    """One assembled micro-batch flowing through the fabric: the
+    flush-time stacked host-numpy operands plus the routing state
+    (replicas that already failed it, the last typed error)."""
+
+    __slots__ = ("key", "live", "ops", "session", "cap", "excluded",
+                 "last_error")
+
+    def __init__(self, key, live, ops, session, cap):
+        self.key = key
+        self.live = live  # engine _Pending records
+        self.ops = ops  # (bundle stack, ref stack, x0 stack)
+        self.session = live[0].session if session is None else session
+        self.cap = cap
+        self.excluded: set = set()  # replica ids that failed/refused
+        self.last_error: BaseException | None = None
+
+    @property
+    def op(self) -> str:
+        return self.key[0]
+
+    def kernel_key(self) -> tuple:
+        return (self.key, self.cap)
+
+    def make_kernel(self, tag: str):
+        """Build this batch's kernel for one replica (the site carries
+        the replica tag so spans/faults are per-replica pinnable)."""
+        from pint_tpu.serve import session as smod
+
+        site = (
+            f"serve:{self.key[0]}:b{self.session.bucket}"
+            f"x{self.cap}@{tag}"
+        )
+        if self.key[0] == "fit":
+            _, _, _, mode, maxiter, tol = self.key
+            return smod.build_fit_kernel(
+                self.session, mode, maxiter, tol, site
+            )
+        return smod.build_residuals_kernel(
+            self.session, self.key[3], site
+        )
+
+    def fail(self, e: BaseException):
+        """Resolve every member future with the typed failure."""
+        exc = (
+            e if isinstance(e, Exception)
+            else PintTpuError(f"fabric dispatch failed: {e!r}")
+        )
+        for p in self.live:
+            if not p.future.done():
+                p.future.set_exception(exc)
+
+    def shed(self, reason: str, detail: str):
+        """Typed load-shed of the whole batch (no replica can serve)."""
+        obs_metrics.counter("serve.rejected").inc(len(self.live))
+        if reason == "no-replica":
+            obs_metrics.counter("serve.fabric.no_replica").inc()
+        TRACER.event(
+            "shed", "fabric", reason=reason, op=self.key[0],
+            n=len(self.live),
+        )
+        for p in self.live:
+            if not p.future.done():
+                p.future.set_exception(RequestRejected(reason, detail))
+
+
+class Replica:
+    """One device's executor: kernel cache + dispatch pipeline +
+    health state machine.
+
+    ``requeue(work, replica)`` re-routes a batch this replica could
+    not serve; ``finisher(work, mats, replica)`` resolves futures from
+    fenced host arrays; ``validator(work, mats, tag)`` is the
+    batch-level finite gate (engine-provided so the response schema
+    stays in one place)."""
+
+    def __init__(self, rid: int, device, *, inflight: int,
+                 quarantine_n: int, requeue, finisher, validator):
+        self.rid = rid
+        self.tag = f"r{rid}"
+        self.device = device
+        self.inflight = max(1, int(inflight))
+        self.quarantine_n = max(1, int(quarantine_n))
+        self._requeue = requeue
+        self._finisher = finisher
+        self._validator = validator
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._fence_q: queue.Queue = queue.Queue()
+        self._sem = threading.BoundedSemaphore(self.inflight)
+        self._kernels: dict = {}  # (batch key, capacity) -> callable
+        self._draining = False
+        # health state: reads are bare attribute loads (GIL-atomic) so
+        # submit() can check state while holding only _cond; writes go
+        # through _set_state under _state_lock
+        self._state = LIVE
+        self._state_lock = threading.Lock()
+        self._consecutive = 0
+        self.batches_done = 0
+        self.failures = 0
+        self._outstanding = 0  # batches queued + in flight
+        self._g_out = obs_metrics.gauge(
+            f"serve.replica.{rid}.outstanding"
+        )
+        self._g_state = obs_metrics.gauge(f"serve.replica.{rid}.state")
+        self._g_state.set(LIVE)
+        self._m_batches = obs_metrics.counter(
+            f"serve.replica.{rid}.batches"
+        )
+        self._canary = self._make_canary()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"pint-tpu-replica {self.tag} dispatch",
+        )
+        self._fencer = threading.Thread(
+            target=self._fence_loop, daemon=True,
+            name=f"pint-tpu-replica {self.tag} fence",
+        )
+        self._dispatcher.start()
+        self._fencer.start()
+
+    # -- introspection (router/stats read these lock-free) ---------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def outstanding(self) -> int:
+        return self._outstanding
+
+    @property
+    def kernel_count(self) -> int:
+        return len(self._kernels)
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, work: BatchWork, block: bool = True,
+               force: bool = False) -> bool:
+        """Enqueue one assembled batch; returns False when the replica
+        is not accepting (draining/quarantined — the caller re-routes).
+        A full queue blocks (bounded wait-poll, so a mid-wait
+        quarantine is noticed) unless ``force`` (the requeue path must
+        never block a peer replica's pipeline thread on this one)."""
+        with TRACER.span(
+            "replica:submit", "fabric", replica=self.tag,
+            op=work.key[0], n=len(work.live),
+        ):
+            with self._cond:
+                while True:
+                    if self._draining or self._state == QUARANTINED:
+                        return False
+                    if force or len(self._queue) < self.inflight:
+                        break
+                    if not block:
+                        return False
+                    self._cond.wait(0.05)
+                self._queue.append(work)
+                self._outstanding += 1
+                self._g_out.set(self._outstanding)
+                self._cond.notify_all()
+        return True
+
+    # -- the dispatch pipeline --------------------------------------------
+    def _kernel_for(self, work: BatchWork):
+        kkey = work.kernel_key()
+        k = self._kernels.get(kkey)
+        if k is None:
+            inner = work.make_kernel(self.tag)
+            traced = [False]
+            lock = work.session.trace_lock
+
+            def k(*args):
+                # first call traces through _with_swapped, which
+                # MUTATES the shared session prototype for the trace's
+                # duration — serialize traces across replicas (warm
+                # dispatches never execute the Python body, so they
+                # stay lock-free and safely concurrent with a trace)
+                if not traced[0]:
+                    with lock:
+                        traced[0] = True
+                        return inner(*args)
+                return inner(*args)
+
+            self._kernels[kkey] = k
+        return k
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._draining:
+                    self._cond.wait(0.2)
+                if not self._queue:
+                    break  # draining and empty
+                work = self._queue.popleft()
+                self._cond.notify_all()
+            if self._state == QUARANTINED and not self._draining:
+                # quarantined with leftover queue (submit race): hand
+                # the work back to the router
+                self._batch_leaves(work)
+                self._requeue(work, self)
+                continue
+            self._run(work)
+        self._fence_q.put(None)
+
+    def _run(self, work: BatchWork):
+        try:
+            kernel = self._kernel_for(work)
+        except BaseException as e:
+            self._batch_leaves(work)
+            work.fail(e)
+            return
+        # backpressure: at most `inflight` dispatched batches may
+        # await this replica's fence
+        self._sem.acquire()
+        try:
+            with TRACER.span(
+                "replica:dispatch", "fabric", replica=self.tag,
+                op=work.key[0], n=len(work.live), cap=work.cap,
+            ):
+                ops = jax.device_put(work.ops, self.device)
+                out = kernel(*ops)  # async guarded device dispatch
+        except BaseException as e:
+            self._sem.release()
+            self._batch_error(work, e)
+            return
+        self._fence_q.put((work, out))
+
+    def _fence_loop(self):
+        while True:
+            item = self._fence_q.get()
+            if item is None:
+                break
+            work, out = item
+            try:
+                with TRACER.span(
+                    "replica:fence", "fabric", replica=self.tag,
+                    op=work.key[0], n=len(work.live),
+                ):
+                    mats = tree_util.tree_map(np.asarray, out)
+                self._validator(work, mats, self.tag)
+            except BaseException as e:
+                self._sem.release()
+                self._batch_error(work, e)
+                continue
+            self._sem.release()
+            self.note_success()
+            try:
+                self._finisher(work, mats, self)
+            except BaseException as e:
+                work.fail(e)
+            self.batches_done += 1
+            self._m_batches.inc()
+            self._batch_leaves(work)
+
+    def _batch_leaves(self, work: BatchWork):
+        with self._cond:
+            self._outstanding = max(0, self._outstanding - 1)
+            self._g_out.set(self._outstanding)
+            self._cond.notify_all()
+
+    def _batch_error(self, work: BatchWork, e: BaseException):
+        self._batch_leaves(work)
+        kind = health_kind(e)
+        work.last_error = e
+        work.excluded.add(self.rid)
+        if kind is None:
+            # deterministic failure: the request's fault, not the
+            # replica's — no health hit, no re-route
+            work.fail(e)
+            return
+        self.failures += 1
+        obs_metrics.counter("serve.fabric.failures").inc()
+        self.note_failure(kind, e)
+        self._requeue(work, self)
+
+    # -- health state machine ---------------------------------------------
+    def _set_state(self, new: str, kind: str = ""):
+        """The single transition chokepoint (tools/lint_obs.py rule 4:
+        every quarantine/readmit is event-instrumented + counted)."""
+        prev, self._state = self._state, new
+        self._g_state.set(new)
+        if new == QUARANTINED:
+            obs_metrics.counter("serve.fabric.quarantines").inc()
+        elif new == LIVE and prev == QUARANTINED:
+            obs_metrics.counter("serve.fabric.readmits").inc()
+        elif new == DEGRADED:
+            obs_metrics.counter("serve.fabric.degraded").inc()
+        TRACER.event(
+            "replica-state", "fabric", replica=self.tag, frm=prev,
+            to=new, kind=kind,
+        )
+
+    def note_failure(self, kind: str, err: BaseException = None):
+        """One guard-class batch failure: LIVE degrades immediately;
+        ``quarantine_n`` consecutive failures quarantine (queued work
+        is handed back to the router)."""
+        flush = []
+        with self._state_lock:
+            if self._state == DRAINED:
+                return
+            self._consecutive += 1
+            if self._state == LIVE:
+                self._set_state(DEGRADED, kind=kind)
+            if (self._consecutive >= self.quarantine_n
+                    and self._state != QUARANTINED):
+                self._set_state(QUARANTINED, kind=kind)
+                with self._cond:
+                    while self._queue:
+                        flush.append(self._queue.popleft())
+                    self._cond.notify_all()
+        for w in flush:
+            self._batch_leaves(w)
+            self._requeue(w, self)
+
+    def note_success(self):
+        if not self._consecutive and self._state == LIVE:
+            return
+        with self._state_lock:
+            self._consecutive = 0
+            if self._state == DEGRADED:
+                self._set_state(LIVE, kind="recovered")
+
+    def readmit(self):
+        """Probe-driven re-admission (pool's canary loop)."""
+        with self._state_lock:
+            if self._state == QUARANTINED:
+                self._consecutive = 0
+                self._set_state(LIVE, kind="probe")
+
+    # -- canary probe ------------------------------------------------------
+    def _make_canary(self):
+        """Small guarded dispatch on THIS device: the probe exercises
+        the same chokepoints a real batch does (dispatch_guard +
+        validate_finite, replica-tagged site), so whatever fault
+        quarantined the replica keeps failing the canary until it
+        actually clears."""
+        site = f"serve:canary@{self.tag}"
+        fn = dispatch_guard(
+            jax.jit(lambda x: jnp.sum(x * 2.0 + 1.0)), site
+        )
+        device = self.device
+
+        def run():
+            x = jax.device_put(np.arange(8.0), device)
+            out = fn(x)
+            validate_finite(
+                {"canary": out}, site=site, what="replica canary probe"
+            )
+
+        return run
+
+    def probe(self) -> bool:
+        """One canary dispatch; True when the device answered sanely."""
+        obs_metrics.counter("serve.fabric.probes").inc()
+        try:
+            with TRACER.span(
+                "replica:probe", "fabric", replica=self.tag
+            ):
+                self._canary()
+            return True
+        except BaseException:
+            return False
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout: float = 60.0):
+        """Stop accepting, finish (or re-route/shed) queued work,
+        fence in-flight batches, join both threads."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        self._dispatcher.join(timeout)
+        self._fencer.join(timeout)
+        with self._state_lock:
+            if self._state != DRAINED:
+                self._set_state(DRAINED, kind="shutdown")

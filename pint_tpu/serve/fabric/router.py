@@ -1,0 +1,126 @@
+"""Affinity router: session-group placement + load-aware routing.
+
+Reference parity: none — TPU-service infrastructure.  Placement is
+keyed by the batcher's GROUP key (operation, composition key, shape
+bucket, op parameters) — the exact identity of a compiled kernel —
+NOT by the par hash alone: same-composition pars share executables
+(serve/session.py), so a brand-new par routing to the group's placed
+replica serves with ZERO fresh compiles (the steady-state invariant
+tests/test_serve.py gates).
+
+Policy (the continuous-batching-server shape — per-replica queues fed
+by a load-aware router):
+
+- a group's first batch is PLACED on the least-loaded live replica
+  and sticks there (cold groups stay on one device — one compiled
+  executable per kernel shape, total);
+- a batch routes to the least-outstanding-work replica among the
+  group's placed LIVE replicas (DEGRADED only when no LIVE peer
+  holds the group), with round-robin rotation among ties;
+- when every placed candidate is SATURATED (outstanding batches
+  exceed its inflight bound — work is queuing, not flowing) and the
+  affinity cap allows, the group SPILLS to one more live replica
+  (hot groups replicate across the mesh; each spill costs that
+  replica one compile per kernel shape, amortized forever after);
+- quarantined/draining replicas are never candidates, and a batch's
+  ``excluded`` set (replicas that already failed it) is honored, so
+  re-routes are bounded by the pool width.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.serve.fabric.replica import DEGRADED, LIVE
+
+
+class Router:
+    """Places session groups on replicas and routes assembled batches."""
+
+    def __init__(self, pool, affinity: int | None = None):
+        self.pool = pool
+        self.affinity = max(
+            1, int(affinity) if affinity else pool.size
+        )
+        self._placements: dict = {}  # group key -> [rid, ...]
+        self._rotor: dict = {}  # group key -> round-robin counter
+        self._lock = threading.Lock()
+        self._m_routes = obs_metrics.counter("serve.fabric.routes")
+        self._m_spills = obs_metrics.counter("serve.fabric.spills")
+
+    def placement(self, key) -> tuple:
+        """The group's current affinity set (observability/tests)."""
+        with self._lock:
+            return tuple(self._placements.get(key, ()))
+
+    def route(self, work, exclude=()):
+        """Pick the serving replica for one assembled batch; None when
+        no live/degraded replica can take it (the caller sheds typed).
+        Every decision is span-instrumented (lint_obs rule 4)."""
+        with TRACER.span(
+            "router:route", "fabric", op=work.key[0],
+            n=len(work.live),
+        ):
+            with self._lock:
+                rep = self._route_locked(work.key, set(exclude))
+            self._m_routes.inc()
+            if rep is not None:
+                TRACER.annotate(replica=rep.tag)
+            return rep
+
+    def _route_locked(self, key, exclude):
+        placed = self._placements.setdefault(key, [])
+        usable = {
+            r.rid: r for r in self.pool.replicas
+            if r.state in (LIVE, DEGRADED) and not r.draining
+            and r.rid not in exclude
+        }
+        cands = [usable[rid] for rid in placed if rid in usable]
+        # prefer LIVE peers; a DEGRADED replica serves only when no
+        # LIVE one holds the group
+        live_cands = [r for r in cands if r.state == LIVE]
+        if live_cands:
+            cands = live_cands
+        if (cands and len(placed) < self.affinity
+                and all(r.outstanding > r.inflight for r in cands)):
+            # saturated affinity set: spill the group to one more
+            # replica (it pays one compile per kernel shape, then
+            # serves this group forever)
+            fresh = [
+                r for r in usable.values() if r.rid not in placed
+            ]
+            if fresh:
+                r = min(fresh, key=lambda r: (r.outstanding, r.rid))
+                placed.append(r.rid)
+                cands.append(r)
+                self._m_spills.inc()
+                TRACER.event(
+                    "spill", "fabric", op=key[0], replica=r.tag,
+                    width=len(placed),
+                )
+        if not cands:
+            # no placed replica is usable: (re)place on the
+            # least-loaded usable replica
+            fresh = list(usable.values())
+            if not fresh:
+                return None
+            r = min(fresh, key=lambda r: (r.outstanding, r.rid))
+            if r.rid not in placed:
+                placed.append(r.rid)
+            return r
+        lo = min(r.outstanding for r in cands)
+        tied = [r for r in cands if r.outstanding == lo]
+        i = self._rotor.get(key, 0)
+        self._rotor[key] = i + 1
+        return tied[i % len(tied)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "groups": len(self._placements),
+                "placement_widths": sorted(
+                    len(v) for v in self._placements.values()
+                ),
+            }

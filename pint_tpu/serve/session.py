@@ -145,6 +145,11 @@ class Session:
         self.static_ref = static
         self.composition = composition_key(cm, static, phash)
         self._polycos: OrderedDict = OrderedDict()  # span key -> Polycos
+        # serializes kernel TRACES across fabric replicas: the trace
+        # runs _with_swapped, which mutates this shared prototype for
+        # the trace's duration (warm dispatches never execute the
+        # Python body and stay lock-free) — serve/fabric/replica.py
+        self.trace_lock = threading.Lock()
 
     # -- phase prediction (host-evaluated polycos) ------------------------
     _POLYCO_CACHE = 8  # spans kept per session

@@ -435,7 +435,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="+",
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
-                             "6", "7", "7b", "serve"])
+                             "6", "7", "7b", "serve",
+                             "serve_replicas"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -443,17 +444,21 @@ def main():
                 "7": config_7, "7b": config_7b}
     hbm_last_peak = 0
     for c in args.configs:
-        if str(c) == "serve":
-            # offered-load ladder for the serving engine (ISSUE 4):
-            # one row per rung, including the shedding rung past the
-            # admission-queue bound (profiling/serve_offered_load.py)
+        if str(c) in ("serve", "serve_replicas"):
+            # serving-engine ladders (profiling/serve_offered_load.py):
+            # 'serve' = the offered-load ladder (ISSUE 4; the top rung
+            # overruns the admission queue to exercise shedding);
+            # 'serve_replicas' = the fabric replica ladder (ISSUE 5;
+            # 1/2/4/8 replicas at fixed offered load -> aggregate
+            # TOAs/s + scaling efficiency)
             import os
             import sys
 
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-            from serve_offered_load import sweep
+            from serve_offered_load import replica_sweep, sweep
 
-            for row in sweep():
+            rows = sweep() if str(c) == "serve" else replica_sweep()
+            for row in rows:
                 print(json.dumps(row))
             continue
         built = builders[str(c)]()

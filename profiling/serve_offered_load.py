@@ -9,8 +9,19 @@ than the admission queue holds, so the shedding behavior (typed
 rejections, not hangs — docs/serving.md's backpressure contract) is
 exercised and reported, not just the happy path.
 
+The REPLICA ladder (ISSUE 5, :func:`replica_sweep`) holds the offered
+load fixed and sweeps the fabric width (1/2/4/8 replicas, inflight=1
+so the router's saturation spill replicates the hot session group
+across the pool during the warm bursts), reporting aggregate TOAs/s
+and scaling efficiency (achieved speedup over the 1-replica rung,
+divided by the replica count) per rung — the serving-capacity scaling
+trajectory next to the offered-load one.  On the virtual CPU mesh the
+"devices" share host cores, so efficiency there measures fabric
+overhead, not hardware scaling.
+
 Usage: ``python profiling/serve_offered_load.py`` (one JSON line per
-rung), or via ``python profiling/run_benchmarks.py --configs serve``.
+rung, both ladders), or via ``python profiling/run_benchmarks.py
+--configs serve`` / ``--configs serve_replicas``.
 """
 
 from __future__ import annotations
@@ -111,11 +122,94 @@ def sweep(loads=(8, 32, 128), npsr: int = 8, max_queue: int = 64,
         engine.close()
 
 
+def replica_sweep(replicas=(1, 2, 4, 8), offered: int = 64,
+                  npsr: int = 8, maxiter: int = 2):
+    """Yield one result row per replica-count rung at fixed offered
+    load (aggregate TOAs/s + scaling efficiency vs the first rung)."""
+    import jax
+
+    from pint_tpu.exceptions import RequestRejected
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+
+    pulsars = build_fleet(npsr)
+    total_toas = sum(len(t) for _, t in pulsars)
+    base_rps = None
+    for nrep in replicas:
+        engine = TimingEngine(
+            max_batch=8, inflight=1, max_wait_ms=5.0,
+            max_queue=max(2 * offered, 64), replicas=nrep,
+            affinity=nrep,
+        )
+        try:
+            def reqs():
+                return [
+                    FitRequest(
+                        par=pulsars[i % npsr][0],
+                        toas=pulsars[i % npsr][1], maxiter=maxiter,
+                    )
+                    for i in range(offered)
+                ]
+
+            for _ in range(2):  # warm + spill + per-replica compiles
+                for f in engine.submit_many(reqs()):
+                    f.result(timeout=3600)
+            engine.reset_stats()
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            t0 = time.perf_counter()
+            completed = rejected = failed = 0
+            for f in engine.submit_many(reqs()):
+                try:
+                    f.result(timeout=3600)
+                    completed += 1
+                except RequestRejected:
+                    rejected += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            rps = completed / wall
+            if base_rps is None:
+                base_rps = rps
+            fab = engine.stats()["fabric"]
+            yield {
+                "config": f"serve replicas={nrep} offered={offered} "
+                          f"fits ({npsr} pulsars, 256 bucket)",
+                "backend": jax.default_backend(),
+                "replicas": nrep,
+                "offered": offered,
+                "completed": completed,
+                "shed": rejected,
+                "failed": failed,
+                "achieved_rps": round(rps, 2),
+                "toas_per_s": round(
+                    rps * total_toas / npsr, 1
+                ),
+                "scaling_x": round(rps / base_rps, 3),
+                "scaling_efficiency": round(
+                    rps / base_rps / nrep, 3
+                ),
+                "replica_occupancy": {
+                    tag: rs["batches"]
+                    for tag, rs in fab["per_replica"].items()
+                    if rs["batches"]
+                },
+                "spills": fab["spills"],
+                "steady_recompiles": (
+                    obs_metrics.counter("compile.recompiles").value
+                    - rec0
+                ),
+            }
+        finally:
+            engine.close()
+
+
 def main():
     import jax
 
     jax.config.update("jax_enable_x64", True)
     for row in sweep():
+        print(json.dumps(row))
+    for row in replica_sweep():
         print(json.dumps(row))
 
 

@@ -99,6 +99,70 @@ def test_linter_flags_uninstrumented_serve_chokepoints(tmp_path):
     )
 
 
+def test_linter_flags_uninstrumented_fabric_chokepoints(tmp_path):
+    """Rule 4: the fabric's route/submit must span, health transitions
+    must emit events, and the canary must dispatch through the guard."""
+    pkg = tmp_path / "pint_tpu"
+    (pkg / "fitting").mkdir(parents=True)
+    (pkg / "runtime").mkdir()
+    (pkg / "models").mkdir()
+    (pkg / "serve" / "fabric").mkdir(parents=True)
+    (pkg / "runtime" / "guard.py").write_text(
+        "def dispatch_guard(fn, site):\n"
+        "    h = TRACER.span(site, 'dispatch')\n"
+        "    return fn\n"
+    )
+    (pkg / "models" / "timing_model.py").write_text(
+        "class CompiledModel:\n"
+        "    def jit(self, fn):\n"
+        "        note_trace(1)\n"
+        "        return dispatch_guard(fn, 'x')\n"
+    )
+    # rule-3 chokepoints present and clean
+    (pkg / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def submit(self, request):\n"
+        "        with TRACER.span('serve:submit', 'serve'):\n"
+        "            return request\n"
+        "    def _flush(self, batch):\n"
+        "        with TRACER.span('serve:flush', 'serve'):\n"
+        "            pass\n"
+    )
+    (pkg / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site):\n"
+        "    note_trace(site, retrace=False)\n"
+        "    return dispatch_guard(fn, site)\n"
+    )
+    # route lost its span; _set_state lost its event; the canary lost
+    # the guard; submit stays clean
+    (pkg / "serve" / "fabric" / "router.py").write_text(
+        "class Router:\n"
+        "    def route(self, work, exclude=()):\n"
+        "        return None\n"
+    )
+    (pkg / "serve" / "fabric" / "replica.py").write_text(
+        "class Replica:\n"
+        "    def submit(self, work, block=True, force=False):\n"
+        "        with TRACER.span('replica:submit', 'fabric'):\n"
+        "            return True\n"
+        "    def _set_state(self, new, kind=''):\n"
+        "        self._state = new\n"
+        "    def _make_canary(self):\n"
+        "        return lambda: None\n"
+    )
+    findings = [str(f) for f in check_chokepoints(pkg)]
+    assert any("Router.route" in f for f in findings)
+    assert not any("Replica.submit" in f for f in findings)
+    assert any(
+        "Replica._set_state" in f and "TRACER.event" in f
+        for f in findings
+    )
+    assert any(
+        "Replica._make_canary" in f and "dispatch_guard" in f
+        for f in findings
+    )
+
+
 def test_linter_flags_undecorated_fit_toas(tmp_path):
     pkg = tmp_path / "pint_tpu"
     (pkg / "fitting").mkdir(parents=True)

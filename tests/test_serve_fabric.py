@@ -1,0 +1,465 @@
+"""Multi-device serving-fabric suite (pint_tpu/serve/fabric) on the
+virtual 8-device CPU mesh (conftest).  Covers the ISSUE 5 acceptance
+surface:
+
+- device discovery + env knobs (PINT_TPU_SERVE_REPLICAS/_AFFINITY/
+  _QUARANTINE_N);
+- router policy units: sticky placement, least-outstanding routing,
+  spill-on-saturation, exclusion, quarantine avoidance;
+- health state machine units (LIVE -> DEGRADED -> QUARANTINED ->
+  readmit) + the canary probe;
+- fault-injection: hang/NaN pinned to ONE replica quarantines it, all
+  queued requests complete on surviving replicas or shed typed, and
+  the canary probe re-admits it after faults clear — the cycle
+  observable in flight_report();
+- placement parity: an identical request stream through a 1-replica
+  and a 4-replica fabric yields bitwise-identical responses per
+  request (placement must not change numerics), padded TOA buckets
+  included;
+- drain guarantees under total outage: every future resolves to a
+  typed error, bounded-time, never a hang.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    PintTpuNumericsError,
+    RequestRejected,
+    RetriesExhausted,
+)
+from pint_tpu.obs import export as obs_export
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs import trace as obs_trace
+from pint_tpu.parallel.mesh import serving_devices
+from pint_tpu.runtime import faults, guard
+from pint_tpu.serve import FitRequest, ResidualsRequest, TimingEngine
+from pint_tpu.serve.fabric import (
+    DEGRADED,
+    DRAINED,
+    LIVE,
+    QUARANTINED,
+    ReplicaPool,
+    Router,
+)
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """
+PSR              J0000+01{i:02d}
+F0               {f0}  1
+F1               -1.3e-15           1
+PEPOCH           55000
+DM               {dm}             1
+"""
+
+
+def _pulsar(i, f0, dm, n, seed):
+    m, t = make_test_pulsar(
+        PAR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+        iterations=1,
+    )
+    return m.as_parfile(), t
+
+
+@pytest.fixture(scope="module")
+def pulsars():
+    """Three same-composition pulsars, mixed TOA counts in the 64
+    bucket (so every batch exercises the padded-TOA path)."""
+    return [
+        _pulsar(0, 133.1, 11.0, 30, 11),
+        _pulsar(1, 207.9, 24.0, 40, 12),
+        _pulsar(2, 91.3, 6.5, 50, 13),
+    ]
+
+
+def _join_guard_threads():
+    """The watchdog ABANDONS wedged attempts; give leftover workers a
+    bounded join so none is inside jax/XLA at interpreter teardown
+    (test_serve.py precedent)."""
+    for th in threading.enumerate():
+        if th.name.startswith("pint-tpu-guard"):
+            th.join(timeout=10)
+
+
+def _wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- discovery + env knobs ------------------------------------------------
+def test_serving_devices_discovery():
+    devs = serving_devices()
+    assert len(devs) == 8  # conftest's virtual CPU mesh
+    assert len(serving_devices(3)) == 3
+    assert len(serving_devices(99)) == 8  # clamped to what exists
+    assert len(serving_devices(0)) == 8  # 0 = all
+
+
+def test_pool_env_knobs(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_SERVE_REPLICAS", "3")
+    monkeypatch.setenv("PINT_TPU_SERVE_QUARANTINE_N", "5")
+    monkeypatch.setenv("PINT_TPU_SERVE_AFFINITY", "2")
+    eng = TimingEngine(max_batch=1, max_wait_ms=0.0)
+    try:
+        assert eng.pool.size == 3
+        assert all(r.quarantine_n == 5 for r in eng.pool.replicas)
+        assert eng.router.affinity == 2
+        st = eng.stats()
+        assert st["fabric"]["replicas"] == 3
+        assert set(st["fabric"]["per_replica"]) == {"r0", "r1", "r2"}
+    finally:
+        eng.close(timeout=60)
+
+
+# -- router policy units --------------------------------------------------
+class FakeReplica:
+    def __init__(self, rid, state=LIVE, outstanding=0, inflight=1):
+        self.rid = rid
+        self.tag = f"r{rid}"
+        self.state = state
+        self.outstanding = outstanding
+        self.inflight = inflight
+        self.draining = False
+
+
+class FakePool:
+    def __init__(self, reps):
+        self.replicas = reps
+
+    @property
+    def size(self):
+        return len(self.replicas)
+
+
+def _work():
+    return types.SimpleNamespace(key=("fit", "comp", 64), live=[1, 2])
+
+
+def test_router_sticky_placement_and_least_loaded():
+    reps = [FakeReplica(0, outstanding=2), FakeReplica(1),
+            FakeReplica(2, outstanding=1)]
+    router = Router(FakePool(reps))
+    w = _work()
+    # initial placement: least-loaded live replica (r1), then sticky
+    assert router.route(w).rid == 1
+    assert router.route(w).rid == 1
+    assert router.placement(w.key) == (1,)
+
+
+def test_router_spills_only_under_saturation():
+    reps = [FakeReplica(0), FakeReplica(1), FakeReplica(2)]
+    router = Router(FakePool(reps), affinity=2)
+    w = _work()
+    assert router.route(w).rid == 0
+    # loaded but not past the inflight bound: no spill
+    reps[0].outstanding = 1
+    assert router.route(w).rid == 0
+    assert router.placement(w.key) == (0,)
+    # saturated (outstanding > inflight): spill to ONE more replica,
+    # capped by the affinity bound
+    reps[0].outstanding = 2
+    s0 = obs_metrics.counter("serve.fabric.spills").value
+    assert router.route(w).rid == 1
+    assert router.placement(w.key) == (0, 1)
+    assert obs_metrics.counter("serve.fabric.spills").value == s0 + 1
+    reps[1].outstanding = 2
+    assert router.route(w).rid in (0, 1)  # affinity=2: no 3rd spill
+    assert router.placement(w.key) == (0, 1)
+
+
+def test_router_skips_quarantined_and_excluded():
+    reps = [FakeReplica(0), FakeReplica(1), FakeReplica(2)]
+    router = Router(FakePool(reps))
+    w = _work()
+    assert router.route(w).rid == 0
+    reps[0].state = QUARANTINED
+    # placed replica quarantined: re-place on a usable one
+    r = router.route(w)
+    assert r.rid == 1
+    assert router.placement(w.key) == (0, 1)
+    # exclusion (a replica that already failed this batch) honored
+    assert router.route(w, exclude={0, 1}).rid == 2
+    # DEGRADED serves only when no LIVE peer holds the group
+    reps[1].state = DEGRADED
+    assert router.route(w, exclude={2}).rid == 1
+    # nothing usable at all -> None (the caller sheds typed)
+    reps[1].state = QUARANTINED
+    reps[2].draining = True
+    assert router.route(w) is None
+
+
+# -- health state machine -------------------------------------------------
+def test_replica_health_machine_and_probe():
+    pool = ReplicaPool(
+        replicas=2, inflight=1, quarantine_n=2, probe_interval_s=30.0,
+        requeue=lambda w, r: None, finisher=lambda w, m, r: None,
+        validator=lambda w, m, t: None,
+    )
+    try:
+        r = pool.replica(0)
+        q0 = obs_metrics.counter("serve.fabric.quarantines").value
+        assert r.state == LIVE
+        r.note_failure("watchdog")
+        assert r.state == DEGRADED
+        r.note_success()  # a success resets the consecutive count
+        assert r.state == LIVE
+        r.note_failure("nan")
+        r.note_failure("nan")
+        assert r.state == QUARANTINED
+        assert (
+            obs_metrics.counter("serve.fabric.quarantines").value
+            == q0 + 1
+        )
+        assert len(pool.live) == 1
+        # the canary passes on a healthy device -> readmit
+        assert r.probe()
+        r.readmit()
+        assert r.state == LIVE
+        assert len(pool.live) == 2
+    finally:
+        pool.drain(timeout=60)
+    assert all(r.state == DRAINED for r in pool.replicas)
+
+
+# -- fault injection: quarantine -> reroute -> probe -> readmit -----------
+def test_hang_pinned_to_one_replica_quarantines_and_readmits(pulsars):
+    eng = TimingEngine(
+        max_batch=2, max_wait_ms=1.0, inflight=1, replicas=3,
+        quarantine_n=2, probe_ms=50, max_queue=64,
+    )
+    try:
+        with obs_trace.tracing(clear=True):
+            # warm: placement lands on r0 and BOTH batch capacities
+            # (1 and 2) compile there, so the faulted calls below are
+            # warm dispatches on the short dispatch watchdog; canaries
+            # compile everywhere for the same reason
+            par, toas = pulsars[0]
+            r = eng.submit(
+                ResidualsRequest(par=par, toas=toas)
+            ).result(timeout=300)
+            assert r.replica == "r0"
+            pair = [
+                eng.submit(ResidualsRequest(par=p, toas=t))
+                for p, t in pulsars[:2]
+            ]
+            assert all(
+                f.result(timeout=300).replica == "r0" for f in pair
+            )
+            for rep in eng.pool.replicas:
+                assert rep.probe()
+            q0 = obs_metrics.counter("serve.fabric.quarantines").value
+            with guard.configured(
+                compile_timeout=20.0, dispatch_timeout=0.4,
+                max_retries=0,
+            ):
+                with faults.inject("hang:inf@r0", hang_seconds=2.0):
+                    futs = [
+                        eng.submit(ResidualsRequest(
+                            par=p, toas=t,
+                        ))
+                        for p, t in (pulsars * 2)
+                    ]
+                    # every request completes on surviving replicas
+                    for f in futs:
+                        resp = f.result(timeout=300)
+                        assert resp.replica != "r0"
+                    _wait_for(
+                        lambda: eng.pool.replica(0).state
+                        == QUARANTINED,
+                        20, "r0 quarantine",
+                    )
+                    # probes run while the fault is armed and keep
+                    # failing: r0 stays quarantined
+                    p0 = obs_metrics.counter(
+                        "serve.fabric.probes"
+                    ).value
+                    _wait_for(
+                        lambda: obs_metrics.counter(
+                            "serve.fabric.probes"
+                        ).value > p0,
+                        20, "a canary probe attempt",
+                    )
+                    assert eng.pool.replica(0).state == QUARANTINED
+                # faults cleared: the canary passes and r0 re-admits
+                _wait_for(
+                    lambda: eng.pool.replica(0).state == LIVE,
+                    30, "r0 re-admission",
+                )
+            assert (
+                obs_metrics.counter("serve.fabric.quarantines").value
+                > q0
+            )
+            assert eng.stats()["fabric"]["readmits"] >= 1
+            assert eng.stats()["fabric"]["reroutes"] >= 1
+            # the cycle is observable in the flight report: always-on
+            # fabric counters + the recorded state-transition events
+            report = obs_export.flight_report()
+            assert "quarantines" in report and "readmits" in report
+            assert "replica-state" in report
+            # a re-admitted replica serves again
+            r2 = eng.submit(
+                ResidualsRequest(par=par, toas=toas)
+            ).result(timeout=300)
+            assert np.array_equal(r2.residuals_s, r.residuals_s)
+    finally:
+        eng.close(timeout=60)
+        _join_guard_threads()
+
+
+def test_nan_pinned_to_one_replica_quarantines_and_recovers(pulsars):
+    eng = TimingEngine(
+        max_batch=2, max_wait_ms=1.0, inflight=1, replicas=3,
+        quarantine_n=1, probe_ms=50, max_queue=64,
+    )
+    try:
+        par, toas = pulsars[1]
+        warm = eng.submit(
+            FitRequest(par=par, toas=toas, maxiter=2)
+        ).result(timeout=300)
+        assert warm.replica == "r0"
+        with faults.inject("nan:inf@r0"):
+            futs = [
+                eng.submit(FitRequest(par=p, toas=t, maxiter=2))
+                for p, t in (pulsars * 2)
+            ]
+            for f in futs:
+                resp = f.result(timeout=300)
+                # the poisoned batch re-routed: responses are real
+                assert resp.replica != "r0"
+                assert np.isfinite(resp.chi2)
+            _wait_for(
+                lambda: eng.pool.replica(0).state == QUARANTINED,
+                20, "r0 quarantine under NaN injection",
+            )
+            # the canary's validator is replica-tagged too: injected
+            # NaN blocks re-admission while armed
+            assert not eng.pool.replica(0).probe()
+        _wait_for(
+            lambda: eng.pool.replica(0).state == LIVE,
+            30, "r0 re-admission after NaN cleared",
+        )
+        again = eng.submit(
+            FitRequest(par=par, toas=toas, maxiter=2)
+        ).result(timeout=300)
+        assert again.chi2 == warm.chi2
+    finally:
+        eng.close(timeout=60)
+
+
+# -- placement parity -----------------------------------------------------
+def _stream(eng, pulsars):
+    """One deterministic request stream: wave-synchronized so both
+    fabrics assemble identical batches (incl. padded buckets) and only
+    PLACEMENT differs."""
+    waves = [
+        [("residuals", 0), ("residuals", 1), ("residuals", 2)],
+        [("fit", 0), ("fit", 1), ("fit", 2)],
+        [("residuals", 1)],
+        [("fit", 2)],
+        [("residuals", 2), ("residuals", 0)],
+    ]
+    out = []
+    for wave in waves:
+        futs = []
+        for op, i in wave:
+            par, toas = pulsars[i]
+            req = (
+                ResidualsRequest(par=par, toas=toas)
+                if op == "residuals"
+                else FitRequest(par=par, toas=toas, maxiter=2)
+            )
+            futs.append(eng.submit(req))
+        out.extend(f.result(timeout=300) for f in futs)
+    return out
+
+
+def test_parity_1_vs_4_replica_fabric(pulsars):
+    """Identical request stream through a 1-replica and a 4-replica
+    fabric: bitwise-identical responses per request — placement must
+    not change numerics (ISSUE 5 parity gate)."""
+
+    def burst(eng):
+        # saturate (inflight=1) so the 4-replica fabric SPILLS the
+        # session groups across its pool before the measured stream
+        futs = [
+            eng.submit(FitRequest(
+                par=pulsars[i % 3][0], toas=pulsars[i % 3][1],
+                maxiter=2,
+            ))
+            for i in range(16)
+        ] + [
+            eng.submit(ResidualsRequest(
+                par=pulsars[i % 3][0], toas=pulsars[i % 3][1],
+            ))
+            for i in range(16)
+        ]
+        for f in futs:
+            f.result(timeout=300)
+
+    kw = dict(max_batch=4, max_wait_ms=100.0, inflight=1,
+              max_queue=128)
+    with TimingEngine(replicas=1, **kw) as e1:
+        burst(e1)
+        out1 = _stream(e1, pulsars)
+    with TimingEngine(replicas=4, affinity=4, **kw) as e4:
+        burst(e4)
+        out4 = _stream(e4, pulsars)
+        spills = e4.stats()["fabric"]["spills"]
+    # the 4-replica fabric really spread the groups (spills happened
+    # and the stream itself was served by more than one device)
+    assert spills >= 1
+    assert len({r.replica for r in out4}) >= 2
+    assert {r.replica for r in out1} == {"r0"}
+    for a, b in zip(out1, out4):
+        assert type(a) is type(b)
+        assert a.ntoa == b.ntoa and a.bucket == b.bucket
+        assert a.batch_size == b.batch_size
+        if hasattr(a, "residuals_s"):
+            np.testing.assert_array_equal(a.residuals_s, b.residuals_s)
+        else:
+            np.testing.assert_array_equal(a.deltas, b.deltas)
+            np.testing.assert_array_equal(
+                a.uncertainties, b.uncertainties
+            )
+            assert a.fitted_par == b.fitted_par
+        assert a.chi2 == b.chi2
+
+
+# -- drain guarantees -----------------------------------------------------
+def test_total_outage_drain_resolves_everything_typed(pulsars):
+    """All replicas wedged: every submitted future still resolves to a
+    typed error (guard trip or RequestRejected) and close() returns in
+    bounded time — never a hang (ISSUE 5 acceptance)."""
+    par, toas = pulsars[0]
+    with guard.configured(
+        compile_timeout=0.4, dispatch_timeout=0.4, max_retries=0
+    ):
+        with faults.inject("hang:inf@serve:", hang_seconds=2.0):
+            eng = TimingEngine(
+                max_batch=1, max_wait_ms=0.0, inflight=1, replicas=2,
+                quarantine_n=1, probe_ms=50, max_queue=32,
+            )
+            t0 = time.monotonic()
+            futs = [
+                eng.submit(ResidualsRequest(par=par, toas=toas))
+                for _ in range(5)
+            ]
+            eng.close(timeout=60)
+            for f in futs:
+                with pytest.raises(
+                    (GuardTimeout, RetriesExhausted, RequestRejected,
+                     PintTpuNumericsError)
+                ):
+                    f.result(timeout=30)
+            wall = time.monotonic() - t0
+    assert wall < 45.0
+    _join_guard_threads()

@@ -43,6 +43,16 @@ Rules (syntactic, like the scalarmath linter):
    ``note_trace``.  Rule 1 already forbids bare ``jax.jit`` anywhere
    under ``serve/``.
 
+4. fabric chokepoints (PR 5) — the multi-device serving fabric's hot
+   points must stay observable: ``Router.route``
+   (serve/fabric/router.py) and ``Replica.submit``
+   (serve/fabric/replica.py) must open recorder spans, every health
+   transition must funnel through ``Replica._set_state`` and emit a
+   recorder event, and the canary probe (``Replica._make_canary``)
+   must dispatch through ``dispatch_guard`` — a silent quarantine or
+   an unguarded probe is exactly the blindness rules 1-3 exist to
+   prevent, one layer up.
+
 Run: ``python tools/lint_obs.py [paths...]`` (default: pint_tpu/).
 Exit status 1 when findings exist.  Wired into tier-1 as
 tests/test_lint_obs.py.
@@ -192,8 +202,29 @@ def check_chokepoints(pkg_root) -> list:
          "serve's dispatch chokepoint must stay guarded and count "
          "(re)traces"),
     )
-    if (pkg_root / "serve").is_dir():
-        for rel, qual, needles, why in serve_checks:
+    # rule 4: fabric chokepoints (skipped when the synthetic package
+    # has no fabric — unit-test fixtures predating PR 5)
+    fabric_checks = (
+        ("serve/fabric/router.py", "Router.route", ("TRACER.span",),
+         "fabric routing decisions must open recorder spans"),
+        ("serve/fabric/replica.py", "Replica.submit", ("TRACER.span",),
+         "the replica admission edge must open recorder spans"),
+        ("serve/fabric/replica.py", "Replica._set_state",
+         ("TRACER.event",),
+         "replica health transitions (quarantine/readmit) must emit "
+         "recorder events"),
+        ("serve/fabric/replica.py", "Replica._make_canary",
+         ("dispatch_guard(",),
+         "the canary probe must dispatch through the guarded "
+         "chokepoint"),
+    )
+    for checks, subdir in (
+        (serve_checks, pkg_root / "serve"),
+        (fabric_checks, pkg_root / "serve" / "fabric"),
+    ):
+        if not subdir.is_dir():
+            continue
+        for rel, qual, needles, why in checks:
             path = pkg_root / rel
             src = path.read_text()
             for miss in _fn_source_has(
