@@ -309,7 +309,15 @@ def _serve_block():
     compiles at most once per (composition, bucket, capacity) — a
     spill's first compile is a fresh wrapper, not a retrace), and on
     accelerators the 4-replica aggregate throughput must reach >= 2x
-    the single-replica rung."""
+    the single-replica rung.
+
+    ISSUE 6 adds the POPULATION figures (_population_probe): 1000
+    distinct pars of one composition served through composition-keyed
+    sessions.  Gates (all backends): zero XLA compiles while serving
+    the full distinct population after the capacity-ladder warm
+    (exactly one compile per (bucket, capacity), never per par), zero
+    steady-state retraces, and distinct-par steady throughput >= 0.8x
+    the single-par figure."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -400,6 +408,120 @@ def _serve_block():
         finally:
             reng.close()
 
+    # population probe (ISSUE 6): 1000 distinct pars of ONE
+    # composition — after the batch-capacity ladder is warm, serving
+    # the whole population must add ZERO XLA compiles (sessions are
+    # composition-keyed; per-par state rides the stacked pulsar axis
+    # as runtime arguments), sustain zero steady-state retraces, and
+    # hold >= 0.8x the single-par steady throughput.  Cold-record
+    # admission (host par parses) is reported unGated as
+    # cold_admit_rps — it is pure host work by construction (the
+    # compile gate is what pins that down).
+    def _population_probe():
+        from pint_tpu.simulation import make_population
+
+        npop = 1000
+        ppars, ptoas = make_population(
+            "PSR POPB\nF0 169.5 1\nF1 -1.8e-15 1\nPEPOCH 55000\n"
+            "DM 6.17 1\n",
+            npop, ntoa=48, seed=23, start_mjd=54000.0,
+            end_mjd=56000.0, iterations=1,
+        )
+
+        def preqs(distinct):
+            return [
+                FitRequest(
+                    par=ppars[j] if distinct else ppars[0],
+                    toas=ptoas, maxiter=2,
+                )
+                for j in range(npop)
+            ]
+
+        # replicas=1: a saturated-burst SPILL compiles legitimately on
+        # the spilled-to replica (PR 5 semantics, covered by the
+        # replica probe below) and would read as a spurious per-par
+        # compile here — one replica isolates the composition-keying
+        # claim
+        peng = TimingEngine(
+            max_batch=16, max_wait_ms=5.0, inflight=4,
+            max_queue=2 * npop, replicas=1,
+        )
+        try:
+            wave = 1
+            while wave <= 16:  # the one compile per (bucket, capacity)
+                for f in peng.submit_many([
+                    FitRequest(par=ppars[0], toas=ptoas, maxiter=2)
+                    for _ in range(wave)
+                ]):
+                    f.result(timeout=3600)
+                wave <<= 1
+            def timed(distinct):
+                t0 = time.perf_counter()
+                for f in peng.submit_many(preqs(distinct)):
+                    f.result(timeout=3600)
+                return npop / (time.perf_counter() - t0)
+
+            # single-par steady figure (best of 2: each phase is a
+            # ~2.5 s window and the ratio gate below must not trip on
+            # transient host noise)
+            single_rps = max(timed(False), timed(False))
+            # cold-record admission of the whole distinct population:
+            # host parses only — the compile counter must not move
+            traces0 = obs_metrics.counter("compile.traces").value
+            admit_rps = timed(True)
+            pop_compiles = (
+                obs_metrics.counter("compile.traces").value - traces0
+            )
+            # steady distinct-par figure (records warm, every request
+            # a DIFFERENT par stacked on the pulsar axis)
+            peng.reset_stats()
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            pop_rps = max(timed(True), timed(True))
+            pop_retraces = (
+                obs_metrics.counter("compile.recompiles").value - rec0
+            )
+            pst = peng.stats()
+        finally:
+            peng.close()
+        if pop_compiles:
+            raise PintTpuError(
+                f"{pop_compiles} XLA compile(s) while serving {npop} "
+                "distinct pars of one warmed composition — sessions "
+                "must be composition-keyed (exactly one compile per "
+                "(bucket, capacity), never per par; docs/serving.md)"
+            )
+        if pop_retraces:
+            raise PintTpuError(
+                f"{pop_retraces} steady-state XLA recompile(s) across "
+                f"{npop} distinct-par serving — the population "
+                "zero-retrace invariant is broken (docs/serving.md)"
+            )
+        ratio = pop_rps / single_rps
+        if ratio < 0.8:
+            raise PintTpuError(
+                f"{npop} distinct-par serving sustained only "
+                f"{ratio:.2f}x the single-par steady throughput "
+                "(>= 0.8x required: per-par state must ride the "
+                "stacked dispatch as runtime arguments, not rebuild "
+                "host/compile state per request; docs/serving.md)"
+            )
+        return {
+            "distinct_pars": npop,
+            "requests_per_s": round(pop_rps, 2),
+            "single_par_requests_per_s": round(single_rps, 2),
+            "throughput_ratio": round(ratio, 3),
+            "cold_admit_rps": round(admit_rps, 2),
+            "compiles_after_warm": pop_compiles,
+            "steady_retraces": pop_retraces,
+            "stack_distinct_mean": (
+                pst["population"]["stack_distinct_mean"]
+            ),
+            "pars_live": pst["population"]["pars"],
+            "compositions": pst["population"]["compositions"],
+        }
+
+    population = _population_probe()
+
     r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
     r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
     scaling = r4_rps / r1_rps
@@ -447,6 +569,7 @@ def _serve_block():
         "serial_requests_per_s": round(serial_rps, 2),
         "speedup_vs_serial": round(speedup, 2),
         "steady_retraces": retraces,
+        "population": population,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
             tag: rs["batches"]

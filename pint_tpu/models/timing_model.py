@@ -188,6 +188,18 @@ class TimingModel:
                 masks.update(c.extra_masks(toas))
         return masks
 
+    def has_tzr_anchor(self) -> bool:
+        """True when the model carries an AbsPhase TZR anchor — such a
+        model's compiled kernels embed the TZR bundle as trace
+        scaffolding, so serving sessions never stack it with other
+        pars (serve/session.py::composition_key folds the par hash
+        in)."""
+        absph = self.components.get("AbsPhase")
+        return (
+            absph is not None
+            and absph.params["TZRMJD"].value is not None
+        )
+
     def compile(self, toas, subtract_mean: bool = True) -> "CompiledModel":
         bundle = make_bundle(toas, self._build_masks(toas))
         tzr_bundle = None
@@ -276,13 +288,42 @@ class TimingModel:
         )
 
 
-def split_ref_runtime(ref: dict):
+def reference_values(model: "TimingModel") -> dict:
+    """Reference (internal-unit) values for every set parameter of the
+    model — the ``x = 0`` anchor of a CompiledModel's delta vector.
+    Extracted from CompiledModel.__init__ so the serving layer's
+    per-par records (serve/session.py::ParRecord) can derive a fresh
+    par's runtime references WITHOUT building a prototype
+    CompiledModel: the values depend only on the host model, never on
+    a TOA set."""
+    ref: dict[str, object] = {}
+    for c in model._ordered_components():
+        for n, p in c.params.items():
+            if p.value is None:
+                continue
+            if isinstance(p, MJDParameter):
+                day, sec = p.internal()
+                ref[n] = (day, sec)
+            else:
+                ref[n] = p.internal()
+    return ref
+
+
+def split_ref_runtime(ref: dict, device: bool = True):
     """Split a reference dict into (numeric device pytree, static host
     dict).  The numeric leaves are what commit() rebases and what the
     PTA batch stacks per pulsar; strings/bools stay static (they shape
     the trace).  Shared by CompiledModel.jit (single model — the
     numeric part rides every call as runtime arguments) and
     parallel/pta.py::_device_ref (vmapped per-pulsar stacks).
+
+    ``device=False`` keeps the numeric leaves HOST numpy f64 scalars
+    (identical values and pytree structure — DD still flattens to
+    (hi, lo)): the serving batcher np.stack's per-par reference
+    pytrees on a leading pulsar axis before anything crosses to the
+    device, and jnp leaf placement here would cost one axon transfer
+    per leaf per admitted par instead of one bulk transfer per
+    dispatched batch (the make_bundle ``as_numpy`` rationale).
 
     CONTRACT (ADVICE r5): every numeric ref must be VALUE-like — a
     quantity kernels consume through ``_pdict`` as an f64 operand.
@@ -297,6 +338,7 @@ def split_ref_runtime(ref: dict):
     rejects the tell-tale case — a bare Python/numpy integer ref —
     loudly at split time instead.
     """
+    f64 = jnp.float64 if device else np.float64
     num, static = {}, {}
     for n, v in ref.items():
         if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
@@ -307,21 +349,20 @@ def split_ref_runtime(ref: dict):
                 "Parameters — see split_ref_runtime's contract)"
             )
         if isinstance(v, HostDD):
-            num[n] = DD(jnp.float64(float(v.hi)), jnp.float64(float(v.lo)))
+            num[n] = DD(f64(float(v.hi)), f64(float(v.lo)))
         elif (
             isinstance(v, tuple) and len(v) == 2
             and isinstance(v[1], HostDD)
         ):
             day, sec = v
             num[n] = (
-                jnp.float64(float(day)),
-                DD(jnp.float64(float(sec.hi)),
-                   jnp.float64(float(sec.lo))),
+                f64(float(day)),
+                DD(f64(float(sec.hi)), f64(float(sec.lo))),
             )
         elif isinstance(v, tuple):
-            num[n] = tuple(jnp.float64(float(e)) for e in v)
+            num[n] = tuple(f64(float(e)) for e in v)
         elif isinstance(v, (float, int)) and not isinstance(v, bool):
-            num[n] = jnp.float64(v)
+            num[n] = f64(v)
         else:
             static[n] = v
     return num, static
@@ -348,16 +389,7 @@ class CompiledModel:
         self.free_names = model.free_params
         self._index = {n: i for i, n in enumerate(self.free_names)}
         # reference (internal-unit) values for every set parameter
-        self.ref: dict[str, object] = {}
-        for c in model._ordered_components():
-            for n, p in c.params.items():
-                if p.value is None:
-                    continue
-                if isinstance(p, MJDParameter):
-                    day, sec = p.internal()
-                    self.ref[n] = (day, sec)
-                else:
-                    self.ref[n] = p.internal()
+        self.ref: dict[str, object] = reference_values(model)
         self.track_mode = (
             "use_pulse_numbers"
             if not np.all(np.isnan(np.asarray(bundle.pulse_number)))

@@ -225,6 +225,31 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
                 f"{k}={v}" for k, v in sorted(fabric_bits.items())
             )
         )
+    # per-composition population breakdown (ISSUE 6): pars joined,
+    # batches dispatched, XLA compiles — per composition id
+    comp_bits = sorted(
+        (k.split(".")[2], k.split(".", 3)[3], v)
+        for k, v in snap.items()
+        if k.startswith("serve.composition.") and v not in (None, 0)
+    )
+    if comp_bits:
+        pop = {
+            k: snap.get(f"serve.session.{k}")
+            for k in ("pars_served", "pars", "compositions")
+        }
+        pop_txt = "  ".join(
+            f"{k}={v}" for k, v in pop.items() if v not in (None, 0)
+        )
+        lines.append(f"population: {pop_txt}".rstrip())
+        per = defaultdict(list)
+        for cid, field, v in comp_bits:
+            per[cid].append(f"{field}={v}")
+        lines.append(
+            "compositions: " + "  ".join(
+                f"{cid}[{' '.join(sorted(fields))}]"
+                for cid, fields in sorted(per.items())
+            )
+        )
     replica_bits = sorted(
         (k.split(".")[2], k.split(".", 3)[3], v)
         for k, v in snap.items()

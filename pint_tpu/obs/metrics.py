@@ -62,9 +62,24 @@ serve.batches                   counter  dispatched micro-batches
 serve.batch_occupancy           histo    live requests per batch
 serve.latency_ms                histo    submit->result wall time
 serve.queue_depth               gauge    admission-queue depth
-serve.session.hits/misses/      counter  session LRU traffic
-  evictions
-serve.polyco.hits/misses        counter  per-session polyco spans
+serve.session.hits/misses/      counter  composition-session LRU
+  evictions                              traffic (compiled layer)
+serve.session.par_hits/         counter  per-par record LRU traffic
+  par_misses/par_evictions               (lightweight host layer)
+serve.session.pars_served       counter  distinct pars ever admitted
+serve.session.pars              gauge    live par records
+serve.session.compositions      gauge    live distinct compositions
+serve.stack.distinct_pars       histo    DISTINCT pars vmapped per
+                                         dispatched batch (stack
+                                         occupancy, ISSUE 6)
+serve.composition.C.pars/       counter  per-composition ledger (C =
+  batches/compiles                       short composition id): pars
+                                         joined, batches dispatched,
+                                         XLA traces — compiles must
+                                         stay at one per (bucket,
+                                         capacity) per replica no
+                                         matter how many pars join
+serve.polyco.hits/misses        counter  per-par-record polyco spans
 serve.fabric.routes/reroutes    counter  routing decisions / failed
                                          -batch re-routes
 serve.fabric.spills             counter  affinity-set growth under

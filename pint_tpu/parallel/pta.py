@@ -76,8 +76,14 @@ def _device_ref(cm):
     """Split a CompiledModel's host reference values into (numeric
     device pytree, static host dict).  The numeric part is what differs
     per pulsar and gets stacked/vmapped; strings/bools stay static.
-    One splitter serves both this and the single-model runtime-ref
-    arguments (models/timing_model.py::split_ref_runtime)."""
+    One splitter serves this, the single-model runtime-ref arguments,
+    AND the serving engine's per-par records (serve/session.py::
+    ParRecord uses the ``device=False`` host variant so population
+    admission never touches the device) — see
+    models/timing_model.py::split_ref_runtime.  This shared trace
+    surface is why a fresh par can join an existing stacked serving
+    kernel without re-tracing: the kernels have always traced with
+    these leaves as (vmapped) runtime values."""
     from pint_tpu.models.timing_model import split_ref_runtime
 
     return split_ref_runtime(cm.ref)

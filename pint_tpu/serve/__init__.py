@@ -5,12 +5,15 @@ Four layers, each its own module:
 - :mod:`pint_tpu.serve.api` — typed request/response records for the
   three core operations (residuals, WLS/GLS fit, polyco
   phase-predict) with per-request deadlines and priorities;
-- :mod:`pint_tpu.serve.session` — the LRU session cache of compiled
-  models keyed by (par-content hash, accel mode, shape bucket),
-  warm-started from the persistent compile/ingest caches;
+- :mod:`pint_tpu.serve.session` — the two-layer serving-state cache
+  (ISSUE 6): lightweight per-par records (host parse only) and
+  compiled sessions keyed by (composition key, accel mode, shape
+  bucket) — N distinct pars of one composition share one compiled
+  session, warm-started from the persistent compile/ingest caches;
 - :mod:`pint_tpu.serve.batcher` — the shape-bucketed dynamic
   micro-batcher (power-of-two TOA buckets + batch capacities: zero
-  XLA retraces at steady state);
+  XLA retraces at steady state, distinct pars stacked on the vmapped
+  pulsar axis);
 - :mod:`pint_tpu.serve.engine` — the async dispatch pipeline (bounded
   queue, load-shedding backpressure, >1 batch in flight across the
   ~85 ms axon tunnel round-trip).

@@ -2,8 +2,12 @@
 
 Reference parity: none — TPU-service infrastructure.  Pending requests
 accumulate in groups keyed by (operation, composition key, shape
-bucket, op parameters); a group flushes when it reaches the max batch
-size or when its oldest member has waited ``max_wait`` (the classic
+bucket, op parameters) — the par hash is deliberately ABSENT (ISSUE
+6): requests with *different pars* of one composition coalesce into
+one group and dispatch as one vmapped pulsar-axis stack, each row
+carrying its own padded bundle + per-par reference pytree as runtime
+arguments.  A group flushes when it reaches the max batch size or
+when its oldest member has waited ``max_wait`` (the classic
 dynamic-batching contract: bounded added latency, amortized ~85 ms
 axon dispatches).  Stacking is HOST-side numpy throughout — each
 request's padded bundle/reference pytree is np.stack'ed on a leading

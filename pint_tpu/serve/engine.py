@@ -33,10 +33,17 @@ Backpressure: each replica caps queued+inflight batches; when the
 routed replica's queue is full the collector blocks, the admission
 queue fills, and new submissions shed — typed rejections at the edge.
 
+Sessions are composition-keyed (ISSUE 6): the collector resolves each
+request into a lightweight per-par record (host parse) plus a shared
+composition session (compiled once per (composition, bucket)), so
+requests with DIFFERENT pars of one composition stack into one
+vmapped dispatch — N distinct-par clients cost one XLA compile per
+(bucket, batch capacity), total.
+
 All engine/serving knobs have ``PINT_TPU_SERVE_*`` env defaults
 (documented in docs/serving.md): MAX_QUEUE, MAX_BATCH, MAX_WAIT_MS,
-INFLIGHT, SESSIONS, MIN_BUCKET, REPLICAS, AFFINITY, QUARANTINE_N,
-PROBE_MS.
+INFLIGHT, SESSIONS, PARS, MIN_BUCKET, REPLICAS, AFFINITY,
+QUARANTINE_N, PROBE_MS.
 """
 
 from __future__ import annotations
@@ -62,13 +69,15 @@ from pint_tpu.fitting.base import noffset
 class _Pending:
     """One admitted request flowing through the pipeline."""
 
-    __slots__ = ("req", "future", "t_submit", "session", "bundle")
+    __slots__ = ("req", "future", "t_submit", "session", "record",
+                 "bundle")
 
     def __init__(self, req, future, t_submit):
         self.req = req
         self.future = future
         self.t_submit = t_submit
-        self.session = None
+        self.session = None  # composition Session (compiled layer)
+        self.record = None  # per-par ParRecord (lightweight layer)
         self.bundle = None  # padded host-numpy TOABundle
 
 
@@ -132,6 +141,9 @@ class TimingEngine:
         self._m_rejected = m.counter("serve.rejected")
         self._m_batches = m.counter("serve.batches")
         self._m_occupancy = m.histogram("serve.batch_occupancy")
+        # stack occupancy (ISSUE 6): DISTINCT pars vmapped per batch —
+        # the population-serving figure next to raw batch occupancy
+        self._m_stack_pars = m.histogram("serve.stack.distinct_pars")
         self._m_latency = m.histogram("serve.latency_ms", unit="ms")
         self._m_depth = m.gauge("serve.queue_depth")
         self._collector = threading.Thread(
@@ -227,10 +239,24 @@ class TimingEngine:
             if req.op == "predict":
                 self._predict(p)
                 return None
-            sess = self.sessions.get_or_create(
-                req.par, req.toas, self.min_bucket
+            from pint_tpu.toas.bundle import make_bundle
+            from pint_tpu.toas.ingest import ingest_for_model
+
+            # per-par layer first (host parse at worst), then the
+            # request's own host-numpy bundle — built exactly once: it
+            # keys the composition AND becomes the stacked operand
+            rec = self.sessions.record_for(req.par)
+            if req.toas.t_tdb is None:
+                ingest_for_model(req.toas, rec.model)
+            nb = make_bundle(
+                req.toas, rec.model._build_masks(req.toas),
+                as_numpy=True,
+            )
+            sess = self.sessions.session_for(
+                rec, req.toas, nb, self.min_bucket
             )
             p.session = sess
+            p.record = rec
             if req.op == "fit":
                 if req.method == "wls" and sess.cm.has_correlated_errors:
                     raise PintTpuError(
@@ -253,15 +279,6 @@ class TimingEngine:
                 )
             else:
                 raise PintTpuError(f"unknown serve op {req.op!r}")
-            from pint_tpu.toas.bundle import make_bundle
-            from pint_tpu.toas.ingest import ingest_for_model
-
-            if req.toas.t_tdb is None:
-                ingest_for_model(req.toas, sess.model)
-            nb = make_bundle(
-                req.toas, sess.model._build_masks(req.toas),
-                as_numpy=True,
-            )
             p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
             return self._batcher.add(
                 key, p, time.monotonic(), req.priority
@@ -283,10 +300,10 @@ class TimingEngine:
         if self._expired(p):
             return
         with TRACER.span("serve:predict", "serve", n=np.size(req.mjds)):
-            text = smod.par_text(req.par)
-            phash = smod.par_content_hash(text)
-            sess = self._predict_session(text, phash)
-            pc, cached = sess.polycos_for(req)
+            # prediction is pure per-par state: the record's model +
+            # polyco cache (no composition session, no device batch)
+            rec = self.sessions.record_for(req.par)
+            pc, cached = rec.polycos_for(req)
             mjds = np.atleast_1d(np.asarray(req.mjds, dtype=np.float64))
             ints, fracs = pc.eval_abs_phase(mjds)
             freq = pc.eval_spin_freq(mjds)
@@ -297,22 +314,6 @@ class TimingEngine:
         ))
         self._m_completed.inc()
         self._note_latency(p)
-
-    def _predict_session(self, text: str, phash: str):
-        """Model-only session for polyco prediction (no TOAs): cached
-        in the same LRU under a predict-specific key."""
-        key = (phash, "predict")
-        with self.sessions._lock:
-            s = self.sessions._sessions.get(key)
-            if s is not None:
-                self.sessions._sessions.move_to_end(key)
-                self.sessions._hits.inc()
-                return s
-        self.sessions._misses.inc()
-        s = _PredictSession(text)
-        with self.sessions._lock:
-            self.sessions._sessions[key] = s
-        return s
 
     def _expired(self, p: _Pending) -> bool:
         dl = p.req.deadline_s
@@ -357,15 +358,30 @@ class TimingEngine:
             self._dispatch(work)
 
     def _assemble(self, key, live) -> BatchWork:
+        """The stacked-dispatch chokepoint (tools/lint_obs.py rule 5):
+        assemble the pulsar-axis stack — every live request's padded
+        bundle + per-par reference pytree, DISTINCT pars included —
+        as the batch's runtime operands.  Pad slots repeat the first
+        live request, so padded rows are bitwise copies of a served
+        row and stacking stays numerics-neutral."""
         sess = live[0].session
         cap = bmod.capacity_for(len(live), self.max_batch)
         pad = cap - len(live)
-        bundles = [p.bundle for p in live] + [live[0].bundle] * pad
-        refs = [p.session.refnum for p in live] \
-            + [live[0].session.refnum] * pad
-        bstack = bmod.stack_trees(bundles)
-        rstack = bmod.stack_trees(refs)
-        xs = np.zeros((cap, sess.cm.nfree))
+        distinct = len({p.record.par_hash for p in live})
+        with TRACER.span(
+            "serve:stack", "serve", op=key[0], n=len(live), cap=cap,
+            distinct_pars=distinct, composition=sess.cid,
+        ):
+            bundles = [p.bundle for p in live] + [live[0].bundle] * pad
+            refs = [p.record.refnum for p in live] \
+                + [live[0].record.refnum] * pad
+            bstack = bmod.stack_trees(bundles)
+            rstack = bmod.stack_trees(refs)
+            xs = np.zeros((cap, sess.cm.nfree))
+        self._m_stack_pars.observe(distinct)
+        obs_metrics.counter(
+            f"serve.composition.{sess.cid}.batches"
+        ).inc()
         return BatchWork(key, live, (bstack, rstack, xs), sess, cap)
 
     def _dispatch(self, work: BatchWork):
@@ -491,7 +507,11 @@ class TimingEngine:
             / np.outer(np.asarray(nrm[i]), np.asarray(nrm[i]))
         )[no:, no:]
         sigmas = np.sqrt(np.diag(cov))
-        fitted = sess.commit_clone(x[i], sigmas)
+        # commit against the REQUEST's own par record — the session is
+        # composition-shared and holds no per-par identity
+        fitted = p.record.commit_clone(
+            sess.cm.free_names, x[i], sigmas
+        )
         return FitResponse(
             request_id=req.request_id,
             names=tuple(sess.cm.free_names),
@@ -521,6 +541,7 @@ class TimingEngine:
             return round(lats[min(len(lats) - 1, int(q * len(lats)))], 3)
 
         occ = self._m_occupancy.value
+        stack = self._m_stack_pars.value
         mc = obs_metrics.counter
         per_replica = self.pool.stats()
         return {
@@ -539,6 +560,21 @@ class TimingEngine:
             "kernels": sum(
                 r["kernels"] for r in per_replica.values()
             ),
+            # population serving (ISSUE 6): the lightweight per-par
+            # layer vs the compiled composition layer, plus how many
+            # DISTINCT pars actually stack per dispatched batch
+            "population": {
+                "pars": self.sessions.npars,
+                "pars_served": mc("serve.session.pars_served").value,
+                "par_evictions": mc(
+                    "serve.session.par_evictions"
+                ).value,
+                "compositions": self.sessions.ncompositions,
+                "stack_distinct_mean": (
+                    None if not stack["count"]
+                    else round(stack["sum"] / stack["count"], 3)
+                ),
+            },
             "fabric": {
                 "replicas": self.pool.size,
                 "live": len(self.pool.live),
@@ -579,17 +615,3 @@ class TimingEngine:
     def __exit__(self, *exc):
         self.close()
         return False
-
-
-class _PredictSession:
-    """Minimal model-only session for polyco prediction requests."""
-
-    _POLYCO_CACHE = smod.Session._POLYCO_CACHE
-    polycos_for = smod.Session.polycos_for
-
-    def __init__(self, text: str):
-        from pint_tpu.models.builder import get_model
-
-        self.par = text
-        self.model = get_model(text)
-        self._polycos = collections.OrderedDict()

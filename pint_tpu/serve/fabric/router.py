@@ -3,10 +3,13 @@
 Reference parity: none — TPU-service infrastructure.  Placement is
 keyed by the batcher's GROUP key (operation, composition key, shape
 bucket, op parameters) — the exact identity of a compiled kernel —
-NOT by the par hash alone: same-composition pars share executables
-(serve/session.py), so a brand-new par routing to the group's placed
-replica serves with ZERO fresh compiles (the steady-state invariant
-tests/test_serve.py gates).
+NEVER by a par hash: sessions themselves are composition-keyed
+(ISSUE 6, serve/session.py), so a brand-new par of a known
+composition routes to the group's sticky replica and rides its
+existing executables with ZERO fresh compiles — a whole population
+of distinct pars stays one affinity group (the steady-state
+invariants tests/test_serve.py and tests/test_serve_population.py
+gate).
 
 Policy (the continuous-batching-server shape — per-replica queues fed
 by a load-aware router):

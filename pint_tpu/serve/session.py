@@ -1,31 +1,46 @@
 """Session cache: compiled-model serving sessions, keyed and bucketed.
 
-Reference parity: none — TPU-service infrastructure.  A *session* is
-everything request execution needs that does not change per request
-for one par file: the parsed TimingModel, a prototype CompiledModel
-(trace scaffolding only — request data always rides as runtime
-arguments), the split reference pytree, the composition key that
-decides which requests may stack on the vmapped pulsar axis, and a
-small polyco cache for phase prediction.
+Reference parity: none — TPU-service infrastructure.  Since ISSUE 6
+the cache is **population-scale**: serving state is split into two
+independently-LRU'd layers so a million distinct par files cost a
+million *lightweight host records* but only one compiled session per
+model *composition*:
 
-Sessions are LRU-cached keyed by **(par-content hash, accel mode,
-shape bucket)** (the accel mode is a derived axis — fixed per backend
-per par — recorded in the key for observability; pulse-number and
-wideband structure flags ride along because they change the traced
-kernel).  A *shape bucket* is the TOA axis padded up to a power of
+- a :class:`ParRecord` is everything that is truly per-par — the
+  parsed TimingModel, the split numeric/static reference pytree
+  (host numpy: the batcher np.stack's it per flush), and a small
+  polyco cache for phase prediction.  No compiled kernels, no
+  prototype bundle: building one is a host-side parse, never an XLA
+  compile.
+- a :class:`Session` is keyed by **(composition key, accel mode,
+  shape bucket)** (the accel mode is a derived axis — fixed per
+  backend per composition — recorded for observability): the
+  prototype CompiledModel used as trace scaffolding plus the trace
+  lock.  EVERY par of the composition shares it — per-par state
+  (bundle columns, split refs, delta vectors) rides each dispatch as
+  runtime arguments stacked on the leading pulsar axis, so N distinct
+  -par clients of one composition cost exactly one XLA compile per
+  (bucket, batch capacity) — the continuous-batching invariant
+  ROADMAP item 2 names "the single biggest lever toward millions of
+  users".
+
+The :func:`composition_key` is the PTABatch compatibility contract
+precomputed; a *shape bucket* is the TOA axis padded up to a power of
 two (:func:`shape_bucket`): every request whose TOA count lands in
 the same bucket shares one set of compiled kernels, so steady-state
-serving of mixed sizes causes ZERO XLA retraces (the acceptance gate
-tests/test_serve.py and bench.py's serve block read off the PR 2
-``compile.recompiles`` counter).
+serving of mixed sizes AND mixed pars causes ZERO XLA retraces (the
+acceptance gates in tests/test_serve.py, tests/test_serve_population
+.py and bench.py's serve block read off the PR 2 ``compile.traces``
+counter).
 
-Warm starts: a cold session costs a host-side ``get_model`` +
-``model.compile`` (cheap) plus one XLA compile per kernel — which the
-persistent compile cache (runtime/compile_cache.py, on by default)
-serves from disk for previously-seen (composition, bucket, capacity)
-shapes, and file-backed TOA loads hit the persistent ingest cache
-(toas/cache.py).  A cold process therefore re-opens sessions at
-cache-hit cost, not at the ~35 s bake the pre-r6 cold path paid.
+Warm starts: a cold par costs a host-side ``get_model`` parse; a cold
+*composition* additionally costs ``model.compile`` (cheap) plus one
+XLA compile per kernel — which the persistent compile cache
+(runtime/compile_cache.py, on by default) serves from disk for
+previously-seen (composition, bucket, capacity) shapes, and
+file-backed TOA loads hit the persistent ingest cache (toas/cache.py).
+A cold process therefore re-opens sessions at cache-hit cost, not at
+the ~35 s bake the pre-r6 cold path paid.
 """
 
 from __future__ import annotations
@@ -42,7 +57,11 @@ from pint_tpu import obs as _obs
 from pint_tpu.exceptions import PintTpuError
 from pint_tpu.fitting.base import make_scan_fit_loop, noffset
 from pint_tpu.fitting.gls import default_accel_mode, gauss_newton_step
-from pint_tpu.models.timing_model import split_ref_runtime
+from pint_tpu.models.timing_model import (
+    CompiledModel,
+    reference_values,
+    split_ref_runtime,
+)
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.runtime.guard import dispatch_guard
 from pint_tpu.timebase.hostdd import HostDD
@@ -78,19 +97,63 @@ def par_content_hash(par) -> str:
     return compute_hash(par_text(par))[:16]
 
 
-def composition_key(cm, static_ref, phash: str) -> tuple:
-    """Hashable structural fingerprint deciding which sessions'
-    requests may stack on the vmapped pulsar axis (the PTABatch
-    compatibility rules, precomputed): identical component stacks,
-    free-parameter layouts, mask/noise-basis column structure, static
-    (string/bool) references, and numeric-reference pytree structure.
-    Models carrying a TZR anchor fold the par hash in — the TZR bundle
-    is trace scaffolding of the prototype, so such sessions only batch
-    with themselves."""
-    T, phi = jax.eval_shape(
-        cm.noise_basis_or_empty, jnp.zeros(cm.nfree)
+#: process-wide cache of eval_shape'd noise-basis structures, keyed by
+#: everything that can legally shape a basis (see _basis_struct) —
+#: eval_shape is ~5 ms of host tracing and dominated the cold-par
+#: admission path at population scale (ISSUE 6: a 1000-par wave spent
+#: more time abstractly re-tracing identical noise stacks than
+#: serving).  Bounded by the number of distinct structures ever seen.
+_BASIS_STRUCT_CACHE: dict = {}
+
+
+def _basis_struct(cm) -> tuple:
+    """(T.shape[1:], phi.shape) of the model's stacked noise basis,
+    via jax.eval_shape with a structure-keyed cache.  Basis shapes are
+    static at trace time, so they can only depend on host-visible
+    structure: the noise component stack and its host parameter
+    values (the TNREDC pattern — shape-like knobs are read straight
+    off host Parameters, split_ref_runtime's contract), the
+    precomputed basis/mask column structure riding in bundle.masks,
+    and the wideband flag.  All of that is the cache key, so two pars
+    differing only in pulse-timing values share one abstract trace."""
+    key = (
+        tuple(
+            (
+                type(c).__name__,
+                tuple(sorted(
+                    (n, repr(p.value)) for n, p in c.params.items()
+                )),
+            )
+            for c in cm.model.noise_components
+        ),
+        tuple(sorted(
+            (k, tuple(v.shape[1:])) for k, v in cm.bundle.masks.items()
+        )),
+        cm.bundle.dm_meas is not None,
+        cm.nfree,
     )
-    num, _ = split_ref_runtime(cm.ref)
+    hit = _BASIS_STRUCT_CACHE.get(key)
+    if hit is None:
+        T, phi = jax.eval_shape(
+            cm.noise_basis_or_empty, jnp.zeros(cm.nfree)
+        )
+        hit = _BASIS_STRUCT_CACHE[key] = (
+            tuple(T.shape[1:]), tuple(phi.shape)
+        )
+    return hit
+
+
+def composition_key(cm, refnum, static_ref, phash: str,
+                    has_tzr: bool) -> tuple:
+    """Hashable structural fingerprint deciding which pars' requests
+    may stack on the vmapped pulsar axis (the PTABatch compatibility
+    rules, precomputed): identical component stacks, free-parameter
+    layouts, mask/noise-basis column structure, static (string/bool)
+    references, and numeric-reference pytree structure.  Every field
+    is TOA-count independent (``shape[1:]`` throughout), so one key
+    covers every bucket.  Models carrying a TZR anchor fold the par
+    hash in — the TZR bundle is trace scaffolding of the prototype,
+    so such sessions only batch with themselves."""
     key = (
         tuple(type(c).__name__ for c in cm.model._ordered_components()),
         tuple(cm.free_names),
@@ -100,59 +163,87 @@ def composition_key(cm, static_ref, phash: str) -> tuple:
             (k, tuple(v.shape[1:])) for k, v in cm.bundle.masks.items()
         )),
         tuple(sorted(static_ref.items())),
-        jax.tree_util.tree_structure(num),
-        (tuple(T.shape[1:]), tuple(phi.shape)),
+        jax.tree_util.tree_structure(refnum),
+        _basis_struct(cm),
         cm.bundle.dm_meas is not None,
         tuple(sorted(cm.bundle.obs_planet_pos_ls)),
     )
-    if cm.tzr_bundle is not None:
+    if has_tzr:
         key += (("tzr", phash),)
     return key
 
 
-class Session:
-    """One (par content, accel mode, shape bucket) serving session."""
+def composition_id(composition: tuple) -> str:
+    """Short stable label of a composition key for metric names and
+    trace attributes (serve.composition.<cid>.* — the per-composition
+    breakdown flight_report prints)."""
+    return compute_hash(repr(composition))[:8]
 
-    def __init__(self, text: str, toas, bucket: int, phash: str):
+
+class ParRecord:
+    """Lightweight per-par serving state: parsed model + split refs +
+    polyco cache.  A record is pure host state — building one never
+    compiles XLA — and it is what a request actually *contributes* to
+    a stacked dispatch: its padded bundle plus this record's numeric
+    reference pytree, both runtime arguments of the composition
+    session's shared kernel."""
+
+    __slots__ = ("par", "par_hash", "model", "_refs", "_compositions",
+                 "_joined", "_polycos")
+
+    def __init__(self, text: str, phash: str):
         from pint_tpu.models.builder import get_model
-        from pint_tpu.parallel.pta import pad_bundle_to
-        from pint_tpu.toas.ingest import ingest_for_model
 
         self.par = text
         self.par_hash = phash
-        self.bucket = bucket
-        model = get_model(text)
-        if toas.t_tdb is None:
-            ingest_for_model(toas, model)
-        self.model = model
-        cm = model.compile(toas)
-        if cm.bundle.ntoa > bucket:
-            raise PintTpuError(
-                f"{cm.bundle.ntoa} TOAs exceed session bucket {bucket}"
+        self.model = get_model(text)
+        self._refs = None  # lazily split (numeric numpy, static) pair
+        self._compositions: dict = {}  # (pulse#, wideband) -> key
+        self._joined: set = set()  # composition ids already counted
+        self._polycos: OrderedDict = OrderedDict()  # span -> Polycos
+
+    # -- runtime references ------------------------------------------------
+    def _split_refs(self):
+        if self._refs is None:
+            # HOST split (device=False): the batcher np.stack's these
+            # per flush — scalars, cheap — shipping them with the
+            # batch instead of one device put per leaf per par
+            self._refs = split_ref_runtime(
+                reference_values(self.model), device=False
             )
-        # the prototype's own bundle is trace scaffolding only (request
-        # data rides as runtime arguments), padded to the bucket so any
-        # shape read off it is consistent with the kernels' argument
-        # shapes
-        cm.bundle = pad_bundle_to(cm.bundle, bucket)
-        self.cm = cm
-        self.mode = default_accel_mode(cm)
-        num, static = split_ref_runtime(cm.ref)
-        # host-numpy reference stack: the batcher np.stack's these per
-        # flush (scalars — cheap), shipping them with the batch instead
-        # of one device put per leaf per request
-        self.refnum = jax.tree_util.tree_map(np.asarray, num)
-        self.static_ref = static
-        self.composition = composition_key(cm, static, phash)
-        self._polycos: OrderedDict = OrderedDict()  # span key -> Polycos
-        # serializes kernel TRACES across fabric replicas: the trace
-        # runs _with_swapped, which mutates this shared prototype for
-        # the trace's duration (warm dispatches never execute the
-        # Python body and stay lock-free) — serve/fabric/replica.py
-        self.trace_lock = threading.Lock()
+        return self._refs
+
+    @property
+    def refnum(self):
+        """Host-numpy numeric reference pytree (stacked per flush)."""
+        return self._split_refs()[0]
+
+    @property
+    def static_ref(self) -> dict:
+        return self._split_refs()[1]
+
+    # -- composition membership -------------------------------------------
+    def composition_for(self, toas, bundle) -> tuple:
+        """This par's composition key for a request's TOA structure —
+        computed from a LIGHT CompiledModel over the request's own
+        (unpadded, host-numpy) bundle: structure only, no prototype
+        compile, no padding, no TZR ingest (the TZR axis enters the
+        key via the host model flag)."""
+        flags = (
+            toas.get_pulse_numbers() is not None, toas.is_wideband()
+        )
+        comp = self._compositions.get(flags)
+        if comp is None:
+            cm_light = CompiledModel(self.model, bundle)
+            comp = composition_key(
+                cm_light, self.refnum, self.static_ref, self.par_hash,
+                self.model.has_tzr_anchor(),
+            )
+            self._compositions[flags] = comp
+        return comp
 
     # -- phase prediction (host-evaluated polycos) ------------------------
-    _POLYCO_CACHE = 8  # spans kept per session
+    _POLYCO_CACHE = 8  # spans kept per par record
 
     def polycos_for(self, req):
         """Polycos covering the request's epochs, cached per (obs,
@@ -200,17 +291,18 @@ class Session:
         return self._polycos[key], cached
 
     # -- fitted-model materialization -------------------------------------
-    def commit_clone(self, deltas, uncertainties):
-        """Fitted deltas folded into a FRESH model parsed from the
-        session par (the session's shared model is never mutated —
-        requests are independent).  Mirrors CompiledModel.commit's
-        internal-units rebase exactly (models/timing_model.py)."""
+    def commit_clone(self, names, deltas, uncertainties):
+        """Fitted deltas folded into a FRESH model parsed from THIS
+        par (the record's shared model is never mutated — requests are
+        independent).  ``names`` is the serving session's free-name
+        order (equal to this model's by composition).  Mirrors
+        CompiledModel.commit's internal-units rebase exactly
+        (models/timing_model.py)."""
         from pint_tpu.models.builder import get_model
 
         m = get_model(self.par)
         for n, dx, u in zip(
-            self.cm.free_names, np.asarray(deltas),
-            np.asarray(uncertainties),
+            names, np.asarray(deltas), np.asarray(uncertainties),
         ):
             p = m.params[n]
             ref = p.internal()
@@ -224,8 +316,49 @@ class Session:
         return m
 
 
+class Session:
+    """One (composition, accel mode, shape bucket) serving session —
+    the compiled prototype EVERY par of the composition dispatches
+    through.  The founding par's CompiledModel is trace scaffolding
+    only: request data and per-par references always ride as runtime
+    arguments (stacked on the leading pulsar axis), so a brand-new par
+    of a known composition serves with zero fresh compiles."""
+
+    def __init__(self, record: ParRecord, toas, bucket: int,
+                 composition: tuple):
+        from pint_tpu.parallel.pta import pad_bundle_to
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        self.bucket = bucket
+        self.composition = composition
+        self.cid = composition_id(composition)
+        self.founder_hash = record.par_hash
+        model = record.model
+        if toas.t_tdb is None:
+            ingest_for_model(toas, model)
+        self.model = model
+        cm = model.compile(toas)
+        if cm.bundle.ntoa > bucket:
+            raise PintTpuError(
+                f"{cm.bundle.ntoa} TOAs exceed session bucket {bucket}"
+            )
+        # the prototype's own bundle is trace scaffolding only (request
+        # data rides as runtime arguments), padded to the bucket so any
+        # shape read off it is consistent with the kernels' argument
+        # shapes
+        cm.bundle = pad_bundle_to(cm.bundle, bucket)
+        self.cm = cm
+        self.mode = default_accel_mode(cm)
+        self.static_ref = record.static_ref
+        # serializes kernel TRACES across fabric replicas: the trace
+        # runs _with_swapped, which mutates this shared prototype for
+        # the trace's duration (warm dispatches never execute the
+        # Python body and stay lock-free) — serve/fabric/replica.py
+        self.trace_lock = threading.Lock()
+
+
 # -- the serve dispatch chokepoint ---------------------------------------
-def traced_jit(fn, site: str):
+def traced_jit(fn, site: str, cid: str | None = None):
     """serve's dispatch chokepoint: ``jax.jit`` + exact XLA (re)trace
     accounting + operand-byte metering + the device-execution guard —
     the ``CompiledModel.jit`` contract for kernels whose operands
@@ -233,11 +366,18 @@ def traced_jit(fn, site: str):
     as runtime arguments.  ``noted`` runs once per XLA (re)trace (jax
     executes the Python body only on jit cache miss), so the PR 2
     ``compile.traces``/``compile.recompiles`` counters are exact here
-    too — a retrace past the first is a bucketing bug."""
+    too — a retrace past the first is a bucketing bug.  ``cid``
+    additionally attributes each trace to its composition
+    (serve.composition.<cid>.compiles — the one-compile-per-
+    composition invariant's per-composition ledger)."""
     ntraces = [0]
 
     def noted(*args):
         _obs.note_trace(site, retrace=ntraces[0] > 0)
+        if cid is not None:
+            _obs.metrics.counter(
+                f"serve.composition.{cid}.compiles"
+            ).inc()
         ntraces[0] += 1
         return fn(*args)
 
@@ -272,7 +412,9 @@ def _with_swapped(proto, static_ref, fn):
 def build_residuals_kernel(session: Session, subtract_mean: bool,
                            site: str):
     """Batched residuals kernel: (bundle_stack, ref_stack, xs (B, p))
-    -> (residuals (B, bucket), chi2 (B,))."""
+    -> (residuals (B, bucket), chi2 (B,)).  The pulsar axis stacks
+    DISTINCT pars of one composition: each row's bundle + reference
+    pytree rides as a vmapped runtime argument."""
     call = _with_swapped(
         session.cm, session.static_ref,
         lambda cm, x: (
@@ -284,7 +426,7 @@ def build_residuals_kernel(session: Session, subtract_mean: bool,
     def run(bundles, refs, xs):
         return jax.vmap(call)(bundles, refs, xs)
 
-    return traced_jit(run, site)
+    return traced_jit(run, site, cid=session.cid)
 
 
 def build_fit_kernel(session: Session, mode: str, maxiter: int,
@@ -293,7 +435,8 @@ def build_fit_kernel(session: Session, mode: str, maxiter: int,
     iteration runs as ONE vmapped lax.scan program (the
     make_scan_fit_loop semantics GLSFitter uses, over the shared
     fitting/gls.py::gauss_newton_step), so a serving batch costs a
-    single dispatch regardless of batch size or maxiter."""
+    single dispatch regardless of batch size, maxiter, or how many
+    distinct pars are stacked on the pulsar axis."""
     proto = session.cm
     p = proto.nfree + noffset(proto)
 
@@ -313,70 +456,157 @@ def build_fit_kernel(session: Session, mode: str, maxiter: int,
     def run(bundles, refs, xs0):
         return jax.vmap(call)(bundles, refs, xs0)
 
-    return traced_jit(run, site)
+    return traced_jit(run, site, cid=session.cid)
 
 
 class SessionCache:
-    """Thread-safe LRU of serving sessions.
+    """Thread-safe two-level LRU of serving state.
 
-    Capacity via ``$PINT_TPU_SERVE_SESSIONS`` (default 32); eviction
-    drops the least-recently-served par/bucket (its kernels fall out
-    of the engine's kernel cache with it, but the persistent compile
-    cache keeps the XLA executables, so re-admission is a disk hit)."""
+    Par records (``$PINT_TPU_SERVE_PARS``, default 1024) and compiled
+    composition sessions (``$PINT_TPU_SERVE_SESSIONS``, default 32)
+    evict INDEPENDENTLY: a population of distinct pars churning
+    through the record LRU never drops a compiled kernel (re-admitting
+    an evicted par is a host parse), and an evicted session's XLA
+    executables remain in the persistent compile cache, so
+    re-admission is a disk hit."""
 
-    def __init__(self, max_sessions: int | None = None):
+    def __init__(self, max_sessions: int | None = None,
+                 max_pars: int | None = None):
         if max_sessions is None:
             max_sessions = int(
                 os.environ.get("PINT_TPU_SERVE_SESSIONS", "32")
             )
+        if max_pars is None:
+            max_pars = int(
+                os.environ.get("PINT_TPU_SERVE_PARS", "1024")
+            )
         self.max_sessions = max(1, int(max_sessions))
+        self.max_pars = max(1, int(max_pars))
         self._lock = threading.Lock()
         self._sessions: OrderedDict = OrderedDict()
-        self._hits = _obs.metrics.counter("serve.session.hits")
-        self._misses = _obs.metrics.counter("serve.session.misses")
-        self._evictions = _obs.metrics.counter("serve.session.evictions")
+        self._records: OrderedDict = OrderedDict()
+        m = _obs.metrics
+        self._hits = m.counter("serve.session.hits")
+        self._misses = m.counter("serve.session.misses")
+        self._evictions = m.counter("serve.session.evictions")
+        self._par_hits = m.counter("serve.session.par_hits")
+        self._par_misses = m.counter("serve.session.par_misses")
+        self._par_evictions = m.counter("serve.session.par_evictions")
+        # population telemetry (ISSUE 6): distinct pars ever admitted,
+        # live record/composition counts — pre-registered so they show
+        # in snapshots/flight reports from the first request
+        self._pars_served = m.counter("serve.session.pars_served")
+        self._g_pars = m.gauge("serve.session.pars")
+        self._g_comps = m.gauge("serve.session.compositions")
+        self._g_pars.set(0)
+        self._g_comps.set(0)
 
     def __len__(self):
+        """Live composition sessions (the compiled layer)."""
         with self._lock:
             return len(self._sessions)
 
-    def key_for(self, par, toas, min_bucket=None) -> tuple:
-        """(par hash, bucket, pulse-number/wideband structure flags) —
-        the accel mode joins after build (it is derived from par +
-        backend, both fixed for a given key)."""
-        return (
-            par_content_hash(par),
-            shape_bucket(len(toas), min_bucket),
-            toas.get_pulse_numbers() is not None,
-            toas.is_wideband(),
+    @property
+    def npars(self) -> int:
+        """Live par records (the lightweight layer)."""
+        with self._lock:
+            return len(self._records)
+
+    @property
+    def ncompositions(self) -> int:
+        """Distinct compositions among live sessions."""
+        with self._lock:
+            return len({comp for comp, _b in self._sessions})
+
+    def _note_sizes_locked(self):
+        self._g_pars.set(len(self._records))
+        self._g_comps.set(
+            len({comp for comp, _b in self._sessions})
         )
 
-    def get_or_create(self, par, toas, min_bucket=None) -> Session:
-        key = self.key_for(par, toas, min_bucket)
+    # -- the lightweight per-par layer ------------------------------------
+    def record_for(self, par) -> ParRecord:
+        """Get-or-parse the per-par record (pure host work)."""
+        text = par_text(par)
+        phash = par_content_hash(text)
+        with self._lock:
+            rec = self._records.get(phash)
+            if rec is not None:
+                self._records.move_to_end(phash)
+                self._par_hits.inc()
+                return rec
+        # build outside the lock (host model parse; the single
+        # collector thread is the only writer, so a duplicate build
+        # race costs at most one redundant parse)
+        self._par_misses.inc()
+        self._pars_served.inc()
+        rec = ParRecord(text, phash)
+        evicted = 0
+        with self._lock:
+            self._records[phash] = rec
+            self._records.move_to_end(phash)
+            while len(self._records) > self.max_pars:
+                self._records.popitem(last=False)
+                evicted += 1
+            self._note_sizes_locked()
+        if evicted:
+            self._par_evictions.inc(evicted)
+        return rec
+
+    # -- the compiled composition layer -----------------------------------
+    def session_for(self, record: ParRecord, toas, bundle,
+                    min_bucket=None) -> Session:
+        """Get-or-build the composition session a request of this
+        (par, TOA structure) dispatches through.  ``bundle`` is the
+        request's unpadded host-numpy bundle (the engine builds it
+        anyway — it becomes the request's stacked operand)."""
+        bucket = shape_bucket(bundle.ntoa, min_bucket)
+        comp = record.composition_for(toas, bundle)
+        key = (comp, bucket)
+        cid = composition_id(comp)
+        if cid not in record._joined:
+            record._joined.add(cid)
+            _obs.metrics.counter(
+                f"serve.composition.{cid}.pars"
+            ).inc()
         with self._lock:
             s = self._sessions.get(key)
             if s is not None:
                 self._sessions.move_to_end(key)
                 self._hits.inc()
                 return s
-        # build outside the lock (host model parse/compile; the single
-        # collector thread is the only writer, so a duplicate build
-        # race costs at most one redundant session)
         self._misses.inc()
         with TRACER.span(
-            "serve:session-build", "serve", bucket=key[1],
-            par_hash=key[0],
+            "serve:session-build", "serve", bucket=bucket,
+            composition=cid, par_hash=record.par_hash,
         ):
-            s = Session(par_text(par), toas, key[1], key[0])
+            s = Session(record, toas, bucket, comp)
         evicted = []
         with self._lock:
             self._sessions[key] = s
             self._sessions.move_to_end(key)
             while len(self._sessions) > self.max_sessions:
                 evicted.append(self._sessions.popitem(last=False))
-        for k, _old in evicted:
+            self._note_sizes_locked()
+        for (_comp, b), old in evicted:
             self._evictions.inc()
             TRACER.event(
-                "session-evict", "serve", par_hash=k[0], bucket=k[1]
+                "session-evict", "serve", composition=old.cid, bucket=b
             )
         return s
+
+    # -- one-call resolver -------------------------------------------------
+    def get_or_create(self, par, toas, min_bucket=None) -> Session:
+        """Record + composition session in one call (tests and
+        library callers; the engine resolves the two layers itself so
+        the request's bundle is built exactly once)."""
+        from pint_tpu.toas.bundle import make_bundle
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        rec = self.record_for(par)
+        if toas.t_tdb is None:
+            ingest_for_model(toas, rec.model)
+        nb = make_bundle(
+            toas, rec.model._build_masks(toas), as_numpy=True
+        )
+        return self.session_for(rec, toas, nb, min_bucket)

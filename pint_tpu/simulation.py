@@ -188,6 +188,64 @@ def make_test_pulsar(
     return model, toas
 
 
+def make_population(
+    par: str,
+    npsr: int,
+    ntoa: int = 64,
+    seed: int = 0,
+    spread: float = 1e-9,
+    **make_test_pulsar_kw,
+):
+    """Population scaffold for composition-keyed serving benches and
+    tests (ISSUE 6): ``npsr`` par-parameter variants of ONE
+    composition sharing ONE simulated TOA set — so population runs pay
+    the host ingest path once, not N times.
+
+    Builds the base pulsar via :func:`make_test_pulsar`, then emits
+    par texts whose free float/HostDD parameters (spin, astrometry,
+    dispersion — whatever the composition frees) are perturbed by
+    ``spread`` relative (absolute for zero-valued references) draws.
+    The component stack, free-parameter layout, and mask structure are
+    untouched, so every variant lands in the same serving composition
+    (serve/session.py::composition_key) and stacks into one vmapped
+    dispatch.  Epoch (MJD) parameters stay fixed: perturbing them
+    would only re-anchor the internal delta, not change composition,
+    and tiny-spread epoch shifts are invisible at f64 anyway.
+
+    Returns ``(pars, toas)`` where ``pars[0]`` is the base model's own
+    parfile and ``toas`` is the shared (already ingested) TOA set.
+    """
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.timebase.hostdd import HostDD
+
+    if npsr < 1:
+        raise ValueError(f"make_population needs npsr >= 1, got {npsr}")
+    model, toas = make_test_pulsar(
+        par, ntoa=ntoa, seed=seed, **make_test_pulsar_kw
+    )
+    base = model.as_parfile()
+    rng = np.random.default_rng(seed + 0x5EED)
+    pars = [base]
+    for _ in range(1, npsr):
+        m = get_model(base)
+        for name in m.free_params:
+            p = m.params[name]
+            ref = p.internal()
+            if isinstance(ref, HostDD):
+                scale = abs(float(ref.hi)) or 1.0
+                p.set_internal(
+                    ref + spread * scale * rng.standard_normal()
+                )
+            elif isinstance(ref, float):
+                scale = abs(ref) or 1.0
+                p.set_internal(
+                    ref + spread * scale * rng.standard_normal()
+                )
+            # tuples (epochs / pair parameters) stay at the base value
+        pars.append(m.as_parfile())
+    return pars, toas
+
+
 def calculate_random_models(
     fitter, n_models: int = 100, rng: Optional[np.random.Generator] = None
 ):

@@ -436,7 +436,7 @@ def main():
     ap.add_argument("--configs", nargs="+",
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
-                             "serve_replicas"])
+                             "serve_replicas", "serve_population"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -444,20 +444,30 @@ def main():
                 "7": config_7, "7b": config_7b}
     hbm_last_peak = 0
     for c in args.configs:
-        if str(c) in ("serve", "serve_replicas"):
+        if str(c) in ("serve", "serve_replicas", "serve_population"):
             # serving-engine ladders (profiling/serve_offered_load.py):
             # 'serve' = the offered-load ladder (ISSUE 4; the top rung
             # overruns the admission queue to exercise shedding);
             # 'serve_replicas' = the fabric replica ladder (ISSUE 5;
             # 1/2/4/8 replicas at fixed offered load -> aggregate
-            # TOAs/s + scaling efficiency)
+            # TOAs/s + scaling efficiency);
+            # 'serve_population' = the distinct-par ladder (ISSUE 6;
+            # 1/10/100/1000 pars of one composition at fixed offered
+            # load -> requests/s + per-rung compile count, which must
+            # stay flat)
             import os
             import sys
 
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-            from serve_offered_load import replica_sweep, sweep
+            from serve_offered_load import (
+                population_sweep, replica_sweep, sweep,
+            )
 
-            rows = sweep() if str(c) == "serve" else replica_sweep()
+            rows = {
+                "serve": sweep,
+                "serve_replicas": replica_sweep,
+                "serve_population": population_sweep,
+            }[str(c)]()
             for row in rows:
                 print(json.dumps(row))
             continue

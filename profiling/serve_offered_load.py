@@ -19,9 +19,19 @@ trajectory next to the offered-load one.  On the virtual CPU mesh the
 "devices" share host cores, so efficiency there measures fabric
 overhead, not hardware scaling.
 
+The POPULATION ladder (ISSUE 6, :func:`population_sweep`) holds the
+offered load fixed and sweeps the DISTINCT-PAR count (1/10/100/1000
+pars of one composition, simulation.make_population), reporting per
+rung the achieved requests/s, the rung's TOTAL XLA compile count
+(cold engine each rung: it must stay flat — one compile per (bucket,
+batch capacity), never one per par), the steady-state retrace count
+(must be zero), and the distinct-par stack occupancy — the
+continuous-batching-across-users trajectory ROADMAP item 2 tracks.
+
 Usage: ``python profiling/serve_offered_load.py`` (one JSON line per
-rung, both ladders), or via ``python profiling/run_benchmarks.py
---configs serve`` / ``--configs serve_replicas``.
+rung, all ladders), or via ``python profiling/run_benchmarks.py
+--configs serve`` / ``--configs serve_replicas`` / ``--configs
+serve_population``.
 """
 
 from __future__ import annotations
@@ -203,6 +213,114 @@ def replica_sweep(replicas=(1, 2, 4, 8), offered: int = 64,
             engine.close()
 
 
+def population_sweep(npars=(1, 10, 100, 1000), offered: int = 1024,
+                     ntoa: int = 48, maxiter: int = 2):
+    """Yield one result row per distinct-par rung at fixed offered
+    load.  Each rung runs a COLD engine so its compile count is
+    self-contained: warm the batch-capacity ladder with the base par,
+    admit the rung's whole population once (cold par records — pure
+    host parses), then measure a steady pass cycling the population.
+    The rung's total compile count must be FLAT across rungs (one per
+    (bucket, capacity); a count growing with npars is the million
+    -session antipattern this ladder exists to catch)."""
+    import jax
+
+    from pint_tpu.exceptions import RequestRejected
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+    from pint_tpu.simulation import make_population
+
+    base = (
+        "PSR POP\nF0 187.25 1\nF1 -1.4e-15 1\nPEPOCH 55000\n"
+        "DM 9.31 1\n"
+    )
+    pars, toas = make_population(
+        base, max(npars), ntoa=ntoa, seed=23,
+        start_mjd=54000.0, end_mjd=56000.0, iterations=1,
+    )
+    for n in npars:
+        # replicas=1: saturation spills compile legitimately on a
+        # second replica (the replica ladder's axis) and would blur
+        # the per-rung compile-count flatness this ladder reports
+        engine = TimingEngine(
+            max_batch=16, inflight=4, max_wait_ms=5.0,
+            max_queue=max(2 * offered, 64), replicas=1,
+        )
+        traces0 = obs_metrics.counter("compile.traces").value
+        try:
+            # warm the kernel set across the batch-capacity ladder
+            # with the BASE par (sweep() precedent)
+            wave = 1
+            while wave <= 16:
+                warm = [
+                    engine.submit(FitRequest(
+                        par=pars[0], toas=toas, maxiter=maxiter,
+                    ))
+                    for _ in range(wave)
+                ]
+                for f in warm:
+                    f.result(timeout=3600)
+                wave <<= 1
+            # cold-record admission: every distinct par once (host
+            # parses; zero compiles — gated by the bench population
+            # block); timed so the ladder tracks admission cost too
+            t0 = time.perf_counter()
+            for f in engine.submit_many([
+                FitRequest(par=p, toas=toas, maxiter=maxiter)
+                for p in pars[:n]
+            ]):
+                f.result(timeout=3600)
+            admit_wall = time.perf_counter() - t0
+            engine.reset_stats()
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            t0 = time.perf_counter()
+            futs = [
+                engine.submit(FitRequest(
+                    par=pars[i % n], toas=toas, maxiter=maxiter,
+                ))
+                for i in range(offered)
+            ]
+            completed = rejected = failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=3600)
+                    completed += 1
+                except RequestRejected:
+                    rejected += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            st = engine.stats()
+            yield {
+                "config": f"serve population={n} pars "
+                          f"offered={offered} fits ({ntoa} TOAs)",
+                "backend": jax.default_backend(),
+                "distinct_pars": n,
+                "offered": offered,
+                "completed": completed,
+                "shed": rejected,
+                "failed": failed,
+                "achieved_rps": round(completed / wall, 2),
+                "cold_admit_rps": round(n / admit_wall, 2),
+                "rung_compiles": (
+                    obs_metrics.counter("compile.traces").value
+                    - traces0
+                ),
+                "steady_recompiles": (
+                    obs_metrics.counter("compile.recompiles").value
+                    - rec0
+                ),
+                "stack_distinct_mean": (
+                    st["population"]["stack_distinct_mean"]
+                ),
+                "p50_ms": st["p50_ms"],
+                "p99_ms": st["p99_ms"],
+                "batch_occupancy": st["batch_occupancy_mean"],
+            }
+        finally:
+            engine.close()
+
+
 def main():
     import jax
 
@@ -210,6 +328,8 @@ def main():
     for row in sweep():
         print(json.dumps(row))
     for row in replica_sweep():
+        print(json.dumps(row))
+    for row in population_sweep():
         print(json.dumps(row))
 
 

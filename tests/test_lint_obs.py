@@ -163,6 +163,59 @@ def test_linter_flags_uninstrumented_fabric_chokepoints(tmp_path):
     )
 
 
+def test_linter_flags_uninstrumented_stack_chokepoint(tmp_path):
+    """Rule 5 (ISSUE 6): the pulsar-axis stack assembly must span and
+    the stacked kernel builders must dispatch through traced_jit."""
+    pkg = tmp_path / "pint_tpu"
+    (pkg / "fitting").mkdir(parents=True)
+    (pkg / "runtime").mkdir()
+    (pkg / "models").mkdir()
+    (pkg / "serve").mkdir()
+    (pkg / "runtime" / "guard.py").write_text(
+        "def dispatch_guard(fn, site):\n"
+        "    h = TRACER.span(site, 'dispatch')\n"
+        "    return fn\n"
+    )
+    (pkg / "models" / "timing_model.py").write_text(
+        "class CompiledModel:\n"
+        "    def jit(self, fn):\n"
+        "        note_trace(1)\n"
+        "        return dispatch_guard(fn, 'x')\n"
+    )
+    # rule-3 chokepoints clean; _assemble stacks WITHOUT a span, the
+    # fit kernel builder bypasses traced_jit, the residuals one is ok
+    (pkg / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def submit(self, request):\n"
+        "        with TRACER.span('serve:submit', 'serve'):\n"
+        "            return request\n"
+        "    def _flush(self, batch):\n"
+        "        with TRACER.span('serve:flush', 'serve'):\n"
+        "            pass\n"
+        "    def _assemble(self, key, live):\n"
+        "        return stack_trees([p.bundle for p in live])\n"
+    )
+    (pkg / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site, cid=None):\n"
+        "    note_trace(site, retrace=False)\n"
+        "    return dispatch_guard(fn, site)\n"
+        "def build_residuals_kernel(session, subtract_mean, site):\n"
+        "    return traced_jit(lambda *a: a, site)\n"
+        "def build_fit_kernel(session, mode, maxiter, tol, site):\n"
+        "    return lambda *a: a\n"
+    )
+    findings = [str(f) for f in check_chokepoints(pkg)]
+    assert any(
+        "TimingEngine._assemble" in f and "TRACER.span" in f
+        for f in findings
+    )
+    assert any(
+        "build_fit_kernel" in f and "traced_jit" in f
+        for f in findings
+    )
+    assert not any("build_residuals_kernel" in f for f in findings)
+
+
 def test_linter_flags_undecorated_fit_toas(tmp_path):
     pkg = tmp_path / "pint_tpu"
     (pkg / "fitting").mkdir(parents=True)

@@ -420,3 +420,47 @@ def test_onchip_measured_noise_floor_within_model_bounds():
         f"cross-program chi2 offset {abs(c_scalar - c_vmap):.3g} "
         f"exceeds the absolute model bound {model_floor:.3g}"
     )
+
+
+def test_onchip_population_stacking_is_bitwise_neutral():
+    """ISSUE 6 spot-check on the real accelerator: a request's served
+    residuals/fit must be BITWISE identical whether its capacity-4
+    batch rows are all its own par or a mix of other pars (padded
+    pulsar-axis slots included).  The CPU mesh proves the program
+    logic (tests/test_serve_population.py); this run proves the
+    emulated-f64 backend executes the vmapped rows just as
+    row-independently."""
+    from pint_tpu.serve import FitRequest, ResidualsRequest, TimingEngine
+    from pint_tpu.simulation import make_population
+
+    pars, toas = make_population(
+        "PSR ONCHIP\nF0 151.3 1\nF1 -1.5e-15 1\nPEPOCH 55000\n"
+        "DM 8.9 1\n",
+        3, ntoa=40, seed=5, iterations=1,
+    )
+
+    def wave(eng, reqs):
+        futs = [eng.submit(r) for r in reqs]
+        return [f.result(timeout=600) for f in futs]
+
+    with TimingEngine(max_batch=4, max_wait_ms=50.0, inflight=2) as eng:
+        solo_res = wave(eng, [
+            ResidualsRequest(par=pars[1], toas=toas) for _ in range(4)
+        ])[0]
+        solo_fit = wave(eng, [
+            FitRequest(par=pars[1], toas=toas, maxiter=2)
+            for _ in range(4)
+        ])[0]
+        mix_res = wave(eng, [
+            ResidualsRequest(par=p, toas=toas) for p in pars
+        ])[1]
+        mix_fit = wave(eng, [
+            FitRequest(par=p, toas=toas, maxiter=2) for p in pars
+        ])[1]
+    np.testing.assert_array_equal(solo_res.residuals_s, mix_res.residuals_s)
+    assert solo_res.chi2 == mix_res.chi2
+    np.testing.assert_array_equal(solo_fit.deltas, mix_fit.deltas)
+    np.testing.assert_array_equal(
+        solo_fit.uncertainties, mix_fit.uncertainties
+    )
+    assert solo_fit.fitted_par == mix_fit.fitted_par

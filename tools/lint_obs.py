@@ -53,6 +53,18 @@ Rules (syntactic, like the scalarmath linter):
    an unguarded probe is exactly the blindness rules 1-3 exist to
    prevent, one layer up.
 
+5. stacked-dispatch chokepoint (ISSUE 6) — the population-serving
+   path that assembles the pulsar-axis stack and dispatches it must
+   stay span-instrumented and retrace-counted:
+   ``TimingEngine._assemble`` (serve/engine.py) must open a recorder
+   span around the ``stack_trees`` assembly (distinct-par stack
+   occupancy rides the span attributes), and the batched kernel
+   builders ``build_residuals_kernel`` / ``build_fit_kernel``
+   (serve/session.py) must route through ``traced_jit`` — a stacked
+   dispatch that bypasses the trace counter would let a per-par
+   recompile (the exact antipattern composition keying exists to
+   kill) pass silently.
+
 Run: ``python tools/lint_obs.py [paths...]`` (default: pint_tpu/).
 Exit status 1 when findings exist.  Wired into tier-1 as
 tests/test_lint_obs.py.
@@ -218,9 +230,27 @@ def check_chokepoints(pkg_root) -> list:
          "the canary probe must dispatch through the guarded "
          "chokepoint"),
     )
+    # rule 5: the stacked-dispatch chokepoint (ISSUE 6) — skipped,
+    # like rule 3, for synthetic packages without the serving
+    # subsystem
+    population_checks = (
+        ("serve/engine.py", "TimingEngine._assemble",
+         ("TRACER.span", "stack_trees("),
+         "the pulsar-axis stack assembly must stay span-instrumented "
+         "(distinct-par stack occupancy)"),
+        ("serve/session.py", "build_residuals_kernel",
+         ("traced_jit(",),
+         "the stacked residuals dispatch must route through the "
+         "trace-counted serve chokepoint"),
+        ("serve/session.py", "build_fit_kernel",
+         ("traced_jit(",),
+         "the stacked fit dispatch must route through the "
+         "trace-counted serve chokepoint"),
+    )
     for checks, subdir in (
         (serve_checks, pkg_root / "serve"),
         (fabric_checks, pkg_root / "serve" / "fabric"),
+        (population_checks, pkg_root / "serve"),
     ):
         if not subdir.is_dir():
             continue
