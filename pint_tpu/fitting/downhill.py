@@ -177,9 +177,11 @@ class DownhillFitter(Fitter):
         )
         all_lams = np.asarray(lams + probe_lams + [0.0])
         lams_arr = jnp.asarray(all_lams)
+        # O(10)-float ladder constant — baking it is intended (way
+        # below any transport/413 threshold, and constant-folds)
         chi2_ladder = self.cm.jit(
             lambda x, dx: jax.vmap(chi2_of)(
-                x[None, :] + lams_arr[:, None] * dx[None, :]
+                x[None, :] + lams_arr[:, None] * dx[None, :]  # lint: ok(transport)
             )
         )
 

@@ -152,7 +152,10 @@ def _wls_step(r, M, w, threshold=None, method=None,
             rank_ok, qr_solve, gram_fallback, None
         )
     else:
-        U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+        # CPU-pinned path: 'svd' is only ever the default on the CPU
+        # backend (default_wls_method routes accelerators to 'qr'
+        # because this very SVD NaNs under axon's emulated f64)
+        U, s, Vt = jnp.linalg.svd(A, full_matrices=False)  # lint: ok(f64-emu)
         bad = s < threshold * s[0]
         s_inv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, s))
         dx = (Vt.T * s_inv[None, :]) @ (U.T @ b)

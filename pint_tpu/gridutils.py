@@ -93,7 +93,9 @@ def _chi2_points(cm, gidx, pts, refit, n_refit_iter):
                 M = design_with_offset(cm, x)
                 w = 1.0 / jnp.square(cm.scaled_sigma(x))
                 dx, _, _ = _wls_step(r, M, w)
-                x = x + free_mask_j * dx[no:]
+                # O(nfree) static mask — bakes as a ~p-float literal,
+                # intended (way below any transport/413 threshold)
+                x = x + free_mask_j * dx[no:]  # lint: ok(transport)
         return cm.chi2(x)
 
     return np.asarray(cm.jit(jax.vmap(chi2_at))(jnp.asarray(pts)))

@@ -202,6 +202,12 @@ class UnknownParameterWarning(UserWarning):
 
 def get_model(par) -> TimingModel:
     """par file (path, text, or file object) -> TimingModel."""
+    from pint_tpu.obs import metrics as _metrics
+
+    # exact host-parse ledger: the serving population gate pins that
+    # steady-state traffic costs ZERO parses (admission is the only
+    # parser; fit responses clone — tests/test_serve_population.py)
+    _metrics.counter("model.parses").inc()
     return ModelBuilder()(par)
 
 

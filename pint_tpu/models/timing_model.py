@@ -263,6 +263,32 @@ class TimingModel:
                     lines.append(line)
         return "".join(lines)
 
+    def clone(self) -> "TimingModel":
+        """Independent copy sharing NOTHING mutable with this model:
+        fresh component instances carrying deep-copied Parameters plus
+        deep-copied top-level params, re-``setup()``.  The serving
+        layer's per-response model materialization
+        (serve/session.py::ParRecord.commit_clone) uses this instead
+        of re-parsing the par text per fit response — a clone is pure
+        param-state copying, no tokenizing/validate/TZR ingest (the
+        ROADMAP item-2 leftover; one host parse per par ADMISSION, not
+        per response).  Heavy derived state memoized on components
+        (AbsPhase._tzr_memo's ingested TZR TOAs) is deliberately NOT
+        copied — a later compile() of the clone re-ingests lazily."""
+        import copy
+
+        comps = []
+        for c in self._ordered_components():
+            c2 = type(c)()
+            c2.params = {
+                n: copy.deepcopy(p) for n, p in c.params.items()
+            }
+            comps.append(c2)
+        m = TimingModel(comps, name=self.name)
+        m.top_params = copy.deepcopy(self.top_params)
+        m.setup()
+        return m
+
     def compare(self, other: "TimingModel") -> str:
         """Human-readable parameter comparison (reference:
         TimingModel.compare)."""

@@ -13,7 +13,7 @@ The helpers here are the accounting hooks the compile chokepoint
 (models/timing_model.py::CompiledModel.jit) calls: they record XLA
 (re)traces, baked-module transport pressure, and operand bytes.  They
 live in obs so the chokepoint stays one import away from the recorder
-and tools/lint_obs.py can statically verify the wiring.
+and pintlint (rules obs1-obs5) can statically verify the wiring.
 """
 
 from __future__ import annotations

@@ -39,6 +39,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# lint: module(matmul-highest) — every matmul here carries an explicit
+# precision: TPU-default matmuls are bf16-pass and this module's whole
+# contract is error-free f32 splits (tools/lint rule f64-emu)
 _HIGHEST = jax.lax.Precision.HIGHEST
 
 
@@ -167,12 +170,16 @@ def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
     # returned at n=32768 (>45 min; the r4 scatter form compiled and
     # ran there), so the fusion win is taken only where compile is
     # known-good.
+    # the rank-k GEMM at HIGHEST: a single bf16 pass would make the
+    # preconditioner an O(1e-3) perturbation instead of the O(eps32)
+    # this docstring promises (and f32 multiplies are exact at HIGHEST)
+    WWt = jnp.matmul(W, W.T, precision=_HIGHEST)
     if n <= 16384:
         ii = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
         jj = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-        Ceq32 = jnp.where(ii == jj, jnp.float32(1.0), W @ W.T)
+        Ceq32 = jnp.where(ii == jj, jnp.float32(1.0), WWt)
     else:
-        Ceq32 = (W @ W.T).at[jnp.arange(n), jnp.arange(n)].set(1.0)
+        Ceq32 = WWt.at[jnp.arange(n), jnp.arange(n)].set(1.0)
     L32 = cholesky(Ceq32)
 
     def solve32(R):
@@ -183,9 +190,14 @@ def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
         return Z.astype(jnp.float64)
 
     def apply_true(X):
-        """C_eq X in f64 via the Woodbury structure (no dense array)."""
+        """C_eq X in f64 via the Woodbury structure (no dense array).
+        HIGHEST so the 'TRUE f64 operator' claim survives the TPU's
+        bf16-pass matmul default on the emulated-f64 components."""
         Xd = X * dinv[:, None]
-        CX = Ndiag[:, None] * Xd + T @ (phi[:, None] * (T.T @ Xd))
+        CX = Ndiag[:, None] * Xd + jnp.matmul(
+            T, phi[:, None] * jnp.matmul(T.T, Xd, precision=_HIGHEST),
+            precision=_HIGHEST,
+        )
         return CX * dinv[:, None]
 
     Beq = B * dinv[:, None]
@@ -230,7 +242,9 @@ def chol_solve_ir(A, B, refine: int = 2, cholesky=None):
         mm = make_matmul_split32(Aeq)  # split Aeq ONCE for all passes
     else:
         def mm(X):
-            return Aeq @ X  # f64: one small matmul per pass
+            # f64: one small matmul per pass — HIGHEST so the IR
+            # residual really applies the exact operator on TPU
+            return jnp.matmul(Aeq, X, precision=_HIGHEST)
 
     X = solve32(Beq)
     for _ in range(refine):

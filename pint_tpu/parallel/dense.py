@@ -29,6 +29,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pint_tpu.fitting.gls import _column_norms, _finish_normal_eqs
 
+# lint: module(matmul-highest) — every matmul here carries an explicit
+# precision: a single default bf16 pass NaNs the Schur cancellation
+# (see blocked_cholesky's precision note; tools/lint rule f64-emu)
+
 
 def _constrain(mesh, x, spec):
     if mesh is None:
@@ -231,7 +235,10 @@ def sharded_gls_step_full_cov(mesh, r, M, Ndiag, T, phi,
     L = blocked_cholesky(C, block=block, mesh=mesh, axis=axis)
     Y = jax.scipy.linalg.solve_triangular(L, X, lower=True)
     CiX = jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
-    G = X.T @ CiX
+    # HIGHEST: this f64 rung also runs on accelerators (the fallback
+    # ladder), where the default bf16-pass matmul would quietly
+    # degrade the normal-equation Gram it feeds _finish_normal_eqs
+    G = jnp.matmul(X.T, CiX, precision=jax.lax.Precision.HIGHEST)
     return _finish_normal_eqs(
         G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
     )

@@ -524,7 +524,7 @@ def diagnose_nonfinite(mats: dict) -> NumericsDiagnosis:
             "scalar path (usually ~2e-8 error, not NaN, but domain "
             "edges differ): route scalar parameters through "
             "ops/scalarmath.py (sin_p/cos_p/...; "
-            "tools/lint_scalarmath.py catches this statically)",
+            "pintlint rule scalarmath catches this statically)",
             backend,
         )
     return NumericsDiagnosis(

@@ -108,11 +108,11 @@ class TimingEngine:
         )
         self.min_bucket = min_bucket
         self.sessions = smod.SessionCache(max_sessions)
-        self._queue: collections.deque = collections.deque()
+        self._queue: collections.deque = collections.deque()  # lint: guarded-by(_cond)
         self._cond = threading.Condition()
         self._batcher = bmod.Batcher(self.max_batch, self.max_wait_s)
-        self._stop = False
-        self._latencies = collections.deque(maxlen=4096)
+        self._stop = False  # lint: guarded-by(_cond)
+        self._latencies = collections.deque(maxlen=4096)  # lint: guarded-by(_lat_lock)
         self._lat_lock = threading.Lock()
         # host response assembly (model parse, par text) is serialized
         # across replica fence threads — it is light next to the device
@@ -358,7 +358,7 @@ class TimingEngine:
             self._dispatch(work)
 
     def _assemble(self, key, live) -> BatchWork:
-        """The stacked-dispatch chokepoint (tools/lint_obs.py rule 5):
+        """The stacked-dispatch chokepoint (pintlint rule obs5):
         assemble the pulsar-axis stack — every live request's padded
         bundle + per-par reference pytree, DISTINCT pars included —
         as the batch's runtime operands.  Pad slots repeat the first

@@ -62,7 +62,7 @@ class ReplicaPool:
             for i, d in enumerate(devices)
         ]
         self._cond = threading.Condition()
-        self._stop = False
+        self._stop = False  # lint: guarded-by(_cond)
         self._prober = threading.Thread(
             target=self._probe_loop, daemon=True,
             name="pint-tpu-fabric prober",

@@ -166,20 +166,21 @@ class Replica:
         self._finisher = finisher
         self._validator = validator
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()
+        self._queue: collections.deque = collections.deque()  # lint: guarded-by(_cond)
         self._fence_q: queue.Queue = queue.Queue()
         self._sem = threading.BoundedSemaphore(self.inflight)
-        self._kernels: dict = {}  # (batch key, capacity) -> callable
-        self._draining = False
+        self._kernels: dict = {}  # (batch key, capacity) -> callable; dispatcher-thread only
+        self._draining = False  # lint: guarded-by(_cond)
         # health state: reads are bare attribute loads (GIL-atomic) so
         # submit() can check state while holding only _cond; writes go
-        # through _set_state under _state_lock
-        self._state = LIVE
+        # through _set_state under _state_lock (the locks rule checks
+        # the declared discipline — tools/lint/rules/locks.py)
+        self._state = LIVE  # lint: guarded-by(_state_lock)
         self._state_lock = threading.Lock()
-        self._consecutive = 0
-        self.batches_done = 0
-        self.failures = 0
-        self._outstanding = 0  # batches queued + in flight
+        self._consecutive = 0  # lint: guarded-by(_state_lock)
+        self.batches_done = 0  # fencer-thread only
+        self.failures = 0  # lint: guarded-by(_state_lock)
+        self._outstanding = 0  # batches queued + in flight; lint: guarded-by(_cond)
         self._g_out = obs_metrics.gauge(
             f"serve.replica.{rid}.outstanding"
         )
@@ -352,15 +353,22 @@ class Replica:
             # replica's — no health hit, no re-route
             work.fail(e)
             return
-        self.failures += 1
+        # _batch_error runs on BOTH the dispatcher thread (dispatch
+        # failures) and the fencer thread (fence/validate failures) —
+        # the bare += here was a lost-update race the locks rule
+        # surfaced (tools/lint/rules/locks.py)
+        with self._state_lock:
+            self.failures += 1
         obs_metrics.counter("serve.fabric.failures").inc()
         self.note_failure(kind, e)
         self._requeue(work, self)
 
     # -- health state machine ---------------------------------------------
-    def _set_state(self, new: str, kind: str = ""):
-        """The single transition chokepoint (tools/lint_obs.py rule 4:
-        every quarantine/readmit is event-instrumented + counted)."""
+    def _set_state(self, new: str, kind: str = ""):  # lint: holds(_state_lock)
+        """The single transition chokepoint (obs4: every quarantine/
+        readmit is event-instrumented + counted).  Callers hold
+        ``_state_lock`` — the declared contract the locks rule
+        enforces at every call site's own mutations."""
         prev, self._state = self._state, new
         self._g_state.set(new)
         if new == QUARANTINED:

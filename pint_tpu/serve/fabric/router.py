@@ -47,8 +47,8 @@ class Router:
         self.affinity = max(
             1, int(affinity) if affinity else pool.size
         )
-        self._placements: dict = {}  # group key -> [rid, ...]
-        self._rotor: dict = {}  # group key -> round-robin counter
+        self._placements: dict = {}  # group key -> [rid, ...]; lint: guarded-by(_lock)
+        self._rotor: dict = {}  # round-robin counters; lint: guarded-by(_lock)
         self._lock = threading.Lock()
         self._m_routes = obs_metrics.counter("serve.fabric.routes")
         self._m_spills = obs_metrics.counter("serve.fabric.spills")
@@ -61,7 +61,7 @@ class Router:
     def route(self, work, exclude=()):
         """Pick the serving replica for one assembled batch; None when
         no live/degraded replica can take it (the caller sheds typed).
-        Every decision is span-instrumented (lint_obs rule 4)."""
+        Every decision is span-instrumented (pintlint rule obs4)."""
         with TRACER.span(
             "router:route", "fabric", op=work.key[0],
             n=len(work.live),

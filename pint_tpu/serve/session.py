@@ -292,15 +292,18 @@ class ParRecord:
 
     # -- fitted-model materialization -------------------------------------
     def commit_clone(self, names, deltas, uncertainties):
-        """Fitted deltas folded into a FRESH model parsed from THIS
-        par (the record's shared model is never mutated — requests are
-        independent).  ``names`` is the serving session's free-name
-        order (equal to this model's by composition).  Mirrors
-        CompiledModel.commit's internal-units rebase exactly
-        (models/timing_model.py)."""
-        from pint_tpu.models.builder import get_model
-
-        m = get_model(self.par)
+        """Fitted deltas folded into a FRESH CLONE of this record's
+        already-parsed model (the shared model is never mutated —
+        requests are independent).  Cloning replaces the former
+        per-response ``get_model(self.par)`` re-parse: param-state
+        copying only, no tokenizing/validate/TZR re-ingest, so the
+        host parse happens once per par ADMISSION and the
+        ``model.parses`` counter stays flat under steady fit traffic
+        (pinned in tests/test_serve_population.py).  ``names`` is the
+        serving session's free-name order (equal to this model's by
+        composition).  Mirrors CompiledModel.commit's internal-units
+        rebase exactly (models/timing_model.py)."""
+        m = self.model.clone()
         for n, dx, u in zip(
             names, np.asarray(deltas), np.asarray(uncertainties),
         ):
@@ -483,8 +486,8 @@ class SessionCache:
         self.max_sessions = max(1, int(max_sessions))
         self.max_pars = max(1, int(max_pars))
         self._lock = threading.Lock()
-        self._sessions: OrderedDict = OrderedDict()
-        self._records: OrderedDict = OrderedDict()
+        self._sessions: OrderedDict = OrderedDict()  # lint: guarded-by(_lock)
+        self._records: OrderedDict = OrderedDict()  # lint: guarded-by(_lock)
         m = _obs.metrics
         self._hits = m.counter("serve.session.hits")
         self._misses = m.counter("serve.session.misses")

@@ -1,0 +1,491 @@
+"""Tier-1 wiring for the unified hazard-analysis framework
+(tools/lint; docs/static_analysis.md): the whole rule suite must pass
+over pint_tpu/ with an effectively-empty baseline, the migrated rules
+must stay finding-for-finding identical to the pre-framework linters,
+and each NEW rule family must demonstrably catch its incident class —
+the r4 tiny-product flush, the r5 eigh solve, the r5 closure-captured
+device array (HTTP 413), and the PR 5 off-lock fabric mutation —
+while passing the fixed/suppressed form.  Pure AST work: CPU mesh, no
+device dispatch.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint.engine import (  # noqa: E402
+    Finding,
+    Module,
+    apply_baseline,
+    check_module,
+    load_baseline,
+    main,
+    run,
+    suppressed,
+)
+from lint.rules import ALL_RULES, rules_by_name  # noqa: E402
+from lint.rules.f64emu import RULE as F64EMU  # noqa: E402
+from lint.rules.locks import RULE as LOCKS  # noqa: E402
+from lint.rules.retrace import RULE as RETRACE  # noqa: E402
+from lint.rules.transport import RULE as TRANSPORT  # noqa: E402
+
+
+def findings_for(rule, source, path="pint_tpu/fixture.py"):
+    mod = Module(path, source)
+    return [
+        f for f in rule.check_module(mod)
+        if not suppressed(rule, mod, f.lineno)
+    ]
+
+
+# -- the CI gate: whole suite over the real tree --------------------------
+def test_whole_suite_is_clean_over_pint_tpu():
+    """Every rule enabled over pint_tpu/ (project chokepoint checks
+    included): zero unbaselined findings.  This is the gate that stops
+    a PR from reintroducing any machine-checked hazard class."""
+    findings = run([REPO / "pint_tpu"], ALL_RULES)
+    new, baselined = apply_baseline(
+        findings, load_baseline(REPO / "tools" / "lint" / "baseline.json")
+    )
+    assert not new, "\n".join(str(f) for f in new)
+    # the committed baseline stays (effectively) empty: deliberate
+    # exemptions are pragmas with justifying comments, never silent
+    # baseline entries
+    assert baselined == []
+
+
+def test_cli_exit_codes_and_json_stability(tmp_path, capsys):
+    """--json output is deterministic (sorted, path-relative) so the
+    driver can diff finding counts across PRs; exit 0/1 tracks
+    unbaselined findings."""
+    bad = tmp_path / "pint_tpu"
+    bad.mkdir()
+    (bad / "a.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def solve(A):\n"
+        "    return jnp.linalg.eigh(A)\n"
+    )
+    argv = [str(bad), "--baseline", str(tmp_path / "nope.json")]
+    assert main(argv + ["--json"]) == 1
+    out1 = capsys.readouterr().out
+    assert main(argv + ["--json"]) == 1
+    out2 = capsys.readouterr().out
+    assert out1 == out2  # stable across runs
+    payload = json.loads(out1)
+    assert payload["count"] == len(payload["findings"]) == 1
+    f = payload["findings"][0]
+    assert f["rule"] == "f64-emu" and f["line"] == 3
+    assert f["path"].endswith("pint_tpu/a.py")
+    # repo-tree findings render repo-relative (the cross-PR diff
+    # contract); tmp fixtures outside the repo stay absolute
+    assert Finding("x", REPO / "pint_tpu" / "a.py", 1, "m").relpath() \
+        == "pint_tpu/a.py"
+    # clean tree -> exit 0
+    (bad / "a.py").write_text("x = 1\n")
+    assert main(argv) == 0
+
+
+def test_baseline_suppresses_known_findings(tmp_path, capsys):
+    pkg = tmp_path / "pint_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def solve(A):\n"
+        "    return jnp.linalg.eigh(A)\n"
+    )
+    findings = run([pkg], ALL_RULES)
+    assert len(findings) == 1
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps([
+        {"rule": f.rule, "path": f.relpath(), "message": f.message}
+        for f in findings
+    ]))
+    assert main([str(pkg), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+
+
+def test_unified_and_legacy_pragmas():
+    src_obs = (
+        "import jax\n"
+        "f = jax.jit(lambda x: x)  # lint: ok(obs1)\n"
+        "g = jax.jit(lambda x: x)  # lint: obs-ok\n"
+        "h = jax.jit(lambda x: x)  # lint: ok(f64-emu)\n"
+    )
+    by_name = rules_by_name()
+    out = findings_for(by_name["obs1"], src_obs)
+    # only line 4's pragma names the WRONG rule and stays flagged
+    assert [f.lineno for f in out] == [4]
+
+
+def test_rules_cli_subset(capsys):
+    assert main(["--list-rules"]) == 0
+    names = capsys.readouterr().out
+    for r in ALL_RULES:
+        assert r.name in names
+    assert main(["--rules", "no-such-rule"]) == 2
+    capsys.readouterr()
+
+
+# -- migration identity ---------------------------------------------------
+OBS_FIXTURE = (
+    "import jax\n"
+    "from pint_tpu.runtime.guard import dispatch_guard\n"
+    "def make_step(cm, step):\n"
+    "    fn = dispatch_guard(jax.jit(step), site='x')\n"
+    "    bare = jax.jit(lambda x: cm.chi2(x))\n"
+    "    aot = jax.jit(step)  # lint: obs-ok\n"
+    "    return fn, bare, aot\n"
+    "@jax.jit\n"
+    "def run(xs):\n"
+    "    return xs\n"
+)
+
+SCALAR_FIXTURE = (
+    "import jax.numpy as jnp\n"
+    "def kernel(self, pdict, bundle):\n"
+    "    amp = jnp.power(10.0, pdict['TNREDAMP'])\n"
+    "    kom = pdict['KOM']\n"
+    "    s = jnp.sin(2.0 * kom)\n"
+    "    kin = pdict['KIN'] + bundle.dt\n"
+    "    v = jnp.sin(kin)\n"
+    "    sup = jnp.log(pdict['X'])  # lint: scalar-ok\n"
+    "    return amp, s, v, sup\n"
+)
+
+
+def test_shims_delegate_to_framework_rules():
+    """The old entry points are thin shims: same module, same finding
+    objects, same (path, lineno) sets as the framework rules — the
+    regression pin for 'finding-for-finding identical'."""
+    import lint_obs
+    import lint_scalarmath
+
+    obs_old = lint_obs.lint_source(OBS_FIXTURE, "pint_tpu/new.py")
+    by_name = rules_by_name()
+    obs_new = findings_for(by_name["obs1"], OBS_FIXTURE, "pint_tpu/new.py")
+    assert [(f.lineno) for f in obs_old] == [f.lineno for f in obs_new]
+    assert [f.lineno for f in obs_old] == [5, 8]
+    assert all(isinstance(f, Finding) for f in obs_old)
+
+    sc_old = lint_scalarmath.lint_source(SCALAR_FIXTURE, "k.py")
+    assert {(f.lineno, f.func) for f in sc_old} == {
+        (3, "power"), (5, "sin"),
+    }
+    assert all(isinstance(f, Finding) for f in sc_old)
+
+    # chokepoint surface still importable and clean on the real tree
+    assert lint_obs.check_chokepoints(REPO / "pint_tpu") == []
+    assert lint_obs.lint_paths([REPO / "pint_tpu"]) == []
+    assert lint_scalarmath.lint_paths([REPO / "pint_tpu"]) == []
+
+
+# -- f64-emu: the r5 eigh / r4 flush incident classes ---------------------
+def test_f64emu_flags_eigh_and_svd():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def solve(A, b):\n"
+        "    w, V = jnp.linalg.eigh(A)\n"          # r5 incident
+        "    U, s, Vt = jnp.linalg.svd(A)\n"
+        "    return w, s\n"
+    )
+    out = findings_for(F64EMU, src)
+    assert [f.lineno for f in out] == [3, 4]
+    assert "cond ~1e3" in out[0].message  # cites the r5 incident
+    # near-miss: the sanctioned shim and host numpy are clean
+    ok = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def _eigh_threshold_solve(A, b):\n"
+        "    w, V = jnp.linalg.eigh(A)\n"
+        "    return w\n"
+        "def host(A):\n"
+        "    return np.linalg.svd(A)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+    # pragma suppression
+    sup = (
+        "import jax.numpy as jnp\n"
+        "def cpu_only(A):\n"
+        "    return jnp.linalg.eigh(A)  # lint: ok(f64-emu)\n"
+    )
+    assert findings_for(F64EMU, sup) == []
+
+
+def test_f64emu_flags_unscaled_sum_of_squares():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def norms(M):\n"
+        "    return jnp.sqrt(jnp.sum(jnp.square(M), axis=0))\n"
+        "def chi2(r):\n"
+        "    return jnp.sum(r ** 2)\n"
+    )
+    assert [f.lineno for f in findings_for(F64EMU, src)] == [3, 5]
+    # near-misses: the |max|-prescale idiom (a division), whitened
+    # residuals, and component-axis vector norms
+    ok = (
+        "import jax.numpy as jnp\n"
+        "def norms(M):\n"
+        "    mx = jnp.max(jnp.abs(M), axis=0)\n"
+        "    return jnp.sqrt(jnp.sum(jnp.square(M / mx[None, :]), axis=0)) * mx\n"
+        "def chi2(r, sig):\n"
+        "    return jnp.sum(jnp.square(r / sig))\n"
+        "def r2(r):\n"
+        "    return jnp.sum(r * r, axis=-1)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+    sup = (
+        "import jax.numpy as jnp\n"
+        "def small(x):\n"
+        "    return jnp.sum(jnp.square(x))  # lint: ok(f64-emu)\n"
+    )
+    assert findings_for(F64EMU, sup) == []
+
+
+def test_f64emu_flags_default_precision_matmul_in_tagged_module():
+    src = (
+        "# lint: module(matmul-highest)\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def gram(W):\n"
+        "    return W @ W.T\n"
+        "def gram2(W):\n"
+        "    return jnp.matmul(W, W.T)\n"
+    )
+    assert [f.lineno for f in findings_for(F64EMU, src)] == [5, 7]
+    # near-misses: precision passed, or an untagged module
+    ok = (
+        "# lint: module(matmul-highest)\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def gram(W):\n"
+        "    return jnp.matmul(W, W.T, precision=jax.lax.Precision.HIGHEST)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+    untagged = "def gram(W):\n    return W @ W.T\n"
+    assert findings_for(F64EMU, untagged) == []
+
+
+def test_f64emu_flags_tiny_literal_product():
+    """The r4 incident class: a sub-flush-threshold factor multiplied
+    on device flushes the whole product to zero."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def phi(amp2, f, gamma):\n"
+        "    return amp2 * 3.9e-48 * f ** (-gamma)\n"  # ~ the r4 value
+    )
+    out = findings_for(F64EMU, src)
+    assert [f.lineno for f in out] == [3]
+    assert "log" in out[0].message.lower()
+    # near-misses: the log-space form and a floor comparison
+    ok = (
+        "import jax.numpy as jnp\n"
+        "def phi(log10_amp, f, gamma, k):\n"
+        "    amp2_k = 10.0 ** (2.0 * log10_amp + k)\n"
+        "    return jnp.maximum(amp2_k * f ** (-gamma), 1e-30)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+    sup = (
+        "def p(x):\n"
+        "    return x * 1e-40  # lint: ok(f64-emu)\n"
+    )
+    assert findings_for(F64EMU, sup) == []
+
+
+# -- transport: the r5 HTTP-413 incident class ----------------------------
+TRANSPORT_BAD = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "def make_kernel(cm, data):\n"
+    "    ops = jax.device_put(data)\n"
+    "    basis = jnp.asarray(data)\n"
+    "    def kernel(x):\n"
+    "        return ops @ x + basis.sum()\n"
+    "    return jax.jit(kernel)\n"
+)
+
+
+def test_transport_flags_closure_captured_device_array():
+    out = findings_for(TRANSPORT, TRANSPORT_BAD)
+    assert {f.lineno for f in out} == {7}
+    assert len(out) == 2  # both captures, named
+    assert {("ops" in f.message or "basis" in f.message)
+            for f in out} == {True}
+    assert "413" in out[0].message
+
+
+def test_transport_allows_arguments_and_scalars():
+    ok = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def make_kernel(cm, data, scale):\n"
+        "    ops = jax.device_put(data)\n"
+        "    def kernel(ops_arg, x):\n"          # rides as argument
+        "        return ops_arg @ x * scale\n"   # scalar capture: fine
+        "    return jax.jit(kernel), ops\n"
+    )
+    assert findings_for(TRANSPORT, ok) == []
+    sup = TRANSPORT_BAD.replace(
+        "return ops @ x + basis.sum()",
+        "return ops @ x + basis.sum()  # lint: ok(transport)",
+    )
+    assert findings_for(TRANSPORT, sup) == []
+
+
+def test_transport_sees_traced_jit_and_cm_jit():
+    """The serve chokepoint (traced_jit) and cm.jit count as traces."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "from pint_tpu.serve.session import traced_jit\n"
+        "def build(session, data):\n"
+        "    stack = jnp.asarray(data)\n"
+        "    def run(xs):\n"
+        "        return stack * xs\n"
+        "    return traced_jit(run, 'site')\n"
+        "def build2(cm, data):\n"
+        "    stack2 = jnp.asarray(data)\n"
+        "    return cm.jit(lambda x: stack2 + x)\n"
+    )
+    out = findings_for(TRANSPORT, src)
+    assert {f.lineno for f in out} == {6, 10}
+
+
+# -- retrace --------------------------------------------------------------
+def test_retrace_flags_host_coercions_in_kernels():
+    src = (
+        "import jax\n"
+        "def kernel(x, n):\n"
+        "    s = x.sum()\n"
+        "    if float(s) > 0:\n"
+        "        return x\n"
+        "    return x * s.item()\n"
+        "k = jax.jit(kernel)\n"
+    )
+    out = findings_for(RETRACE, src)
+    linenos = sorted(f.lineno for f in out)
+    assert 4 in linenos  # float() coercion
+    assert 6 in linenos  # .item()
+    # near-miss: the same coercions OUTSIDE any traced body are host
+    # code and fine
+    ok = (
+        "def host(x):\n"
+        "    return float(x.sum()), x.item()\n"
+    )
+    assert findings_for(RETRACE, ok) == []
+
+
+def test_retrace_flags_value_branch_allows_shape_branch():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(A, b):\n"
+        "    if A.shape[0] < A.shape[1]:\n"   # static: allowed
+        "        return b\n"
+        "    if b > 0:\n"                     # value-dependent: flagged
+        "        return A\n"
+        "    return A\n"
+    )
+    out = findings_for(RETRACE, src)
+    assert [f.lineno for f in out] == [6]
+    sup = src.replace("if b > 0:", "if b > 0:  # lint: ok(retrace)")
+    assert findings_for(RETRACE, sup) == []
+
+
+def test_retrace_flags_unordered_cache_keys():
+    src = (
+        "def composition_key(parts, masks):\n"
+        "    return (tuple(masks.items()), tuple(set(parts)))\n"
+    )
+    out = findings_for(RETRACE, src)
+    assert len(out) == 2  # the dict view AND the set
+    # near-miss: sorted views, and dict views outside key functions
+    ok = (
+        "def composition_key(masks):\n"
+        "    return tuple(sorted(masks.items()))\n"
+        "def render(masks):\n"
+        "    return tuple(masks.items())\n"
+    )
+    assert findings_for(RETRACE, ok) == []
+
+
+# -- locks: the PR 5 fabric race class ------------------------------------
+LOCKS_BAD = (
+    "import threading\n"
+    "class Replica:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._queue = []  # lint: guarded-by(_lock)\n"
+    "    def submit(self, work):\n"
+    "        self._queue.append(work)\n"      # off-lock: the bug class
+    "    def drain(self):\n"
+    "        with self._lock:\n"
+    "            self._queue.clear()\n"       # locked: fine
+)
+
+
+def test_locks_flags_off_lock_mutation():
+    out = findings_for(LOCKS, LOCKS_BAD)
+    assert [f.lineno for f in out] == [7]
+    assert "guarded-by(_lock)" in out[0].message
+
+
+def test_locks_allows_locked_holds_and_pragma():
+    ok = (
+        "import threading\n"
+        "class Session:\n"
+        "    def __init__(self):\n"
+        "        self.trace_lock = threading.Lock()\n"
+        "        self._proto = None  # lint: guarded-by(trace_lock)\n"
+        "    def swap(self, b):\n"
+        "        with self.trace_lock:\n"
+        "            self._proto = b\n"
+        "    def _swap_locked(self, b):\n"      # *_locked convention
+        "        self._proto = b\n"
+        "    def _set(self, b):  # lint: holds(trace_lock)\n"
+        "        self._proto = b\n"
+    )
+    assert findings_for(LOCKS, ok) == []
+    sup = LOCKS_BAD.replace(
+        "self._queue.append(work)",
+        "self._queue.append(work)  # lint: ok(locks)",
+    )
+    assert findings_for(LOCKS, sup) == []
+
+
+def test_locks_flags_wrong_lock_and_subscript():
+    src = (
+        "import threading\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._other = threading.Lock()\n"
+        "        self._sessions = {}  # lint: guarded-by(_lock)\n"
+        "    def put(self, k, v):\n"
+        "        with self._other:\n"         # WRONG lock
+        "            self._sessions[k] = v\n"
+    )
+    out = findings_for(LOCKS, src)
+    assert [f.lineno for f in out] == [9]
+
+
+# -- incident-class acceptance: the real modules carry the guards ---------
+def test_real_tree_declares_the_incident_guards():
+    """The acceptance wiring is live in the production tree: the
+    mixed-precision modules are matmul-tagged, the serving stack
+    declares its lock discipline, and the one deliberate eigh/svd
+    site is the sanctioned shim (plus the pragma'd CPU-only SVD)."""
+    ffgram = (REPO / "pint_tpu" / "ops" / "ffgram.py").read_text()
+    dense = (REPO / "pint_tpu" / "parallel" / "dense.py").read_text()
+    assert "lint: module(matmul-highest)" in ffgram
+    assert "lint: module(matmul-highest)" in dense
+    replica = (
+        REPO / "pint_tpu" / "serve" / "fabric" / "replica.py"
+    ).read_text()
+    assert "lint: guarded-by(_state_lock)" in replica
+    assert "lint: guarded-by(_cond)" in replica
+    engine_src = (REPO / "pint_tpu" / "serve" / "engine.py").read_text()
+    assert "lint: guarded-by(_cond)" in engine_src

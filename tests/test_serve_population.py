@@ -174,6 +174,27 @@ def test_fit_commits_against_own_par(stack_engine, population):
         assert abs(fitted_f0 - own_f0) < 1e-10 * own_f0
 
 
+def test_fit_responses_never_reparse(stack_engine, population):
+    """The ROADMAP item-2 leftover, pinned: materializing each fit
+    response clones the record's already-parsed model instead of
+    re-parsing the par text (ParRecord.commit_clone ->
+    TimingModel.clone), so steady-state fit traffic over admitted
+    pars moves the exact host-parse ledger (``model.parses``,
+    models/builder.py::get_model) by ZERO."""
+    pars, toas = population
+    # admit (and warm) these pars first — admission parses are the one
+    # legitimate cost, paid before the measurement window opens
+    _serve_wave(stack_engine, [
+        FitRequest(par=p, toas=toas, maxiter=2) for p in pars[:3]
+    ])
+    parses0 = obs_metrics.counter("model.parses").value
+    resps = _serve_wave(stack_engine, [
+        FitRequest(par=p, toas=toas, maxiter=2) for p in pars[:3]
+    ] * 2)
+    assert all(r.fitted_par for r in resps)  # responses materialized
+    assert obs_metrics.counter("model.parses").value == parses0
+
+
 def test_population_observability(stack_engine):
     """The per-composition ledger + flight report breakdown exist and
     the compile count did not scale with pars."""

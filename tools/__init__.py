@@ -1,0 +1,6 @@
+"""Repo tooling (linters, profilers, citation regen).
+
+``tools.lint`` is the unified hazard-analysis framework
+(docs/static_analysis.md); ``tools/lint_obs.py`` and
+``tools/lint_scalarmath.py`` are thin back-compat shims over it.
+"""

@@ -1,0 +1,26 @@
+"""pintlint: the unified hazard-analysis framework.
+
+``python -m tools.lint [paths]`` runs every rule; see
+docs/static_analysis.md for the rule catalog, pragma syntax, baseline
+semantics, and how to add a rule.
+"""
+
+from __future__ import annotations
+
+from .engine import (  # noqa: F401
+    Finding,
+    Module,
+    Rule,
+    apply_baseline,
+    check_module,
+    load_baseline,
+    main,
+    run,
+    suppressed,
+)
+
+
+def all_rules():
+    from .rules import ALL_RULES
+
+    return ALL_RULES

@@ -1,0 +1,300 @@
+"""pintlint engine: shared AST analysis substrate for the hazard rules.
+
+The codebase's hardest bugs have all been invisible to the CPU test
+mesh — the r4 log-space flush that zeroed the power-law phi, the r5
+eigh solve that lost all accuracy past cond ~1e3, the r5 HTTP-413 hang
+from closure-captured constants, the PR 5 fabric races around
+``Session.trace_lock``.  Each hazard class is documented
+(CLAUDE.md, docs/precision.md) but documentation does not fail a PR;
+this framework does.  One engine (module loader, parent-tracked
+walker, per-rule plugin registry, unified pragma, optional baseline,
+text + JSON output) serves every rule so adding a hazard class is one
+small plugin, not a fourth hand-rolled linter.
+
+Vocabulary:
+
+- a :class:`Rule` contributes per-module findings
+  (:meth:`Rule.check_module`) and/or whole-package findings
+  (:meth:`Rule.check_project` — the obs chokepoint meta-checks);
+- a :class:`Module` wraps one parsed source file with lazily-built
+  parent links (``Module.parents``) so rules can walk upward;
+- a :class:`Finding` is one diagnostic; its identity for baseline
+  matching is (rule, relative path, message) — line numbers drift,
+  messages don't;
+- the pragma ``# lint: ok(<rule>[, <rule>...])`` on a finding's line
+  suppresses it (justify in an adjacent comment); the pre-framework
+  pragmas ``# lint: obs-ok`` / ``# lint: scalar-ok`` keep working for
+  their rules (``Rule.legacy_pragma``).
+
+CLI: ``python -m tools.lint [paths...]`` (default: pint_tpu/), with
+``--json`` (stable: sorted, path-relative), ``--rules``,
+``--baseline`` (default tools/lint/baseline.json), ``--list-rules``.
+Exit status 1 when unbaselined findings exist.  Wired into tier-1 as
+tests/test_lint_framework.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_OK_RE = re.compile(r"lint:\s*ok\(([^)]*)\)")
+
+
+class Finding:
+    """One diagnostic.  ``func`` carries the jnp function name for the
+    scalarmath rule's back-compat consumers (tests/test_lint_scalarmath
+    .py reads it); other rules leave it None."""
+
+    __slots__ = ("rule", "path", "lineno", "message", "func")
+
+    def __init__(self, rule: str, path, lineno: int, message: str,
+                 func: str | None = None):
+        self.rule = rule
+        self.path = str(path)
+        self.lineno = int(lineno)
+        self.message = message
+        self.func = func
+
+    def relpath(self) -> str:
+        p = Path(self.path)
+        try:
+            p = p.resolve().relative_to(REPO_ROOT)
+        except ValueError:
+            pass
+        return p.as_posix()
+
+    def key(self) -> tuple:
+        """Baseline identity: line numbers drift across edits, the
+        (rule, file, message) triple doesn't."""
+        return (self.rule, self.relpath(), self.message)
+
+    def as_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.relpath(),
+            "line": self.lineno,
+            "message": self.message,
+        }
+
+    def __str__(self):
+        return f"{self.path}:{self.lineno}: [{self.rule}] {self.message}"
+
+    __repr__ = __str__
+
+
+class Module:
+    """One parsed source file + lazy parent links for upward walks."""
+
+    def __init__(self, path, source: str):
+        self.path = str(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self._parents: dict | None = None
+
+    @property
+    def parents(self) -> dict:
+        """id(child node) -> parent node, whole tree."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[id(child)] = node
+        return self._parents
+
+    def parent(self, node):
+        return self.parents.get(id(node))
+
+    def ancestors(self, node):
+        """Parents from ``node`` outward to the module root."""
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+    def enclosing_function(self, node):
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Rule:
+    """Plugin base.  Subclasses set ``name`` (the pragma token and the
+    JSON/baseline tag) and override one or both hooks; the docstring
+    names the incident the rule guards against."""
+
+    name: str = ""
+    legacy_pragma: str | None = None
+
+    def check_module(self, mod: Module) -> list:
+        return []
+
+    def check_project(self, pkg_root: Path) -> list:
+        return []
+
+
+def suppressed(rule: Rule, mod: Module, lineno: int) -> bool:
+    """Unified pragma: ``# lint: ok(<rule>)`` (comma list accepted) on
+    the finding's line, or the rule's legacy pragma."""
+    line = mod.line(lineno)
+    m = _OK_RE.search(line)
+    if m:
+        names = {s.strip() for s in m.group(1).split(",")}
+        if rule.name in names or "all" in names:
+            return True
+    return bool(rule.legacy_pragma and rule.legacy_pragma in line)
+
+
+def check_module(mod: Module, rules) -> list:
+    """All per-module findings for one parsed file, pragma-filtered."""
+    findings = []
+    for rule in rules:
+        for f in rule.check_module(mod):
+            if not suppressed(rule, mod, f.lineno):
+                findings.append(f)
+    return findings
+
+
+def iter_py_files(paths):
+    for root in paths:
+        root = Path(root)
+        if root.is_file():
+            yield root
+        else:
+            yield from sorted(root.rglob("*.py"))
+
+
+def looks_like_package_root(path: Path) -> bool:
+    """A lint target that carries the framework's instrumented
+    chokepoints gets the whole-package checks (obs2-obs5) too — the
+    auto equivalent of the old ``lint_obs.py`` no-argv default."""
+    return path.is_dir() and (path / "runtime" / "guard.py").is_file()
+
+
+def run(paths, rules, project_checks: bool = True) -> list:
+    """Lint ``paths`` with ``rules``; returns pragma-filtered findings
+    sorted by (path, line, rule, message) — the stable order the JSON
+    output and baseline diffing rely on."""
+    roots = [Path(p) for p in paths]
+    findings = []
+    for py in iter_py_files(roots):
+        mod = Module(py, py.read_text())
+        findings.extend(check_module(mod, rules))
+    if project_checks:
+        for root in roots:
+            if looks_like_package_root(root):
+                for rule in rules:
+                    findings.extend(rule.check_project(root))
+    findings.sort(
+        key=lambda f: (f.relpath(), f.lineno, f.rule, f.message)
+    )
+    return findings
+
+
+# -- baseline -------------------------------------------------------------
+def load_baseline(path) -> list:
+    """Baseline entries: [{"rule", "path", "message"}, ...].  Absent
+    file = empty baseline (the committed default stays empty; a true
+    positive with a deliberate exemption gets a pragma + justifying
+    comment, never a silent baseline entry — see docs/static_analysis
+    .md)."""
+    path = Path(path)
+    if not path.is_file():
+        return []
+    entries = json.loads(path.read_text() or "[]")
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path} must be a JSON list")
+    return entries
+
+
+def apply_baseline(findings, entries):
+    """-> (new, baselined) partition by (rule, path, message) key."""
+    keys = {
+        (e.get("rule"), e.get("path"), e.get("message"))
+        for e in entries
+    }
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in keys else new).append(f)
+    return new, old
+
+
+# -- CLI ------------------------------------------------------------------
+def main(argv=None) -> int:
+    # the rules package is imported lazily so `engine` has no import
+    # cycle with the rule modules it hosts
+    if __package__:
+        from .rules import ALL_RULES, rules_by_name
+    else:  # tools/ on sys.path (the shim import style)
+        from lint.rules import ALL_RULES, rules_by_name
+
+    ap = argparse.ArgumentParser(
+        prog="tools.lint",
+        description="pintlint: unified hazard analysis "
+                    "(docs/static_analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: pint_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="stable JSON output (sorted, path-relative)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON (default: tools/lint/baseline.json)")
+    ap.add_argument("--no-project-checks", action="store_true",
+                    help="skip the whole-package chokepoint checks")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            doc = (r.__doc__ or "").strip().splitlines()
+            print(f"{r.name:<12} {doc[0] if doc else ''}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rules:
+        by_name = rules_by_name()
+        names = [s.strip() for s in args.rules.split(",") if s.strip()]
+        unknown = [n for n in names if n not in by_name]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [by_name[n] for n in names]
+
+    paths = args.paths or [REPO_ROOT / "pint_tpu"]
+    findings = run(paths, rules,
+                   project_checks=not args.no_project_checks)
+    new, baselined = apply_baseline(
+        findings, load_baseline(args.baseline)
+    )
+
+    if args.as_json:
+        print(json.dumps({
+            "rules": [r.name for r in rules],
+            "count": len(new),
+            "baselined": len(baselined),
+            "findings": [f.as_json() for f in new],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f)
+        if new:
+            print(f"{len(new)} finding(s)"
+                  + (f" ({len(baselined)} baselined)" if baselined
+                     else ""))
+    return 1 if new else 0

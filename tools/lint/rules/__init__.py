@@ -1,0 +1,20 @@
+"""pintlint rule registry (docs/static_analysis.md has the catalog).
+
+Ordering is the report order for equal (path, line); keep migrated
+rules first so shim output stays familiar.
+"""
+
+from __future__ import annotations
+
+from .scalarmath import RULE as SCALARMATH
+from .obs import RULES as OBS_RULES
+from .f64emu import RULE as F64EMU
+from .transport import RULE as TRANSPORT
+from .retrace import RULE as RETRACE
+from .locks import RULE as LOCKS
+
+ALL_RULES = (SCALARMATH, *OBS_RULES, F64EMU, TRANSPORT, RETRACE, LOCKS)
+
+
+def rules_by_name() -> dict:
+    return {r.name: r for r in ALL_RULES}

@@ -1,0 +1,165 @@
+"""Shared discovery of jit-traced function bodies.
+
+The transport and retrace rules both need to know which function
+bodies end up inside an XLA trace.  Syntactically a body is traced
+when it is
+
+- passed to a jit-like callable: ``jax.jit(f)``, any ``.jit(f)``
+  method (``cm.jit`` — models/timing_model.py), or ``traced_jit(f)``
+  (serve/session.py), possibly through ``jax.vmap``/
+  ``functools.partial`` wrappers; or
+- decorated with ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``.
+
+Resolution is lexical: a ``Name`` argument resolves to the nearest
+enclosing-scope ``def`` of that name; attribute-valued arguments
+(``self.cm.chi2``) are out of reach for a syntactic pass and are
+skipped — the runtime guard remains the backstop there.
+"""
+
+from __future__ import annotations
+
+import ast
+
+#: bare-name jit-like callables (the serve dispatch chokepoint)
+JIT_NAME_FUNCS = {"traced_jit"}
+
+#: wrappers whose first argument is the function being traced
+_TRANSPARENT_WRAPPERS = {"vmap", "partial", "grad", "value_and_grad"}
+
+
+def _is_jit_func(f) -> bool:
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None
+    )
+    return name in JIT_NAME_FUNCS
+
+
+def _unwrap(expr):
+    """Peel jax.vmap(f)/functools.partial(f, ...) down to f."""
+    while (
+        isinstance(expr, ast.Call)
+        and expr.args
+        and (
+            (isinstance(expr.func, ast.Attribute)
+             and expr.func.attr in _TRANSPARENT_WRAPPERS)
+            or (isinstance(expr.func, ast.Name)
+                and expr.func.id in _TRANSPARENT_WRAPPERS)
+        )
+    ):
+        expr = expr.args[0]
+    return expr
+
+
+def _resolve_name(mod, call, name: str):
+    """Nearest def of ``name`` in the call's enclosing scopes."""
+    scopes = [
+        a for a in mod.ancestors(call)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Module))
+    ]
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name == name:
+                return node
+    return None
+
+
+def _is_jit_decorator(dec) -> bool:
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        # @functools.partial(jax.jit, static_argnames=...) and
+        # @jax.jit(...)-style configured decorators
+        if _is_jit_func(dec.func):
+            return True
+        if (
+            isinstance(dec.func, ast.Attribute)
+            and dec.func.attr == "partial"
+            and dec.args
+            and isinstance(dec.args[0], ast.Attribute)
+            and dec.args[0].attr == "jit"
+        ):
+            return True
+    return False
+
+
+def traced_functions(mod) -> list:
+    """[(def-or-lambda node, the jit call/decorator site node)] for
+    every function body this module syntactically hands to a trace."""
+    out = []
+    seen: set[int] = set()
+
+    def add(fn, site):
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, site))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _is_jit_func(node.func):
+            if not node.args:
+                continue
+            target = _unwrap(node.args[0])
+            if isinstance(target, ast.Lambda):
+                add(target, node)
+            elif isinstance(target, ast.Name):
+                add(_resolve_name(mod, node, target.id), node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                add(node, node)
+    return out
+
+
+def param_names(fn) -> set:
+    a = fn.args
+    names = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def bound_names(fn) -> set:
+    """Every name bound anywhere inside ``fn`` (params, assignments,
+    loop/with/comprehension targets, nested defs, imports) — the
+    complement of the free/closure-captured set."""
+    names = param_names(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ) and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.Lambda):
+            names.update(param_names(node))
+    return names
+
+
+def free_loads(fn):
+    """[(name, Name node)] loads inside ``fn`` of names not bound in
+    it — closure captures, in first-occurrence order."""
+    bound = bound_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    loads = []
+    seen = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id not in bound
+                and node.id not in seen
+            ):
+                seen.add(node.id)
+                loads.append((node.id, node))
+    return loads

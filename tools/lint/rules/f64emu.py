@@ -1,0 +1,249 @@
+"""Rule ``f64-emu``: arithmetic patterns that silently break under
+axon's f32-pair emulated f64 (docs/precision.md).
+
+The emulation keeps the f32 EXPONENT range and is non-IEEE, so four
+documented hazard classes pass every CPU test and fail only on chip:
+
+1. **decompositions** — ``jnp.linalg.svd`` NaNs outright under the
+   emulation, and ``jnp.linalg.eigh`` is only ~f32-accurate (r5
+   incident: the WLS gram/eigh solve silently lost ALL accuracy past
+   cond ~1e3; accelerator WLS is QR now).  Any call outside the
+   sanctioned thresholded-eigh shim (fitting/gls.py::
+   _eigh_threshold_solve) is flagged.
+2. **unscaled sums of squares** — design columns reach ~1e17-1e21 and
+   their squares overflow the f32 exponent range to inf->NaN (r5
+   incident: weighted-design column norms).  ``jnp.sum`` of a square
+   is flagged unless the squared operand is |max|-prescaled (a
+   division, the fitting/gls.py::_column_norms idiom).
+3. **matmul precision** — TPU-default matmuls are bf16-pass; in
+   modules carrying the ``# lint: module(matmul-highest)`` marker
+   (the mixed-precision linear-algebra core, where a single bf16 pass
+   loses ~1e-3 and NaNs Schur complements — parallel/dense.py::
+   blocked_cholesky) every matmul must pass an explicit
+   ``precision=``; the bare ``@`` operator cannot, so it is flagged
+   there too.
+4. **tiny-literal products** — float literals below the emulation's
+   ~1.2e-38 flush threshold multiplied into device expressions flush
+   to ZERO (r4 incident: A^2 * f_yr^(gamma-3) ~ 4e-38 silently zeroed
+   the power-law phi on device; models/noise.py::powerlaw_phi forms
+   such products in log space).
+
+Suppress with ``# lint: ok(f64-emu)`` plus a justifying comment (e.g.
+a CPU-only code path).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Module, Rule
+
+#: functions allowed to call jnp.linalg.eigh/svd: the sanctioned
+#: degenerate-direction shim every solver routes through
+ALLOWED_DECOMP_FNS = {"_eigh_threshold_solve"}
+
+#: the per-module opt-in marker for check 3 (add it to modules whose
+#: docstring/comments promise a matmul precision contract)
+MATMUL_MARKER = "lint: module(matmul-highest)"
+
+#: jnp matmul-family callables that accept a precision kwarg
+_MATMUL_FUNCS = {"dot", "matmul", "einsum", "tensordot", "vdot"}
+
+#: axon's emulated-f64 subnormal flush threshold (~f32 tiny)
+FLUSH_THRESHOLD = 1.2e-38
+
+
+def _is_jnp(node) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in ("jnp", "jax.numpy")
+    if isinstance(node, ast.Attribute):
+        return (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+            and node.attr == "numpy"
+        )
+    return False
+
+
+def _is_jnp_linalg(node) -> bool:
+    """The ``jnp.linalg`` in ``jnp.linalg.eigh`` (jax.numpy too)."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "linalg"
+        and _is_jnp(node.value)
+    )
+
+
+def _is_square(node) -> ast.AST | None:
+    """The squared operand when ``node`` is a square: jnp.square(E),
+    E ** 2, or E * E (identical sides); else None."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "square"
+        and _is_jnp(node.func.value)
+        and node.args
+    ):
+        return node.args[0]
+    if isinstance(node, ast.BinOp):
+        if (
+            isinstance(node.op, ast.Pow)
+            and isinstance(node.right, ast.Constant)
+            and node.right.value == 2
+        ):
+            return node.left
+        if isinstance(node.op, ast.Mult) and ast.dump(
+            node.left
+        ) == ast.dump(node.right):
+            return node.left
+    return None
+
+
+class F64EmuRule(Rule):
+    """Emulated-f64 hazards: eigh/svd, unscaled sums of squares,
+    default-precision matmuls in tagged modules, tiny-literal
+    products (r4 phi flush / r5 eigh / r5 column-norm overflow)."""
+
+    name = "f64-emu"
+
+    def check_module(self, mod: Module) -> list:
+        findings = []
+        tagged = MATMUL_MARKER in mod.source
+        for node in ast.walk(mod.tree):
+            findings += self._decomposition(mod, node)
+            findings += self._sum_of_squares(mod, node)
+            if tagged:
+                findings += self._matmul_precision(mod, node)
+            findings += self._tiny_literal(mod, node)
+        return sorted(findings, key=lambda f: (f.lineno, f.message))
+
+    # -- 1. eigh/svd -------------------------------------------------------
+    def _decomposition(self, mod, node) -> list:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("eigh", "svd")
+            and _is_jnp_linalg(node.func.value)
+        ):
+            return []
+        fn = mod.enclosing_function(node)
+        if fn is not None and fn.name in ALLOWED_DECOMP_FNS:
+            return []
+        what = node.func.attr
+        detail = (
+            "NaNs outright under axon's emulated f64" if what == "svd"
+            else "is only ~f32-accurate under axon's emulated f64 "
+                 "(r5: the WLS gram/eigh solve silently lost all "
+                 "accuracy past cond ~1e3)"
+        )
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            f"jnp.linalg.{what} {detail} — use QR/Cholesky, or route "
+            "degenerate-direction zeroing through fitting/gls.py::"
+            "_eigh_threshold_solve (the sanctioned shim); suppress "
+            "with '# lint: ok(f64-emu)' only on CPU-pinned paths "
+            "(docs/precision.md)",
+        )]
+
+    # -- 2. unscaled sum of squares ---------------------------------------
+    def _sum_of_squares(self, mod, node) -> list:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("sum", "nansum")
+            and _is_jnp(node.func.value)
+            and node.args
+        ):
+            return []
+        sq = _is_square(node.args[0])
+        if sq is None:
+            return []
+        # axis=-1 reductions are component-axis vector norms (Roemer/
+        # Shapiro geometry, |r| ~ 1e2-1e4 light-seconds) — the
+        # incident class is TOA-axis reductions of design-scale
+        # (~1e17-1e21) columns, which reduce axis 0 or everything
+        for kw in node.keywords:
+            if kw.arg == "axis":
+                v = kw.value
+                if isinstance(v, ast.UnaryOp) and isinstance(
+                    v.op, ast.USub
+                ):
+                    v = v.operand
+                    if isinstance(v, ast.Constant) and v.value == 1:
+                        return []
+        # the prescale idiom: the squared operand is a division
+        # (x / x_max), so every squared intermediate stays <= n — the
+        # fitting/gls.py::_column_norms recipe (and whitened residuals
+        # r / sigma, already O(1))
+        if isinstance(sq, ast.BinOp) and isinstance(sq.op, ast.Div):
+            return []
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            "sum of squares without |max|-prescale — on axon's "
+            "emulated f64 (f32 EXPONENT range) squaring values >~1e19 "
+            "overflows to inf->NaN (r5: weighted design columns); "
+            "divide by the |max| first (fitting/gls.py::_column_norms) "
+            "or suppress with '# lint: ok(f64-emu)' if the operand is "
+            "provably O(1)",
+        )]
+
+    # -- 3. matmul precision in tagged modules ----------------------------
+    def _matmul_precision(self, mod, node) -> list:
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, ast.MatMult
+        ):
+            return [Finding(
+                self.name, mod.path, node.lineno,
+                "bare '@' matmul in a matmul-highest module — TPU-"
+                "default matmuls are bf16-pass (a single pass loses "
+                "~1e-3 and NaNs Schur cancellations; parallel/dense.py"
+                "::blocked_cholesky) and '@' cannot carry a precision "
+                "argument: use jnp.matmul(..., precision=jax.lax."
+                "Precision.HIGHEST)",
+            )]
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and (
+                (node.func.attr in _MATMUL_FUNCS
+                 and _is_jnp(node.func.value))
+                or node.func.attr == "dot_general"
+            )
+            and not any(k.arg == "precision" for k in node.keywords)
+        ):
+            return [Finding(
+                self.name, mod.path, node.lineno,
+                f"{node.func.attr} without an explicit precision= in "
+                "a matmul-highest module — TPU-default matmuls are "
+                "bf16-pass; pass precision=jax.lax.Precision.HIGHEST "
+                "(or HIGH with a documented refinement contract)",
+            )]
+        return []
+
+    # -- 4. sub-flush literals in products --------------------------------
+    def _tiny_literal(self, mod, node) -> list:
+        if not (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, float)
+            and 0.0 < abs(node.value) < FLUSH_THRESHOLD
+        ):
+            return []
+        parent = mod.parent(node)
+        while isinstance(parent, ast.UnaryOp):
+            parent = mod.parent(parent)
+        if not (
+            isinstance(parent, ast.BinOp)
+            and isinstance(parent.op, (ast.Mult, ast.Div, ast.Pow))
+        ):
+            return []
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            f"float literal {node.value!r} is below axon's emulated-"
+            "f64 flush threshold (~1.2e-38): products of tiny factors "
+            "flush to ZERO on device (r4: A^2*f_yr^(gamma-3) ~4e-38 "
+            "silently zeroed the power-law phi) — form the product in "
+            "LOG space (models/noise.py::powerlaw_phi)",
+        )]
+
+
+RULE = F64EmuRule()

@@ -1,0 +1,189 @@
+"""Rule ``locks``: declared-lock discipline for the serving stack.
+
+The PR 5 fabric races were all one shape: state shared across the
+collector / dispatcher / fencer / prober threads mutated without the
+lock its readers synchronize on (the ``Session.trace_lock``
+shared-prototype mutation class, fixed by hand in PR 5).  Nothing on
+the CPU mesh reproduces the interleavings reliably, so the discipline
+is declared and machine-checked instead:
+
+- a field is DECLARED guarded at its initializing assignment::
+
+      self._queue = collections.deque()  # lint: guarded-by(_cond)
+
+- every later mutation of ``self._queue`` (assignment, augmented
+  assignment, ``del``, item assignment, or a mutating method call —
+  append/pop/clear/update/...) must sit lexically inside a matching
+  ``with self._cond:`` block, OR inside a method that documents the
+  caller-holds contract: a ``*_locked`` name suffix (holds every
+  declared lock — the serve/session.py convention) or an explicit
+  ``def _set_state(...):  # lint: holds(_state_lock)`` annotation.
+- ``__init__`` is exempt (no concurrent readers exist yet).
+- reads are NOT checked — the codebase deliberately does lock-free
+  GIL-atomic reads of health/depth fields (serve/fabric/replica.py).
+
+This is a syntactic race detector: it cannot see locks taken by a
+caller at runtime, so the two annotations above are the escape for
+intentional designs — and a mutation with neither annotation nor a
+``with`` is exactly the PR 5 bug class.  Suppress a single site with
+``# lint: ok(locks)`` plus a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, Module, Rule
+
+GUARD_RE = re.compile(r"lint:\s*guarded-by\((\w+)\)")
+HOLDS_RE = re.compile(r"lint:\s*holds\((\w+(?:\s*,\s*\w+)*)\)")
+
+#: method calls that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "add", "update", "setdefault", "move_to_end", "sort", "reverse",
+    "put", "put_nowait",
+}
+
+
+def _self_field(node) -> str | None:
+    """'X' when node is ``self.X`` (Attribute on the Name self)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _mutation_targets(node):
+    """(field, description) pairs for mutations of self.<field> in one
+    statement/expression node."""
+    out = []
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in targets:
+            field = _self_field(t)
+            if field:
+                out.append((field, f"assignment to self.{field}"))
+            elif isinstance(t, ast.Subscript):
+                field = _self_field(t.value)
+                if field:
+                    out.append(
+                        (field, f"item assignment on self.{field}")
+                    )
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            field = _self_field(t) or (
+                _self_field(t.value)
+                if isinstance(t, ast.Subscript) else None
+            )
+            if field:
+                out.append((field, f"del on self.{field}"))
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            field = _self_field(f.value)
+            if field:
+                out.append(
+                    (field, f"self.{field}.{f.attr}(...)")
+                )
+    return out
+
+
+def _held_locks(mod: Module, node) -> set:
+    """Lock fields whose ``with self.<lock>:`` lexically encloses
+    ``node``."""
+    held = set()
+    for a in mod.ancestors(node):
+        if isinstance(a, (ast.With, ast.AsyncWith)):
+            for item in a.items:
+                field = _self_field(item.context_expr)
+                if field:
+                    held.add(field)
+        elif isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # lock scope ends at the enclosing function
+    return held
+
+
+class LocksRule(Rule):
+    """Off-lock mutation of a field declared ``# lint: guarded-by(L)``
+    (the PR 5 ``Session.trace_lock`` shared-state race class)."""
+
+    name = "locks"
+
+    def check_module(self, mod: Module) -> list:
+        findings = []
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings += self._check_class(mod, cls)
+        return sorted(findings, key=lambda f: (f.lineno, f.message))
+
+    def _declared(self, mod, cls) -> dict:
+        """field -> lock field, from guarded-by annotations anywhere
+        in the class body."""
+        guarded = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            m = GUARD_RE.search(mod.line(node.lineno))
+            if not m:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                field = _self_field(t)
+                if field:
+                    guarded[field] = m.group(1)
+        return guarded
+
+    def _check_class(self, mod, cls) -> list:
+        guarded = self._declared(mod, cls)
+        if not guarded:
+            return []
+        findings = []
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if method.name == "__init__":
+                continue  # no concurrent readers during construction
+            holds: set = set()
+            if method.name.endswith("_locked"):
+                holds = set(guarded.values())
+            m = HOLDS_RE.search(mod.line(method.lineno))
+            if m:
+                holds |= {
+                    s.strip() for s in m.group(1).split(",")
+                }
+            for node in ast.walk(method):
+                for field, desc in _mutation_targets(node):
+                    lock = guarded.get(field)
+                    if lock is None or lock in holds:
+                        continue
+                    if lock in _held_locks(mod, node):
+                        continue
+                    findings.append(Finding(
+                        self.name, mod.path, node.lineno,
+                        f"{desc} outside 'with self.{lock}:' — the "
+                        f"field is declared guarded-by({lock}) and "
+                        "this is the PR 5 fabric race class (shared "
+                        "state mutated off-lock, invisible on the "
+                        "CPU mesh); take the lock, rename the method "
+                        "*_locked, or annotate the caller-holds "
+                        f"contract with '# lint: holds({lock})' "
+                        "(docs/static_analysis.md)",
+                    ))
+        return findings
+
+
+RULE = LocksRule()

@@ -1,0 +1,323 @@
+"""Rules ``obs1``-``obs5``: dispatch paths that bypass the flight
+recorder, and chokepoints losing their instrumentation.
+
+PR 2's observability contract: every host-side device dispatch in the
+framework routes through an instrumented chokepoint —
+``CompiledModel.jit`` (models/timing_model.py, which counts XLA
+(re)traces and operand bytes) wrapping ``dispatch_guard``
+(runtime/guard.py, which opens the compile/dispatch spans), or
+``dispatch_guard`` directly for non-model programs (parallel/gls.py).
+A NEW code path that calls bare ``jax.jit`` for a host dispatch would
+silently vanish from traces, the recompile gate, and the guard — and
+nothing at runtime can notice the absence.
+
+- ``obs1`` — any ``jax.jit`` reference (call, decorator,
+  ``functools.partial`` argument) in ``pint_tpu/`` is flagged UNLESS
+  it is inside ``models/timing_model.py`` (the instrumented chokepoint
+  itself), under ``ops/`` (kernel-level jits that inline under
+  cm.jit), under ``templates/`` (host-scale CPU mini-fits), lexically
+  wrapped in a ``dispatch_guard(...)`` call, or suppressed with
+  ``# lint: ok(obs1)`` / ``# lint: obs-ok``.
+- ``obs2`` — core chokepoint meta-checks: ``dispatch_guard`` opens
+  recorder spans, ``CompiledModel.jit`` routes through
+  ``dispatch_guard`` and counts traces, every ``fit_toas`` under
+  ``fitting/`` carries ``@record_fit``.
+- ``obs3`` — serving chokepoints (PR 4): ``TimingEngine.submit`` /
+  ``_flush`` span, ``traced_jit`` stays guarded + trace-counted.
+- ``obs4`` — fabric chokepoints (PR 5): ``Router.route`` /
+  ``Replica.submit`` span, health transitions funnel through
+  ``Replica._set_state`` with a recorder event, the canary dispatches
+  through ``dispatch_guard``.
+- ``obs5`` — stacked-dispatch chokepoint (ISSUE 6):
+  ``TimingEngine._assemble`` spans the ``stack_trees`` assembly, the
+  batched kernel builders route through ``traced_jit``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..engine import Finding, Module, Rule, suppressed
+
+#: path parts that exempt a file from obs1 (rationale in module doc)
+ALLOWED_FILES = {"timing_model.py"}
+ALLOWED_DIRS = {"ops", "templates"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _guarded_jit_nodes(tree) -> set:
+    """ids of jax.jit Attribute nodes lexically inside a
+    dispatch_guard(...) call — those route through the recorder."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name != "dispatch_guard":
+            continue
+        for sub in ast.walk(node):
+            if _is_jax_jit(sub):
+                out.add(id(sub))
+    return out
+
+
+class Obs1Rule(Rule):
+    """Bare ``jax.jit`` host dispatch bypassing the flight recorder
+    (PR 2 blindness class: invisible to spans, the recompile gate, and
+    the watchdog)."""
+
+    name = "obs1"
+    legacy_pragma = "lint: obs-ok"
+
+    def check_module(self, mod: Module) -> list:
+        p = Path(mod.path)
+        if p.name in ALLOWED_FILES or ALLOWED_DIRS & set(p.parts):
+            return []
+        guarded = _guarded_jit_nodes(mod.tree)
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not _is_jax_jit(node) or id(node) in guarded:
+                continue
+            findings.append(Finding(
+                self.name, mod.path, node.lineno,
+                "bare jax.jit dispatch path bypasses the flight "
+                "recorder — route through CompiledModel.jit or wrap in "
+                "dispatch_guard(...) (runtime/guard.py) so spans/"
+                "metrics/watchdog cover it; suppress with "
+                "'# lint: ok(obs1)' only for non-dispatch uses "
+                "(docs/observability.md)",
+            ))
+        return sorted(findings, key=lambda f: f.lineno)
+
+
+def _fn_source_has(tree, source, qualname: str, needles) -> list:
+    """Missing ``needles`` in the named (possibly nested/method)
+    function's source segment; [] when all present."""
+    parts = qualname.split(".")
+
+    def find(body, names):
+        for node in body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)
+            ) and node.name == names[0]:
+                if len(names) == 1:
+                    return node
+                return find(node.body, names[1:])
+        return None
+
+    node = find(tree.body, parts)
+    if node is None:
+        return [f"function {qualname} not found"]
+    seg = ast.get_source_segment(source, node) or ""
+    return [f"{qualname} no longer contains {n!r}" for n in needles
+            if n not in seg]
+
+
+def _check_needles(rule, path, qualname, needles, why) -> list:
+    src = path.read_text()
+    return [
+        Finding(rule, str(path), 1, f"{miss} — {why}")
+        for miss in _fn_source_has(ast.parse(src), src, qualname, needles)
+    ]
+
+
+def _core_chokepoints(pkg_root: Path) -> list:
+    findings = _check_needles(
+        Obs2Rule.name, pkg_root / "runtime" / "guard.py",
+        "dispatch_guard", ("TRACER.span",),
+        "the dispatch chokepoint must open flight-recorder spans",
+    )
+    findings += _check_needles(
+        Obs2Rule.name, pkg_root / "models" / "timing_model.py",
+        "CompiledModel.jit", ("dispatch_guard(", "note_trace("),
+        "cm.jit must stay guarded and count (re)traces",
+    )
+    return findings
+
+
+def _fit_decorators(pkg_root: Path) -> list:
+    findings = []
+    for py in sorted((pkg_root / "fitting").rglob("*.py")):
+        src = py.read_text()
+        for node in ast.walk(ast.parse(src)):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "fit_toas"
+            ):
+                deco = {
+                    d.id if isinstance(d, ast.Name)
+                    else d.attr if isinstance(d, ast.Attribute)
+                    else None
+                    for d in node.decorator_list
+                }
+                if "record_fit" not in deco:
+                    findings.append(Finding(
+                        Obs2Rule.name, str(py), node.lineno,
+                        "fit_toas without @record_fit — every fitter "
+                        "fit must open the fit-level span "
+                        "(fitting/base.py::record_fit)",
+                    ))
+    return findings
+
+
+#: (relative path, qualname, needles, why) per rule — the serving/
+#: fabric checks are skipped for synthetic packages that predate/omit
+#: the subsystem (unit-test fixtures)
+_SERVE_CHECKS = (
+    ("serve/engine.py", "TimingEngine.submit", ("TRACER.span",),
+     "the serving admission edge must open recorder spans"),
+    ("serve/engine.py", "TimingEngine._flush", ("TRACER.span",),
+     "the serving flush chokepoint must open recorder spans"),
+    ("serve/session.py", "traced_jit",
+     ("dispatch_guard(", "note_trace("),
+     "serve's dispatch chokepoint must stay guarded and count "
+     "(re)traces"),
+)
+_FABRIC_CHECKS = (
+    ("serve/fabric/router.py", "Router.route", ("TRACER.span",),
+     "fabric routing decisions must open recorder spans"),
+    ("serve/fabric/replica.py", "Replica.submit", ("TRACER.span",),
+     "the replica admission edge must open recorder spans"),
+    ("serve/fabric/replica.py", "Replica._set_state",
+     ("TRACER.event",),
+     "replica health transitions (quarantine/readmit) must emit "
+     "recorder events"),
+    ("serve/fabric/replica.py", "Replica._make_canary",
+     ("dispatch_guard(",),
+     "the canary probe must dispatch through the guarded "
+     "chokepoint"),
+)
+_POPULATION_CHECKS = (
+    ("serve/engine.py", "TimingEngine._assemble",
+     ("TRACER.span", "stack_trees("),
+     "the pulsar-axis stack assembly must stay span-instrumented "
+     "(distinct-par stack occupancy)"),
+    ("serve/session.py", "build_residuals_kernel",
+     ("traced_jit(",),
+     "the stacked residuals dispatch must route through the "
+     "trace-counted serve chokepoint"),
+    ("serve/session.py", "build_fit_kernel",
+     ("traced_jit(",),
+     "the stacked fit dispatch must route through the "
+     "trace-counted serve chokepoint"),
+)
+
+
+def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
+    if not subdir.is_dir():
+        return []
+    findings = []
+    for rel, qual, needles, why in checks:
+        findings += _check_needles(
+            rule, pkg_root / rel, qual, needles, why
+        )
+    return findings
+
+
+class Obs2Rule(Rule):
+    """Core chokepoint meta-checks: the instrumentation itself must
+    stay wired (dispatch_guard spans, cm.jit guard + trace counter,
+    @record_fit on every fitter)."""
+
+    name = "obs2"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        return _core_chokepoints(pkg_root) + _fit_decorators(pkg_root)
+
+
+class Obs3Rule(Rule):
+    """Serving chokepoints (PR 4): submit/_flush span, traced_jit
+    guarded + trace-counted."""
+
+    name = "obs3"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        return _run_checks(
+            self.name, pkg_root, _SERVE_CHECKS, pkg_root / "serve"
+        )
+
+
+class Obs4Rule(Rule):
+    """Fabric chokepoints (PR 5): route/submit span, health
+    transitions event-instrumented, canary guarded."""
+
+    name = "obs4"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        return _run_checks(
+            self.name, pkg_root, _FABRIC_CHECKS,
+            pkg_root / "serve" / "fabric",
+        )
+
+
+class Obs5Rule(Rule):
+    """Stacked-dispatch chokepoint (ISSUE 6): _assemble spans the
+    stack, batched kernel builders route through traced_jit."""
+
+    name = "obs5"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        return _run_checks(
+            self.name, pkg_root, _POPULATION_CHECKS,
+            pkg_root / "serve",
+        )
+
+
+OBS1 = Obs1Rule()
+OBS2 = Obs2Rule()
+OBS3 = Obs3Rule()
+OBS4 = Obs4Rule()
+OBS5 = Obs5Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5)
+
+
+# -- back-compat surface (tools/lint_obs.py shim) -------------------------
+def lint_source(source: str, path: str = "<string>") -> list:
+    """obs1 over one module's source; pragma-filtered findings."""
+    mod = Module(path, source)
+    return [
+        f for f in OBS1.check_module(mod)
+        if not suppressed(OBS1, mod, f.lineno)
+    ]
+
+
+def lint_paths(paths) -> list:
+    findings = []
+    for root in paths:
+        root = Path(root)
+        files = (
+            [root] if root.is_file() else sorted(root.rglob("*.py"))
+        )
+        for py in files:
+            findings.extend(lint_source(py.read_text(), str(py)))
+    return findings
+
+
+def check_chokepoints(pkg_root) -> list:
+    """obs2-obs5 over one package root (the pre-framework
+    ``check_chokepoints`` surface, finding-for-finding)."""
+    pkg_root = Path(pkg_root)
+    findings = _core_chokepoints(pkg_root)
+    findings += OBS3.check_project(pkg_root)
+    findings += OBS4.check_project(pkg_root)
+    findings += OBS5.check_project(pkg_root)
+    findings += _fit_decorators(pkg_root)
+    return findings

@@ -1,0 +1,216 @@
+"""Rule ``retrace``: patterns that break or silently defeat the
+trace-cache discipline.
+
+The serving/fitting stack's steady-state invariant is ZERO XLA
+retraces (bench.py's serve gate; the PR 2 ``compile.traces``
+counter).  Three syntactic patterns defeat it:
+
+1. **host coercions on traced values** — ``float()``/``int()``/
+   ``bool()``/``.item()``/``np.asarray`` applied to a kernel
+   parameter inside a traced body either raises a concretization
+   error at trace time or, worse, silently re-fires the Python body
+   per call and blocks on the ~85 ms tunnel round-trip.
+2. **data-dependent Python control flow** — ``if``/``while`` on a
+   kernel parameter's VALUE inside a traced body (shape/dtype/ndim
+   reads and ``len()`` are static at trace time and stay allowed —
+   the static-argument plumbing fitting/wls.py uses for the
+   underdetermined-QR routing).
+3. **unordered iteration feeding cache keys** — ``tuple(<set>)`` (set
+   iteration order is hash-randomized across processes, so a
+   set-derived key defeats the persistent compile cache), and in
+   ``*key*`` functions ``tuple(d.items()/keys()/values())`` without
+   ``sorted`` (the serve/session.py::composition_key contract: two
+   pars differing only in dict construction order must produce the
+   same session key).
+
+Suppress with ``# lint: ok(retrace)`` plus a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Module, Rule
+from ._traced import param_names, traced_functions
+
+_COERCIONS = {"float", "int", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type"}
+_DICT_VIEWS = {"items", "keys", "values"}
+
+
+def _rooted_names(expr, rooted: set) -> list:
+    """Name-load nodes in ``expr`` whose id is param-rooted."""
+    return [
+        n for n in ast.walk(expr)
+        if isinstance(n, ast.Name)
+        and isinstance(n.ctx, ast.Load)
+        and n.id in rooted
+    ]
+
+
+def _is_static_use(mod: Module, name_node) -> bool:
+    """shape/dtype/len/isinstance/`is None` uses are trace-static."""
+    parent = mod.parent(name_node)
+    if (
+        isinstance(parent, ast.Attribute)
+        and parent.attr in _STATIC_ATTRS
+    ):
+        return True
+    if (
+        isinstance(parent, ast.Call)
+        and isinstance(parent.func, ast.Name)
+        and parent.func.id in _STATIC_CALLS
+    ):
+        return True
+    if isinstance(parent, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+    ):
+        return True
+    return False
+
+
+class RetraceRule(Rule):
+    """Host coercions / Python branches on traced values in kernel
+    bodies, and unordered iteration feeding trace cache keys (the
+    zero-steady-state-retrace invariant, docs/serving.md)."""
+
+    name = "retrace"
+
+    def check_module(self, mod: Module) -> list:
+        findings = []
+        for fn, _site in traced_functions(mod):
+            findings += self._check_traced_body(mod, fn)
+        for node in ast.walk(mod.tree):
+            findings += self._key_iteration(mod, node)
+        return sorted(findings, key=lambda f: (f.lineno, f.message))
+
+    # -- 1 + 2: inside traced bodies --------------------------------------
+    def _check_traced_body(self, mod, fn) -> list:
+        rooted = set(param_names(fn))
+        findings = []
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # light taint: locals assigned from rooted exprs root
+                if isinstance(node, ast.Assign):
+                    is_rooted = bool(_rooted_names(node.value, rooted))
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            if is_rooted:
+                                rooted.add(t.id)
+                            else:
+                                rooted.discard(t.id)
+                elif isinstance(node, ast.Call):
+                    findings += self._coercion(mod, node, rooted)
+                elif isinstance(node, (ast.If, ast.While)):
+                    findings += self._branch(mod, node, rooted)
+        return findings
+
+    def _coercion(self, mod, node, rooted) -> list:
+        f = node.func
+        what = None
+        if isinstance(f, ast.Name) and f.id in _COERCIONS:
+            what = f"{f.id}()"
+        elif isinstance(f, ast.Attribute) and f.attr == "item":
+            # x.item(): the object itself is the operand
+            if _rooted_names(f.value, rooted):
+                what = ".item()"
+            else:
+                return []
+        elif (
+            isinstance(f, ast.Attribute) and f.attr == "asarray"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy")
+        ):
+            what = "np.asarray()"
+        else:
+            return []
+        if what != ".item()" and not any(
+            _rooted_names(a, rooted) for a in node.args
+        ):
+            return []
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            f"{what} on a traced value inside a jitted body — "
+            "concretization error at trace time or a silent per-call "
+            "host sync (~85 ms tunnel round-trip each); keep the "
+            "kernel pure and materialize on the host after dispatch "
+            "(np.asarray over the fenced result, serve/fabric/"
+            "replica.py)",
+        )]
+
+    def _branch(self, mod, node, rooted) -> list:
+        dynamic = [
+            n for n in _rooted_names(node.test, rooted)
+            if not _is_static_use(mod, n)
+        ]
+        if not dynamic:
+            return []
+        kind = "if" if isinstance(node, ast.If) else "while"
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            f"Python '{kind}' on traced value {dynamic[0].id!r} "
+            "inside a jitted body — value-dependent host control flow "
+            "either fails to trace or forks the trace cache per "
+            "branch; use jax.lax.cond/where (shape/dtype/len reads "
+            "are static and fine — the fitting/wls.py "
+            "underdetermined-QR routing idiom)",
+        )]
+
+    # -- 3: unordered iteration feeding cache keys ------------------------
+    def _key_iteration(self, mod, node) -> list:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "tuple"
+            and len(node.args) == 1
+        ):
+            return []
+        arg = node.args[0]
+        # tuple(<set>): hash-randomized order anywhere
+        is_set = isinstance(arg, (ast.Set, ast.SetComp)) or (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Name)
+            and arg.func.id in ("set", "frozenset")
+        )
+        if is_set:
+            return [Finding(
+                self.name, mod.path, node.lineno,
+                "tuple() over a set — iteration order is hash-"
+                "randomized across processes, so a set-derived cache "
+                "key defeats the persistent compile cache and "
+                "composition keying; sort first (tuple(sorted(...)), "
+                "the serve/session.py::composition_key contract)",
+            )]
+        # tuple(d.items()) in *key* functions: insertion-order keys
+        fn = mod.enclosing_function(node)
+        if fn is None or "key" not in fn.name.lower():
+            return []
+        view = None
+        if (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Attribute)
+            and arg.func.attr in _DICT_VIEWS
+        ):
+            view = f".{arg.func.attr}()"
+        elif isinstance(arg, ast.GeneratorExp) and any(
+            isinstance(g.iter, ast.Call)
+            and isinstance(g.iter.func, ast.Attribute)
+            and g.iter.func.attr in _DICT_VIEWS
+            for g in arg.generators
+        ):
+            view = "a dict-view generator"
+        if view is None:
+            return []
+        return [Finding(
+            self.name, mod.path, node.lineno,
+            f"tuple over {view} without sorted() in a key-building "
+            "function — dict insertion order varies with construction "
+            "path, so equal contents can produce unequal trace-cache "
+            "keys (one extra XLA compile per ordering); wrap in "
+            "sorted() (serve/session.py::composition_key)",
+        )]
+
+
+RULE = RetraceRule()

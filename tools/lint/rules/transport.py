@@ -1,0 +1,108 @@
+"""Rule ``transport``: jit-traced closures capturing arrays.
+
+The remote-compile transport chokes on big embedded constants (r5
+incident: closure-captured device arrays serialize into the compile
+request — HTTP 413 at ~256 MB; the n=32768 dense step, ~16 MB of
+baked literals, never returned).  The framework's contract is that
+big operands ride jitted calls as runtime ARGUMENTS (``cm.jit``,
+models/timing_model.py; ``$PINT_TPU_BAKE_THRESHOLD`` governs the
+bake/argue cutover) — a traced body that closure-captures an array
+built in an enclosing function re-creates the hazard invisibly: the
+module still compiles fine at unit-test scale and only dies on the
+axon tunnel at production size.
+
+Detection: for every traced body (see rules/_traced.py), each free
+(closure-captured) name whose binding assignment in an enclosing
+function is a device/array constructor call — ``jax.device_put`` or a
+``jnp.``/``np.`` array builder (``array``/``asarray``/``zeros``/
+``ones``/``arange``/``linspace``/``full``/``empty``) — is flagged at
+its first use inside the trace.  Passing the same array as an
+argument, or capturing scalars/callables, is clean.
+
+Suppress with ``# lint: ok(transport)`` when the capture is provably
+O(1) (a shape-constant probe vector, a static mask of bounded size)
+with a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Module, Rule
+from ._traced import free_loads, traced_functions
+
+#: constructors whose result is a device array / array literal
+ARRAY_BUILDERS = {
+    "array", "asarray", "zeros", "ones", "arange", "linspace",
+    "full", "empty",
+}
+_ARRAY_MODULES = {"jnp", "np", "numpy"}
+
+
+def _constructor_name(value) -> str | None:
+    """'jax.device_put' / 'jnp.zeros' / ... when ``value`` is an
+    array-constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name) and f.id == "device_put":
+        return "device_put"
+    if isinstance(f, ast.Attribute):
+        if f.attr == "device_put":
+            return "jax.device_put"
+        if f.attr in ARRAY_BUILDERS and isinstance(f.value, ast.Name) \
+                and f.value.id in _ARRAY_MODULES:
+            return f"{f.value.id}.{f.attr}"
+    return None
+
+
+def _enclosing_array_bindings(mod: Module, fn) -> dict:
+    """name -> constructor for assignments in the traced body's
+    enclosing FUNCTION scopes (module-level constants are a separate,
+    deliberate idiom — ops/ffgram.py's ``_HIGHEST`` etc.)."""
+    bindings: dict = {}
+    inside_fn = {id(n) for n in ast.walk(fn)}
+    for scope in mod.ancestors(fn):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(scope):
+            if id(node) in inside_fn or not isinstance(node, ast.Assign):
+                continue
+            ctor = _constructor_name(node.value)
+            if ctor is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id not in bindings:
+                    bindings[t.id] = ctor
+    return bindings
+
+
+class TransportRule(Rule):
+    """Closure-captured device arrays / array literals inside a traced
+    body (r5 HTTP-413 incident class) — pass them as jit arguments."""
+
+    name = "transport"
+
+    def check_module(self, mod: Module) -> list:
+        findings = []
+        for fn, _site in traced_functions(mod):
+            bindings = _enclosing_array_bindings(mod, fn)
+            if not bindings:
+                continue
+            for name, load in free_loads(fn):
+                ctor = bindings.get(name)
+                if ctor is None:
+                    continue
+                findings.append(Finding(
+                    self.name, mod.path, load.lineno,
+                    f"jit-traced closure captures {name!r} (built by "
+                    f"{ctor} in an enclosing scope) — closure-captured "
+                    "arrays serialize into the remote-compile request "
+                    "(r5: HTTP 413 at ~256 MB) and bake as module "
+                    "literals; pass the array as a runtime argument "
+                    "instead (cm.jit contract, docs/performance.md)",
+                ))
+        return sorted(findings, key=lambda f: (f.lineno, f.message))
+
+
+RULE = TransportRule()

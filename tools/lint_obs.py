@@ -1,309 +1,29 @@
-"""Static check for dispatch paths that bypass the flight recorder.
-
-PR 2's observability contract: every host-side device dispatch in the
-framework routes through an instrumented chokepoint —
-``CompiledModel.jit`` (models/timing_model.py, which counts XLA
-(re)traces and operand bytes) wrapping ``dispatch_guard``
-(runtime/guard.py, which opens the compile/dispatch spans), or
-``dispatch_guard`` directly for non-model programs (parallel/gls.py).
-A NEW code path that calls bare ``jax.jit`` for a host dispatch would
-silently vanish from traces, the recompile gate, and the guard — the
-exact blindness this PR exists to remove — and nothing at runtime can
-notice the absence.  Like tools/lint_scalarmath.py for the scalar
--transcendental hazard, this linter catches it at review time instead.
-
-Rules (syntactic, like the scalarmath linter):
-
-1. any ``jax.jit`` reference (call, decorator, ``functools.partial``
-   argument) in ``pint_tpu/`` is flagged UNLESS it is
-
-   - inside ``models/timing_model.py`` (the instrumented chokepoint
-     itself),
-   - under ``ops/`` (kernel-level jits that inline under cm.jit —
-     their host-callable use is test-only),
-   - under ``templates/`` (host-scale photon-template mini-fits, a
-     CPU path with no axon dispatch),
-   - lexically wrapped in a ``dispatch_guard(...)`` call (the
-     parallel/gls.py idiom), or
-   - suppressed with ``# lint: obs-ok`` on the line (justify in an
-     adjacent comment).
-
-2. chokepoint meta-checks — the instrumentation itself must stay
-   wired: ``dispatch_guard`` must open recorder spans
-   (``TRACER.span``), ``CompiledModel.jit`` must route through
-   ``dispatch_guard`` and count traces (``note_trace``), and every
-   ``fit_toas`` defined under ``pint_tpu/fitting/`` must carry the
-   ``@record_fit`` span decorator.
-
-3. serving chokepoints (PR 4) — the serve pipeline's hot points must
-   stay span-instrumented and guarded: ``TimingEngine.submit`` and
-   ``TimingEngine._flush`` (serve/engine.py) must open recorder spans,
-   and ``traced_jit`` (serve/session.py — serve's dispatch chokepoint)
-   must route through ``dispatch_guard`` and count XLA (re)traces via
-   ``note_trace``.  Rule 1 already forbids bare ``jax.jit`` anywhere
-   under ``serve/``.
-
-4. fabric chokepoints (PR 5) — the multi-device serving fabric's hot
-   points must stay observable: ``Router.route``
-   (serve/fabric/router.py) and ``Replica.submit``
-   (serve/fabric/replica.py) must open recorder spans, every health
-   transition must funnel through ``Replica._set_state`` and emit a
-   recorder event, and the canary probe (``Replica._make_canary``)
-   must dispatch through ``dispatch_guard`` — a silent quarantine or
-   an unguarded probe is exactly the blindness rules 1-3 exist to
-   prevent, one layer up.
-
-5. stacked-dispatch chokepoint (ISSUE 6) — the population-serving
-   path that assembles the pulsar-axis stack and dispatches it must
-   stay span-instrumented and retrace-counted:
-   ``TimingEngine._assemble`` (serve/engine.py) must open a recorder
-   span around the ``stack_trees`` assembly (distinct-par stack
-   occupancy rides the span attributes), and the batched kernel
-   builders ``build_residuals_kernel`` / ``build_fit_kernel``
-   (serve/session.py) must route through ``traced_jit`` — a stacked
-   dispatch that bypasses the trace counter would let a per-par
-   recompile (the exact antipattern composition keying exists to
-   kill) pass silently.
-
-Run: ``python tools/lint_obs.py [paths...]`` (default: pint_tpu/).
-Exit status 1 when findings exist.  Wired into tier-1 as
-tests/test_lint_obs.py.
-"""
+"""Back-compat shim: the obs linter now lives in the unified
+framework as rules ``obs1``-``obs5`` (tools/lint/rules/obs.py;
+docs/static_analysis.md).  This entry point keeps the historical CLI
+and the ``lint_source``/``lint_paths``/``check_chokepoints`` API,
+finding-for-finding."""
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from lint.rules.obs import (  # noqa: E402,F401
+    check_chokepoints,
+    lint_paths,
+    lint_source,
+)
+
 SUPPRESS_PRAGMA = "lint: obs-ok"
-
-#: path parts that exempt a file from rule 1 (rationale in docstring)
-ALLOWED_FILES = {"timing_model.py"}
-ALLOWED_DIRS = {"ops", "templates"}
-
-
-class _Finding:
-    def __init__(self, path, lineno, detail):
-        self.path = path
-        self.lineno = lineno
-        self.detail = detail
-
-    def __str__(self):
-        return f"{self.path}:{self.lineno}: {self.detail}"
-
-
-def _is_jax_jit(node: ast.AST) -> bool:
-    return (
-        isinstance(node, ast.Attribute)
-        and node.attr == "jit"
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "jax"
-    )
-
-
-def _guarded_jit_nodes(tree) -> set:
-    """ids of jax.jit Attribute nodes lexically inside a
-    dispatch_guard(...) call — those route through the recorder."""
-    out: set = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        name = (
-            f.id if isinstance(f, ast.Name)
-            else f.attr if isinstance(f, ast.Attribute) else None
-        )
-        if name != "dispatch_guard":
-            continue
-        for sub in ast.walk(node):
-            if _is_jax_jit(sub):
-                out.add(id(sub))
-    return out
-
-
-def lint_source(source: str, path: str = "<string>") -> list:
-    """Rule 1 over one module's source; returns findings."""
-    p = Path(path)
-    if p.name in ALLOWED_FILES or ALLOWED_DIRS & set(p.parts):
-        return []
-    tree = ast.parse(source, filename=path)
-    lines = source.splitlines()
-    guarded = _guarded_jit_nodes(tree)
-    findings = []
-    for node in ast.walk(tree):
-        if not _is_jax_jit(node) or id(node) in guarded:
-            continue
-        line = (
-            lines[node.lineno - 1]
-            if node.lineno - 1 < len(lines) else ""
-        )
-        if SUPPRESS_PRAGMA in line:
-            continue
-        findings.append(_Finding(
-            path, node.lineno,
-            "bare jax.jit dispatch path bypasses the flight recorder "
-            "— route through CompiledModel.jit or wrap in "
-            "dispatch_guard(...) (runtime/guard.py) so spans/metrics/"
-            "watchdog cover it; suppress with '# lint: obs-ok' only "
-            "for non-dispatch uses (docs/observability.md)",
-        ))
-    return sorted(findings, key=lambda f: f.lineno)
-
-
-def _fn_source_has(tree, source, qualname: str, needles) -> list:
-    """Missing ``needles`` in the named (possibly nested/method)
-    function's source segment; [] when all present."""
-    parts = qualname.split(".")
-
-    def find(body, names):
-        for node in body:
-            if isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                       ast.ClassDef)
-            ) and node.name == names[0]:
-                if len(names) == 1:
-                    return node
-                return find(node.body, names[1:])
-        return None
-
-    node = find(tree.body, parts)
-    if node is None:
-        return [f"function {qualname} not found"]
-    seg = ast.get_source_segment(source, node) or ""
-    return [f"{qualname} no longer contains {n!r}" for n in needles
-            if n not in seg]
-
-
-def check_chokepoints(pkg_root) -> list:
-    """Rule 2: the instrumented chokepoints stay instrumented."""
-    pkg_root = Path(pkg_root)
-    findings = []
-
-    guard_py = pkg_root / "runtime" / "guard.py"
-    src = guard_py.read_text()
-    for miss in _fn_source_has(
-        ast.parse(src), src, "dispatch_guard", ("TRACER.span",)
-    ):
-        findings.append(_Finding(
-            str(guard_py), 1,
-            f"{miss} — the dispatch chokepoint must open flight-"
-            "recorder spans",
-        ))
-
-    tm_py = pkg_root / "models" / "timing_model.py"
-    src = tm_py.read_text()
-    for miss in _fn_source_has(
-        ast.parse(src), src, "CompiledModel.jit",
-        ("dispatch_guard(", "note_trace("),
-    ):
-        findings.append(_Finding(
-            str(tm_py), 1,
-            f"{miss} — cm.jit must stay guarded and count (re)traces",
-        ))
-
-    # rule 3: serve chokepoints (skipped for synthetic packages that
-    # predate / omit the serving subsystem — unit-test fixtures)
-    serve_checks = (
-        ("serve/engine.py", "TimingEngine.submit", ("TRACER.span",),
-         "the serving admission edge must open recorder spans"),
-        ("serve/engine.py", "TimingEngine._flush", ("TRACER.span",),
-         "the serving flush chokepoint must open recorder spans"),
-        ("serve/session.py", "traced_jit",
-         ("dispatch_guard(", "note_trace("),
-         "serve's dispatch chokepoint must stay guarded and count "
-         "(re)traces"),
-    )
-    # rule 4: fabric chokepoints (skipped when the synthetic package
-    # has no fabric — unit-test fixtures predating PR 5)
-    fabric_checks = (
-        ("serve/fabric/router.py", "Router.route", ("TRACER.span",),
-         "fabric routing decisions must open recorder spans"),
-        ("serve/fabric/replica.py", "Replica.submit", ("TRACER.span",),
-         "the replica admission edge must open recorder spans"),
-        ("serve/fabric/replica.py", "Replica._set_state",
-         ("TRACER.event",),
-         "replica health transitions (quarantine/readmit) must emit "
-         "recorder events"),
-        ("serve/fabric/replica.py", "Replica._make_canary",
-         ("dispatch_guard(",),
-         "the canary probe must dispatch through the guarded "
-         "chokepoint"),
-    )
-    # rule 5: the stacked-dispatch chokepoint (ISSUE 6) — skipped,
-    # like rule 3, for synthetic packages without the serving
-    # subsystem
-    population_checks = (
-        ("serve/engine.py", "TimingEngine._assemble",
-         ("TRACER.span", "stack_trees("),
-         "the pulsar-axis stack assembly must stay span-instrumented "
-         "(distinct-par stack occupancy)"),
-        ("serve/session.py", "build_residuals_kernel",
-         ("traced_jit(",),
-         "the stacked residuals dispatch must route through the "
-         "trace-counted serve chokepoint"),
-        ("serve/session.py", "build_fit_kernel",
-         ("traced_jit(",),
-         "the stacked fit dispatch must route through the "
-         "trace-counted serve chokepoint"),
-    )
-    for checks, subdir in (
-        (serve_checks, pkg_root / "serve"),
-        (fabric_checks, pkg_root / "serve" / "fabric"),
-        (population_checks, pkg_root / "serve"),
-    ):
-        if not subdir.is_dir():
-            continue
-        for rel, qual, needles, why in checks:
-            path = pkg_root / rel
-            src = path.read_text()
-            for miss in _fn_source_has(
-                ast.parse(src), src, qual, needles
-            ):
-                findings.append(_Finding(
-                    str(path), 1, f"{miss} — {why}",
-                ))
-
-    for py in sorted((pkg_root / "fitting").rglob("*.py")):
-        src = py.read_text()
-        for node in ast.walk(ast.parse(src)):
-            if (
-                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name == "fit_toas"
-            ):
-                deco = {
-                    d.id if isinstance(d, ast.Name)
-                    else d.attr if isinstance(d, ast.Attribute)
-                    else None
-                    for d in node.decorator_list
-                }
-                if "record_fit" not in deco:
-                    findings.append(_Finding(
-                        str(py), node.lineno,
-                        "fit_toas without @record_fit — every fitter "
-                        "fit must open the fit-level span "
-                        "(fitting/base.py::record_fit)",
-                    ))
-    return findings
-
-
-def lint_paths(paths) -> list:
-    findings = []
-    for root in paths:
-        root = Path(root)
-        files = (
-            [root] if root.is_file() else sorted(root.rglob("*.py"))
-        )
-        for py in files:
-            findings.extend(lint_source(py.read_text(), str(py)))
-    return findings
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     pkg = Path(__file__).resolve().parent.parent / "pint_tpu"
-    paths = argv or [pkg]
-    findings = lint_paths(paths)
+    findings = lint_paths(argv or [pkg])
     if not argv:
         findings += check_chokepoints(pkg)
     for f in findings:

@@ -1,257 +1,23 @@
-"""Static check for the axon 0-d transcendental hazard.
-
-axon lowers transcendentals on 0-d f64 operands to a scalar path that
-is only f32-accurate (~2e-8 — a ~10 us Roemer error from one scalar
-sky angle; ops/scalarmath.py, docs/precision.md).  Scalar MODEL
-PARAMETERS meeting ``jnp.sin/cos/tan/exp/log/arctan2/power`` must go
-through the ops/scalarmath.py wrappers (``sin_p`` etc.) — and nothing
-on the CPU test mesh catches a violation, only the on-chip accuracy
-suite does.  This linter catches new instances at review time instead.
-
-Detection is syntactic taint tracking, tuned for the framework's one
-idiom for scalar parameters: inside a device kernel every 0-d model
-parameter arrives as ``pdict[<name>]`` or ``self.val(pdict, <name>)``
-(architecture invariant — kernels are pure functions of the delta
-vector).  Per function body, an expression is *scalar-tainted* when it
-is
-
-- a ``pdict[...]`` / ``*_pdict[...]`` subscript,
-- a ``.val(...)`` / ``.param(...)`` call (TimingModel scalar access),
-- a name previously assigned from a tainted expression, or
-- arithmetic (``+ - * / **``, unary ``-``) combining a tainted
-  expression with plain numeric constants only.
-
-Arithmetic with any non-constant, non-tainted operand CLEARS the
-taint: ``kin0 + dkin_pm`` (a per-TOA array drift) is how scalars are
-legitimately broadcast to rank 1, and ``jnp.sin`` of the result takes
-the accurate vector path (models/pulsar_binary.py::_kopeikin).  The
-linter therefore flags exactly the direct scalar->transcendental
-pattern and stays quiet on array math, at the cost of missing taint
-laundered through helper calls — the on-chip suite remains the
-backstop for those.
-
-A finding can be suppressed with ``# lint: scalar-ok`` on the call's
-line when the operand is known rank>=1 despite the syntax.
-
-Run: ``python tools/lint_scalarmath.py [paths...]`` (default:
-pint_tpu/).  Exit status 1 when findings exist.  Wired into tier-1 as
-tests/test_lint_scalarmath.py.
-"""
+"""Back-compat shim: the scalar-transcendental linter now lives in
+the unified framework as rule ``scalarmath`` (tools/lint/rules/
+scalarmath.py; docs/static_analysis.md).  This entry point keeps the
+historical CLI and the ``lint_source``/``lint_paths`` API,
+finding-for-finding."""
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-#: jnp functions with a known-bad 0-d lowering on axon and a wrapper
-#: in ops/scalarmath.py (keep in sync with that module).
-HAZARD_FUNCS = {
-    "sin": "sin_p",
-    "cos": "cos_p",
-    "tan": "tan_p",
-    "exp": "exp_p",
-    "log": "log_p",
-    "arctan2": "arctan2_p",
-    "power": "power_p",
-}
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-_JNP_NAMES = {"jnp", "jax.numpy"}
+from lint.rules.scalarmath import (  # noqa: E402,F401
+    HAZARD_FUNCS,
+    lint_paths,
+    lint_source,
+)
+
 SUPPRESS_PRAGMA = "lint: scalar-ok"
-
-#: files the rule does not apply to: the wrappers themselves, and host
-#: -side (numpy/HostDD) ingest where jnp never appears anyway.
-EXCLUDE_PARTS = {"scalarmath.py"}
-
-
-def _is_jnp(node: ast.AST) -> bool:
-    """True for the `jnp` in `jnp.sin` / `jax.numpy.sin`."""
-    if isinstance(node, ast.Name):
-        return node.id in _JNP_NAMES
-    if isinstance(node, ast.Attribute):
-        return (
-            isinstance(node.value, ast.Name)
-            and node.value.id == "jax"
-            and node.attr == "numpy"
-        )
-    return False
-
-
-class _Finding:
-    def __init__(self, path, lineno, func, detail):
-        self.path = path
-        self.lineno = lineno
-        self.func = func
-        self.detail = detail
-
-    def __str__(self):
-        return (
-            f"{self.path}:{self.lineno}: jnp.{self.func} on a scalar "
-            f"model parameter ({self.detail}) — use "
-            f"ops.scalarmath.{HAZARD_FUNCS[self.func]} (axon 0-d "
-            "transcendentals are only f32-accurate; docs/precision.md)"
-        )
-
-
-class _FunctionLinter(ast.NodeVisitor):
-    """Taint pass over one function body, statements in order."""
-
-    def __init__(self, path, source_lines, findings):
-        self.path = path
-        self.lines = source_lines
-        self.findings = findings
-        self.tainted: set[str] = set()
-
-    # -- taint sources ---------------------------------------------------
-    def _taint_reason(self, node) -> str | None:
-        """Why `node` is scalar-tainted, or None."""
-        if isinstance(node, ast.Subscript):
-            base = node.value
-            if isinstance(base, ast.Name) and (
-                base.id == "pdict" or base.id.endswith("_pdict")
-            ):
-                return f"{base.id}[...] subscript"
-            return None
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr in ("val", "param"):
-                return f".{f.attr}(...) scalar parameter access"
-            return None
-        if isinstance(node, ast.Name):
-            if node.id in self.tainted:
-                return f"name {node.id!r} assigned from a scalar parameter"
-            return None
-        if isinstance(node, ast.UnaryOp):
-            return self._taint_reason(node.operand)
-        if isinstance(node, ast.BinOp):
-            lt = self._taint_reason(node.left)
-            rt = self._taint_reason(node.right)
-            lc = isinstance(node.left, ast.Constant)
-            rc = isinstance(node.right, ast.Constant)
-            # taint survives arithmetic only against constants or other
-            # tainted scalars; any other operand (an array) clears it
-            if (lt and (rc or rt)) or (rt and (lc or lt)):
-                return lt or rt
-            return None
-        return None
-
-    # -- taint propagation through assignments ---------------------------
-    def visit_Assign(self, node):
-        reason = self._taint_reason(node.value)
-        targets = []
-        for t in node.targets:
-            targets.extend(
-                t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-            )
-        values = (
-            node.value.elts
-            if isinstance(node.value, (ast.Tuple, ast.List))
-            and len(targets) > 1
-            else None
-        )
-        for i, t in enumerate(targets):
-            if not isinstance(t, ast.Name):
-                continue
-            r = (
-                self._taint_reason(values[i])
-                if values is not None and i < len(values)
-                else reason
-            )
-            if r:
-                self.tainted.add(t.id)
-            else:
-                self.tainted.discard(t.id)  # reassignment clears
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node):
-        # `x += <array>` launders the scalar into rank>=1 exactly like
-        # the BinOp rule; treat conservatively: keep taint only when
-        # the RHS alone would taint
-        if isinstance(node.target, ast.Name):
-            if not self._taint_reason(node.value):
-                self.tainted.discard(node.target.id)
-        self.generic_visit(node)
-
-    # -- the check -------------------------------------------------------
-    def visit_Call(self, node):
-        f = node.func
-        if (
-            isinstance(f, ast.Attribute)
-            and f.attr in HAZARD_FUNCS
-            and _is_jnp(f.value)
-        ):
-            line = (
-                self.lines[node.lineno - 1]
-                if node.lineno - 1 < len(self.lines)
-                else ""
-            )
-            if SUPPRESS_PRAGMA not in line:
-                for arg in node.args:
-                    reason = self._taint_reason(arg)
-                    if reason:
-                        self.findings.append(
-                            _Finding(
-                                self.path, node.lineno, f.attr, reason
-                            )
-                        )
-                        break
-        self.generic_visit(node)
-
-    # nested functions get their own pass with the enclosing taint (a
-    # closure over a tainted scalar is still a scalar)
-    def visit_FunctionDef(self, node):
-        sub = _FunctionLinter(self.path, self.lines, self.findings)
-        sub.tainted = set(self.tainted)
-        for stmt in node.body:
-            sub.visit(stmt)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-
-def lint_source(source: str, path: str = "<string>") -> list:
-    """Lint one module's source text; returns the findings list."""
-    tree = ast.parse(source, filename=path)
-    lines = source.splitlines()
-    findings: list = []
-    top = _FunctionLinter(path, lines, findings)
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            sub = _FunctionLinter(path, lines, findings)
-            for stmt in node.body:
-                sub.visit(stmt)
-    # module-level statements too (rare, but a module-scope kernel
-    # constant from a pdict cannot occur; keep for completeness)
-    for stmt in tree.body:
-        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-            top.visit(stmt)
-    # ast.walk visits nested functions twice (outer pass recurses via
-    # visit_FunctionDef, and walk yields the nested def again) —
-    # dedupe on (path, lineno, func)
-    seen = set()
-    out = []
-    for fnd in findings:
-        key = (fnd.path, fnd.lineno, fnd.func)
-        if key not in seen:
-            seen.add(key)
-            out.append(fnd)
-    return out
-
-
-def lint_paths(paths) -> list:
-    findings = []
-    for root in paths:
-        root = Path(root)
-        files = (
-            [root] if root.is_file() else sorted(root.rglob("*.py"))
-        )
-        for py in files:
-            if py.name in EXCLUDE_PARTS:
-                continue
-            findings.extend(
-                lint_source(py.read_text(), str(py))
-            )
-    return findings
 
 
 def main(argv=None) -> int:
