@@ -287,6 +287,78 @@ def _obs_block():
     return out
 
 
+def _fit_traj_block():
+    """Fused-trajectory telemetry for BENCH_*.json (ISSUE 9): a small
+    downhill probe gates the tentpole invariant — ONE complete steady
+    -state downhill fit (GN proposal + lambda ladder + noise-floor
+    measurement + stop/freeze control, all maxiter legs) costs exactly
+    ONE guarded dispatch (fitting/downhill.py::_fused_loop).  Reported
+    next to it: the host-loop rung on the SAME fitter
+    (PINT_TPU_DOWNHILL_FUSED=0 — per-leg dispatches plus per-call
+    re-jit, what every downhill fit paid before the fusion), so the
+    driver tracks the dispatch amortization per round
+    (profiling/dispatch_floor.py has the full ladder)."""
+    import os
+
+    from pint_tpu.exceptions import PintTpuError
+    from pint_tpu.fitting.downhill import DownhillWLSFitter
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR TRAJ\nF0 61.485 1\nF1 -1.2e-15 1\nPEPOCH 53750\n"
+        "DM 224.1 1\n"
+    )
+    m, toas = make_test_pulsar(
+        par, ntoa=62, start_mjd=53000.0, end_mjd=57000.0, iterations=1
+    )
+    f = DownhillWLSFitter(toas, m)
+    g = obs_metrics.counter("dispatch.guarded")
+    f.fit_toas()  # warm: compile + fault-ladder probes
+    nrep = 3
+    g0 = g.value
+    t0 = time.perf_counter()
+    for _ in range(nrep):
+        f.fit_toas()
+    fused_wall = (time.perf_counter() - t0) / nrep
+    per_fit = (g.value - g0) / nrep
+    if not f.converged:
+        raise PintTpuError("fit_traj probe did not converge")
+    if per_fit != 1.0:
+        raise PintTpuError(
+            f"{per_fit:g} guarded dispatch(es) per steady-state "
+            "downhill fit — the fused-trajectory invariant is exactly "
+            "ONE (fitting/downhill.py::_fused_loop; "
+            "docs/performance.md)"
+        )
+    saved = os.environ.get("PINT_TPU_DOWNHILL_FUSED")
+    os.environ["PINT_TPU_DOWNHILL_FUSED"] = "0"
+    try:
+        f.fit_toas()  # the host rung re-jits per call; still "warm"
+        h0 = g.value
+        t0 = time.perf_counter()
+        f.fit_toas()
+        host_wall = time.perf_counter() - t0
+        host_dispatches = g.value - h0
+    finally:
+        if saved is None:
+            os.environ.pop("PINT_TPU_DOWNHILL_FUSED", None)
+        else:
+            os.environ["PINT_TPU_DOWNHILL_FUSED"] = saved
+    return {
+        "dispatches_per_fit": per_fit,
+        "fused_wall_ms": round(fused_wall * 1e3, 2),
+        "host_wall_ms": round(host_wall * 1e3, 2),
+        "host_dispatches_per_fit": host_dispatches,
+        "dispatch_amortization_x": round(
+            host_dispatches / per_fit, 1
+        ),
+        "wall_speedup_x": round(
+            host_wall / max(fused_wall, 1e-9), 1
+        ),
+    }
+
+
 def _serve_block():
     """Serving telemetry for BENCH_*.json (ISSUE 4 — pint_tpu/serve):
     a mixed-size fleet of same-composition pulsars served as fits,
@@ -317,7 +389,14 @@ def _serve_block():
     the full distinct population after the capacity-ladder warm
     (exactly one compile per (bucket, capacity), never per par), zero
     steady-state retraces, and distinct-par steady throughput >= 0.8x
-    the single-par figure."""
+    the single-par figure.
+
+    ISSUE 9 adds the COALESCING figure: in-replica batch coalescing
+    (serve/fabric/replica.py::Replica._coalesce) runs at its default
+    (ON) throughout this block, so the zero-steady-retrace gates above
+    ALSO certify that merged dispatches only ever land on warmed
+    kernel capacities; coalesced_batches reports how many queued
+    batches were absorbed into stacked dispatches."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -569,6 +648,7 @@ def _serve_block():
         "serial_requests_per_s": round(serial_rps, 2),
         "speedup_vs_serial": round(speedup, 2),
         "steady_retraces": retraces,
+        "coalesced_batches": st["fabric"]["coalesced"],
         "population": population,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
@@ -625,6 +705,7 @@ def main():
 
     guard_block = _guard_block(cm, step, mode, t_dev)
     obs_block = _obs_block()
+    fit_traj_block = _fit_traj_block()
     serve_block = _serve_block()
 
     # CPU baseline: the all-f64 reference-class computation on host
@@ -691,6 +772,7 @@ def main():
                 "vs_baseline": round(t_cpu / t_dev, 3),
                 "guard": guard_block,
                 "obs": obs_block,
+                "fit_traj": fit_traj_block,
                 "serve": serve_block,
                 "cold": {
                     **cold_block,

@@ -55,6 +55,40 @@ def design_with_offset(cm, x):
     return jnp.concatenate([ones, M], axis=1)
 
 
+def device_noise_floor(lams, c):
+    """Traced twin of ``DownhillFitter._chi2_noise_floor``: the
+    measured per-trial chi2 scatter at the current state, computed
+    IN-PROGRAM so the fused downhill trajectory re-measures the
+    backend's chi2 evaluation noise every iteration without a host
+    round-trip.
+
+    ``lams`` is the static probe-lambda vector (probes + the lambda=0
+    baseline); ``c`` the matching chi2 trials.  Degree-1 least squares
+    in closed form, with non-finite trials masked out of EVERY sum (a
+    poisoned probe must not poison the floor), and fewer than 4 finite
+    points yielding 0.0 — exactly the host staticmethod's semantics
+    (np.polyfit solves the same normal equations; the two agree to
+    rounding, which is far below the 6-sigma inflation the floor
+    carries)."""
+    m = jnp.isfinite(c)
+    w = m.astype(lams.dtype)
+    cs = jnp.where(m, c, 0.0)
+    n = jnp.sum(w)
+    n_safe = jnp.maximum(n, 1.0)
+    xm = jnp.sum(w * lams) / n_safe
+    ym = jnp.sum(cs) / n_safe
+    dxl = lams - xm
+    sxx = jnp.sum(w * dxl * dxl)
+    sxy = jnp.sum(w * dxl * (cs - ym))
+    slope = sxy / jnp.where(sxx > 0, sxx, 1.0)
+    resid = w * ((cs - ym) - slope * dxl)
+    # operands are O(chi2-scatter) deviations from the fitted line —
+    # provably O(1), no |max|-prescale needed
+    ss = jnp.sum(resid * resid)  # lint: ok(f64-emu)
+    dof = jnp.maximum(n - 2.0, 1.0)
+    return jnp.where(n >= 4.0, 6.0 * jnp.sqrt(ss / dof), 0.0)
+
+
 def make_scan_fit_loop(live_step, p, maxiter, tol_chi2, init_chi2,
                        cm=None):
     """The whole Gauss-Newton iteration as ONE device program

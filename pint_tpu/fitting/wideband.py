@@ -181,7 +181,9 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
         # accelerator mixed proposals, as in DownhillGLSFitter (the
         # chi2 ladder still gates acceptance); force_f64 is the
         # guard's fallback rung (all-f64 Woodbury over the stacked
-        # [TOA; DM] system)
+        # [TOA; DM] system).  RAW body (downhill.py contract): the
+        # fused trajectory traces it inside its scan; host-loop
+        # callers wrap it in cm.jit at the use site.
         if force_f64:
             fn = gls_step_woodbury
         elif full_cov:
@@ -191,7 +193,6 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
         else:
             fn = gls_step_woodbury
 
-        @self.cm.jit
         def proposal(x):
             r = self._combined_residuals(x)
             M = self._combined_design(x)
@@ -208,7 +209,6 @@ class WidebandDownhillFitter(_WidebandKernels, DownhillFitter):
     def _make_chi2(self):
         n = self.cm.bundle.ntoa
 
-        @self.cm.jit
         def chi2(x):
             r = self._combined_residuals(x)
             Ndiag, T, phi = self._combined_noise(x)

@@ -274,12 +274,23 @@ class TimingModel:
         ROADMAP item-2 leftover; one host parse per par ADMISSION, not
         per response).  Heavy derived state memoized on components
         (AbsPhase._tzr_memo's ingested TZR TOAs) is deliberately NOT
-        copied — a later compile() of the clone re-ingests lazily."""
+        copied — a later compile() of the clone re-ingests lazily.
+        Every OTHER instance attribute rides along: components keep
+        builder-populated registries of their dynamically-added
+        params (EcorrNoise.ecorr_params, PhaseJump.jump_params,
+        DispersionDMX.dmx_indices, ...) outside ``params``, and a
+        clone that dropped them silently lost those terms from the
+        noise basis / design matrix (the ISSUE 9 parse-cache bringup
+        caught ECORR vanishing from cloned GLS fits)."""
         import copy
 
         comps = []
         for c in self._ordered_components():
             c2 = type(c)()
+            for k, v in vars(c).items():
+                if k in ("params", "_tzr_memo"):
+                    continue
+                setattr(c2, k, copy.deepcopy(v))
             c2.params = {
                 n: copy.deepcopy(p) for n, p in c.params.items()
             }

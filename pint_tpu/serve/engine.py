@@ -584,6 +584,7 @@ class TimingEngine:
                 "quarantines": mc("serve.fabric.quarantines").value,
                 "readmits": mc("serve.fabric.readmits").value,
                 "probes": mc("serve.fabric.probes").value,
+                "coalesced": mc("serve.fabric.coalesced").value,
                 **self.router.stats(),
                 "per_replica": per_replica,
             },

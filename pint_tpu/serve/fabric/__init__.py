@@ -25,7 +25,9 @@ Env knobs: ``PINT_TPU_SERVE_REPLICAS`` (pool width; 0 = all local
 devices), ``PINT_TPU_SERVE_AFFINITY`` (max replicas per session
 group; 0 = pool width), ``PINT_TPU_SERVE_QUARANTINE_N`` (consecutive
 failures before quarantine), ``PINT_TPU_SERVE_PROBE_MS`` (canary
-probe cadence).  Semantics in docs/serving.md; the per-replica span/
+probe cadence), ``PINT_TPU_SERVE_COALESCE`` (in-replica same-key
+batch coalescing, default on; ISSUE 9).  Semantics in
+docs/serving.md; the per-replica span/
 metric taxonomy in docs/observability.md.
 """
 
@@ -38,6 +40,7 @@ from pint_tpu.serve.fabric.replica import (
     BatchWork,
     Replica,
     health_kind,
+    merge_batch_works,
 )
 from pint_tpu.serve.fabric.router import Router
 
@@ -51,4 +54,5 @@ __all__ = [
     "ReplicaPool",
     "Router",
     "health_kind",
+    "merge_batch_works",
 ]

@@ -33,11 +33,24 @@ pool width); only when no candidate remains do the member futures see
 the original typed error.  Deterministic failures (transport 413s,
 model errors) are the request's own fault — they fail the futures
 immediately and never damage replica health.
+
+In-replica batch coalescing (ISSUE 9): when the dispatcher pops a
+batch and finds MORE same-key batches queued behind it, it merges
+them into one stacked dispatch along the existing vmapped capacity
+axis (:func:`merge_batch_works`) — deepening the batch at the ~85 ms
+dispatch floor instead of serializing launches.  Coalescing may only
+land on capacities this replica has ALREADY traced (the merged
+``(key, capacity)`` must be in the kernel cache), so the
+zero-steady-retrace invariant survives by construction; the flight
+recorder attributes every merge (``replica:coalesce`` span,
+``serve.fabric.coalesced`` counter).  ``PINT_TPU_SERVE_COALESCE=0``
+disables it.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import queue
 import threading
 
@@ -145,6 +158,57 @@ class BatchWork:
                 p.future.set_exception(RequestRejected(reason, detail))
 
 
+def _pow2_capacity(n: int) -> int:
+    """Smallest power of two >= n — the fabric's capacity grid
+    (batcher.capacity_for without the engine's max_batch clamp: the
+    coalescer's warmed-kernel gate bounds growth instead, and warmed
+    capacities never exceed the engine's clamp by construction)."""
+    cap = 1
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def merge_batch_works(works: list[BatchWork], cap: int) -> BatchWork:
+    """Merge co-resident same-key batches into ONE stacked work along
+    the vmapped capacity axis.
+
+    Row discipline: each source work's operand leaves carry ``w.cap``
+    rows of which only the first ``len(w.live)`` are real (the engine
+    pads by repeating live[0]'s row; x0 pad rows are zeros).  The
+    merge STRIPS every source's pad rows and concatenates the real
+    rows in works order, so merged row ``i`` stays aligned with
+    ``merged.live[i]`` — the positional contract ``_response``
+    indexes by.  The merged batch is then re-padded to ``cap`` by
+    repeating its own row 0, bitwise-matching what
+    ``TimingEngine._assemble`` would have produced for the combined
+    live set (bundle/ref pads repeat live[0]; x0 rows are all zeros,
+    so repeating row 0 is exact there too)."""
+    live = [p for w in works for p in w.live]
+    if len(live) > cap:
+        raise PintTpuError(
+            f"coalesce overflow: {len(live)} live rows > capacity {cap}"
+        )
+    counts = [len(w.live) for w in works]
+
+    def merge(*leaves):
+        rows = np.concatenate(
+            [np.asarray(leaf)[:n] for leaf, n in zip(leaves, counts)],
+            axis=0,
+        )
+        pad = cap - rows.shape[0]
+        if pad:
+            rows = np.concatenate(
+                [rows, np.repeat(rows[:1], pad, axis=0)], axis=0
+            )
+        return rows
+
+    ops = tree_util.tree_map(merge, *[w.ops for w in works])
+    merged = BatchWork(works[0].key, live, ops, works[0].session, cap)
+    merged.excluded = set().union(*(w.excluded for w in works))
+    return merged
+
+
 class Replica:
     """One device's executor: kernel cache + dispatch pipeline +
     health state machine.
@@ -170,6 +234,9 @@ class Replica:
         self._fence_q: queue.Queue = queue.Queue()
         self._sem = threading.BoundedSemaphore(self.inflight)
         self._kernels: dict = {}  # (batch key, capacity) -> callable; dispatcher-thread only
+        self._coalesce_on = (
+            os.environ.get("PINT_TPU_SERVE_COALESCE", "1") != "0"
+        )
         self._draining = False  # lint: guarded-by(_cond)
         # health state: reads are bare attribute loads (GIL-atomic) so
         # submit() can check state while holding only _cond; writes go
@@ -284,8 +351,63 @@ class Replica:
                 self._batch_leaves(work)
                 self._requeue(work, self)
                 continue
-            self._run(work)
+            self._run(self._coalesce(work))
         self._fence_q.put(None)
+
+    def _coalesce(self, work: BatchWork) -> BatchWork:
+        """In-replica batch coalescing (obs6 chokepoint): absorb
+        queued same-key batches into ``work``'s stacked dispatch,
+        deepening the batch at the dispatch floor instead of
+        serializing launches.  A candidate is absorbed only when the
+        grown ``(key, capacity)`` is ALREADY in this replica's kernel
+        cache — coalescing may only land on warmed capacities, so the
+        zero-steady-retrace invariant holds by construction (a cold
+        capacity keeps its batches separate and warms normally).
+        Dispatcher-thread only (it owns ``_kernels``); queue surgery
+        happens under ``_cond``."""
+        if not self._coalesce_on:
+            return work
+        picked: list[BatchWork] = []
+        total = len(work.live)
+        cap = work.cap
+        with self._cond:
+            if self._queue:
+                keep: collections.deque = collections.deque()
+                for w in self._queue:
+                    grown = max(
+                        cap, _pow2_capacity(total + len(w.live))
+                    )
+                    if (w.key == work.key
+                            and (work.key, grown) in self._kernels):
+                        picked.append(w)
+                        total += len(w.live)
+                        cap = grown
+                    else:
+                        keep.append(w)
+                if picked:
+                    self._queue = keep
+                    # absorbed batches leave the queue as independent
+                    # units here; the merged batch gets the single
+                    # remaining _batch_leaves at completion, so
+                    # _outstanding balances against submit()'s
+                    # one-increment-per-batch
+                    self._outstanding = max(
+                        0, self._outstanding - len(picked)
+                    )
+                    self._g_out.set(self._outstanding)
+                    self._cond.notify_all()
+        if not picked:
+            return work
+        with TRACER.span(
+            "replica:coalesce", "fabric", replica=self.tag,
+            op=work.key[0], absorbed=len(picked), n=total, cap=cap,
+        ):
+            merged = merge_batch_works([work] + picked, cap)
+        obs_metrics.counter("serve.fabric.coalesced").inc(len(picked))
+        obs_metrics.histogram("serve.fabric.coalesce_depth").observe(
+            total
+        )
+        return merged
 
     def _run(self, work: BatchWork):
         try:

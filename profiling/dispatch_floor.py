@@ -1,0 +1,175 @@
+"""Dispatch-floor ladder: separate launch / transfer / compute per
+step, plus fused-vs-host downhill trajectories (ISSUE 9 evidence).
+
+ROADMAP item 3's measured ceilings are dispatch-bound, not compute
+-bound: small/mid fit steps pin at ~1.3-1.6 ms/step regardless of
+ntoa.  This ladder decomposes that floor per config:
+
+- ``compute_ms``  — per-step cost from a chain=128 dependent lax.scan
+  (the >=16-chain rule: one dispatch amortizes the ~85 ms axon tunnel
+  round-trip to < 1 ms/step, leaving pure in-program compute);
+- ``dispatch_ms`` — wall of the SAME step as a chain=1 program
+  (launch + operand/result transfer + compute: what every host-loop
+  leg of an unfused fit pays);
+- ``launch_ms``   — a 1-element echo dispatch (the pure launch floor);
+- ``transfer_ms`` — an ntoa-sized echo minus the launch floor (the
+  operand-sized round-trip share);
+- ``overhead_ms`` = dispatch_ms - compute_ms and
+  ``chain_amortization_x`` = dispatch_ms / compute_ms — how much a
+  fused trajectory saves per step it keeps on device.
+
+The downhill rows are the tentpole's direct before/after: the SAME
+fitter refit at steady state with the fused trajectory (default; ONE
+guarded dispatch per fit) vs PINT_TPU_DOWNHILL_FUSED=0 (the host
+-loop rung: ~maxiter x (proposal + ladder) dispatches plus per-call
+re-jit — the old fit_toas behavior, kept as the fault-ladder rung).
+
+Run: ``python profiling/dispatch_floor.py`` (one JSON line per row)
+or ``python profiling/run_benchmarks.py --configs dispatch_floor``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _median_wall(fn, nrep=5):
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _echo_floor_ms(n):
+    """Wall of one warm echo dispatch of an n-element f64 array: the
+    launch floor (n=1) or launch + n-sized transfer."""
+    import jax
+
+    f = jax.jit(lambda x: x + 0.0)
+    x = np.zeros(max(1, int(n)))
+    np.asarray(f(x))  # warm (compile outside the measurement)
+    return _median_wall(lambda: np.asarray(f(x))) * 1e3
+
+
+def _floor_row(name, builder):
+    from run_benchmarks import _timeit
+
+    built = builder()
+    label, ntoa, step, x0 = built[:4]
+    chain = built[4] if len(built) > 4 else 128
+    extras = dict(built[5]) if len(built) > 5 else {}
+    jit_wrap = extras.pop("jit_wrap", None)
+    # >=16-chain rule for the compute figure; chain=1 for the honest
+    # per-dispatch wall (the round-trip IS the measurement there)
+    t_chain, _ = _timeit(step, x0, chain=max(chain, 16),
+                         jit_wrap=jit_wrap)
+    t_single, _ = _timeit(step, x0, chain=1, jit_wrap=jit_wrap)
+    launch = _echo_floor_ms(1)
+    sized = _echo_floor_ms(ntoa)
+    compute = t_chain * 1e3
+    dispatch = t_single * 1e3
+    return {
+        "config": f"dispatch_floor {name}: {label}",
+        "ntoa": ntoa,
+        "compute_ms": round(compute, 3),
+        "dispatch_ms": round(dispatch, 3),
+        "launch_ms": round(launch, 3),
+        "transfer_ms": round(max(sized - launch, 0.0), 3),
+        "overhead_ms": round(max(dispatch - compute, 0.0), 3),
+        "chain_amortization_x": round(dispatch / compute, 1)
+        if compute > 0 else None,
+    }
+
+
+def _downhill_row(name, par, ntoa, fitter_cls, nrep):
+    """Steady-state refit wall + guarded-dispatch count per fit,
+    fused (default) vs the host-loop rung (PINT_TPU_DOWNHILL_FUSED=0)
+    on the SAME converged fitter — equal footing, only the trajectory
+    driver differs."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.simulation import make_test_pulsar
+
+    m, toas = make_test_pulsar(
+        par, ntoa=ntoa, start_mjd=53000, end_mjd=57000, iterations=1
+    )
+    f = fitter_cls(toas, m)
+    g = obs_metrics.counter("dispatch.guarded")
+    row = {"config": f"dispatch_floor downhill {name}", "ntoa": ntoa}
+    for mode in ("fused", "host"):
+        saved = os.environ.get("PINT_TPU_DOWNHILL_FUSED")
+        try:
+            if mode == "host":
+                os.environ["PINT_TPU_DOWNHILL_FUSED"] = "0"
+            else:
+                os.environ.pop("PINT_TPU_DOWNHILL_FUSED", None)
+            f.fit_toas(maxiter=5)  # warm this mode's programs
+            g0 = g.value
+            t0 = time.perf_counter()
+            for _ in range(nrep):
+                f.fit_toas(maxiter=5)
+            wall = (time.perf_counter() - t0) / nrep
+            row[f"{mode}_wall_ms"] = round(wall * 1e3, 2)
+            row[f"{mode}_dispatches_per_fit"] = round(
+                (g.value - g0) / nrep, 2
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("PINT_TPU_DOWNHILL_FUSED", None)
+            else:
+                os.environ["PINT_TPU_DOWNHILL_FUSED"] = saved
+    row["dispatch_amortization_x"] = round(
+        row["host_dispatches_per_fit"]
+        / max(row["fused_dispatches_per_fit"], 1.0),
+        1,
+    )
+    row["wall_speedup_x"] = round(
+        row["host_wall_ms"] / max(row["fused_wall_ms"], 1e-9), 1
+    )
+    return row
+
+
+def floor_rows(configs=("1", "3", "5")):
+    """All ladder rows (run_benchmarks config ``dispatch_floor``)."""
+    import run_benchmarks as rb
+
+    builders = {"1": rb.config_1, "3": rb.config_3, "5": rb.config_5}
+    rows = [_floor_row(c, builders[c]) for c in configs]
+    from pint_tpu.fitting.downhill import (
+        DownhillGLSFitter,
+        DownhillWLSFitter,
+    )
+
+    rows.append(_downhill_row(
+        "config1 WLS 62 TOAs",
+        "PSR C1\nF0 61.485 1\nF1 -1.2e-15 1\nPEPOCH 53750\n"
+        "DM 224.1 1\n",
+        62, DownhillWLSFitter, nrep=3,
+    ))
+    rows.append(_downhill_row(
+        "config3 GLS 1e5 TOAs + red noise",
+        "PSR CX\nF0 218.81 1\nF1 -4.08e-16 1\nPEPOCH 55000\n"
+        "DM 15.99 1\nEFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n"
+        "TNREDAMP -13.8\nTNREDGAM 4.3\nTNREDC 30\n",
+        100_000, DownhillGLSFitter, nrep=2,
+    ))
+    return rows
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    for row in floor_rows():
+        row["backend"] = jax.default_backend()
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

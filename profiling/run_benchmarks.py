@@ -436,7 +436,8 @@ def main():
     ap.add_argument("--configs", nargs="+",
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
-                             "serve_replicas", "serve_population"])
+                             "serve_replicas", "serve_population",
+                             "dispatch_floor"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -469,6 +470,19 @@ def main():
                 "serve_population": population_sweep,
             }[str(c)]()
             for row in rows:
+                print(json.dumps(row))
+            continue
+        if str(c) == "dispatch_floor":
+            # launch/transfer/compute decomposition + fused-vs-host
+            # downhill trajectories (ISSUE 9;
+            # profiling/dispatch_floor.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from dispatch_floor import floor_rows
+
+            for row in floor_rows():
                 print(json.dumps(row))
             continue
         built = builders[str(c)]()

@@ -11,7 +11,12 @@ import numpy as np
 import pytest
 
 from pint_tpu.exceptions import TimingModelError
-from pint_tpu.models.builder import UnknownParameterWarning, get_model
+from pint_tpu.models.builder import (
+    UnknownParameterWarning,
+    clear_parse_cache,
+    get_model,
+)
+from pint_tpu.obs import metrics as obs_metrics
 
 PAR = """
 PSRJ            J1857+0943
@@ -114,6 +119,87 @@ def test_prefix_param_beyond_preallocated():
     )
     m = get_model(par)
     assert m.params["F14"].value == pytest.approx(1e-34)
+
+
+# -- par-text parse cache (ISSUE 9) ---------------------------------------
+def _parses():
+    return obs_metrics.counter("model.parses").value
+
+
+def _f0(m):
+    v = m.params["F0"].value
+    return float(v.to_float()) if hasattr(v, "to_float") else float(v)
+
+
+def test_parse_cache_hit_skips_parse_and_isolates():
+    clear_parse_cache()
+    par = PAR.replace("J1857+0943", "J1857+0001")
+    p0 = _parses()
+    h0 = obs_metrics.counter("model.parse_cache_hits").value
+    m1 = get_model(par)
+    m2 = get_model(par)
+    # second load is a cache hit: no host parse happened
+    assert _parses() == p0 + 1
+    assert (
+        obs_metrics.counter("model.parse_cache_hits").value == h0 + 1
+    )
+    assert m2 is not m1
+    assert m1.as_parfile() == m2.as_parfile()
+    assert set(m1.components) == set(m2.components)
+    assert m2.params["JUMP1"].key == "-fe"
+    # the cache hands out INDEPENDENT models: mutating one never
+    # leaks into the cached prototype or later loads
+    f0 = _f0(m1)
+    m2.params["F0"].value = 1.0
+    m3 = get_model(par)
+    assert _f0(m3) == pytest.approx(f0)
+
+
+def test_parse_cache_env_disable(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_PARSE_CACHE", "0")
+    clear_parse_cache()
+    par = PAR.replace("J1857+0943", "J1857+0002")
+    p0 = _parses()
+    get_model(par)
+    get_model(par)
+    assert _parses() == p0 + 2
+
+
+def test_parse_cache_replays_parse_warnings():
+    clear_parse_cache()
+    par = "PSR J0\nF0 10\nPEPOCH 55000\nNOTAPARAM 12\n"
+    with pytest.warns(UnknownParameterWarning):
+        m1 = get_model(par)
+    with pytest.warns(UnknownParameterWarning):
+        m2 = get_model(par)  # replayed from the cache hit
+    assert "NOTAPARAM" in m1.unrecognized
+    assert "NOTAPARAM" in m2.unrecognized
+
+
+def test_parse_cache_ignores_paths(tmp_path):
+    # a path's content can change on disk — only par TEXT caches
+    clear_parse_cache()
+    f = tmp_path / "a.par"
+    f.write_text("PSR J0\nF0 10 1\nPEPOCH 55000\n")
+    p0 = _parses()
+    get_model(str(f))
+    get_model(str(f))
+    assert _parses() == p0 + 2
+
+
+def test_parse_cache_lru_bound(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_PARSE_CACHE_SIZE", "2")
+    clear_parse_cache()
+    pars = [
+        f"PSR J000{i}\nF0 10 1\nPEPOCH 55000\n" for i in range(3)
+    ]
+    for p in pars:
+        get_model(p)
+    p0 = _parses()
+    get_model(pars[0])  # LRU-evicted by pars[2]: re-parses
+    assert _parses() == p0 + 1
+    get_model(pars[2])  # still resident: hit
+    assert _parses() == p0 + 1
 
 
 def test_dmx_routing():

@@ -160,6 +160,92 @@ def test_downhill_measured_noise_floor_zero_on_cpu():
     assert f.last_noise_floor < 1e-6 * max(chi2, 1.0)
 
 
+# -- fused-vs-host trajectory parity (ISSUE 9) ----------------------------
+def _vals(m, names=("F0", "F1", "DM")):
+    out = {}
+    for n in names:
+        v = m.params[n].value
+        out[n] = float(v.to_float()) if hasattr(v, "to_float") else float(v)
+        out[n + ".unc"] = m.params[n].uncertainty
+    return out
+
+
+@pytest.mark.parametrize("offset_start", [False, True])
+def test_fused_trajectory_matches_host_loop_wls(monkeypatch, offset_start):
+    """The fused single-dispatch trajectory must be decision-for
+    -decision identical to the reference host loop: same convergence
+    verdict, same iteration count, same parameters/uncertainties (the
+    in-program ladder and noise-floor fit replicate the host math)."""
+    m_true = get_model(PAR)
+    toas = _toas(m_true, n=200)
+    results = {}
+    for mode in ("fused", "host"):
+        if mode == "host":
+            monkeypatch.setenv("PINT_TPU_DOWNHILL_FUSED", "0")
+        else:
+            monkeypatch.delenv("PINT_TPU_DOWNHILL_FUSED", raising=False)
+        m = get_model(PAR)
+        if offset_start:
+            m.params["F0"].value = str(
+                float(m.params["F0"].value.to_float()) + 5e-10
+            )
+        f = DownhillWLSFitter(toas, m)
+        chi2 = f.fit_toas()
+        results[mode] = (f.converged, f.niter, chi2, _vals(m))
+    conv_f, niter_f, chi2_f, vals_f = results["fused"]
+    conv_h, niter_h, chi2_h, vals_h = results["host"]
+    assert conv_f == conv_h is True
+    assert niter_f == niter_h
+    assert chi2_f == pytest.approx(chi2_h, rel=1e-9)
+    for k in vals_h:
+        assert vals_f[k] == pytest.approx(
+            vals_h[k], rel=1e-9, abs=1e-30
+        ), k
+
+
+def test_fused_trajectory_matches_host_loop_gls(monkeypatch):
+    par = PAR + "ECORR -f L-wide 0.5\nTNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 10\n"
+    m_true = get_model(PAR)
+    toas = _toas(m_true, n=120)
+    for i, fl in enumerate(toas.flags):
+        fl["f"] = "L-wide" if i % 2 else "S-wide"
+    results = {}
+    for mode in ("fused", "host"):
+        if mode == "host":
+            monkeypatch.setenv("PINT_TPU_DOWNHILL_FUSED", "0")
+        else:
+            monkeypatch.delenv("PINT_TPU_DOWNHILL_FUSED", raising=False)
+        m = get_model(par)
+        f = DownhillGLSFitter(toas, m)
+        chi2 = f.fit_toas()
+        results[mode] = (f.converged, f.niter, chi2, _vals(m))
+    conv_f, niter_f, chi2_f, vals_f = results["fused"]
+    conv_h, niter_h, chi2_h, vals_h = results["host"]
+    assert conv_f == conv_h is True
+    assert niter_f == niter_h
+    assert chi2_f == pytest.approx(chi2_h, rel=1e-8)
+    for k in vals_h:
+        assert vals_f[k] == pytest.approx(vals_h[k], rel=1e-8, abs=1e-30), k
+
+
+def test_fused_steady_state_is_one_guarded_dispatch():
+    """The tentpole's observable: a warm refit moves the guarded
+    -dispatch counter by EXACTLY one (the whole trajectory is one
+    device program; the host loop pays ~maxiter x (proposal +
+    ladder))."""
+    from pint_tpu.obs import metrics as obs_metrics
+
+    m_true = get_model(PAR)
+    toas = _toas(m_true)
+    f = DownhillWLSFitter(toas, get_model(PAR))
+    f.fit_toas()  # warm: compiles + ladder probes
+    g = obs_metrics.counter("dispatch.guarded")
+    g0 = g.value
+    f.fit_toas()
+    assert f.converged
+    assert g.value - g0 == 1
+
+
 def test_ftest():
     # adding 2 useless params: p ~ uniform; adding 2 that wipe chi2: p ~ 0
     assert ftest(100.0, 98, 99.0, 96) > 0.3

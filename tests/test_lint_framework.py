@@ -472,6 +472,41 @@ def test_locks_flags_wrong_lock_and_subscript():
     assert [f.lineno for f in out] == [9]
 
 
+# -- obs6: the ISSUE 9 dispatch-floor chokepoints -------------------------
+def test_obs6_flags_stripped_trajectory_and_coalesce_guards(tmp_path):
+    """obs6 catches a fused-trajectory or coalescing path losing its
+    guard/instrumentation, skips packages that predate the subsystem,
+    and passes the real tree (where the guards are live)."""
+    obs6 = rules_by_name()["obs6"]
+    # fixture packages without fitting/ or serve/fabric/ skip
+    bare = tmp_path / "bare" / "pint_tpu"
+    bare.mkdir(parents=True)
+    (bare / "a.py").write_text("x = 1\n")
+    assert obs6.check_project(bare) == []
+    # stripped guards are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "fitting").mkdir(parents=True)
+    (pkg / "serve" / "fabric").mkdir(parents=True)
+    (pkg / "fitting" / "downhill.py").write_text(
+        "class DownhillFitter:\n"
+        "    def _fused_loop(self):\n"
+        "        return 1\n"
+        "    def fit_toas(self):\n"
+        "        return self._fused_loop()\n"
+    )
+    (pkg / "serve" / "fabric" / "replica.py").write_text(
+        "class Replica:\n"
+        "    def _coalesce(self, work):\n"
+        "        return work\n"
+    )
+    msgs = "\n".join(f.message for f in obs6.check_project(pkg))
+    assert "cm.jit(" in msgs          # fused dispatch unguarded
+    assert "run_ladder(" in msgs      # fault ladder bypassed
+    assert "TRACER.span" in msgs and "_kernels" in msgs  # coalescer
+    # the real tree carries all the guards
+    assert obs6.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

@@ -422,6 +422,69 @@ def test_onchip_measured_noise_floor_within_model_bounds():
     )
 
 
+def test_onchip_fused_trajectory_matches_host_loop():
+    """ISSUE 9 spot-check on the real accelerator: the fused single
+    -dispatch downhill trajectory runs its lambda ladder, noise-floor
+    line fit, and accept/reject control IN-PROGRAM under emulated f64
+    — it must still land on the host loop's verdict and parameters
+    (cross-program chi2 offsets are below the measured noise floor, so
+    decisions agree; iteration counts may differ by ladder-edge coin
+    flips and are pinned on CPU in tests/test_downhill.py, not here),
+    and a warm refit must cost exactly ONE guarded dispatch."""
+    import os
+
+    from pint_tpu.fitting import DownhillWLSFitter
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR FUSED\nF0 211.7 1\nF1 -9.9e-16 1\nPEPOCH 55000\n"
+        "DM 21.4 1\n"
+    )
+    results = {}
+    for mode in ("fused", "host"):
+        saved = os.environ.get("PINT_TPU_DOWNHILL_FUSED")
+        try:
+            if mode == "host":
+                os.environ["PINT_TPU_DOWNHILL_FUSED"] = "0"
+            else:
+                os.environ.pop("PINT_TPU_DOWNHILL_FUSED", None)
+            m, toas = make_test_pulsar(
+                par, ntoa=300, start_mjd=54000.0, end_mjd=56000.0,
+                seed=7, iterations=1,
+            )
+            f = DownhillWLSFitter(toas, m)
+            chi2 = f.fit_toas()
+            assert np.isfinite(chi2) and f.converged, mode
+            if mode == "fused":
+                # warm refit: the whole trajectory is one guarded
+                # dispatch (the tentpole's on-chip observable)
+                g = obs_metrics.counter("dispatch.guarded")
+                g0 = g.value
+                f.fit_toas()
+                assert g.value - g0 == 1
+            vals = {}
+            for n in f.cm.free_names:
+                p = f.model.params[n]
+                v = p.value
+                vals[n] = (
+                    float(v.to_float()) if hasattr(v, "to_float")
+                    else float(v),
+                    float(p.uncertainty),
+                )
+            results[mode] = vals
+        finally:
+            if saved is None:
+                os.environ.pop("PINT_TPU_DOWNHILL_FUSED", None)
+            else:
+                os.environ["PINT_TPU_DOWNHILL_FUSED"] = saved
+    for n, (vf, uf) in results["fused"].items():
+        vh, _ = results["host"][n]
+        assert abs(vf - vh) < 0.2 * uf + 1e-12, (
+            f"{n}: fused {vf} vs host {vh} ({abs(vf-vh)/uf:.3f} sigma)"
+        )
+
+
 def test_onchip_population_stacking_is_bitwise_neutral():
     """ISSUE 6 spot-check on the real accelerator: a request's served
     residuals/fit must be BITWISE identical whether its capacity-4

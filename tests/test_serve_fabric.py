@@ -20,6 +20,7 @@ surface:
   typed error, bounded-time, never a hang.
 """
 
+import collections
 import threading
 import time
 import types
@@ -29,6 +30,7 @@ import pytest
 
 from pint_tpu.exceptions import (
     GuardTimeout,
+    PintTpuError,
     PintTpuNumericsError,
     RequestRejected,
     RetriesExhausted,
@@ -44,8 +46,11 @@ from pint_tpu.serve.fabric import (
     DRAINED,
     LIVE,
     QUARANTINED,
+    BatchWork,
+    Replica,
     ReplicaPool,
     Router,
+    merge_batch_works,
 )
 from pint_tpu.simulation import make_test_pulsar
 
@@ -432,6 +437,170 @@ def test_parity_1_vs_4_replica_fabric(pulsars):
             )
             assert a.fitted_par == b.fitted_par
         assert a.chi2 == b.chi2
+
+
+# -- in-replica batch coalescing (ISSUE 9) --------------------------------
+def _mk_work(key, nlive, cap, base, excluded=()):
+    """Synthetic BatchWork: distinct real rows (value encodes live
+    index), pad rows repeating row 0 — the engine _assemble shape."""
+    live = [types.SimpleNamespace(idx=base + j) for j in range(nlive)]
+    real = base + np.arange(nlive, dtype=float)
+    a = real[:, None] * np.array([1.0, 10.0, 100.0])
+    b = real.copy()
+
+    def pad(leaf):
+        extra = cap - leaf.shape[0]
+        if extra:
+            leaf = np.concatenate(
+                [leaf, np.repeat(leaf[:1], extra, axis=0)]
+            )
+        return leaf
+
+    w = BatchWork(key, live, (pad(a), pad(b)), session="sess", cap=cap)
+    w.excluded = set(excluded)
+    return w
+
+
+def test_merge_batch_works_row_alignment_and_padding():
+    key = ("residuals", "comp", 64, True)
+    a = _mk_work(key, 2, 4, base=0, excluded={1})
+    b = _mk_work(key, 3, 4, base=10, excluded={2})
+    m = merge_batch_works([a, b], 8)
+    assert m.cap == 8 and m.key == key and m.session == "sess"
+    # merged row i stays aligned with merged.live[i] (source pad rows
+    # stripped, real rows concatenated in works order)
+    assert [p.idx for p in m.live] == [0, 1, 10, 11, 12]
+    la, lb = m.ops
+    expect = np.array([0.0, 1.0, 10.0, 11.0, 12.0])
+    np.testing.assert_array_equal(lb[:5], expect)
+    np.testing.assert_array_equal(
+        la[:5], expect[:, None] * np.array([1.0, 10.0, 100.0])
+    )
+    # re-pad repeats the MERGED batch's own row 0 (_assemble parity)
+    np.testing.assert_array_equal(lb[5:], np.repeat(lb[:1], 3))
+    np.testing.assert_array_equal(la[5:], np.tile(la[:1], (3, 1)))
+    assert m.excluded == {1, 2}
+    with pytest.raises(PintTpuError):
+        merge_batch_works([a, b], 4)
+
+
+def _bare_replica():
+    """A thread-less Replica shell: enough state for the _coalesce
+    decision logic (FakeReplica precedent — unit-test the policy
+    without devices/threads)."""
+    r = object.__new__(Replica)
+    r.tag = "rX"
+    r._cond = threading.Condition()
+    r._queue = collections.deque()
+    r._kernels = {}
+    r._coalesce_on = True
+    r._outstanding = 0
+    r._g_out = obs_metrics.gauge("serve.replica.test.outstanding")
+    return r
+
+
+def test_coalesce_only_lands_on_warmed_capacities():
+    key = ("residuals", "comp", 64, True)
+    other = ("residuals", "comp2", 64, True)
+    r = _bare_replica()
+    head = _mk_work(key, 2, 2, base=0)
+    r._queue.append(_mk_work(key, 1, 1, base=10))
+    r._outstanding = 2
+    # grown capacity (pow2(3) = 4) NOT warmed: nothing is absorbed
+    assert r._coalesce(head) is head
+    assert len(r._queue) == 1 and r._outstanding == 2
+    # warm it; a different-key neighbor must stay queued
+    r._kernels[(key, 4)] = lambda *a: None
+    r._queue.append(_mk_work(other, 1, 1, base=20))
+    r._outstanding = 3
+    merged = r._coalesce(head)
+    assert merged is not head
+    assert [p.idx for p in merged.live] == [0, 1, 10]
+    assert merged.cap == 4
+    assert [w.key for w in r._queue] == [other]
+    # absorbed batch accounted out of _outstanding (the merged batch
+    # keeps ONE slot for its single completion-time _batch_leaves)
+    assert r._outstanding == 2
+
+
+def test_coalesce_disabled_by_env(monkeypatch, pulsars):
+    monkeypatch.setenv("PINT_TPU_SERVE_COALESCE", "0")
+    eng = TimingEngine(max_batch=2, max_wait_ms=1.0, replicas=1)
+    try:
+        assert all(
+            not rep._coalesce_on for rep in eng.pool.replicas
+        )
+        w = object()  # pass-through when disabled: never inspected
+        assert eng.pool.replica(0)._coalesce(w) is w
+    finally:
+        eng.close(timeout=60)
+
+
+def test_coalesce_merges_queued_same_key_batches(pulsars):
+    """End-to-end: batches co-resident behind a stalled dispatch merge
+    into ONE stacked dispatch on an already-warmed capacity — the
+    coalesced counter moves, responses stay bitwise-identical to the
+    uncoalesced path, and NO new XLA trace happens (the zero-steady
+    -retrace invariant with coalescing on)."""
+    eng = TimingEngine(
+        max_batch=4, max_wait_ms=40.0, inflight=8, replicas=1,
+        max_queue=64,
+    )
+    try:
+        par, toas = pulsars[0]
+
+        def wave(n):
+            futs = [
+                eng.submit(ResidualsRequest(par=par, toas=toas))
+                for _ in range(n)
+            ]
+            return [f.result(timeout=300) for f in futs]
+
+        # warm capacities 1, 2 and 4 on r0
+        warm = wave(1)[0]
+        assert {r.batch_size for r in wave(2)} == {2}
+        assert {r.batch_size for r in wave(4)} == {4}
+        c0 = obs_metrics.counter("serve.fabric.coalesced").value
+        traces0 = obs_metrics.counter("compile.traces").value
+        # stall the FIRST measured dispatch so the two partial batches
+        # submitted behind it are co-resident in r0's queue when the
+        # dispatcher wakes
+        with faults.inject(
+            "hang:1@serve:residuals", hang_seconds=2.0
+        ):
+            first = eng.submit(ResidualsRequest(par=par, toas=toas))
+            time.sleep(0.3)  # its 1-row batch flushed and is hanging
+            pair1 = [
+                eng.submit(ResidualsRequest(par=par, toas=toas))
+                for _ in range(2)
+            ]
+            time.sleep(0.25)  # > max_wait: forces a SECOND 2-row batch
+            pair2 = [
+                eng.submit(ResidualsRequest(par=par, toas=toas))
+                for _ in range(2)
+            ]
+            out = [
+                f.result(timeout=300)
+                for f in [first, *pair1, *pair2]
+            ]
+        assert (
+            obs_metrics.counter("serve.fabric.coalesced").value
+            >= c0 + 1
+        )
+        # the two 2-row batches really served as ONE 4-deep dispatch
+        assert [r.batch_size for r in out[1:]] == [4, 4, 4, 4]
+        # coalescing must not change numerics or trace anything new
+        for r in out:
+            np.testing.assert_array_equal(
+                r.residuals_s, warm.residuals_s
+            )
+            assert r.chi2 == warm.chi2
+        assert (
+            obs_metrics.counter("compile.traces").value == traces0
+        )
+        assert eng.stats()["fabric"]["coalesced"] >= 1
+    finally:
+        eng.close(timeout=60)
 
 
 # -- drain guarantees -----------------------------------------------------

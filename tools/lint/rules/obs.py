@@ -31,6 +31,11 @@ nothing at runtime can notice the absence.
 - ``obs5`` — stacked-dispatch chokepoint (ISSUE 6):
   ``TimingEngine._assemble`` spans the ``stack_trees`` assembly, the
   batched kernel builders route through ``traced_jit``.
+- ``obs6`` — dispatch-floor chokepoints (ISSUE 9): the fused downhill
+  trajectory builds through ``cm.jit`` (guarded, trace-counted) and
+  ``fit_toas`` drives it under the ``run_ladder`` fault ladder; the
+  replica batch coalescer stays span-instrumented and gated on the
+  warmed ``_kernels`` cache (the zero-steady-retrace invariant).
 """
 
 from __future__ import annotations
@@ -215,6 +220,24 @@ _POPULATION_CHECKS = (
      "the stacked fit dispatch must route through the "
      "trace-counted serve chokepoint"),
 )
+_TRAJECTORY_CHECKS = (
+    ("fitting/downhill.py", "DownhillFitter._fused_loop",
+     ("cm.jit(",),
+     "the fused downhill trajectory must dispatch through the "
+     "guarded, trace-counted chokepoint (one dispatch per fit is "
+     "only observable if the recorder sees it)"),
+    ("fitting/downhill.py", "DownhillFitter.fit_toas",
+     ("run_ladder(",),
+     "the fused trajectory must run under the guarded fault ladder "
+     "(native -> f64-fallback -> host-loop)"),
+)
+_COALESCE_CHECKS = (
+    ("serve/fabric/replica.py", "Replica._coalesce",
+     ("TRACER.span", "_kernels"),
+     "replica batch coalescing must stay span-instrumented and "
+     "gated on warmed kernel-cache entries (the zero-steady-retrace "
+     "invariant)"),
+)
 
 
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
@@ -281,12 +304,31 @@ class Obs5Rule(Rule):
         )
 
 
+class Obs6Rule(Rule):
+    """Dispatch-floor chokepoints (ISSUE 9): the fused downhill
+    trajectory dispatches through cm.jit under run_ladder, replica
+    coalescing stays span-instrumented and warmed-kernel gated."""
+
+    name = "obs6"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        return _run_checks(
+            self.name, pkg_root, _TRAJECTORY_CHECKS,
+            pkg_root / "fitting",
+        ) + _run_checks(
+            self.name, pkg_root, _COALESCE_CHECKS,
+            pkg_root / "serve" / "fabric",
+        )
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
 OBS4 = Obs4Rule()
 OBS5 = Obs5Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5)
+OBS6 = Obs6Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
@@ -312,12 +354,13 @@ def lint_paths(paths) -> list:
 
 
 def check_chokepoints(pkg_root) -> list:
-    """obs2-obs5 over one package root (the pre-framework
+    """obs2-obs6 over one package root (the pre-framework
     ``check_chokepoints`` surface, finding-for-finding)."""
     pkg_root = Path(pkg_root)
     findings = _core_chokepoints(pkg_root)
     findings += OBS3.check_project(pkg_root)
     findings += OBS4.check_project(pkg_root)
     findings += OBS5.check_project(pkg_root)
+    findings += OBS6.check_project(pkg_root)
     findings += _fit_decorators(pkg_root)
     return findings
