@@ -396,7 +396,16 @@ def _serve_block():
     (ON) throughout this block, so the zero-steady-retrace gates above
     ALSO certify that merged dispatches only ever land on warmed
     kernel capacities; coalesced_batches reports how many queued
-    batches were absorbed into stacked dispatches."""
+    batches were absorbed into stacked dispatches.
+
+    ISSUE 10 adds the GANG figures (_gang_probe): a mixed pool (one
+    gang over half the devices + singles) serving an above-threshold
+    1024-bucket fit load.  Gates (all backends, >= 2 devices): the
+    big work is served by gang-tagged executors through normal
+    submit(), and the steady window adds ZERO recompiles on every
+    executor (the per-gang mode-keyed kernel caches); on accelerators
+    with a real gang the sharded big-fit throughput must reach
+    >= 1.5x the single-replica rung."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -599,7 +608,109 @@ def _serve_block():
             "compositions": pst["population"]["compositions"],
         }
 
+    # gang probe (ISSUE 10): big-bucket work through a mixed pool —
+    # the router must keep it on the gang, the gang must shard it
+    # with zero steady recompiles, and on accelerators the sharded
+    # compute must beat one chip
+    def _gang_probe():
+        from pint_tpu.parallel.mesh import serving_devices
+
+        ndev = len(serving_devices())
+        if ndev < 2:
+            return {
+                "skipped": f"needs >= 2 devices, have {ndev}",
+            }
+        gsize = max(2, ndev // 2)
+        bm, btoas = make_test_pulsar(
+            "PSR BIGG\nF0 171.5 1\nF1 -1.5e-15 1\nPEPOCH 55000\n"
+            "DM 7.7 1\n",
+            ntoa=600,  # 1024 bucket: above the probe's gang threshold
+            start_mjd=54000.0, end_mjd=56000.0, seed=41,
+            iterations=1,
+        )
+        bpar = bm.as_parfile()
+        nreq = 6
+
+        def big_reqs():
+            return [
+                FitRequest(par=bpar, toas=btoas, maxiter=2)
+                for _ in range(nreq)
+            ]
+
+        def rung(**kw):
+            geng = TimingEngine(
+                max_batch=2, max_wait_ms=2.0, inflight=2,
+                max_queue=256, **kw,
+            )
+            try:
+                for _ in range(2):  # warm the (bucket, cap) kernels
+                    for f in geng.submit_many(big_reqs()):
+                        f.result(timeout=3600)
+                geng.reset_stats()
+                rec0 = obs_metrics.counter("compile.recompiles").value
+                t0 = time.perf_counter()
+                futs = []
+                for _ in range(rounds):
+                    futs += geng.submit_many(big_reqs())
+                tags = {f.result(timeout=3600).replica for f in futs}
+                rung_wall = time.perf_counter() - t0
+                rec = (
+                    obs_metrics.counter("compile.recompiles").value
+                    - rec0
+                )
+                return (
+                    nreq * rounds / rung_wall, rec, tags,
+                    geng.stats()["fabric"],
+                )
+            finally:
+                geng.close()
+
+        s_rps, s_rec, _s_tags, _ = rung(replicas=1)
+        g_rps, g_rec, g_tags, g_fab = rung(
+            replicas=0, gangs=1, gang_size=gsize,
+            gang_threshold=512, affinity=1,
+        )
+        if s_rec or g_rec:
+            raise PintTpuError(
+                f"{s_rec}+{g_rec} steady-state XLA recompile(s) "
+                "across the gang-probe rungs — an executor retraced "
+                "a warmed kernel (per-gang caches key (group, "
+                "capacity, gang shape, placement mode); "
+                "docs/serving.md)"
+            )
+        if g_fab["gangs"] >= 1 and not all(
+            t.startswith("g") for t in g_tags
+        ):
+            raise PintTpuError(
+                f"above-threshold 1024-bucket fits served by {sorted(g_tags)} "
+                "— the router must place big session groups on gang "
+                "executors (docs/serving.md)"
+            )
+        gang_scaling = g_rps / s_rps
+        if (jax.default_backend() != "cpu"
+                and g_fab["gangs"] >= 1 and gang_scaling < 1.5):
+            raise PintTpuError(
+                f"gang-of-{gsize} sharded big-fit throughput reached "
+                f"only {gang_scaling:.2f}x the single-replica rung "
+                "(>= 1.5x required on accelerators: the gang must "
+                "shard the TOA axis across its members; "
+                "docs/serving.md)"
+            )
+        return {
+            "devices": ndev,
+            "gang_size": gsize,
+            "gangs": g_fab["gangs"],
+            "big_bucket": 1024,
+            "gang_threshold": g_fab["gang_threshold"],
+            "single_replica_rps": round(s_rps, 2),
+            "gang_rps": round(g_rps, 2),
+            "gang_scaling_x": round(gang_scaling, 2),
+            "big_served_by": sorted(g_tags),
+            "steady_recompiles": s_rec + g_rec,
+        }
+
     population = _population_probe()
+    gang = _gang_probe()
 
     r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
     r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
@@ -650,6 +761,7 @@ def _serve_block():
         "steady_retraces": retraces,
         "coalesced_batches": st["fabric"]["coalesced"],
         "population": population,
+        "gang": gang,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
             tag: rs["batches"]

@@ -263,7 +263,13 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
             "replicas: " + "  ".join(
                 f"r{rid}[{' '.join(fields)}]"
                 for rid, fields in sorted(
-                    per.items(), key=lambda kv: int(kv[0])
+                    # rids are numeric in production but test doubles
+                    # register arbitrary strings — sort those after
+                    per.items(),
+                    key=lambda kv: (
+                        (0, int(kv[0]), "") if kv[0].isdigit()
+                        else (1, 0, kv[0])
+                    ),
                 )
             )
         )

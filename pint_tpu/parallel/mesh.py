@@ -107,6 +107,22 @@ def serving_devices(n: Optional[int] = None) -> list:
     return devs
 
 
+def gang_mesh(devices) -> Mesh:
+    """1-D ('toa',) mesh over a gang's device subset.
+
+    The serving fabric's gang replicas (serve/fabric/gang.py) carve
+    contiguous subsets out of :func:`serving_devices` and shard their
+    big-bucket session dispatches over this mesh — same axis name and
+    layout convention as the batch shard_map kernels
+    (parallel/gls.py::sharded_gls_step, parallel/dense.py), so the
+    collectives GSPMD inserts match the ones those kernels spell
+    explicitly (docs/parallelism.md)."""
+    devs = list(devices)
+    if not devs:
+        raise ValueError("gang_mesh: empty device set")
+    return Mesh(np.asarray(devs), axis_names=("toa",))
+
+
 def make_mesh(
     n_toa_shards: Optional[int] = None,
     n_pulsar_shards: int = 1,

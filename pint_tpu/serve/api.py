@@ -156,7 +156,7 @@ class ResidualsResponse:
     bucket: int  # TOA-axis shape bucket that served the request
     batch_size: int  # live requests stacked in the serving batch
     wall_ms: float  # submit -> result wall time
-    replica: str = ""  # fabric replica tag ('r3') that ran the batch
+    replica: str = ""  # fabric executor tag ('r3', or 'g0' for a gang)
 
 
 @dataclass
@@ -174,7 +174,7 @@ class FitResponse:
     bucket: int
     batch_size: int
     wall_ms: float
-    replica: str = ""  # fabric replica tag that ran the batch
+    replica: str = ""  # fabric executor tag ('rN' single, 'gN' gang)
 
 
 @dataclass

@@ -40,10 +40,20 @@ requests with DIFFERENT pars of one composition stack into one
 vmapped dispatch — N distinct-par clients cost one XLA compile per
 (bucket, batch capacity), total.
 
+Gang scheduling (ISSUE 10): the pool may be MIXED — gang executors
+(serve/fabric/gang.py — one executor over a device subset, sharding
+big-bucket session dispatches over its own 'toa' mesh) next to
+single-device replicas — and the router classifies every group by its
+TOA bucket against the gang threshold: big sessions place on gangs
+(typed responses carry the gang tag ``gN``), small ones on singles.
+Sub-ceiling work keeps bitwise single-replica numerics; the whole
+path stays zero-steady-retrace (per-gang kernel caches keyed
+(group key, capacity, gang shape, placement mode)).
+
 All engine/serving knobs have ``PINT_TPU_SERVE_*`` env defaults
 (documented in docs/serving.md): MAX_QUEUE, MAX_BATCH, MAX_WAIT_MS,
 INFLIGHT, SESSIONS, PARS, MIN_BUCKET, REPLICAS, AFFINITY,
-QUARANTINE_N, PROBE_MS.
+QUARANTINE_N, PROBE_MS, GANGS, GANG_SIZE, GANG_THRESHOLD.
 """
 
 from __future__ import annotations
@@ -63,6 +73,7 @@ from pint_tpu.runtime.guard import validate_finite
 from pint_tpu.serve import batcher as bmod
 from pint_tpu.serve import session as smod
 from pint_tpu.serve.fabric import BatchWork, ReplicaPool, Router
+from pint_tpu.serve.fabric.gang import gang_threshold as gang_threshold_fn
 from pint_tpu.fitting.base import noffset
 
 
@@ -87,7 +98,8 @@ class TimingEngine:
     def __init__(self, *, max_queue=None, max_batch=None,
                  max_wait_ms=None, inflight=None, min_bucket=None,
                  max_sessions=None, replicas=None, affinity=None,
-                 quarantine_n=None, probe_ms=None):
+                 quarantine_n=None, probe_ms=None, gangs=None,
+                 gang_size=None, gang_threshold=None):
         env = os.environ.get
         self.max_queue = int(
             max_queue if max_queue is not None
@@ -118,8 +130,10 @@ class TimingEngine:
         # across replica fence threads — it is light next to the device
         # work and not audited for concurrent use
         self._finish_lock = threading.Lock()
-        # the multi-device fabric: one executor per serving device +
-        # the affinity router (serve/fabric/)
+        # the multi-device fabric: one executor per serving device —
+        # or per device SUBSET for gang executors (ISSUE 10) — plus
+        # the size-classifying affinity router (serve/fabric/)
+        gang_threshold = gang_threshold_fn(gang_threshold)
         self.pool = ReplicaPool(
             replicas=replicas,
             inflight=max(1, self.inflight),
@@ -127,13 +141,19 @@ class TimingEngine:
             probe_interval_s=(
                 None if probe_ms is None else float(probe_ms) / 1e3
             ),
+            gangs=gangs,
+            gang_size=gang_size,
+            gang_threshold=gang_threshold,
             requeue=self._requeue,
             finisher=self._finish_batch,
             validator=self._validate_batch,
         )
         if affinity is None:
             affinity = int(env("PINT_TPU_SERVE_AFFINITY", "0"))
-        self.router = Router(self.pool, affinity=affinity or None)
+        self.router = Router(
+            self.pool, affinity=affinity or None,
+            gang_threshold_toas=gang_threshold,
+        )
         m = obs_metrics
         self._m_requests = m.counter("serve.requests")
         self._m_completed = m.counter("serve.completed")
@@ -577,6 +597,7 @@ class TimingEngine:
             },
             "fabric": {
                 "replicas": self.pool.size,
+                "gangs": len(self.pool.gangs),
                 "live": len(self.pool.live),
                 "routes": mc("serve.fabric.routes").value,
                 "reroutes": mc("serve.fabric.reroutes").value,

@@ -21,16 +21,29 @@ not one global dispatch loop):
   prober that re-admits quarantined replicas, and graceful
   drain-on-shutdown.
 
+- :mod:`pint_tpu.serve.fabric.gang` — the width-N executor (ISSUE
+  10): a gang replica owns a device SUBSET, shards big-bucket session
+  dispatches over its own ``('toa',)`` mesh (the batch shard_map
+  axis convention — parallel/gls.py, parallel/dense.py), runs
+  sub-threshold work bitwise-identically to a single replica on its
+  lead device, and quarantines/readmits/drains as a unit (fault
+  sites ``...@gN``).  The pool partitions devices into gangs +
+  singles; the router classifies groups by TOA bucket against the
+  gang threshold.
+
 Env knobs: ``PINT_TPU_SERVE_REPLICAS`` (pool width; 0 = all local
 devices), ``PINT_TPU_SERVE_AFFINITY`` (max replicas per session
 group; 0 = pool width), ``PINT_TPU_SERVE_QUARANTINE_N`` (consecutive
 failures before quarantine), ``PINT_TPU_SERVE_PROBE_MS`` (canary
 probe cadence), ``PINT_TPU_SERVE_COALESCE`` (in-replica same-key
-batch coalescing, default on; ISSUE 9).  Semantics in
-docs/serving.md; the per-replica span/
-metric taxonomy in docs/observability.md.
+batch coalescing, default on; ISSUE 9), ``PINT_TPU_SERVE_GANGS`` /
+``PINT_TPU_SERVE_GANG_SIZE`` (mixed-pool partition; default 0 gangs),
+``PINT_TPU_SERVE_GANG_THRESHOLD`` (big-session TOA-bucket cutover;
+default the bake/argue threshold).  Semantics in docs/serving.md;
+the per-replica span/metric taxonomy in docs/observability.md.
 """
 
+from pint_tpu.serve.fabric.gang import GangReplica, gang_threshold
 from pint_tpu.serve.fabric.pool import ReplicaPool
 from pint_tpu.serve.fabric.replica import (
     DEGRADED,
@@ -48,11 +61,13 @@ __all__ = [
     "BatchWork",
     "DEGRADED",
     "DRAINED",
+    "GangReplica",
     "LIVE",
     "QUARANTINED",
     "Replica",
     "ReplicaPool",
     "Router",
+    "gang_threshold",
     "health_kind",
     "merge_batch_works",
 ]

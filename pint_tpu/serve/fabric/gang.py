@@ -1,0 +1,217 @@
+"""Gang replica: one executor over a device SUBSET (ISSUE 10).
+
+Reference parity: none — TPU-service infrastructure.  The r8 fabric
+pinned one replica per device, so no serving session could ever be
+larger than one chip — yet the heaviest workloads in the ladder are
+exactly the ones that already shard 8-way (dense full-cov GLS via
+parallel/dense.py::blocked_cholesky, the 2^20-TOA Woodbury axis,
+sharded wideband).  A :class:`GangReplica` is the width-N case of the
+generalized executor (replica.py): it owns a contiguous subset of
+:func:`~pint_tpu.parallel.mesh.serving_devices`, carves a 1-D
+``('toa',)`` mesh over it (:func:`~pint_tpu.parallel.mesh.gang_mesh`
+— same axis convention as the batch shard_map kernels in
+parallel/gls.py / parallel/dense.py, so GSPMD inserts the same
+psum collectives those kernels spell explicitly), and serves the
+router's BIG session groups by sharding each stacked dispatch's TOA
+axis across the gang:
+
+- **big buckets** (``bucket >= shard_threshold``, the router's gang
+  classification threshold — env ``PINT_TPU_SERVE_GANG_THRESHOLD``,
+  default keyed off the bake/argue cutover ``PINT_TPU_BAKE_THRESHOLD``):
+  :meth:`GangReplica._place_ops` commits every stacked operand leaf
+  whose second axis is the TOA bucket with
+  ``NamedSharding(mesh, P(None, 'toa'))`` (axis 0 is the vmapped
+  capacity axis) and replicates the rest; the session's unmodified
+  ``traced_jit`` kernel then GSPMD-partitions the whole fused program
+  from the committed input shardings.  Buckets and gang widths are
+  both powers of two, so the shard split is always even.
+- **small buckets**: the gang runs the EXACT single-replica program,
+  committed whole to its lead device (``devices[0]``) — bitwise
+  parity with a width-1 replica by construction (gated in
+  tests/test_serve_gang.py), which is also the perf-correct choice:
+  sub-ceiling programs are dispatch-floor-bound, not compute-bound.
+
+Per-gang kernel caches key (group key, capacity, gang shape,
+placement mode) — a given group key always resolves to ONE placement
+mode (the bucket is inside the key and the threshold is fixed per
+gang), so every wrapper instance traces exactly once and the
+zero-steady-retrace invariant survives (``traced_jit`` counts any
+second trace on one wrapper as a retrace).
+
+Health is UNIT health: the gang is one executor in the pool, so the
+LIVE→DEGRADED→QUARANTINED→readmit machine, the queue-flush-on-
+quarantine, and drain all apply to the gang as a whole.  The canary
+probe dispatches a guarded reduction sharded over the WHOLE gang mesh
+(site ``serve:canary@gN``), so a fault pinned to any member device —
+or injected via ``PINT_TPU_FAULTS=...@gN`` — keeps failing the unit
+probe until it clears.  Partition policy lives in pool.py
+(``PINT_TPU_SERVE_GANGS`` / ``PINT_TPU_SERVE_GANG_SIZE``); placement
+policy in router.py.  docs/serving.md "gang-scheduled sessions".
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.parallel.mesh import gang_mesh
+from pint_tpu.runtime.guard import dispatch_guard, validate_finite
+from pint_tpu.serve.fabric.replica import QUARANTINED, BatchWork, Replica
+
+
+def gang_threshold(override: int | float | None = None) -> int:
+    """The big-session classification threshold (TOA bucket size at or
+    above which work prefers gang placement and gangs shard it).
+
+    Resolution order: explicit ``override`` (engine/router kwarg) >
+    env ``PINT_TPU_SERVE_GANG_THRESHOLD`` > the bake/argue cutover
+    ``PINT_TPU_BAKE_THRESHOLD`` (default 200000 — the same "too big to
+    treat as small" boundary models/timing_model.py::cm.jit uses for
+    baked-literal vs argument-fed bundles)."""
+    if override is not None:
+        return max(1, int(override))
+    raw = os.environ.get("PINT_TPU_SERVE_GANG_THRESHOLD", "").strip()
+    if raw:
+        return max(1, int(float(raw)))
+    return max(
+        1, int(float(os.environ.get("PINT_TPU_BAKE_THRESHOLD", "2e5")))
+    )
+
+
+class GangReplica(Replica):
+    """Width-N executor: shards big-bucket session dispatches over its
+    own device subset; runs small ones solo on the lead device.
+
+    Inherits the whole dispatch pipeline (queue, coalescer, guarded
+    kernels, fencer) and health machine from :class:`Replica` — the
+    only specializations are operand placement, kernel-cache keying,
+    the mesh-wide canary, and unit-health event annotation."""
+
+    def __init__(self, rid: int, devices, *, shard_threshold=None,
+                 tag: str | None = None, **kw):
+        members = tuple(devices)
+        if len(members) < 2:
+            raise ValueError(
+                f"GangReplica needs >= 2 devices, got {len(members)}"
+            )
+        # gang membership is fixed at construction and read by the
+        # dispatcher/fencer/prober threads; any future membership
+        # mutation (resize, member eviction) must hold the health lock
+        self._members = members  # lint: guarded-by(_state_lock)
+        self.mesh = gang_mesh(members)  # lint: guarded-by(_state_lock)
+        # (row, replicated) NamedShardings, built lazily at the
+        # placement chokepoint; the dispatcher thread owns the build
+        # but the canary/prober reads mesh-derived state too
+        self._shard_places = None  # lint: guarded-by(_cond)
+        self.shard_threshold = gang_threshold(shard_threshold)
+        super().__init__(
+            rid, members, tag=tag if tag is not None else f"g{rid}",
+            **kw,
+        )
+
+    # -- placement ---------------------------------------------------------
+    def _shards_key(self, key) -> bool:
+        """Big buckets shard over the gang mesh; everything else runs
+        the exact single-replica program on the lead device (bitwise
+        parity with a width-1 replica).  Both buckets and gang widths
+        are powers of two, so the divisibility guard only fires for
+        hand-built odd-width pools."""
+        bucket = int(key[2])
+        return (
+            bucket >= self.shard_threshold
+            and bucket % self.width == 0
+        )
+
+    def _wants_shard(self, work: BatchWork) -> bool:
+        return self._shards_key(work.key)
+
+    def _place_ops(self, work: BatchWork):
+        """The gang dispatch chokepoint (pintlint rule obs7): commit
+        the stacked operands with per-leaf shardings over the gang
+        mesh so the guarded ``traced_jit`` kernel GSPMD-partitions the
+        program — or fall through to the base lead-device commit for
+        sub-threshold work."""
+        if not self._wants_shard(work):
+            return super()._place_ops(work)
+        bucket = int(work.key[2])
+        with self._cond:
+            if self._shard_places is None:
+                # stacked ops are (capacity, bucket, ...): axis 1 is
+                # the TOA axis — shard it, replicate everything else
+                self._shard_places = (
+                    NamedSharding(self.mesh, P(None, "toa")),
+                    NamedSharding(self.mesh, P()),
+                )
+            row_place, rep_place = self._shard_places
+
+        def place(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim >= 2 and arr.shape[1] == bucket:
+                return jax.device_put(arr, row_place)
+            return jax.device_put(arr, rep_place)
+
+        with TRACER.span(
+            "gang:place", "fabric", gang=self.tag, op=work.key[0],
+            bucket=bucket, shards=self.width, cap=work.cap,
+        ):
+            return tree_util.tree_map(place, work.ops)
+
+    def _kernel_cache_key(self, work: BatchWork) -> tuple:
+        """Per-gang kernel cache key: (group key, capacity, gang
+        shape, placement mode).  The mode is redundant — a key's
+        bucket fixes it — but keying it explicitly makes the
+        one-placement-per-wrapper invariant structural rather than
+        incidental (a wrapper that saw both placements would count a
+        retrace in traced_jit)."""
+        mode = "shard" if self._wants_shard(work) else "solo"
+        return (work.key, work.cap, (self.width,), mode)
+
+    def _warmed(self, key, cap: int) -> bool:
+        mode = "shard" if self._shards_key(key) else "solo"
+        return (key, cap, (self.width,), mode) in self._kernels
+
+    # -- health (unit semantics) -------------------------------------------
+    def _set_state(self, new: str, kind: str = ""):  # lint: holds(_state_lock)
+        """Chain the replica state machine (the gang quarantines,
+        readmits, and drains as ONE unit — it is one executor), then
+        annotate the transition with the member-device census so the
+        flight recorder can tell a gang outage from a single-chip one
+        (pintlint rule obs7)."""
+        prev = self._state
+        super()._set_state(new, kind=kind)
+        if new == QUARANTINED:
+            obs_metrics.counter("serve.fabric.gang_quarantines").inc()
+        TRACER.event(
+            "gang-state", "fabric", gang=self.tag, width=self.width,
+            frm=prev, to=new, kind=kind,
+        )
+
+    # -- canary (mesh-wide) ------------------------------------------------
+    def _make_canary(self):
+        """Guarded reduction SHARDED over the whole gang mesh: every
+        member device owns a shard, so a wedged/NaN-ing member fails
+        the unit probe — and the ``serve:canary@gN`` site lets
+        ``PINT_TPU_FAULTS=...@gN`` pin faults per gang, exactly like
+        ``@rN`` pins them per single replica."""
+        site = f"serve:canary@{self.tag}"
+        fn = dispatch_guard(
+            jax.jit(lambda x: jnp.sum(x * 2.0 + 1.0)), site
+        )
+        sharding = NamedSharding(self.mesh, P("toa"))
+        width = self.width
+
+        def run():
+            x = jax.device_put(np.arange(8.0 * width), sharding)
+            out = fn(x)
+            validate_finite(
+                {"canary": out}, site=site, what="gang canary probe"
+            )
+
+        return run

@@ -17,7 +17,16 @@ Env knobs (constructor kwargs override):
 - ``PINT_TPU_SERVE_QUARANTINE_N`` — consecutive guard-class failures
   before a replica quarantines (default 3);
 - ``PINT_TPU_SERVE_PROBE_MS`` — canary probe cadence for quarantined
-  replicas (default 500 ms).
+  replicas (default 500 ms);
+- ``PINT_TPU_SERVE_GANGS`` / ``PINT_TPU_SERVE_GANG_SIZE`` — the mixed
+  -pool partition (ISSUE 10): the first ``gangs x gang_size`` devices
+  form gang executors (fabric/gang.py — tags ``g0..``, each sharding
+  big-bucket sessions over its own device subset), the remainder stay
+  single-device replicas (tags ``r0..``).  Default 0 gangs = the r8
+  all-singles pool; gang_size 0 = devices split evenly across the
+  requested gangs.  A gang needs >= 2 devices — on a too-small host
+  the partition degrades to singles rather than fabricating width-1
+  "gangs".
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ import threading
 
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.parallel.mesh import serving_devices
+from pint_tpu.serve.fabric.gang import GangReplica
 from pint_tpu.serve.fabric.replica import (
     DEGRADED,
     LIVE,
@@ -41,6 +51,8 @@ class ReplicaPool:
     def __init__(self, *, replicas: int | None = None, inflight: int,
                  quarantine_n: int | None = None,
                  probe_interval_s: float | None = None,
+                 gangs: int | None = None, gang_size: int | None = None,
+                 gang_threshold: int | None = None,
                  requeue=None, finisher=None, validator=None):
         env = os.environ.get
         if replicas is None:
@@ -51,16 +63,39 @@ class ReplicaPool:
             probe_interval_s = (
                 float(env("PINT_TPU_SERVE_PROBE_MS", "500")) / 1e3
             )
+        if gangs is None:
+            gangs = int(env("PINT_TPU_SERVE_GANGS", "0"))
+        if gang_size is None:
+            gang_size = int(env("PINT_TPU_SERVE_GANG_SIZE", "0"))
         self.probe_interval_s = max(0.01, float(probe_interval_s))
         devices = serving_devices(replicas or None)
-        self.replicas = [
-            Replica(
-                i, d, inflight=inflight, quarantine_n=quarantine_n,
-                requeue=requeue, finisher=finisher,
-                validator=validator,
-            )
-            for i, d in enumerate(devices)
-        ]
+        kw = dict(
+            inflight=inflight, quarantine_n=quarantine_n,
+            requeue=requeue, finisher=finisher, validator=validator,
+        )
+        # mixed-pool partition (ISSUE 10): the FIRST gangs*gang_size
+        # devices form gang executors, the remainder stay singles
+        self.replicas = []
+        ngang = max(0, int(gangs))
+        if ngang:
+            if gang_size <= 0:
+                gang_size = max(2, len(devices) // ngang)
+            take = 0
+            for g in range(ngang):
+                members = devices[take:take + gang_size]
+                if len(members) < 2:
+                    break  # too few devices left for a real gang
+                self.replicas.append(GangReplica(
+                    len(self.replicas), members, tag=f"g{g}",
+                    shard_threshold=gang_threshold, **kw,
+                ))
+                take += len(members)
+            devices = devices[take:]
+        base = len(self.replicas)
+        self.replicas.extend(
+            Replica(base + j, d, tag=f"r{j}", **kw)
+            for j, d in enumerate(devices)
+        )
         self._cond = threading.Condition()
         self._stop = False  # lint: guarded-by(_cond)
         self._prober = threading.Thread(
@@ -72,6 +107,16 @@ class ReplicaPool:
     @property
     def size(self) -> int:
         return len(self.replicas)
+
+    @property
+    def gangs(self) -> list:
+        """The width>1 executors (mixed-pool gang class)."""
+        return [r for r in self.replicas if r.width > 1]
+
+    @property
+    def singles(self) -> list:
+        """The width-1 executors (mixed-pool single class)."""
+        return [r for r in self.replicas if r.width == 1]
 
     @property
     def live(self) -> list:
@@ -130,6 +175,7 @@ class ReplicaPool:
                 "batches": r.batches_done,
                 "failures": r.failures,
                 "kernels": r.kernel_count,
+                "width": r.width,
                 "device": str(r.device),
             }
             for r in self.replicas

@@ -220,10 +220,20 @@ class Replica:
     stays in one place)."""
 
     def __init__(self, rid: int, device, *, inflight: int,
-                 quarantine_n: int, requeue, finisher, validator):
+                 quarantine_n: int, requeue, finisher, validator,
+                 tag: str | None = None):
         self.rid = rid
-        self.tag = f"r{rid}"
-        self.device = device
+        # an executor owns a device SET; the plain replica is the
+        # width-1 case and a gang (fabric/gang.py) the width-N one.
+        # `device` stays the lead device — the solo dispatch target.
+        devices = (
+            tuple(device) if isinstance(device, (tuple, list))
+            else (device,)
+        )
+        self.devices = devices
+        self.width = len(devices)
+        self.tag = tag if tag is not None else f"r{rid}"
+        self.device = devices[0]
         self.inflight = max(1, int(inflight))
         self.quarantine_n = max(1, int(quarantine_n))
         self._requeue = requeue
@@ -313,8 +323,19 @@ class Replica:
         return True
 
     # -- the dispatch pipeline --------------------------------------------
+    def _kernel_cache_key(self, work: BatchWork) -> tuple:
+        """Cache identity of one kernel on THIS executor; gangs extend
+        it with (gang shape, placement mode) — fabric/gang.py."""
+        return work.kernel_key()
+
+    def _warmed(self, key, cap: int) -> bool:
+        """Whether a (group key, capacity) kernel is already traced on
+        this executor (the coalescer's retrace-free gate).
+        Dispatcher-thread only."""
+        return (key, cap) in self._kernels
+
     def _kernel_for(self, work: BatchWork):
-        kkey = work.kernel_key()
+        kkey = self._kernel_cache_key(work)
         k = self._kernels.get(kkey)
         if k is None:
             inner = work.make_kernel(self.tag)
@@ -378,7 +399,7 @@ class Replica:
                         cap, _pow2_capacity(total + len(w.live))
                     )
                     if (w.key == work.key
-                            and (work.key, grown) in self._kernels):
+                            and self._warmed(work.key, grown)):
                         picked.append(w)
                         total += len(w.live)
                         cap = grown
@@ -424,13 +445,21 @@ class Replica:
                 "replica:dispatch", "fabric", replica=self.tag,
                 op=work.key[0], n=len(work.live), cap=work.cap,
             ):
-                ops = jax.device_put(work.ops, self.device)
+                ops = self._place_ops(work)
                 out = kernel(*ops)  # async guarded device dispatch
         except BaseException as e:
             self._sem.release()
             self._batch_error(work, e)
             return
         self._fence_q.put((work, out))
+
+    def _place_ops(self, work: BatchWork):
+        """Commit the stacked host operands to this executor's
+        device(s).  The width-1 replica commits everything to its one
+        device; GangReplica overrides this with sharded placement over
+        its mesh (the jit wrapper then GSPMD-partitions the program
+        from the committed operand shardings)."""
+        return jax.device_put(work.ops, self.device)
 
     def _fence_loop(self):
         while True:

@@ -28,6 +28,20 @@ by a load-aware router):
 - quarantined/draining replicas are never candidates, and a batch's
   ``excluded`` set (replicas that already failed it) is honored, so
   re-routes are bounded by the pool width.
+
+Mixed-pool classification (ISSUE 10): groups whose TOA bucket is at
+or above the gang threshold (``PINT_TPU_SERVE_GANG_THRESHOLD``,
+default the bake/argue cutover — serve/fabric/gang.py::gang_threshold)
+prefer the pool's GANG executors (sticky by group key, spill between
+gangs under saturation), smaller groups prefer singles; when the
+preferred class has no usable member (no gangs configured, or every
+single quarantined) the group falls back to the other class so work
+is served rather than shed.  Load comparisons are CAPACITY-WEIGHTED:
+an executor's outstanding work counts per device
+(``outstanding / width``) and it saturates at ``inflight x width`` —
+a gang of 4 with 3 queued batches is LESS loaded than a single with
+1, not more; comparing raw outstanding across widths would starve one
+class of the mixed pool.
 """
 
 from __future__ import annotations
@@ -36,17 +50,39 @@ import threading
 
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.serve.fabric.gang import gang_threshold
 from pint_tpu.serve.fabric.replica import DEGRADED, LIVE
+
+
+def _width(r) -> int:
+    """Executor capacity weight (1 for singles, device count for
+    gangs; tolerant of width-less test doubles)."""
+    return max(1, int(getattr(r, "width", 1)))
+
+
+def _load(r) -> float:
+    """Capacity-weighted load: outstanding batches per device — the
+    comparable quantity across executors of different widths (the
+    raw-outstanding tie-break starved mixed pools, ISSUE 10)."""
+    return r.outstanding / _width(r)
+
+
+def _saturated(r) -> bool:
+    """Work is queuing, not flowing: outstanding past the executor's
+    per-device inflight bound times its width."""
+    return r.outstanding > r.inflight * _width(r)
 
 
 class Router:
     """Places session groups on replicas and routes assembled batches."""
 
-    def __init__(self, pool, affinity: int | None = None):
+    def __init__(self, pool, affinity: int | None = None,
+                 gang_threshold_toas: int | None = None):
         self.pool = pool
         self.affinity = max(
             1, int(affinity) if affinity else pool.size
         )
+        self.gang_threshold = gang_threshold(gang_threshold_toas)
         self._placements: dict = {}  # group key -> [rid, ...]; lint: guarded-by(_lock)
         self._rotor: dict = {}  # round-robin counters; lint: guarded-by(_lock)
         self._lock = threading.Lock()
@@ -73,13 +109,32 @@ class Router:
                 TRACER.annotate(replica=rep.tag)
             return rep
 
-    def _route_locked(self, key, exclude):
-        placed = self._placements.setdefault(key, [])
-        usable = {
-            r.rid: r for r in self.pool.replicas
+    def _is_big(self, key) -> bool:
+        """Gang-class work: the group's TOA bucket (key[2] for both
+        fit and residuals group keys) at/above the gang threshold."""
+        try:
+            return int(key[2]) >= self.gang_threshold
+        except (IndexError, TypeError, ValueError):
+            return False
+
+    def _usable_locked(self, key, exclude) -> dict:
+        """rid -> executor for every candidate that may serve ``key``:
+        the preferred size class (gangs for big groups, singles for
+        small) when it has a usable member, the whole pool otherwise
+        (a gang-only pool still serves small work on gang lead
+        devices; a gangless pool still serves big work solo)."""
+        usable = [
+            r for r in self.pool.replicas
             if r.state in (LIVE, DEGRADED) and not r.draining
             and r.rid not in exclude
-        }
+        ]
+        big = self._is_big(key)
+        pref = [r for r in usable if (_width(r) > 1) == big]
+        return {r.rid: r for r in (pref or usable)}
+
+    def _route_locked(self, key, exclude):
+        placed = self._placements.setdefault(key, [])
+        usable = self._usable_locked(key, exclude)
         cands = [usable[rid] for rid in placed if rid in usable]
         # prefer LIVE peers; a DEGRADED replica serves only when no
         # LIVE one holds the group
@@ -87,15 +142,15 @@ class Router:
         if live_cands:
             cands = live_cands
         if (cands and len(placed) < self.affinity
-                and all(r.outstanding > r.inflight for r in cands)):
+                and all(_saturated(r) for r in cands)):
             # saturated affinity set: spill the group to one more
-            # replica (it pays one compile per kernel shape, then
-            # serves this group forever)
+            # executor of its class (it pays one compile per kernel
+            # shape, then serves this group forever)
             fresh = [
                 r for r in usable.values() if r.rid not in placed
             ]
             if fresh:
-                r = min(fresh, key=lambda r: (r.outstanding, r.rid))
+                r = min(fresh, key=lambda r: (_load(r), r.rid))
                 placed.append(r.rid)
                 cands.append(r)
                 self._m_spills.inc()
@@ -109,12 +164,12 @@ class Router:
             fresh = list(usable.values())
             if not fresh:
                 return None
-            r = min(fresh, key=lambda r: (r.outstanding, r.rid))
+            r = min(fresh, key=lambda r: (_load(r), r.rid))
             if r.rid not in placed:
                 placed.append(r.rid)
             return r
-        lo = min(r.outstanding for r in cands)
-        tied = [r for r in cands if r.outstanding == lo]
+        lo = min(_load(r) for r in cands)
+        tied = [r for r in cands if _load(r) == lo]
         i = self._rotor.get(key, 0)
         self._rotor[key] = i + 1
         return tied[i % len(tied)]
@@ -126,4 +181,5 @@ class Router:
                 "placement_widths": sorted(
                     len(v) for v in self._placements.values()
                 ),
+                "gang_threshold": self.gang_threshold,
             }

@@ -437,7 +437,7 @@ def main():
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
                              "serve_replicas", "serve_population",
-                             "dispatch_floor"])
+                             "serve_gang", "dispatch_floor"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -445,7 +445,8 @@ def main():
                 "7": config_7, "7b": config_7b}
     hbm_last_peak = 0
     for c in args.configs:
-        if str(c) in ("serve", "serve_replicas", "serve_population"):
+        if str(c) in ("serve", "serve_replicas", "serve_population",
+                      "serve_gang"):
             # serving-engine ladders (profiling/serve_offered_load.py):
             # 'serve' = the offered-load ladder (ISSUE 4; the top rung
             # overruns the admission queue to exercise shedding);
@@ -455,19 +456,24 @@ def main():
             # 'serve_population' = the distinct-par ladder (ISSUE 6;
             # 1/10/100/1000 pars of one composition at fixed offered
             # load -> requests/s + per-rung compile count, which must
-            # stay flat)
+            # stay flat);
+            # 'serve_gang' = the mixed-pool partition ladder (ISSUE
+            # 10; all-singles / 4+4 / 2x gang-of-4 / 1 gang-of-8 at
+            # fixed mixed small+huge load -> rps, big-class placement,
+            # zero steady retraces)
             import os
             import sys
 
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
             from serve_offered_load import (
-                population_sweep, replica_sweep, sweep,
+                gang_sweep, population_sweep, replica_sweep, sweep,
             )
 
             rows = {
                 "serve": sweep,
                 "serve_replicas": replica_sweep,
                 "serve_population": population_sweep,
+                "serve_gang": gang_sweep,
             }[str(c)]()
             for row in rows:
                 print(json.dumps(row))

@@ -28,10 +28,19 @@ batch capacity), never one per par), the steady-state retrace count
 (must be zero), and the distinct-par stack occupancy — the
 continuous-batching-across-users trajectory ROADMAP item 2 tracks.
 
+The GANG ladder (ISSUE 10, :func:`gang_sweep`) holds a MIXED offered
+load fixed (interleaved 256-bucket and above-threshold 1024-bucket
+fits) and sweeps the 8-device pool partition (all singles / 4+4 /
+2 gangs-of-4 / 1 gang-of-8), reporting per rung the achieved rps,
+which executor tags served the big class (gangs whenever the rung has
+any), spill counts between gangs, and the steady-state retrace count
+(must stay zero) — the gang-scheduling trajectory next to the replica
+-scaling one.
+
 Usage: ``python profiling/serve_offered_load.py`` (one JSON line per
 rung, all ladders), or via ``python profiling/run_benchmarks.py
 --configs serve`` / ``--configs serve_replicas`` / ``--configs
-serve_population``.
+serve_population`` / ``--configs serve_gang``.
 """
 
 from __future__ import annotations
@@ -321,6 +330,110 @@ def population_sweep(npars=(1, 10, 100, 1000), offered: int = 1024,
             engine.close()
 
 
+def gang_sweep(partitions=((0, 0), (1, 4), (2, 4), (1, 8)),
+               offered: int = 48, big_every: int = 4,
+               gang_threshold: int = 512, maxiter: int = 2):
+    """The MIXED-POOL partition ladder (ISSUE 10): hold the offered
+    load fixed — an interleaved stream of small (256-bucket) and huge
+    (1024-bucket, above the gang threshold) fit requests — and sweep
+    the 8-device partition: all singles / 4 singles + 1 gang-of-4 /
+    2 gangs-of-4 / 1 gang-of-8.  Per rung: achieved rps split by size
+    class, which executor tags served the big work (the router must
+    keep it on gangs whenever the rung has any), and the steady-state
+    retrace count (must be zero — the per-gang mode-keyed kernel
+    caches).  The all-singles rung is the baseline: on accelerators
+    the gang rungs should win on the big class (sharded compute) and
+    roughly hold the small class (solo path on the gang lead)."""
+    import jax
+
+    from pint_tpu.exceptions import RequestRejected
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    small = build_fleet(4)
+    m, toas = make_test_pulsar(
+        "PSR BIG\nF0 171.5 1\nF1 -1.5e-15 1\nPEPOCH 55000\n"
+        "DM 7.7 1\n",
+        ntoa=600,  # 1024 bucket: above the rung gang threshold
+        start_mjd=54000.0, end_mjd=56000.0, seed=41, iterations=1,
+    )
+    big = (m.as_parfile(), toas)
+    base_rps = None
+    for gangs, gang_size in partitions:
+        engine = TimingEngine(
+            max_batch=4, inflight=1, max_wait_ms=5.0,
+            max_queue=max(2 * offered, 64), replicas=8,
+            affinity=2, gangs=gangs, gang_size=gang_size,
+            gang_threshold=gang_threshold,
+        )
+        try:
+            def reqs():
+                out = []
+                for i in range(offered):
+                    par, t = (
+                        big if i % big_every == 0
+                        else small[i % len(small)]
+                    )
+                    out.append(FitRequest(
+                        par=par, toas=t, maxiter=maxiter,
+                    ))
+                return out
+
+            for _ in range(2):  # warm: spill + per-executor compiles
+                for f in engine.submit_many(reqs()):
+                    f.result(timeout=3600)
+            engine.reset_stats()
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            t0 = time.perf_counter()
+            completed = rejected = failed = 0
+            big_tags, small_tags = set(), set()
+            for i, f in enumerate(engine.submit_many(reqs())):
+                try:
+                    resp = f.result(timeout=3600)
+                    completed += 1
+                    (big_tags if i % big_every == 0
+                     else small_tags).add(resp.replica)
+                except RequestRejected:
+                    rejected += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            rps = completed / wall
+            if base_rps is None:
+                base_rps = rps
+            fab = engine.stats()["fabric"]
+            yield {
+                "config": f"serve gangs={gangs}x{gang_size or 8} "
+                          f"offered={offered} mixed fits "
+                          f"(1024-bucket every {big_every})",
+                "backend": jax.default_backend(),
+                "gangs": gangs,
+                "gang_size": gang_size,
+                "gang_threshold": gang_threshold,
+                "offered": offered,
+                "completed": completed,
+                "shed": rejected,
+                "failed": failed,
+                "achieved_rps": round(rps, 2),
+                "vs_all_singles_x": round(rps / base_rps, 3),
+                "big_served_by": sorted(big_tags),
+                "small_served_by": sorted(small_tags),
+                "executor_occupancy": {
+                    tag: rs["batches"]
+                    for tag, rs in fab["per_replica"].items()
+                    if rs["batches"]
+                },
+                "spills": fab["spills"],
+                "steady_recompiles": (
+                    obs_metrics.counter("compile.recompiles").value
+                    - rec0
+                ),
+            }
+        finally:
+            engine.close()
+
+
 def main():
     import jax
 
@@ -330,6 +443,8 @@ def main():
     for row in replica_sweep():
         print(json.dumps(row))
     for row in population_sweep():
+        print(json.dumps(row))
+    for row in gang_sweep():
         print(json.dumps(row))
 
 

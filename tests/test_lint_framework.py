@@ -507,6 +507,44 @@ def test_obs6_flags_stripped_trajectory_and_coalesce_guards(tmp_path):
     assert obs6.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs7: the ISSUE 10 gang chokepoints ----------------------------------
+def test_obs7_flags_stripped_gang_guards(tmp_path):
+    """obs7 catches a gang losing its placement span/shardings, unit
+    -health chaining, mesh-wide guarded canary, or declared membership
+    lock discipline; skips packages without the gang module (the
+    obs4/obs6 fixtures carry a stripped replica.py but no gang.py);
+    passes the real tree."""
+    obs7 = rules_by_name()["obs7"]
+    # no gang.py (even with serve/fabric/ present) -> subsystem absent
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "serve" / "fabric").mkdir(parents=True)
+    (bare / "serve" / "fabric" / "replica.py").write_text(
+        "class Replica:\n    pass\n"
+    )
+    assert obs7.check_project(bare) == []
+    # stripped gang guards are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "serve" / "fabric").mkdir(parents=True)
+    (pkg / "serve" / "fabric" / "gang.py").write_text(
+        "class GangReplica:\n"
+        "    def _place_ops(self, work):\n"
+        "        return work.ops\n"
+        "    def _set_state(self, new, kind=''):\n"
+        "        self._state = new\n"
+        "    def _make_canary(self):\n"
+        "        return lambda: None\n"
+    )
+    msgs = "\n".join(f.message for f in obs7.check_project(pkg))
+    assert "TRACER.span" in msgs          # placement span stripped
+    assert "NamedSharding" in msgs        # mesh shardings stripped
+    assert "dispatch_guard(" in msgs      # canary unguarded
+    assert "super()._set_state" in msgs   # unit health unchained
+    assert "TRACER.event" in msgs         # gang-state event stripped
+    assert "guarded-by(" in msgs          # lock discipline dropped
+    # the real tree carries all the guards
+    assert obs7.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

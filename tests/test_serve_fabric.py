@@ -127,9 +127,11 @@ def test_pool_env_knobs(monkeypatch):
 
 # -- router policy units --------------------------------------------------
 class FakeReplica:
-    def __init__(self, rid, state=LIVE, outstanding=0, inflight=1):
+    def __init__(self, rid, state=LIVE, outstanding=0, inflight=1,
+                 width=1):
         self.rid = rid
-        self.tag = f"r{rid}"
+        self.width = width
+        self.tag = f"g{rid}" if width > 1 else f"r{rid}"
         self.state = state
         self.outstanding = outstanding
         self.inflight = inflight
@@ -200,6 +202,65 @@ def test_router_skips_quarantined_and_excluded():
     reps[1].state = QUARANTINED
     reps[2].draining = True
     assert router.route(w) is None
+
+
+def test_router_weighted_tie_break_by_executor_width():
+    """ISSUE 10: load comparisons count outstanding PER DEVICE — a
+    gang of 4 with 3 queued batches is less loaded than a gang of 2
+    with 2, even though its raw outstanding is higher.  Raw
+    comparisons across widths starve one class of a mixed pool."""
+    g4 = FakeReplica(0, outstanding=3, inflight=1, width=4)  # load .75
+    g2 = FakeReplica(1, outstanding=2, inflight=1, width=2)  # load 1.0
+    router = Router(FakePool([g4, g2]), gang_threshold_toas=64)
+    w = _work()  # bucket 64 >= threshold -> gang-class work
+    # raw outstanding would prefer g2 (2 < 3); per-device weighting
+    # must prefer g4 (0.75 < 1.0)
+    assert router.route(w).rid == 0
+
+
+def test_router_saturation_is_capacity_weighted():
+    """A gang saturates at inflight x width outstanding batches, not
+    at the single-device inflight bound."""
+    ga = FakeReplica(0, inflight=1, width=4)
+    gb = FakeReplica(1, inflight=1, width=4)
+    router = Router(
+        FakePool([ga, gb]), affinity=2, gang_threshold_toas=64
+    )
+    w = _work()
+    assert router.route(w).rid == 0
+    # past the per-device inflight bound but within inflight x width:
+    # work is still flowing, no spill
+    ga.outstanding = 3
+    s0 = obs_metrics.counter("serve.fabric.spills").value
+    assert router.route(w).rid == 0
+    assert router.placement(w.key) == (0,)
+    # past inflight x width: saturated -> the group spills BETWEEN
+    # gangs
+    ga.outstanding = 5
+    assert router.route(w).rid == 1
+    assert router.placement(w.key) == (0, 1)
+    assert obs_metrics.counter("serve.fabric.spills").value == s0 + 1
+
+
+def test_router_classifies_by_gang_threshold():
+    """Big groups (bucket >= threshold) prefer gang executors, small
+    ones singles; a down preferred class falls back to the other so
+    work is served rather than shed."""
+    gang = FakeReplica(0, width=4)
+    single = FakeReplica(1)
+    router = Router(
+        FakePool([gang, single]), gang_threshold_toas=256
+    )
+    small = types.SimpleNamespace(key=("fit", "comp", 64), live=[1])
+    big = types.SimpleNamespace(key=("fit", "comp", 1024), live=[1])
+    assert router.route(small).rid == 1
+    assert router.route(big).rid == 0
+    # preferred class down: fall back to the other class
+    single.state = QUARANTINED
+    assert router.route(small).rid == 0
+    single.state = LIVE
+    gang.state = QUARANTINED
+    assert router.route(big).rid == 1
 
 
 # -- health state machine -------------------------------------------------

@@ -1,0 +1,409 @@
+"""Gang-scheduled multi-device sessions (pint_tpu/serve/fabric/gang)
+on the virtual 8-device CPU mesh (conftest).  Covers the ISSUE 10
+acceptance surface:
+
+- mixed-pool partition (PINT_TPU_SERVE_GANGS/_GANG_SIZE) + the
+  gang-threshold resolution ladder;
+- gang-vs-single-replica BITWISE parity on sub-threshold work
+  (padded TOA buckets included): the gang's solo path runs the exact
+  single-replica program on its lead device;
+- sharded-path numerics: a big-bucket request served through the
+  normal TimingEngine.submit lands on a gang (typed response tagged
+  ``gN``), matches the single-replica answer to f64 roundoff, and
+  steady-state repeats cost ZERO traces and ZERO retraces;
+- router classification: big buckets prefer gangs (sticky, spill
+  BETWEEN gangs under saturation), small buckets prefer singles;
+- unit health: a fault pinned to ``@g0`` quarantines the WHOLE gang,
+  traffic re-routes, the mesh-wide canary re-admits it as a unit
+  once faults clear — observable in flight_report();
+- drain under total outage (gang included): every future resolves
+  typed in bounded time.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import (
+    GuardTimeout,
+    PintTpuNumericsError,
+    RequestRejected,
+    RetriesExhausted,
+)
+from pint_tpu.obs import export as obs_export
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs import trace as obs_trace
+from pint_tpu.runtime import faults, guard
+from pint_tpu.serve import FitRequest, ResidualsRequest, TimingEngine
+from pint_tpu.serve.fabric import LIVE, QUARANTINED, gang_threshold
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """
+PSR              J0000+01{i:02d}
+F0               {f0}  1
+F1               -1.3e-15           1
+PEPOCH           55000
+DM               {dm}             1
+"""
+
+
+def _pulsar(i, f0, dm, n, seed):
+    m, t = make_test_pulsar(
+        PAR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+        iterations=1,
+    )
+    return m.as_parfile(), t
+
+
+@pytest.fixture(scope="module")
+def pulsars():
+    """Three same-composition pulsars, mixed TOA counts in the 64
+    bucket (so every batch exercises the padded-TOA path)."""
+    return [
+        _pulsar(0, 133.1, 11.0, 30, 11),
+        _pulsar(1, 207.9, 24.0, 40, 12),
+        _pulsar(2, 91.3, 6.5, 50, 13),
+    ]
+
+
+@pytest.fixture(scope="module")
+def big_pulsar():
+    """One pulsar in the 1024 bucket: above the test gang threshold
+    (512), so it classifies BIG and the gang shards its dispatches."""
+    return _pulsar(7, 151.7, 9.0, 600, 17)
+
+
+def _join_guard_threads():
+    for th in threading.enumerate():
+        if th.name.startswith("pint-tpu-guard"):
+            th.join(timeout=10)
+
+
+def _wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- partition + threshold ------------------------------------------------
+def test_pool_partition_and_stats():
+    eng = TimingEngine(
+        max_batch=1, max_wait_ms=0.0, replicas=8, gangs=2,
+        gang_size=2, gang_threshold=512,
+    )
+    try:
+        tags = [r.tag for r in eng.pool.replicas]
+        assert tags == ["g0", "g1", "r0", "r1", "r2", "r3"]
+        assert [r.width for r in eng.pool.replicas] == [2, 2, 1, 1, 1, 1]
+        assert [r.rid for r in eng.pool.replicas] == list(range(6))
+        assert len(eng.pool.gangs) == 2 and len(eng.pool.singles) == 4
+        # gang members are disjoint contiguous device subsets
+        g0, g1 = eng.pool.gangs
+        assert not set(g0.devices) & set(g1.devices)
+        st = eng.stats()["fabric"]
+        assert st["gangs"] == 2 and st["gang_threshold"] == 512
+        assert st["per_replica"]["g0"]["width"] == 2
+        assert st["per_replica"]["r0"]["width"] == 1
+    finally:
+        eng.close(timeout=60)
+
+
+def test_gang_threshold_resolution(monkeypatch):
+    monkeypatch.delenv("PINT_TPU_SERVE_GANG_THRESHOLD", raising=False)
+    monkeypatch.delenv("PINT_TPU_BAKE_THRESHOLD", raising=False)
+    assert gang_threshold() == 200000  # bake/argue cutover default
+    monkeypatch.setenv("PINT_TPU_BAKE_THRESHOLD", "3e4")
+    assert gang_threshold() == 30000
+    monkeypatch.setenv("PINT_TPU_SERVE_GANG_THRESHOLD", "1024")
+    assert gang_threshold() == 1024
+    assert gang_threshold(256) == 256  # explicit kwarg wins
+
+
+def test_small_host_degrades_to_singles():
+    # a gang needs >= 2 devices: asking for more gangs than the mesh
+    # can seat must not fabricate width-1 "gangs"
+    eng = TimingEngine(
+        max_batch=1, max_wait_ms=0.0, replicas=3, gangs=2,
+        gang_size=2,
+    )
+    try:
+        assert [r.tag for r in eng.pool.replicas] == ["g0", "r0"]
+        assert [r.width for r in eng.pool.replicas] == [2, 1]
+    finally:
+        eng.close(timeout=60)
+
+
+# -- solo-path bitwise parity ---------------------------------------------
+def _stream(eng, pulsars):
+    """One deterministic request stream: wave-synchronized so both
+    fabrics assemble identical batches (incl. padded buckets) and only
+    PLACEMENT differs."""
+    waves = [
+        [("residuals", 0), ("residuals", 1), ("residuals", 2)],
+        [("fit", 0), ("fit", 1), ("fit", 2)],
+        [("residuals", 1)],
+        [("fit", 2)],
+        [("residuals", 2), ("residuals", 0)],
+    ]
+    out = []
+    for wave in waves:
+        futs = []
+        for op, i in wave:
+            par, toas = pulsars[i]
+            req = (
+                ResidualsRequest(par=par, toas=toas)
+                if op == "residuals"
+                else FitRequest(par=par, toas=toas, maxiter=2)
+            )
+            futs.append(eng.submit(req))
+        out.extend(f.result(timeout=300) for f in futs)
+    return out
+
+
+def test_gang_solo_path_bitwise_parity(pulsars):
+    """Identical request stream through a 1-replica fabric and an
+    all-gang fabric whose threshold is above every bucket: the gang's
+    solo path commits the EXACT single-replica program to its lead
+    device, so responses are bitwise-identical per request (padded
+    buckets included) — the ISSUE 10 numerics-neutrality gate."""
+    kw = dict(max_batch=4, max_wait_ms=100.0, inflight=1,
+              max_queue=128)
+    with TimingEngine(replicas=1, **kw) as e1:
+        out1 = _stream(e1, pulsars)
+    with TimingEngine(replicas=4, gangs=1, gang_size=4, affinity=1,
+                      gang_threshold=1 << 20, **kw) as eg:
+        outg = _stream(eg, pulsars)
+    assert {r.replica for r in out1} == {"r0"}
+    assert {r.replica for r in outg} == {"g0"}
+    for a, b in zip(out1, outg):
+        assert type(a) is type(b)
+        assert a.ntoa == b.ntoa and a.bucket == b.bucket
+        assert a.batch_size == b.batch_size
+        if hasattr(a, "residuals_s"):
+            np.testing.assert_array_equal(a.residuals_s, b.residuals_s)
+        else:
+            np.testing.assert_array_equal(a.deltas, b.deltas)
+            np.testing.assert_array_equal(
+                a.uncertainties, b.uncertainties
+            )
+            assert a.fitted_par == b.fitted_par
+        assert a.chi2 == b.chi2
+
+
+# -- sharded path ---------------------------------------------------------
+def test_sharded_big_request_parity_and_zero_steady_retrace(big_pulsar):
+    """A request whose bucket crosses the gang threshold is served
+    through normal submit() by the gang (typed response, replica tag
+    gN), matches the single-replica answer to f64 roundoff, and
+    steady-state repeats are deterministic with ZERO further traces or
+    retraces (the per-gang (key, cap, shape, mode) kernel cache)."""
+    par, toas = big_pulsar
+    kw = dict(max_batch=1, max_wait_ms=0.0, inflight=1, max_queue=64)
+    with TimingEngine(replicas=1, **kw) as e1:
+        r1 = e1.submit(
+            ResidualsRequest(par=par, toas=toas)
+        ).result(timeout=300)
+        f1 = e1.submit(
+            FitRequest(par=par, toas=toas, maxiter=2)
+        ).result(timeout=300)
+    with TimingEngine(replicas=4, gangs=1, gang_size=4, affinity=1,
+                      gang_threshold=512, **kw) as eg:
+        rg = eg.submit(
+            ResidualsRequest(par=par, toas=toas)
+        ).result(timeout=300)
+        fg = eg.submit(
+            FitRequest(par=par, toas=toas, maxiter=2)
+        ).result(timeout=300)
+        # served by the gang, above the threshold => sharded dispatch
+        assert rg.replica == "g0" and fg.replica == "g0"
+        assert rg.bucket == 1024 and rg.bucket % 4 == 0
+        gang = eng_gang = eg.pool.replicas[0]
+        assert eng_gang.width == 4
+        assert gang._shards_key(("residuals", "x", rg.bucket, True))
+        # f64-roundoff parity vs the single-chip program (GSPMD psums
+        # reassociate the TOA-axis reductions — bitwise is solo-only)
+        np.testing.assert_allclose(
+            rg.residuals_s, r1.residuals_s, rtol=1e-7, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            fg.deltas, f1.deltas, rtol=1e-6, atol=0
+        )
+        np.testing.assert_allclose(
+            fg.uncertainties, f1.uncertainties, rtol=1e-6, atol=0
+        )
+        np.testing.assert_allclose(fg.chi2, f1.chi2, rtol=1e-7)
+        # steady state: warm repeats trace nothing, retrace nothing,
+        # and are bitwise-deterministic run to run
+        traces0 = obs_metrics.counter("compile.traces").value
+        retr0 = obs_metrics.counter("compile.recompiles").value
+        for _ in range(3):
+            r = eg.submit(
+                ResidualsRequest(par=par, toas=toas)
+            ).result(timeout=300)
+            np.testing.assert_array_equal(r.residuals_s, rg.residuals_s)
+            f = eg.submit(
+                FitRequest(par=par, toas=toas, maxiter=2)
+            ).result(timeout=300)
+            np.testing.assert_array_equal(f.deltas, fg.deltas)
+            assert f.chi2 == fg.chi2
+        assert obs_metrics.counter("compile.traces").value == traces0
+        assert obs_metrics.counter("compile.recompiles").value == retr0
+    _join_guard_threads()
+
+
+# -- mixed-pool placement + spill -----------------------------------------
+def test_big_prefers_gangs_small_prefers_singles_and_gang_spill(
+    pulsars, big_pulsar
+):
+    """Router classification on a mixed pool: small buckets land on
+    single replicas, big ones on gangs; a saturated sticky gang spills
+    the big group to the OTHER gang (spill between gangs)."""
+    bpar, btoas = big_pulsar
+    eng = TimingEngine(
+        max_batch=1, max_wait_ms=0.0, inflight=1, replicas=8,
+        gangs=2, gang_size=2, gang_threshold=512, affinity=2,
+        max_queue=128,
+    )
+    try:
+        spar, stoas = pulsars[0]
+        small = eng.submit(
+            ResidualsRequest(par=spar, toas=stoas)
+        ).result(timeout=300)
+        assert small.replica.startswith("r")
+        # a burst of big requests with inflight=1 saturates the sticky
+        # gang and spills the group to the second gang
+        futs = [
+            eng.submit(ResidualsRequest(par=bpar, toas=btoas))
+            for _ in range(10)
+        ]
+        tags = {f.result(timeout=300).replica for f in futs}
+        assert tags and all(t.startswith("g") for t in tags)
+        assert tags == {"g0", "g1"}
+        assert eng.stats()["fabric"]["spills"] >= 1
+    finally:
+        eng.close(timeout=60)
+        _join_guard_threads()
+
+
+# -- unit health ----------------------------------------------------------
+def test_gang_quarantines_and_readmits_as_a_unit(pulsars, big_pulsar):
+    """A hang pinned to @g0 quarantines the WHOLE gang: queued big
+    requests complete on the surviving singles, the mesh-wide canary
+    keeps failing while the fault is armed, and the gang re-admits as
+    one unit after it clears — the cycle observable in
+    flight_report() via the gang-state events."""
+    bpar, btoas = big_pulsar
+    eng = TimingEngine(
+        max_batch=1, max_wait_ms=0.0, inflight=1, replicas=4,
+        gangs=1, gang_size=2, gang_threshold=512, quarantine_n=2,
+        probe_ms=50, max_queue=64,
+    )
+    try:
+        with obs_trace.tracing(clear=True):
+            # warm: the big group lands on g0 and its (cap 1) kernel
+            # compiles there, so the faulted calls below are warm
+            # dispatches on the short dispatch watchdog
+            warm = eng.submit(
+                ResidualsRequest(par=bpar, toas=btoas)
+            ).result(timeout=300)
+            assert warm.replica == "g0"
+            gang = eng.pool.replica(0)
+            assert gang.width == 2 and gang.probe()
+            gq0 = obs_metrics.counter(
+                "serve.fabric.gang_quarantines"
+            ).value
+            with guard.configured(
+                compile_timeout=60.0, dispatch_timeout=0.4,
+                max_retries=0,
+            ):
+                with faults.inject("hang:inf@g0", hang_seconds=2.0):
+                    futs = [
+                        eng.submit(ResidualsRequest(
+                            par=bpar, toas=btoas,
+                        ))
+                        for _ in range(4)
+                    ]
+                    # big work re-routes to the surviving singles
+                    for f in futs:
+                        resp = f.result(timeout=300)
+                        assert resp.replica.startswith("r")
+                    _wait_for(
+                        lambda: gang.state == QUARANTINED,
+                        20, "g0 quarantine",
+                    )
+                    # the mesh-wide canary runs while the fault is
+                    # armed and keeps failing: g0 stays quarantined
+                    p0 = obs_metrics.counter(
+                        "serve.fabric.probes"
+                    ).value
+                    _wait_for(
+                        lambda: obs_metrics.counter(
+                            "serve.fabric.probes"
+                        ).value > p0,
+                        20, "a gang canary probe attempt",
+                    )
+                    assert gang.state == QUARANTINED
+                # faults cleared: the canary passes and the gang
+                # re-admits AS A UNIT
+                _wait_for(
+                    lambda: gang.state == LIVE, 30, "g0 re-admission",
+                )
+            assert (
+                obs_metrics.counter(
+                    "serve.fabric.gang_quarantines"
+                ).value > gq0
+            )
+            assert eng.stats()["fabric"]["readmits"] >= 1
+            assert eng.stats()["fabric"]["reroutes"] >= 1
+            report = obs_export.flight_report()
+            assert "gang-state" in report and "gang_quarantines" in report
+            # the re-admitted gang serves the big group again,
+            # bitwise-identical to its own pre-fault answer (same
+            # warmed sharded kernel)
+            r2 = eng.submit(
+                ResidualsRequest(par=bpar, toas=btoas)
+            ).result(timeout=300)
+            assert r2.replica == "g0"
+            np.testing.assert_array_equal(
+                r2.residuals_s, warm.residuals_s
+            )
+    finally:
+        eng.close(timeout=60)
+        _join_guard_threads()
+
+
+# -- drain guarantees -----------------------------------------------------
+def test_total_outage_drain_resolves_everything_typed(pulsars):
+    """All executors wedged — gang included: every submitted future
+    still resolves to a typed error and close() returns in bounded
+    time, never a hang (the r8 drain contract extended to gangs)."""
+    par, toas = pulsars[0]
+    with guard.configured(
+        compile_timeout=0.4, dispatch_timeout=0.4, max_retries=0
+    ):
+        with faults.inject("hang:inf@serve:", hang_seconds=2.0):
+            eng = TimingEngine(
+                max_batch=1, max_wait_ms=0.0, inflight=1, replicas=4,
+                gangs=1, gang_size=2, quarantine_n=1, probe_ms=50,
+                max_queue=32,
+            )
+            t0 = time.monotonic()
+            futs = [
+                eng.submit(ResidualsRequest(par=par, toas=toas))
+                for _ in range(5)
+            ]
+            eng.close(timeout=60)
+            for f in futs:
+                with pytest.raises(
+                    (GuardTimeout, RetriesExhausted, RequestRejected,
+                     PintTpuNumericsError)
+                ):
+                    f.result(timeout=30)
+            wall = time.monotonic() - t0
+    assert wall < 45.0
+    _join_guard_threads()

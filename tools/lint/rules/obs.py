@@ -36,6 +36,12 @@ nothing at runtime can notice the absence.
   ``fit_toas`` drives it under the ``run_ladder`` fault ladder; the
   replica batch coalescer stays span-instrumented and gated on the
   warmed ``_kernels`` cache (the zero-steady-retrace invariant).
+- ``obs7`` — gang chokepoints (ISSUE 10): the gang's sharded operand
+  placement (``GangReplica._place_ops``) stays span-instrumented with
+  mesh shardings, its unit-health transitions chain the replica state
+  machine and emit the gang-state event, the mesh-wide canary
+  dispatches through ``dispatch_guard``, and gang membership/sharding
+  fields declare ``# lint: guarded-by(...)`` lock discipline.
 """
 
 from __future__ import annotations
@@ -133,6 +139,11 @@ def _fn_source_has(tree, source, qualname: str, needles) -> list:
 
 
 def _check_needles(rule, path, qualname, needles, why) -> list:
+    if not path.is_file():
+        # a deleted chokepoint file is an instrumentation loss, not
+        # a linter crash
+        return [Finding(rule, str(path), 1,
+                        f"{qualname}: file missing — {why}")]
     src = path.read_text()
     return [
         Finding(rule, str(path), 1, f"{miss} — {why}")
@@ -238,6 +249,27 @@ _COALESCE_CHECKS = (
      "gated on warmed kernel-cache entries (the zero-steady-retrace "
      "invariant)"),
 )
+_GANG_CHECKS = (
+    ("serve/fabric/gang.py", "GangReplica._place_ops",
+     ("TRACER.span", "NamedSharding"),
+     "the gang dispatch chokepoint (sharded operand placement over "
+     "the gang mesh) must stay span-instrumented so shard shape and "
+     "placement cost stay attributable per gang"),
+    ("serve/fabric/gang.py", "GangReplica._make_canary",
+     ("dispatch_guard(", "NamedSharding"),
+     "the gang canary must dispatch through the guard SHARDED over "
+     "the whole gang mesh (site serve:canary@gN) so member-device "
+     "faults keep failing the unit probe"),
+    ("serve/fabric/gang.py", "GangReplica._set_state",
+     ("super()._set_state", "TRACER.event"),
+     "gang health transitions must chain the replica state machine "
+     "(unit quarantine/readmit semantics) and emit the gang-state "
+     "event with the member census"),
+    ("serve/fabric/gang.py", "GangReplica",
+     ("guarded-by(",),
+     "gang membership/sharding fields must declare their lock "
+     "discipline (# lint: guarded-by(...)) for the locks rule"),
+)
 
 
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
@@ -313,11 +345,38 @@ class Obs6Rule(Rule):
 
     def check_project(self, pkg_root: Path) -> list:
         pkg_root = Path(pkg_root)
-        return _run_checks(
-            self.name, pkg_root, _TRAJECTORY_CHECKS,
-            pkg_root / "fitting",
-        ) + _run_checks(
+        findings = []
+        # gate on the fused module itself, not just fitting/: the
+        # obs2 unit-test fixture packages carry a fitting/ dir
+        # without a downhill.py (same convention as obs7's gang gate)
+        if (pkg_root / "fitting" / "downhill.py").is_file():
+            findings += _run_checks(
+                self.name, pkg_root, _TRAJECTORY_CHECKS,
+                pkg_root / "fitting",
+            )
+        findings += _run_checks(
             self.name, pkg_root, _COALESCE_CHECKS,
+            pkg_root / "serve" / "fabric",
+        )
+        return findings
+
+
+class Obs7Rule(Rule):
+    """Gang chokepoints (ISSUE 10): sharded placement spanned, unit
+    health chained + event-instrumented, mesh-wide canary guarded,
+    membership lock discipline declared."""
+
+    name = "obs7"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the gang module itself, not just serve/fabric/: the
+        # obs4/obs6 unit-test fixture packages carry a stripped
+        # replica.py without a gang.py
+        if not (pkg_root / "serve" / "fabric" / "gang.py").is_file():
+            return []
+        return _run_checks(
+            self.name, pkg_root, _GANG_CHECKS,
             pkg_root / "serve" / "fabric",
         )
 
@@ -328,7 +387,8 @@ OBS3 = Obs3Rule()
 OBS4 = Obs4Rule()
 OBS5 = Obs5Rule()
 OBS6 = Obs6Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6)
+OBS7 = Obs7Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
@@ -354,7 +414,7 @@ def lint_paths(paths) -> list:
 
 
 def check_chokepoints(pkg_root) -> list:
-    """obs2-obs6 over one package root (the pre-framework
+    """obs2-obs7 over one package root (the pre-framework
     ``check_chokepoints`` surface, finding-for-finding)."""
     pkg_root = Path(pkg_root)
     findings = _core_chokepoints(pkg_root)
@@ -362,5 +422,6 @@ def check_chokepoints(pkg_root) -> list:
     findings += OBS4.check_project(pkg_root)
     findings += OBS5.check_project(pkg_root)
     findings += OBS6.check_project(pkg_root)
+    findings += OBS7.check_project(pkg_root)
     findings += _fit_decorators(pkg_root)
     return findings
