@@ -405,7 +405,16 @@ def _serve_block():
     submit(), and the steady window adds ZERO recompiles on every
     executor (the per-gang mode-keyed kernel caches); on accelerators
     with a real gang the sharded big-fit throughput must reach
-    >= 1.5x the single-replica rung."""
+    >= 1.5x the single-replica rung.
+
+    ISSUE 11 adds the RESTART and SLO figures (_restart_probe /
+    _slo_probe): kill-and-restart through the warm ledger must
+    recover >= 0.9x the pre-kill steady rps (accelerators) with zero
+    fresh XLA compiles (persistent-cache hits only) and zero steady
+    retraces; near-deadline requests must close their batch early
+    (serve.slo.early_close), and the per-composition admission quota
+    must shed a hot composition's surplus typed while keeping an
+    interactive composition's p99 bounded."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -709,8 +718,254 @@ def _serve_block():
             "steady_recompiles": s_rec + g_rec,
         }
 
+    # restart probe (ISSUE 11): kill-and-restart through the warm
+    # ledger (serve/warm_ledger.py).  Generation 1 warms the fit
+    # capacity ladder and records the ledger; generation 2's boot
+    # replay must recover the full kernel set with ZERO fresh XLA
+    # compiles (persistent-compile-cache hits only), then sustain the
+    # prior traffic mix with zero live traces, zero steady retraces,
+    # and >= 0.9x the pre-kill steady throughput (accelerators).
+    def _restart_probe():
+        import os as _os
+        import tempfile
+
+        from pint_tpu.runtime import compile_cache
+
+        lpath = _os.path.join(
+            tempfile.mkdtemp(prefix="pint-tpu-bench-restart-"),
+            "warm-ledger.json",
+        )
+        kw = dict(
+            max_batch=4, max_wait_ms=2.0, inflight=2, replicas=1,
+            warm_ledger=lpath,
+        )
+
+        def _steady(eng):
+            t0 = time.perf_counter()
+            futs = []
+            for _ in range(rounds):
+                futs += eng.submit_many(requests())
+            for f in futs:
+                f.result(timeout=3600)
+            return npsr * rounds / (time.perf_counter() - t0)
+
+        eng = TimingEngine(**kw)
+        try:
+            wave = 1
+            while wave <= 4:  # warm + record caps 1, 2, 4
+                for f in eng.submit_many([
+                    FitRequest(
+                        par=pulsars[i % npsr][0],
+                        toas=pulsars[i % npsr][1], maxiter=2,
+                    )
+                    for i in range(wave)
+                ]):
+                    f.result(timeout=3600)
+                wave <<= 1
+            rps_before = _steady(eng)
+        finally:
+            eng.close()
+
+        xla0 = compile_cache.entry_count()
+        tr = obs_metrics.counter("compile.traces")
+        tr0 = tr.value
+        rep0 = obs_metrics.counter("serve.warm.replayed").value
+        eng2 = TimingEngine(**kw)  # boot replays the ledger
+        try:
+            replay_traces = tr.value - tr0
+            replayed = (
+                obs_metrics.counter("serve.warm.replayed").value - rep0
+            )
+            tr1 = tr.value
+            rec0 = obs_metrics.counter("compile.recompiles").value
+            rps_after = _steady(eng2)
+            fresh_traces = tr.value - tr1
+            steady_retraces = (
+                obs_metrics.counter("compile.recompiles").value - rec0
+            )
+        finally:
+            eng2.close()
+        xla_new = compile_cache.entry_count() - xla0
+        if replayed < 1:
+            raise PintTpuError(
+                "warm-restart replay re-warmed no kernels — the "
+                "ledger write-through or the boot pre-warmer is "
+                "broken (serve/warm_ledger.py; docs/robustness.md)"
+            )
+        if fresh_traces or steady_retraces:
+            raise PintTpuError(
+                f"{fresh_traces} fresh trace(s) + {steady_retraces} "
+                "retrace(s) under the prior traffic mix after a "
+                "warm restart — replay must recover the FULL "
+                "(bucket, capacity, op) kernel set "
+                "(serve/warm_ledger.py; docs/robustness.md)"
+            )
+        if compile_cache.cache_dir() is not None and xla_new > 0:
+            raise PintTpuError(
+                f"{xla_new} fresh persistent-cache executable(s) "
+                "written during the warm-restart replay — generation "
+                "2 must be served entirely by compile-cache HITS "
+                "(runtime/compile_cache.py; docs/robustness.md)"
+            )
+        ratio = rps_after / rps_before
+        if jax.default_backend() != "cpu" and ratio < 0.9:
+            raise PintTpuError(
+                f"post-restart steady throughput is {ratio:.2f}x the "
+                "pre-kill figure (>= 0.9x required on accelerators: "
+                "a warm restart must recover serving capacity, not "
+                "re-pay the cold start; docs/robustness.md)"
+            )
+        return {
+            "rps_before": round(rps_before, 2),
+            "rps_after": round(rps_after, 2),
+            "throughput_ratio": round(ratio, 3),
+            "replayed_kernels": replayed,
+            "replay_traces": replay_traces,
+            "fresh_traces": fresh_traces,
+            "steady_retraces": steady_retraces,
+            "xla_new_entries": xla_new,
+            "compile_cache_enabled": (
+                compile_cache.cache_dir() is not None
+            ),
+        }
+
+    # SLO probe (ISSUE 11): deadline-aware batch close + the per
+    # -composition admission quota.  Leg 1: a near-deadline request in
+    # an otherwise-idle engine with a LONG max-wait must be flushed at
+    # (deadline - margin), not at max-wait — serve.slo.early_close
+    # moves and the observed latency stays well under max_wait.
+    # Leg 2: a hot composition floods the pipeline; with quota on, the
+    # surplus sheds typed RequestRejected('quota') and an interactive
+    # composition's p99 stays bounded instead of queueing behind the
+    # flood (gated vs the quota-off p99 on accelerators).
+    def _slo_probe():
+        hot_par, hot_toas = pulsars[0]
+        im, itoas = make_test_pulsar(
+            "PSR INTR\nF0 88.0 1\nPEPOCH 55000\nDM 12.0 1\n",
+            ntoa=48, start_mjd=54000.0, end_mjd=56000.0, seed=77,
+            iterations=1,
+        )
+        ipar = im.as_parfile()
+
+        # leg 1: deadline-aware early close
+        deng = TimingEngine(
+            max_batch=8, max_wait_ms=500.0, inflight=2, replicas=1,
+            slo_close_ms=400.0,
+        )
+        try:
+            deng.submit(FitRequest(
+                par=hot_par, toas=hot_toas, maxiter=2,
+            )).result(timeout=3600)  # warm cap 1
+            ec0 = obs_metrics.counter("serve.slo.early_close").value
+            t0 = time.perf_counter()
+            deng.submit(FitRequest(
+                par=hot_par, toas=hot_toas, maxiter=2,
+                deadline_s=0.45,
+            )).result(timeout=3600)
+            near_deadline_ms = (time.perf_counter() - t0) * 1e3
+            early_closes = (
+                obs_metrics.counter("serve.slo.early_close").value
+                - ec0
+            )
+        finally:
+            deng.close()
+        if early_closes < 1 or near_deadline_ms >= 450.0:
+            raise PintTpuError(
+                f"near-deadline request took {near_deadline_ms:.0f} ms "
+                f"with {early_closes} early close(s) — the collector "
+                "must flush a batch at (deadline - margin), not at "
+                "max_wait (serve/batcher.py; docs/serving.md)"
+            )
+
+        # leg 2: quota fairness under a hot-composition flood
+        from pint_tpu.exceptions import RequestRejected
+
+        def _quota_rung(quota):
+            # warm with admission unthrottled (the capacity-ladder
+            # waves would themselves trip the quota), then arm it for
+            # the measured flood window only
+            qeng = TimingEngine(
+                max_batch=8, max_wait_ms=4.0, inflight=2, replicas=1,
+                max_queue=512, quota=0,
+            )
+            try:
+                wave = 1
+                while wave <= 8:  # warm the hot capacity ladder
+                    for f in qeng.submit_many([
+                        FitRequest(
+                            par=hot_par, toas=hot_toas, maxiter=2,
+                        )
+                        for _ in range(wave)
+                    ]):
+                        f.result(timeout=3600)
+                    wave <<= 1
+                for f in qeng.submit_many([  # warm interactive caps
+                    FitRequest(par=ipar, toas=itoas, maxiter=2)
+                    for _ in range(2)
+                ]):
+                    f.result(timeout=3600)
+                qeng.quota = quota
+                flood = [
+                    qeng.submit(FitRequest(
+                        par=hot_par, toas=hot_toas, maxiter=2,
+                    ))
+                    for _ in range(160)
+                ]
+                # interactive requests one at a time (a real
+                # interactive caller awaits each answer): with the
+                # quota off the first one queues behind the whole
+                # flood; with it on the flood surplus is already shed
+                lats = []
+                for _ in range(10):
+                    ti = time.perf_counter()
+                    qeng.submit(FitRequest(
+                        par=ipar, toas=itoas, maxiter=2,
+                    )).result(timeout=3600)
+                    lats.append(time.perf_counter() - ti)
+                shed = 0
+                for f in flood:
+                    try:
+                        f.result(timeout=3600)
+                    except RequestRejected as e:
+                        assert e.reason == "quota", e.reason
+                        shed += 1
+                p99 = float(np.percentile(
+                    np.asarray(lats) * 1e3, 99,
+                ))
+                return p99, shed
+            finally:
+                qeng.close()
+
+        p99_off, shed_off = _quota_rung(0)
+        p99_on, shed_on = _quota_rung(6)
+        if shed_on < 1 or shed_off != 0:
+            raise PintTpuError(
+                f"quota rung shed {shed_on} (on) / {shed_off} (off) — "
+                "a hot-composition flood over quota must shed typed "
+                "RequestRejected('quota') exactly when the quota is "
+                "enabled (serve/engine.py::_check_quota; "
+                "docs/serving.md)"
+            )
+        if jax.default_backend() != "cpu" and p99_on > 0.8 * p99_off:
+            raise PintTpuError(
+                f"interactive p99 {p99_on:.0f} ms with the quota on "
+                f"vs {p99_off:.0f} ms without (accelerator bound: "
+                "<= 0.8x — the per-composition quota must keep the "
+                "hot flood from monopolizing the pipeline; "
+                "docs/serving.md)"
+            )
+        return {
+            "near_deadline_ms": round(near_deadline_ms, 1),
+            "early_closes": early_closes,
+            "interactive_p99_ms_quota_on": round(p99_on, 1),
+            "interactive_p99_ms_quota_off": round(p99_off, 1),
+            "hot_shed_quota_on": shed_on,
+        }
+
     population = _population_probe()
     gang = _gang_probe()
+    restart = _restart_probe()
+    slo = _slo_probe()
 
     r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
     r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
@@ -762,6 +1017,8 @@ def _serve_block():
         "coalesced_batches": st["fabric"]["coalesced"],
         "population": population,
         "gang": gang,
+        "restart": restart,
+        "slo": slo,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
             tag: rs["batches"]

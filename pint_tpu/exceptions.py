@@ -179,9 +179,13 @@ class RequestRejected(PintTpuError):
     an overloaded engine REFUSES work loudly — a bounded-queue
     rejection, a missed per-request deadline, or a shutdown — and
     never hangs, OOMs, or silently drops a request.  ``reason`` is one
-    of ``'queue-full'``, ``'deadline'``, ``'shutdown'``, or
-    ``'no-replica'`` (the serving fabric had no live replica left to
-    take the batch — every candidate quarantined or drained)."""
+    of ``'queue-full'``, ``'deadline'``, ``'quota'`` (the request's
+    composition is at its per-composition in-flight quota —
+    ``PINT_TPU_SERVE_QUOTA``; admission fairness, ISSUE 11),
+    ``'shutdown'``, or ``'no-replica'`` (the serving fabric had no
+    live replica left to take the batch — every candidate quarantined
+    or drained).  The full reason table clients can switch on lives in
+    docs/serving.md and is pinned by tests/test_serve_slo.py."""
 
     def __init__(self, reason: str, detail: str = ""):
         self.reason = reason

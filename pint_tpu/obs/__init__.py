@@ -67,6 +67,16 @@ for _name, _unit in (
     ("serve.fabric.readmits", ""),
     ("serve.fabric.probes", ""),
     ("serve.fabric.no_replica", ""),
+    # fleet operability (pint_tpu/serve — ISSUE 11): dispatch-boundary
+    # late sheds, SLO-aware early batch closes, per-composition quota
+    # rejections, and the warm-restart ledger's replay accounting
+    ("serve.shed.late", ""),
+    ("serve.slo.early_close", ""),
+    ("serve.quota_rejected", ""),
+    ("serve.warm.recorded", ""),
+    ("serve.warm.replayed", ""),
+    ("serve.warm.failed", ""),
+    ("serve.warm.stale", ""),
 ):
     metrics.counter(_name, unit=_unit)
 del _name, _unit

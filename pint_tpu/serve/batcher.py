@@ -85,30 +85,55 @@ def stack_trees(trees: list):
 class MicroBatch:
     """One flushable group of same-composition pending requests."""
 
-    __slots__ = ("key", "items", "t_oldest", "priority")
+    __slots__ = ("key", "items", "t_oldest", "priority", "deadline",
+                 "slo_closed")
 
     def __init__(self, key):
         self.key = key
         self.items: list = []
         self.t_oldest: float | None = None
         self.priority: int = 10**9
+        # earliest ABSOLUTE (monotonic) member deadline — the SLO-aware
+        # close trigger (ISSUE 11); None when no member carries one
+        self.deadline: float | None = None
+        # set by Batcher.take_due when the deadline trigger (not the
+        # max-wait timer) closed the group — the engine's
+        # serve.slo.early_close accounting reads it
+        self.slo_closed: bool = False
 
-    def add(self, item, now: float, priority: int):
+    def add(self, item, now: float, priority: int,
+            deadline: float | None = None):
         self.items.append(item)
         if self.t_oldest is None:
             self.t_oldest = now
         self.priority = min(self.priority, priority)
+        if deadline is not None and (
+                self.deadline is None or deadline < self.deadline):
+            self.deadline = deadline
 
     def __len__(self):
         return len(self.items)
 
 
 class Batcher:
-    """Group accumulator with full-batch and max-wait flush triggers."""
+    """Group accumulator with full-batch and max-wait flush triggers.
 
-    def __init__(self, max_batch: int, max_wait_s: float):
+    SLO-aware close (ISSUE 11): when ``slo_margin_s`` is not None, a
+    group whose earliest member deadline is within the margin closes
+    EARLY — due time is ``min(t_oldest + max_wait,
+    deadline - slo_margin_s)`` — so a near-deadline request dispatches
+    with whatever depth has accumulated instead of waiting out the
+    fixed timer and shedding at flush.  The margin budgets the
+    stack + route + dispatch + fence path downstream of the close
+    decision (``PINT_TPU_SERVE_SLO_CLOSE``, ms; 0 disables)."""
+
+    def __init__(self, max_batch: int, max_wait_s: float,
+                 slo_margin_s: float | None = None):
         self.max_batch = max(1, int(max_batch))
         self.max_wait_s = float(max_wait_s)
+        self.slo_margin_s = (
+            None if slo_margin_s is None else max(0.0, float(slo_margin_s))
+        )
         self._groups: dict = {}
 
     def __len__(self):
@@ -117,30 +142,49 @@ class Batcher:
     def empty(self) -> bool:
         return not self._groups
 
-    def add(self, key, item, now: float, priority: int):
+    def _due_at(self, g: MicroBatch) -> float:
+        """Absolute time the group closes: the max-wait timer, pulled
+        earlier by a near-deadline member under SLO-aware close (never
+        earlier than arrival — an already-blown margin closes now)."""
+        due = g.t_oldest + self.max_wait_s
+        if self.slo_margin_s is not None and g.deadline is not None:
+            due = min(due, max(g.t_oldest, g.deadline - self.slo_margin_s))
+        return due
+
+    def add(self, key, item, now: float, priority: int,
+            deadline: float | None = None):
         """Queue one request; returns the group when it just filled to
-        max_batch (popped — flush it now), else None."""
+        max_batch (popped — flush it now), else None.  ``deadline`` is
+        the member's absolute monotonic deadline (None = none)."""
         g = self._groups.get(key)
         if g is None:
             g = self._groups[key] = MicroBatch(key)
-        g.add(item, now, priority)
+        g.add(item, now, priority, deadline)
         if len(g) >= self.max_batch:
             return self._groups.pop(key)
         return None
 
     def take_due(self, now: float, take_all: bool = False) -> list:
-        """Pop groups whose oldest member has waited max_wait (all
-        groups when ``take_all`` — engine shutdown drain)."""
-        due = [
+        """Pop groups past their due time — the max-wait timer or an
+        SLO-aware deadline close, whichever is earlier (all groups
+        when ``take_all`` — engine shutdown drain)."""
+        out = []
+        for k in [
             k for k, g in self._groups.items()
-            if take_all or now - g.t_oldest >= self.max_wait_s
-        ]
-        return [self._groups.pop(k) for k in due]
+            if take_all or now >= self._due_at(g)
+        ]:
+            g = self._groups.pop(k)
+            g.slo_closed = (
+                not take_all
+                and now - g.t_oldest < self.max_wait_s
+            )
+            out.append(g)
+        return out
 
     def next_wait_s(self, now: float):
         """Seconds until the earliest pending group becomes due, or
         None when nothing is pending (the collector's wait timeout)."""
         if not self._groups:
             return None
-        oldest = min(g.t_oldest for g in self._groups.values())
-        return max(0.0, oldest + self.max_wait_s - now)
+        due = min(self._due_at(g) for g in self._groups.values())
+        return max(0.0, due - now)

@@ -50,10 +50,29 @@ Sub-ceiling work keeps bitwise single-replica numerics; the whole
 path stays zero-steady-retrace (per-gang kernel caches keyed
 (group key, capacity, gang shape, placement mode)).
 
+Fleet operability (ISSUE 11):
+
+- **SLO-aware admission** — the batcher closes a group EARLY when its
+  oldest member's deadline is within ``PINT_TPU_SERVE_SLO_CLOSE`` ms
+  (serve/batcher.py; ``serve.slo.early_close``), replicas re-check
+  deadlines at the dispatch boundary so expired work never burns a
+  device dispatch (``serve.shed.late``), and a per-composition
+  in-flight quota (``PINT_TPU_SERVE_QUOTA``) keeps one hot
+  composition from starving the rest — over-quota admissions shed as
+  typed ``RequestRejected('quota')``.
+- **warm restarts** — with ``PINT_TPU_SERVE_WARM_LEDGER`` set, every
+  kernel the fabric traces is recorded in the warm-state ledger
+  (serve/warm_ledger.py) riding next to the persistent XLA compile
+  cache, and a restarted engine REPLAYS it at boot
+  (``ReplicaPool.prewarm``): sessions rebuild from persisted
+  prototypes, kernels re-trace as disk-cache hits, and steady rps
+  recovers with zero fresh XLA compiles (bench.py's restart probe).
+
 All engine/serving knobs have ``PINT_TPU_SERVE_*`` env defaults
 (documented in docs/serving.md): MAX_QUEUE, MAX_BATCH, MAX_WAIT_MS,
 INFLIGHT, SESSIONS, PARS, MIN_BUCKET, REPLICAS, AFFINITY,
-QUARANTINE_N, PROBE_MS, GANGS, GANG_SIZE, GANG_THRESHOLD.
+QUARANTINE_N, PROBE_MS, GANGS, GANG_SIZE, GANG_THRESHOLD, QUOTA,
+SLO_CLOSE, WARM_LEDGER.
 """
 
 from __future__ import annotations
@@ -99,7 +118,10 @@ class TimingEngine:
                  max_wait_ms=None, inflight=None, min_bucket=None,
                  max_sessions=None, replicas=None, affinity=None,
                  quarantine_n=None, probe_ms=None, gangs=None,
-                 gang_size=None, gang_threshold=None):
+                 gang_size=None, gang_threshold=None, quota=None,
+                 slo_close_ms=None, warm_ledger=None, prewarm=True):
+        from pint_tpu.serve import warm_ledger as wlmod
+
         env = os.environ.get
         self.max_queue = int(
             max_queue if max_queue is not None
@@ -119,10 +141,29 @@ class TimingEngine:
             else env("PINT_TPU_SERVE_INFLIGHT", "4")
         )
         self.min_bucket = min_bucket
+        # per-composition in-flight admission quota (ISSUE 11):
+        # 0/unset = unlimited
+        self.quota = int(
+            quota if quota is not None
+            else env("PINT_TPU_SERVE_QUOTA", "0")
+        )
+        # SLO-aware early-close margin (ms; 0 disables): how far ahead
+        # of a member's deadline its group closes, budgeting the
+        # stack + route + dispatch + fence path downstream
+        slo_ms = float(
+            slo_close_ms if slo_close_ms is not None
+            else env("PINT_TPU_SERVE_SLO_CLOSE", "25")
+        )
+        self.slo_margin_s = None if slo_ms <= 0 else slo_ms / 1e3
         self.sessions = smod.SessionCache(max_sessions)
         self._queue: collections.deque = collections.deque()  # lint: guarded-by(_cond)
         self._cond = threading.Condition()
-        self._batcher = bmod.Batcher(self.max_batch, self.max_wait_s)
+        self._batcher = bmod.Batcher(
+            self.max_batch, self.max_wait_s,
+            slo_margin_s=self.slo_margin_s,
+        )
+        self._quota_lock = threading.Lock()
+        self._quota_inflight: dict = {}  # cid -> admitted unresolved; lint: guarded-by(_quota_lock)
         self._stop = False  # lint: guarded-by(_cond)
         self._latencies = collections.deque(maxlen=4096)  # lint: guarded-by(_lat_lock)
         self._lat_lock = threading.Lock()
@@ -166,6 +207,25 @@ class TimingEngine:
         self._m_stack_pars = m.histogram("serve.stack.distinct_pars")
         self._m_latency = m.histogram("serve.latency_ms", unit="ms")
         self._m_depth = m.gauge("serve.queue_depth")
+        self._m_quota = m.counter("serve.quota_rejected")
+        self._m_slo_close = m.counter("serve.slo.early_close")
+        # warm-restart ledger (ISSUE 11): register for write-through
+        # and REPLAY it before the collector exists — prewarm_kernel's
+        # boot-thread safety contract (serve/fabric/replica.py)
+        self._ledger = None
+        path = wlmod.ledger_path(warm_ledger)
+        if path is not None:
+            self._ledger = wlmod.WarmLedger(path)
+            wlmod.register(self._ledger)
+            if prewarm:
+                with TRACER.span(
+                    "serve:warm-replay", "serve", path=path,
+                ):
+                    jobs = wlmod.replay_jobs(
+                        self._ledger, self.sessions, self.max_batch
+                    )
+                    if jobs:
+                        self.pool.prewarm(jobs)
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True,
             name="pint-tpu-serve collector",
@@ -277,6 +337,7 @@ class TimingEngine:
             )
             p.session = sess
             p.record = rec
+            self._check_quota(p, sess.cid)
             if req.op == "fit":
                 if req.method == "wls" and sess.cm.has_correlated_errors:
                     raise PintTpuError(
@@ -300,8 +361,12 @@ class TimingEngine:
             else:
                 raise PintTpuError(f"unknown serve op {req.op!r}")
             p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
+            deadline = (
+                None if req.deadline_s is None
+                else p.t_submit + float(req.deadline_s)
+            )
             return self._batcher.add(
-                key, p, time.monotonic(), req.priority
+                key, p, time.monotonic(), req.priority, deadline
             )
         except BaseException as e:  # per-request failure, not fatal
             if not p.future.done():
@@ -310,6 +375,47 @@ class TimingEngine:
                     else PintTpuError(f"admit failed: {e!r}")
                 )
             return None
+
+    def _check_quota(self, p: _Pending, cid: str):
+        """Per-composition admission quota + fairness chokepoint
+        (pintlint rule obs8): at most ``quota`` admitted-but-
+        unresolved requests per composition may occupy the pipeline,
+        so one hot composition's burst cannot monopolize batch slots
+        and replica queues while interactive compositions starve
+        (the SLO probe in bench.py measures exactly that p99).
+        Over-quota requests shed typed at admission —
+        ``RequestRejected('quota')``, ``serve.quota_rejected`` — and
+        the occupancy releases when the future RESOLVES (done
+        callback), not when it dispatches: in-flight device work
+        counts against the composition too."""
+        if self.quota <= 0:
+            return
+        with self._quota_lock:
+            n = self._quota_inflight.get(cid, 0)
+            if n >= self.quota:
+                self._m_quota.inc()
+                self._m_rejected.inc()
+                TRACER.event(
+                    "shed", "serve", reason="quota", op=p.req.op,
+                    composition=cid, inflight=n,
+                )
+                raise RequestRejected(
+                    "quota",
+                    f"composition {cid}: {n} in flight >= "
+                    f"quota {self.quota}",
+                )
+            self._quota_inflight[cid] = n + 1
+        p.future.add_done_callback(
+            lambda _f, cid=cid: self._quota_release(cid)
+        )
+
+    def _quota_release(self, cid: str):
+        with self._quota_lock:
+            n = self._quota_inflight.get(cid, 0)
+            if n <= 1:
+                self._quota_inflight.pop(cid, None)
+            else:
+                self._quota_inflight[cid] = n - 1
 
     def _predict(self, p: _Pending):
         """Polyco phase prediction: generated+cached per session span,
@@ -356,6 +462,14 @@ class TimingEngine:
     def _flush(self, batch):
         """The flush chokepoint: shed expired members, stack operands,
         route the assembled batch onto a fabric replica."""
+        if getattr(batch, "slo_closed", False):
+            # the batcher's deadline trigger (not the max-wait timer)
+            # closed this group — SLO-aware admission accounting
+            self._m_slo_close.inc()
+            TRACER.event(
+                "slo-close", "serve", op=batch.key[0],
+                n=len(batch.items),
+            )
         live = [p for p in batch.items if not self._expired(p)]
         if not live:
             return
@@ -609,6 +723,19 @@ class TimingEngine:
                 **self.router.stats(),
                 "per_replica": per_replica,
             },
+            # fleet operability (ISSUE 11): SLO-aware admission and
+            # the warm-restart ledger's replay accounting
+            "slo": {
+                "early_closes": mc("serve.slo.early_close").value,
+                "late_sheds": mc("serve.shed.late").value,
+                "quota_rejected": mc("serve.quota_rejected").value,
+            },
+            "warm": {
+                "recorded": mc("serve.warm.recorded").value,
+                "replayed": mc("serve.warm.replayed").value,
+                "failed": mc("serve.warm.failed").value,
+                "stale": mc("serve.warm.stale").value,
+            },
         }
 
     def reset_stats(self):
@@ -630,6 +757,11 @@ class TimingEngine:
             self._cond.notify_all()
         self._collector.join(timeout)
         self.pool.drain(timeout)
+        if self._ledger is not None:
+            from pint_tpu.serve import warm_ledger as wlmod
+
+            wlmod.unregister(self._ledger)
+            self._ledger = None
 
     def __enter__(self):
         return self

@@ -34,6 +34,7 @@ from __future__ import annotations
 import os
 import threading
 
+from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.parallel.mesh import serving_devices
 from pint_tpu.serve.fabric.gang import GangReplica
@@ -165,6 +166,50 @@ class ReplicaPool:
                         r.note_success()
                     else:
                         r.note_failure("probe")
+
+    # -- warm-restart replay (ISSUE 11) ------------------------------------
+    def prewarm(self, jobs: list) -> int:
+        """Boot-time warm-ledger replay chokepoint (pintlint rule
+        obs8): dispatch each resolved pre-warm job — a synthetic
+        zero-member BatchWork plus its recorded placement classes —
+        through EVERY executor of each class (``gang``/``single``;
+        whole-pool fallback when a recorded class has no executor in
+        the restarted topology), so the kernel caches every replica
+        would have built under the prior traffic mix are re-populated
+        from the persistent XLA compile cache before the collector
+        starts.  MUST be called from the engine constructor, before
+        the collector thread exists — Replica.prewarm_kernel's
+        boot-thread safety contract.  Per-(job, replica) failures are
+        counted (``serve.warm.failed``) and skipped: replay is
+        best-effort, a bad entry costs warmth, never a boot."""
+        warmed = 0
+        for work, placements in jobs:
+            targets, seen = [], set()
+            for placement in placements:
+                cls = self.gangs if placement == "gang" else self.singles
+                if not cls:
+                    cls = self.replicas
+                for r in cls:
+                    if r.rid not in seen:
+                        seen.add(r.rid)
+                        targets.append(r)
+            for r in targets:
+                with TRACER.span(
+                    "pool:prewarm", "fabric", replica=r.tag,
+                    op=work.key[0], cap=work.cap,
+                    bucket=work.session.bucket,
+                ):
+                    try:
+                        r.prewarm_kernel(work)
+                        warmed += 1
+                        obs_metrics.counter("serve.warm.replayed").inc()
+                    except BaseException as e:
+                        obs_metrics.counter("serve.warm.failed").inc()
+                        TRACER.event(
+                            "prewarm-failed", "fabric", replica=r.tag,
+                            op=work.key[0], error=repr(e),
+                        )
+        return warmed
 
     # -- stats / lifecycle -------------------------------------------------
     def stats(self) -> dict:

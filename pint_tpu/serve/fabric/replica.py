@@ -53,6 +53,7 @@ import collections
 import os
 import queue
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -118,20 +119,25 @@ class BatchWork:
 
     def make_kernel(self, tag: str):
         """Build this batch's kernel for one replica (the site carries
-        the replica tag so spans/faults are per-replica pinnable)."""
+        the replica tag so spans/faults are per-replica pinnable).
+        ``warm`` threads the warm-restart ledger write-through down to
+        traced_jit: the kernel's first trace records (session, key,
+        capacity, tag) so a restarted process can replay exactly this
+        warm surface (serve/warm_ledger.py, ISSUE 11)."""
         from pint_tpu.serve import session as smod
 
         site = (
             f"serve:{self.key[0]}:b{self.session.bucket}"
             f"x{self.cap}@{tag}"
         )
+        warm = (self.session, self.key, self.cap, tag)
         if self.key[0] == "fit":
             _, _, _, mode, maxiter, tol = self.key
             return smod.build_fit_kernel(
-                self.session, mode, maxiter, tol, site
+                self.session, mode, maxiter, tol, site, warm=warm
             )
         return smod.build_residuals_kernel(
-            self.session, self.key[3], site
+            self.session, self.key[3], site, warm=warm
         )
 
     def fail(self, e: BaseException):
@@ -430,7 +436,94 @@ class Replica:
         )
         return merged
 
+    def _shed_late(self, work: BatchWork):
+        """Dispatch-boundary deadline re-check (ISSUE 11 satellite):
+        a member that expired while its batch sat in this replica's
+        queue — behind a slow batch or a quarantine re-route — would
+        otherwise still burn a device dispatch whose answer nobody can
+        use.  Shed it typed HERE, right before the device sees the
+        batch: expired members resolve RequestRejected('deadline')
+        (``serve.shed.late``), survivors keep dispatching through the
+        SAME (key, capacity) kernel via the merge_batch_works row
+        discipline — gather survivor rows in order, re-pad to the
+        unchanged capacity by repeating row 0 (bundle/ref pads are
+        bitwise copies of a served row; x0 rows are all zeros) — so
+        row ``i`` stays aligned with ``live[i]`` and the shed can
+        never cause a retrace.  Returns None when every member
+        expired: the dispatch is skipped entirely."""
+        now = time.monotonic()
+        flags = [
+            p.req.deadline_s is not None
+            and now - p.t_submit >= p.req.deadline_s
+            for p in work.live
+        ]
+        if not any(flags):
+            return work
+        expired = [p for p, f in zip(work.live, flags) if f]
+        obs_metrics.counter("serve.shed.late").inc(len(expired))
+        obs_metrics.counter("serve.shed").inc(len(expired))
+        TRACER.event(
+            "shed", "fabric", reason="deadline-late", op=work.key[0],
+            replica=self.tag, n=len(expired),
+        )
+        for p in expired:
+            if not p.future.done():
+                waited = now - p.t_submit
+                p.future.set_exception(RequestRejected(
+                    "deadline",
+                    f"expired at the dispatch boundary: waited "
+                    f"{waited:.3f}s >= deadline {p.req.deadline_s}s",
+                ))
+        keep_idx = [i for i, f in enumerate(flags) if not f]
+        if not keep_idx:
+            self._batch_leaves(work)
+            return None
+        cap = work.cap
+
+        def surgery(leaf):
+            rows = np.asarray(leaf)[keep_idx]
+            pad = cap - rows.shape[0]
+            if pad:
+                rows = np.concatenate(
+                    [rows, np.repeat(rows[:1], pad, axis=0)], axis=0
+                )
+            return rows
+
+        kept = BatchWork(
+            work.key,
+            [p for p, f in zip(work.live, flags) if not f],
+            tree_util.tree_map(surgery, work.ops),
+            work.session, cap,
+        )
+        kept.excluded = set(work.excluded)
+        kept.last_error = work.last_error
+        return kept
+
+    def prewarm_kernel(self, work: BatchWork) -> None:
+        """Boot-time kernel pre-warm (ISSUE 11): trace + dispatch one
+        synthetic zero-member batch through the NORMAL guarded path —
+        ``_kernel_for`` (traced_jit: exact trace accounting +
+        dispatch_guard) and ``_place_ops`` (per-executor placement,
+        gang sharding included) — so a restarted process re-populates
+        this executor's kernel cache from the persistent XLA compile
+        cache before traffic arrives.  Runs on the BOOT thread, which
+        is safe for the dispatcher-thread-only ``_kernels`` dict only
+        because ``ReplicaPool.prewarm`` is called from the engine
+        constructor, before the collector exists — the dispatcher has
+        never touched the cache yet and dict writes are GIL-atomic."""
+        with TRACER.span(
+            "replica:prewarm", "fabric", replica=self.tag,
+            op=work.key[0], cap=work.cap, bucket=work.session.bucket,
+        ):
+            kernel = self._kernel_for(work)
+            ops = self._place_ops(work)
+            out = kernel(*ops)  # compiles (disk-cache hit) + runs
+            tree_util.tree_map(np.asarray, out)  # fence
+
     def _run(self, work: BatchWork):
+        work = self._shed_late(work)
+        if work is None:
+            return
         try:
             kernel = self._kernel_for(work)
         except BaseException as e:

@@ -336,6 +336,11 @@ class Session:
         self.composition = composition
         self.cid = composition_id(composition)
         self.founder_hash = record.par_hash
+        # founder par TEXT rides into the warm-restart ledger
+        # (serve/warm_ledger.py): replay re-parses it so the
+        # composition key — including any TZR par-hash fold —
+        # recomputes bit-identically at boot
+        self.founder_par = record.par
         model = record.model
         if toas.t_tdb is None:
             ingest_for_model(toas, model)
@@ -359,9 +364,38 @@ class Session:
         # Python body and stay lock-free) — serve/fabric/replica.py
         self.trace_lock = threading.Lock()
 
+    @classmethod
+    def from_prototype(cls, record: ParRecord, cm, bucket: int,
+                       composition: tuple) -> "Session":
+        """Rebuild a serving session from a persisted prototype — the
+        warm-restart ledger replay path (serve/warm_ledger.py).
+        ``cm`` is a CompiledModel over the ledger sidecar's
+        ALREADY-PADDED founder bundle (+ TZR bundle), so boot needs no
+        TOA set, no clock/EOP/ephemeris ingest environment, and no TZR
+        re-ingest; the session is trace scaffolding identical in every
+        shape/dtype to what live traffic would have built, which is
+        what makes the replayed XLA compiles persistent-cache hits."""
+        s = object.__new__(cls)
+        s.bucket = int(bucket)
+        s.composition = composition
+        s.cid = composition_id(composition)
+        s.founder_hash = record.par_hash
+        s.founder_par = record.par
+        s.model = record.model
+        if cm.bundle.ntoa != s.bucket:
+            raise PintTpuError(
+                f"prototype bundle has {cm.bundle.ntoa} TOAs, "
+                f"session bucket is {s.bucket}"
+            )
+        s.cm = cm
+        s.mode = default_accel_mode(cm)
+        s.static_ref = record.static_ref
+        s.trace_lock = threading.Lock()
+        return s
+
 
 # -- the serve dispatch chokepoint ---------------------------------------
-def traced_jit(fn, site: str, cid: str | None = None):
+def traced_jit(fn, site: str, cid: str | None = None, warm=None):
     """serve's dispatch chokepoint: ``jax.jit`` + exact XLA (re)trace
     accounting + operand-byte metering + the device-execution guard —
     the ``CompiledModel.jit`` contract for kernels whose operands
@@ -372,7 +406,12 @@ def traced_jit(fn, site: str, cid: str | None = None):
     too — a retrace past the first is a bucketing bug.  ``cid``
     additionally attributes each trace to its composition
     (serve.composition.<cid>.compiles — the one-compile-per-
-    composition invariant's per-composition ledger)."""
+    composition invariant's per-composition ledger).  ``warm`` is the
+    warm-restart ledger's write-through hook (ISSUE 11): a
+    ``(session, group key, capacity, replica tag)`` tuple recorded on
+    the wrapper's FIRST trace via serve/warm_ledger.py::note_warm —
+    the same body the compile counters live in, so the persisted warm
+    surface and the trace accounting can never disagree."""
     ntraces = [0]
 
     def noted(*args):
@@ -381,6 +420,10 @@ def traced_jit(fn, site: str, cid: str | None = None):
             _obs.metrics.counter(
                 f"serve.composition.{cid}.compiles"
             ).inc()
+        if warm is not None and ntraces[0] == 0:
+            from pint_tpu.serve import warm_ledger as _wl
+
+            _wl.note_warm(*warm)
         ntraces[0] += 1
         return fn(*args)
 
@@ -413,7 +456,7 @@ def _with_swapped(proto, static_ref, fn):
 
 
 def build_residuals_kernel(session: Session, subtract_mean: bool,
-                           site: str):
+                           site: str, warm=None):
     """Batched residuals kernel: (bundle_stack, ref_stack, xs (B, p))
     -> (residuals (B, bucket), chi2 (B,)).  The pulsar axis stacks
     DISTINCT pars of one composition: each row's bundle + reference
@@ -429,11 +472,11 @@ def build_residuals_kernel(session: Session, subtract_mean: bool,
     def run(bundles, refs, xs):
         return jax.vmap(call)(bundles, refs, xs)
 
-    return traced_jit(run, site, cid=session.cid)
+    return traced_jit(run, site, cid=session.cid, warm=warm)
 
 
 def build_fit_kernel(session: Session, mode: str, maxiter: int,
-                     tol_chi2: float, site: str):
+                     tol_chi2: float, site: str, warm=None):
     """Batched fit kernel: every request's whole Gauss-Newton
     iteration runs as ONE vmapped lax.scan program (the
     make_scan_fit_loop semantics GLSFitter uses, over the shared
@@ -459,7 +502,7 @@ def build_fit_kernel(session: Session, mode: str, maxiter: int,
     def run(bundles, refs, xs0):
         return jax.vmap(call)(bundles, refs, xs0)
 
-    return traced_jit(run, site, cid=session.cid)
+    return traced_jit(run, site, cid=session.cid, warm=warm)
 
 
 class SessionCache:
@@ -597,6 +640,32 @@ class SessionCache:
                 "session-evict", "serve", composition=old.cid, bucket=b
             )
         return s
+
+    def install(self, session: Session) -> Session:
+        """Insert a REBUILT session (the warm-restart ledger replay,
+        serve/warm_ledger.py) unless an equivalent one is already live
+        — get-or-keep, returning the canonical instance so every
+        pre-warm job of a composition shares one trace lock, and the
+        first real post-restart request of the composition is a
+        session HIT dispatching through the already-warmed kernels."""
+        key = (session.composition, session.bucket)
+        evicted = []
+        with self._lock:
+            cur = self._sessions.get(key)
+            if cur is not None:
+                self._sessions.move_to_end(key)
+                return cur
+            self._sessions[key] = session
+            self._sessions.move_to_end(key)
+            while len(self._sessions) > self.max_sessions:
+                evicted.append(self._sessions.popitem(last=False))
+            self._note_sizes_locked()
+        for (_comp, b), old in evicted:
+            self._evictions.inc()
+            TRACER.event(
+                "session-evict", "serve", composition=old.cid, bucket=b
+            )
+        return session
 
     # -- one-call resolver -------------------------------------------------
     def get_or_create(self, par, toas, min_bucket=None) -> Session:

@@ -1,0 +1,342 @@
+"""Warm-restart ledger: crash-safe serve warm state (ISSUE 11).
+
+Reference parity: none — TPU-service infrastructure.  The persistent
+XLA compile cache (runtime/compile_cache.py) already makes a process
+restart's *compiles* disk hits, but the serving fabric still had to
+re-DISCOVER its warm surface from live traffic: which compositions,
+buckets, capacities, and gang/single placements were actually serving.
+Until the traffic mix re-arrived, every first-of-kind batch paid a
+trace (and serialized on the session trace lock) in the latency path —
+restart-to-steady-rps was a re-warm storm.
+
+This module persists that warm surface as a *ledger* riding alongside
+the compile cache and replays it at boot:
+
+- **write-through** happens at the serve dispatch chokepoint
+  (serve/session.py::traced_jit): each kernel wrapper's FIRST trace
+  calls :func:`note_warm` with its (session, group key, capacity,
+  replica tag), and every registered ledger records it — so the ledger
+  is exactly the set of kernels the fleet ever traced, never a guess.
+- **entries** are JSON (``{"version": 1, "entries": {...}}``): per
+  (composition, op, bucket, op-params) — the founder par TEXT (replay
+  re-parses it, so the composition key including any TZR par-hash fold
+  recomputes bit-identically), the capacity ladder actually warmed,
+  and the placement classes (``single``/``gang``) that served it.  A
+  pickle *sidecar* per (composition, bucket) persists the PADDED
+  prototype bundle (+ TZR bundle), so session rebuild at boot needs no
+  TOA set, no ingest environment, and no TZR re-ingest
+  (serve/session.py::Session.from_prototype).
+- **replay** (:func:`replay_jobs` + ``ReplicaPool.prewarm``) rebuilds
+  each session, installs it in the SessionCache, and dispatches one
+  synthetic zero-member batch per (key, capacity) through every
+  executor of the recorded placement class — the normal guarded path,
+  so the XLA compile is a persistent-cache hit and the traced wrapper
+  lands in the replica kernel cache before traffic arrives.  The
+  restart probe in bench.py gates the contract: recovered steady rps
+  with ZERO fresh XLA compiles and zero steady retraces.
+
+A corrupted, truncated, or version-stale ledger (or sidecar) always
+degrades to a clean COLD boot — ``serve.warm.stale`` counts it, nothing
+crashes (tests/test_warm_ledger.py).  Enablement is explicit:
+``$PINT_TPU_SERVE_WARM_LEDGER`` (or the ``TimingEngine(warm_ledger=)``
+kwarg) — ``0``/``off`` disables, ``1``/``on`` uses the default path
+next to the XLA cache, anything else is the ledger path itself.
+Security note: the sidecar is pickle in the user's own cache directory
+— the same trust boundary as the XLA executable cache beside it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from pint_tpu import obs as _obs
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import compile_cache
+
+#: bump when the entry/sidecar schema changes — a mismatched version
+#: ledger is IGNORED (clean cold boot), never migrated in place
+LEDGER_VERSION = 1
+
+#: ledger entries kept (LRU by last warm) — bounds the JSON rewrite
+#: cost and the boot replay surface
+MAX_ENTRIES = 64
+
+
+def ledger_path(override=None) -> str | None:
+    """Resolve the active warm-ledger path, or None when disabled.
+
+    ``override`` (the engine kwarg) beats ``$PINT_TPU_SERVE_WARM_
+    LEDGER``: False/'0'/'off' disable, True/'1'/'on' select the
+    default path in the persistent compile cache's parent directory
+    (the ledger "rides alongside" the XLA cache), any other string is
+    the path itself."""
+    if override is False:
+        return None
+    if override is None or override is True:
+        raw = os.environ.get("PINT_TPU_SERVE_WARM_LEDGER", "")
+        if override is True and not raw.strip():
+            raw = "1"
+    else:
+        raw = str(override)
+    raw = raw.strip()
+    if raw.lower() in ("", "0", "off", "no", "false"):
+        return None
+    if raw.lower() in ("1", "on", "yes", "true"):
+        d = compile_cache.cache_dir()
+        parent = (
+            os.path.dirname(d) if d
+            else os.path.join(os.path.expanduser("~"), ".cache",
+                              "pint_tpu")
+        )
+        return os.path.join(parent, "serve-warm-ledger.json")
+    return raw
+
+
+class WarmLedger:
+    """One on-disk warm-state ledger (JSON index + pickle sidecars).
+
+    Thread-safe: ``record`` is called from whichever replica thread
+    traces first (via the traced_jit write-through), ``load``/
+    ``load_sidecar`` from the boot thread.  Writes are atomic
+    (tmp + rename) and synchronous — they only happen on cold warms,
+    which are rare by the zero-steady-retrace invariant."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict | None = None  # lint: guarded-by(_lock)
+
+    # -- read side ---------------------------------------------------------
+    def load(self) -> list:
+        """Parsed ledger entries (copies), [] on any corruption or
+        version mismatch — a bad ledger is a clean cold boot."""
+        with self._lock:
+            return [dict(e) for e in self._load_locked().values()]
+
+    def _load_locked(self) -> OrderedDict:
+        if self._entries is not None:
+            return self._entries
+        entries: OrderedDict = OrderedDict()
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            if doc.get("version") != LEDGER_VERSION:
+                raise ValueError(
+                    f"ledger version {doc.get('version')!r} != "
+                    f"{LEDGER_VERSION}"
+                )
+            for eid, e in doc["entries"].items():
+                if not (isinstance(e, dict) and "par" in e
+                        and "op" in e and "bucket" in e):
+                    raise ValueError(f"malformed entry {eid!r}")
+                entries[eid] = e
+        except FileNotFoundError:
+            pass
+        except Exception as exc:
+            entries = OrderedDict()
+            _obs.metrics.counter("serve.warm.stale").inc()
+            TRACER.event(
+                "warm-ledger-stale", "serve", path=self.path,
+                error=repr(exc),
+            )
+        self._entries = entries
+        return entries
+
+    def load_sidecar(self, entry: dict):
+        """(padded prototype bundle, tzr_bundle) of one entry; raises
+        on a missing/corrupt/stale sidecar (replay skips the entry)."""
+        p = os.path.join(
+            os.path.dirname(self.path) or ".", entry["sidecar"]
+        )
+        with open(p, "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("version") != LEDGER_VERSION:
+            raise PintTpuError(
+                f"warm sidecar {entry['sidecar']!r} version "
+                f"{payload.get('version')!r} != {LEDGER_VERSION}"
+            )
+        return payload["bundle"], payload["tzr_bundle"]
+
+    # -- write side --------------------------------------------------------
+    def record(self, session, key: tuple, cap: int, tag: str):
+        """Write-through one warmed kernel: merge (composition, op,
+        bucket, op-params) x (capacity, placement class) into the
+        ledger and persist — called (via :func:`note_warm`) from the
+        first trace of each serve kernel wrapper."""
+        op = key[0]
+        bucket = int(key[2])
+        if op == "fit":
+            params = {
+                "mode": str(key[3]), "maxiter": int(key[4]),
+                "tol": float(key[5]),
+            }
+        elif op == "residuals":
+            params = {"subtract_mean": bool(key[3])}
+        else:
+            return
+        placement = "gang" if str(tag).startswith("g") else "single"
+        eid = f"{session.cid}:{op}:{bucket}:" + ":".join(
+            f"{k}={v}" for k, v in sorted(params.items())
+        )
+        with self._lock:
+            entries = self._load_locked()
+            e = entries.get(eid)
+            changed = e is None
+            if e is None:
+                e = entries[eid] = {
+                    "cid": session.cid, "op": op, "bucket": bucket,
+                    "par": session.founder_par, "params": params,
+                    "caps": [], "placements": [],
+                    "sidecar": f"warm-{session.cid}-{bucket}.pkl",
+                }
+            if int(cap) not in e["caps"]:
+                e["caps"] = sorted(set(e["caps"]) | {int(cap)})
+                changed = True
+            if placement not in e["placements"]:
+                e["placements"] = sorted(
+                    set(e["placements"]) | {placement}
+                )
+                changed = True
+            entries.move_to_end(eid)
+            while len(entries) > MAX_ENTRIES:
+                entries.popitem(last=False)
+                changed = True
+            if changed:
+                self._write_sidecar_locked(e["sidecar"], session)
+                self._save_locked()
+        if changed:
+            _obs.metrics.counter("serve.warm.recorded").inc()
+
+    def _write_sidecar_locked(self, name: str, session):
+        p = os.path.join(os.path.dirname(self.path) or ".", name)
+        if os.path.exists(p):
+            return
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        payload = {
+            "version": LEDGER_VERSION,
+            "bundle": session.cm.bundle,
+            "tzr_bundle": session.cm.tzr_bundle,
+        }
+        tmp = f"{p}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, p)
+
+    def _save_locked(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        doc = {"version": LEDGER_VERSION, "entries": dict(self._entries)}
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self.path)
+
+
+# -- write-through registration (serve/session.py::traced_jit calls in) --
+_alock = threading.Lock()
+_active: list = []  # lint: guarded-by(_alock)
+
+
+def register(ledger: WarmLedger):
+    with _alock:
+        _active.append(ledger)
+
+
+def unregister(ledger: WarmLedger):
+    with _alock:
+        if ledger in _active:
+            _active.remove(ledger)
+
+
+def note_warm(session, key: tuple, cap: int, tag: str):
+    """The serve/session.py write-through hook — called from INSIDE
+    ``traced_jit``'s noted body on each kernel wrapper's first trace
+    (exactly where the compile counters live, so the ledger and the
+    trace accounting can never disagree).  Never raises: a ledger
+    write failure costs warm state, not a dispatch."""
+    if not _active:
+        return
+    with _alock:
+        leds = list(_active)
+    for led in leds:
+        try:
+            led.record(session, key, cap, tag)
+        except Exception as exc:
+            _obs.metrics.counter("serve.warm.failed").inc()
+            TRACER.event(
+                "warm-record-failed", "serve", error=repr(exc)
+            )
+
+
+# -- boot-time replay ------------------------------------------------------
+def replay_jobs(ledger: WarmLedger, sessions, max_batch=None) -> list:
+    """Resolve every ledger entry into pre-warm jobs for
+    ``ReplicaPool.prewarm``: a list of (BatchWork, placement classes)
+    with zero live members and synthetic stacked operands (the padded
+    prototype bundle repeated to each recorded capacity — exactly the
+    shapes/dtypes live traffic stacks, so the traced program is the
+    one the XLA disk cache already holds).  Each entry rebuilds its
+    session via :meth:`Session.from_prototype` and installs it in the
+    SessionCache so the first real request of the composition is a
+    session HIT.  Per-entry failures skip that entry
+    (``serve.warm.failed``) — replay is best-effort by design."""
+    from pint_tpu.models.timing_model import CompiledModel
+    from pint_tpu.serve import batcher as bmod
+    from pint_tpu.serve import session as smod
+    from pint_tpu.serve.fabric import BatchWork
+
+    cap_ceiling = (
+        None if max_batch is None
+        else bmod.capacity_for(int(max_batch), int(max_batch))
+    )
+    jobs = []
+    for e in ledger.load():
+        try:
+            rec = sessions.record_for(e["par"])
+            bundle, tzr = ledger.load_sidecar(e)
+            cm = CompiledModel(
+                rec.model, bundle, subtract_mean=True, tzr_bundle=tzr
+            )
+            comp = smod.composition_key(
+                cm, rec.refnum, rec.static_ref, rec.par_hash,
+                rec.model.has_tzr_anchor(),
+            )
+            sess = sessions.install(smod.Session.from_prototype(
+                rec, cm, int(e["bucket"]), comp
+            ))
+            params = e["params"]
+            if e["op"] == "fit":
+                key = (
+                    "fit", sess.composition, sess.bucket, sess.mode,
+                    int(params["maxiter"]), float(params["tol"]),
+                )
+            else:
+                key = (
+                    "residuals", sess.composition, sess.bucket,
+                    bool(params["subtract_mean"]),
+                )
+            placements = tuple(e.get("placements") or ("single",))
+            for cap in e["caps"]:
+                cap = int(cap)
+                if cap_ceiling is not None and cap > cap_ceiling:
+                    continue
+                bstack = bmod.stack_trees([sess.cm.bundle] * cap)
+                rstack = bmod.stack_trees([rec.refnum] * cap)
+                xs = np.zeros((cap, sess.cm.nfree))
+                jobs.append((
+                    BatchWork(key, [], (bstack, rstack, xs), sess, cap),
+                    placements,
+                ))
+        except Exception as exc:
+            _obs.metrics.counter("serve.warm.failed").inc()
+            TRACER.event(
+                "warm-replay-skip", "serve", cid=e.get("cid", "?"),
+                error=repr(exc),
+            )
+    return jobs

@@ -1,0 +1,66 @@
+"""Bounded chaos sweep for the benchmark ladder (ISSUE 11).
+
+Wraps :func:`tools.chaos.run_sweep` — the deterministic whole-fabric
+fault matrix (every replica/gang-tagged guard site x every fault kind
+the injector knows, plus the kill-and-restart warm-ledger leg) — in
+the ~60 s envelope the driver-run profiling ladder expects: a small
+mixed pool (one gang + singles when the host has >= 4 serving
+devices, all singles otherwise), a fault-leg time budget that reports
+skipped legs explicitly instead of silently capping, and one JSON
+line per leg.
+
+Each fault row carries the operability verdict the chaos harness
+computed: ``outcomes`` (every future typed), ``quarantined`` /
+``readmitted`` (the health cycle), ``steady_traces`` /
+``steady_retraces`` (both must be 0 — faults and re-routes against
+warm kernels never compile), and ``ok``.  The restart row carries
+``killed_typed``, ``replayed``, ``fresh_traces`` and
+``xla_new_entries`` (the zero-fresh-compile warm-restart gate).
+
+Usage: ``python profiling/chaos_sweep.py`` or ``python
+profiling/run_benchmarks.py --configs chaos``.  Workflow:
+docs/robustness.md "fleet operability".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def chaos_rows(time_budget_s: float = 45.0):
+    """Yield one result row per chaos leg + a summary row."""
+    import jax
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from tools.chaos import run_sweep
+
+    from pint_tpu.parallel.mesh import serving_devices
+
+    ndev = len(serving_devices(None))
+    topo = (
+        {"replicas": 4, "gangs": 1, "gang_size": 2} if ndev >= 4
+        else {"replicas": ndev or 1, "gangs": 0}
+    )
+    report = run_sweep(
+        time_budget_s=time_budget_s, timeout=120.0, **topo,
+    )
+    backend = jax.default_backend()
+    for leg in report["legs"]:
+        yield {"bench": "chaos", "backend": backend, **topo, **leg}
+    yield {
+        "bench": "chaos", "backend": backend, "summary": True, **topo,
+        "executors": report["executors"],
+        "skipped": report["skipped"],
+        "ok": report["ok"],
+        "flight_has_quarantine": report["flight_has_quarantine"],
+        "flight_has_readmit": report["flight_has_readmit"],
+    }
+
+
+if __name__ == "__main__":
+    for row in chaos_rows():
+        print(json.dumps(row))

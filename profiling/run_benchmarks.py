@@ -437,7 +437,7 @@ def main():
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
                              "serve_replicas", "serve_population",
-                             "serve_gang", "dispatch_floor"])
+                             "serve_gang", "dispatch_floor", "chaos"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -476,6 +476,19 @@ def main():
                 "serve_gang": gang_sweep,
             }[str(c)]()
             for row in rows:
+                print(json.dumps(row))
+            continue
+        if str(c) == "chaos":
+            # bounded deterministic fault sweep: every executor tag x
+            # every fault kind + the kill-and-restart warm-ledger leg
+            # (ISSUE 11; profiling/chaos_sweep.py wraps tools/chaos.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from chaos_sweep import chaos_rows
+
+            for row in chaos_rows():
                 print(json.dumps(row))
             continue
         if str(c) == "dispatch_floor":

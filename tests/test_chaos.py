@@ -1,0 +1,112 @@
+"""Chaos-harness smoke (ISSUE 11): tools/chaos.py on the CPU mesh.
+
+The full matrix is the driver-run ``chaos`` profiling config
+(profiling/chaos_sweep.py); this suite pins the harness MECHANICS with
+a bounded sweep — one numerics fault kind and one deterministic
+transport kind over a two-single-replica pool, plus the
+kill-and-restart warm-ledger leg:
+
+- every (executor, kind) leg reports ``ok`` — futures typed, health
+  kinds quarantine AND readmit, deterministic kinds stay LIVE, zero
+  steady traces/retraces while faults fire and batches re-route;
+- the restart leg kills an engine mid-wave (orphans typed), then
+  replays the ledger with zero fresh XLA compiles;
+- :func:`tools.chaos.classify` buckets outcomes strictly by TYPE —
+  the operability contract's measurement instrument.
+"""
+
+from concurrent.futures import Future
+
+from pint_tpu.exceptions import PintTpuError, RequestRejected
+
+
+def test_classify_buckets_outcomes_by_type():
+    from tools.chaos import classify
+
+    ok, rej, typed, untyped, pending = (Future() for _ in range(5))
+    ok.set_result(42)
+    rej.set_exception(RequestRejected("quota", "over"))
+    typed.set_exception(PintTpuError("diagnosed"))
+    untyped.set_exception(ValueError("contract violation"))
+    out = classify([ok, rej, typed, untyped, pending], timeout=0.01)
+    assert out["offered"] == 5
+    assert out["completed"] == 1
+    assert out["rejected"] == {"quota": 1}
+    assert out["failed"] == {"PintTpuError": 1}
+    assert out["untyped"] == {"ValueError": 1}
+    assert out["unresolved"] == 1
+    assert out["typed"] is False
+    pending.set_result(0)
+    assert classify([ok, rej, typed, pending], 0.01)["typed"] is True
+
+
+def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
+    """One health kind + one deterministic kind across every executor
+    of a two-replica pool, then kill-and-restart.  Bounded: the big
+    traffic class is shrunk to a 256 bucket (the full 1024-bucket
+    gang matrix belongs to the profiling config)."""
+    import tools.chaos as chaos
+
+    monkeypatch.setattr(chaos, "build_big", _small_big)
+    report = chaos.run_sweep(
+        kinds=("nan", "413"), npsr=2, replicas=2, gangs=0,
+        restart=True, ledger_dir=str(tmp_path), timeout=120.0,
+    )
+    assert report["executors"] == ["r0", "r1"]
+    legs = {(leg["tag"], leg["kind"]): leg for leg in report["legs"]}
+    assert set(legs) == {
+        ("r0", "nan"), ("r0", "413"), ("r1", "nan"), ("r1", "413"),
+        ("restart", "kill-restart"),
+    }
+    for leg in report["legs"]:
+        assert leg["ok"], leg
+    # the health cycle ran for real and the faults actually fired
+    for tag in ("r0", "r1"):
+        nan = legs[(tag, "nan")]
+        assert nan["fired"] > 0 and nan["quarantined"] \
+            and nan["readmitted"] and nan["readmits"] >= 1
+        det = legs[(tag, "413")]
+        assert det["fired"] > 0 and not det["quarantined"]
+        assert sum(det["outcomes"]["failed"].values()) > 0
+        for leg in (nan, det):
+            assert leg["steady_traces"] == 0
+            assert leg["steady_retraces"] == 0
+    restart = legs[("restart", "kill-restart")]
+    assert restart["killed_typed"] and restart["replayed"] >= 1
+    assert restart["fresh_traces"] == 0
+    assert report["skipped"] == 0
+    assert report["ok"] is True
+    assert report["flight_has_quarantine"]
+    assert report["flight_has_readmit"]
+
+
+def _small_big():
+    """A 200-TOA 'big' pulsar: same two-class warm structure, a
+    quarter of the 1024-bucket compile bill."""
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR CBIG\nF0 305.5 1\nF1 -2.2e-15 1\n"
+        "PEPOCH 55000\nDM 21.4 1\n"
+    )
+    m, toas = make_test_pulsar(
+        par, ntoa=200, start_mjd=53000.0, end_mjd=57000.0,
+        seed=991, iterations=1,
+    )
+    return (m.as_parfile(), toas)
+
+
+def test_time_budget_reports_skipped_legs_explicitly(monkeypatch):
+    """An exhausted time budget records what was NOT exercised — an
+    explicit ``skipped`` row per remaining leg, never a silent cap."""
+    import tools.chaos as chaos
+
+    monkeypatch.setattr(chaos, "build_big", _small_big)
+    report = chaos.run_sweep(
+        kinds=("413",), npsr=2, replicas=2, gangs=0, restart=False,
+        time_budget_s=0.0, timeout=60.0,
+    )
+    assert report["skipped"] == 2
+    for leg in report["legs"]:
+        assert leg == {"tag": leg["tag"], "kind": "413",
+                       "skipped": True, "ok": True}

@@ -545,6 +545,69 @@ def test_obs7_flags_stripped_gang_guards(tmp_path):
     assert obs7.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs8: the ISSUE 11 fleet-operability chokepoints ---------------------
+def test_obs8_flags_stripped_operability_guards(tmp_path):
+    """obs8 catches the warm-ledger write-through/replay or quota
+    instrumentation being stripped and a missing or nondeterministic
+    chaos entry; skips packages without the ledger module; passes the
+    real tree."""
+    obs8 = rules_by_name()["obs8"]
+    # no warm_ledger.py -> subsystem absent, fixture packages skip
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "serve").mkdir(parents=True)
+    (bare / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site):\n    return fn\n"
+    )
+    assert obs8.check_project(bare) == []
+    # stripped guards are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "serve" / "fabric").mkdir(parents=True)
+    (pkg / "serve" / "warm_ledger.py").write_text(
+        "def note_warm(*a):\n    pass\n"
+    )
+    (pkg / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site):\n    return fn\n"
+    )
+    (pkg / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def __init__(self):\n"
+        "        pass\n"
+        "    def _check_quota(self, p, cid):\n"
+        "        pass\n"
+    )
+    (pkg / "serve" / "fabric" / "pool.py").write_text(
+        "class ReplicaPool:\n"
+        "    def prewarm(self, jobs):\n"
+        "        return 0\n"
+    )
+    (pkg / "serve" / "fabric" / "replica.py").write_text(
+        "class Replica:\n"
+        "    def prewarm_kernel(self, work):\n"
+        "        pass\n"
+    )
+    msgs = "\n".join(f.message for f in obs8.check_project(pkg))
+    assert "note_warm(" in msgs          # write-through unwired
+    assert "serve.warm.failed" in msgs   # failure counting stripped
+    assert "replay_jobs(" in msgs        # boot replay unwired
+    assert "RequestRejected" in msgs     # quota shed untyped
+    assert "prewarm_kernel(" in msgs     # pool replay chokepoint
+    assert "_kernel_for(" in msgs        # replica pre-warm path
+    assert "tools/chaos.py missing" in msgs  # chaos entry gone
+    # a nondeterministic chaos entry is flagged too
+    tools = tmp_path / "pkg" / "tools"
+    tools.mkdir()
+    (tools / "chaos.py").write_text(
+        "import random\n"
+        "def run_sweep():\n"
+        "    return random.random()\n"
+    )
+    msgs = "\n".join(f.message for f in obs8.check_project(pkg))
+    assert "imports 'random'" in msgs
+    assert "faults.inject" in msgs
+    # the real tree carries all the guards and a deterministic entry
+    assert obs8.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

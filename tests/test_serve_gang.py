@@ -275,15 +275,39 @@ def test_big_prefers_gangs_small_prefers_singles_and_gang_spill(
             ResidualsRequest(par=spar, toas=stoas)
         ).result(timeout=300)
         assert small.replica.startswith("r")
-        # a burst of big requests with inflight=1 saturates the sticky
-        # gang and spills the group to the second gang
-        futs = [
-            eng.submit(ResidualsRequest(par=bpar, toas=btoas))
-            for _ in range(10)
-        ]
-        tags = {f.result(timeout=300).replica for f in futs}
-        assert tags and all(t.startswith("g") for t in tags)
-        assert tags == {"g0", "g1"}
+        # the big group places sticky on one gang and compiles there
+        warm = eng.submit(
+            ResidualsRequest(par=bpar, toas=btoas)
+        ).result(timeout=300)
+        sticky = warm.replica
+        assert sticky.startswith("g")
+        g_sticky = next(
+            r for r in eng.pool.replicas if r.tag == sticky
+        )
+        other = next(
+            r.tag for r in eng.pool.replicas
+            if r.tag.startswith("g") and r.tag != sticky
+        )
+        # saturate the sticky gang DETERMINISTICALLY by pinning the
+        # router's load signal (outstanding; saturated past inflight x
+        # width, and +4 outweighs any transient load the spill target
+        # can accrue) — racing a real burst against the gang's own
+        # completions loses on a loaded host, with all requests
+        # landing sticky and no spill
+        with g_sticky._cond:
+            g_sticky._outstanding += 4
+        try:
+            futs = [
+                eng.submit(ResidualsRequest(par=bpar, toas=btoas))
+                for _ in range(10)
+            ]
+            tags = {f.result(timeout=300).replica for f in futs}
+        finally:
+            with g_sticky._cond:
+                g_sticky._outstanding -= 4
+        # spill between gangs: the saturated sticky gang keeps the
+        # placement, the burst serves on the OTHER gang
+        assert tags == {other}
         assert eng.stats()["fabric"]["spills"] >= 1
     finally:
         eng.close(timeout=60)

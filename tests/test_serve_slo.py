@@ -1,0 +1,444 @@
+"""SLO-aware admission suite (ISSUE 11) on the virtual 8-device CPU
+mesh (conftest).  Covers the operability-PR admission surface:
+
+- Batcher deadline-aware close policy (``_due_at`` / ``slo_closed``)
+  as a pure unit — the margin pulls a group's due time ahead of the
+  max-wait timer, never before arrival, and ``take_due`` marks groups
+  the deadline trigger (not the timer) closed;
+- end-to-end early close: a near-deadline request dispatches well
+  inside the max-wait window and ``serve.slo.early_close`` counts it;
+- per-composition in-flight quota: over-quota admissions shed typed
+  ``RequestRejected('quota')``, occupancy releases when the future
+  RESOLVES, compositions are isolated, predict is exempt;
+- the replica dispatch-boundary deadline re-check
+  (``Replica._shed_late``): members that expired in the replica queue
+  shed typed (``serve.shed.late``) while survivors keep the SAME
+  (key, capacity) kernel with rows still aligned to ``live``;
+- the full ``RequestRejected.reason`` table clients switch on —
+  ``queue-full`` / ``deadline`` / ``quota`` / ``shutdown`` /
+  ``no-replica`` — each reason triggered for real, its string pinned,
+  and its row required in docs/serving.md (the reason table the
+  exceptions docstring promises).
+"""
+
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import PintTpuError, RequestRejected
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.serve import (
+    FitRequest,
+    PredictRequest,
+    ResidualsRequest,
+    TimingEngine,
+)
+from pint_tpu.serve.batcher import Batcher, MicroBatch
+from pint_tpu.serve.engine import _Pending
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """
+PSR              J0000+00{i:02d}
+F0               {f0}  1
+F1               -1.1e-15           1
+PEPOCH           55000
+DM               {dm}             1
+"""
+
+
+def _pulsar(i, f0, dm, n, seed):
+    m, t = make_test_pulsar(
+        PAR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+        iterations=1,
+    )
+    return m.as_parfile(), t
+
+
+@pytest.fixture(scope="module")
+def pulsars():
+    """Three same-composition pulsars, all in the 64 bucket."""
+    return [
+        _pulsar(0, 107.3, 11.0, 40, 11),
+        _pulsar(1, 203.7, 19.0, 50, 12),
+        _pulsar(2, 91.9, 6.5, 60, 13),
+    ]
+
+
+@pytest.fixture(scope="module")
+def engine(pulsars):
+    eng = TimingEngine(
+        max_batch=4, max_wait_ms=2.0, inflight=2, replicas=2,
+    )
+    # warm the residuals path once so later legs measure steady state
+    for f in eng.submit_many(
+        [ResidualsRequest(par=p, toas=t) for p, t in pulsars]
+    ):
+        f.result(timeout=600)
+    yield eng
+    eng.close(timeout=60)
+
+
+def _targeted_work(engine, pulsars, deadlines=None):
+    """Assemble one residuals batch through the engine's own admission
+    + stacking chokepoints without routing it (the tools/chaos.py
+    targeting idiom), with optional per-member deadlines."""
+    from pint_tpu.serve import batcher as bmod
+    from pint_tpu.toas.bundle import make_bundle
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    live = []
+    key = None
+    for j, (par, toas) in enumerate(pulsars):
+        dl = None if deadlines is None else deadlines[j]
+        req = ResidualsRequest(par=par, toas=toas, deadline_s=dl)
+        req.validate()
+        p = _Pending(req, Future(), time.monotonic())
+        rec = engine.sessions.record_for(par)
+        if toas.t_tdb is None:
+            ingest_for_model(toas, rec.model)
+        nb = make_bundle(toas, rec.model._build_masks(toas),
+                         as_numpy=True)
+        sess = engine.sessions.session_for(
+            rec, toas, nb, engine.min_bucket
+        )
+        p.record, p.session = rec, sess
+        p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
+        key = ("residuals", sess.composition, sess.bucket,
+               bool(req.subtract_mean))
+        live.append(p)
+    return engine._assemble(key, live), [p.future for p in live]
+
+
+# -- Batcher deadline policy (pure unit) ----------------------------------
+def test_due_at_pulls_close_ahead_of_max_wait():
+    b = Batcher(max_batch=8, max_wait_s=0.5, slo_margin_s=0.05)
+    now = 100.0
+    b.add("k", "a", now, priority=1, deadline=now + 0.2)
+    (g,) = b._groups.values()
+    # deadline - margin beats t_oldest + max_wait
+    assert b._due_at(g) == pytest.approx(now + 0.15)
+    # a second, LATER deadline does not move the close
+    b.add("k", "b", now + 0.01, priority=1, deadline=now + 0.4)
+    assert g.deadline == pytest.approx(now + 0.2)
+    assert b._due_at(g) == pytest.approx(now + 0.15)
+
+
+def test_due_at_never_before_arrival_and_timer_wins_when_far():
+    b = Batcher(max_batch=8, max_wait_s=0.5, slo_margin_s=0.05)
+    now = 100.0
+    # an already-blown margin closes NOW (t_oldest), not in the past
+    b.add("blown", "a", now, priority=1, deadline=now + 0.01)
+    assert b._due_at(b._groups["blown"]) == pytest.approx(now)
+    # a distant deadline leaves the max-wait timer in charge
+    b.add("far", "a", now, priority=1, deadline=now + 9.0)
+    assert b._due_at(b._groups["far"]) == pytest.approx(now + 0.5)
+    # no deadline at all: the classic timer
+    b.add("none", "a", now, priority=1)
+    assert b._due_at(b._groups["none"]) == pytest.approx(now + 0.5)
+
+
+def test_due_at_disabled_margin_ignores_deadlines():
+    b = Batcher(max_batch=8, max_wait_s=0.5, slo_margin_s=None)
+    b.add("k", "a", 100.0, priority=1, deadline=100.05)
+    assert b._due_at(b._groups["k"]) == pytest.approx(100.5)
+
+
+def test_take_due_marks_slo_closed_groups():
+    b = Batcher(max_batch=8, max_wait_s=0.5, slo_margin_s=0.05)
+    now = 100.0
+    b.add("slo", "a", now, priority=1, deadline=now + 0.2)
+    b.add("timer", "a", now, priority=1)
+    # at t=0.2: the deadline group is due (0.15), the timer one is not
+    out = b.take_due(now + 0.2)
+    assert [g.key for g in out] == ["slo"]
+    assert out[0].slo_closed is True
+    # the timer group closes at max-wait, NOT an SLO close
+    out = b.take_due(now + 0.6)
+    assert [g.key for g in out] == ["timer"]
+    assert out[0].slo_closed is False
+
+
+def test_take_all_drain_is_never_an_slo_close():
+    b = Batcher(max_batch=8, max_wait_s=0.5, slo_margin_s=0.05)
+    b.add("k", "a", 100.0, priority=1, deadline=100.2)
+    (g,) = b.take_due(100.0, take_all=True)
+    assert g.slo_closed is False
+
+
+def test_microbatch_tracks_earliest_member_deadline():
+    g = MicroBatch("k")
+    g.add("a", 1.0, priority=3)
+    assert g.deadline is None
+    g.add("b", 1.1, priority=2, deadline=9.0)
+    g.add("c", 1.2, priority=1, deadline=5.0)
+    g.add("d", 1.3, priority=1, deadline=7.0)
+    assert g.deadline == 5.0
+    assert g.t_oldest == 1.0
+    assert g.priority == 1
+
+
+# -- end-to-end early close ------------------------------------------------
+def test_deadline_early_close_beats_max_wait(pulsars):
+    """A near-deadline request must dispatch at (deadline - margin),
+    well inside a deliberately huge max-wait window, and the engine
+    must count the SLO close."""
+    eng = TimingEngine(
+        max_batch=8, max_wait_ms=500.0, inflight=2, replicas=1,
+        slo_close_ms=400.0,
+    )
+    try:
+        par, toas = pulsars[0]
+        # warm the (key, cap=1) kernel so the timed leg is steady-state
+        eng.submit(ResidualsRequest(par=par, toas=toas)).result(
+            timeout=600
+        )
+        c0 = obs_metrics.counter("serve.slo.early_close").value
+        t0 = time.monotonic()
+        res = eng.submit(ResidualsRequest(
+            par=par, toas=toas, deadline_s=0.45,
+        )).result(timeout=60)
+        wall = time.monotonic() - t0
+        assert res.ntoa == toas.ntoas
+        # close fires at deadline - margin = 50 ms, not the 500 ms
+        # timer (generous ceiling: CPU-mesh dispatch jitter)
+        assert wall < 0.45
+        assert obs_metrics.counter("serve.slo.early_close").value > c0
+    finally:
+        eng.close(timeout=60)
+
+
+# -- per-composition quota -------------------------------------------------
+def _fake_pending(op="residuals"):
+    class _Req:
+        pass
+
+    r = _Req()
+    r.op = op
+    return _Pending(r, Future(), time.monotonic())
+
+
+def test_quota_semantics_shed_release_isolation(engine):
+    """The admission-quota chokepoint: typed shed at the quota, the
+    slot releases when the future RESOLVES (not dispatches), and
+    compositions are isolated from each other."""
+    q0 = obs_metrics.counter("serve.quota_rejected").value
+    engine.quota = 2
+    try:
+        p1, p2, p3 = (_fake_pending() for _ in range(3))
+        engine._check_quota(p1, "compA")
+        engine._check_quota(p2, "compA")
+        with pytest.raises(RequestRejected) as ei:
+            engine._check_quota(p3, "compA")
+        assert ei.value.reason == "quota"
+        assert obs_metrics.counter("serve.quota_rejected").value \
+            == q0 + 1
+        # a DIFFERENT composition is unaffected by compA's saturation
+        engine._check_quota(_fake_pending(), "compB")
+        # resolving one compA future releases its slot
+        p1.future.set_result(None)
+        engine._check_quota(_fake_pending(), "compA")
+        with pytest.raises(RequestRejected):
+            engine._check_quota(_fake_pending(), "compA")
+    finally:
+        engine.quota = 0
+        assert engine._check_quota(_fake_pending(), "compA") is None
+
+
+def test_quota_flood_sheds_typed_end_to_end(engine, pulsars):
+    """A hot-composition burst through the public edge: every outcome
+    is a completion or a typed quota rejection, never anything else,
+    and admission recovers once the burst resolves."""
+    engine.quota = 1
+    try:
+        futs = engine.submit_many([
+            FitRequest(par=pulsars[i % 3][0], toas=pulsars[i % 3][1])
+            for i in range(16)
+        ])
+        done, shed = 0, 0
+        for f in futs:
+            try:
+                f.result(timeout=600)
+                done += 1
+            except RequestRejected as e:
+                assert e.reason == "quota"
+                shed += 1
+        assert done + shed == 16
+        assert done >= 1
+        # one composition, quota 1, 16 near-simultaneous fits: the
+        # collector admits at most one unresolved at a time
+        assert shed >= 1
+        # burst resolved -> quota slots free again
+        engine.submit(ResidualsRequest(
+            par=pulsars[0][0], toas=pulsars[0][1],
+        )).result(timeout=600)
+    finally:
+        engine.quota = 0
+
+
+def test_quota_exempts_predict(engine, pulsars):
+    """Phase prediction is per-par host state — no batch slot, no
+    replica queue — so the fairness quota never throttles it."""
+    engine.quota = 1
+    q0 = obs_metrics.counter("serve.quota_rejected").value
+    try:
+        futs = engine.submit_many([
+            PredictRequest(
+                par=pulsars[0][0], mjds=np.linspace(55000, 55001, 5),
+            )
+            for _ in range(6)
+        ])
+        for f in futs:
+            assert f.result(timeout=600).phase_frac.shape == (5,)
+        assert obs_metrics.counter("serve.quota_rejected").value == q0
+    finally:
+        engine.quota = 0
+
+
+# -- dispatch-boundary deadline re-check ----------------------------------
+def test_shed_late_sheds_expired_keeps_alignment(engine, pulsars):
+    """``Replica._shed_late``: an expired member sheds typed at the
+    dispatch boundary (``serve.shed.late``) while survivors keep the
+    SAME capacity with operand rows still aligned to ``live``."""
+    work, futs = _targeted_work(
+        engine, pulsars, deadlines=[None, 5.0, 600.0],
+    )
+    # age member 1 past its deadline without sleeping
+    work.live[1].t_submit -= 10.0
+    before = {
+        id(leaf): np.array(leaf)
+        for leaf in _leaves(work.ops)
+    }
+    c0 = obs_metrics.counter("serve.shed.late").value
+    rep = engine.pool.replicas[0]
+    kept = rep._shed_late(work)
+    assert obs_metrics.counter("serve.shed.late").value == c0 + 1
+    with pytest.raises(RequestRejected) as ei:
+        futs[1].result(timeout=1)
+    assert ei.value.reason == "deadline"
+    assert not futs[0].done() and not futs[2].done()
+    # survivors: same key/capacity (the shed can never retrace), rows
+    # 0..1 are the surviving members' original rows, pads repeat row 0
+    assert kept is not None and kept is not work
+    assert kept.key == work.key and kept.cap == work.cap
+    assert [p.req.deadline_s for p in kept.live] == [None, 600.0]
+    for old, new in zip(_leaves(work.ops), _leaves(kept.ops)):
+        old = before[id(old)]
+        np.testing.assert_array_equal(new[0], old[0])
+        np.testing.assert_array_equal(new[1], old[2])
+        for pad_row in new[len(kept.live):]:
+            np.testing.assert_array_equal(pad_row, new[0])
+
+
+def test_shed_late_passthrough_and_full_expiry(engine, pulsars):
+    rep = engine.pool.replicas[0]
+    # nothing expired: the SAME object flows on, zero shed accounting
+    work, _futs = _targeted_work(engine, pulsars[:2],
+                                 deadlines=[None, 900.0])
+    c0 = obs_metrics.counter("serve.shed.late").value
+    assert rep._shed_late(work) is work
+    assert obs_metrics.counter("serve.shed.late").value == c0
+    # every member expired: the dispatch is skipped entirely
+    work, futs = _targeted_work(engine, pulsars[:2],
+                                deadlines=[1.0, 2.0])
+    for p in work.live:
+        p.t_submit -= 60.0
+    assert rep._shed_late(work) is None
+    for f in futs:
+        with pytest.raises(RequestRejected) as ei:
+            f.result(timeout=1)
+        assert ei.value.reason == "deadline"
+    assert obs_metrics.counter("serve.shed.late").value == c0 + 2
+
+
+def _leaves(tree):
+    from jax import tree_util
+
+    return tree_util.tree_leaves(tree)
+
+
+# -- the RequestRejected reason table --------------------------------------
+def _trigger_queue_full(engine, pulsars):
+    par, toas = pulsars[0]
+    saved = engine.max_queue
+    engine.max_queue = 0  # every submit is over the bound
+    try:
+        with pytest.raises(RequestRejected) as ei:
+            engine.submit(
+                ResidualsRequest(par=par, toas=toas)
+            ).result(timeout=60)
+    finally:
+        engine.max_queue = saved
+    return ei.value
+
+
+def _trigger_deadline(engine, pulsars):
+    par, toas = pulsars[0]
+    with pytest.raises(RequestRejected) as ei:
+        engine.submit(ResidualsRequest(
+            par=par, toas=toas, deadline_s=1e-6,
+        )).result(timeout=60)
+    return ei.value
+
+
+def _trigger_quota(engine, pulsars):
+    engine.quota = 1
+    try:
+        engine._check_quota(_fake_pending(), "quota-trigger")
+        with pytest.raises(RequestRejected) as ei:
+            engine._check_quota(_fake_pending(), "quota-trigger")
+    finally:
+        engine.quota = 0
+    return ei.value
+
+
+def _trigger_shutdown(engine, pulsars):
+    par, toas = pulsars[0]
+    eng = TimingEngine(
+        max_batch=2, max_wait_ms=2.0, inflight=1, replicas=1,
+    )
+    eng.close(timeout=60)
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(ResidualsRequest(par=par, toas=toas)).result(
+            timeout=60
+        )
+    return ei.value
+
+
+def _trigger_no_replica(engine, pulsars):
+    # every replica excluded (the re-route path ran out of fabric):
+    # the dispatch sheds typed instead of hanging
+    work, futs = _targeted_work(engine, pulsars[:1])
+    work.excluded = {r.rid for r in engine.pool.replicas}
+    engine._dispatch(work)
+    with pytest.raises(RequestRejected) as ei:
+        futs[0].result(timeout=60)
+    return ei.value
+
+
+@pytest.mark.parametrize("reason,trigger", [
+    ("queue-full", _trigger_queue_full),
+    ("deadline", _trigger_deadline),
+    ("quota", _trigger_quota),
+    ("shutdown", _trigger_shutdown),
+    ("no-replica", _trigger_no_replica),
+])
+def test_rejection_reason_table(engine, pulsars, reason, trigger):
+    """Pin the typed-rejection contract clients switch on: every
+    documented reason is reachable, its string is stable, and
+    docs/serving.md carries its table row."""
+    exc = trigger(engine, pulsars)
+    assert exc.reason == reason
+    assert f"request rejected ({reason})" in str(exc)
+    assert isinstance(exc, PintTpuError)
+    doc = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "serving.md",
+    )
+    with open(doc) as f:
+        assert f"`{reason}`" in f.read(), (
+            f"docs/serving.md must document RequestRejected "
+            f"reason {reason!r}"
+        )

@@ -1,0 +1,272 @@
+"""Warm-restart ledger suite (ISSUE 11): serve/warm_ledger.py.
+
+The crash-safe warm-state contract on the virtual 8-device CPU mesh:
+
+- **round trip** — traffic through a ledgered engine records exactly
+  the warmed (composition, op, bucket) x (capacity, placement) surface
+  (write-through at the traced_jit first trace); a FRESH engine booted
+  on the same ledger replays it (``serve.warm.replayed``) and then
+  serves the prior traffic mix with ZERO live traces;
+- **degradation** — a corrupted, truncated, or version-stale ledger
+  (or sidecar) is a clean COLD boot: ``serve.warm.stale`` /
+  ``serve.warm.failed`` count it, nothing crashes, traffic still
+  serves;
+- **enablement** — the ledger is explicit opt-in
+  (``PINT_TPU_SERVE_WARM_LEDGER`` / the ``warm_ledger=`` kwarg);
+  disabled engines register nothing and write nothing;
+- **write-through safety** — :func:`note_warm` never raises into the
+  dispatch path: a failing ledger costs warm state, not a request.
+"""
+
+import json
+import os
+
+import pytest
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.serve import ResidualsRequest, TimingEngine
+from pint_tpu.serve import warm_ledger as wlmod
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """
+PSR              J0101+01{i:02d}
+F0               {f0}  1
+F1               -1.3e-15           1
+PEPOCH           55000
+DM               {dm}             1
+"""
+
+
+def _pulsar(i, f0, dm, n, seed):
+    m, t = make_test_pulsar(
+        PAR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+        iterations=1,
+    )
+    return m.as_parfile(), t
+
+
+@pytest.fixture(scope="module")
+def pulsars():
+    return [
+        _pulsar(0, 113.7, 9.0, 40, 21),
+        _pulsar(1, 187.1, 17.0, 48, 22),
+    ]
+
+
+ENGINE_KW = dict(max_batch=4, max_wait_ms=2.0, inflight=1, replicas=1)
+
+
+def _counter(name):
+    return obs_metrics.counter(name).value
+
+
+def _drive(eng, pulsars):
+    """Warm capacities 1 and 2 DETERMINISTICALLY (targeted assembly
+    through the engine's own chokepoints — collector batching jitter
+    must not decide what the ledger records)."""
+    from tools.chaos import _targeted_work
+
+    for group in ([pulsars[0]], pulsars[:2]):
+        work, futs = _targeted_work(eng, group)
+        eng._dispatch(work)
+        for f in futs:
+            f.result(timeout=600)
+
+
+# -- the round trip --------------------------------------------------------
+def test_round_trip_records_then_replays_trace_free(tmp_path, pulsars):
+    lp = str(tmp_path / "warm-ledger.json")
+    rec0 = _counter("serve.warm.recorded")
+
+    eng = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        _drive(eng, pulsars)
+    finally:
+        eng.close(timeout=60)
+
+    # the ledger is exactly the warmed surface: one residuals entry of
+    # the shared composition, caps {1, 2}, single placement
+    assert _counter("serve.warm.recorded") - rec0 >= 1
+    with open(lp) as f:
+        doc = json.load(f)
+    assert doc["version"] == wlmod.LEDGER_VERSION
+    (entry,) = doc["entries"].values()
+    assert entry["op"] == "residuals"
+    assert entry["caps"] == [1, 2]
+    assert entry["placements"] == ["single"]
+    assert os.path.exists(tmp_path / entry["sidecar"])
+
+    # generation 2: boot replays (replay traces are allowed — they hit
+    # the persistent XLA cache), then the SAME mix runs trace-free
+    rep0 = _counter("serve.warm.replayed")
+    eng2 = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        assert _counter("serve.warm.replayed") - rep0 == 2  # caps 1, 2
+        t0 = _counter("compile.traces")
+        _drive(eng2, pulsars)
+        for f in eng2.submit_many([
+            ResidualsRequest(par=p, toas=t) for p, t in pulsars
+        ]):
+            f.result(timeout=600)
+        assert _counter("compile.traces") - t0 == 0
+    finally:
+        eng2.close(timeout=60)
+
+
+def test_replay_respects_capacity_ceiling(tmp_path, pulsars):
+    """A gen-2 engine with a SMALLER max batch skips ledgered
+    capacities it could never serve instead of warming dead kernels."""
+    lp = str(tmp_path / "warm-ledger.json")
+    eng = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        _drive(eng, pulsars)  # caps 1 and 2
+    finally:
+        eng.close(timeout=60)
+    rep0 = _counter("serve.warm.replayed")
+    kw = dict(ENGINE_KW, max_batch=1)
+    eng2 = TimingEngine(warm_ledger=lp, **kw)
+    try:
+        assert _counter("serve.warm.replayed") - rep0 == 1  # cap 1 only
+    finally:
+        eng2.close(timeout=60)
+
+
+# -- degradation: every bad ledger is a clean cold boot --------------------
+@pytest.mark.parametrize("payload", [
+    "{ not json at all",
+    json.dumps({"version": wlmod.LEDGER_VERSION + 99, "entries": {}}),
+    json.dumps({"version": wlmod.LEDGER_VERSION,
+                "entries": {"x": {"not": "an entry"}}}),
+])
+def test_bad_ledger_degrades_to_cold_boot(tmp_path, pulsars, payload):
+    lp = str(tmp_path / "warm-ledger.json")
+    with open(lp, "w") as f:
+        f.write(payload)
+    s0 = _counter("serve.warm.stale")
+    eng = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        assert _counter("serve.warm.stale") - s0 == 1
+        # cold but healthy: traffic serves, and the write-through then
+        # REPLACES the bad ledger with a good one
+        par, toas = pulsars[0]
+        res = eng.submit(
+            ResidualsRequest(par=par, toas=toas)
+        ).result(timeout=600)
+        assert res.ntoa == toas.ntoas
+    finally:
+        eng.close(timeout=60)
+    with open(lp) as f:
+        assert json.load(f)["version"] == wlmod.LEDGER_VERSION
+
+
+def test_bad_sidecar_skips_entry_never_crashes(tmp_path, pulsars):
+    lp = str(tmp_path / "warm-ledger.json")
+    eng = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        _drive(eng, pulsars)
+    finally:
+        eng.close(timeout=60)
+    with open(lp) as f:
+        (entry,) = json.load(f)["entries"].values()
+    with open(tmp_path / entry["sidecar"], "wb") as f:
+        f.write(b"\x00corrupt, not a pickle")
+    f0 = _counter("serve.warm.failed")
+    rep0 = _counter("serve.warm.replayed")
+    eng2 = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        assert _counter("serve.warm.failed") - f0 >= 1
+        assert _counter("serve.warm.replayed") - rep0 == 0
+        par, toas = pulsars[0]
+        eng2.submit(ResidualsRequest(par=par, toas=toas)).result(
+            timeout=600
+        )
+    finally:
+        eng2.close(timeout=60)
+
+
+# -- enablement ------------------------------------------------------------
+def test_ledger_path_resolution(monkeypatch, tmp_path):
+    monkeypatch.delenv("PINT_TPU_SERVE_WARM_LEDGER", raising=False)
+    # disabled spellings
+    assert wlmod.ledger_path(False) is None
+    assert wlmod.ledger_path(None) is None  # env unset
+    for off in ("0", "off", "no", "false", ""):
+        assert wlmod.ledger_path(off) is None
+    # an explicit path IS the path; True selects the default location
+    p = str(tmp_path / "l.json")
+    assert wlmod.ledger_path(p) == p
+    dflt = wlmod.ledger_path(True)
+    assert dflt is not None and dflt.endswith("serve-warm-ledger.json")
+    # env enables when the kwarg is unset; the kwarg beats the env
+    monkeypatch.setenv("PINT_TPU_SERVE_WARM_LEDGER", p)
+    assert wlmod.ledger_path(None) == p
+    assert wlmod.ledger_path(False) is None
+
+
+def test_disabled_engine_registers_nothing(tmp_path, pulsars,
+                                           monkeypatch):
+    monkeypatch.delenv("PINT_TPU_SERVE_WARM_LEDGER", raising=False)
+    rec0 = _counter("serve.warm.recorded")
+    eng = TimingEngine(warm_ledger=False, **ENGINE_KW)
+    try:
+        assert eng._ledger is None
+        par, toas = pulsars[0]
+        eng.submit(ResidualsRequest(par=par, toas=toas)).result(
+            timeout=600
+        )
+    finally:
+        eng.close(timeout=60)
+    assert _counter("serve.warm.recorded") == rec0
+    assert list(tmp_path.iterdir()) == []
+
+
+# -- write-through safety --------------------------------------------------
+def test_note_warm_never_raises_into_dispatch():
+    """A broken ledger (unwritable path, malformed session) costs warm
+    state and a ``serve.warm.failed`` tick — never a dispatch."""
+    led = wlmod.WarmLedger(os.path.join(os.sep, "proc", "nonexistent",
+                                        "nope", "ledger.json"))
+    wlmod.register(led)
+    f0 = _counter("serve.warm.failed")
+    try:
+        class _Sess:
+            cid = "deadbeef"
+            founder_par = "PSR FAKE"
+
+            class cm:  # missing bundle attrs -> sidecar write fails
+                pass
+
+        wlmod.note_warm(
+            _Sess(), ("residuals", "deadbeef", 64, True), 1, "r0"
+        )
+    finally:
+        wlmod.unregister(led)
+    assert _counter("serve.warm.failed") - f0 == 1
+
+
+def test_ledger_lru_bounds_entries(tmp_path):
+    """The entry LRU caps the boot-replay surface at MAX_ENTRIES."""
+    led = wlmod.WarmLedger(str(tmp_path / "l.json"))
+
+    class _Sess:
+        def __init__(self, cid):
+            self.cid = cid
+            self.founder_par = f"PSR {cid}"
+
+            class _CM:
+                bundle = {"x": 1}
+                tzr_bundle = None
+
+            self.cm = _CM()
+
+    for i in range(wlmod.MAX_ENTRIES + 5):
+        led.record(
+            _Sess(f"c{i:03d}"), ("residuals", f"c{i:03d}", 64, True),
+            1, "r0",
+        )
+    entries = led.load()
+    assert len(entries) == wlmod.MAX_ENTRIES
+    # oldest evicted, newest retained
+    cids = {e["cid"] for e in entries}
+    assert "c000" not in cids
+    assert f"c{wlmod.MAX_ENTRIES + 4:03d}" in cids

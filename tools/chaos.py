@@ -1,0 +1,484 @@
+"""Whole-fabric chaos harness (ISSUE 11): deterministic fault sweep.
+
+Reference parity: none — TPU-service operability infrastructure.
+
+Enumerates every replica/gang-tagged guard site in a serving fabric
+(the executor tags ``rN``/``gN`` that suffix ``serve:*@rN`` /
+``serve:*@gN`` sites) and, for each fault class the deterministic
+injector knows (:mod:`pint_tpu.runtime.faults` — ``hang``, ``nan``,
+``transient``, ``413``), drives mixed traffic while the fault is
+pinned to ONE executor and asserts the operability contract:
+
+- **every future resolves typed** — completed, ``RequestRejected``
+  with a documented reason (docs/serving.md), or a ``PintTpuError``
+  subclass; never a bare hang, never an untyped crash;
+- **health kinds quarantine and readmit** — ``hang``/``transient``
+  (watchdog class) and ``nan`` (numerics class) trip the replica
+  health machine to QUARANTINED, and once the fault clears the canary
+  prober re-admits it to LIVE;
+- **deterministic kinds stay healthy** — ``413`` (transport class)
+  fails the batch typed with NO health damage and NO re-route storm;
+- **zero steady-state retraces** — every leg runs against pre-warmed
+  kernels on every executor, so ``compile.traces`` and
+  ``compile.recompiles`` stay flat while faults fire and batches
+  re-route.
+
+A final **kill-and-restart leg** exercises the warm-restart ledger
+(serve/warm_ledger.py) under load: an engine is killed mid-traffic
+(every orphaned future must resolve typed — completed or
+``RequestRejected('shutdown')``), then restarted against the same
+ledger, and the replayed pre-warm must absorb the prior traffic mix
+with ZERO fresh XLA compiles (persistent-compile-cache hits only) and
+zero live traces under post-restart traffic.
+
+Determinism: the harness is driven exclusively by the deterministic
+:func:`pint_tpu.runtime.faults.inject` spec grammar (the same
+``PINT_TPU_FAULTS`` engine, armed programmatically per leg) — it
+imports no randomness source and fixes every simulation seed, so a
+failing leg replays bit-identically (pintlint rule obs8 machine
+-checks this).  Legs target executors DIRECTLY — each targeted batch
+is assembled by the engine's own stacking chokepoint and force
+-submitted to the tagged replica — so coverage of every tag is by
+construction, not by hoping the sticky router happens to place a key
+there.
+
+Entry points: :func:`run_sweep` (the full matrix, returns a report
+dict), ``python -m tools.chaos`` (one JSON line per leg; the
+``chaos`` config of profiling/run_benchmarks.py and
+profiling/chaos_sweep.py wrap it).  Workflow: docs/robustness.md
+"fleet operability".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from concurrent.futures import Future, TimeoutError as FutureTimeout
+
+# the harness is pure host orchestration: heavyweight pint_tpu imports
+# happen inside functions so `python -m tools.lint` and the pintlint
+# AST pass can import this module cheaply
+
+HEALTH_KINDS = ("hang", "transient", "nan")  # quarantine + readmit
+DETERMINISTIC_KINDS = ("413",)  # typed failure, no health damage
+ALL_KINDS = HEALTH_KINDS + DETERMINISTIC_KINDS
+
+
+# -- deterministic fleets ---------------------------------------------------
+def build_fleet(npsr: int = 3):
+    """Small same-composition pulsars (one 64-TOA bucket): the single
+    -replica traffic class.  Fixed seeds — the sweep is replayable."""
+    from pint_tpu.simulation import make_test_pulsar
+
+    pulsars = []
+    for i in range(npsr):
+        par = (
+            f"PSR C{i:02d}\nF0 {170 + 7 * i}.25 1\nF1 -1.1e-15 1\n"
+            f"PEPOCH 55000\nDM {5 + 1.7 * i:.2f} 1\n"
+        )
+        m, toas = make_test_pulsar(
+            par, ntoa=40 + 8 * i, start_mjd=54000.0, end_mjd=56000.0,
+            seed=100 + i, iterations=1,
+        )
+        pulsars.append((m.as_parfile(), toas))
+    return pulsars
+
+
+def build_big(ntoa: int = 600):
+    """One big pulsar (1024-TOA bucket, past the default gang
+    threshold when the pool has gangs): the gang traffic class."""
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR CBIG\nF0 305.5 1\nF1 -2.2e-15 1\n"
+        "PEPOCH 55000\nDM 21.4 1\n"
+    )
+    m, toas = make_test_pulsar(
+        par, ntoa=ntoa, start_mjd=53000.0, end_mjd=57000.0,
+        seed=991, iterations=1,
+    )
+    return (m.as_parfile(), toas)
+
+
+# -- harness plumbing -------------------------------------------------------
+def _wait_for(cond, timeout: float = 60.0, tick: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+def classify(futures, timeout: float = 120.0) -> dict:
+    """Resolve every future and bucket its outcome by TYPE.  The
+    operability contract is ``unresolved == 0 and untyped == {}`` —
+    anything else is a chaos-sweep failure."""
+    from pint_tpu.exceptions import PintTpuError, RequestRejected
+
+    out = {
+        "offered": len(futures), "completed": 0, "rejected": {},
+        "failed": {}, "untyped": {}, "unresolved": 0,
+    }
+    for f in futures:
+        try:
+            f.result(timeout=timeout)
+            out["completed"] += 1
+        except RequestRejected as e:
+            out["rejected"][e.reason] = out["rejected"].get(
+                e.reason, 0) + 1
+        except PintTpuError as e:
+            name = type(e).__name__
+            out["failed"][name] = out["failed"].get(name, 0) + 1
+        except FutureTimeout:
+            out["unresolved"] += 1
+        except BaseException as e:  # the contract violation bucket
+            name = type(e).__name__
+            out["untyped"][name] = out["untyped"].get(name, 0) + 1
+    out["typed"] = out["unresolved"] == 0 and not out["untyped"]
+    return out
+
+
+def _targeted_work(engine, pulsars):
+    """Assemble one residuals batch through the engine's OWN admission
+    + stacking chokepoints (record/session/bundle resolution exactly
+    as ``_admit`` does, then ``_assemble``), but do not route it —
+    the caller force-submits it to a specific executor.  Returns
+    ``(work, futures)``."""
+    from pint_tpu.serve.api import ResidualsRequest
+    from pint_tpu.serve.engine import _Pending
+    from pint_tpu.serve import batcher as bmod
+    from pint_tpu.toas.bundle import make_bundle
+    from pint_tpu.toas.ingest import ingest_for_model
+
+    live = []
+    key = None
+    for par, toas in pulsars:
+        req = ResidualsRequest(par=par, toas=toas)
+        req.validate()
+        p = _Pending(req, Future(), time.monotonic())
+        rec = engine.sessions.record_for(par)
+        if toas.t_tdb is None:
+            ingest_for_model(toas, rec.model)
+        nb = make_bundle(
+            toas, rec.model._build_masks(toas), as_numpy=True,
+        )
+        sess = engine.sessions.session_for(
+            rec, toas, nb, engine.min_bucket
+        )
+        p.record, p.session = rec, sess
+        p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
+        key = (
+            "residuals", sess.composition, sess.bucket,
+            bool(req.subtract_mean),
+        )
+        live.append(p)
+    work = engine._assemble(key, live)
+    return work, [p.future for p in live]
+
+
+def _submit_targeted(engine, rep, pulsars):
+    """Force-submit one targeted batch at the tagged executor; if it
+    stopped accepting (already quarantined mid-leg), fall back to the
+    engine's router so the members still resolve typed."""
+    work, futs = _targeted_work(engine, pulsars)
+    if not rep.submit(work, block=False, force=True):
+        engine._dispatch(work)
+    return futs
+
+
+def executor_sites(engine) -> list:
+    """Every replica/gang-tagged guard-site handle in the fabric: the
+    ``@tag`` suffix that scopes ``serve:*@rN`` / ``serve:*@gN`` fault
+    specs to one executor."""
+    return [
+        {"tag": r.tag, "site": f"@{r.tag}", "width": r.width,
+         "rid": r.rid}
+        for r in engine.pool.replicas
+    ]
+
+
+def warm_executors(engine, small, big, timeout: float = 600.0):
+    """Pre-warm EVERY executor before any fault leg: canary kernels
+    (one probe each) plus BOTH traffic classes — small residuals at
+    caps 1 and 2, big residuals at cap 1 — on every executor, not
+    just its preferred class: when a leg quarantines the last member
+    of a size class the router falls back to the whole pool
+    (fabric/router.py::_usable_locked), and the zero-steady-retrace
+    assertion only holds if those fallback targets are warm too."""
+    futs = []
+    for rep in engine.pool.replicas:
+        if not rep.probe():
+            raise RuntimeError(f"pre-leg canary failed on {rep.tag}")
+        for wave in ([small[0]], small[:2], [big]):
+            futs.extend(_submit_targeted(engine, rep, wave))
+    res = classify(futs, timeout)
+    if res["completed"] != res["offered"]:
+        raise RuntimeError(f"executor warm-up failed: {res}")
+    return res
+
+
+# -- the fault legs ---------------------------------------------------------
+def run_leg(engine, tag: str, kind: str, *, small, big,
+            hang_seconds: float = 1.5, batches: int = 3,
+            background: int = 4, timeout: float = 120.0) -> dict:
+    """One (executor, fault-kind) leg: arm ``kind`` at every guard
+    site of ``tag``, drive targeted + background traffic, classify
+    every future, and watch the health machine."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import faults, guard
+    from pint_tpu.serve import ResidualsRequest
+    from pint_tpu.serve.fabric.replica import LIVE, QUARANTINED
+
+    rep = next(r for r in engine.pool.replicas if r.tag == tag)
+    health = kind in HEALTH_KINDS
+    traffic = [big] if rep.width > 1 else small[:2]
+    traces0 = obs_metrics.counter("compile.traces").value
+    rec0 = obs_metrics.counter("compile.recompiles").value
+    q0 = obs_metrics.counter("serve.fabric.quarantines").value
+    r0 = obs_metrics.counter("serve.fabric.readmits").value
+
+    # hang legs tighten the dispatch watchdog so a pinned hang trips
+    # in ~0.4 s instead of the production timeout; every leg disables
+    # guard retries so quarantine_n failures accumulate immediately
+    gkw = {"max_retries": 0}
+    if kind == "hang":
+        gkw.update(compile_timeout=20.0, dispatch_timeout=0.4)
+    spec = f"{kind}:inf@@{tag}"
+    futs = []
+    with guard.configured(**gkw):
+        with faults.inject(spec, hang_seconds=hang_seconds) as plan:
+            for _ in range(batches):
+                futs.extend(_submit_targeted(engine, rep, traffic))
+            futs.extend(
+                engine.submit(ResidualsRequest(par=p, toas=t))
+                for p, t in (small * 2)[:background]
+            )
+            outcomes = classify(futs, timeout)
+            quarantined = (
+                _wait_for(lambda: rep.state == QUARANTINED, timeout)
+                if health else rep.state == QUARANTINED
+            )
+            fired = len(plan.fired)
+    # fault cleared: the canary prober must readmit health-tripped
+    # executors; deterministic kinds must never have left LIVE
+    readmitted = _wait_for(lambda: rep.state == LIVE, timeout)
+    leg = {
+        "tag": tag, "kind": kind, "fired": fired,
+        "outcomes": outcomes,
+        "quarantined": quarantined, "readmitted": readmitted,
+        "quarantines": (
+            obs_metrics.counter("serve.fabric.quarantines").value - q0
+        ),
+        "readmits": (
+            obs_metrics.counter("serve.fabric.readmits").value - r0
+        ),
+        "steady_traces": (
+            obs_metrics.counter("compile.traces").value - traces0
+        ),
+        "steady_retraces": (
+            obs_metrics.counter("compile.recompiles").value - rec0
+        ),
+    }
+    leg["ok"] = bool(
+        outcomes["typed"]
+        and fired > 0
+        and leg["steady_traces"] == 0
+        and leg["steady_retraces"] == 0
+        and readmitted
+        and (
+            (quarantined and leg["readmits"] >= 1) if health
+            else (not quarantined and leg["quarantines"] == 0
+                  and sum(outcomes["failed"].values()) > 0)
+        )
+    )
+    return leg
+
+
+# -- the kill-and-restart leg ----------------------------------------------
+def restart_leg(small, ledger_path: str, *, engine_kw: dict,
+                wave: int = 6, timeout: float = 600.0) -> dict:
+    """Exercise the warm-restart ledger under load: generation 1
+    warms the capacity ladder and records the ledger, is killed with
+    a wave still in flight (every orphan resolves typed), and
+    generation 2 must replay to warmth with zero fresh XLA compiles
+    and zero live traces under the same traffic mix."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import compile_cache
+    from pint_tpu.serve import ResidualsRequest, TimingEngine
+
+    def _wave(eng, n):
+        return [
+            eng.submit(ResidualsRequest(
+                par=small[i % len(small)][0],
+                toas=small[i % len(small)][1],
+            ))
+            for i in range(n)
+        ]
+
+    # generation 1: warm caps 1 and 2 DETERMINISTICALLY (targeted
+    # assembly dispatched through the router — collector batching
+    # jitter must not decide which capacities the ledger records),
+    # record the ledger, then die mid-traffic
+    eng = TimingEngine(warm_ledger=ledger_path, **engine_kw)
+    wfuts = []
+    for group in ([small[0]], small[:2]):
+        work, futs = _targeted_work(eng, group)
+        eng._dispatch(work)
+        wfuts.extend(futs)
+    warm = classify(wfuts, timeout)
+    inflight = _wave(eng, wave)
+    eng.close(timeout=timeout)
+    killed = classify(inflight, timeout=30.0)
+    killed_typed = bool(
+        killed["typed"] and not killed["failed"]
+        and set(killed["rejected"]) <= {"shutdown"}
+    )
+
+    # generation 2: boot replays the ledger (replay traces hit the
+    # persistent XLA compile cache — no fresh compile work), then the
+    # same mix must run trace-free
+    xla0 = compile_cache.entry_count()
+    t0 = obs_metrics.counter("compile.traces").value
+    rep0 = obs_metrics.counter("serve.warm.replayed").value
+    eng2 = TimingEngine(warm_ledger=ledger_path, **engine_kw)
+    replay_traces = obs_metrics.counter("compile.traces").value - t0
+    replayed = (
+        obs_metrics.counter("serve.warm.replayed").value - rep0
+    )
+    t1 = obs_metrics.counter("compile.traces").value
+    steady = classify(_wave(eng2, 1) + _wave(eng2, 2) + _wave(eng2, wave),
+                      timeout)
+    fresh_traces = obs_metrics.counter("compile.traces").value - t1
+    xla1 = compile_cache.entry_count()
+    eng2.close(timeout=timeout)
+    leg = {
+        "tag": "restart", "kind": "kill-restart",
+        "warm": warm, "killed": killed, "killed_typed": killed_typed,
+        "replay_traces": replay_traces, "replayed": replayed,
+        "steady": steady, "fresh_traces": fresh_traces,
+        "xla_new_entries": (
+            None if xla0 is None or xla1 is None else xla1 - xla0
+        ),
+    }
+    leg["ok"] = bool(
+        warm["completed"] == warm["offered"]
+        and killed_typed
+        and replayed >= 1
+        and fresh_traces == 0
+        and steady["completed"] == steady["offered"]
+        and (leg["xla_new_entries"] in (None, 0))
+    )
+    return leg
+
+
+# -- the sweep --------------------------------------------------------------
+def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
+              replicas: int | None = None, gangs: int | None = None,
+              gang_size: int | None = None,
+              hang_seconds: float = 1.5, restart: bool = True,
+              ledger_dir: str | None = None,
+              time_budget_s: float | None = None,
+              timeout: float = 120.0) -> dict:
+    """The full chaos matrix: one leg per (executor tag, fault kind)
+    over a mixed single/gang fabric, plus the kill-and-restart leg.
+    Returns the report dict ``python -m tools.chaos`` prints.
+
+    ``time_budget_s`` bounds the FAULT-leg portion (the profiling
+    ``chaos`` config's ~60 s envelope): legs past the budget are
+    reported as ``{"skipped": True}`` rows — an explicit record of
+    what was NOT exercised, never a silent cap — and the restart leg
+    always runs."""
+    from pint_tpu.obs.export import flight_report
+    from pint_tpu.serve import TimingEngine
+
+    small = build_fleet(npsr)
+    big = build_big()
+    engine = TimingEngine(
+        max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
+        replicas=replicas, gangs=gangs, gang_size=gang_size,
+        gang_threshold=512 if gangs else None,
+        quarantine_n=2, probe_ms=50, warm_ledger=False,
+    )
+    legs = []
+    t_start = time.monotonic()
+    try:
+        sites = executor_sites(engine)
+        warm_executors(engine, small, big, timeout=max(timeout, 600.0))
+        for site in sites:
+            for kind in kinds:
+                if (time_budget_s is not None
+                        and time.monotonic() - t_start > time_budget_s):
+                    legs.append({
+                        "tag": site["tag"], "kind": kind,
+                        "skipped": True, "ok": True,
+                    })
+                    continue
+                legs.append(run_leg(
+                    engine, site["tag"], kind, small=small, big=big,
+                    hang_seconds=hang_seconds, timeout=timeout,
+                ))
+        report_text = flight_report()
+    finally:
+        engine.close()
+    if restart:
+        lp = os.path.join(
+            ledger_dir or tempfile.mkdtemp(prefix="pint-tpu-chaos-"),
+            "chaos-warm-ledger.json",
+        )
+        legs.append(restart_leg(
+            small, lp,
+            engine_kw=dict(
+                max_batch=2, max_wait_ms=2.0, inflight=1,
+                replicas=replicas, prewarm=True,
+            ),
+            timeout=max(timeout, 600.0),
+        ))
+    return {
+        "executors": [s["tag"] for s in sites],
+        "legs": legs,
+        "skipped": sum(1 for leg in legs if leg.get("skipped")),
+        "ok": all(leg["ok"] for leg in legs),
+        "flight_has_quarantine": "quarantines" in report_text,
+        "flight_has_readmit": "readmits" in report_text,
+    }
+
+
+def main(argv=None) -> int:
+    """CLI: one JSON line per leg + a final summary line."""
+    import argparse
+
+    import jax
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kinds", default=",".join(ALL_KINDS))
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--gangs", type=int, default=None)
+    ap.add_argument("--gang-size", type=int, default=None)
+    ap.add_argument("--no-restart", action="store_true")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    report = run_sweep(
+        kinds=tuple(k for k in args.kinds.split(",") if k),
+        replicas=args.replicas, gangs=args.gangs,
+        gang_size=args.gang_size, restart=not args.no_restart,
+        timeout=args.timeout,
+    )
+    for leg in report["legs"]:
+        print(json.dumps({
+            "bench": "chaos", "backend": jax.default_backend(), **leg,
+        }))
+    print(json.dumps({
+        "bench": "chaos", "summary": True,
+        "backend": jax.default_backend(),
+        "executors": report["executors"], "ok": report["ok"],
+        "flight_has_quarantine": report["flight_has_quarantine"],
+        "flight_has_readmit": report["flight_has_readmit"],
+    }))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

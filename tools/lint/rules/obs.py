@@ -42,6 +42,15 @@ nothing at runtime can notice the absence.
   machine and emit the gang-state event, the mesh-wide canary
   dispatches through ``dispatch_guard``, and gang membership/sharding
   fields declare ``# lint: guarded-by(...)`` lock discipline.
+- ``obs8`` — fleet-operability chokepoints (ISSUE 11): the warm
+  -ledger write-through stays wired at ``traced_jit`` (failure
+  -counted, never raised into the trace path), the boot replay runs
+  span-instrumented through ``ReplicaPool.prewarm`` /
+  ``Replica.prewarm_kernel`` before the collector starts, quota
+  admission sheds stay typed + event-instrumented, and the chaos
+  entry (``tools/chaos.py``) stays DETERMINISTIC — driven by
+  ``faults.inject`` (the ``PINT_TPU_FAULTS`` grammar) with no
+  randomness imports, so a failing leg replays bit-identically.
 """
 
 from __future__ import annotations
@@ -272,6 +281,35 @@ _GANG_CHECKS = (
 )
 
 
+_OPERABILITY_CHECKS = (
+    ("serve/session.py", "traced_jit", ("note_warm(",),
+     "the warm-restart ledger's write-through must stay wired at the "
+     "serve dispatch chokepoint (first trace of a warmed kernel "
+     "records its (key, capacity, placement); serve/warm_ledger.py)"),
+    ("serve/warm_ledger.py", "note_warm", ("serve.warm.failed",),
+     "ledger write-through failures must be counted "
+     "(serve.warm.failed), never raised into the trace path"),
+    ("serve/engine.py", "TimingEngine.__init__",
+     ("replay_jobs(", "TRACER.span"),
+     "the engine boot replay must run under the serve:warm-replay "
+     "span BEFORE the collector starts (Replica.prewarm_kernel's "
+     "boot-thread safety contract)"),
+    ("serve/engine.py", "TimingEngine._check_quota",
+     ("TRACER.event", "RequestRejected"),
+     "quota admission sheds must stay typed "
+     "(RequestRejected('quota')) and event-instrumented"),
+    ("serve/fabric/pool.py", "ReplicaPool.prewarm",
+     ("TRACER.span", "prewarm_kernel(", "serve.warm.replayed"),
+     "the boot-time warm-ledger replay must stay span-instrumented "
+     "and counted per replayed kernel"),
+    ("serve/fabric/replica.py", "Replica.prewarm_kernel",
+     ("TRACER.span", "_kernel_for("),
+     "the replica pre-warm dispatch must stay span-instrumented and "
+     "route through the per-replica kernel cache — the same "
+     "traced_jit-guarded path live traffic uses"),
+)
+
+
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
     if not subdir.is_dir():
         return []
@@ -381,6 +419,78 @@ class Obs7Rule(Rule):
         )
 
 
+class Obs8Rule(Rule):
+    """Fleet-operability chokepoints (ISSUE 11): warm-ledger
+    write-through + boot replay instrumented, quota sheds typed, the
+    chaos entry deterministic (faults.inject only, no randomness)."""
+
+    name = "obs8"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the ledger module itself: fixture packages that
+        # predate the operability subsystem skip (obs7 convention)
+        if not (pkg_root / "serve" / "warm_ledger.py").is_file():
+            return []
+        findings = _run_checks(
+            self.name, pkg_root, _OPERABILITY_CHECKS,
+            pkg_root / "serve",
+        )
+        findings += self._chaos_entry(pkg_root)
+        return findings
+
+    def _chaos_entry(self, pkg_root: Path) -> list:
+        """The chaos harness rides outside the package
+        (<repo>/tools/chaos.py, next to this linter): it must exist
+        alongside the ledger subsystem, drive faults exclusively
+        through the deterministic ``faults.inject`` spec grammar, and
+        import no randomness source — a failing chaos leg that cannot
+        be replayed bit-identically is not a diagnosis, it is a
+        flake."""
+        chaos = pkg_root.parent / "tools" / "chaos.py"
+        if not chaos.is_file():
+            return [Finding(
+                self.name, str(chaos), 1,
+                "tools/chaos.py missing — the deterministic chaos "
+                "entry is part of the ISSUE 11 operability surface "
+                "(docs/robustness.md 'fleet operability')",
+            )]
+        src = chaos.read_text()
+        findings = []
+        if "faults.inject(" not in src:
+            findings.append(Finding(
+                self.name, str(chaos), 1,
+                "the chaos entry no longer arms faults through "
+                "faults.inject (the deterministic PINT_TPU_FAULTS "
+                "grammar) — ad-hoc fault injection cannot be "
+                "replayed from a spec string",
+            ))
+        for node in ast.walk(ast.parse(src)):
+            mods = ()
+            if isinstance(node, ast.Import):
+                mods = tuple(a.name for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                mods = (node.module or "",)
+            for m in mods:
+                if m.split(".")[0] in ("random", "secrets"):
+                    findings.append(Finding(
+                        self.name, str(chaos), node.lineno,
+                        f"chaos entry imports {m!r} — the sweep must "
+                        "be deterministic (fixed seeds + "
+                        "faults.inject specs) so failing legs "
+                        "replay bit-identically",
+                    ))
+        for needle in ("np.random.", "numpy.random."):
+            if needle in src:
+                findings.append(Finding(
+                    self.name, str(chaos), 1,
+                    f"chaos entry uses {needle}* — the sweep must "
+                    "be deterministic (fixed seeds + faults.inject "
+                    "specs) so failing legs replay bit-identically",
+                ))
+        return findings
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
@@ -388,7 +498,8 @@ OBS4 = Obs4Rule()
 OBS5 = Obs5Rule()
 OBS6 = Obs6Rule()
 OBS7 = Obs7Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7)
+OBS8 = Obs8Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
@@ -423,5 +534,6 @@ def check_chokepoints(pkg_root) -> list:
     findings += OBS5.check_project(pkg_root)
     findings += OBS6.check_project(pkg_root)
     findings += OBS7.check_project(pkg_root)
+    findings += OBS8.check_project(pkg_root)
     findings += _fit_decorators(pkg_root)
     return findings
