@@ -287,7 +287,7 @@ def _obs_block():
     return out
 
 
-def _fit_traj_block():
+def _fit_traj_block(t_dev=None):
     """Fused-trajectory telemetry for BENCH_*.json (ISSUE 9): a small
     downhill probe gates the tentpole invariant — ONE complete steady
     -state downhill fit (GN proposal + lambda ladder + noise-floor
@@ -347,6 +347,12 @@ def _fit_traj_block():
             os.environ["PINT_TPU_DOWNHILL_FUSED"] = saved
     return {
         "dispatches_per_fit": per_fit,
+        # the north-star per-step device cost next to the trajectory
+        # figures (ISSUE 12): fused_wall_ms / dev_step_ms ~ the
+        # host-side overhead share the fusion + donation path leaves
+        "dev_step_ms": (
+            None if t_dev is None else round(t_dev * 1e3, 4)
+        ),
         "fused_wall_ms": round(fused_wall * 1e3, 2),
         "host_wall_ms": round(host_wall * 1e3, 2),
         "host_dispatches_per_fit": host_dispatches,
@@ -414,7 +420,17 @@ def _serve_block():
     retraces; near-deadline requests must close their batch early
     (serve.slo.early_close), and the per-composition admission quota
     must shed a hot composition's surplus typed while keeping an
-    interactive composition's p99 bounded."""
+    interactive composition's p99 bounded.
+
+    ISSUE 12 adds the XKEY figure (_xkey_probe): co-resident
+    DISTINCT-key small batches on one replica served as one fused
+    device call (serve/fabric/replica.py::_fuse) — >= 2x fewer
+    guarded dispatches than the PINT_TPU_SERVE_XKEY_FUSE=0 hatch,
+    zero steady retraces either mode, bitwise-identical responses.
+    Buffer donation (PINT_TPU_DONATE) and transfer overlap
+    (PINT_TPU_SERVE_OVERLAP) run at their defaults (ON) throughout
+    this block, so every gate above also certifies the donation
+    snapshot/fence contract and the double-buffered dispatcher."""
     import jax
 
     from pint_tpu.exceptions import PintTpuError
@@ -962,10 +978,156 @@ def _serve_block():
             "hot_shed_quota_on": shed_on,
         }
 
+    def _xkey_probe():
+        """Cross-key fused dispatches (ISSUE 12): three distinct
+        small (key, capacity) identities made co-resident on ONE
+        replica must serve with >= 2x fewer guarded dispatches than
+        the unfused hatch (PINT_TPU_SERVE_XKEY_FUSE=0) at steady
+        state, ZERO steady retraces in both modes, and bitwise
+        -identical responses (the fused wrapper runs the members'
+        exact solo programs and de-multiplexes).
+
+        Co-residency is made DETERMINISTIC (the driver gate cannot
+        tolerate a scheduler race): each round submits a full PLUG
+        batch first — it pops with an empty queue, so it always
+        dispatches solo — and a one-shot hang fault stalls the
+        dispatcher inside that plug dispatch while the three small
+        -key batches close behind it.  The fuser then sees all three
+        at once, so the only combo that can ever form is the full
+        sorted 3-set: the warm rounds trace exactly the solos then
+        exactly that one combo wrapper, and steady rounds trace
+        nothing.  Both modes run the identical stall, and only
+        dispatch COUNTS are gated, so the fault never touches the
+        measured figure."""
+        import os
+
+        import numpy as np
+
+        from pint_tpu.runtime import faults
+        from pint_tpu.serve import ResidualsRequest
+        from pint_tpu.simulation import make_test_pulsar
+
+        pa, ta = pulsars[0]          # plug: residuals @ bucket 256
+        pb, tb = pulsars[1]          # small key 1: fit @ bucket 256
+        mc, tc = make_test_pulsar(   # small keys 2+3 @ bucket 128
+            "PSR X9\nF0 97.31 1\nF1 -1.4e-15 1\nPEPOCH 55000\n"
+            "DM 12.4 1\n", ntoa=100, start_mjd=54000.0,
+            end_mjd=56000.0, seed=77, iterations=1,
+        )
+        pc = mc.as_parfile()
+        nrounds = 3
+
+        def burst(e):
+            with faults.inject(
+                "hang:1@serve:residuals", hang_seconds=0.5
+            ):
+                fs = [
+                    e.submit(ResidualsRequest(par=pa, toas=ta))
+                    for _ in range(8)
+                ]
+                for _ in range(8):
+                    fs.append(e.submit(
+                        FitRequest(par=pb, toas=tb, maxiter=2)
+                    ))
+                    fs.append(e.submit(
+                        ResidualsRequest(par=pc, toas=tc)
+                    ))
+                    fs.append(e.submit(
+                        FitRequest(par=pc, toas=tc, maxiter=2)
+                    ))
+                return [f.result(timeout=3600) for f in fs]
+
+        g = obs_metrics.counter("dispatch.guarded")
+        tr = obs_metrics.counter("compile.traces")
+        out = {}
+        for mmode in ("on", "off"):
+            saved = os.environ.get("PINT_TPU_SERVE_XKEY_FUSE")
+            try:
+                if mmode == "off":
+                    os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = "0"
+                else:
+                    os.environ.pop("PINT_TPU_SERVE_XKEY_FUSE", None)
+                e = TimingEngine(
+                    replicas=1, max_batch=8, max_wait_ms=5.0,
+                    inflight=8, max_queue=256,
+                )
+                try:
+                    # two warm rounds: solos trace first, then (fused
+                    # mode) the one combo wrapper the solo-warm gate
+                    # admits
+                    for _ in range(2):
+                        burst(e)
+                    disp, traces, rounds_d = 0, 0, []
+                    results = []
+                    for _ in range(nrounds):
+                        g0, tr0 = g.value, tr.value
+                        results = burst(e)
+                        rounds_d.append(g.value - g0)
+                        disp += g.value - g0
+                        traces += tr.value - tr0
+                    out[mmode] = (disp, traces, rounds_d, results)
+                finally:
+                    e.close()
+            finally:
+                if saved is None:
+                    os.environ.pop(
+                        "PINT_TPU_SERVE_XKEY_FUSE", None
+                    )
+                else:
+                    os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = saved
+        disp_on, tr_on, rounds_on, res_on = out["on"]
+        disp_off, tr_off, _, res_off = out["off"]
+        if tr_on or tr_off:
+            raise PintTpuError(
+                f"{tr_on} (fused) / {tr_off} (solo) steady-state "
+                "trace(s) in the mixed-key probe — cross-key fusion "
+                "must only dispatch warmed combo wrappers "
+                "(serve/fabric/replica.py::_fuse; docs/serving.md)"
+            )
+        # the plug is exactly one known solo dispatch per round —
+        # subtract it so the ratio measures the fusible small keys
+        best_x = max(
+            (disp_off / nrounds - 1) / max(d - 1, 1)
+            for d in rounds_on
+        )
+        if best_x < 2.0:
+            raise PintTpuError(
+                f"mixed-key fusion reached only {best_x:.2f}x fewer "
+                "dispatches than the unfused hatch (>= 2.0x "
+                "required: N co-resident distinct-key batches must "
+                "serve as one fused device call; "
+                "serve/fabric/replica.py::_fuse, docs/serving.md)"
+            )
+        for a, b in zip(res_on, res_off):
+            if hasattr(a, "residuals_s"):
+                same = (np.array_equal(a.residuals_s, b.residuals_s)
+                        and a.chi2 == b.chi2)
+            else:
+                same = (np.array_equal(a.deltas, b.deltas)
+                        and a.chi2 == b.chi2)
+            if not same:
+                raise PintTpuError(
+                    "fused-mode response differs from the unfused "
+                    "hatch — cross-key fusion must de-multiplex "
+                    "bitwise-identically (the members' exact solo "
+                    "programs; serve/session.py::build_fused_kernel)"
+                )
+        return {
+            "fused_dispatches_per_round": round(
+                disp_on / nrounds, 2
+            ),
+            "solo_dispatches_per_round": round(
+                disp_off / nrounds, 2
+            ),
+            "dispatch_reduction_x": round(best_x, 2),
+            "steady_retraces": tr_on + tr_off,
+        }
+
     population = _population_probe()
     gang = _gang_probe()
     restart = _restart_probe()
     slo = _slo_probe()
+    xkey = _xkey_probe()
 
     r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
     r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
@@ -1019,6 +1181,7 @@ def _serve_block():
         "gang": gang,
         "restart": restart,
         "slo": slo,
+        "xkey": xkey,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
             tag: rs["batches"]
@@ -1074,7 +1237,7 @@ def main():
 
     guard_block = _guard_block(cm, step, mode, t_dev)
     obs_block = _obs_block()
-    fit_traj_block = _fit_traj_block()
+    fit_traj_block = _fit_traj_block(t_dev)
     serve_block = _serve_block()
 
     # CPU baseline: the all-f64 reference-class computation on host

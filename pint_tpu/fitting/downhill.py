@@ -47,7 +47,7 @@ from pint_tpu.fitting.gls import (
     make_cinv_mult,
 )
 from pint_tpu.fitting.wls import _wls_step
-from pint_tpu.runtime.guard import validate_finite
+from pint_tpu.runtime.guard import fence_owned, validate_finite
 
 
 def _ladder_lams(min_lambda: float):
@@ -316,7 +316,12 @@ class DownhillFitter(Fitter):
                 badp, badb, executed, nbads, floors,
             )
 
-        loop = self.cm.jit(downhill_traj)
+        # the scan state is donated (ISSUE 12): x0 is freshly built
+        # per fit_toas call (cm.x0()), the trajectory's x output
+        # aliases it in place, and the guard snapshots it before any
+        # replayable attempt — never reuse a loop argument after the
+        # call (pintlint rule perf1)
+        loop = self.cm.jit(downhill_traj, donate=True)
         self._fit_loops[key] = loop
         return loop
 
@@ -389,7 +394,11 @@ class DownhillFitter(Fitter):
                     force_f64, maxiter, required_chi2_decrease,
                     max_chi2_increase, min_lambda,
                 )
-                return ("fused", loop(self.cm.x0()))
+                # the loop donates its operands, so its outputs may
+                # alias recyclable buffers: materialize host-owned
+                # copies before anything downstream keeps a view
+                # (runtime/guard.py::fence_owned)
+                return ("fused", fence_owned(loop(self.cm.x0())))
 
             return thunk
 

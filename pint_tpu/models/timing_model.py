@@ -463,10 +463,24 @@ class CompiledModel:
         finally:
             self.ref = saved
 
-    def jit(self, fn):
+    def jit(self, fn, donate=False):
         """jax.jit(fn) with this model's TOA bundles AND numeric
         reference values passed as RUNTIME arguments instead of
         closure constants.
+
+        ``donate=True`` (ISSUE 12) marks the CALLER-VISIBLE operands —
+        the per-dispatch ``args``, e.g. the fused-downhill scan state —
+        as ``donate_argnums``: XLA aliases them into same-shaped
+        outputs (the x-in/x-out fit loop) and frees the rest at
+        dispatch instead of holding both copies live.  The cached
+        bundle/reference operands are NEVER donated — they ride every
+        call.  Donation is a per-call-fresh-operand contract: the
+        caller must not reuse an argument after the call (pintlint
+        rule perf1 flags use-after-donate statically), which is why it
+        is opt-in.  The guard snapshots donated operands it may need
+        to replay (runtime/guard.py::snapshot_donated), so the retry
+        ladder never reads a freed buffer.  ``PINT_TPU_DONATE=0``
+        disables donation everywhere at wrapper build time.
 
         A plain ``jax.jit`` over a CompiledModel method bakes every
         bundle column (and the precomputed Fourier basis riding in
@@ -514,9 +528,16 @@ class CompiledModel:
         import os
 
         from pint_tpu import obs as _obs
-        from pint_tpu.runtime.guard import dispatch_guard
+        from pint_tpu.runtime.guard import (
+            dispatch_guard,
+            donation_enabled,
+            quiet_unusable_donation,
+        )
 
         site = f"cm.jit:{getattr(fn, '__name__', 'fn')}"
+        donating = bool(donate) and donation_enabled()
+        if donating:
+            quiet_unusable_donation()
 
         # flight-recorder hooks (pint_tpu/obs): `noted` replaces fn in
         # the traced position, so its host side effect fires exactly
@@ -539,14 +560,22 @@ class CompiledModel:
             os.environ.get("PINT_TPU_BAKE_THRESHOLD", "200000")
         )
 
-        @jax.jit
-        def inner(bundles, refnum, args):
+        def _inner(bundles, refnum, args):
             old = (self.bundle, self.tzr_bundle)
             self.bundle, self.tzr_bundle = bundles
             try:
                 return self._ref_swap_call(noted, refnum, args)
             finally:
                 self.bundle, self.tzr_bundle = old
+
+        # donation covers ONLY position 2 — the caller's per-dispatch
+        # args; the bundle/reference pytrees (0, 1) are cached and
+        # reused across every call, so donating them would free the
+        # model out from under the next dispatch
+        inner = (
+            jax.jit(_inner, donate_argnums=(2,)) if donating
+            else jax.jit(_inner)
+        )
 
         _arg_bytes = [None]
 
@@ -635,11 +664,25 @@ class CompiledModel:
                     _clear_for_retrace()
                     # fresh closure each re-bake: jax's trace cache
                     # keys on function identity, so jit(fn) again
-                    # would serve the OLD bundle's baked trace
+                    # would serve the OLD bundle's baked trace.  The
+                    # donating variant takes the caller args as ONE
+                    # tuple operand so the donated position is static
+                    # regardless of arity.
                     baked[:] = [
                         self.bundle, self.tzr_bundle,
-                        jax.jit(lambda refnum, *a:
-                                self._ref_swap_call(noted, refnum, a)),
+                        (
+                            jax.jit(
+                                lambda refnum, a: self._ref_swap_call(
+                                    noted, refnum, a
+                                ),
+                                donate_argnums=(1,),
+                            )
+                            if donating
+                            else jax.jit(
+                                lambda refnum, *a:
+                                self._ref_swap_call(noted, refnum, a)
+                            )
+                        ),
                         _shape_sig((self.bundle, self.tzr_bundle)),
                     ]
                     # baked-literal transport pressure (near-413
@@ -672,6 +715,8 @@ class CompiledModel:
                         self._ref_runtime()
                     )
                 _obs.note_transfer(site, _const_bytes[0], args)
+                if donating:
+                    return _jitted()(self._ref_runtime(), args)
                 return _jitted()(self._ref_runtime(), *args)
 
             # AOT hook: lower against the CURRENT bundles/refs + mode
@@ -681,8 +726,17 @@ class CompiledModel:
                     self._ref_runtime(), args,
                 )
                 if mode[0] == "args"
-                else _jitted().lower(self._ref_runtime(), *args)
+                else (
+                    _jitted().lower(self._ref_runtime(), args)
+                    if donating
+                    else _jitted().lower(self._ref_runtime(), *args)
+                )
             )
+            if donating:
+                # every caller-visible position is donated (they all
+                # land in the donated inner operand) — the guard's
+                # retry snapshot marker (runtime/guard.py)
+                rebaking._donate_argnums = True
             return dispatch_guard(rebaking, site)
 
         @functools.wraps(fn)
@@ -693,6 +747,8 @@ class CompiledModel:
         wrapped.lower = lambda *args: inner.lower(
             (self.bundle, self.tzr_bundle), self._ref_runtime(), args
         )
+        if donating:
+            wrapped._donate_argnums = True
         return dispatch_guard(wrapped, site)
 
     # -- pdict construction (inside trace) --------------------------------

@@ -45,8 +45,10 @@ import os
 import random
 import threading
 import time
+import warnings
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.exceptions import (
@@ -277,6 +279,82 @@ def classify_error(e: BaseException) -> str:
     return "fatal"
 
 
+# -- buffer donation (ISSUE 12) ------------------------------------------
+def donation_enabled() -> bool:
+    """The ``PINT_TPU_DONATE`` hatch, read at wrapper BUILD time:
+    ``cm.jit(fn, donate=True)`` and serve's ``traced_jit`` donate
+    their large per-dispatch operands (XLA aliases input buffers into
+    outputs and frees the non-aliasable ones at dispatch) only while
+    this is on.  ``=0`` restores copy-in semantics everywhere."""
+    return os.environ.get("PINT_TPU_DONATE", "1") != "0"
+
+
+_donation_warning_quieted = [False]
+
+
+def quiet_unusable_donation() -> None:
+    """Narrowly silence jax's once-per-lowering "Some donated buffers
+    were not usable" UserWarning: a donated operand with no
+    same-shaped output cannot alias, but donation still frees it at
+    dispatch — exactly the peak-memory win we want for the stacked
+    bundle operands — so the warning is expected, not actionable.
+    Installed only when a donating wrapper is actually built."""
+    if not _donation_warning_quieted[0]:
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        _donation_warning_quieted[0] = True
+
+
+def _copy_donated_leaf(leaf):
+    # jnp.copy follows the operand's committed placement/sharding
+    # (computation-follows-data), so replica- and gang-committed
+    # operands snapshot onto their own device(s), never the default
+    if isinstance(leaf, jax.Array):
+        return jnp.copy(leaf)
+    return leaf
+
+
+def snapshot_donated(args, donate):
+    """Replay snapshot of the donated argument positions: device-side
+    copies of every ``jax.Array`` leaf, taken BEFORE the dispatch —
+    a failed attempt may already have consumed the donated buffers
+    (jax invalidates them at call time regardless of how the attempt
+    ends), so a retry must substitute these copies.  Host-numpy leaves
+    pass through untouched: jit stages host operands through a fresh
+    device buffer, so donation can never invalidate them.  ``donate``
+    is ``True`` (every position) or an iterable of positions — the
+    ``_donate_argnums`` marker a donating wrapper carries."""
+    if donate is True:
+        posns = range(len(args))
+    else:
+        posns = [int(i) for i in donate if 0 <= int(i) < len(args)]
+    out = list(args)
+    for i in posns:
+        out[i] = jax.tree_util.tree_map(_copy_donated_leaf, out[i])
+    return tuple(out)
+
+
+def fence_owned(out):
+    """Materialize a DONATING dispatch's outputs as host-OWNED numpy.
+
+    On CPU, ``np.asarray`` of a jax Array is a zero-copy view of the
+    XLA buffer — safe while nothing recycles it, which donation
+    breaks: an output buffer aliased onto a donated input returns to
+    the allocator the moment its jax Array drops, and a long-lived
+    response view silently goes garbage when LATER dispatches reuse
+    the memory (caught by the serve parity gate).  So every fence
+    downstream of a donating kernel must own its bytes: one host
+    memcpy on CPU, no change on accelerators (their fence is a real
+    device-to-host transfer either way).  Passes through ``np.asarray``
+    views when donation is off — today's semantics."""
+    if donation_enabled():
+        return jax.tree_util.tree_map(
+            lambda leaf: np.array(leaf, copy=True), out
+        )
+    return jax.tree_util.tree_map(np.asarray, out)
+
+
 # -- the supervisor ------------------------------------------------------
 def _attempt(fn, args, site, timeout, obs_span=None):
     """One supervised attempt: fault hooks + optional watchdog thread.
@@ -324,19 +402,39 @@ def _attempt(fn, args, site, timeout, obs_span=None):
 
 
 def guarded_call(fn, args=(), site="", config=None, timeout=_UNSET,
-                 is_compile=False):
+                 is_compile=False, donate_argnums=None):
     """Run ``fn(*args)`` under the guard: watchdog + bounded retries.
 
     Raises GuardTimeout (watchdog exhausted), TransportRejection
     (deterministic — immediately), RetriesExhausted (transient failures
     past max_retries), or the original error (fatal class).  The
-    fallback ladder catches exactly these to drop a rung."""
+    fallback ladder catches exactly these to drop a rung.
+
+    ``donate_argnums`` (True = every position, or a position tuple —
+    the wrapper's ``_donate_argnums`` marker) declares that ``fn``
+    DONATES those operands: jax invalidates the donated device buffers
+    at call time whether or not the attempt succeeds, so a retry with
+    the original ``args`` would read freed buffers.  Before any
+    attempt that could be retried the guard snapshots the donated
+    positions (:func:`snapshot_donated`) and substitutes the snapshot
+    on the retry path — re-snapshotting each round so every retry is
+    itself replayable.  The snapshot is skipped when no retry can
+    plausibly happen (no watchdog armed AND no faults injected — the
+    CPU steady state), keeping donation free where transient transport
+    failures don't exist."""
     cfg = config or current_config()
     if timeout is _UNSET:
         timeout = cfg.compile_timeout if is_compile else cfg.dispatch_timeout
     attempts = max(0, int(cfg.max_retries)) + 1
     delay = cfg.backoff_base
     for attempt in range(1, attempts + 1):
+        snap = None
+        if (donate_argnums and attempt < attempts
+                and (timeout is not None or faults.active())):
+            # taken BEFORE the dispatch: a transient failure arrives
+            # AFTER the donated buffers are already gone
+            snap = snapshot_donated(args, donate_argnums)
+            obs_metrics.counter("guard.donation_snapshots").inc()
         # span per attempt (recorder off: shared no-op handle), so the
         # trace shows each retry's wall time and watchdog margin
         h = TRACER.span(
@@ -369,6 +467,10 @@ def guarded_call(fn, args=(), site="", config=None, timeout=_UNSET,
                 raise
             if attempt == attempts:
                 raise RetriesExhausted(site, attempt, e) from e
+        if snap is not None:
+            # replay against the pre-dispatch copies, never the
+            # (possibly freed) donated originals
+            args = snap
         STATS.bump("retries")
         TRACER.event("retry", "guard", site=site, attempt=attempt)
         time.sleep(
@@ -398,8 +500,12 @@ def dispatch_guard(fn, site: str):
     guarded_call.  The compile-vs-dispatch timeout choice tracks the
     first call per (wrapper, ladder device) — a rung falling to the CPU
     device pays a fresh compile and gets the compile watchdog again.
-    Preserves the ``.lower`` AOT hook (profiling/bench)."""
+    Preserves the ``.lower`` AOT hook (profiling/bench), and honors
+    the wrapper's ``_donate_argnums`` marker (ISSUE 12): a donating
+    wrapper's retries replay guard-side snapshots instead of the freed
+    donated buffers (see guarded_call)."""
     compiled_for: set = set()
+    donate = getattr(fn, "_donate_argnums", None)
 
     @functools.wraps(fn)
     def guarded(*args):
@@ -422,7 +528,10 @@ def dispatch_guard(fn, site: str):
                 with _device_ctx():  # the ladder pin still applies
                     return fn(*args)
             STATS.bump("guarded")
-            out = guarded_call(fn, args, site=site, is_compile=first)
+            out = guarded_call(
+                fn, args, site=site, is_compile=first,
+                donate_argnums=donate,
+            )
             compiled_for.add(devkey)
             return out
 

@@ -39,8 +39,13 @@ probe cadence), ``PINT_TPU_SERVE_COALESCE`` (in-replica same-key
 batch coalescing, default on; ISSUE 9), ``PINT_TPU_SERVE_GANGS`` /
 ``PINT_TPU_SERVE_GANG_SIZE`` (mixed-pool partition; default 0 gangs),
 ``PINT_TPU_SERVE_GANG_THRESHOLD`` (big-session TOA-bucket cutover;
-default the bake/argue threshold).  Semantics in docs/serving.md;
-the per-replica span/metric taxonomy in docs/observability.md.
+default the bake/argue threshold), ``PINT_TPU_SERVE_OVERLAP``
+(dispatcher transfer/compute double-buffering, default on; ISSUE 12),
+``PINT_TPU_SERVE_XKEY_FUSE`` / ``PINT_TPU_SERVE_XKEY_THRESHOLD`` /
+``PINT_TPU_SERVE_XKEY_MAX`` (cross-key small-batch fusion, default
+on / 4096-TOA bucket cutoff / 4 members; ISSUE 12).  Semantics in
+docs/serving.md; the per-replica span/metric taxonomy in
+docs/observability.md.
 """
 
 from pint_tpu.serve.fabric.gang import GangReplica, gang_threshold
@@ -51,6 +56,7 @@ from pint_tpu.serve.fabric.replica import (
     LIVE,
     QUARANTINED,
     BatchWork,
+    FusedBatch,
     Replica,
     health_kind,
     merge_batch_works,
@@ -61,6 +67,7 @@ __all__ = [
     "BatchWork",
     "DEGRADED",
     "DRAINED",
+    "FusedBatch",
     "GangReplica",
     "LIVE",
     "QUARANTINED",

@@ -45,11 +45,36 @@ zero-steady-retrace invariant survives by construction; the flight
 recorder attributes every merge (``replica:coalesce`` span,
 ``serve.fabric.coalesced`` counter).  ``PINT_TPU_SERVE_COALESCE=0``
 disables it.
+
+Transfer overlap (ISSUE 12): the dispatcher double-buffers — batch
+k+1's host-numpy stacking + ``device_put`` against this executor's
+committed placement (``_place_ops``, gang sharding included) runs
+BEFORE the inflight semaphore, i.e. while batch k still computes, so
+steady-state wall is max(compute, transfer) instead of their sum.
+``replica:place`` span + ``serve.fabric.overlapped`` counter;
+``PINT_TPU_SERVE_OVERLAP=0`` restores place-after-acquire.
+
+Cross-key fusion (ISSUE 12): where the coalescer deepens ONE key's
+batch, the fuser widens across keys — up to ``PINT_TPU_SERVE_XKEY_MAX``
+co-resident queued batches with DISTINCT (key, capacity) identities,
+every bucket at or below ``PINT_TPU_SERVE_XKEY_THRESHOLD``, dispatch
+as one multi-program device call (serve/session.py::
+build_fused_kernel) cached under the sorted member-identity combo.
+The gate mirrors the coalescer's: a fusion may land only when the
+combo wrapper is already traced OR every member's solo kernel is (the
+one fused trace per combo is a counted fresh compile, never a
+retrace); results de-multiplex per member bitwise-identically to
+separate dispatches.  A fused failure marks every member ``no_fuse``
+so retries dispatch solo — the fault ladder degrades to exactly the
+unfused path.  ``replica:xkey-fuse`` span, ``serve.fabric.xkey_fused``
+counter, ``serve.fabric.xkey_members`` histogram;
+``PINT_TPU_SERVE_XKEY_FUSE=0`` disables.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import queue
 import threading
@@ -70,7 +95,11 @@ from pint_tpu.exceptions import (
 )
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
-from pint_tpu.runtime.guard import dispatch_guard, validate_finite
+from pint_tpu.runtime.guard import (
+    dispatch_guard,
+    fence_owned,
+    validate_finite,
+)
 
 #: health states (docs/serving.md state diagram)
 LIVE = "LIVE"
@@ -99,7 +128,7 @@ class BatchWork:
     (replicas that already failed it, the last typed error)."""
 
     __slots__ = ("key", "live", "ops", "session", "cap", "excluded",
-                 "last_error")
+                 "last_error", "no_fuse")
 
     def __init__(self, key, live, ops, session, cap):
         self.key = key
@@ -109,6 +138,9 @@ class BatchWork:
         self.cap = cap
         self.excluded: set = set()  # replica ids that failed/refused
         self.last_error: BaseException | None = None
+        # set after a fused-dispatch failure: the retry must take the
+        # solo path (the fault ladder's degrade-to-unfused rung)
+        self.no_fuse = False
 
     @property
     def op(self) -> str:
@@ -212,7 +244,22 @@ def merge_batch_works(works: list[BatchWork], cap: int) -> BatchWork:
     ops = tree_util.tree_map(merge, *[w.ops for w in works])
     merged = BatchWork(works[0].key, live, ops, works[0].session, cap)
     merged.excluded = set().union(*(w.excluded for w in works))
+    merged.no_fuse = any(w.no_fuse for w in works)
     return merged
+
+
+class FusedBatch:
+    """A cross-key fused dispatch in flight: member BatchWorks in
+    combo (sorted-identity) order — the fused wrapper's argument and
+    output order — plus the kernel-cache combo key.  Members keep
+    their own ``_outstanding`` units and fence/resolve independently
+    at de-multiplex."""
+
+    __slots__ = ("members", "combo")
+
+    def __init__(self, members, combo):
+        self.members = tuple(members)
+        self.combo = combo
 
 
 class Replica:
@@ -253,6 +300,18 @@ class Replica:
         self._coalesce_on = (
             os.environ.get("PINT_TPU_SERVE_COALESCE", "1") != "0"
         )
+        self._overlap_on = (
+            os.environ.get("PINT_TPU_SERVE_OVERLAP", "1") != "0"
+        )
+        self._xkey_on = (
+            os.environ.get("PINT_TPU_SERVE_XKEY_FUSE", "1") != "0"
+        )
+        self._xkey_threshold = int(
+            os.environ.get("PINT_TPU_SERVE_XKEY_THRESHOLD", "4096")
+        )
+        self._xkey_max = max(2, int(
+            os.environ.get("PINT_TPU_SERVE_XKEY_MAX", "4")
+        ))
         self._draining = False  # lint: guarded-by(_cond)
         # health state: reads are bare attribute loads (GIL-atomic) so
         # submit() can check state while holding only _cond; writes go
@@ -378,7 +437,11 @@ class Replica:
                 self._batch_leaves(work)
                 self._requeue(work, self)
                 continue
-            self._run(self._coalesce(work))
+            job = self._fuse(self._coalesce(work))
+            if isinstance(job, FusedBatch):
+                self._run_fused(job)
+            else:
+                self._run(job)
         self._fence_q.put(None)
 
     def _coalesce(self, work: BatchWork) -> BatchWork:
@@ -435,6 +498,67 @@ class Replica:
             total
         )
         return merged
+
+    def _fusible(self, work: BatchWork) -> bool:
+        """Small-batch fusion eligibility: below the bucket cutoff
+        (key[2] is the group's TOA bucket) and not a fused-failure
+        retry."""
+        return (not work.no_fuse
+                and int(work.key[2]) <= self._xkey_threshold)
+
+    def _fuse(self, work: BatchWork):
+        """Cross-key fusion (ISSUE 12): widen the dispatch across
+        DISTINCT (key, capacity) identities the coalescer cannot
+        touch.  Scans the queue for up to ``_xkey_max - 1`` fusible
+        co-resident batches whose kernel identities differ from
+        ``work``'s and each other's, forms the sorted-identity combo,
+        and fuses only when the combo wrapper is already in this
+        replica's ``_kernels`` cache OR every member's solo kernel is
+        — the coalescer's warmed gate, lifted to the combo: at steady
+        state a fusion can never compile or retrace (the one fused
+        trace per combo is a counted FRESH compile off solo-warmed
+        member programs).  Candidates stay queued until the gate
+        passes, so a failed gate costs nothing.  Members keep their
+        individual ``_outstanding`` units (each gets its own
+        ``_batch_leaves`` at de-multiplex).  Dispatcher-thread only;
+        queue surgery under ``_cond``.  Returns the FusedBatch, or
+        ``work`` unchanged when nothing fused."""
+        if not self._xkey_on or not self._fusible(work):
+            return work
+        ident = self._kernel_cache_key
+        with self._cond:
+            if not self._queue:
+                return work
+            seen = {ident(work)}
+            cands: list[BatchWork] = []
+            for w in self._queue:
+                if len(cands) + 2 > self._xkey_max:
+                    break
+                kk = ident(w)
+                if self._fusible(w) and kk not in seen:
+                    cands.append(w)
+                    seen.add(kk)
+            if not cands:
+                return work
+            order = sorted([work] + cands, key=lambda w: repr(ident(w)))
+            combo = ("xkey",) + tuple(ident(w) for w in order)
+            if combo not in self._kernels and not all(
+                    ident(w) in self._kernels for w in order):
+                return work
+            for w in cands:
+                self._queue.remove(w)
+            self._cond.notify_all()
+        n = sum(len(w.live) for w in order)
+        with TRACER.span(
+            "replica:xkey-fuse", "fabric", replica=self.tag,
+            members=len(order), n=n,
+        ):
+            fused = FusedBatch(order, combo)
+        obs_metrics.counter("serve.fabric.xkey_fused").inc(len(cands))
+        obs_metrics.histogram("serve.fabric.xkey_members").observe(
+            len(order)
+        )
+        return fused
 
     def _shed_late(self, work: BatchWork):
         """Dispatch-boundary deadline re-check (ISSUE 11 satellite):
@@ -497,6 +621,7 @@ class Replica:
         )
         kept.excluded = set(work.excluded)
         kept.last_error = work.last_error
+        kept.no_fuse = work.no_fuse
         return kept
 
     def prewarm_kernel(self, work: BatchWork) -> None:
@@ -530,6 +655,23 @@ class Replica:
             self._batch_leaves(work)
             work.fail(e)
             return
+        ops = None
+        if self._overlap_on:
+            # transfer overlap (ISSUE 12): stack + device_put run HERE,
+            # before the inflight semaphore — while up to `inflight`
+            # prior batches still compute, this batch's host->device
+            # copy proceeds against the committed placement, so the
+            # steady-state wall is max(compute, transfer)
+            try:
+                with TRACER.span(
+                    "replica:place", "fabric", replica=self.tag,
+                    op=work.key[0], cap=work.cap,
+                ):
+                    ops = self._place_ops(work)
+                obs_metrics.counter("serve.fabric.overlapped").inc()
+            except BaseException as e:
+                self._batch_error(work, e)
+                return
         # backpressure: at most `inflight` dispatched batches may
         # await this replica's fence
         self._sem.acquire()
@@ -538,13 +680,107 @@ class Replica:
                 "replica:dispatch", "fabric", replica=self.tag,
                 op=work.key[0], n=len(work.live), cap=work.cap,
             ):
-                ops = self._place_ops(work)
+                if ops is None:
+                    ops = self._place_ops(work)
                 out = kernel(*ops)  # async guarded device dispatch
         except BaseException as e:
             self._sem.release()
             self._batch_error(work, e)
             return
         self._fence_q.put((work, out))
+
+    # -- the cross-key fused dispatch pipeline ----------------------------
+    def _fused_kernel_for(self, combo: tuple, members):
+        """Build-or-fetch the fused multi-program wrapper for one
+        sorted member combo.  The first trace runs every member's
+        ``_with_swapped`` body, so it must hold EVERY distinct member
+        session's trace lock — acquired in a deterministic (id-sorted)
+        global order so concurrent fusions on other replicas cannot
+        deadlock.  Dispatcher-thread only (owns ``_kernels``)."""
+        k = self._kernels.get(combo)
+        if k is None:
+            from pint_tpu.serve import session as smod
+            from pint_tpu.utils import compute_hash
+
+            site = (
+                f"serve:xkey:{compute_hash(repr(combo))[:8]}"
+                f"x{len(members)}@{self.tag}"
+            )
+            inner = smod.build_fused_kernel(
+                [(w.session, w.key) for w in members], site
+            )
+            locks = sorted(
+                {id(w.session.trace_lock): w.session.trace_lock
+                 for w in members}.items()
+            )
+            traced = [False]
+
+            def k(*args):
+                if not traced[0]:
+                    with contextlib.ExitStack() as stack:
+                        for _, lock in locks:
+                            stack.enter_context(lock)
+                        traced[0] = True
+                        return inner(*args)
+                return inner(*args)
+
+            self._kernels[combo] = k
+        return k
+
+    def _place_flat(self, members):
+        """Flatten member placements into the fused wrapper's argument
+        list — 3 positions per member, combo order."""
+        flat = []
+        for w in members:
+            flat.extend(self._place_ops(w))
+        return flat
+
+    def _run_fused(self, fused: FusedBatch):
+        kept = []
+        for w in fused.members:
+            w2 = self._shed_late(w)
+            if w2 is not None:
+                kept.append(w2)
+        if len(kept) < len(fused.members):
+            # a member expired wholesale at the dispatch boundary: the
+            # combo identity changed — dispatch survivors solo rather
+            # than compiling a one-off sub-combo
+            for w in kept:
+                self._run(w)
+            return
+        fused = FusedBatch(kept, fused.combo)
+        try:
+            kernel = self._fused_kernel_for(fused.combo, fused.members)
+        except BaseException as e:
+            self._fused_error([(w, e) for w in fused.members])
+            return
+        flat = None
+        if self._overlap_on:
+            try:
+                with TRACER.span(
+                    "replica:place", "fabric", replica=self.tag,
+                    op="xkey", members=len(fused.members),
+                ):
+                    flat = self._place_flat(fused.members)
+                obs_metrics.counter("serve.fabric.overlapped").inc()
+            except BaseException as e:
+                self._fused_error([(w, e) for w in fused.members])
+                return
+        self._sem.acquire()  # ONE device call in flight for the combo
+        try:
+            with TRACER.span(
+                "replica:dispatch", "fabric", replica=self.tag,
+                op="xkey", members=len(fused.members),
+                n=sum(len(w.live) for w in fused.members),
+            ):
+                if flat is None:
+                    flat = self._place_flat(fused.members)
+                out = kernel(*flat)
+        except BaseException as e:
+            self._sem.release()
+            self._fused_error([(w, e) for w in fused.members])
+            return
+        self._fence_q.put((fused, out))
 
     def _place_ops(self, work: BatchWork):
         """Commit the stacked host operands to this executor's
@@ -560,12 +796,18 @@ class Replica:
             if item is None:
                 break
             work, out = item
+            if isinstance(work, FusedBatch):
+                self._fence_fused(work, out)
+                continue
             try:
                 with TRACER.span(
                     "replica:fence", "fabric", replica=self.tag,
                     op=work.key[0], n=len(work.live),
                 ):
-                    mats = tree_util.tree_map(np.asarray, out)
+                    # serve kernels donate: responses must own their
+                    # bytes (guard.fence_owned), never view buffers
+                    # the allocator may recycle
+                    mats = fence_owned(out)
                 self._validator(work, mats, self.tag)
             except BaseException as e:
                 self._sem.release()
@@ -580,6 +822,68 @@ class Replica:
             self.batches_done += 1
             self._m_batches.inc()
             self._batch_leaves(work)
+
+    def _fence_fused(self, fused: FusedBatch, out):
+        """De-multiplex one fused dispatch: member ``i``'s output is
+        ``out[i]`` (build_fused_kernel's tuple contract, combo order).
+        Each member fences, validates, and resolves independently —
+        exactly the solo fence body — so a NaN in one member fails
+        only that member's futures; the single inflight unit releases
+        once.  Fencer-thread only."""
+        failed: list = []
+        any_ok = False
+        for w, member_out in zip(fused.members, out):
+            try:
+                with TRACER.span(
+                    "replica:fence", "fabric", replica=self.tag,
+                    op=w.key[0], n=len(w.live),
+                    fused=len(fused.members),
+                ):
+                    mats = fence_owned(member_out)
+                self._validator(w, mats, self.tag)
+            except BaseException as e:
+                failed.append((w, e))
+                continue
+            any_ok = True
+            try:
+                self._finisher(w, mats, self)
+            except BaseException as e:
+                w.fail(e)
+            self.batches_done += 1
+            self._m_batches.inc()
+            self._batch_leaves(w)
+        self._sem.release()
+        if any_ok:
+            self.note_success()
+        if failed:
+            self._fused_error(failed)
+
+    def _fused_error(self, pairs):
+        """Failure path for (a subset of) a fused dispatch's members:
+        ``pairs`` is [(work, error), ...].  ONE health hit covers the
+        whole device-level event (a single dispatch failed, not N),
+        and every member is marked ``no_fuse`` before re-routing so
+        the retry runs the plain solo path — the fused overlay can
+        never wedge a batch that would succeed unfused.  Deterministic
+        member errors (kind None) fail their own futures directly, as
+        in ``_batch_error``."""
+        health_hit = False
+        for w, e in pairs:
+            w.last_error = e
+            w.excluded.add(self.rid)
+            w.no_fuse = True
+            self._batch_leaves(w)
+            kind = health_kind(e)
+            if kind is None:
+                w.fail(e)
+                continue
+            if not health_hit:
+                health_hit = True
+                with self._state_lock:
+                    self.failures += 1
+                obs_metrics.counter("serve.fabric.failures").inc()
+                self.note_failure(kind, e)
+            self._requeue(w, self)
 
     def _batch_leaves(self, work: BatchWork):
         with self._cond:

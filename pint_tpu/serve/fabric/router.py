@@ -42,10 +42,22 @@ an executor's outstanding work counts per device
 a gang of 4 with 3 queued batches is LESS loaded than a single with
 1, not more; comparing raw outstanding across widths would starve one
 class of the mixed pool.
+
+Fusion colocation (ISSUE 12): the replica cross-key fuser
+(replica.py::Replica._fuse) can only merge batches that are queued on
+the SAME executor, so when ``PINT_TPU_SERVE_XKEY_FUSE`` is on, a
+small group's COLD placement prefers the usable replica already
+holding the most other small-group placements (tie-break by load then
+rid, as before) — distinct small compositions pile onto one executor
+and co-resident different-key batches become fusible instead of
+scattering one-per-device.  Spill under saturation is unchanged, so
+the heuristic trades nothing under load; big groups and the
+fusion-off hatch keep the pure least-loaded placement.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 
 from pint_tpu.obs import metrics as obs_metrics
@@ -83,6 +95,12 @@ class Router:
             1, int(affinity) if affinity else pool.size
         )
         self.gang_threshold = gang_threshold(gang_threshold_toas)
+        self.xkey_fuse = (
+            os.environ.get("PINT_TPU_SERVE_XKEY_FUSE", "1") != "0"
+        )
+        self.xkey_threshold = int(
+            os.environ.get("PINT_TPU_SERVE_XKEY_THRESHOLD", "4096")
+        )
         self._placements: dict = {}  # group key -> [rid, ...]; lint: guarded-by(_lock)
         self._rotor: dict = {}  # round-robin counters; lint: guarded-by(_lock)
         self._lock = threading.Lock()
@@ -116,6 +134,25 @@ class Router:
             return int(key[2]) >= self.gang_threshold
         except (IndexError, TypeError, ValueError):
             return False
+
+    def _is_small(self, key) -> bool:
+        """Fusion-class work: bucket at/below the cross-key fusion
+        cutoff (replica.py::Replica._fusible's criterion)."""
+        try:
+            return int(key[2]) <= self.xkey_threshold
+        except (IndexError, TypeError, ValueError):
+            return False
+
+    def _small_counts_locked(self, key) -> dict:
+        """rid -> how many OTHER small groups are placed there (the
+        colocation score; group census is session-cache-bounded, so
+        the scan is cheap)."""
+        counts: dict = {}
+        for k2, rids in self._placements.items():
+            if k2 != key and self._is_small(k2):
+                for rid in rids:
+                    counts[rid] = counts.get(rid, 0) + 1
+        return counts
 
     def _usable_locked(self, key, exclude) -> dict:
         """rid -> executor for every candidate that may serve ``key``:
@@ -160,11 +197,19 @@ class Router:
                 )
         if not cands:
             # no placed replica is usable: (re)place on the
-            # least-loaded usable replica
+            # least-loaded usable replica — except that small groups
+            # colocate with other small groups when cross-key fusion
+            # is on (module docstring: co-resident ≠ scattered)
             fresh = list(usable.values())
             if not fresh:
                 return None
-            r = min(fresh, key=lambda r: (_load(r), r.rid))
+            if self.xkey_fuse and self._is_small(key):
+                small = self._small_counts_locked(key)
+                r = min(fresh, key=lambda r: (
+                    -small.get(r.rid, 0), _load(r), r.rid
+                ))
+            else:
+                r = min(fresh, key=lambda r: (_load(r), r.rid))
             if r.rid not in placed:
                 placed.append(r.rid)
             return r

@@ -395,7 +395,23 @@ class Session:
 
 
 # -- the serve dispatch chokepoint ---------------------------------------
-def traced_jit(fn, site: str, cid: str | None = None, warm=None):
+def serve_donate_argnums(nargs: int = 3):
+    """The serving kernels' donation contract (ISSUE 12): every
+    stacked operand — bundle stack, ref stack, state stack, times the
+    member count for fused kernels — is freshly ``device_put`` by the
+    replica per dispatch and read by nobody afterwards, so ALL
+    positions are donated; the xs stack aliases the fit kernel's x
+    output in place and the rest free at dispatch (peak-memory win for
+    big buckets).  Returns None when ``PINT_TPU_DONATE=0``."""
+    from pint_tpu.runtime.guard import donation_enabled
+
+    if not donation_enabled():
+        return None
+    return tuple(range(nargs))
+
+
+def traced_jit(fn, site: str, cid: str | None = None, warm=None,
+               donate_argnums=None):
     """serve's dispatch chokepoint: ``jax.jit`` + exact XLA (re)trace
     accounting + operand-byte metering + the device-execution guard —
     the ``CompiledModel.jit`` contract for kernels whose operands
@@ -411,7 +427,15 @@ def traced_jit(fn, site: str, cid: str | None = None, warm=None):
     ``(session, group key, capacity, replica tag)`` tuple recorded on
     the wrapper's FIRST trace via serve/warm_ledger.py::note_warm —
     the same body the compile counters live in, so the persisted warm
-    surface and the trace accounting can never disagree."""
+    surface and the trace accounting can never disagree.
+
+    ``donate_argnums`` (ISSUE 12) forwards to ``jax.jit`` and marks
+    the wrapper for the guard's replay snapshot
+    (runtime/guard.py::snapshot_donated): donated device operands are
+    freed at dispatch, so a transient-fault retry substitutes
+    guard-side copies.  Serving callers pass
+    :func:`serve_donate_argnums` — per-dispatch stacked operands only,
+    never cached state."""
     ntraces = [0]
 
     def noted(*args):
@@ -427,7 +451,21 @@ def traced_jit(fn, site: str, cid: str | None = None, warm=None):
         ntraces[0] += 1
         return fn(*args)
 
-    guarded = dispatch_guard(jax.jit(noted), site)
+    if donate_argnums:
+        from pint_tpu.runtime.guard import quiet_unusable_donation
+
+        quiet_unusable_donation()
+        # both branches feed dispatch_guard below — the donate split
+        # only decides the jit flags, not the guard routing
+        jitted = jax.jit(  # lint: ok(obs1)
+            noted, donate_argnums=tuple(donate_argnums)
+        )
+        # the guard's retry-snapshot marker (PjitFunction accepts
+        # attribute assignment; dispatch_guard reads it)
+        jitted._donate_argnums = tuple(donate_argnums)
+    else:
+        jitted = jax.jit(noted)  # lint: ok(obs1)
+    guarded = dispatch_guard(jitted, site)
 
     def dispatch(*args):
         _obs.note_transfer(site, 0, args)
@@ -455,9 +493,8 @@ def _with_swapped(proto, static_ref, fn):
     return call
 
 
-def build_residuals_kernel(session: Session, subtract_mean: bool,
-                           site: str, warm=None):
-    """Batched residuals kernel: (bundle_stack, ref_stack, xs (B, p))
+def _residuals_run(session: Session, subtract_mean: bool):
+    """Raw batched residuals body: (bundle_stack, ref_stack, xs (B, p))
     -> (residuals (B, bucket), chi2 (B,)).  The pulsar axis stacks
     DISTINCT pars of one composition: each row's bundle + reference
     pytree rides as a vmapped runtime argument."""
@@ -472,12 +509,12 @@ def build_residuals_kernel(session: Session, subtract_mean: bool,
     def run(bundles, refs, xs):
         return jax.vmap(call)(bundles, refs, xs)
 
-    return traced_jit(run, site, cid=session.cid, warm=warm)
+    return run
 
 
-def build_fit_kernel(session: Session, mode: str, maxiter: int,
-                     tol_chi2: float, site: str, warm=None):
-    """Batched fit kernel: every request's whole Gauss-Newton
+def _fit_run(session: Session, mode: str, maxiter: int,
+             tol_chi2: float):
+    """Raw batched fit body: every request's whole Gauss-Newton
     iteration runs as ONE vmapped lax.scan program (the
     make_scan_fit_loop semantics GLSFitter uses, over the shared
     fitting/gls.py::gauss_newton_step), so a serving batch costs a
@@ -502,7 +539,72 @@ def build_fit_kernel(session: Session, mode: str, maxiter: int,
     def run(bundles, refs, xs0):
         return jax.vmap(call)(bundles, refs, xs0)
 
-    return traced_jit(run, site, cid=session.cid, warm=warm)
+    return run
+
+
+def _run_for_key(session: Session, key: tuple):
+    """The raw (unjitted) batched body for one fabric group key —
+    exactly the program build_fit_kernel / build_residuals_kernel
+    would jit for ``key`` (fabric BatchWork.make_kernel's dispatch),
+    exposed so the cross-key fuser composes member programs without
+    duplicating the key decode."""
+    if key[0] == "fit":
+        _, _, _, mode, maxiter, tol = key
+        return _fit_run(session, mode, maxiter, tol)
+    return _residuals_run(session, key[3])
+
+
+def build_residuals_kernel(session: Session, subtract_mean: bool,
+                           site: str, warm=None):
+    """Batched residuals kernel (see :func:`_residuals_run`), jitted
+    through the traced_jit chokepoint with the serving donation
+    contract on the stacked operands."""
+    return traced_jit(
+        _residuals_run(session, subtract_mean), site,
+        cid=session.cid, warm=warm,
+        donate_argnums=serve_donate_argnums(),
+    )
+
+
+def build_fit_kernel(session: Session, mode: str, maxiter: int,
+                     tol_chi2: float, site: str, warm=None):
+    """Batched fit kernel (see :func:`_fit_run`), jitted through the
+    traced_jit chokepoint with the serving donation contract on the
+    stacked operands."""
+    return traced_jit(
+        _fit_run(session, mode, maxiter, tol_chi2), site,
+        cid=session.cid, warm=warm,
+        donate_argnums=serve_donate_argnums(),
+    )
+
+
+def build_fused_kernel(parts, site: str):
+    """Cross-key fused dispatch kernel (ISSUE 12): ``parts`` is a list
+    of (session, group key) members, each contributing its exact
+    single-key batched program (:func:`_run_for_key`).  The fused
+    wrapper takes the members' operand triples FLAT — 3 positions per
+    member, in ``parts`` order — and runs the member programs inside
+    ONE jitted device call, returning a tuple of per-member outputs.
+    XLA sees one module with N independent subgraphs, so one launch +
+    one transfer fence replaces N; each member's subgraph is the SAME
+    program its solo kernel traces, so the de-multiplexed results are
+    bitwise-identical to separate dispatches.  The wrapper is cached
+    by the replica under the sorted member (key, cap) combo, gated by
+    the coalescer's warmed-kernel rule — steady state never compiles
+    or retraces here.  No ``cid``/``warm``: the fused combo is a
+    replica-local overlay, not a composition surface (members' solo
+    kernels own the warm-restart ledger rows)."""
+    runs = [_run_for_key(session, key) for session, key in parts]
+
+    def fused(*flat):
+        return tuple(
+            run(*flat[3 * i:3 * i + 3]) for i, run in enumerate(runs)
+        )
+
+    return traced_jit(
+        fused, site,
+        donate_argnums=serve_donate_argnums(3 * len(runs)),
+    )
 
 
 class SessionCache:

@@ -24,6 +24,14 @@ guarded dispatch per fit) vs PINT_TPU_DOWNHILL_FUSED=0 (the host
 -loop rung: ~maxiter x (proposal + ladder) dispatches plus per-call
 re-jit — the old fit_toas behavior, kept as the fault-ladder rung).
 
+The ISSUE 12 rows extend the ladder past the one-dispatch floor:
+``donation`` (the fused refit with buffer donation on vs
+PINT_TPU_DONATE=0 — the aliasing win), ``serve xkey`` (a mixed-key
+burst through one replica: cross-key fusion on vs
+PINT_TPU_SERVE_XKEY_FUSE=0, dispatches per burst is the headline) and
+``serve overlap`` (single-key burst, transfer/compute double
+-buffering on vs PINT_TPU_SERVE_OVERLAP=0).
+
 Run: ``python profiling/dispatch_floor.py`` (one JSON line per row)
 or ``python profiling/run_benchmarks.py --configs dispatch_floor``.
 """
@@ -135,6 +143,145 @@ def _downhill_row(name, par, ntoa, fitter_cls, nrep):
     return row
 
 
+def _donation_row(name, par, ntoa, fitter_cls, nrep):
+    """Steady-state FUSED refit with buffer donation on (default) vs
+    PINT_TPU_DONATE=0 (ISSUE 12).  Donation is read at wrapper BUILD
+    time, so each mode gets a fresh fitter — both pay one compile
+    outside the measurement, only the aliasing differs."""
+    from pint_tpu.simulation import make_test_pulsar
+
+    m, toas = make_test_pulsar(
+        par, ntoa=ntoa, start_mjd=53000, end_mjd=57000, iterations=1
+    )
+    row = {"config": f"dispatch_floor donation {name}", "ntoa": ntoa}
+    for mode in ("donate", "nodonate"):
+        saved = os.environ.get("PINT_TPU_DONATE")
+        try:
+            if mode == "nodonate":
+                os.environ["PINT_TPU_DONATE"] = "0"
+            else:
+                os.environ.pop("PINT_TPU_DONATE", None)
+            f = fitter_cls(toas, m)
+            f.fit_toas(maxiter=5)  # warm this mode's wrapper
+            t0 = time.perf_counter()
+            for _ in range(nrep):
+                f.fit_toas(maxiter=5)
+            wall = (time.perf_counter() - t0) / nrep
+            row[f"{mode}_wall_ms"] = round(wall * 1e3, 2)
+        finally:
+            if saved is None:
+                os.environ.pop("PINT_TPU_DONATE", None)
+            else:
+                os.environ["PINT_TPU_DONATE"] = saved
+    row["donation_speedup_x"] = round(
+        row["nodonate_wall_ms"] / max(row["donate_wall_ms"], 1e-9), 2
+    )
+    return row
+
+
+def _serve_burst_row(kind, nburst, nrep, env_knob):
+    """One serving-ladder leg (ISSUE 12): a mixed-key burst through a
+    ONE-replica engine with ``env_knob`` on (default) vs =0.
+
+    - kind='xkey': residuals + fit requests over two pulsars = two
+      distinct (key, capacity) identities co-resident in the replica
+      queue; the fused mode dispatches them as one device call, so
+      ``dispatches_per_burst`` is the headline (the wall moves too,
+      but on the CPU mesh the dispatch COUNT is the honest figure).
+    - kind='overlap': a single-key burst; the on mode stages each
+      batch's host stacking + placement before the inflight slot
+      (steady wall = max(compute, transfer)), counted by
+      ``serve.fabric.overlapped``.
+    """
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import (
+        FitRequest,
+        ResidualsRequest,
+        TimingEngine,
+    )
+    from pint_tpu.simulation import make_test_pulsar
+
+    ma, ta = make_test_pulsar(
+        "PSR D1\nF0 88.12 1\nF1 -2.1e-15 1\nPEPOCH 55000\n"
+        "DM 9.7 1\n", ntoa=40, iterations=1,
+    )
+    mb, tb = make_test_pulsar(
+        "PSR D2\nF0 311.49 1\nF1 -7.3e-16 1\nPEPOCH 55000\n"
+        "DM 31.2 1\n", ntoa=50, iterations=1,
+    )
+    pa, pb = ma.as_parfile(), mb.as_parfile()
+
+    def burst(eng):
+        fs = [eng.submit(ResidualsRequest(par=pa, toas=ta))]
+        if kind == "xkey":
+            fs.append(eng.submit(
+                FitRequest(par=pb, toas=tb, maxiter=2)
+            ))
+        else:
+            fs.append(eng.submit(ResidualsRequest(par=pb, toas=tb)))
+        return fs
+
+    g = obs_metrics.counter("dispatch.guarded")
+    ov = obs_metrics.counter("serve.fabric.overlapped")
+    row = {
+        "config": f"dispatch_floor serve {kind} burst",
+        "requests_per_burst": 2 * nburst,
+    }
+    for mode in ("on", "off"):
+        saved = os.environ.get(env_knob)
+        try:
+            if mode == "off":
+                os.environ[env_knob] = "0"
+            else:
+                os.environ.pop(env_knob, None)
+            eng = TimingEngine(
+                replicas=1, max_batch=8, max_wait_ms=5.0, inflight=8,
+                max_queue=4 * nburst + 8,
+            )
+            try:
+                # two warm rounds at the MEASUREMENT shape: the first
+                # traces the solo (key, capacity) kernels, the second
+                # the fused combo wrappers (which only build once the
+                # members are solo-warmed) — so no compile leaks into
+                # the steady-state figure
+                for _ in range(2):
+                    warm = []
+                    for _ in range(nburst):
+                        warm.extend(burst(eng))
+                    for f in warm:
+                        f.result(timeout=600)
+                g0, ov0 = g.value, ov.value
+                t0 = time.perf_counter()
+                for _ in range(nrep):
+                    fs = []
+                    for _ in range(nburst):
+                        fs.extend(burst(eng))
+                    for f in fs:
+                        f.result(timeout=600)
+                wall = (time.perf_counter() - t0) / nrep
+                row[f"{mode}_wall_ms_per_burst"] = round(wall * 1e3, 2)
+                row[f"{mode}_dispatches_per_burst"] = round(
+                    (g.value - g0) / nrep, 1
+                )
+                if kind == "overlap":
+                    row[f"{mode}_overlapped_per_burst"] = round(
+                        (ov.value - ov0) / nrep, 1
+                    )
+            finally:
+                eng.close(timeout=60)
+        finally:
+            if saved is None:
+                os.environ.pop(env_knob, None)
+            else:
+                os.environ[env_knob] = saved
+    if kind == "xkey":
+        row["dispatch_reduction_x"] = round(
+            row["off_dispatches_per_burst"]
+            / max(row["on_dispatches_per_burst"], 1.0), 2
+        )
+    return row
+
+
 def floor_rows(configs=("1", "3", "5")):
     """All ladder rows (run_benchmarks config ``dispatch_floor``)."""
     import run_benchmarks as rb
@@ -159,6 +306,16 @@ def floor_rows(configs=("1", "3", "5")):
         "TNREDAMP -13.8\nTNREDGAM 4.3\nTNREDC 30\n",
         100_000, DownhillGLSFitter, nrep=2,
     ))
+    rows.append(_donation_row(
+        "config1 WLS 62 TOAs",
+        "PSR C1\nF0 61.485 1\nF1 -1.2e-15 1\nPEPOCH 53750\n"
+        "DM 224.1 1\n",
+        62, DownhillWLSFitter, nrep=3,
+    ))
+    rows.append(_serve_burst_row("xkey", nburst=12, nrep=2,
+                                 env_knob="PINT_TPU_SERVE_XKEY_FUSE"))
+    rows.append(_serve_burst_row("overlap", nburst=12, nrep=2,
+                                 env_knob="PINT_TPU_SERVE_OVERLAP"))
     return rows
 
 

@@ -625,3 +625,77 @@ def test_real_tree_declares_the_incident_guards():
     assert "lint: guarded-by(_cond)" in replica
     engine_src = (REPO / "pint_tpu" / "serve" / "engine.py").read_text()
     assert "lint: guarded-by(_cond)" in engine_src
+
+
+# -- perf1: the ISSUE 12 use-after-donate class ---------------------------
+def test_perf1_flags_read_after_donation():
+    src = (
+        "def fit(self, x0):\n"
+        "    loop = self.cm.jit(traj, donate=True)\n"
+        "    out = loop(x0)\n"
+        "    return x0 + out\n"
+    )
+    perf1 = rules_by_name()["perf1"]
+    out = findings_for(perf1, src)
+    assert [f.lineno for f in out] == [4]
+    assert "donated to 'loop'" in out[0].message
+
+
+def test_perf1_allows_rebind_prior_reads_and_undonating():
+    src = (
+        "def fit(self, x0):\n"
+        "    loop = self.cm.jit(traj, donate=True)\n"
+        "    y = x0 * 2\n"            # read BEFORE the call: clean
+        "    out = loop(x0)\n"
+        "    x0 = fresh()\n"          # rebound: owns fresh buffers
+        "    return x0 + out + y\n"
+        "\n"
+        "def undonating(self, x0):\n"
+        "    loop = self.cm.jit(traj, donate=False)\n"
+        "    out = loop(x0)\n"
+        "    return x0 + out\n"
+    )
+    perf1 = rules_by_name()["perf1"]
+    assert findings_for(perf1, src) == []
+
+
+def test_perf1_positional_argnums_and_pragma():
+    src = (
+        "def run(b, r, xs):\n"
+        "    k = traced_jit(fn, 's', donate_argnums=(0, 2))\n"
+        "    out = k(b, r, xs)\n"
+        "    keep = r\n"              # position 1 not donated: clean
+        "    return keep + xs\n"      # xs donated at position 2
+        "\n"
+        "def hatch(x0):\n"
+        "    loop = jax.jit(fn, donate_argnums=(0,))\n"
+        "    out = loop(x0)\n"
+        "    return x0  # lint: ok(perf1) -- read under donation off\n"
+    )
+    perf1 = rules_by_name()["perf1"]
+    out = findings_for(perf1, src)
+    assert [f.lineno for f in out] == [5]
+    assert "'xs'" in out[0].message
+
+
+def test_perf1_project_checks_flag_stripped_donation_contract(tmp_path):
+    """perf1's chokepoint needles catch the donation contract being
+    stripped (guard snapshot, traced_jit forwarding); fixture packages
+    without runtime/guard.py skip; the real tree passes."""
+    perf1 = rules_by_name()["perf1"]
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "serve").mkdir(parents=True)
+    assert perf1.check_project(bare) == []
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "runtime").mkdir(parents=True)
+    (pkg / "runtime" / "guard.py").write_text(
+        "def guarded_call(fn, args=()):\n    return fn(*args)\n"
+    )
+    (pkg / "serve").mkdir()
+    (pkg / "serve" / "session.py").write_text(
+        "def traced_jit(fn, site):\n    return fn\n"
+    )
+    msgs = "\n".join(f.message for f in perf1.check_project(pkg))
+    assert "snapshot_donated(" in msgs
+    assert "donate_argnums" in msgs
+    assert perf1.check_project(REPO / "pint_tpu") == []

@@ -544,3 +544,83 @@ def test_guard_disabled_context(gls_pulsar):
         # the armed fault is still pending outside the block; drain it
         with guard.configured(max_retries=1, **FAST):
             assert guard.guarded_call(lambda: 7, site="drain") == 7
+
+
+# -- buffer donation: snapshot + replay (ISSUE 12) -----------------------
+def test_donating_dispatch_retries_bitwise_with_snapshot():
+    """A donating wrapper under a transient fault: the guard snapshots
+    the donated positions BEFORE the attempt, the retry replays the
+    snapshot, and the served result is bitwise-identical to a clean
+    run.  The snapshot counter is the observable."""
+    import jax.numpy as jnp
+
+    from pint_tpu.obs import metrics as obs_metrics
+
+    jitted = jax.jit(lambda v: v * 2.0 + 1.0, donate_argnums=(0,))
+    jitted._donate_argnums = (0,)
+    site = "donate-replay"
+    fn = guard.dispatch_guard(jitted, site)
+    x = np.arange(8.0) + 1.0
+    clean = np.array(fn(jnp.array(x)), copy=True)
+    # donation is real: a successful call invalidates its operand
+    op = jnp.array(x)
+    fn(op)
+    assert op.is_deleted()
+    snaps0 = obs_metrics.counter("guard.donation_snapshots").value
+    with guard.configured(max_retries=2, **FAST):
+        with faults.inject(f"transient:1@{site}"):
+            out = np.array(fn(jnp.array(x)), copy=True)
+    np.testing.assert_array_equal(out, clean)
+    assert (
+        obs_metrics.counter("guard.donation_snapshots").value > snaps0
+    )
+    assert guard.STATS.retries == 1
+
+
+def test_donation_snapshot_skipped_on_quiet_steady_state():
+    """No watchdog armed and no faults active: the donating wrapper
+    pays ZERO snapshot copies (the CPU steady state)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.obs import metrics as obs_metrics
+
+    jitted = jax.jit(lambda v: v - 3.0, donate_argnums=(0,))
+    jitted._donate_argnums = (0,)
+    fn = guard.dispatch_guard(jitted, "donate-quiet")
+    snaps0 = obs_metrics.counter("guard.donation_snapshots").value
+    with guard.configured(
+        compile_timeout=None, dispatch_timeout=None, **FAST
+    ):
+        out = np.array(fn(jnp.arange(4.0)), copy=True)
+    np.testing.assert_array_equal(out, np.arange(4.0) - 3.0)
+    assert (
+        obs_metrics.counter("guard.donation_snapshots").value == snaps0
+    )
+
+
+def test_donation_env_hatch(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_DONATE", "0")
+    assert not guard.donation_enabled()
+    from pint_tpu.serve.session import serve_donate_argnums
+
+    assert serve_donate_argnums() is None
+    monkeypatch.delenv("PINT_TPU_DONATE")
+    assert guard.donation_enabled()
+    assert serve_donate_argnums() == (0, 1, 2)
+    assert serve_donate_argnums(6) == (0, 1, 2, 3, 4, 5)
+
+
+def test_fence_owned_survives_donated_buffer_recycling():
+    """fence_owned materializes host-OWNED bytes: deleting the jax
+    output and churning same-shape donating dispatches (which recycle
+    the freed buffer on CPU) cannot corrupt the fenced values."""
+    import jax.numpy as jnp
+
+    jitted = jax.jit(lambda v: v + 1.0, donate_argnums=(0,))
+    out = jitted(jnp.arange(512.0))
+    fenced = guard.fence_owned(out)
+    assert fenced.flags.owndata
+    del out
+    for k in range(4):
+        jitted(jnp.arange(512.0) * float(k))  # buffer churn
+    np.testing.assert_array_equal(fenced, np.arange(512.0) + 1.0)

@@ -47,6 +47,7 @@ from pint_tpu.serve.fabric import (
     LIVE,
     QUARANTINED,
     BatchWork,
+    FusedBatch,
     Replica,
     ReplicaPool,
     Router,
@@ -546,8 +547,8 @@ def test_merge_batch_works_row_alignment_and_padding():
 
 
 def _bare_replica():
-    """A thread-less Replica shell: enough state for the _coalesce
-    decision logic (FakeReplica precedent — unit-test the policy
+    """A thread-less Replica shell: enough state for the _coalesce and
+    _fuse decision logic (FakeReplica precedent — unit-test the policy
     without devices/threads)."""
     r = object.__new__(Replica)
     r.tag = "rX"
@@ -555,6 +556,10 @@ def _bare_replica():
     r._queue = collections.deque()
     r._kernels = {}
     r._coalesce_on = True
+    r._xkey_on = True
+    r._xkey_threshold = 4096
+    r._xkey_max = 4
+    r._overlap_on = True
     r._outstanding = 0
     r._g_out = obs_metrics.gauge("serve.replica.test.outstanding")
     return r
@@ -662,6 +667,213 @@ def test_coalesce_merges_queued_same_key_batches(pulsars):
         assert eng.stats()["fabric"]["coalesced"] >= 1
     finally:
         eng.close(timeout=60)
+
+
+# -- cross-key fused dispatches (ISSUE 12) --------------------------------
+def test_xkey_fuse_policy_gates():
+    """Unit-level fusion policy: distinct warmed identities fuse,
+    members keep their _outstanding units; cold members, no_fuse
+    retries, same-key neighbors, big buckets and the member cap all
+    leave the queue untouched."""
+    key_a = ("residuals", "compA", 64, True)
+    key_b = ("residuals", "compB", 64, True)
+    r = _bare_replica()
+    head = _mk_work(key_a, 2, 2, base=0)
+    r._queue.append(_mk_work(key_b, 1, 1, base=10))
+    r._outstanding = 2
+    # neither the combo nor the members' solo kernels warmed: no fuse
+    assert r._fuse(head) is head
+    assert len(r._queue) == 1
+    # solo-warm both members: fusion proceeds
+    r._kernels[(key_a, 2)] = lambda *a: None
+    r._kernels[(key_b, 1)] = lambda *a: None
+    fused = r._fuse(head)
+    assert isinstance(fused, FusedBatch)
+    assert {w.key for w in fused.members} == {key_a, key_b}
+    assert not r._queue
+    # members keep INDIVIDUAL outstanding units (each fences its own
+    # _batch_leaves at de-multiplex — unlike the coalescer's merge)
+    assert r._outstanding == 2
+    # combo identity: sorted member (key, cap) pairs, order matching
+    # fused.members (the wrapper's argument order)
+    idents = tuple(w.kernel_key() for w in fused.members)
+    assert idents == tuple(sorted(idents, key=repr))
+    assert fused.combo == ("xkey",) + idents
+    # a fused-failure retry (no_fuse) never re-fuses
+    head2 = _mk_work(key_a, 2, 2, base=0)
+    head2.no_fuse = True
+    r._queue.append(_mk_work(key_b, 1, 1, base=20))
+    r._outstanding = 2
+    assert r._fuse(head2) is head2
+    assert len(r._queue) == 1
+    # same-IDENTITY neighbors are the coalescer's business: a queued
+    # batch sharing (key, cap) with the head never joins a combo
+    r._queue.clear()
+    r._queue.append(_mk_work(key_a, 1, 2, base=30))
+    head3 = _mk_work(key_a, 2, 2, base=0)
+    assert r._fuse(head3) is head3
+    assert len(r._queue) == 1
+    # big buckets never fuse (bucket is key[2])
+    big = ("residuals", "compC", 8192, True)
+    r._queue.clear()
+    r._queue.append(_mk_work(key_b, 1, 1, base=40))
+    bighead = _mk_work(big, 1, 1, base=50)
+    r._kernels[(big, 1)] = lambda *a: None
+    assert r._fuse(bighead) is bighead
+    # the member cap bounds combo width
+    r._xkey_max = 2
+    key_c = ("residuals", "compC2", 64, True)
+    r._kernels[(key_c, 1)] = lambda *a: None
+    r._queue.clear()
+    r._queue.append(_mk_work(key_b, 1, 1, base=60))
+    r._queue.append(_mk_work(key_c, 1, 1, base=70))
+    fused2 = r._fuse(_mk_work(key_a, 2, 2, base=80))
+    assert isinstance(fused2, FusedBatch)
+    assert len(fused2.members) == 2
+    assert len(r._queue) == 1
+    # the hatch restores pass-through
+    r._xkey_on = False
+    r._queue.append(_mk_work(key_b, 1, 1, base=90))
+    head4 = _mk_work(key_a, 2, 2, base=100)
+    assert r._fuse(head4) is head4
+
+
+def test_xkey_fuse_disabled_by_env(monkeypatch, pulsars):
+    monkeypatch.setenv("PINT_TPU_SERVE_XKEY_FUSE", "0")
+    monkeypatch.setenv("PINT_TPU_SERVE_OVERLAP", "0")
+    eng = TimingEngine(max_batch=2, max_wait_ms=1.0, replicas=1)
+    try:
+        assert all(
+            not rep._xkey_on and not rep._overlap_on
+            for rep in eng.pool.replicas
+        )
+        w = _mk_work(("residuals", "comp", 64, True), 1, 1, base=0)
+        assert eng.pool.replica(0)._fuse(w) is w
+        assert not eng.router.xkey_fuse
+    finally:
+        eng.close(timeout=60)
+
+
+def test_xkey_fuse_bitwise_parity_and_zero_steady_retrace(pulsars):
+    """End-to-end: a residuals batch and a fit batch of DIFFERENT
+    group keys (distinct pars, padded buckets) co-resident behind a
+    stalled dispatch serve as ONE fused device call — the xkey counter
+    moves, every response is bitwise-identical to its solo-dispatch
+    warm-up, and the SECOND fused round (combo already traced) adds
+    zero traces and zero retraces."""
+    eng = TimingEngine(
+        max_batch=4, max_wait_ms=40.0, inflight=8, replicas=1,
+        max_queue=64,
+    )
+    try:
+        par_r, toas_r = pulsars[1]
+        par_f, toas_f = pulsars[2]
+
+        def residuals():
+            return eng.submit(
+                ResidualsRequest(par=par_r, toas=toas_r)
+            )
+
+        def fit():
+            return eng.submit(
+                FitRequest(par=par_f, toas=toas_f, maxiter=2)
+            )
+
+        # warm both solo kernels at capacity 1 (distinct keys: op
+        # differs, and the fit key carries mode/maxiter/tol)
+        warm_r = residuals().result(timeout=300)
+        warm_f = fit().result(timeout=300)
+        fused0 = obs_metrics.counter("serve.fabric.xkey_fused").value
+
+        def fused_round():
+            # stall the first residuals dispatch so the next
+            # residuals batch and the fit batch are co-resident in
+            # r0's queue when the dispatcher wakes
+            with faults.inject(
+                "hang:1@serve:residuals", hang_seconds=1.5
+            ):
+                first = residuals()
+                time.sleep(0.3)
+                rr = residuals()
+                ff = fit()
+                time.sleep(0.1)
+                return [
+                    f.result(timeout=300) for f in (first, rr, ff)
+                ]
+
+        out1 = fused_round()  # first fusion: traces the combo once
+        assert (
+            obs_metrics.counter("serve.fabric.xkey_fused").value
+            > fused0
+        )
+        traces0 = obs_metrics.counter("compile.traces").value
+        retraces0 = obs_metrics.counter("compile.recompiles").value
+        out2 = fused_round()  # steady state: warmed combo
+        assert (
+            obs_metrics.counter("compile.traces").value == traces0
+        )
+        assert (
+            obs_metrics.counter("compile.recompiles").value
+            == retraces0
+        )
+        for out in (out1, out2):
+            for r in out[:2]:
+                np.testing.assert_array_equal(
+                    r.residuals_s, warm_r.residuals_s
+                )
+                assert r.chi2 == warm_r.chi2
+            f = out[2]
+            np.testing.assert_array_equal(f.deltas, warm_f.deltas)
+            np.testing.assert_array_equal(
+                f.uncertainties, warm_f.uncertainties
+            )
+            assert f.chi2 == warm_f.chi2
+            assert f.fitted_par == warm_f.fitted_par
+    finally:
+        eng.close(timeout=60)
+
+
+def test_xkey_fused_failure_degrades_to_solo(pulsars):
+    """A NaN injected at the fused site fails typed, marks the
+    members no_fuse, and the re-routed solo retries still serve — the
+    fused overlay can never wedge work that succeeds unfused."""
+    eng = TimingEngine(
+        max_batch=4, max_wait_ms=40.0, inflight=8, replicas=2,
+        quarantine_n=10, max_queue=64,
+    )
+    try:
+        par_r, toas_r = pulsars[1]
+        par_f, toas_f = pulsars[2]
+        warm_r = eng.submit(
+            ResidualsRequest(par=par_r, toas=toas_r)
+        ).result(timeout=300)
+        eng.submit(
+            FitRequest(par=par_f, toas=toas_f, maxiter=2)
+        ).result(timeout=300)
+        # poison every xkey fused dispatch; solo dispatches are clean
+        with faults.inject("nan:inf@serve:xkey"):
+            with faults.inject(
+                "hang:1@serve:residuals", hang_seconds=1.5
+            ):
+                first = eng.submit(
+                    ResidualsRequest(par=par_r, toas=toas_r)
+                )
+                time.sleep(0.3)
+                rr = eng.submit(
+                    ResidualsRequest(par=par_r, toas=toas_r)
+                )
+                ff = eng.submit(
+                    FitRequest(par=par_f, toas=toas_f, maxiter=2)
+                )
+                out = [
+                    f.result(timeout=300) for f in (first, rr, ff)
+                ]
+        np.testing.assert_array_equal(
+            out[1].residuals_s, warm_r.residuals_s
+        )
+    finally:
+        eng.close(timeout=60)
+    _join_guard_threads()
 
 
 # -- drain guarantees -----------------------------------------------------

@@ -12,8 +12,11 @@ from .f64emu import RULE as F64EMU
 from .transport import RULE as TRANSPORT
 from .retrace import RULE as RETRACE
 from .locks import RULE as LOCKS
+from .perf1 import RULE as PERF1
 
-ALL_RULES = (SCALARMATH, *OBS_RULES, F64EMU, TRANSPORT, RETRACE, LOCKS)
+ALL_RULES = (
+    SCALARMATH, *OBS_RULES, F64EMU, TRANSPORT, RETRACE, LOCKS, PERF1
+)
 
 
 def rules_by_name() -> dict:

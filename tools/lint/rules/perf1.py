@@ -1,0 +1,202 @@
+"""Rule ``perf1``: use-after-donate (ISSUE 12).
+
+Buffer donation (``cm.jit(fn, donate=True)``, ``traced_jit(...,
+donate_argnums=...)``, bare ``jax.jit(..., donate_argnums=...)``)
+frees the donated device operands AT DISPATCH — jax invalidates them
+whether or not the call succeeds.  A later read of a donated variable
+is the classic use-after-free shape, and on the CPU test mesh it does
+NOT crash: numpy views of recycled XLA buffers silently read whatever
+the allocator wrote there next (the ISSUE 12 parity-gate incident —
+responses full of 6.9e-310 denormals).  Device-side it raises a
+runtime error only sometimes (sharded buffers), so the hazard is
+invisible to exactly the tests we run.
+
+Detection (per function scope, statement order): an assignment whose
+value is a donating-jit builder call makes the target a *donating
+wrapper*; a call of that wrapper marks every plain-name argument at a
+donated position as *consumed*; any later load of a consumed name in
+the same scope is flagged.  Rebinding the name first is clean (the
+fresh value owns fresh buffers), as are reads BEFORE the consuming
+call, ``donate=False`` wrappers, and non-name operands (calls,
+attributes — nothing aliasable survives the statement).
+
+The project check pins the donation contract's load-bearing
+chokepoints: ``CompiledModel.jit`` keeps its ``donate`` path,
+``traced_jit`` forwards ``donate_argnums``, the fused downhill loop
+donates its scan state, and the guard snapshots donated operands it
+may need to replay.
+
+Suppress with ``# lint: ok(perf1)`` plus a comment proving the read
+happens before any buffer recycling (e.g. under donation disabled).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..engine import Finding, Module, Rule
+from .obs import _check_needles
+
+#: donation-contract chokepoints (qualname needles, obs-rule idiom)
+_DONATION_CHECKS = (
+    ("models/timing_model.py", "CompiledModel.jit",
+     ("donate", "_donate_argnums"),
+     "cm.jit must keep the opt-in donation path and mark donating "
+     "wrappers for the guard's snapshot/replay contract"),
+    ("serve/session.py", "traced_jit",
+     ("donate_argnums", "quiet_unusable_donation("),
+     "serve kernels must keep forwarding donate_argnums (stacked "
+     "per-dispatch operands are the peak-memory win) and quiet the "
+     "expected unusable-donation warning"),
+    ("fitting/downhill.py", "DownhillFitter._fused_loop",
+     ("donate=True",),
+     "the fused downhill trajectory must donate its scan state — the "
+     "dispatch-floor peak-memory contract (docs/performance.md)"),
+    ("runtime/guard.py", "guarded_call",
+     ("snapshot_donated(",),
+     "the guard must snapshot donated operands before retryable "
+     "attempts — a retry with the original args reads freed buffers"),
+)
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _donated_positions(value):
+    """``True`` (all positions) / tuple of positions / ``None`` when
+    ``value`` is (not) a donating-jit builder call."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _call_name(value)
+    if name not in ("jit", "traced_jit"):
+        return None
+    for kw in value.keywords:
+        if kw.arg == "donate":
+            # cm.jit(fn, donate=True): every caller-visible position
+            if isinstance(kw.value, ast.Constant):
+                return True if kw.value.value else None
+            return True  # donate=<expr>: assume on
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and v.value is None:
+                return None
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts
+            ):
+                return tuple(e.value for e in v.elts)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            return True  # computed argnums: assume every position
+    return None
+
+
+def _scope_statements(scope):
+    """Nodes in ``scope`` in source order, excluding nested function
+    scopes (their own pass analyzes them — donation state does not
+    flow across scope boundaries here)."""
+    out = []
+    stack = list(
+        scope.body if hasattr(scope, "body") else []
+    )
+    while stack:
+        node = stack.pop(0)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue  # nested scope: analyzed by its own pass
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return sorted(
+        out,
+        key=lambda n: (getattr(n, "lineno", 0),
+                       getattr(n, "col_offset", 0)),
+    )
+
+
+class Perf1Rule(Rule):
+    """Use-after-donate: a variable passed at a donated position of a
+    donating-jit wrapper is read again later in the same scope."""
+
+    name = "perf1"
+
+    def _check_scope(self, mod: Module, scope) -> list:
+        findings = []
+        wrappers: dict = {}   # name -> True | tuple(positions)
+        consumed: dict = {}   # var name -> consuming wrapper name
+        call_args: set = set()  # id() of loads at consumption sites
+        for node in _scope_statements(mod.tree if scope is None
+                                      else scope):
+            if isinstance(node, ast.Assign):
+                posns = _donated_positions(node.value)
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if posns is not None:
+                        wrappers[t.id] = posns
+                    else:
+                        wrappers.pop(t.id, None)
+                    # rebinding owns fresh buffers
+                    consumed.pop(t.id, None)
+                continue
+            if isinstance(node, ast.Call):
+                fname = _call_name(node)
+                posns = wrappers.get(fname) if isinstance(
+                    node.func, ast.Name) else None
+                if posns is not None:
+                    for i, arg in enumerate(node.args):
+                        if posns is not True and i not in posns:
+                            continue
+                        if isinstance(arg, ast.Name):
+                            consumed[arg.id] = fname
+                            call_args.add(id(arg))
+                continue
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in consumed
+                    and id(node) not in call_args):
+                findings.append(Finding(
+                    self.name, mod.path, node.lineno,
+                    f"{node.id!r} read after being donated to "
+                    f"{consumed[node.id]!r} — jax freed its device "
+                    "buffers at dispatch; on CPU this reads recycled "
+                    "memory silently.  Rebind the name, or pass a "
+                    "fresh operand (docs/performance.md "
+                    "'dispatch floor')",
+                ))
+                del consumed[node.id]  # one finding per consumption
+        return findings
+
+    def check_module(self, mod: Module) -> list:
+        findings = self._check_scope(mod, None)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings += self._check_scope(mod, node)
+        return sorted(findings, key=lambda f: f.lineno)
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the donation chokepoints existing: the lint
+        # framework's unit-test fixture packages are stripped trees
+        if not (pkg_root / "runtime" / "guard.py").is_file():
+            return []
+        findings = []
+        for rel, qual, needles, why in _DONATION_CHECKS:
+            path = pkg_root / rel
+            if not path.is_file():
+                continue
+            findings += _check_needles(
+                self.name, path, qual, needles, why
+            )
+        return findings
+
+
+RULE = Perf1Rule()
