@@ -365,6 +365,177 @@ def _fit_traj_block(t_dev=None):
     }
 
 
+#: bf16 MXU peak of the bench TPU generation (shared accounting with
+#: profiling/run_benchmarks.py and profiling/mfu.py — model MFU is
+#: model-FLOPs / wall / this peak, a LOWER bound on true utilization)
+PEAK_BF16_FLOPS = 197e12
+
+
+def _mfu_time_op(fn, arg, nrep=3, chain=16):
+    """Chained dependent timing (>=16 rule: the ~85 ms tunnel
+    round-trip amortizes 1/chain; scalar feedback keeps steps
+    dependent, scalar output keeps the host copy off the clock)."""
+    import jax
+
+    @jax.jit
+    def run(A):
+        def body(c, _):
+            L = fn(c)
+            return (c + 1e-30 * L[0, 0]), L[0, 0]
+
+        _, ls = jax.lax.scan(body, A, None, length=chain)
+        return ls[-1]
+
+    _ = float(np.asarray(run(arg)))
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        _ = float(np.asarray(run(arg)))
+        ts.append((time.perf_counter() - t0) / chain)
+    return float(np.median(ts))
+
+
+def _mfu_block(cm):
+    """ISSUE 13 `mfu` block: arithmetic utilization of the two solve
+    paths every serve fit funnels through, plus the solve-policy
+    parity gate.
+
+    dense rung — blocked_cholesky(precision='highest', the 6-pass
+    accuracy-bearing factorization) vs fast_cholesky32 (bf16x3 'high'
+    trailing GEMMs, the IR preconditioner) on an equilibrated operand;
+    GF/s and model MFU (n^3/3 model FLOPs over the bf16 peak).  GATE
+    on accelerators: the bf16x3 recipe must hold >= 1.3x over the
+    6-pass rung — the multipass win ISSUE 13 banks.
+
+    woodbury rung — per-solve latency of the k x k Sigma IR solve
+    (ops/ffgram.py::chol_solve_ir) on the bench model's real basis.
+
+    parity gate (ALL backends) — one mixed GLS step with the policy
+    FORCED vs OFF must agree within the _woodbury_mixed_tail contract
+    (dx 2e-3 of the largest component, chi2 1e-3 relative, normalized
+    covariance 5e-3).  A violation raises PintTpuError: the policy
+    may never trade correctness for MFU silently."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.exceptions import PintTpuError
+    from pint_tpu.parallel.dense import blocked_cholesky, fast_cholesky32
+
+    accel = jax.default_backend() != "cpu"
+    n = 8192 if accel else 1024
+
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(n, 64)).astype(np.float32)
+    C = W @ W.T + n * np.eye(n, dtype=np.float32)
+    d = np.sqrt(np.diag(C))
+    Ceq = jnp.asarray((C / np.outer(d, d)).astype(np.float32))
+    flops = n**3 / 3
+
+    t_highest = _mfu_time_op(
+        lambda A: blocked_cholesky(A, block=512, precision="highest",
+                                   diag_bump=3e-5),
+        Ceq,
+    )
+    t_fast = _mfu_time_op(fast_cholesky32, Ceq)
+    speedup = t_highest / t_fast
+    if accel and speedup < 1.3:
+        raise PintTpuError(
+            f"mfu gate: bf16x3 fast_cholesky32 at n={n} is only "
+            f"{speedup:.2f}x over the 6-pass HIGHEST factorization "
+            "(gate >= 1.3x) — the multipass trailing GEMM lost its "
+            "advantage (driver regression gate, ISSUE 13)"
+        )
+
+    # woodbury rung: the real bench-model Sigma solve
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.ops.ffgram import chol_solve_ir, gram32_joint
+
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Ninv = 1.0 / jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    from pint_tpu.fitting.gls import _column_norms
+
+    norm = _column_norms(M)
+    X = jnp.concatenate([M / norm[None, :], r[:, None]], axis=1)
+    sig_tt, twx, _ = gram32_joint(T.astype(jnp.float32), X, Ninv)
+    Sigma = jnp.diag(1.0 / phi) + sig_tt
+    k = int(Sigma.shape[0])
+    t_wood = _mfu_time_op(
+        lambda S: chol_solve_ir(S, twx, check_rtol=1e-5), Sigma
+    )
+
+    # parity gate: the policy forced vs off, fresh traces each (the
+    # env is read at trace time — ops/solve_policy.py)
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+
+    def _step_under(setting):
+        saved = os.environ.get("PINT_TPU_SOLVE_IR")
+        os.environ["PINT_TPU_SOLVE_IR"] = setting
+
+        @jax.jit
+        def stepfn(xx):
+            rr = cm.time_residuals(xx, subtract_mean=False)
+            MM = design_with_offset(cm, xx)
+            Nd = jnp.square(cm.scaled_sigma(xx))
+            TT, pp = cm.noise_basis_or_empty(xx)
+            return gls_step_woodbury_mixed(
+                rr, MM, Nd, TT, pp, normalized_cov=True
+            )
+
+        try:
+            dx, (covn, nm), chi2, _ = stepfn(x)
+            return (np.asarray(dx), np.asarray(covn),
+                    float(chi2))
+        finally:
+            if saved is None:
+                os.environ.pop("PINT_TPU_SOLVE_IR", None)
+            else:
+                os.environ["PINT_TPU_SOLVE_IR"] = saved
+
+    dx_off, cov_off, chi_off = _step_under("0")
+    dx_on, cov_on, chi_on = _step_under("force")
+    dx_rel = float(np.max(np.abs(dx_on - dx_off))
+                   / np.max(np.abs(dx_off)))
+    chi_rel = abs(chi_on - chi_off) / abs(chi_off)
+    cov_rel = float(np.max(np.abs(cov_on - cov_off))
+                    / np.max(np.abs(cov_off)))
+    # inverted comparisons: a NaN (poisoned or diverged IR step) must
+    # FAIL the gate, and `nan > tol` is False
+    if not (dx_rel <= 2e-3 and chi_rel <= 1e-3 and cov_rel <= 5e-3):
+        raise PintTpuError(
+            "mfu gate: IR'd mixed step diverged from the exact-policy "
+            f"step (dx_rel={dx_rel:.2e} gate 2e-3, chi2_rel="
+            f"{chi_rel:.2e} gate 1e-3, cov_rel={cov_rel:.2e} gate "
+            "5e-3; nan = poisoned solve) — the solve policy broke the "
+            "_woodbury_mixed_tail contract (ISSUE 13)"
+        )
+
+    return {
+        "dense_n": n,
+        "dense_highest_ms": round(t_highest * 1e3, 2),
+        "dense_bf16x3_ms": round(t_fast * 1e3, 2),
+        "dense_highest_gflops": round(flops / t_highest / 1e9, 1),
+        "dense_bf16x3_gflops": round(flops / t_fast / 1e9, 1),
+        "dense_bf16x3_mfu_vs_bf16_peak": round(
+            flops / t_fast / PEAK_BF16_FLOPS, 4
+        ),
+        "dense_speedup_x": round(speedup, 2),
+        "dense_speedup_gate": ">=1.3x on accelerators",
+        "woodbury_k": k,
+        "woodbury_solve_ms": round(t_wood * 1e3, 3),
+        "parity": {
+            "dx_rel": round(dx_rel, 9),
+            "chi2_rel": round(chi_rel, 9),
+            "cov_rel": round(cov_rel, 9),
+            "gates": "dx<=2e-3 chi2<=1e-3 cov<=5e-3 (all backends)",
+        },
+    }
+
+
 def _serve_block():
     """Serving telemetry for BENCH_*.json (ISSUE 4 — pint_tpu/serve):
     a mixed-size fleet of same-composition pulsars served as fits,
@@ -1239,6 +1410,7 @@ def main():
     obs_block = _obs_block()
     fit_traj_block = _fit_traj_block(t_dev)
     serve_block = _serve_block()
+    mfu_block = _mfu_block(cm)
 
     # CPU baseline: the all-f64 reference-class computation on host
     # (dispatch-free, so a short chain measures the same steady state).
@@ -1306,6 +1478,7 @@ def main():
                 "obs": obs_block,
                 "fit_traj": fit_traj_block,
                 "serve": serve_block,
+                "mfu": mfu_block,
                 "cold": {
                     **cold_block,
                     # executables persisted by THIS run: >0 on a cold

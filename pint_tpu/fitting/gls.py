@@ -70,7 +70,8 @@ def _eigh_threshold_solve(A, b, threshold=None):
     return Vw @ (V.T @ b), Vw @ V.T, jnp.sum(bad)
 
 
-def _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov=False):
+def _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov=False,
+                       ir=False):
     """Shared normal-equation tail for every GLS flavor: thresholded
     solve, covariance, chi2 = r^T C^-1 r minus the fitted decrement
     dx^T b (removes the offset-column power, matching the reference),
@@ -81,8 +82,37 @@ def _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov=False):
     unnormalized variance of a stiff column (F1 ~ 1e-40 s^-4) sits
     BELOW the f32 exponent range that axon's emulated f64 inherits and
     flushes to zero on device; fitters unnormalize on the host in IEEE
-    f64 (Fitter._unnorm_cov)."""
-    dxn, covn, nbad = _eigh_threshold_solve(A, b)
+    f64 (Fitter._unnorm_cov).
+
+    ir=True opts this solve into the per-solve precision policy
+    (ops/solve_policy.py): when the policy is active (accelerator
+    backends, PINT_TPU_SOLVE_IR!=0) the p x p system solves as an
+    equilibrated f32 Cholesky + f64 iterative refinement with the
+    residual check, replacing an emulated-f64 eigh that is both slow
+    AND only ~f32-accurate on chip (docs/precision.md).  The trade is
+    degeneracy semantics: the eigh shim zeroes near-degenerate
+    directions (min-norm + DegeneracyWarning count); the IR path has
+    no spectral view, so a degenerate system NaNs the Cholesky, fails
+    the residual check, and the fallback ladder re-serves the fit from
+    the f64 rung — where the eigh semantics still live.  The mixed
+    paths pass ir=True; the f64 paths never do, keeping the ladder's
+    landing spot strict."""
+    from pint_tpu.ops import solve_policy
+
+    if ir and solve_policy.ir_active():
+        from pint_tpu.ops.ffgram import chol_solve_ir
+
+        p = A.shape[0]
+        X = chol_solve_ir(
+            A, jnp.concatenate([b[:, None], jnp.eye(p)], axis=1),
+            cholesky=solve_policy.ir_cholesky(p),
+            check_rtol=solve_policy.check_rtol(),
+        )
+        dxn = X[:, 0]
+        covn = 0.5 * (X[:, 1:] + X[:, 1:].T)  # A^-1, symmetrized
+        nbad = jnp.zeros((), jnp.int32)  # degeneracy -> NaN -> ladder
+    else:
+        dxn, covn, nbad = _eigh_threshold_solve(A, b)
     chi2 = r_cinv_r - jnp.dot(dxn, b)
     if normalized_cov:
         return dxn / norm, (covn, norm), chi2, nbad
@@ -162,17 +192,28 @@ def _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
     largest component, chi2 <1e-3 relative, uncertainties <5e-3;
     iterated fits within ~1e-2 sigma.
     """
+    from pint_tpu.ops import solve_policy
     from pint_tpu.ops.ffgram import chol_solve_ir
 
     A_white = G_XX[:-1, :-1]
     b_white = G_XX[:-1, -1]
     r_Nr = G_XX[-1, -1]
     Sigma = jnp.diag(1.0 / phi) + sig_tt
-    corr = chol_solve_ir(Sigma, twx)  # Sigma^-1 T^T N^-1 [Mn | r]
+    # Sigma^-1 T^T N^-1 [Mn | r]: under the solve policy (accelerator
+    # backends) the k x k factorization takes the bf16x3 blocked
+    # kernel at large k and arms the residual check; with
+    # PINT_TPU_SOLVE_IR=0 both kwargs are None — bitwise the
+    # pre-policy call (ops/solve_policy.py)
+    corr = chol_solve_ir(
+        Sigma, twx,
+        cholesky=solve_policy.ir_cholesky(Sigma.shape[0]),
+        check_rtol=solve_policy.check_rtol(),
+    )
     A = A_white - twx[:, :-1].T @ corr[:, :-1]
     b = -(b_white - twx[:, :-1].T @ corr[:, -1])
     r_cinv_r = r_Nr - jnp.dot(twx[:, -1], corr[:, -1])
-    return _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov)
+    return _finish_normal_eqs(A, b, r_cinv_r, norm, normalized_cov,
+                              ir=True)
 
 
 def gls_step_woodbury_fourier(r, M, Ndiag, t_sec, freqs, phi,
@@ -301,6 +342,7 @@ def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
     if method is None:
         method = "f64" if jax.default_backend() == "cpu" else "mixed"
     if method == "mixed" and T is not None:
+        from pint_tpu.ops import solve_policy
         from pint_tpu.ops.ffgram import (
             matmul_split32, woodbury_chol_solve_ir,
         )
@@ -331,27 +373,37 @@ def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
             from pint_tpu.parallel.dense import fast_cholesky32
 
             CiX = woodbury_chol_solve_ir(
-                Ndiag, T, phi, X, cholesky=fast_cholesky32
+                Ndiag, T, phi, X, cholesky=fast_cholesky32,
+                check_rtol=solve_policy.check_rtol(),
             )
         else:
-            CiX = woodbury_chol_solve_ir(Ndiag, T, phi, X)
+            CiX = woodbury_chol_solve_ir(
+                Ndiag, T, phi, X,
+                check_rtol=solve_policy.check_rtol(),
+            )
         # X^T C^-1 X on the MXU (an n x (p+1) emulated-f64 matmul
         # would cost more than the factorization on TPU)
         G = matmul_split32(X.T, CiX)
         return _finish_normal_eqs(
-            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
+            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov,
+            ir=True,
         )
     C = dense_noise_cov(Ndiag, T, phi)
     if method == "mixed":  # pure-white C: small/diagonal, dense is fine
+        from pint_tpu.ops import solve_policy
         from pint_tpu.ops.ffgram import chol_solve_ir, matmul_split32
 
         norm = _column_norms(M)
         Mn = M / norm[None, :]
         X = jnp.concatenate([Mn, r[:, None]], axis=1)
-        CiX = chol_solve_ir(C, X)
+        CiX = chol_solve_ir(
+            C, X, cholesky=solve_policy.ir_cholesky(C.shape[0]),
+            check_rtol=solve_policy.check_rtol(),
+        )
         G = matmul_split32(X.T, CiX)
         return _finish_normal_eqs(
-            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
+            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov,
+            ir=True,
         )
     L = jnp.linalg.cholesky(C)
 

@@ -134,8 +134,24 @@ def matmul_split32(A, B, chunk: int = 128):
     return make_matmul_split32(A, chunk)(B)
 
 
+def _check_poison(X, Req, Beq, check_rtol):
+    """Shared residual-check tail of the IR solves: NaN-poison the
+    solution when the final equilibrated residual exceeds
+    ``check_rtol`` relative to the equilibrated RHS.  A plain
+    ``jnp.where`` on a scalar predicate — NEVER ``lax.cond``, which
+    the vmapped serve dispatches would lower to a both-branches
+    select — so the poisoned value flows to the shared finite
+    validator (runtime/guard.py::ensure_scan_finite) and the fallback
+    ladder re-serves the fit from the strict f64 rung
+    (ops/solve_policy.py documents the policy).  Formulated as a
+    product compare (|R| <= rtol * |B|) so no epsilon guard is needed:
+    an exactly-zero RHS has an exactly-zero residual and passes."""
+    ok = jnp.max(jnp.abs(Req)) <= check_rtol * jnp.max(jnp.abs(Beq))
+    return jnp.where(ok, X, jnp.nan)
+
+
 def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
-                           cholesky=None):
+                           cholesky=None, check_rtol=None):
     """Solve (diag(N) + T diag(phi) T^T) X = B (f64) WITHOUT ever
     materializing the dense f64 covariance.
 
@@ -152,6 +168,12 @@ def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
     Assembly accuracy: C32 is built from the EXACT diagonal (f64,
     then rounded) and an f32 rank-k GEMM of W = D^-1/2 T sqrt(phi) —
     an O(eps32) perturbation of the preconditioner only.
+
+    ``check_rtol`` (None = no check, the exact pre-ISSUE-13 call)
+    arms the post-refinement residual check: the final solution is
+    NaN-poisoned when its equilibrated residual exceeds check_rtol
+    relative to the RHS, feeding the guard/fallback ladder instead of
+    returning a stalled-IR answer (see _check_poison).
     """
     if cholesky is None:
         cholesky = jnp.linalg.cholesky
@@ -204,10 +226,13 @@ def woodbury_chol_solve_ir(Ndiag, T, phi, B, refine: int = 2,
     X = solve32(Beq)
     for _ in range(refine):
         X = X + solve32(Beq - apply_true(X))
+    if check_rtol is not None:
+        X = _check_poison(X, Beq - apply_true(X), Beq, check_rtol)
     return X * dinv[:, None]
 
 
-def chol_solve_ir(A, B, refine: int = 2, cholesky=None):
+def chol_solve_ir(A, B, refine: int = 2, cholesky=None,
+                  check_rtol=None):
     """Solve SPD A X = B (f64) with an f32 Cholesky + f64 iterative
     refinement.  Jacobi equilibration first: power-law red-noise
     Woodbury matrices have ~1e10 dynamic range on the diagonal, beyond
@@ -220,8 +245,12 @@ def chol_solve_ir(A, B, refine: int = 2, cholesky=None):
     solve on TPU).
 
     `cholesky` swaps the factorization (default jnp.linalg.cholesky;
-    parallel/dense.py passes its mesh-sharded blocked variant) — ONE
-    copy of the equilibration+IR recipe serves both.
+    parallel/dense.py passes its mesh-sharded blocked variant, the
+    solve policy the bf16x3 fast_cholesky32 at large n) — ONE copy of
+    the equilibration+IR recipe serves all of them.  ``check_rtol``
+    (None = no check, the exact pre-ISSUE-13 call) arms the
+    post-refinement residual check — see _check_poison and
+    ops/solve_policy.py for the poison-to-ladder contract.
     """
     if cholesky is None:
         cholesky = jnp.linalg.cholesky
@@ -249,4 +278,6 @@ def chol_solve_ir(A, B, refine: int = 2, cholesky=None):
     X = solve32(Beq)
     for _ in range(refine):
         X = X + solve32(Beq - mm(X))
+    if check_rtol is not None:
+        X = _check_poison(X, Beq - mm(X), Beq, check_rtol)
     return X * dinv[:, None]

@@ -18,6 +18,17 @@ host-precomputed f64 basis (models/noise.py::fourier_basis) and
 f32-Grams it on the MXU — as fast, and f64-basis accurate.  These
 kernels remain the answer when n*2k is too large to materialize.
 On CPU the kernels run in interpret mode (tests exercise both).
+
+MXU pass ladder (ISSUE 13): the in-kernel contractions take an
+explicit `precision` ('highest'|'high'|'default') mapped onto the
+bf16 multi-pass ladder — 6-pass (~f32-exact), 3-pass bf16x3 (~1e-6
+rel, preconditioner-grade: legal only under an IR consumer, the
+ops/solve_policy.py contract), and single-pass bf16 (~1e-3 rel, only
+for probing the roofline in profiling/mfu.py).  The default is
+'highest': the Gram accumulates n/BN block outer products, and at
+PTA n the single-pass ~1e-3 relative error in Sigma rivals the 1e-5
+phase-argument error this docstring already concedes — interpret-mode
+CPU ignores the knob entirely, so tier-1 behavior is unchanged.
 """
 
 from __future__ import annotations
@@ -39,7 +50,19 @@ _enable_x64 = getattr(jax, "enable_x64", None)
 if _enable_x64 is None:  # pre-move jax
     from jax.experimental import enable_x64 as _enable_x64
 
+# lint: module(matmul-highest) — in-kernel dot_generals carry an
+# explicit precision from the pass ladder below (rule f64-emu)
+# lint: module(ir-refined) — the 'high' rung is preconditioner-grade
+# by the solve_policy contract (rule f64-emu check 5)
+
 _TWO_PI = 2.0 * math.pi
+
+#: bf16 multi-pass ladder for the in-kernel MXU contractions
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
 
 
 def _pad_to(x: int, m: int) -> int:
@@ -60,7 +83,7 @@ def _on_cpu() -> bool:
 # ---------------------------------------------------------------------- #
 # fourier_gram: Sigma = T^T diag(w) T, TWX = T^T diag(w) X, streaming
 # ---------------------------------------------------------------------- #
-def _gram_kernel(t_ref, w_ref, x_ref, f_ref, sig_ref, twx_ref):
+def _gram_kernel(prec, t_ref, w_ref, x_ref, f_ref, sig_ref, twx_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -78,21 +101,26 @@ def _gram_kernel(t_ref, w_ref, x_ref, f_ref, sig_ref, twx_ref):
     sig_ref[:] += jax.lax.dot_general(
         Tw, T, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=prec,
     )
     twx_ref[:] += jax.lax.dot_general(
         Tw, x_ref[:], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=prec,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def fourier_gram(t, freqs, w, X, block: int = 8192):
+@functools.partial(jax.jit, static_argnames=("block", "precision"))
+def fourier_gram(t, freqs, w, X, block: int = 8192,
+                 precision: str = "highest"):
     """(Sigma (2k, 2k), TWX (2k, p)) for T = [sin(2pi f t); cos(...)]^T
     without materializing T.
 
     t (n,) seconds; freqs (k,) Hz; w (n,) weights; X (n, p).
     f32 compute; zero-padding on every axis is exact (padded TOAs get
     w = 0; padded columns produce zero rows/cols that are sliced off).
+    `precision` selects the MXU pass ladder for the in-kernel
+    contractions (module docstring); CPU interpret mode ignores it.
     Traced under enable_x64(False): Mosaic cannot legalize the int64
     grid indices that global x64 mode would produce.
     """
@@ -103,10 +131,12 @@ def fourier_gram(t, freqs, w, X, block: int = 8192):
         a.astype(jnp.float32) for a in (t, freqs, w, X)
     )
     with _enable_x64(False):
-        return _fourier_gram_32(t, freqs, w, X, block)
+        return _fourier_gram_32(
+            t, freqs, w, X, block, _PRECISIONS[precision]
+        )
 
 
-def _fourier_gram_32(t, freqs, w, X, block):
+def _fourier_gram_32(t, freqs, w, X, block, prec):
     n = t.shape[0]
     k = freqs.shape[0]
     p = X.shape[1]
@@ -130,7 +160,7 @@ def _fourier_gram_32(t, freqs, w, X, block):
 
     grid = (n_pad // bn,)
     sig, twx = pl.pallas_call(
-        _gram_kernel,
+        functools.partial(_gram_kernel, prec),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bn), lambda i: (0, i)),
@@ -164,7 +194,7 @@ def _fourier_gram_32(t, freqs, w, X, block):
 # ---------------------------------------------------------------------- #
 # fourier_apply: y = T z, streaming
 # ---------------------------------------------------------------------- #
-def _apply_kernel(t_ref, z_ref, f_ref, y_ref):
+def _apply_kernel(prec, t_ref, z_ref, f_ref, y_ref):
     t = t_ref[0, :]  # (BN,)
     f = f_ref[:, 0]
     arg = _TWO_PI * f[:, None] * t[None, :]  # (K, BN)
@@ -172,20 +202,24 @@ def _apply_kernel(t_ref, z_ref, f_ref, y_ref):
     y_ref[:] = jax.lax.dot_general(
         T, z_ref[:], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=prec,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def fourier_apply(t, freqs, z, block: int = 8192):
+@functools.partial(jax.jit, static_argnames=("block", "precision"))
+def fourier_apply(t, freqs, z, block: int = 8192,
+                  precision: str = "highest"):
     """y (n, m) = T z for T = [sin | cos] basis, without materializing
-    T; z (2k, m)."""
+    T; z (2k, m).  `precision` as in fourier_gram."""
     # pre-context f32 cast: see fourier_gram
     t, freqs, z = (a.astype(jnp.float32) for a in (t, freqs, z))
     with _enable_x64(False):
-        return _fourier_apply_32(t, freqs, z, block)
+        return _fourier_apply_32(
+            t, freqs, z, block, _PRECISIONS[precision]
+        )
 
 
-def _fourier_apply_32(t, freqs, z, block):
+def _fourier_apply_32(t, freqs, z, block, prec):
     n = t.shape[0]
     k = freqs.shape[0]
     m = z.shape[1]
@@ -206,7 +240,7 @@ def _fourier_apply_32(t, freqs, z, block):
 
     grid = (n_pad // bn,)
     y = pl.pallas_call(
-        _apply_kernel,
+        functools.partial(_apply_kernel, prec),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bn), lambda i: (0, i)),

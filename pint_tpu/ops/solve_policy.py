@@ -1,0 +1,111 @@
+"""Per-solve precision policy for the iteratively-refined GLS solves.
+
+The MFU campaign (ISSUE 13 / ROADMAP item 2b) pushes the
+``fast_cholesky32`` recipe — bf16x3 'high' trailing GEMMs, equilibrated
+f32 factor, f64 iterative refinement — down into the Woodbury hot loop:
+the k x k Sigma factorization (fitting/gls.py::_woodbury_mixed_tail)
+and the p x p normal-equation solve (fitting/gls.py::
+_finish_normal_eqs, which otherwise pays an emulated-f64 eigh per step
+on accelerators — only ~f32-accurate there anyway, docs/precision.md).
+This module is the ONE place that decides, per solve, whether the IR
+recipe applies and with which factorization:
+
+- **Backend gate** (:func:`ir_active`): the policy is accelerator-only.
+  CPU backends keep the exact f64 paths — IEEE f64 is native there and
+  the eigh degeneracy semantics are the reference behavior.
+  ``PINT_TPU_SOLVE_IR=0`` restores the pre-policy behavior EXACTLY on
+  every backend (callers pass ``cholesky=None, check_rtol=None`` —
+  bitwise the old call); ``PINT_TPU_SOLVE_IR=force`` enables the
+  policy on CPU too (tests + the bench parity gate exercise the IR
+  code path deterministically on the CPU mesh).
+
+- **Size policy** (:func:`ir_cholesky`): below
+  :data:`IR_BLOCKED_MIN` the equilibrated f32 factorization uses XLA's
+  native Cholesky (the blocked kernel only adds compile time where the
+  factorization is not the bottleneck — the r5 selection-window
+  finding); at or above it, ``parallel/dense.py::fast_cholesky32``
+  (bf16x3 'high' trailing GEMMs, per-block ridge, unroll-capped).
+
+- **Condition policy = the residual check** (:func:`check_rtol`): the
+  true condition number is not observable at trace time, so the policy
+  is *optimistic with a dynamic probe*: Jacobi equilibration removes
+  the benign ~1e10 diagonal dynamic range of power-law Woodbury
+  matrices, and the post-refinement residual check inside
+  ``ops/ffgram.py::chol_solve_ir``/``woodbury_chol_solve_ir`` catches
+  the genuinely-ill-conditioned remainder (equilibrated cond beyond
+  f32's ~1/eps32 reach, where IR stalls): a failed check NaN-poisons
+  the solve INSIDE the jitted program (``jnp.where`` — never
+  ``lax.cond``, which vmapped serve dispatches would execute
+  both-branch), the shared finite validator refuses the result, and
+  the fallback ladder (runtime/fallback.py) re-serves the fit from the
+  strict all-f64 rung.  The f64 rung never takes the IR path, so the
+  degradation target always exists.
+
+Every knob is read at TRACE time (plain env reads in Python): the
+policy is static per compiled kernel, so serve steady state can never
+retrace on it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+#: smallest equilibrated operand routed to the blocked bf16x3
+#: factorization (below it XLA's native f32 Cholesky wins on compile
+#: time; the r5 cholesky_sweep selection window)
+IR_BLOCKED_MIN = 2048
+
+#: default relative residual-check tolerance: the large-n refinement
+#: residual is computed through the split-f32 matmul (~1e-7 relative
+#: floor — ops/ffgram.py), and a converged IR sits at that floor while
+#: a stalled one sits at O(1); 1e-5 separates them with two orders of
+#: margin on each side.
+DEFAULT_CHECK_RTOL = 1e-5
+
+
+def ir_setting() -> str:
+    return os.environ.get("PINT_TPU_SOLVE_IR", "1").strip().lower()
+
+
+def ir_active() -> bool:
+    """Whether the IR'd solve policy applies to the current backend."""
+    s = ir_setting()
+    if s in ("0", "off", "false", ""):
+        return False
+    if s == "force":  # tests / bench parity gate: IR on the CPU mesh
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def check_rtol() -> float | None:
+    """Residual-check tolerance when the policy is active, else None
+    (None = no check = the exact pre-policy call)."""
+    if not ir_active():
+        return None
+    return float(
+        os.environ.get("PINT_TPU_SOLVE_IR_RTOL", str(DEFAULT_CHECK_RTOL))
+    )
+
+
+def ir_cholesky(n: int):
+    """The equilibrated-f32 factorization for an (n, n) solve under the
+    policy: None (= XLA native Cholesky inside chol_solve_ir) below
+    IR_BLOCKED_MIN, the bf16x3 blocked kernel at or above it.  Returns
+    None when the policy is inactive — callers pass the result
+    straight through, restoring the exact pre-policy call."""
+    if not ir_active() or n < IR_BLOCKED_MIN:
+        return None
+    from pint_tpu.parallel.dense import fast_cholesky32
+
+    return fast_cholesky32
+
+
+def dense_lookahead() -> bool:
+    """Whether blocked_cholesky uses the lookahead/double-buffered
+    trailing-update schedule (PINT_TPU_DENSE_LOOKAHEAD, default on;
+    ``0`` restores the sequential right-looking schedule bitwise)."""
+    return os.environ.get(
+        "PINT_TPU_DENSE_LOOKAHEAD", "1"
+    ).strip().lower() not in ("0", "off", "false")

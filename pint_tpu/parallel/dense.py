@@ -32,6 +32,10 @@ from pint_tpu.fitting.gls import _column_norms, _finish_normal_eqs
 # lint: module(matmul-highest) — every matmul here carries an explicit
 # precision: a single default bf16 pass NaNs the Schur cancellation
 # (see blocked_cholesky's precision note; tools/lint rule f64-emu)
+# lint: module(ir-refined) — the 'high' (bf16x3) trailing GEMMs here
+# are preconditioner-grade by contract: f64 iterative refinement with
+# the TRUE operator sits on top (fast_cholesky32 / chol_solve_ir;
+# tools/lint rule f64-emu check 5)
 
 
 def _constrain(mesh, x, spec):
@@ -42,9 +46,85 @@ def _constrain(mesh, x, spec):
     )
 
 
+def _panel_factor(Cc, block, prec, panel, bump, eye):
+    """Factor one panel from its fully-updated block column Cc
+    ((m, b): diagonal block on top, sub-column below) — the shared
+    diagonal-factorization + panel-solve step of both schedules."""
+    D = Cc[:block, :block]
+    if bump is not None:
+        D = D + bump
+    Ld = jnp.linalg.cholesky(D)  # replicated
+    if panel == "inv":
+        Ldinv = jax.scipy.linalg.solve_triangular(Ld, eye, lower=True)
+        pan = jnp.matmul(Cc[block:], Ldinv.T, precision=prec)
+    else:
+        pan = jax.scipy.linalg.solve_triangular(
+            Ld, Cc[block:].T, lower=True
+        ).T
+    return Ld, pan
+
+
+def _lookahead_factor(A, npad, block, mesh, axis, prec, panel, bump,
+                      eye, update_chunks):
+    """Depth-1 lookahead schedule for blocked_cholesky (ISSUE 13).
+
+    Loop invariant: A is the trailing matrix whose FIRST block column
+    already carries every earlier panel's Schur update, and (Ld, pan)
+    — the last col_blocks entry — is that column's factorization,
+    computed BEFORE the previous iteration's remainder GEMM was
+    needed.  Each iteration then (a) forms ONLY the next block
+    column's update, a thin (m, b) GEMM, and factors panel j+1 from
+    it immediately; (b) applies the remainder of panel j's trailing
+    update — the big sharded GEMM — in update_chunks independent
+    column groups.  (b) has no data dependency on panel j+1's serial
+    O(b^3) factorization/panel-solve, so the compiler can run them
+    concurrently, and each chunk's inter-shard pan gather can overlap
+    a neighboring chunk's local GEMM."""
+    if update_chunks <= 0:
+        update_chunks = 2 if mesh is not None else 1
+    nblk = npad // block
+    # remote-compile budget: the sequential schedule emits ~nblk
+    # trailing GEMMs; cap the chunked count at ~2x that (CLAUDE.md's
+    # n=32768 transport limit)
+    while update_chunks > 1 and nblk * (update_chunks + 1) > 96:
+        update_chunks -= 1
+    A = _constrain(mesh, A, P(axis, None))
+    col_blocks = [_panel_factor(A[:, :block], block, prec, panel,
+                                bump, eye)]
+    for _ in range(nblk - 1):
+        _, pan = col_blocks[-1]
+        pan = _constrain(mesh, pan, P(axis, None))
+        m = A.shape[0] - block
+        # (a) next block column, fully updated — panel j+1 factors
+        # from it without waiting on the remainder GEMM below
+        Cnext = A[block:, block:2 * block] - jnp.matmul(
+            pan, pan[:block].T, precision=prec
+        )
+        col_blocks.append(_panel_factor(Cnext, block, prec, panel,
+                                        bump, eye))
+        # (b) remainder trailing update in independent column groups
+        pieces = [Cnext]
+        rest = m - block
+        if rest > 0:
+            nch = min(update_chunks, max(1, rest // block))
+            bounds = [rest * i // nch for i in range(nch + 1)]
+            for c0, c1 in zip(bounds[:-1], bounds[1:]):
+                piece = A[block:, 2 * block + c0:2 * block + c1]
+                piece = piece - jnp.matmul(
+                    pan, pan[block + c0:block + c1].T, precision=prec
+                )
+                pieces.append(_constrain(mesh, piece, P(axis, None)))
+            A = jnp.concatenate(pieces, axis=1)
+        else:
+            A = Cnext
+        A = _constrain(mesh, A, P(axis, None))
+    return col_blocks
+
+
 def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa",
                      precision: str = "highest", panel: str = "solve",
-                     diag_bump: float = 0.0):
+                     diag_bump: float = 0.0, lookahead=None,
+                     update_chunks: int = 0):
     """Lower Cholesky factor of SPD C (n, n), any n.
 
     Right-looking blocked algorithm with a PYTHON-UNROLLED outer loop:
@@ -87,7 +167,30 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa",
     n that is not a block multiple is zero-padded with a unit diagonal
     (the padded factor is block-diagonal [L, I], so slicing back to
     (n, n) is exact) — arbitrary real TOA counts work without a
-    caller-side padding recipe (ADVICE r2; VERDICT r2 weak 5)."""
+    caller-side padding recipe (ADVICE r2; VERDICT r2 weak 5).
+
+    lookahead (None = $PINT_TPU_DENSE_LOOKAHEAD, default on; ISSUE 13)
+    selects the depth-1 lookahead/double-buffered schedule: panel j's
+    trailing update is SPLIT into (a) the next block-column's update —
+    a small (m, b, b) GEMM from which panel j+1 factors IMMEDIATELY —
+    and (b) the remainder update, the big sharded GEMM, which carries
+    no data dependency into panel j+1's factorization, so the compiler
+    is free to run the serial O(b^3) factorization and panel solve
+    while the shard-parallel GEMM (and its inter-shard collective) is
+    in flight.  update_chunks (0 = auto: 2 when sharded, 1 otherwise)
+    additionally splits the remainder update into independent
+    block-column groups so each chunk's collective (the pan gather)
+    can overlap the neighboring chunk's local GEMM — psum/gather
+    splitting on the ('toa',) mesh.  The chunk count is capped so the
+    python-unrolled HLO stays inside the remote-compile budget
+    (CLAUDE.md's n=32768 transport limit).  Element-wise the schedule
+    computes the same contractions (each output element is the same
+    dot over b terms), but fusion boundaries differ, so exact bitwise
+    equality with the sequential schedule is not guaranteed —
+    PINT_TPU_DENSE_LOOKAHEAD=0 (or lookahead=False) restores the
+    sequential schedule bitwise.  Overlap is MEASURED, not asserted:
+    profiling/cholesky_sweep.py and sharded_dense_scaling.py emit the
+    per-rung lookahead times and estimated overlap fraction."""
     prec = {
         "highest": jax.lax.Precision.HIGHEST,
         "high": jax.lax.Precision.HIGH,
@@ -107,29 +210,42 @@ def blocked_cholesky(C, block: int = 1024, mesh=None, axis: str = "toa",
         jnp.asarray(diag_bump, C.dtype) * jnp.eye(block, dtype=C.dtype)
         if diag_bump else None
     )
-    for j in range(0, npad, block):
-        A = _constrain(mesh, A, P(axis, None))
-        D = A[:block, :block]
-        if bump is not None:
-            D = D + bump
-        Ld = jnp.linalg.cholesky(D)  # replicated
-        if panel == "inv":
-            Ldinv = jax.scipy.linalg.solve_triangular(
-                Ld, eye, lower=True
-            )
-            pan = jnp.matmul(A[block:, :block], Ldinv.T, precision=prec)
-        else:
-            pan = jax.scipy.linalg.solve_triangular(
-                Ld, A[block:, :block].T, lower=True
-            ).T
-        col_blocks.append((Ld, pan))
-        if j + block < npad:
-            pan = _constrain(mesh, pan, P(axis, None))
-            # the O((n-j)^2 b) trailing GEMM — sharded, static shapes
-            A = A[block:, block:] - jnp.matmul(
-                pan, pan.T, precision=prec
-            )
+    if lookahead is None:
+        from pint_tpu.ops.solve_policy import dense_lookahead
+
+        lookahead = dense_lookahead()
+    if lookahead:
+        col_blocks = _lookahead_factor(
+            A, npad, block, mesh, axis, prec, panel, bump, eye,
+            update_chunks,
+        )
+    else:
+        for j in range(0, npad, block):
             A = _constrain(mesh, A, P(axis, None))
+            D = A[:block, :block]
+            if bump is not None:
+                D = D + bump
+            Ld = jnp.linalg.cholesky(D)  # replicated
+            if panel == "inv":
+                Ldinv = jax.scipy.linalg.solve_triangular(
+                    Ld, eye, lower=True
+                )
+                pan = jnp.matmul(
+                    A[block:, :block], Ldinv.T, precision=prec
+                )
+            else:
+                pan = jax.scipy.linalg.solve_triangular(
+                    Ld, A[block:, :block].T, lower=True
+                ).T
+            col_blocks.append((Ld, pan))
+            if j + block < npad:
+                pan = _constrain(mesh, pan, P(axis, None))
+                # the O((n-j)^2 b) trailing GEMM — sharded, static
+                # shapes
+                A = A[block:, block:] - jnp.matmul(
+                    pan, pan.T, precision=prec
+                )
+                A = _constrain(mesh, A, P(axis, None))
     L = jnp.zeros((npad, npad), C.dtype)
     for k, (Ld, pan) in enumerate(col_blocks):
         j = k * block
@@ -190,10 +306,12 @@ def fast_cholesky32(Aeq32, block: int = 512, ridge: float = 3e-5):
 
 
 def sharded_chol_solve_ir(C, B, block: int = 512, mesh=None,
-                          axis: str = "toa", refine: int = 2):
+                          axis: str = "toa", refine: int = 2,
+                          check_rtol=None):
     """chol_solve_ir (ops/ffgram.py — the single equilibration+IR
     recipe and accuracy contract) with the f32 factorization swapped
-    for the mesh-sharded blocked Cholesky."""
+    for the mesh-sharded blocked Cholesky.  check_rtol passes through
+    to the post-refinement residual check (ops/solve_policy.py)."""
     from pint_tpu.ops.ffgram import chol_solve_ir
 
     return chol_solve_ir(
@@ -201,6 +319,7 @@ def sharded_chol_solve_ir(C, B, block: int = 512, mesh=None,
         cholesky=lambda A32: blocked_cholesky(
             A32, block=block, mesh=mesh, axis=axis
         ),
+        check_rtol=check_rtol,
     )
 
 
@@ -222,13 +341,16 @@ def sharded_gls_step_full_cov(mesh, r, M, Ndiag, T, phi,
     X = jnp.concatenate([Mn, r[:, None]], axis=1)
     if method == "mixed":
         from pint_tpu.ops.ffgram import matmul_split32
+        from pint_tpu.ops import solve_policy
 
         CiX = sharded_chol_solve_ir(
-            C, X, block=block, mesh=mesh, axis=axis
+            C, X, block=block, mesh=mesh, axis=axis,
+            check_rtol=solve_policy.check_rtol(),
         )
         G = matmul_split32(X.T, CiX)
         return _finish_normal_eqs(
-            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov
+            G[:-1, :-1], -G[:-1, -1], G[-1, -1], norm, normalized_cov,
+            ir=True,
         )
     if method != "f64":
         raise ValueError(f"unknown method {method!r}")

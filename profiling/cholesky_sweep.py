@@ -85,14 +85,28 @@ def main():
         for b in args.blocks:
             if b >= n:
                 continue
-            t = _time_op(
-                lambda A, b=b: blocked_cholesky(A, block=b), C
-            )
-            print(json.dumps({
-                "kernel": f"blocked_b{b}", "n": n,
-                "ms": round(t * 1e3, 1),
-                "model_tflops_per_s": round(flops / t / 1e12, 2),
-            }))
+            # sequential vs depth-1 lookahead schedule (ISSUE 13): on
+            # one device the contractions are identical and there are
+            # no collectives to hide, so overlap_fraction is null —
+            # the sharded sweep (sharded_dense_scaling.py) estimates
+            # it per mesh size.  Pin lookahead explicitly per rung so
+            # rows stay comparable whatever PINT_TPU_DENSE_LOOKAHEAD
+            # says.
+            for look in (False, True):
+                t = _time_op(
+                    lambda A, b=b, look=look: blocked_cholesky(
+                        A, block=b, lookahead=look
+                    ),
+                    C,
+                )
+                print(json.dumps({
+                    "kernel": f"blocked_b{b}"
+                              + ("_lookahead" if look else ""),
+                    "n": n,
+                    "ms": round(t * 1e3, 1),
+                    "model_tflops_per_s": round(flops / t / 1e12, 2),
+                    "overlap_fraction": None,
+                }))
 
 
 if __name__ == "__main__":

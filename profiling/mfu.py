@@ -1,0 +1,185 @@
+"""Roofline-style MFU ladder (ISSUE 13): achieved FLOP/s and model MFU
+for the three solve paths every serve fit funnels through — the
+Woodbury reduced-rank step's Grams + k x k IR solve, the Pallas
+streaming fourier-gram, and the dense full-cov factorization — on
+whichever backend is default (CPU mesh or the axon TPU).
+
+Model accounting is deliberately simple and stated per rung: MACs of
+the dominant contractions times 2, over the measured per-op wall from
+a >=16-deep chained dependent scan (CLAUDE.md timing rule: the ~85 ms
+tunnel round-trip amortizes 1/chain; scalar feedback keeps the chain
+dependent, scalar output keeps the host copy off the clock).  "Model
+MFU" divides by the bf16 MXU peak, so it is a LOWER bound on true
+utilization — the same convention as run_benchmarks.py, so rows are
+comparable across rounds.
+
+    python profiling/run_benchmarks.py --configs mfu
+    python profiling/mfu.py              # standalone, same rows
+"""
+
+import json
+import time
+
+import numpy as np
+
+#: bf16 MXU peak (shared with run_benchmarks.py / bench.py)
+PEAK_BF16_FLOPS = 197e12
+
+
+def _time_scalar_chain(fn, arg, nrep=3, chain=16):
+    """Median per-op seconds of fn(arg)->scalar-bearing output, chained
+    `chain` deep with scalar feedback."""
+    import jax
+
+    @jax.jit
+    def run(A):
+        def body(c, _):
+            s = fn(c)
+            return (c + 1e-30 * s), s
+
+        _, ss = jax.lax.scan(body, A, None, length=chain)
+        return ss[-1]
+
+    _ = float(np.asarray(run(arg)))
+    ts = []
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        _ = float(np.asarray(run(arg)))
+        ts.append((time.perf_counter() - t0) / chain)
+    return float(np.median(ts))
+
+
+def _row(path, kernel, model_flops, t, backend, **extra):
+    return {
+        "path": path,
+        "kernel": kernel,
+        "backend": backend,
+        "ms": round(t * 1e3, 3),
+        "model_gflops_per_op": round(model_flops / 1e9, 2),
+        "model_gflops_per_s": round(model_flops / t / 1e9, 1),
+        "model_mfu_vs_bf16_peak": round(
+            model_flops / t / PEAK_BF16_FLOPS, 6
+        ),
+        "chain": 16,
+        **extra,
+    }
+
+
+def _dense_rows(backend, accel):
+    import jax.numpy as jnp
+
+    from pint_tpu.parallel.dense import blocked_cholesky, fast_cholesky32
+
+    rows = []
+    for n in ((4096, 8192, 16384) if accel else (1024, 2048)):
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(n, 64)).astype(np.float32)
+        C = W @ W.T + n * np.eye(n, dtype=np.float32)
+        d = np.sqrt(np.diag(C))
+        Ceq = jnp.asarray((C / np.outer(d, d)).astype(np.float32))
+        flops = n**3 / 3
+
+        t = _time_scalar_chain(
+            lambda A: blocked_cholesky(
+                A, block=512, precision="highest", diag_bump=3e-5
+            )[0, 0],
+            Ceq,
+        )
+        rows.append(_row("dense", "blocked_highest", flops, t,
+                         backend, n=n))
+        t = _time_scalar_chain(lambda A: fast_cholesky32(A)[0, 0], Ceq)
+        rows.append(_row("dense", "fast_cholesky32_bf16x3", flops, t,
+                         backend, n=n))
+    return rows
+
+
+def _woodbury_rows(backend, accel):
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import _column_norms
+    from pint_tpu.ops.ffgram import chol_solve_ir, gram32_joint
+    from pint_tpu.simulation import make_test_pulsar
+
+    ntoa = 100_000 if accel else 20_000
+    par = (
+        "PSR MFU1\nF0 245.42 1\nF1 -5.4e-16 1\nPEPOCH 55000\n"
+        "DM 3.14 1\nEFAC -f L-wide 1.1\nEQUAD -f L-wide 0.5\n"
+        "TNREDAMP -13.5\nTNREDGAM 3.7\nTNREDC 30\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=ntoa, start_mjd=53000.0,
+                               end_mjd=57500.0, seed=0, iterations=1)
+    cm = m.compile(toas)
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Ninv = 1.0 / jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+    norm = _column_norms(M)
+    X = jnp.concatenate([M / norm[None, :], r[:, None]], axis=1)
+    n, k = T.shape
+    p = X.shape[1]
+
+    # gram rung: T^T N^-1 [T | X] + X^T N^-1 X (the mixed step's MXU
+    # work) — 2 MACs per contraction element
+    T32 = T.astype(jnp.float32)
+    gram_flops = 2 * n * (k * (k + p) + p * p)
+    t = _time_scalar_chain(
+        lambda w: gram32_joint(T32, X, w)[0][0, 0], Ninv
+    )
+    rows = [_row("woodbury", "gram32_joint", gram_flops, t, backend,
+                 n=n, k=k, p=p)]
+
+    # solve rung: the k x k Sigma IR solve under the policy's residual
+    # check (k^3/3 factor + refinement products)
+    sig_tt, twx, _ = gram32_joint(T32, X, Ninv)
+    Sigma = jnp.diag(1.0 / phi) + sig_tt
+    solve_flops = k**3 / 3 + 2 * 3 * k * k * (p + 1)
+    t = _time_scalar_chain(
+        lambda S: chol_solve_ir(S, twx, check_rtol=1e-5)[0, 0], Sigma
+    )
+    rows.append(_row("woodbury", "chol_solve_ir", solve_flops, t,
+                     backend, k=k, p=p))
+    return rows, (n, k, p, cm, X, Ninv)
+
+
+def _fourier_rows(backend, wood_ctx):
+    from pint_tpu.ops.pallas_kernels import fourier_gram
+
+    n, k, p, cm, X, Ninv = wood_ctx
+    spec = cm.noise_fourier_spec(cm.x0())
+    if spec is None:
+        return []
+    t_sec, freqs, _ = spec
+    gram_flops = 2 * n * (k * (k + p))
+    rows = []
+    for precision in ("highest", "high"):
+        t = _time_scalar_chain(
+            lambda w, precision=precision: fourier_gram(
+                t_sec, freqs, w, X, precision=precision
+            )[0][0, 0],
+            Ninv,
+        )
+        rows.append(_row(
+            "fourier-gram", f"pallas_{precision}", gram_flops, t,
+            backend, n=n, k=k, p=p,
+        ))
+    return rows
+
+
+def mfu_rows():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    backend = jax.default_backend()
+    accel = backend != "cpu"
+    rows = _dense_rows(backend, accel)
+    wood, ctx = _woodbury_rows(backend, accel)
+    rows += wood
+    rows += _fourier_rows(backend, ctx)
+    return rows
+
+
+if __name__ == "__main__":
+    for row in mfu_rows():
+        print(json.dumps(row))

@@ -437,7 +437,8 @@ def main():
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
                              "serve_replicas", "serve_population",
-                             "serve_gang", "dispatch_floor", "chaos"])
+                             "serve_gang", "dispatch_floor", "chaos",
+                             "mfu"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -489,6 +490,20 @@ def main():
             from chaos_sweep import chaos_rows
 
             for row in chaos_rows():
+                print(json.dumps(row))
+            continue
+        if str(c) == "mfu":
+            # roofline ladder: achieved FLOP/s + model MFU per solve
+            # path — woodbury gram/IR-solve, Pallas fourier-gram at
+            # both MXU pass counts, dense highest-vs-bf16x3 (ISSUE 13;
+            # profiling/mfu.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from mfu import mfu_rows
+
+            for row in mfu_rows():
                 print(json.dumps(row))
             continue
         if str(c) == "dispatch_floor":

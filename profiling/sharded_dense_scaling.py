@@ -40,11 +40,13 @@ def main():
     T = jnp.asarray(rng.normal(size=(n, k)))
     phi = jnp.asarray(1e-12 * np.arange(1, k + 1, dtype=float) ** -2.0)
 
-    devs = jax.devices()
-    for nmesh in (1, 2, 4, 8):
-        if nmesh > len(devs):
-            break
-        mesh = Mesh(np.array(devs[:nmesh]), ("toa",))
+    def _time_step(mesh, lookahead):
+        # the factorization reads PINT_TPU_DENSE_LOOKAHEAD at TRACE
+        # time (ops/solve_policy.py::dense_lookahead), so pin it per
+        # rung and trace a fresh wrapper
+        os.environ["PINT_TPU_DENSE_LOOKAHEAD"] = (
+            "1" if lookahead else "0"
+        )
         fn = jax.jit(
             lambda *a: sharded_gls_step_full_cov(
                 mesh, *a, method="f64", block=768
@@ -58,13 +60,53 @@ def main():
             out = fn(r, M, Nd, T, phi)
             _ = np.asarray(out[0])
             ts.append(time.perf_counter() - t0)
-        t = float(np.median(ts))
-        print(json.dumps({
-            "bench": "sharded_dense_full_cov_f64",
-            "n": n, "mesh_devices": nmesh, "block": 768,
-            "step_s": round(t, 3),
-            "model_tflops_per_s": round(n**3 / 3 / t / 1e12, 4),
-        }))
+        return float(np.median(ts))
+
+    devs = jax.devices()
+    saved = os.environ.get("PINT_TPU_DENSE_LOOKAHEAD")
+    t_seq_1 = None
+    try:
+        for nmesh in (1, 2, 4, 8):
+            if nmesh > len(devs):
+                break
+            mesh = Mesh(np.array(devs[:nmesh]), ("toa",))
+            t_seq = _time_step(mesh, lookahead=False)
+            t_look = _time_step(mesh, lookahead=True)
+            if t_seq_1 is None:
+                t_seq_1 = t_seq
+            for label, t in (("sequential", t_seq),
+                             ("lookahead", t_look)):
+                row = {
+                    "bench": "sharded_dense_full_cov_f64",
+                    "schedule": label,
+                    "n": n, "mesh_devices": nmesh, "block": 768,
+                    "step_s": round(t, 3),
+                    "model_tflops_per_s": round(
+                        n**3 / 3 / t / 1e12, 4
+                    ),
+                }
+                if label == "lookahead":
+                    # overlap-fraction ESTIMATE (stated as such): the
+                    # wall the lookahead schedule hid, over the
+                    # collective+imbalance overhead the sequential
+                    # schedule pays at this mesh size (sequential wall
+                    # minus its perfectly-scaled 1-device wall).  On
+                    # mesh=1 there is nothing to hide -> null.
+                    if nmesh == 1:
+                        row["overlap_fraction"] = None
+                    else:
+                        hidden = max(0.0, t_seq - t_look)
+                        coll = t_seq - t_seq_1 / nmesh
+                        row["overlap_fraction"] = (
+                            round(min(1.0, hidden / coll), 3)
+                            if coll > 0 else None
+                        )
+                print(json.dumps(row))
+    finally:
+        if saved is None:
+            os.environ.pop("PINT_TPU_DENSE_LOOKAHEAD", None)
+        else:
+            os.environ["PINT_TPU_DENSE_LOOKAHEAD"] = saved
 
 
 if __name__ == "__main__":

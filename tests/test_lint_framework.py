@@ -270,6 +270,51 @@ def test_f64emu_flags_default_precision_matmul_in_tagged_module():
     assert findings_for(F64EMU, untagged) == []
 
 
+def test_f64emu_flags_high_precision_outside_ir_refined_module():
+    """ISSUE 13 check 5: bf16x3 'high' matmuls are preconditioner-
+    grade and legal only under the ir-refined module contract (f64
+    iterative refinement with the true operator on top)."""
+    # true positives: the string spelling and the enum spelling, in a
+    # module without the ir-refined tag (matmul-highest alone is not
+    # enough — the tags assert DIFFERENT contracts)
+    src = (
+        "# lint: module(matmul-highest)\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def trail(W):\n"
+        "    return jnp.matmul(W, W.T, precision=jax.lax.Precision.HIGH)\n"
+        "def trail2(A, W):\n"
+        "    return chol(A, precision='high')\n"
+    )
+    out = findings_for(F64EMU, src)
+    assert [f.lineno for f in out] == [5, 7]
+    assert all("ir-refined" in f.message for f in out)
+    # near miss: HIGHEST is the accuracy-bearing spelling, no finding
+    ok = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def trail(W):\n"
+        "    return jnp.matmul(W, W.T, precision=jax.lax.Precision.HIGHEST)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+    # the ir-refined tag licenses the 3-pass rung module-wide
+    tagged = (
+        "# lint: module(ir-refined)\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def trail(W):\n"
+        "    return jnp.matmul(W, W.T, precision=jax.lax.Precision.HIGH)\n"
+    )
+    assert findings_for(F64EMU, tagged) == []
+    # pragma suppression still works per line
+    sup = (
+        "import jax.numpy as jnp\n"
+        "def trail(W):\n"
+        "    return jnp.matmul(W, W.T, precision='high')  # lint: ok(f64-emu)\n"
+    )
+    assert findings_for(F64EMU, sup) == []
+
+
 def test_f64emu_flags_tiny_literal_product():
     """The r4 incident class: a sub-flush-threshold factor multiplied
     on device flushes the whole product to zero."""
@@ -618,6 +663,13 @@ def test_real_tree_declares_the_incident_guards():
     dense = (REPO / "pint_tpu" / "parallel" / "dense.py").read_text()
     assert "lint: module(matmul-highest)" in ffgram
     assert "lint: module(matmul-highest)" in dense
+    # ISSUE 13: the bf16x3 'high' trailing GEMMs in dense.py (and the
+    # Pallas pass ladder) are licensed by the ir-refined contract
+    assert "lint: module(ir-refined)" in dense
+    pallas = (
+        REPO / "pint_tpu" / "ops" / "pallas_kernels.py"
+    ).read_text()
+    assert "lint: module(ir-refined)" in pallas
     replica = (
         REPO / "pint_tpu" / "serve" / "fabric" / "replica.py"
     ).read_text()

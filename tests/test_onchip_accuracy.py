@@ -527,3 +527,79 @@ def test_onchip_population_stacking_is_bitwise_neutral():
         solo_fit.uncertainties, mix_fit.uncertainties
     )
     assert solo_fit.fitted_par == mix_fit.fitted_par
+
+
+def test_onchip_ir_solve_ladder_and_policy_default():
+    """ISSUE 13: the bf16-multipass + f64-IR solve ON CHIP.  The
+    policy is accelerator-default-on, so this pins (a) the IR'd solve
+    tracking a known solution across the diagonal-dynamic-range
+    ladder the Woodbury Sigma occupies (phi^-1 spans ~1e10), at both
+    the native-Cholesky rung and the bf16x3 blocked rung (n past
+    solve_policy.IR_BLOCKED_MIN), and (b) a mixed GLS fit landing in
+    the same tolerance class as its own CPU answer, with the policy
+    ACTIVE (no env override).  Emulated-f64 hazards make this
+    uncheckable from the CPU suite (CLAUDE.md)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.ops import solve_policy
+    from pint_tpu.ops.ffgram import chol_solve_ir
+
+    assert solve_policy.ir_active()  # accelerator default
+
+    rng = np.random.default_rng(13)
+    for n, dyn, tol in ((96, 1e8, 1e-6), (96, 1e10, 1e-5),
+                        (solve_policy.IR_BLOCKED_MIN, 1e8, 1e-6)):
+        W = rng.standard_normal((n, 3 * n))
+        Cw = W @ W.T / (3 * n)
+        d = np.sqrt(np.diag(Cw))
+        Cw = Cw / np.outer(d, d)
+        s = np.sqrt(np.logspace(0, np.log10(dyn), n))
+        A = Cw * np.outer(s, s)
+        x_true = rng.standard_normal((n, 2))
+        B = np.asarray(
+            A.astype(np.longdouble) @ x_true.astype(np.longdouble),
+            np.float64,
+        )
+        X = np.asarray(chol_solve_ir(
+            jnp.asarray(A), jnp.asarray(B),
+            cholesky=solve_policy.ir_cholesky(n),
+            check_rtol=solve_policy.check_rtol(),
+        ))
+        relerr = float(np.max(np.abs(X - x_true))
+                       / np.max(np.abs(x_true)))
+        assert np.isfinite(X).all(), (n, dyn)
+        assert relerr < tol, (n, dyn, relerr)
+
+
+def test_onchip_mixed_fit_with_ir_policy_matches_cpu():
+    """End-to-end: a red-noise mixed fit on chip with the IR policy
+    active lands within the 0.2-sigma on-chip contract of the CPU
+    IEEE-f64 oracle (same bound as the pre-policy suite — the policy
+    must not widen it)."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+
+    from pint_tpu.fitting.gls import GLSFitter
+    from pint_tpu.runtime import guard
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR IRCHIP\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\n"
+        "DM 3.14 1\nTNREDAMP -13.1\nTNREDGAM 3.3\nTNREDC 6\n"
+    )
+    m, toas = make_test_pulsar(par, ntoa=64, seed=9)
+    f_chip = GLSFitter(toas, m, fused="mixed")
+    chi_chip = f_chip.fit_toas(maxiter=3)
+    assert not f_chip.guard_report.fell_back  # IR converged on chip
+
+    with guard.ladder_device(jax.devices("cpu")[0]):
+        f_cpu = GLSFitter(toas, m, fused=False)
+        chi_cpu = f_cpu.fit_toas(maxiter=3)
+
+    assert np.isfinite(chi_chip)
+    assert chi_chip == pytest.approx(chi_cpu, rel=1e-2)
+    for name in f_chip.model.free_params:
+        v = float(getattr(f_chip.model, name).value)
+        v0 = float(getattr(f_cpu.model, name).value)
+        u0 = float(getattr(f_cpu.model, name).uncertainty)
+        assert abs(v - v0) < 0.2 * u0 + 1e-15, name

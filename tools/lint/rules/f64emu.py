@@ -27,6 +27,16 @@ documented hazard classes pass every CPU test and fail only on chip:
    to ZERO (r4 incident: A^2 * f_yr^(gamma-3) ~ 4e-38 silently zeroed
    the power-law phi on device; models/noise.py::powerlaw_phi forms
    such products in log space).
+5. **unrefined bf16x3 ('high') matmuls** — ``precision="high"`` /
+   ``Precision.HIGH`` is the 3-pass bf16x3 ladder rung: ~1e-6
+   relative, preconditioner-grade ONLY.  Legal solely inside modules
+   tagged ``# lint: module(ir-refined)``, whose contract is that f64
+   iterative refinement with the TRUE operator sits on top of every
+   'high' product (parallel/dense.py::fast_cholesky32 under
+   ops/ffgram.py::chol_solve_ir; ops/solve_policy.py).  A 'high' pass
+   in cancellation-sensitive code without that consumer loses ~1e-3
+   in Schur-style cancellations exactly like the single-pass default
+   check 3 exists for (ISSUE 13).
 
 Suppress with ``# lint: ok(f64-emu)`` plus a justifying comment (e.g.
 a CPU-only code path).
@@ -45,6 +55,11 @@ ALLOWED_DECOMP_FNS = {"_eigh_threshold_solve"}
 #: the per-module opt-in marker for check 3 (add it to modules whose
 #: docstring/comments promise a matmul precision contract)
 MATMUL_MARKER = "lint: module(matmul-highest)"
+
+#: the per-module marker licensing bf16x3 'high' matmuls (check 5):
+#: the module's contract is that f64 iterative refinement with the
+#: true operator consumes every 'high' product (ops/solve_policy.py)
+IR_MARKER = "lint: module(ir-refined)"
 
 #: jnp matmul-family callables that accept a precision kwarg
 _MATMUL_FUNCS = {"dot", "matmul", "einsum", "tensordot", "vdot"}
@@ -109,11 +124,14 @@ class F64EmuRule(Rule):
     def check_module(self, mod: Module) -> list:
         findings = []
         tagged = MATMUL_MARKER in mod.source
+        ir_tagged = IR_MARKER in mod.source
         for node in ast.walk(mod.tree):
             findings += self._decomposition(mod, node)
             findings += self._sum_of_squares(mod, node)
             if tagged:
                 findings += self._matmul_precision(mod, node)
+            if not ir_tagged:
+                findings += self._high_without_ir(mod, node)
             findings += self._tiny_literal(mod, node)
         return sorted(findings, key=lambda f: (f.lineno, f.message))
 
@@ -218,6 +236,33 @@ class F64EmuRule(Rule):
                 "bf16-pass; pass precision=jax.lax.Precision.HIGHEST "
                 "(or HIGH with a documented refinement contract)",
             )]
+        return []
+
+    # -- 5. bf16x3 'high' matmuls outside ir-refined modules --------------
+    def _high_without_ir(self, mod, node) -> list:
+        if not isinstance(node, ast.Call):
+            return []
+        for kw in node.keywords:
+            if kw.arg != "precision":
+                continue
+            v = kw.value
+            is_high = (
+                isinstance(v, ast.Constant) and v.value == "high"
+            ) or (
+                isinstance(v, ast.Attribute) and v.attr == "HIGH"
+            )
+            if is_high:
+                return [Finding(
+                    self.name, mod.path, node.lineno,
+                    "precision='high' (bf16x3, ~1e-6 rel — "
+                    "preconditioner-grade) outside a module tagged "
+                    "'# lint: module(ir-refined)': the 3-pass product "
+                    "is only legal where f64 iterative refinement "
+                    "with the TRUE operator consumes it (ops/"
+                    "solve_policy.py; parallel/dense.py::"
+                    "fast_cholesky32) — use HIGHEST, or tag the "
+                    "module and document the refinement contract",
+                )]
         return []
 
     # -- 4. sub-flush literals in products --------------------------------
