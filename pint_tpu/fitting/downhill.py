@@ -364,6 +364,24 @@ class DownhillFitter(Fitter):
             )
         return self._finalize(np.asarray(x), cov, chi2)
 
+    def _start_x(self, x0):
+        """Starting delta vector for a trajectory: ``cm.x0()`` (zeros =
+        the par-file model) or a caller-supplied WARM START (ISSUE 14
+        streaming refits: x0 = the previous converged solution, so the
+        trajectory lands in 1-2 iterations).  The warm vector is
+        round-tripped through host numpy into a FRESH device buffer:
+        the fused loop donates its operand (perf1), and donating a
+        buffer the caller still holds would poison their copy."""
+        if x0 is None:
+            return self.cm.x0()
+        x0 = np.asarray(x0, dtype=np.float64)
+        if x0.shape != (len(self.cm.free_names),):
+            raise ValueError(
+                f"warm-start x0 has shape {x0.shape}; expected "
+                f"({len(self.cm.free_names)},)"
+            )
+        return jnp.asarray(x0)
+
     @record_fit
     def fit_toas(
         self,
@@ -371,6 +389,7 @@ class DownhillFitter(Fitter):
         required_chi2_decrease: float = 1e-2,
         max_chi2_increase: float = 1e-2,
         min_lambda: float = 1e-3,
+        x0=None,
     ) -> float:
         """One guarded dispatch at steady state: the fused trajectory
         runs down the fault ladder native -> all-f64 -> reference host
@@ -378,11 +397,19 @@ class DownhillFitter(Fitter):
         validator gating each rung — an injected or real non-finite
         fused result degrades instead of committing garbage.
         ``PINT_TPU_DOWNHILL_FUSED=0`` restores the host loop
-        outright."""
+        outright.
+
+        ``x0`` (optional) WARM-STARTS the trajectory from a previous
+        solution: the start vector is already a runtime argument of the
+        cached fused-loop kernel, so a warm refit reuses the SAME
+        compiled program as a cold fit — zero retraces — and the lambda
+        ladder + convergence control are unchanged (a warm start near
+        the optimum simply converges on the first or second iteration;
+        a bad warm start walks downhill exactly like a cold fit)."""
         if os.environ.get("PINT_TPU_DOWNHILL_FUSED", "1") == "0":
             return self._fit_toas_host(
                 maxiter, required_chi2_decrease, max_chi2_increase,
-                min_lambda,
+                min_lambda, x0=x0,
             )
         from pint_tpu.runtime.fallback import run_ladder
 
@@ -398,14 +425,14 @@ class DownhillFitter(Fitter):
                 # alias recyclable buffers: materialize host-owned
                 # copies before anything downstream keeps a view
                 # (runtime/guard.py::fence_owned)
-                return ("fused", fence_owned(loop(self.cm.x0())))
+                return ("fused", fence_owned(loop(self._start_x(x0))))
 
             return thunk
 
         def host_thunk(_rung_site):
             return ("host", self._fit_toas_host(
                 maxiter, required_chi2_decrease, max_chi2_increase,
-                min_lambda,
+                min_lambda, x0=x0,
             ))
 
         def validate(tagged, rung_site):
@@ -452,6 +479,7 @@ class DownhillFitter(Fitter):
         required_chi2_decrease: float,
         max_chi2_increase: float,
         min_lambda: float,
+        x0=None,
     ) -> float:
         """The reference host loop (~one guarded dispatch per leg):
         the fused trajectory's last ladder rung, and the
@@ -479,7 +507,7 @@ class DownhillFitter(Fitter):
             )
         )
 
-        x = self.cm.x0()
+        x = self._start_x(x0)
         chi2 = float(chi2_of(x))
         if not np.isfinite(chi2):
             raise InvalidModelParameters(

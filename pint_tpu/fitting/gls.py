@@ -414,6 +414,171 @@ def gls_step_full_cov(r, M, Ndiag, T, phi, method=None,
     return _solve_normal_eqs(cinv_mult, r, M, normalized_cov)
 
 
+# ---------------------------------------------------------------------- #
+# O(append) streaming state (ISSUE 14)
+# ---------------------------------------------------------------------- #
+#
+# A long-lived timing stream maintains the GLS normal equations as an
+# ADDITIVE Gram-block state so that appending j TOAs costs
+# O(j k^2 + k^2 p + p^3) — independent of the n TOAs already absorbed:
+#
+#   G    (q, q)  X^T N^-1 X for X = [Mn | r], q = p1 + 1   (A_white,
+#                b_white, r^T N^-1 r all live here — the same layout
+#                as gram32's G_XX)
+#   twx  (k, q)  T^T N^-1 X
+#   stt  (k, k)  T^T N^-1 T              (Sigma = diag(1/phi) + stt)
+#   sig_L (k,k)  maintained Cholesky factor of the EQUILIBRATED Sigma
+#                (frozen Jacobi diagonal sig_d from the last refresh),
+#                advanced per append by ops/cholupdate.py::chol_update
+#                in solve_policy.stream_factor_dtype()
+#   norm (p1,)   FROZEN column norms — normalization must not move
+#                between appends or the Gram blocks stop being additive
+#   x    (nfree,) current solution; r-dependent state entries always
+#                refer to residuals at this x
+#
+# Appended rows enter with their exact per-row weight, pad rows with
+# EXACTLY zero weight (stronger than the batch PAD_ERROR_US
+# convention: streaming state accumulates forever, so pads must be
+# perfectly neutral).  After each solve the r-dependent blocks are
+# advanced under the LINEARIZATION r(x+dx) = r(x) + Mn dxn — exact in
+# the state's own model, drifting from the true nonlinear residuals
+# only at second order; the periodic refresh (PINT_TPU_STREAM_REFRESH)
+# re-anchors everything, and both solves carry the poison-to-NaN drift
+# check (solve_policy.stream_drift_rtol) so numerical decay can never
+# go unnoticed (ops/cholupdate.py documents the convention).
+
+
+def stream_state_init(r, M, Ninv, T, phi, x):
+    """Build the streaming Gram state from full arrays at solution x
+    (runs once per stream open/refresh — the only O(n) solver work in
+    a stream's steady state).  ``Ninv`` is the per-row INVERSE white
+    variance — exact zeros on pad rows (same convention as
+    stream_state_append).  Returns the state dict above plus
+    ``phi_inv``/``sig_d``."""
+    from pint_tpu.ops import solve_policy
+
+    norm = _column_norms(M * jnp.sqrt(Ninv)[:, None])
+    Mn = M / norm[None, :]
+    X = jnp.concatenate([Mn, r[:, None]], axis=1)
+    XN = X * Ninv[:, None]
+    G = X.T @ XN
+    twx = T.T @ XN
+    stt = (T * Ninv[:, None]).T @ T
+    phi_inv = 1.0 / phi
+    Sigma = jnp.diag(phi_inv) + stt
+    k = T.shape[1]
+    if k:
+        sig_d = jnp.diagonal(Sigma)
+        dinv = 1.0 / jnp.sqrt(sig_d)
+        Seq = Sigma * jnp.outer(dinv, dinv)
+        sig_L = jnp.linalg.cholesky(
+            Seq.astype(solve_policy.stream_factor_dtype())
+        )
+    else:
+        sig_d = jnp.ones((0,))
+        sig_L = jnp.zeros((0, 0), solve_policy.stream_factor_dtype())
+    return {
+        "G": G, "twx": twx, "stt": stt, "sig_L": sig_L,
+        "sig_d": sig_d, "phi_inv": phi_inv, "norm": norm,
+        "x": jnp.asarray(x, jnp.float64),
+    }
+
+
+def stream_state_append(state, r_j, M_j, Ninv_j, T_j):
+    """Absorb j appended rows: additive Gram updates + the rank-j
+    Cholesky update of the maintained equilibrated Sigma factor.
+    ``Ninv_j`` must already carry exact zeros on pad rows."""
+    from pint_tpu.ops.cholupdate import chol_update
+
+    Mn_j = M_j / state["norm"][None, :]
+    X_j = jnp.concatenate([Mn_j, r_j[:, None]], axis=1)
+    XN_j = X_j * Ninv_j[:, None]
+    out = dict(state)
+    out["G"] = state["G"] + X_j.T @ XN_j
+    out["twx"] = state["twx"] + T_j.T @ XN_j
+    out["stt"] = state["stt"] + (T_j * Ninv_j[:, None]).T @ T_j
+    if state["sig_L"].shape[0]:
+        V = T_j.T * jnp.sqrt(Ninv_j)[None, :]
+        Veq = V / jnp.sqrt(state["sig_d"])[:, None]
+        out["sig_L"] = chol_update(state["sig_L"], Veq)
+    return out
+
+
+def stream_state_solve(state, noffset_: int, check_rtol=None):
+    """One exact GLS solve of the current state (the state is a linear
+    least-squares problem, so one solve IS the converged answer) and
+    the linearized advance of the r-dependent blocks to the new x.
+
+    Returns ``(state', dx (p1,), (covn, norm), chi2)`` with the
+    normalized-covariance convention of _finish_normal_eqs.  Both the
+    maintained-factor Sigma solve and the p x p normal-equation solve
+    carry the ``check_rtol`` poison-to-NaN drift check; on a failed
+    check the returned state is the UNCHANGED input state (callers
+    re-serve via a warm full refit — the poisoned dx/chi2 never feed
+    anything downstream)."""
+    from pint_tpu.ops.cholupdate import factor_solve_ir
+    from pint_tpu.ops.ffgram import chol_solve_ir
+
+    G, twx = state["G"], state["twx"]
+    k = twx.shape[0]
+    if k:
+        dinv = 1.0 / jnp.sqrt(state["sig_d"])
+        Sigma_eq = (jnp.diag(state["phi_inv"]) + state["stt"]) \
+            * jnp.outer(dinv, dinv)
+        corr = dinv[:, None] * factor_solve_ir(
+            state["sig_L"], Sigma_eq, dinv[:, None] * twx,
+            check_rtol=check_rtol,
+        )
+        A = G[:-1, :-1] - twx[:, :-1].T @ corr[:, :-1]
+        b = -(G[:-1, -1] - twx[:, :-1].T @ corr[:, -1])
+        r_cinv_r = G[-1, -1] - jnp.dot(twx[:, -1], corr[:, -1])
+    else:
+        A = G[:-1, :-1]
+        b = -G[:-1, -1]
+        r_cinv_r = G[-1, -1]
+    p = A.shape[0]
+    X = chol_solve_ir(
+        A, jnp.concatenate([b[:, None], jnp.eye(p)], axis=1),
+        check_rtol=check_rtol,
+    )
+    dxn = X[:, 0]
+    covn = 0.5 * (X[:, 1:] + X[:, 1:].T)
+    chi2 = r_cinv_r - jnp.dot(dxn, b)
+    # linearized advance r -> r + Mn dxa of every r-dependent block
+    # (exact in the state's model; OLD blocks on the right-hand
+    # sides).  The OFFSET components of the step are ZEROED first:
+    # the fitter never commits them (gauss_newton_step returns
+    # x + dx[no:]) — residuals at any x carry the model's own phase
+    # convention, and appended rows are evaluated exactly there, so
+    # folding the profiled offset into the stored r-column would make
+    # old and new rows inconsistent by a constant the next solve's
+    # global offset column cannot absorb.  The offset is re-profiled
+    # by every solve instead, mirroring the iterated fitter.
+    dxa = dxn.at[:noffset_].set(0.0)
+    Gmm = G[:-1, :-1]
+    gmr = G[:-1, -1]
+    Gd = Gmm @ dxa
+    G2 = G.at[:-1, -1].set(gmr + Gd).at[-1, :-1].set(gmr + Gd)
+    G2 = G2.at[-1, -1].set(
+        G[-1, -1] + 2.0 * jnp.dot(dxa, gmr) + jnp.dot(dxa, Gd)
+    )
+    out = dict(state)
+    out["G"] = G2
+    if k:
+        out["twx"] = twx.at[:, -1].set(
+            twx[:, -1] + twx[:, :-1] @ dxa
+        )
+    out["x"] = state["x"] + (dxn / state["norm"])[noffset_:]
+    # drift poison: a failed check must leave the STATE untouched so
+    # the retry/fallback path re-runs from a clean anchor (scalar
+    # jnp.where — never lax.cond, these solves run vmapped in serve)
+    ok = jnp.isfinite(chi2) & jnp.all(jnp.isfinite(dxn))
+    out = {
+        kk: jnp.where(ok, v, state[kk]) for kk, v in out.items()
+    }
+    return out, dxn / state["norm"], (covn, state["norm"]), chi2
+
+
 class GLSFitter(Fitter):
     """Iterated GLS fit; also correct (equals WLS) with no correlated
     noise in the model.

@@ -263,6 +263,22 @@ def fourier_basis(bundle, nharm: int, mask_key: str | None = None):
     return F, jnp.concatenate([f, f]), tspan
 
 
+def fourier_basis_rows(bundle, freqs, day0):
+    """Rows of a FROZEN-frequency Fourier basis for newly appended
+    TOAs (ISSUE 14 basis slicing): the streaming solver extends its
+    noise basis by evaluating only the new rows, against the BASE
+    span's harmonic layout — ``freqs`` (nharm,) and epoch ``day0``
+    are the stream state's frozen values from the last refresh, NOT
+    recomputed from this (tail) bundle, so appended rows land in
+    exactly the columns the absorbed Gram state already spans.
+    Returns (j, 2*nharm) [sin | cos] matching fourier_basis's layout.
+    Device-side f64 sin/cos on rank-1 arrays (~1e-14 on axon — the
+    scalar-transcendental hazard does not apply; CLAUDE.md)."""
+    t = (bundle.tdb_day - day0) * 86400.0 + bundle.tdb_sec.to_float()
+    arg = 2.0 * math.pi * t[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=1)
+
+
 def host_fourier_basis(toas, nharm: int) -> np.ndarray:
     """Host-side (IEEE f64 numpy) twin of fourier_basis's sin/cos
     matrix, from the same TDB columns bundle.py packs — computed once

@@ -1,0 +1,140 @@
+"""Rank-k Cholesky updates for the O(append) streaming solver.
+
+Reference parity: none — TPU-service infrastructure (the role of
+LINPACK ``dchud``/qr-update in classical streaming least squares).
+Streaming timing (ISSUE 14) maintains the Woodbury inner matrix
+Sigma = phi^-1 + T^T N^-1 T as session state; appending j TOAs
+perturbs it by V V^T with V = T_j^T sqrt(Ninv_j) (k, j), and the
+factor follows by a rank-j update in O(j k^2) instead of a fresh
+O(k^3) factorization.
+
+The update is the classic LINPACK positive-update recurrence (per
+column j: a scaled Givens rotation against the update vector),
+expressed as a ``lax.scan`` over factor columns with full-vector
+masked updates — O(k) sequential steps of O(k) vector work per rank-1
+update, one fused device program for the whole rank-j batch.
+
+Precision policy (ops/solve_policy.py — the one place that decides):
+the host-facing/CPU path keeps the factor in exact f64; on
+accelerators the factor is held in equilibrated f32 (axon's emulated
+f64 would pay ~300x per op for accuracy f32 + refinement beats) and
+every downstream solve refines against the TRUE f64 matrix with the
+poison-to-NaN residual check (``factor_solve_ir``), the same
+three-precision IR ladder as ops/ffgram.py::chol_solve_ir.
+
+Degeneracy convention: a non-positive pivot makes ``sqrt`` return NaN,
+which propagates through the remaining columns — the factor poisons
+itself, the streaming drift guard's residual check fails, and the
+caller falls back to a fresh warm refit (docs/serving.md streaming
+section).  No ``lax.cond`` anywhere: these kernels run vmapped inside
+serve dispatches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# lint: module(matmul-highest) — the refinement residual must apply
+# the true operator; TPU-default matmuls are bf16-pass
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _rank1_update(L, w):
+    """One positive rank-1 update: factor of L L^T + w w^T.
+
+    LINPACK recurrence, scanned over columns with masked full-vector
+    body (dynamic column indexing stays inside the scan carry — no
+    host branching, vmap-safe).  Dtype follows L (f64 host path, f32
+    accelerator path per the solve policy).
+    """
+    n = L.shape[0]
+    idx = jnp.arange(n)
+
+    def body(carry, j):
+        L, w = carry
+        Ljj = L[j, j]
+        wj = w[j]
+        r = jnp.sqrt(Ljj * Ljj + wj * wj)
+        c = r / Ljj
+        s = wj / Ljj
+        col = L[:, j]
+        below = idx > j
+        # updated subdiagonal of column j, then the update vector
+        # against the UPDATED column (the recurrence's data flow)
+        newcol = jnp.where(below, (col + s * w) / c, col)
+        newcol = newcol.at[j].set(r)
+        w = jnp.where(below, c * w - s * newcol, w)
+        L = L.at[:, j].set(newcol)
+        return (L, w), None
+
+    (L, _), _ = jax.lax.scan(body, (L, w.astype(L.dtype)), idx)
+    return L
+
+
+def chol_update(L, V):
+    """Factor of L L^T + V V^T for lower-triangular L (k, k) and
+    update block V (k, j) — j sequential rank-1 recurrences, O(j k^2).
+
+    k == 0 (pure-white streaming state) and j == 0 (an append whose
+    tail bucket padded to zero live basis columns) both degenerate to
+    the identity.  Zero columns of V (exactly-neutral pad rows with
+    Ninv == 0) pass through as exact identity updates (r == Ljj,
+    c == 1, s == 0)."""
+    if L.shape[0] == 0 or V.shape[1] == 0:
+        return L
+
+    def body(L, w):
+        return _rank1_update(L, w), None
+
+    L, _ = jax.lax.scan(body, L, V.T)
+    return L
+
+
+def chol_factor_solve(L, B):
+    """Plain two-triangular-solve with a maintained factor (host/f64
+    path: the factor IS the truth)."""
+    Y = jax.scipy.linalg.solve_triangular(L, B, lower=True)
+    return jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
+
+
+def factor_solve_ir(L, A_true, B, refine: int = 2, check_rtol=None):
+    """Solve A_true X = B using an incrementally-maintained Cholesky
+    factor ``L`` of (an approximation of) A_true as the preconditioner.
+
+    The streaming IR contract (docs/precision.md three-precision
+    ladder, applied to a maintained factor): ``L`` may be f32 (the
+    accelerator policy) and carries accumulated update roundoff; each
+    refinement sweep applies the TRUE f64 matrix (the streaming state
+    keeps Sigma = phi^-1 + T^T N^-1 T exactly as an additive f64
+    Gram), so the refined solution converges to the exact solve and
+    the residual check catches a stale/degenerate factor.
+
+    ``check_rtol`` (None = no check) NaN-poisons the solution when the
+    final residual exceeds ``check_rtol`` relative to the RHS — a
+    product compare max|R| <= rtol * max|B| (never an epsilon
+    division: sub-flush literals are the r4 hazard class), scalar
+    ``jnp.where`` (never ``lax.cond``) so vmapped serve dispatches
+    stay single-program.
+    """
+    if L.shape[0] == 0:
+        return B
+
+    def solve_pre(R):
+        Y = jax.scipy.linalg.solve_triangular(
+            L, R.astype(L.dtype), lower=True
+        )
+        Z = jax.scipy.linalg.solve_triangular(L.T, Y, lower=False)
+        return Z.astype(jnp.float64)
+
+    def apply_true(X):
+        return jnp.matmul(A_true, X, precision=_HIGHEST)
+
+    X = solve_pre(B)
+    for _ in range(refine):
+        X = X + solve_pre(B - apply_true(X))
+    if check_rtol is not None:
+        R = B - apply_true(X)
+        ok = jnp.max(jnp.abs(R)) <= check_rtol * jnp.max(jnp.abs(B))
+        X = jnp.where(ok, X, jnp.nan)
+    return X

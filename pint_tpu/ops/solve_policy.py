@@ -102,6 +102,41 @@ def ir_cholesky(n: int):
     return fast_cholesky32
 
 
+#: default residual-check tolerance for the streaming rank-update
+#: solves: the maintained factor accumulates update roundoff (unlike a
+#: fresh factorization), so the check is armed on EVERY backend — a
+#: converged f64 factor sits at ~1e-14, a converged f32+IR one at the
+#: ~1e-7 split-matmul floor, and a stale/degenerate factor at O(1)
+DEFAULT_STREAM_RTOL = 1e-5
+
+
+def stream_factor_dtype():
+    """Dtype of the maintained streaming rank-update Cholesky factor
+    (ops/cholupdate.py): equilibrated f32 with f64 iterative
+    refinement on accelerators (the three-precision ladder — an
+    emulated-f64 factor update pays ~300x for accuracy IR recovers),
+    exact f64 on CPU.  Routed through the same PINT_TPU_SOLVE_IR
+    policy switch as the batch solves: ``=0`` keeps f64 everywhere,
+    ``=force`` exercises the f32+IR path on the CPU mesh."""
+    import jax.numpy as jnp
+
+    return jnp.float32 if ir_active() else jnp.float64
+
+
+def stream_drift_rtol() -> float:
+    """Residual-check tolerance of the streaming drift guard
+    (PINT_TPU_STREAM_DRIFT_RTOL).  Unlike :func:`check_rtol` this is
+    armed on every backend — both streaming solves (the maintained
+    Sigma factor and the per-append normal equations) NaN-poison past
+    it, and the serving layer falls back to a warm full refit
+    (docs/serving.md streaming section)."""
+    return float(
+        os.environ.get(
+            "PINT_TPU_STREAM_DRIFT_RTOL", str(DEFAULT_STREAM_RTOL)
+        )
+    )
+
+
 def dense_lookahead() -> bool:
     """Whether blocked_cholesky uses the lookahead/double-buffered
     trailing-update schedule (PINT_TPU_DENSE_LOOKAHEAD, default on;

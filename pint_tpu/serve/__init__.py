@@ -35,6 +35,8 @@ from pint_tpu.serve.api import (
     PRIORITY_HIGH,
     PRIORITY_LOW,
     PRIORITY_NORMAL,
+    AppendRequest,
+    AppendResponse,
     FitRequest,
     FitResponse,
     PredictRequest,
@@ -45,13 +47,17 @@ from pint_tpu.serve.api import (
 )
 from pint_tpu.serve.engine import TimingEngine
 from pint_tpu.serve.session import SessionCache, shape_bucket
+from pint_tpu.serve.stream import ObserveSession
 
 __all__ = [
     "PRIORITY_HIGH",
     "PRIORITY_LOW",
     "PRIORITY_NORMAL",
+    "AppendRequest",
+    "AppendResponse",
     "FitRequest",
     "FitResponse",
+    "ObserveSession",
     "PredictRequest",
     "PredictResponse",
     "Request",

@@ -106,6 +106,12 @@ class FitRequest(Request):
     method: str = "auto"
     maxiter: int = 4
     tol_chi2: Optional[float] = None
+    #: optional warm start: initial free-parameter deltas (nfree,).
+    #: A runtime argument of the already-warmed fit kernel — a warm
+    #: fit NEVER traces anything a cold fit of the same (composition,
+    #: bucket) has not already traced (the streaming warm-refit path,
+    #: docs/serving.md).
+    x0: object = None
 
     op: ClassVar[str] = "fit"
 
@@ -118,6 +124,14 @@ class FitRequest(Request):
             )
         if self.maxiter < 1:
             raise PintTpuError("FitRequest needs maxiter >= 1")
+        if self.x0 is not None:
+            x0 = np.asarray(self.x0, dtype=np.float64)
+            if x0.ndim != 1:
+                raise PintTpuError(
+                    f"FitRequest x0 must be 1-D (got shape {x0.shape})"
+                )
+            if not np.all(np.isfinite(x0)):
+                raise PintTpuError("FitRequest x0 must be finite")
 
 
 @dataclass(frozen=True)
@@ -143,6 +157,41 @@ class PredictRequest(Request):
         if self.deadline_s is not None and self.deadline_s < 0:
             raise PintTpuError(
                 f"negative deadline {self.deadline_s!r}"
+            )
+
+
+@dataclass(frozen=True)
+class AppendRequest(Request):
+    """Absorb a TAIL of newly-observed TOAs into a long-lived
+    streaming session (serve/stream.py::ObserveSession) — the
+    O(append) rank-update refit (fitting/gls.py streaming state).
+
+    ``toas`` is the appended tail ONLY (the stream owns the absorbed
+    prefix); ``state`` is the stream's host-side solver-state dict
+    (Gram blocks + maintained Sigma factor + frozen basis anchor),
+    threaded through the batched append kernel as runtime arguments
+    and returned advanced in :class:`AppendResponse`.  Users never
+    build these directly — ``ObserveSession.append`` does (it owns
+    per-stream serialization and the incremental -> warm -> cold
+    fallback chain)."""
+
+    #: host-side streaming solver state (fitting/gls.py stream_state_*)
+    state: object = None
+    #: frozen Fourier-basis anchor: (freqs (nharm,), day0) from the
+    #: stream's last refresh — appended rows evaluate the SAME basis
+    freqs: object = None
+    day0: float = 0.0
+    #: TOAs already absorbed by the stream (response provenance only)
+    ntoa_prev: int = 0
+
+    op: ClassVar[str] = "append"
+
+    def validate(self):
+        super().validate()
+        if not isinstance(self.state, dict) or "G" not in self.state:
+            raise PintTpuError(
+                "AppendRequest needs a streaming state dict "
+                "(open a stream via TimingEngine.open_stream)"
             )
 
 
@@ -175,6 +224,34 @@ class FitResponse:
     batch_size: int
     wall_ms: float
     replica: str = ""  # fabric executor tag ('rN' single, 'gN' gang)
+
+
+@dataclass
+class AppendResponse:
+    """Result of one absorbed tail.  ``refit`` records which rung of
+    the streaming fallback chain actually served it: 'incremental'
+    (the O(append) rank-update kernel), 'warm' (a full refit warm
+    -started from the stream's solution — same warmed fit kernel,
+    zero retraces), or 'cold' (a from-scratch fit; the drift guard's
+    last rung)."""
+
+    request_id: str
+    ntoa: int  # TOTAL TOAs absorbed by the stream after this append
+    appended: int  # live tail rows in this request
+    names: tuple
+    deltas: np.ndarray  # updated free-parameter deltas (nfree,)
+    uncertainties: np.ndarray
+    chi2: float
+    converged: bool
+    refit: str  # 'incremental' | 'warm' | 'cold'
+    alerts: tuple  # residual-anomaly alert strings ('' = none)
+    bucket: int  # TAIL-axis shape bucket that served the append
+    batch_size: int
+    wall_ms: float
+    replica: str = ""
+    #: advanced solver state (engine-internal; ObserveSession commits
+    #: it and strips it before handing the response to the caller)
+    state: object = None
 
 
 @dataclass

@@ -141,6 +141,15 @@ class TimingEngine:
             else env("PINT_TPU_SERVE_INFLIGHT", "4")
         )
         self.min_bucket = min_bucket
+        # streaming sessions (ISSUE 14): bounded count of long-lived
+        # ObserveSessions; past the cap open_stream sheds typed
+        self.max_streams = int(env("PINT_TPU_SERVE_STREAMS", "64"))
+        self._streams: set = set()  # lint: guarded-by(_streams_lock)
+        self._streams_lock = threading.Lock()
+        # streaming continuation executor (lazy): commit/fallback work
+        # runs OFF the replica fence threads so a fallback refit can
+        # never stall _finish_batch's serialized finisher
+        self._stream_exec = None  # lint: guarded-by(_streams_lock)
         # per-composition in-flight admission quota (ISSUE 11):
         # 0/unset = unlimited
         self.quota = int(
@@ -273,6 +282,57 @@ class TimingEngine:
     def submit_many(self, requests) -> list:
         return [self.submit(r) for r in requests]
 
+    def open_stream(self, par, toas, **kwargs):
+        """Open a long-lived streaming session (ISSUE 14): a cold fit
+        + state build over ``toas``, returning an
+        :class:`~pint_tpu.serve.stream.ObserveSession` whose
+        ``append(tail)`` absorbs newly-observed TOAs at O(append)
+        cost through the replica fabric.  Blocking (the cold fit is
+        O(n) by definition); bounded by ``PINT_TPU_SERVE_STREAMS`` —
+        past the cap, sheds typed ``RequestRejected('streams')``."""
+        from pint_tpu.serve.stream import ObserveSession
+
+        with self._streams_lock:
+            if len(self._streams) >= self.max_streams:
+                self._m_rejected.inc()
+                TRACER.event(
+                    "shed", "serve", reason="streams",
+                    open=len(self._streams),
+                )
+                raise RequestRejected(
+                    "streams",
+                    f"{len(self._streams)} streams open >= "
+                    f"PINT_TPU_SERVE_STREAMS={self.max_streams}",
+                )
+        s = ObserveSession(self, par, toas, **kwargs)
+        with self._streams_lock:
+            self._streams.add(s)
+        obs_metrics.gauge("serve.streams.open").set(
+            len(self._streams)
+        )
+        return s
+
+    def _close_stream(self, s):
+        with self._streams_lock:
+            self._streams.discard(s)
+            n = len(self._streams)
+        obs_metrics.gauge("serve.streams.open").set(n)
+
+    def _stream_executor(self):
+        """Lazy shared executor for stream continuations (commit /
+        fallback-refit work) — keeps them OFF the replica fence
+        threads, where they would run inside the serialized finisher
+        (``_finish_lock``) and stall co-batched members."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._streams_lock:
+            if self._stream_exec is None:
+                self._stream_exec = ThreadPoolExecutor(
+                    max_workers=2,
+                    thread_name_prefix="pint-tpu-stream",
+                )
+            return self._stream_exec
+
     # -- stage 2: collector ------------------------------------------------
     def _collect_loop(self):
         while True:
@@ -349,6 +409,12 @@ class TimingEngine:
                 tol = req.tol_chi2
                 if tol is None:
                     tol = 1e-10 if sess.mode == "f64" else 3e-6
+                if req.x0 is not None \
+                        and np.size(req.x0) != sess.cm.nfree:
+                    raise PintTpuError(
+                        f"FitRequest x0 has {np.size(req.x0)} entries; "
+                        f"the model has {sess.cm.nfree} free parameters"
+                    )
                 key = (
                     "fit", sess.composition, sess.bucket, sess.mode,
                     int(req.maxiter), float(tol),
@@ -357,6 +423,22 @@ class TimingEngine:
                 key = (
                     "residuals", sess.composition, sess.bucket,
                     bool(req.subtract_mean),
+                )
+            elif req.op == "append":
+                # O(append) streaming (ISSUE 14): the session/bucket
+                # are the TAIL's — the absorbed prefix lives in the
+                # request's solver state, so appending to a 1e6-TOA
+                # stream batches through the same small-bucket kernel
+                # as any other stream of the composition
+                if smod.stream_fast_path(sess.cm) is None:
+                    raise PintTpuError(
+                        "composition has no incremental streaming "
+                        "path (quantized/chromatic correlated basis); "
+                        "ObserveSession serves such appends through "
+                        "the warm-refit rung"
+                    )
+                key = (
+                    "append", sess.composition, sess.bucket, sess.mode,
                 )
             else:
                 raise PintTpuError(f"unknown serve op {req.op!r}")
@@ -511,12 +593,49 @@ class TimingEngine:
                 + [live[0].record.refnum] * pad
             bstack = bmod.stack_trees(bundles)
             rstack = bmod.stack_trees(refs)
-            xs = np.zeros((cap, sess.cm.nfree))
+            if key[0] == "append":
+                # the third stacked operand is each stream's solver
+                # state + frozen basis anchor + live tail count (all
+                # leaves composition-static shapes); pad slots repeat
+                # live[0]'s row — their outputs are discarded
+                auxs = [self._append_aux(p) for p in live]
+                auxs += [auxs[0]] * pad
+                xs = bmod.stack_trees(auxs)
+            else:
+                xs = np.zeros((cap, sess.cm.nfree))
+                if key[0] == "fit":
+                    # warm starts (ISSUE 14): x0 rides as a runtime
+                    # argument of the already-warmed fit kernel
+                    for j, p in enumerate(live):
+                        if p.req.x0 is not None:
+                            xs[j] = np.asarray(p.req.x0, np.float64)
         self._m_stack_pars.observe(distinct)
         obs_metrics.counter(
             f"serve.composition.{sess.cid}.batches"
         ).inc()
-        return BatchWork(key, live, (bstack, rstack, xs), sess, cap)
+        work = BatchWork(key, live, (bstack, rstack, xs), sess, cap)
+        if key[0] == "append":
+            # append groups never cross-key fuse: their operand triple
+            # carries a state tree, not an xs matrix
+            work.no_fuse = True
+        return work
+
+    @staticmethod
+    def _append_aux(p: _Pending) -> dict:
+        """One stream's per-row aux operand for the batched append
+        kernel (serve/session.py::_append_run)."""
+        req = p.req
+        return {
+            "state": {
+                k: np.asarray(v) for k, v in req.state.items()
+            },
+            "nlive": np.int32(len(req.toas)),
+            "freqs": np.asarray(
+                req.freqs if req.freqs is not None else [],
+                dtype=np.float64,
+            ),
+            "day0": np.float64(req.day0),
+        }
 
     def _dispatch(self, work: BatchWork):
         """Route one assembled batch (backpressure: when the routed
@@ -580,6 +699,18 @@ class TimingEngine:
                 {"residuals": resid, "chi2": chi2}, site=site,
                 what="served batch (residuals)",
             )
+        elif work.key[0] == "append":
+            # STATE leaves only: the in-kernel drift guard rolls a
+            # failed row's state back to its finite pre-append anchor,
+            # so non-finite state here means a sick replica (injected
+            # fault / device fault), not drift — drift stays a per-row
+            # NaN in dx/chi2, refused in _response so ONLY that
+            # stream's future fails over to the warm-refit rung
+            st, _dx, _covn, _nrm, _chi2 = mats
+            validate_finite(
+                {f"state.{k}": v for k, v in st.items()}, site=site,
+                what="served batch (append state)",
+            )
         else:
             x, chi2, _cov, _conv, _nbads, _bads = mats
             validate_finite(
@@ -622,6 +753,36 @@ class TimingEngine:
                 residuals_s=resid[i][:ntoa], chi2=float(chi2[i]),
                 bucket=sess.bucket, batch_size=nlive, wall_ms=wall_ms,
                 replica=rtag,
+            )
+        if key[0] == "append":
+            from pint_tpu.serve.api import AppendResponse
+
+            st, dx, covn, nrm, chi2 = mats
+            # per-row drift refusal: the in-kernel guard NaN-poisons
+            # dx/chi2 (state already rolled back) — refuse HERE so the
+            # stream's fallback chain re-serves via a warm full refit
+            validate_finite(
+                {"dx": np.asarray(dx[i]), "chi2": chi2[i]},
+                site=site,
+                what="served append (drift check poisoned the "
+                     "incremental solve)",
+            )
+            no = noffset(sess.cm)
+            cov = (
+                np.asarray(covn[i])
+                / np.outer(np.asarray(nrm[i]), np.asarray(nrm[i]))
+            )[no:, no:]
+            state_i = {k: np.asarray(v[i]) for k, v in st.items()}
+            return AppendResponse(
+                request_id=req.request_id,
+                ntoa=int(req.ntoa_prev) + ntoa, appended=ntoa,
+                names=tuple(sess.cm.free_names),
+                deltas=state_i["x"],
+                uncertainties=np.sqrt(np.diag(cov)),
+                chi2=float(chi2[i]), converged=True,
+                refit="incremental", alerts=(),
+                bucket=sess.bucket, batch_size=nlive,
+                wall_ms=wall_ms, replica=rtag, state=state_i,
             )
         # fit: the make_scan_fit_loop result tuple, batched
         x, chi2, (covn, nrm), conv, _nbads, bads = mats
@@ -736,6 +897,17 @@ class TimingEngine:
                 "failed": mc("serve.warm.failed").value,
                 "stale": mc("serve.warm.stale").value,
             },
+            # O(append) streaming (ISSUE 14): which fallback rung
+            # served each absorbed tail (docs/serving.md)
+            "stream": {
+                "open": len(self._streams),
+                "appends": mc("serve.stream.appends").value,
+                "incremental": mc("serve.stream.incremental").value,
+                "warm_refits": mc("serve.stream.warm_refit").value,
+                "cold_refits": mc("serve.stream.cold_refit").value,
+                "refreshes": mc("serve.stream.refresh").value,
+                "alerts": mc("serve.stream.alerts").value,
+            },
         }
 
     def reset_stats(self):
@@ -757,6 +929,10 @@ class TimingEngine:
             self._cond.notify_all()
         self._collector.join(timeout)
         self.pool.drain(timeout)
+        with self._streams_lock:
+            exc, self._stream_exec = self._stream_exec, None
+        if exc is not None:
+            exc.shutdown(wait=True)
         if self._ledger is not None:
             from pint_tpu.serve import warm_ledger as wlmod
 

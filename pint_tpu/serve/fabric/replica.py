@@ -168,6 +168,10 @@ class BatchWork:
             return smod.build_fit_kernel(
                 self.session, mode, maxiter, tol, site, warm=warm
             )
+        if self.key[0] == "append":
+            # warm ledger excluded: replay cannot synthesize a
+            # solver-state stack (build_append_kernel documents)
+            return smod.build_append_kernel(self.session, site)
         return smod.build_residuals_kernel(
             self.session, self.key[3], site, warm=warm
         )

@@ -542,15 +542,131 @@ def _fit_run(session: Session, mode: str, maxiter: int,
     return run
 
 
+def stream_fast_path(cm):
+    """Which O(append) incremental path a composition is eligible for:
+    ``'fourier'`` (exactly one pure-Fourier achromatic correlated
+    basis — appended basis rows re-evaluate from the stream's FROZEN
+    (freqs, day0) anchor via models/noise.py::fourier_basis_rows),
+    ``'white'`` (no correlated errors — the noise_basis_or_empty dummy
+    column, appended rows enter as exact zeros), or ``None``
+    (quantized/chromatic bases whose appended rows are not a pure
+    function of the new TOAs — ECORR epochs, DMX-like structure;
+    ObserveSession serves every append of such compositions through
+    the warm full-refit rung instead)."""
+    if not cm.has_correlated_errors:
+        return "white"
+    # eval_shape: trace-only structure query, no device work
+    spec = jax.eval_shape(cm.noise_fourier_spec, jnp.zeros(cm.nfree))
+    return "fourier" if spec is not None else None
+
+
+def _append_run(session: Session):
+    """Raw batched O(append) body (ISSUE 14): (tail bundle stack,
+    ref stack, aux stack) -> (state' stack, dx, covn, norm, chi2).
+
+    Each row's ``aux`` threads the stream's host-held solver state
+    (fitting/gls.py stream_state_*) plus the frozen Fourier anchor
+    and the live tail count as RUNTIME arguments — appending to any
+    stream of the composition dispatches through this one kernel with
+    zero retraces.  Pad rows (tail bucket) enter with EXACTLY zero
+    Ninv, so they are perfectly neutral in the accumulated Gram.  A
+    failed drift check rolls the state back to the PRE-append anchor
+    (stream_state_solve's own rollback target is the post-append
+    state, which a degenerate rank update may already have poisoned)
+    and returns NaN dx/chi2 — the per-row signal ObserveSession's
+    fallback chain keys on."""
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import (
+        stream_state_append, stream_state_solve,
+    )
+    from pint_tpu.models.noise import fourier_basis_rows
+    from pint_tpu.ops import solve_policy
+
+    proto = session.cm
+    no = noffset(proto)
+    bucket = session.bucket
+    rtol = solve_policy.stream_drift_rtol()
+    path = stream_fast_path(proto)
+    if path is None:
+        raise PintTpuError(
+            "composition has no incremental streaming path "
+            "(quantized/chromatic correlated basis) — appends must "
+            "take the warm-refit rung"
+        )
+    (kcols,), _ = _basis_struct(proto)
+
+    def one(cm, aux):
+        state = aux["state"]
+        x = state["x"]
+        r = cm.time_residuals(x, subtract_mean=False)
+        M = design_with_offset(cm, x)
+        live = jnp.arange(bucket) < aux["nlive"]
+        Ninv = jnp.where(
+            live, 1.0 / jnp.square(cm.scaled_sigma(x)), 0.0
+        )
+        if path == "fourier":
+            T = fourier_basis_rows(cm.bundle, aux["freqs"], aux["day0"])
+        else:  # white: the dummy basis column stays exactly zero
+            T = jnp.zeros((bucket, kcols))
+        st = stream_state_append(state, r, M, Ninv, T)
+        st2, dx, (covn, nrm), chi2 = stream_state_solve(
+            st, no, check_rtol=rtol
+        )
+        ok = jnp.isfinite(chi2) & jnp.all(jnp.isfinite(dx))
+        st2 = {kk: jnp.where(ok, v, state[kk])
+               for kk, v in st2.items()}
+        return st2, dx, covn, nrm, chi2
+
+    call = _with_swapped(proto, session.static_ref, one)
+
+    def run(bundles, refs, auxs):
+        return jax.vmap(call)(bundles, refs, auxs)
+
+    return run
+
+
+def _stream_init_run(session: Session):
+    """Raw streaming-state (re)build body: (padded full bundle,
+    refnum, x, nlive) -> state dict — the only O(n) solver work in a
+    stream's steady state, dispatched directly by ObserveSession at
+    open/refresh (not batched: refresh is rare by construction).
+    Retraces only at FULL-set bucket promotion."""
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import stream_state_init
+
+    proto = session.cm
+    bucket = session.bucket
+
+    def one(cm, x, nlive):
+        r = cm.time_residuals(x, subtract_mean=False)
+        M = design_with_offset(cm, x)
+        live = jnp.arange(bucket) < nlive
+        Ninv = jnp.where(
+            live, 1.0 / jnp.square(cm.scaled_sigma(x)), 0.0
+        )
+        T, phi = cm.noise_basis_or_empty(x)
+        return stream_state_init(r, M, Ninv, T, phi, x)
+
+    call = _with_swapped(proto, session.static_ref, one)
+
+    def run(bundle, refnum, x, nlive):
+        return call(bundle, refnum, x, nlive)
+
+    return run
+
+
 def _run_for_key(session: Session, key: tuple):
     """The raw (unjitted) batched body for one fabric group key —
-    exactly the program build_fit_kernel / build_residuals_kernel
-    would jit for ``key`` (fabric BatchWork.make_kernel's dispatch),
-    exposed so the cross-key fuser composes member programs without
-    duplicating the key decode."""
+    exactly the program build_fit_kernel / build_residuals_kernel /
+    build_append_kernel would jit for ``key`` (fabric
+    BatchWork.make_kernel's dispatch), exposed so the cross-key fuser
+    composes member programs without duplicating the key decode
+    (append groups are no_fuse, but the decode stays total)."""
     if key[0] == "fit":
         _, _, _, mode, maxiter, tol = key
         return _fit_run(session, mode, maxiter, tol)
+    if key[0] == "append":
+        return _append_run(session)
     return _residuals_run(session, key[3])
 
 
@@ -575,6 +691,35 @@ def build_fit_kernel(session: Session, mode: str, maxiter: int,
         _fit_run(session, mode, maxiter, tol_chi2), site,
         cid=session.cid, warm=warm,
         donate_argnums=serve_donate_argnums(),
+    )
+
+
+def build_append_kernel(session: Session, site: str, warm=None):
+    """Batched O(append) kernel (see :func:`_append_run`), jitted
+    through the traced_jit chokepoint with the serving donation
+    contract — the stacked solver states are per-dispatch
+    ``device_put`` copies of host-held stream state, so donating them
+    is safe by construction (the authoritative state lives on the
+    host in ObserveSession and commits only from fenced outputs).
+    ``warm`` is accepted for make_kernel signature parity but the
+    ledger never records append kernels: replay cannot synthesize a
+    solver-state stack (serve/warm_ledger.py replays fit/residuals
+    only)."""
+    del warm
+    return traced_jit(
+        _append_run(session), site,
+        cid=session.cid,
+        donate_argnums=serve_donate_argnums(),
+    )
+
+
+def build_stream_init_kernel(session: Session, site: str):
+    """Streaming-state (re)build kernel (see :func:`_stream_init_run`)
+    — dispatched directly by ObserveSession (open/refresh), outside
+    the batcher.  No donation: the x operand is the caller's live
+    solution vector."""
+    return traced_jit(
+        _stream_init_run(session), site, cid=session.cid,
     )
 
 

@@ -1,0 +1,463 @@
+"""ObserveSession: the O(append) streaming-timing serving surface.
+
+Reference parity: none — the reference framework refits from scratch
+per dataset; this is the ISSUE 14 tentpole.  An observatory pipeline
+watches one pulsar for months: every few minutes a handful of new
+TOAs arrive and the operator wants the refreshed timing solution
+(and residual alerts) at O(new data) cost, not O(entire history).
+
+A stream owns three layers of state:
+
+- **TOA layer**: the absorbed TOA set, extended per append through
+  ``toas/cache.py::append_ingested`` — ONLY the tail is ingested
+  (clock/geometry columns of absorbed rows are never recomputed).
+- **Solver layer**: the additive Gram-block state of
+  ``fitting/gls.py::stream_state_*`` (normal equations, Woodbury
+  blocks, the maintained equilibrated Sigma Cholesky factor advanced
+  by ``ops/cholupdate.py``), held HOST-side as numpy between appends
+  — donation-safe by construction (the serve kernels donate their
+  per-dispatch ``device_put`` copies, never the authority) — plus
+  the FROZEN Fourier anchor (freqs, day0) appended basis rows are
+  evaluated against (``models/noise.py::fourier_basis_rows``).
+- **Serving layer**: appends ride the SAME replica fabric as every
+  other request — an :class:`~pint_tpu.serve.api.AppendRequest`
+  batched under key ``("append", composition, tail bucket, mode)``,
+  so concurrent streams of one composition stack into one vmapped
+  dispatch and steady state never retraces (tail buckets are
+  power-of-two; a retrace happens only at bucket promotion).
+
+Fallback chain (every rung resolves the SAME caller future, typed):
+
+1. **incremental** — the O(append) rank-update kernel.  Eligible
+   compositions only (``serve/session.py::stream_fast_path``: white
+   or a single pure-Fourier achromatic basis); the in-kernel drift
+   guard (``PINT_TPU_STREAM_DRIFT_RTOL`` poison-to-NaN residual
+   check) rolls the state back and fails ONLY that stream's row.
+2. **warm** — a full refit warm-started from the stream's solution
+   (``FitRequest(x0=...)``: a runtime argument of the already-warmed
+   fit kernel — zero retraces), which also re-anchors the solver
+   state (the periodic refresh: every ``PINT_TPU_STREAM_REFRESH``
+   appends the append itself takes this rung).  Ineligible
+   compositions (ECORR/chromatic bases) serve every append here.
+3. **cold** — a from-scratch fit (x0 = par-file model), the ladder's
+   strict landing spot.
+4. a typed exception on the caller's future.  Never a hang, never a
+   silent wrong answer.
+
+Appends on one stream are SERIALIZED (the solver state is a chain);
+continuation work runs on the engine's stream executor, OFF the
+replica fence threads.  Residual alerts: the chi2 increment of each
+append is scored against its chi2_k expectation (plus the
+``fitting/utils.py::ftest`` hook for nested-model checks on refresh);
+anomalies land in ``AppendResponse.alerts`` and
+``serve.stream.alerts``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from pint_tpu.exceptions import PintTpuError, RequestRejected
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime.guard import validate_finite
+from pint_tpu.serve import batcher as bmod
+from pint_tpu.serve import session as smod
+from pint_tpu.serve.api import (
+    PRIORITY_NORMAL, AppendRequest, AppendResponse, FitRequest,
+)
+
+#: default appends between full re-anchors of the solver state
+DEFAULT_REFRESH = 64
+
+#: default chi2-increment tail probability below which an append
+#: raises a residual alert (scored against chi2_k, k = appended rows)
+DEFAULT_ALERT_P = 1e-3
+
+
+def stream_refresh() -> int:
+    """PINT_TPU_STREAM_REFRESH: appends between full re-anchors (the
+    linearized r-advance drifts at second order; the drift guard
+    catches decay, the refresh bounds it by construction)."""
+    return int(os.environ.get("PINT_TPU_STREAM_REFRESH",
+                              str(DEFAULT_REFRESH)))
+
+
+def _chi2_tail_p(dchi2: float, k: int) -> float:
+    """P(chi2_k >= dchi2) — the residual-alert score: each appended
+    whitened residual contributes ~chi2_1 under the current model."""
+    from scipy.stats import chi2 as chi2_dist
+
+    return float(chi2_dist.sf(max(float(dchi2), 0.0), max(int(k), 1)))
+
+
+class ObserveSession:
+    """One long-lived streaming timing session (build via
+    ``TimingEngine.open_stream`` — the engine owns the stream cap)."""
+
+    def __init__(self, engine, par, toas, *, maxiter: int = 4,
+                 refresh: int | None = None,
+                 alert_p: float | None = None):
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        self.engine = engine
+        self._rec = engine.sessions.record_for(par)
+        self._maxiter = int(maxiter)
+        self._refresh = (
+            stream_refresh() if refresh is None else int(refresh)
+        )
+        self._alert_p = (
+            DEFAULT_ALERT_P if alert_p is None else float(alert_p)
+        )
+        self._lock = threading.Lock()
+        self._pending: deque = deque()  # lint: guarded-by(_lock)
+        self._busy = False  # lint: guarded-by(_lock)
+        self._closed = False  # lint: guarded-by(_lock)
+        self._init_kernels: dict = {}  # bucket -> (session, kernel)
+        self._state = None  # host-side solver state (numpy leaves)
+        self._freqs = np.zeros(0)
+        self._day0 = 0.0
+        self._since_refresh = 0
+        if toas.t_tdb is None:
+            ingest_for_model(toas, self._rec.model)
+        with TRACER.span(
+            "stream:open", "serve", ntoa=len(toas),
+        ):
+            # rung 3 exactly: the from-scratch anchor fit
+            resp = engine.submit(FitRequest(
+                par=self._rec.par, toas=toas, maxiter=self._maxiter,
+            )).result()
+            self._commit_fit(resp, toas)
+            self._rebuild_state()
+
+    # -- the public surface ------------------------------------------------
+    def append(self, tail, *, deadline_s=None,
+               priority=PRIORITY_NORMAL) -> Future:
+        """Absorb newly-observed TOAs; returns a Future resolving to
+        an :class:`AppendResponse` (or raising typed).  Appends on one
+        stream serialize in submission order — the solver state is a
+        chain; concurrency comes from batching ACROSS streams."""
+        outer: Future = Future()
+        with TRACER.span(
+            "stream:append", "serve", ntoa=len(tail),
+            absorbed=self._ntoa,
+        ):
+            obs_metrics.counter("serve.stream.appends").inc()
+            with self._lock:
+                if self._closed:
+                    raise RequestRejected(
+                        "stream-closed", "ObserveSession is closed"
+                    )
+                self._pending.append(
+                    (tail, outer, deadline_s, priority)
+                )
+                launch = not self._busy
+                if launch:
+                    self._busy = True
+            if launch:
+                self.engine._stream_executor().submit(self._advance)
+        return outer
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+        self.engine._close_stream(self)
+
+    @property
+    def ntoa(self) -> int:
+        return self._ntoa
+
+    @property
+    def deltas(self) -> np.ndarray:
+        return np.array(self._x)
+
+    @property
+    def uncertainties(self) -> np.ndarray:
+        return np.array(self._unc)
+
+    @property
+    def chi2(self) -> float:
+        return self._chi2
+
+    @property
+    def names(self) -> tuple:
+        return tuple(self._names)
+
+    def fitted_par(self) -> str:
+        """Par-file text with the stream's current solution
+        committed (the request's own record, never the session
+        prototype)."""
+        return self._rec.commit_clone(
+            self._names, self._x, self._unc
+        ).as_parfile()
+
+    # -- serialized append machinery (stream-executor threads) -------------
+    def _advance(self):
+        with self._lock:
+            if not self._pending:
+                self._busy = False
+                return
+            tail, outer, deadline_s, priority = self._pending.popleft()
+        try:
+            self._serve_one(tail, outer, deadline_s, priority)
+        except Exception as e:
+            if not outer.done():
+                outer.set_exception(e)
+            self._advance()
+
+    def _serve_one(self, tail, outer, deadline_s, priority):
+        incremental = (
+            self._state is not None
+            and self._since_refresh < self._refresh
+        )
+        if not incremental:
+            # the periodic refresh rides the warm rung: the refit's
+            # state rebuild IS the re-anchor
+            self._warm_refit(tail, outer, deadline_s, priority,
+                             rung="warm")
+            return
+        req = AppendRequest(
+            par=self._rec.par, toas=tail, state=self._state,
+            freqs=self._freqs, day0=self._day0,
+            ntoa_prev=self._ntoa, deadline_s=deadline_s,
+            priority=priority,
+        )
+        fut = self.engine.submit(req)
+        fut.add_done_callback(
+            lambda f: self.engine._stream_executor().submit(
+                self._on_incremental, f, tail, outer,
+                deadline_s, priority,
+            )
+        )
+
+    def _on_incremental(self, fut, tail, outer, deadline_s, priority):
+        try:
+            resp = fut.result()
+        except Exception as e:
+            # drift poison, replica fault, shed — every failure class
+            # fails over to the warm rung (docs/serving.md records the
+            # reason ladder); the warm refit re-anchors, so a drifted
+            # state never serves twice
+            obs_metrics.counter("serve.stream.drift_fallback").inc()
+            TRACER.event(
+                "stream-fallback", "serve", rung="warm",
+                error=type(e).__name__,
+            )
+            try:
+                self._warm_refit(tail, outer, deadline_s, priority,
+                                 rung="warm")
+            except Exception as e2:
+                if not outer.done():
+                    outer.set_exception(e2)
+                self._advance()
+            return
+        try:
+            from pint_tpu.toas.cache import append_ingested
+
+            merged = append_ingested(
+                self._toas, tail, self._rec.model
+            )
+            alerts = self._score_alerts(
+                resp.chi2, len(tail), resp.refit
+            )
+            self._toas = merged
+            self._ntoa = len(merged)
+            self._state = resp.state
+            self._x = np.asarray(resp.state["x"])
+            self._unc = np.asarray(resp.uncertainties)
+            self._chi2 = float(resp.chi2)
+            self._since_refresh += 1
+            resp.ntoa = self._ntoa
+            resp.alerts = alerts
+            resp.state = None  # engine-internal, never caller-facing
+            obs_metrics.counter("serve.stream.incremental").inc()
+            outer.set_result(resp)
+        except Exception as e:
+            if not outer.done():
+                outer.set_exception(e)
+        self._advance()
+
+    def _warm_refit(self, tail, outer, deadline_s, priority, *,
+                    rung: str):
+        """Rungs 2/3: a full refit over the merged set, warm-started
+        from the stream's solution on the 'warm' rung (x0 rides the
+        ALREADY-WARMED fit kernel as a runtime argument — zero
+        retraces at steady bucket), from the par-file model on
+        'cold'."""
+        from pint_tpu.toas.cache import append_ingested
+
+        merged = append_ingested(self._toas, tail, self._rec.model)
+        req = FitRequest(
+            par=self._rec.par, toas=merged,
+            x0=(np.array(self._x) if rung == "warm" else None),
+            maxiter=self._maxiter, deadline_s=deadline_s,
+            priority=priority,
+        )
+        fut = self.engine.submit(req)
+        fut.add_done_callback(
+            lambda f: self.engine._stream_executor().submit(
+                self._on_refit, f, merged, tail, outer,
+                deadline_s, priority, rung,
+            )
+        )
+
+    def _on_refit(self, fut, merged, tail, outer, deadline_s,
+                  priority, rung):
+        try:
+            resp = fut.result()
+        except Exception as e:
+            if rung == "warm":
+                obs_metrics.counter("serve.stream.cold_fallback").inc()
+                TRACER.event(
+                    "stream-fallback", "serve", rung="cold",
+                    error=type(e).__name__,
+                )
+                try:
+                    self._warm_refit(tail, outer, deadline_s,
+                                     priority, rung="cold")
+                except Exception as e2:
+                    if not outer.done():
+                        outer.set_exception(e2)
+                    self._advance()
+            else:
+                if not outer.done():
+                    outer.set_exception(e)
+                self._advance()
+            return
+        try:
+            alerts = self._score_alerts(resp.chi2, len(tail), rung)
+            self._commit_fit(resp, merged)
+            self._rebuild_state()
+            obs_metrics.counter(f"serve.stream.{rung}_refit").inc()
+            outer.set_result(AppendResponse(
+                request_id=resp.request_id, ntoa=self._ntoa,
+                appended=len(tail), names=resp.names,
+                deltas=resp.deltas,
+                uncertainties=resp.uncertainties, chi2=resp.chi2,
+                converged=resp.converged, refit=rung, alerts=alerts,
+                bucket=resp.bucket, batch_size=resp.batch_size,
+                wall_ms=resp.wall_ms, replica=resp.replica,
+            ))
+        except Exception as e:
+            if not outer.done():
+                outer.set_exception(e)
+        self._advance()
+
+    # -- state anchoring ---------------------------------------------------
+    def _commit_fit(self, resp, toas):
+        self._toas = toas
+        self._ntoa = len(toas)
+        self._names = tuple(resp.names)
+        self._x = np.asarray(resp.deltas, dtype=np.float64)
+        self._unc = np.asarray(resp.uncertainties)
+        self._chi2 = float(resp.chi2)
+
+    def _score_alerts(self, chi2_new, k: int, rung: str) -> tuple:
+        """chi2-increment anomaly score: under the current model the
+        k appended whitened residuals add ~chi2_k; a tail probability
+        below ``alert_p`` flags a timing anomaly (glitch / profile
+        change / instrumental).  Refit rungs may DECREASE chi2 (the
+        solution moved); only the increment is scored."""
+        dchi2 = float(chi2_new) - self._chi2
+        p = _chi2_tail_p(dchi2, k)
+        if p >= self._alert_p:
+            return ()
+        obs_metrics.counter("serve.stream.alerts").inc()
+        TRACER.event(
+            "stream-alert", "serve", dchi2=round(dchi2, 3), k=k,
+            p=float(p), rung=rung,
+        )
+        return (
+            f"chi2-jump: +{dchi2:.3f} over {k} appended TOAs "
+            f"(P[chi2_{k} >= dchi2] = {p:.2e} < {self._alert_p:g})",
+        )
+
+    def _rebuild_state(self):
+        """(Re)build the solver state from the full absorbed set —
+        stream open and every refresh.  O(n), by design rare; the
+        init kernel is cached per full-set bucket, so a re-anchor at
+        an unchanged bucket dispatches warm and a retrace happens
+        only at bucket promotion."""
+        from pint_tpu.toas.bundle import make_bundle
+
+        # a failed rebuild must leave the stream WARM-ONLY, never a
+        # stale state that excludes already-committed TOAs
+        self._state = None
+        eng = self.engine
+        rec = self._rec
+        nb = make_bundle(
+            self._toas, rec.model._build_masks(self._toas),
+            as_numpy=True,
+        )
+        sess = eng.sessions.session_for(
+            rec, self._toas, nb, eng.min_bucket
+        )
+        if smod.stream_fast_path(sess.cm) is None:
+            # no incremental path for this composition: every append
+            # takes the warm rung (still batched, still zero-retrace)
+            self._state = None
+            return
+        with TRACER.span(
+            "stream:refresh", "serve", ntoa=self._ntoa,
+            bucket=sess.bucket,
+        ):
+            obs_metrics.counter("serve.stream.refresh").inc()
+            cached = self._init_kernels.get(sess.bucket)
+            if cached is None:
+                kernel = smod.build_stream_init_kernel(
+                    sess, f"serve:stream-init:b{sess.bucket}"
+                )
+                # first dispatch TRACES through the shared prototype
+                # (_with_swapped mutates it) — same discipline as
+                # Replica._kernel_for
+                with sess.trace_lock:
+                    out = self._dispatch_init(kernel, sess, nb)
+                self._init_kernels[sess.bucket] = (sess, kernel)
+            else:
+                _, kernel = cached
+                out = self._dispatch_init(kernel, sess, nb)
+            state = {k: np.asarray(v) for k, v in out.items()}
+            validate_finite(
+                {f"state.{k}": v for k, v in state.items()},
+                site="serve:stream-init",
+                what="streaming state rebuild",
+            )
+            self._state = state
+            self._freqs, self._day0 = self._frozen_anchor(sess)
+            self._since_refresh = 0
+
+    def _dispatch_init(self, kernel, sess, nb):
+        return kernel(
+            bmod.pad_bundle_np(nb, sess.bucket),
+            self._rec.refnum,
+            np.asarray(self._x, dtype=np.float64),
+            np.int32(self._ntoa),
+        )
+
+    def _frozen_anchor(self, sess):
+        """The frozen Fourier layout appended rows evaluate against:
+        host-IEEE twin of models/noise.py::fourier_freqs over the
+        CURRENT absorbed set (exactly host_fourier_basis's
+        convention, which precomputed the init basis in
+        bundle.masks)."""
+        if smod.stream_fast_path(sess.cm) != "fourier":
+            return np.zeros(0), 0.0
+        (kcols,), _ = smod._basis_struct(sess.cm)
+        nharm = kcols // 2
+        day = np.asarray(self._toas.t_tdb.mjd_int, dtype=np.float64)
+        sec = np.asarray(
+            self._toas.t_tdb.sec.to_float(), dtype=np.float64
+        )
+        t = (day - day[0]) * 86400.0 + sec
+        tspan = t.max() - t.min()
+        if not tspan > 0:
+            raise PintTpuError(
+                "streaming Fourier anchor needs a nonzero TOA span"
+            )
+        freqs = np.arange(1, nharm + 1, dtype=np.float64) / tspan
+        return freqs, float(day[0])

@@ -199,6 +199,45 @@ def _stitch_columns(full: TOAs, prefix: TOAs, tail: TOAs):
     full.ephem = tail.ephem if tail.ephem is not None else prefix.ephem
 
 
+def append_ingested(base: TOAs, tail: TOAs, model=None,
+                    **ingest_kw) -> TOAs:
+    """In-memory sibling of :func:`get_TOAs`'s append-incremental
+    path — the streaming ObserveSession's TOA-set extension (ISSUE
+    14): ingest ONLY the appended ``tail`` (the base's computed
+    columns are reused as-is, zero re-ingest of absorbed rows) and
+    merge.  The merge time-sorts and refuses inconsistent ephemerides
+    (toas/toas.py::merge_TOAs); accounting lands on the same
+    ``ingest.cache.incremental``/``rows_reused`` counters as the
+    file-path tail ingest, so the O(append) claim is observable."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.obs.trace import TRACER
+    from pint_tpu.toas.ingest import ingest, ingest_for_model
+    from pint_tpu.toas.toas import merge_TOAs
+
+    if base.t_tdb is None:
+        raise ValueError(
+            "append_ingested needs an already-ingested base TOA set"
+        )
+    with TRACER.span(
+        "ingest:append", "ingest", base=len(base), tail=len(tail),
+    ):
+        if tail.t_tdb is None:
+            if model is not None:
+                ingest_for_model(tail, model, **ingest_kw)
+            else:
+                ingest(tail, **ingest_kw)
+        merged = merge_TOAs([base, tail])
+    obs_metrics.counter(
+        "ingest.cache.incremental",
+        help="ingest-cache prefix reuses (tail-only ingest)",
+    ).inc()
+    obs_metrics.counter(
+        "ingest.cache.rows_reused", unit="TOAs",
+        help="TOA rows served from the ingest cache",
+    ).inc(len(base))
+    return merged
+
+
 def get_TOAs(
     tim_path,
     model=None,

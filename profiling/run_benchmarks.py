@@ -438,7 +438,7 @@ def main():
                              "6", "7", "7b", "serve",
                              "serve_replicas", "serve_population",
                              "serve_gang", "dispatch_floor", "chaos",
-                             "mfu"])
+                             "mfu", "streaming"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -504,6 +504,20 @@ def main():
             from mfu import mfu_rows
 
             for row in mfu_rows():
+                print(json.dumps(row))
+            continue
+        if str(c) == "streaming":
+            # O(append) streaming ladder: append sizes 1/16/256/4096
+            # on large absorbed bases — incremental vs full-refit ms
+            # per append + p99 + zero-steady-trace accounting (ISSUE
+            # 14; profiling/streaming_append.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from streaming_append import streaming_rows
+
+            for row in streaming_rows():
                 print(json.dumps(row))
             continue
         if str(c) == "dispatch_floor":

@@ -1,0 +1,144 @@
+"""O(append) streaming-session ladder (ISSUE 14).
+
+Sweeps the appended-tail size (1/16/256/4096 TOAs) against long-lived
+``ObserveSession`` streams over large absorbed bases and reports, per
+(base, append-size) rung, the steady-state incremental append latency
+(median + p99), the full-refit reference on the same merged set
+through the same warmed engine (the cost every append paid before the
+rank-update path existed), the speedup, and the steady-state XLA
+trace count (must stay ZERO — appends ride the warmed per-tail-bucket
+kernel; a growing count is the retrace antipattern the serving stack
+exists to kill).
+
+Bases default to 1e5 everywhere plus 1e6 on accelerators — the 1e6
+rung is the production campaign shape but its O(n) anchor fit and
+from-scratch references are too slow to be a useful signal on the
+virtual CPU mesh (the bench.py ``stream`` block carries the honest
+CPU numbers at a bounded base).
+
+All rungs share ONE stream per base: each append-size rung warms its
+own power-of-two tail-bucket kernel (64/64/256/4096) with one
+untimed append, then times ``nsteady`` appends; absorbed TOAs
+accumulate but stay inside the base's fit bucket, so the full-refit
+reference stays warm too.  Tails are pre-ingested slices of one
+simulated set, so both sides of the comparison measure solver + serve
+cost, not host ingest (toas/cache.py::append_ingested stitches the
+ingested tail either way).
+
+Usage: ``python profiling/streaming_append.py`` (one JSON line per
+rung), or via ``python profiling/run_benchmarks.py --configs
+streaming``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+PAR = (
+    "PSR STRM\nF0 218.81 1\nF1 -2.2e-15 1\nPEPOCH 55000\n"
+    "DM 12.4 1\nTNREDAMP -13.2\nTNREDGAM 3.2\nTNREDC 10\n"
+)
+
+
+def _pct(samples, q):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def streaming_rows(bases=None, appends=(1, 16, 256, 4096),
+                   nsteady: int = 5, maxiter: int = 4):
+    """Yield one result row per (base_ntoa, append_size) rung."""
+    import jax
+
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    if bases is None:
+        bases = (100_000,)
+        if jax.default_backend() != "cpu":
+            bases = (100_000, 1_000_000)
+    rows = []
+    for n in bases:
+        reserve = sum(k * (1 + nsteady) for k in appends)
+        model, toas = make_test_pulsar(
+            PAR, ntoa=n + reserve, start_mjd=53000.0,
+            end_mjd=57500.0, seed=14, iterations=1,
+        )
+        par = model.as_parfile()
+        engine = TimingEngine(
+            max_batch=4, max_wait_ms=1.0, inflight=2,
+        )
+        try:
+            t0 = time.perf_counter()
+            stream = engine.open_stream(
+                par, toas[:n], maxiter=maxiter,
+            )
+            open_s = time.perf_counter() - t0
+            used = n
+            for k in appends:
+                # one untimed append warms the tail-bucket kernel
+                stream.append(toas[used:used + k]).result(
+                    timeout=3600
+                )
+                used += k
+                traces0 = obs_metrics.counter(
+                    "compile.traces"
+                ).value
+                lat = []
+                for _ in range(nsteady):
+                    t0 = time.perf_counter()
+                    stream.append(
+                        toas[used:used + k]
+                    ).result(timeout=3600)
+                    lat.append(time.perf_counter() - t0)
+                    used += k
+                steady_traces = (
+                    obs_metrics.counter("compile.traces").value
+                    - traces0
+                )
+                # full-refit reference: the same merged set through
+                # the same warmed engine (1 untimed + 3 timed)
+                merged = toas[:used]
+                full = []
+                for i in range(4):
+                    t0 = time.perf_counter()
+                    engine.submit(FitRequest(
+                        par=par, toas=merged, maxiter=maxiter,
+                    )).result(timeout=3600)
+                    if i:
+                        full.append(time.perf_counter() - t0)
+                incr_ms = _pct(lat, 0.5) * 1e3
+                full_ms = _pct(full, 0.5) * 1e3
+                rows.append({
+                    "config": "streaming append ladder",
+                    "backend": jax.default_backend(),
+                    "base_ntoa": n,
+                    "append": k,
+                    "absorbed_ntoa": used,
+                    "open_s": round(open_s, 2),
+                    "incremental_ms": round(incr_ms, 3),
+                    "incremental_p99_ms": round(
+                        _pct(lat, 0.99) * 1e3, 3
+                    ),
+                    "full_refit_ms": round(full_ms, 3),
+                    "speedup_x": round(full_ms / incr_ms, 2),
+                    "steady_traces": steady_traces,
+                    "stream": engine.stats()["stream"],
+                })
+        finally:
+            engine.close()
+    return rows
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    for row in streaming_rows():
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

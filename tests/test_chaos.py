@@ -9,6 +9,10 @@ kill-and-restart warm-ledger leg:
 - every (executor, kind) leg reports ``ok`` — futures typed, health
   kinds quarantine AND readmit, deterministic kinds stay LIVE, zero
   steady traces/retraces while faults fire and batches re-route;
+- the streaming leg (ISSUE 14) pins faults at the ``serve:append``
+  dispatch sites of a live ObserveSession — every append resolves
+  typed through the fallback ladder, and the stream recovers the
+  incremental path once the fault clears;
 - the restart leg kills an engine mid-wave (orphans typed), then
   replays the ledger with zero fresh XLA compiles;
 - :func:`tools.chaos.classify` buckets outcomes strictly by TYPE —
@@ -56,7 +60,7 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
     legs = {(leg["tag"], leg["kind"]): leg for leg in report["legs"]}
     assert set(legs) == {
         ("r0", "nan"), ("r0", "413"), ("r1", "nan"), ("r1", "413"),
-        ("restart", "kill-restart"),
+        ("stream", "append-faults"), ("restart", "kill-restart"),
     }
     for leg in report["legs"]:
         assert leg["ok"], leg
@@ -71,6 +75,17 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
         for leg in (nan, det):
             assert leg["steady_traces"] == 0
             assert leg["steady_retraces"] == 0
+    # the streaming leg (ISSUE 14): faulted appends resolve typed
+    # through the fallback ladder, then the stream recovers the
+    # incremental path with zero fresh traces
+    stream = legs[("stream", "append-faults")]
+    assert {r["kind"] for r in stream["rounds"]} == {"nan", "413"}
+    for rnd in stream["rounds"]:
+        assert rnd["ok"], rnd
+        assert rnd["fired"] > 0
+        assert rnd["faulted"]["typed"] and rnd["after"]["typed"]
+        assert rnd["clean_traces"] == 0
+        assert rnd["recovered_incremental"]
     restart = legs[("restart", "kill-restart")]
     assert restart["killed_typed"] and restart["replayed"] >= 1
     assert restart["fresh_traces"] == 0
@@ -106,7 +121,10 @@ def test_time_budget_reports_skipped_legs_explicitly(monkeypatch):
         kinds=("413",), npsr=2, replicas=2, gangs=0, restart=False,
         time_budget_s=0.0, timeout=60.0,
     )
-    assert report["skipped"] == 2
+    assert report["skipped"] == 3  # 2 fault legs + the stream leg
+    kinds = {leg["tag"]: leg["kind"] for leg in report["legs"]}
+    assert kinds == {"r0": "413", "r1": "413",
+                     "stream": "append-faults"}
     for leg in report["legs"]:
-        assert leg == {"tag": leg["tag"], "kind": "413",
+        assert leg == {"tag": leg["tag"], "kind": leg["kind"],
                        "skipped": True, "ok": True}

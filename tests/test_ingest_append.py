@@ -1,0 +1,167 @@
+"""Append-incremental ingest (ISSUE 14 satellite): the in-memory
+``toas/cache.py::append_ingested`` path the streaming ObserveSession
+rides, plus the file-path tail-ingest it mirrors.
+
+Covers:
+
+- append_ingested merges an already-ingested base with a raw tail by
+  ingesting ONLY the tail — columns match a from-scratch full ingest;
+- tails smaller than the parallel-ingest chunk (the ingest chain is a
+  pure per-TOA map — chunking cannot change values);
+- successive appends accumulate correctly and land on the
+  ``ingest.cache.incremental`` / ``rows_reused`` counters;
+- a base that was never ingested is refused loudly;
+- the file path: a grown tim file re-ingests only the tail, and an
+  OPTIONS/MODEL change invalidates the stitched prefix (full
+  re-ingest, counted as a miss).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.simulation import make_test_pulsar
+from pint_tpu.toas.cache import append_ingested, get_TOAs
+from pint_tpu.toas.ingest import ingest_for_model
+
+PAR = """
+PSR              J1744-1134
+F0               245.4261196898081   1
+F1               -5.38156E-16        1
+PEPOCH           55000
+DM               3.1380              1
+"""
+
+
+@pytest.fixture(scope="module")
+def pulsar():
+    m, t = make_test_pulsar(PAR, ntoa=60, seed=7, iterations=1)
+    return m, t
+
+
+def _strip_ingest(toas):
+    """A raw (pre-ingest) copy: same rows, no derived columns."""
+    from pint_tpu.toas.toas import TOAs
+
+    raw = TOAs(
+        toas.t, np.array(toas.freq), np.array(toas.error_us),
+        list(toas.obs), [dict(f) for f in toas.flags],
+    )
+    raw.ephem = toas.ephem
+    return raw
+
+
+def test_append_ingested_matches_full_ingest(pulsar):
+    m, t = pulsar
+    base, tail = t[:45], _strip_ingest(t[45:])
+    assert tail.t_tdb is None
+    merged = append_ingested(base, tail, m)
+    assert len(merged) == 60
+    np.testing.assert_array_equal(merged.t_tdb.mjd_int, t.t_tdb.mjd_int)
+    np.testing.assert_array_equal(merged.t_tdb.sec.hi, t.t_tdb.sec.hi)
+    np.testing.assert_array_equal(merged.t_tdb.sec.lo, t.t_tdb.sec.lo)
+    np.testing.assert_array_equal(merged.ssb_obs_pos, t.ssb_obs_pos)
+
+
+def test_append_ingested_counts_reuse(pulsar):
+    m, t = pulsar
+    inc0 = obs_metrics.counter("ingest.cache.incremental").value
+    rows0 = obs_metrics.counter("ingest.cache.rows_reused").value
+    merged = append_ingested(t[:50], _strip_ingest(t[50:]), m)
+    assert len(merged) == 60
+    assert obs_metrics.counter(
+        "ingest.cache.incremental"
+    ).value == inc0 + 1
+    assert obs_metrics.counter(
+        "ingest.cache.rows_reused"
+    ).value == rows0 + 50
+
+
+def test_append_ingested_tail_below_chunk(pulsar, monkeypatch):
+    """A 3-TOA tail under chunked parallel ingest must be bit-equal
+    to the serial path (the chunking contract)."""
+    m, t = pulsar
+    tail = _strip_ingest(t[57:])
+    monkeypatch.setenv("PINT_TPU_INGEST_WORKERS", "4")
+    merged = append_ingested(t[:57], tail, m)
+    np.testing.assert_array_equal(
+        merged.t_tdb.sec.hi, t.t_tdb.sec.hi
+    )
+    np.testing.assert_array_equal(
+        merged.t_tdb.sec.lo, t.t_tdb.sec.lo
+    )
+
+
+def test_append_ingested_successive(pulsar):
+    m, t = pulsar
+    cur = t[:40]
+    for lo, hi in ((40, 47), (47, 53), (53, 60)):
+        cur = append_ingested(cur, _strip_ingest(t[lo:hi]), m)
+    assert len(cur) == 60
+    np.testing.assert_array_equal(cur.t_tdb.sec.hi, t.t_tdb.sec.hi)
+
+
+def test_append_ingested_pre_ingested_tail_skips_reingest(pulsar):
+    m, t = pulsar
+    tail = t[55:]
+    assert tail.t_tdb is not None
+    merged = append_ingested(t[:55], tail, m)
+    assert len(merged) == 60
+
+
+def test_append_ingested_refuses_raw_base(pulsar):
+    m, t = pulsar
+    with pytest.raises(ValueError, match="already-ingested"):
+        append_ingested(_strip_ingest(t[:40]), t[40:], m)
+
+
+# -- the file path (grown tim file) ---------------------------------------
+def test_tim_growth_reingests_only_tail(pulsar, tmp_path, monkeypatch):
+    from pint_tpu.io.tim import write_tim_file
+
+    m, t = pulsar
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    tim = tmp_path / "grow.tim"
+    write_tim_file(str(tim), t[:40])
+    t1 = get_TOAs(str(tim), model=m, usepickle=True)
+    assert len(t1) == 40
+    inc0 = obs_metrics.counter("ingest.cache.incremental").value
+    # grow the file: the old rows stay a byte-exact prefix
+    write_tim_file(str(tim), t)
+    t2 = get_TOAs(str(tim), model=m, usepickle=True)
+    assert len(t2) == 60
+    assert obs_metrics.counter(
+        "ingest.cache.incremental"
+    ).value == inc0 + 1
+    # stitched columns must be bitwise the from-scratch full ingest
+    # of the SAME tim file (the written file rounds arrival times, so
+    # the in-memory TOAs are not the reference here)
+    ref = get_TOAs(str(tim), model=m, usepickle=False)
+    np.testing.assert_array_equal(t2.t_tdb.sec.hi, ref.t_tdb.sec.hi)
+    np.testing.assert_array_equal(t2.t_tdb.sec.lo, ref.t_tdb.sec.lo)
+    np.testing.assert_array_equal(t2.ssb_obs_pos, ref.ssb_obs_pos)
+
+
+def test_model_change_invalidates_stitched_prefix(
+    pulsar, tmp_path, monkeypatch
+):
+    """The options key bakes the model par text: a changed model must
+    MISS (full re-ingest), never stitch against stale columns."""
+    from pint_tpu.io.tim import write_tim_file
+    from pint_tpu.models.builder import get_model
+
+    m, t = pulsar
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    tim = tmp_path / "inval.tim"
+    write_tim_file(str(tim), t[:40])
+    get_TOAs(str(tim), model=m, usepickle=True)
+    write_tim_file(str(tim), t)
+    m2 = get_model(PAR.replace("3.1380", "9.9990"))
+    miss0 = obs_metrics.counter("ingest.cache.misses").value
+    inc0 = obs_metrics.counter("ingest.cache.incremental").value
+    t2 = get_TOAs(str(tim), model=m2, usepickle=True)
+    assert len(t2) == 60
+    assert obs_metrics.counter("ingest.cache.misses").value == miss0 + 1
+    assert obs_metrics.counter(
+        "ingest.cache.incremental"
+    ).value == inc0

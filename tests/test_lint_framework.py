@@ -653,6 +653,60 @@ def test_obs8_flags_stripped_operability_guards(tmp_path):
     assert obs8.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs9: the ISSUE 14 streaming-session chokepoints ---------------------
+def test_obs9_flags_stripped_stream_guards(tmp_path):
+    """obs9 catches the streaming append entry, state rebuild, or
+    O(append) kernel losing its instrumentation/policy routing;
+    skips packages without the stream module; passes the real
+    tree."""
+    obs9 = rules_by_name()["obs9"]
+    # no serve/stream.py -> subsystem absent, fixture packages skip
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "serve").mkdir(parents=True)
+    (bare / "serve" / "session.py").write_text(
+        "def build_append_kernel(session, site):\n    return None\n"
+    )
+    assert obs9.check_project(bare) == []
+    # stripped guards are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    for sub in ("serve", "fitting", "ops"):
+        (pkg / sub).mkdir(parents=True)
+    (pkg / "serve" / "stream.py").write_text(
+        "class ObserveSession:\n"
+        "    def append(self, tail):\n"
+        "        pass\n"
+        "    def _rebuild_state(self):\n"
+        "        pass\n"
+        "    def _on_refit(self, fut):\n"
+        "        pass\n"
+    )
+    (pkg / "serve" / "session.py").write_text(
+        "def _append_run(session):\n"
+        "    return None\n"
+        "def build_append_kernel(session, site):\n"
+        "    return None\n"
+    )
+    (pkg / "fitting" / "gls.py").write_text(
+        "def stream_state_solve(state, noffset_):\n"
+        "    return state\n"
+    )
+    (pkg / "ops" / "solve_policy.py").write_text(
+        "def stream_drift_rtol():\n"
+        "    return 1e-5\n"
+    )
+    msgs = "\n".join(f.message for f in obs9.check_project(pkg))
+    assert "serve.stream.appends" in msgs      # append entry uncounted
+    assert "validate_finite" in msgs           # rebuild unvalidated
+    assert "serve.stream.cold_fallback" in msgs  # ladder uncounted
+    assert "guarded-by(" in msgs               # lock discipline gone
+    assert "stream_drift_rtol" in msgs         # ad-hoc tolerance
+    assert "traced_jit(" in msgs               # kernel off-chokepoint
+    assert "factor_solve_ir" in msgs           # drift check stripped
+    assert "PINT_TPU_STREAM_DRIFT_RTOL" in msgs  # policy knob gone
+    # the real tree carries every guard
+    assert obs9.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

@@ -1,0 +1,329 @@
+"""ObserveSession end-to-end suite (ISSUE 14): the O(append)
+streaming serving surface on the virtual 8-device CPU mesh.
+
+Acceptance surface:
+
+- PARITY: an incrementally-advanced stream matches the engine's cold
+  full fit on the concatenated TOAs — white and pure-Fourier
+  red-noise compositions (the span-preserving construction: the
+  Fourier basis is anchored on the stream's span, so parity vs a
+  cold fit requires the appends not to move it; span-extending
+  appends re-anchor at the refresh);
+- the warm rung for ineligible compositions (ECORR) — exact parity,
+  zero incremental state;
+- ZERO XLA retraces at steady state (the ``compile.traces`` counter
+  is flat once the tail bucket's append kernel is warm);
+- FitRequest.x0 warm starts ride the already-warmed fit kernel —
+  zero retraces, same answer;
+- the refresh cadence (``PINT_TPU_STREAM_REFRESH`` / the refresh
+  kwarg) and the drift guard's fallback chain: corrupted solver
+  state and injected dispatch faults both land on the warm rung with
+  the SAME caller future resolving typed;
+- residual alerts on a glitched tail;
+- typed shedding: the ``PINT_TPU_SERVE_STREAMS`` cap and
+  closed-stream appends.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.exceptions import RequestRejected
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.runtime import faults
+from pint_tpu.serve import FitRequest, TimingEngine
+from pint_tpu.simulation import make_test_pulsar
+from pint_tpu.toas.toas import merge_TOAs
+
+PAR = """
+PSR              J0613-0200
+F0               326.6005670880  1
+F1               -1.02e-15       1
+PEPOCH           55000
+DM               38.779          1
+"""
+RED = PAR + "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 10\n"
+ECORR = PAR + "ECORR -f L-wide 0.5\n"
+
+
+def _pulsar(partxt, n=300, seed=42):
+    m, t = make_test_pulsar(partxt, ntoa=n, seed=seed, iterations=1)
+    return m.as_parfile(), t
+
+
+@pytest.fixture(scope="module")
+def white():
+    return _pulsar(PAR)
+
+
+@pytest.fixture(scope="module")
+def red():
+    return _pulsar(RED)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TimingEngine(max_batch=4, max_wait_ms=2.0, inflight=2)
+    yield eng
+    eng.close(timeout=60)
+
+
+def _parity(eng, s, parts, tol_delta=1e-6, tol_unc=1e-6,
+            tol_chi2=1e-9):
+    """Compare a stream's committed solution to the engine's cold fit
+    on the concatenated TOAs."""
+    full = parts[0]
+    for p in parts[1:]:
+        full = merge_TOAs([full, p])
+    cold = eng.submit(
+        FitRequest(par=s._rec.par, toas=full, maxiter=4)
+    ).result(timeout=300)
+    unc = np.asarray(cold.uncertainties)
+    assert s.names == tuple(cold.names)
+    # per-parameter tolerance in units of the fitted uncertainty
+    diff = np.abs(s.deltas - np.asarray(cold.deltas))
+    assert np.all(diff <= tol_delta * unc), (diff / unc, tol_delta)
+    np.testing.assert_allclose(
+        s.uncertainties, unc, rtol=tol_unc
+    )
+    assert s.chi2 == pytest.approx(cold.chi2, rel=tol_chi2)
+
+
+# -- parity ---------------------------------------------------------------
+def test_white_incremental_parity(engine, white):
+    par, t = white
+    base, t1, t2 = t[:260], t[260:280], t[280:300]
+    s = engine.open_stream(par, base)
+    try:
+        r1 = s.append(t1).result(timeout=300)
+        r2 = s.append(t2).result(timeout=300)
+        assert (r1.refit, r2.refit) == ("incremental", "incremental")
+        assert r1.state is None  # engine-internal, never caller-facing
+        assert r2.ntoa == s.ntoa == 300
+        assert r2.appended == 20
+        _parity(engine, s, [base, t1, t2],
+                tol_delta=1e-6, tol_unc=1e-9, tol_chi2=1e-9)
+        # the response's provenance names the TAIL bucket
+        assert r1.bucket >= 20
+        assert s.fitted_par().startswith("PSR")
+    finally:
+        s.close()
+
+
+def test_fourier_incremental_parity_span_preserving(engine, red):
+    """PLRedNoise fast path.  The appends are INTERIOR TOAs: the
+    stream's frozen Fourier anchor (freqs = k/tspan, day0) then
+    equals the cold fit's own basis and parity is tight.  (A
+    span-extending append keeps the frozen anchor by design — the
+    basis re-derives only at the refresh rung.)"""
+    par, t = red
+    idx = np.arange(300)
+    interior = idx[40:80]
+    keep = np.array(sorted(set(idx.tolist()) - set(interior.tolist())))
+    base, t1, t2 = t[keep], t[interior[:20]], t[interior[20:]]
+    s = engine.open_stream(par, base)
+    try:
+        r1 = s.append(t1).result(timeout=300)
+        r2 = s.append(t2).result(timeout=300)
+        assert (r1.refit, r2.refit) == ("incremental", "incremental")
+        _parity(engine, s, [base, t1, t2],
+                tol_delta=1e-4, tol_unc=1e-4, tol_chi2=1e-6)
+    finally:
+        s.close()
+
+
+def test_ecorr_serves_appends_on_warm_rung(engine):
+    """Quantized bases (ECORR epochs) have no incremental path: every
+    append is a warm full refit — exact parity by construction."""
+    par, t = _pulsar(ECORR, n=200, seed=5)
+    base, t1 = t[:180], t[180:]
+    s = engine.open_stream(par, base)
+    try:
+        assert s._state is None  # stream_fast_path == None
+        r1 = s.append(t1).result(timeout=300)
+        assert r1.refit == "warm"
+        _parity(engine, s, [base, t1],
+                tol_delta=1e-7, tol_unc=1e-9, tol_chi2=1e-12)
+    finally:
+        s.close()
+
+
+# -- zero retraces at steady state ---------------------------------------
+def test_zero_retraces_at_steady_state(engine, white):
+    par, t = white
+    base = t[:200]
+    s = engine.open_stream(par, base)
+    try:
+        # first append warms the tail-bucket append kernel
+        s.append(t[200:220]).result(timeout=300)
+        traces0 = obs_metrics.counter("compile.traces").value
+        for lo in (220, 240, 260, 280):
+            r = s.append(t[lo:lo + 20]).result(timeout=300)
+            assert r.refit == "incremental"
+        assert obs_metrics.counter(
+            "compile.traces"
+        ).value == traces0, "steady-state appends must not retrace"
+    finally:
+        s.close()
+
+
+def test_fit_x0_warm_start_zero_retraces(engine, white):
+    par, t = white
+    toas = t[:250]
+    cold = engine.submit(
+        FitRequest(par=par, toas=toas, maxiter=4)
+    ).result(timeout=300)
+    traces0 = obs_metrics.counter("compile.traces").value
+    warm = engine.submit(FitRequest(
+        par=par, toas=toas, maxiter=4,
+        x0=np.asarray(cold.deltas),
+    )).result(timeout=300)
+    assert obs_metrics.counter("compile.traces").value == traces0
+    assert warm.converged
+    unc = np.asarray(cold.uncertainties)
+    diff = np.abs(np.asarray(warm.deltas) - np.asarray(cold.deltas))
+    assert np.all(diff <= 1e-6 * unc), diff / unc
+
+
+# -- refresh cadence ------------------------------------------------------
+def test_refresh_cadence(engine, white):
+    par, t = white
+    s = engine.open_stream(par, t[:220], refresh=2)
+    try:
+        refreshes0 = obs_metrics.counter("serve.stream.refresh").value
+        r1 = s.append(t[220:240]).result(timeout=300)
+        r2 = s.append(t[240:260]).result(timeout=300)
+        r3 = s.append(t[260:280]).result(timeout=300)
+        r4 = s.append(t[280:300]).result(timeout=300)
+        assert [r.refit for r in (r1, r2, r3, r4)] == [
+            "incremental", "incremental", "warm", "incremental",
+        ]
+        # the warm rung re-anchored the solver state
+        assert obs_metrics.counter(
+            "serve.stream.refresh"
+        ).value >= refreshes0 + 1
+        assert s._state is not None
+    finally:
+        s.close()
+
+
+# -- the fallback chain ---------------------------------------------------
+def test_drift_guard_state_corruption_falls_back_warm(engine, white):
+    """A corrupted solver state (non-SPD normal equations) NaN-poisons
+    the in-kernel solve; the per-row drift refusal fails over to the
+    warm rung on the SAME caller future, and the refit re-anchors."""
+    par, t = white
+    s = engine.open_stream(par, t[:260])
+    try:
+        assert s._state is not None
+        fb0 = obs_metrics.counter("serve.stream.drift_fallback").value
+        s._state["G"] = -np.asarray(s._state["G"])  # non-SPD
+        r = s.append(t[260:280]).result(timeout=300)
+        assert r.refit == "warm"
+        assert obs_metrics.counter(
+            "serve.stream.drift_fallback"
+        ).value == fb0 + 1
+        # the refit rebuilt a CLEAN state: the next append is
+        # incremental again and parity holds
+        r2 = s.append(t[280:300]).result(timeout=300)
+        assert r2.refit == "incremental"
+        _parity(engine, s, [t[:260], t[260:280], t[280:300]],
+                tol_delta=1e-6, tol_unc=1e-9, tol_chi2=1e-9)
+    finally:
+        s.close()
+
+
+def test_fourier_factor_drift_check_falls_back_warm(engine, red):
+    """The maintained-factor drift check (factor_solve_ir residual
+    compare against the TRUE Sigma): a stale/corrupted factor fails
+    the check, poisons to NaN, and the append lands warm."""
+    par, t = red
+    s = engine.open_stream(par, t[:260])
+    try:
+        assert s._state is not None
+        assert s._state["sig_L"].shape[0] > 0
+        fb0 = obs_metrics.counter("serve.stream.drift_fallback").value
+        s._state["sig_L"] = np.asarray(s._state["sig_L"]) * 37.0
+        r = s.append(t[260:280]).result(timeout=300)
+        assert r.refit == "warm"
+        assert obs_metrics.counter(
+            "serve.stream.drift_fallback"
+        ).value == fb0 + 1
+    finally:
+        s.close()
+
+
+def test_injected_dispatch_fault_falls_back_warm(white):
+    """PINT_TPU_FAULTS at the append dispatch site: the replica-level
+    failure resolves the caller future through the warm rung — typed,
+    never a hang (the chaos harness runs the full
+    quarantine/readmit cycle)."""
+    from pint_tpu.runtime import guard
+
+    par, t = white
+    eng = TimingEngine(max_batch=4, max_wait_ms=2.0, inflight=2)
+    try:
+        s = eng.open_stream(par, t[:260])
+        try:
+            with guard.configured(max_retries=0):
+                with faults.inject("nan:inf@serve:append"):
+                    r = s.append(t[260:280]).result(timeout=300)
+            assert r.refit == "warm"
+        finally:
+            s.close()
+    finally:
+        eng.close(timeout=60)
+
+
+# -- residual alerts ------------------------------------------------------
+def test_glitch_tail_raises_alert(engine, white):
+    par, t = white
+    s = engine.open_stream(par, t[:280])
+    try:
+        alerts0 = obs_metrics.counter("serve.stream.alerts").value
+        tail = t[280:300]
+        # a 200 us glitch against ~1 us white errors: the chi2
+        # increment's chi2_k tail probability collapses to ~0
+        tail.t_tdb.sec.hi = tail.t_tdb.sec.hi + 2e-4
+        r = s.append(tail).result(timeout=300)
+        assert r.alerts, "glitched tail must raise a residual alert"
+        assert "chi2-jump" in r.alerts[0]
+        assert obs_metrics.counter(
+            "serve.stream.alerts"
+        ).value == alerts0 + 1
+    finally:
+        s.close()
+
+
+# -- typed shedding -------------------------------------------------------
+def test_stream_cap_sheds_typed(white, monkeypatch):
+    par, t = white
+    monkeypatch.setenv("PINT_TPU_SERVE_STREAMS", "1")
+    eng = TimingEngine(max_batch=4, max_wait_ms=2.0, inflight=2)
+    try:
+        s = eng.open_stream(par, t[:200])
+        with pytest.raises(RequestRejected, match="streams"):
+            eng.open_stream(par, t[:200])
+        s.close()
+        # closing released the slot
+        s2 = eng.open_stream(par, t[:200])
+        s2.close()
+    finally:
+        eng.close(timeout=60)
+
+
+def test_closed_stream_append_sheds_typed(engine, white):
+    par, t = white
+    s = engine.open_stream(par, t[:200])
+    s.close()
+    with pytest.raises(RequestRejected, match="stream-closed"):
+        s.append(t[200:220])
+
+
+def test_stats_stream_block(engine):
+    st = engine.stats()["stream"]
+    for key in ("open", "appends", "incremental", "warm_refits",
+                "cold_refits", "refreshes", "alerts"):
+        assert key in st
+    assert st["appends"] >= 1
+    assert st["incremental"] >= 1
+    assert st["warm_refits"] >= 1

@@ -1,0 +1,331 @@
+"""Math-layer suite for the O(append) streaming solver (ISSUE 14):
+ops/cholupdate.py rank-k factor updates and the fitting/gls.py
+stream_state_* Gram-block state.
+
+Covers (CPU, exact f64 unless PINT_TPU_SOLVE_IR=force):
+
+- chol_update parity vs a fresh factorization, incl. the k == 0 /
+  j == 0 / zero-column (neutral pad) degeneracies and the
+  non-positive-pivot NaN poison convention;
+- factor_solve_ir refinement against a deliberately-stale factor,
+  and its poison-to-NaN residual check;
+- stream_state_init + stream_state_solve parity vs
+  gls_step_woodbury on identical inputs (dx, cov, chi2);
+- append parity: init(base) + append(tail) == init(base + tail),
+  with pad rows (exactly zero Ninv) perfectly neutral;
+- the OFFSET-profiling convention of the linearized advance: the
+  profiled offset components of the step never fold into the stored
+  residual column (the iterated fitter discards them too —
+  gauss_newton_step returns ``x + dx[no:]``), so appended rows
+  evaluated at the model's own phase convention stay consistent
+  with absorbed rows;
+- the drift guard: a corrupted maintained factor poisons dx/chi2 to
+  NaN and the returned state is the UNCHANGED input state.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting import gls
+from pint_tpu.ops import solve_policy
+from pint_tpu.ops.cholupdate import (
+    chol_factor_solve,
+    chol_update,
+    factor_solve_ir,
+)
+
+
+def _spd(k, seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((k, k))
+    return scale * (A @ A.T + k * np.eye(k))
+
+
+def _problem(n=200, p=4, k=6, seed=0, pad=0):
+    """A synthetic GLS problem: (r, M, Ninv, T, phi).  ``pad``
+    trailing rows carry exactly zero Ninv (the streaming pad
+    convention)."""
+    rng = np.random.default_rng(seed)
+    M = rng.standard_normal((n, p)) * np.geomspace(1.0, 1e3, p)
+    r = rng.standard_normal(n) * 1e-2
+    Ninv = 1.0 / rng.uniform(0.5, 2.0, n)
+    T = rng.standard_normal((n, k)) if k else np.zeros((n, 0))
+    phi = rng.uniform(0.1, 10.0, k)
+    if pad:
+        Ninv[-pad:] = 0.0
+    return (jnp.asarray(r), jnp.asarray(M), jnp.asarray(Ninv),
+            jnp.asarray(T), jnp.asarray(phi))
+
+
+# -- chol_update ----------------------------------------------------------
+def test_chol_update_matches_fresh_factorization():
+    k, j = 8, 5
+    A = _spd(k, 1)
+    L = np.linalg.cholesky(A)
+    V = np.random.default_rng(2).standard_normal((k, j))
+    L2 = np.asarray(chol_update(jnp.asarray(L), jnp.asarray(V)))
+    ref = np.linalg.cholesky(A + V @ V.T)
+    assert np.allclose(L2, ref, rtol=0, atol=1e-12 * np.max(ref))
+
+
+def test_chol_update_degenerate_shapes_are_identity():
+    L0 = jnp.zeros((0, 0))
+    assert chol_update(L0, jnp.zeros((0, 3))).shape == (0, 0)
+    L = jnp.asarray(np.linalg.cholesky(_spd(4, 3)))
+    out = chol_update(L, jnp.zeros((4, 0)))
+    assert np.array_equal(np.asarray(out), np.asarray(L))
+
+
+def test_chol_update_zero_columns_exact_identity():
+    """Zero update columns — the exactly-neutral pad rows — must pass
+    through BITWISE (c == 1, s == 0 in the recurrence)."""
+    L = jnp.asarray(np.linalg.cholesky(_spd(6, 4)))
+    out = chol_update(L, jnp.zeros((6, 3)))
+    assert np.array_equal(np.asarray(out), np.asarray(L))
+
+
+def test_chol_update_nonpositive_pivot_poisons_nan():
+    """A downdate-like corruption (negative pivot) must NaN-poison,
+    never silently produce a wrong factor (the drift guard's
+    upstream trigger)."""
+    L = jnp.asarray(np.linalg.cholesky(np.eye(3) * 1e-6))
+    V = jnp.asarray(np.array([[10.0], [0.0], [0.0]]))
+    # L L^T + V V^T is fine; corrupt the factor to force sqrt(neg)
+    bad = L.at[0, 0].set(jnp.nan)
+    out = chol_update(bad, V)
+    assert np.isnan(np.asarray(out)).any()
+
+
+def test_chol_factor_solve_roundtrip():
+    A = _spd(5, 7)
+    L = jnp.asarray(np.linalg.cholesky(A))
+    B = jnp.asarray(np.random.default_rng(8).standard_normal((5, 2)))
+    X = np.asarray(chol_factor_solve(L, B))
+    assert np.allclose(A @ X, np.asarray(B), atol=1e-10)
+
+
+# -- factor_solve_ir ------------------------------------------------------
+def test_factor_solve_ir_refines_stale_factor():
+    """An f32-grade / slightly-stale factor still solves the TRUE f64
+    matrix after refinement (the accelerator streaming contract)."""
+    k = 12
+    A = _spd(k, 9)
+    L = np.linalg.cholesky(A).astype(np.float32).astype(np.float64)
+    B = np.random.default_rng(10).standard_normal((k, 3))
+    X = np.asarray(factor_solve_ir(
+        jnp.asarray(L), jnp.asarray(A), jnp.asarray(B), refine=2,
+    ))
+    assert np.allclose(A @ X, B, rtol=0, atol=1e-9 * np.abs(B).max())
+
+
+def test_factor_solve_ir_check_poisons_on_garbage_factor():
+    k = 6
+    A = _spd(k, 11)
+    B = np.random.default_rng(12).standard_normal((k, 2))
+    garbage = jnp.asarray(np.tril(np.full((k, k), 1e-12)))
+    X = np.asarray(factor_solve_ir(
+        garbage, jnp.asarray(A), jnp.asarray(B),
+        refine=0, check_rtol=1e-8,
+    ))
+    assert np.isnan(X).all()
+
+
+def test_factor_solve_ir_empty_factor_passthrough():
+    B = jnp.asarray(np.ones((0, 3)))
+    out = factor_solve_ir(jnp.zeros((0, 0)), jnp.zeros((0, 0)), B)
+    assert out.shape == (0, 3)
+
+
+# -- stream state vs the one-shot solver ---------------------------------
+@pytest.mark.parametrize("k", [0, 6])
+def test_stream_init_solve_matches_woodbury(k):
+    r, M, Ninv, T, phi = _problem(k=k, seed=20)
+    p = M.shape[1]
+    st = gls.stream_state_init(r, M, Ninv, T, phi, jnp.zeros(p))
+    st2, dx, (covn, norm), chi2 = gls.stream_state_solve(st, 0)
+    # the one-shot reference needs a basis column: white models go
+    # through noise_basis_or_empty's degenerate dummy (zero basis,
+    # 1e-30 weight)
+    Tref = T if k else jnp.zeros((M.shape[0], 1))
+    phiref = phi if k else jnp.full((1,), 1e-30)
+    ref_dx, (ref_covn, ref_norm), ref_chi2, _ = gls.gls_step_woodbury(
+        r, M, 1.0 / Ninv, Tref, phiref, normalized_cov=True,
+    )
+    assert np.allclose(np.asarray(dx), np.asarray(ref_dx),
+                       rtol=1e-10, atol=1e-14)
+    # normalizations differ (the streaming norm is weighted), so
+    # compare the UN-normalized covariance
+    cov = np.asarray(covn) / np.outer(np.asarray(norm),
+                                      np.asarray(norm))
+    ref_cov = np.asarray(ref_covn) / np.outer(np.asarray(ref_norm),
+                                              np.asarray(ref_norm))
+    assert np.allclose(cov, ref_cov, rtol=1e-9)
+    assert np.isclose(float(chi2), float(ref_chi2), rtol=1e-10)
+    # the advanced state solves to ~zero on the same data: the state
+    # is a linear LS problem and one solve IS its converged answer
+    _, dx2, _, _ = gls.stream_state_solve(st2, 0)
+    assert np.abs(np.asarray(dx2)).max() < 1e-6 * max(
+        np.abs(np.asarray(dx)).max(), 1e-30
+    )
+
+
+def test_stream_append_matches_full_init():
+    """init(base) + append(tail) must equal init(base + tail) — the
+    O(append) claim is exactness, not approximation."""
+    r, M, Ninv, T, phi = _problem(n=300, k=6, seed=21)
+    nb = 240
+    st_full = gls.stream_state_init(r, M, Ninv, T, phi, jnp.zeros(4))
+    st = gls.stream_state_init(
+        r[:nb], M[:nb], Ninv[:nb], T[:nb], phi, jnp.zeros(4)
+    )
+    # append in two chunks, reusing the FROZEN norm/sig_d of the base
+    for lo, hi in ((nb, 270), (270, 300)):
+        st = gls.stream_state_append(
+            st, r[lo:hi], M[lo:hi], Ninv[lo:hi], T[lo:hi]
+        )
+    # stt is the only norm-free raw block (G/twx carry the frozen
+    # base normalization); everything else is compared at solve level
+    ref = np.asarray(st_full["stt"])
+    got = np.asarray(st["stt"])
+    assert np.allclose(got, ref, rtol=0,
+                       atol=1e-9 * max(np.abs(ref).max(), 1.0))
+    _, dx_a, (cov_a, nrm_a), chi2_a = gls.stream_state_solve(st, 0)
+    _, dx_f, (cov_f, nrm_f), chi2_f = gls.stream_state_solve(
+        st_full, 0
+    )
+    # un-normalized comparisons (the two states froze different norms)
+    assert np.allclose(np.asarray(dx_a), np.asarray(dx_f),
+                       rtol=1e-8, atol=1e-14)
+    assert np.isclose(float(chi2_a), float(chi2_f), rtol=1e-9)
+    unc_a = np.sqrt(np.diagonal(np.asarray(cov_a))) / np.asarray(nrm_a)
+    unc_f = np.sqrt(np.diagonal(np.asarray(cov_f))) / np.asarray(nrm_f)
+    assert np.allclose(unc_a, unc_f, rtol=1e-8)
+
+
+def test_stream_append_pad_rows_exactly_neutral():
+    """Pad rows enter with Ninv == 0 and must be PERFECTLY neutral:
+    the state accumulates forever, so anything less compounds."""
+    r, M, Ninv, T, phi = _problem(n=260, k=6, seed=22)
+    st = gls.stream_state_init(
+        r[:200], M[:200], Ninv[:200], T[:200], phi, jnp.zeros(4)
+    )
+    live = gls.stream_state_append(
+        st, r[200:230], M[200:230], Ninv[200:230], T[200:230]
+    )
+    # same live rows + 30 garbage rows at zero weight
+    rng = np.random.default_rng(23)
+    rj = jnp.concatenate([r[200:230], jnp.asarray(
+        rng.standard_normal(30) * 1e6
+    )])
+    Mj = jnp.concatenate([M[200:230], jnp.asarray(
+        rng.standard_normal((30, 4)) * 1e6
+    )])
+    Tj = jnp.concatenate([T[200:230], jnp.asarray(
+        rng.standard_normal((30, 6)) * 1e6
+    )])
+    Nj = jnp.concatenate([Ninv[200:230], jnp.zeros(30)])
+    padded = gls.stream_state_append(st, rj, Mj, Nj, Tj)
+    # zero-weight rows contribute exact zeros; the only admissible
+    # difference is reduction-tree regrouping between the two matmul
+    # SHAPES (within serve the padded shape is fixed, so steady-state
+    # dispatches are bitwise reproducible)
+    for key in ("G", "twx", "stt", "sig_L"):
+        a, b = np.asarray(live[key]), np.asarray(padded[key])
+        scale = max(np.abs(a).max(), 1e-30)
+        assert np.allclose(a, b, rtol=0, atol=1e-14 * scale), key
+
+
+def test_stream_solve_offset_profiled_not_committed():
+    """noffset_ > 0: the offset components of the step are re-profiled
+    every solve, never folded into the stored residual column —
+    mirroring gauss_newton_step's ``x + dx[no:]``.  Regression: with
+    the offset folded in, appended rows (evaluated at the model's own
+    phase convention) disagree with absorbed rows by a constant and
+    chi2 inflates."""
+    rng = np.random.default_rng(24)
+    n, p = 300, 4
+    M = np.concatenate(
+        [np.ones((n, 1)), rng.standard_normal((n, p - 1))], axis=1
+    )
+    x_true = np.array([0.5, 1.0, -2.0, 0.3])
+    r0 = M @ x_true + rng.standard_normal(n) * 1e-3
+    Ninv = np.ones(n)
+    T = np.zeros((n, 0))
+    phi = np.zeros(0)
+    st = gls.stream_state_init(
+        jnp.asarray(r0[:200]), jnp.asarray(M[:200]),
+        jnp.asarray(Ninv[:200]), jnp.asarray(T[:200]),
+        jnp.asarray(phi), jnp.zeros(p - 1),
+    )
+    st, dx1, _, _ = gls.stream_state_solve(st, 1)
+    # the advance committed only the non-offset components
+    assert np.allclose(
+        np.asarray(st["x"]),
+        np.asarray(dx1)[1:] / 1.0,
+        rtol=0, atol=1e-12 * max(np.abs(np.asarray(dx1)).max(), 1.0),
+    )
+    # append rows that do NOT carry the profiled offset (they are
+    # evaluated from the model, which has no offset parameter) — the
+    # repo convention is r(x) = r(0) + M x (gauss_newton_step applies
+    # x + dx and the advance is r -> r + Mn dxn), evaluated at the
+    # stream's committed x
+    r_tail = r0[200:] + (M[200:, 1:] @ np.asarray(st["x"]))
+    st = gls.stream_state_append(
+        st, jnp.asarray(r_tail), jnp.asarray(M[200:]),
+        jnp.asarray(Ninv[200:]), jnp.asarray(T[200:]),
+    )
+    st2, dx2, _, chi2_stream = gls.stream_state_solve(st, 1)
+    # reference: the one-shot full-data step from x = 0
+    ref_dx, _, ref_chi2, _ = gls.gls_step_woodbury(
+        jnp.asarray(r0), jnp.asarray(M), jnp.asarray(1.0 / Ninv),
+        jnp.zeros((n, 1)), jnp.full((1,), 1e-30),
+    )
+    x_stream = np.asarray(st2["x"])
+    # total committed solution == the one-shot solution's free part
+    assert np.allclose(
+        x_stream, np.asarray(ref_dx)[1:], rtol=1e-6, atol=1e-9
+    )
+    assert np.isfinite(float(chi2_stream))
+
+
+def test_stream_solve_drift_check_poisons_and_rolls_back():
+    r, M, Ninv, T, phi = _problem(k=6, seed=25)
+    st = gls.stream_state_init(r, M, Ninv, T, phi, jnp.zeros(4))
+    bad = dict(st)
+    bad["sig_L"] = st["sig_L"] * 37.0  # corrupted maintained factor
+    out, dx, _, chi2 = gls.stream_state_solve(
+        bad, 0, check_rtol=1e-10
+    )
+    assert np.isnan(np.asarray(dx)).all()
+    assert np.isnan(float(chi2))
+    # the returned state is the UNCHANGED input — callers fall back
+    # to a warm refit from a clean anchor
+    for key, v in out.items():
+        assert np.array_equal(np.asarray(v), np.asarray(bad[key])), key
+
+
+def test_stream_solve_ir_forced_matches_exact(monkeypatch):
+    """PINT_TPU_SOLVE_IR=force: the f32-factor + refinement path on
+    CPU must agree with the exact-f64 path to the IR contract."""
+    r, M, Ninv, T, phi = _problem(k=6, seed=26)
+    st_exact = gls.stream_state_init(r, M, Ninv, T, phi, jnp.zeros(4))
+    _, dx_e, _, chi2_e = gls.stream_state_solve(st_exact, 0)
+    monkeypatch.setenv("PINT_TPU_SOLVE_IR", "force")
+    assert solve_policy.stream_factor_dtype() == jnp.float32
+    st = gls.stream_state_init(r, M, Ninv, T, phi, jnp.zeros(4))
+    assert st["sig_L"].dtype == jnp.float32
+    _, dx, _, chi2 = gls.stream_state_solve(
+        st, 0, check_rtol=solve_policy.stream_drift_rtol()
+    )
+    assert np.allclose(np.asarray(dx), np.asarray(dx_e),
+                       rtol=1e-8, atol=1e-12)
+    assert np.isclose(float(chi2), float(chi2_e), rtol=1e-8)
+
+
+def test_stream_drift_rtol_env(monkeypatch):
+    assert solve_policy.stream_drift_rtol() == pytest.approx(1e-5)
+    monkeypatch.setenv("PINT_TPU_STREAM_DRIFT_RTOL", "3e-7")
+    assert solve_policy.stream_drift_rtol() == pytest.approx(3e-7)

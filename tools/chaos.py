@@ -297,6 +297,98 @@ def run_leg(engine, tag: str, kind: str, *, small, big,
     return leg
 
 
+# -- the streaming leg ------------------------------------------------------
+def stream_leg(*, kinds=ALL_KINDS, hang_seconds: float = 1.5,
+               timeout: float = 120.0) -> dict:
+    """ISSUE 14: faults pinned at the O(append) dispatch sites of a
+    live ObserveSession.  For every fault kind, appends driven while
+    ``kind:inf@serve:append`` is armed must resolve TYPED — the
+    fallback ladder (incremental -> warm refit -> cold refit) rides
+    the UNFAULTED fit path, so a faulted append completes via refit
+    rather than failing; once the fault clears, the next append must
+    run incrementally again with zero fresh traces (the stream's
+    solver state survives the fault).  Deterministic by construction:
+    fixed seed, faults.inject specs only."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import faults, guard
+    from pint_tpu.serve import TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    k = 8
+    m, toas = make_test_pulsar(
+        "PSR CSTR\nF0 199.25 1\nF1 -1.3e-15 1\nPEPOCH 55000\n"
+        "DM 6.6 1\n",
+        ntoa=200 + k * (2 + 3 * len(kinds)), start_mjd=54000.0,
+        end_mjd=56000.0, seed=321, iterations=1,
+    )
+    par = m.as_parfile()
+    engine = TimingEngine(
+        max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
+        warm_ledger=False,
+    )
+    rounds = []
+    try:
+        stream = engine.open_stream(par, toas[:200], maxiter=2)
+        used = 200
+        for _ in range(2):  # warm the tail-bucket append kernel
+            stream.append(toas[used:used + k]).result(timeout=timeout)
+            used += k
+        for kind in kinds:
+            gkw = {"max_retries": 0}
+            if kind == "hang":
+                gkw.update(compile_timeout=20.0, dispatch_timeout=0.4)
+            inc0 = obs_metrics.counter(
+                "serve.stream.incremental"
+            ).value
+            with guard.configured(**gkw):
+                with faults.inject(
+                    f"{kind}:inf@serve:append",
+                    hang_seconds=hang_seconds,
+                ) as plan:
+                    futs = []
+                    for _ in range(2):
+                        futs.append(stream.append(
+                            toas[used:used + k]
+                        ))
+                        used += k
+                    faulted = classify(futs, timeout)
+                    fired = len(plan.fired)
+            # fault cleared: the next append must be incremental
+            # again (state intact) with zero fresh traces
+            t0 = obs_metrics.counter("compile.traces").value
+            after = classify(
+                [stream.append(toas[used:used + k])], timeout
+            )
+            used += k
+            clean_traces = (
+                obs_metrics.counter("compile.traces").value - t0
+            )
+            recovered = (
+                obs_metrics.counter("serve.stream.incremental").value
+                - inc0
+            )
+            rounds.append({
+                "kind": kind, "fired": fired, "faulted": faulted,
+                "after": after, "clean_traces": clean_traces,
+                "recovered_incremental": recovered >= 1,
+                "ok": bool(
+                    faulted["typed"] and after["typed"]
+                    and fired > 0
+                    and after["completed"] == after["offered"]
+                    and clean_traces == 0
+                    and recovered >= 1
+                ),
+            })
+        stream_stats = engine.stats()["stream"]
+    finally:
+        engine.close()
+    return {
+        "tag": "stream", "kind": "append-faults",
+        "rounds": rounds, "stream": stream_stats,
+        "ok": all(r["ok"] for r in rounds),
+    }
+
+
 # -- the kill-and-restart leg ----------------------------------------------
 def restart_leg(small, ledger_path: str, *, engine_kw: dict,
                 wave: int = 6, timeout: float = 600.0) -> dict:
@@ -379,11 +471,13 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
               replicas: int | None = None, gangs: int | None = None,
               gang_size: int | None = None,
               hang_seconds: float = 1.5, restart: bool = True,
+              stream: bool = True,
               ledger_dir: str | None = None,
               time_budget_s: float | None = None,
               timeout: float = 120.0) -> dict:
     """The full chaos matrix: one leg per (executor tag, fault kind)
-    over a mixed single/gang fabric, plus the kill-and-restart leg.
+    over a mixed single/gang fabric, plus the streaming append-fault
+    leg (ISSUE 14) and the kill-and-restart leg.
     Returns the report dict ``python -m tools.chaos`` prints.
 
     ``time_budget_s`` bounds the FAULT-leg portion (the profiling
@@ -423,6 +517,18 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
         report_text = flight_report()
     finally:
         engine.close()
+    if stream:
+        if (time_budget_s is not None
+                and time.monotonic() - t_start > time_budget_s):
+            legs.append({
+                "tag": "stream", "kind": "append-faults",
+                "skipped": True, "ok": True,
+            })
+        else:
+            legs.append(stream_leg(
+                kinds=kinds, hang_seconds=hang_seconds,
+                timeout=timeout,
+            ))
     if restart:
         lp = os.path.join(
             ledger_dir or tempfile.mkdtemp(prefix="pint-tpu-chaos-"),
@@ -458,12 +564,14 @@ def main(argv=None) -> int:
     ap.add_argument("--gangs", type=int, default=None)
     ap.add_argument("--gang-size", type=int, default=None)
     ap.add_argument("--no-restart", action="store_true")
+    ap.add_argument("--no-stream", action="store_true")
     ap.add_argument("--timeout", type=float, default=120.0)
     args = ap.parse_args(argv)
     report = run_sweep(
         kinds=tuple(k for k in args.kinds.split(",") if k),
         replicas=args.replicas, gangs=args.gangs,
         gang_size=args.gang_size, restart=not args.no_restart,
+        stream=not args.no_stream,
         timeout=args.timeout,
     )
     for leg in report["legs"]:

@@ -310,6 +310,52 @@ _OPERABILITY_CHECKS = (
 )
 
 
+_STREAM_CHECKS = (
+    ("serve/stream.py", "ObserveSession.append",
+     ("TRACER.span", "serve.stream.appends"),
+     "the streaming append entry must stay span-instrumented and "
+     "counted — it is the only door into the O(append) fast path and "
+     "its fallback chain (docs/serving.md 'streaming sessions')"),
+    ("serve/stream.py", "ObserveSession._rebuild_state",
+     ("TRACER.span", "validate_finite"),
+     "the state rebuild (open/refresh) is the only O(n) solver work "
+     "in a stream's life: it must stay span-instrumented and its "
+     "output finite-validated before becoming the incremental anchor"),
+    ("serve/stream.py", "ObserveSession._on_refit",
+     ("serve.stream.cold_fallback",),
+     "warm-refit failures must count the cold-fallback rung so the "
+     "fallback ladder stays observable per stream"),
+    ("serve/stream.py", "ObserveSession",
+     ("guarded-by(",),
+     "stream queue/lifecycle fields must declare their lock "
+     "discipline (# lint: guarded-by(...)) for the locks rule"),
+    ("serve/session.py", "_append_run",
+     ("stream_drift_rtol", "stream_state_solve"),
+     "the batched append kernel body must route its drift tolerance "
+     "through ops/solve_policy.py (PINT_TPU_STREAM_DRIFT_RTOL) and "
+     "the rank-update solve through fitting/gls.py stream_state_solve "
+     "— ad-hoc tolerances or solves dodge the drift guard"),
+    ("serve/session.py", "build_append_kernel",
+     ("traced_jit(",),
+     "the append kernel must build through the traced_jit chokepoint "
+     "so appends stay guarded, trace-counted and donation-managed "
+     "like every other serve dispatch"),
+)
+
+
+_STREAM_SOLVER_CHECKS = (
+    ("fitting/gls.py", "stream_state_solve",
+     ("factor_solve_ir", "check_rtol"),
+     "the rank-update solve must keep the refined factor solve with "
+     "its poison-to-NaN residual check — silent numerical decay of "
+     "the maintained Cholesky is the streaming failure mode"),
+    ("ops/solve_policy.py", "stream_drift_rtol",
+     ("PINT_TPU_STREAM_DRIFT_RTOL",),
+     "the drift tolerance must stay centrally policy-owned and "
+     "env-overridable (ops/solve_policy.py), not scattered literals"),
+)
+
+
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
     if not subdir.is_dir():
         return []
@@ -491,6 +537,35 @@ class Obs8Rule(Rule):
         return findings
 
 
+class Obs9Rule(Rule):
+    """Streaming-session chokepoints (ISSUE 14): append entry
+    spanned + counted, state rebuild validated, fallback ladder
+    counted, the O(append) kernel routed through traced_jit with its
+    drift check policy-owned."""
+
+    name = "obs9"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the stream module itself: fixture packages that
+        # predate the streaming subsystem skip (obs7/obs8 convention)
+        if not (pkg_root / "serve" / "stream.py").is_file():
+            return []
+        findings = _run_checks(
+            self.name, pkg_root, _STREAM_CHECKS,
+            pkg_root / "serve",
+        )
+        findings += _run_checks(
+            self.name, pkg_root, _STREAM_SOLVER_CHECKS[:1],
+            pkg_root / "fitting",
+        )
+        findings += _run_checks(
+            self.name, pkg_root, _STREAM_SOLVER_CHECKS[1:],
+            pkg_root / "ops",
+        )
+        return findings
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
@@ -499,7 +574,8 @@ OBS5 = Obs5Rule()
 OBS6 = Obs6Rule()
 OBS7 = Obs7Rule()
 OBS8 = Obs8Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8)
+OBS9 = Obs9Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
