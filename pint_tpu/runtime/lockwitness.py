@@ -1,0 +1,335 @@
+"""Runtime lock-witness sanitizer for the serving stack (ISSUE 15).
+
+The static ``lockorder`` / ``blocking`` rules (tools/lint) prove what
+the program *structure* shows; they cannot see dynamic composition —
+``Future`` done-callbacks running inline under the finisher lock,
+closures dispatched onto executor threads, the id-sorted multi-
+``trace_lock`` protocol in ``Replica._fused_kernel_for`` whose loop
+variable no static resolver follows.  This module is the dynamic half:
+a drop-in wrapper registry for the serve-stack locks that records
+per-thread acquisition stacks and detects, while real traffic (or the
+chaos harness) runs:
+
+- **order inversions**: the first time ``A`` is held while ``B`` is
+  acquired, the edge ``A -> B`` is recorded with its acquisition
+  stack; a later acquisition in the reverse order is a violation
+  carrying *both* witness stacks;
+- **same-identity nesting**: two instances under one name (the fused
+  cross-key dispatch taking several ``Session.trace_lock``s) must be
+  acquired in ascending ``id()`` order — the deterministic global
+  order that makes the protocol deadlock-free; a descending
+  acquisition is a violation;
+- **blocking-under-lock**: ``Condition.wait()`` with no timeout while
+  other witnessed locks are held.
+
+Cost model (the ``PINT_TPU_TRACE`` pattern — ~free when off):
+``wrap()`` returns the *raw* lock unless the witness is installed
+(``PINT_TPU_LOCK_WITNESS=1`` at import, or programmatic
+:func:`enable` / :func:`armed` before the locks are created), so
+production pays literally nothing; installed-but-disabled proxies pay
+one module-global flag check per acquire.  Violations land in
+:func:`violations`, the ``lockwitness.violations`` obs counter, and a
+``TRACER`` event.  ``tools/chaos.py`` arms the witness for every leg
+and asserts zero violations (docs/robustness.md).
+
+Semaphores and queues are deliberately NOT witnessed: their ownership
+is handed across threads (``Replica._sem`` acquires on the dispatcher
+and releases on the fencer), which a per-thread held-stack model would
+misread as a leak.  The static ``blocking`` rule covers their
+untimed-acquire hazards instead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from contextlib import contextmanager
+
+__all__ = [
+    "wrap", "enable", "disable", "armed", "enabled", "installed",
+    "violations", "violation_count", "reset",
+]
+
+_env_on = os.environ.get("PINT_TPU_LOCK_WITNESS", "") not in ("", "0")
+_installed = _env_on   # wrap() returns proxies iff True at creation
+_enabled = _env_on     # recording on/off (cheap flag on the hot path)
+
+_tls = threading.local()
+_graph_lock = threading.Lock()
+_edges: dict = {}        # (outer, inner) -> first-witness record
+_violations: list = []
+_reported: set = set()   # dedupe key per violation class/pair
+
+
+def installed() -> bool:
+    return _installed
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable():
+    """Install + enable.  Locks created after this point get proxies;
+    locks created before (while not installed) stay raw."""
+    global _installed, _enabled
+    _installed = True
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+@contextmanager
+def armed():
+    """Enable for the duration of the block (the chaos-harness hook:
+    engines built inside get witnessed locks)."""
+    global _enabled
+    prev = _enabled
+    enable()
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+def violations() -> list:
+    with _graph_lock:
+        return [dict(v) for v in _violations]
+
+
+def violation_count() -> int:
+    with _graph_lock:
+        return len(_violations)
+
+
+def reset():
+    """Clear the order graph and recorded violations (between chaos
+    legs / tests).  Per-thread held stacks are left alone — they
+    drain naturally as the owning threads release."""
+    with _graph_lock:
+        _edges.clear()
+        _violations.clear()
+        _reported.clear()
+
+
+# -- recording -------------------------------------------------------------
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _capture(limit: int = 10) -> list:
+    frames = traceback.extract_stack()
+    # drop the witness's own frames (tail) and cap depth
+    frames = [
+        f for f in frames[:-2]
+        if "lockwitness" not in (f.filename or "")
+    ][-limit:]
+    return [f"{f.filename}:{f.lineno} in {f.name}" for f in frames]
+
+
+def _emit(kind: str, name: str, detail: str, stacks: dict):
+    key = (kind, detail.split(" — ")[0])
+    with _graph_lock:
+        if key in _reported:
+            return
+        _reported.add(key)
+        _violations.append({
+            "kind": kind,
+            "lock": name,
+            "thread": threading.current_thread().name,
+            "detail": detail,
+            "stacks": stacks,
+        })
+    try:  # the obs layer is optional at this depth — never raise
+        from pint_tpu.obs import metrics as obs_metrics
+        from pint_tpu.obs.trace import TRACER
+
+        obs_metrics.counter("lockwitness.violations").inc()
+        TRACER.event("lockwitness", "runtime", kind=kind, lock=name)
+    except Exception:
+        pass
+
+
+def _check_order(name: str, obj) -> None:
+    """Edge/violation bookkeeping at an acquisition ATTEMPT (before
+    blocking on the real lock, so a would-be deadlock still gets
+    recorded)."""
+    held = _held()
+    if not held:
+        return
+    me = threading.current_thread().name
+    for hname, hid, hstack in held:
+        if hname == name:
+            if hid == id(obj):
+                continue  # re-entrant same instance (RLock/Condition)
+            if id(obj) < hid:
+                _emit(
+                    "same-identity-order", name,
+                    f"same-identity-order {name} — nested acquisition "
+                    "of a second instance with DESCENDING id(); the "
+                    "deadlock-free protocol is ascending-id order "
+                    "(Replica._fused_kernel_for)",
+                    {"outer": hstack, "inner": _capture()},
+                )
+            continue
+        edge = (hname, name)
+        rev = (name, hname)
+        with _graph_lock:
+            prior = _edges.get(rev)
+            if edge not in _edges:
+                _edges[edge] = {
+                    "thread": me, "stack": _capture(),
+                    "under": hstack,
+                }
+        if prior is not None:
+            _emit(
+                "inversion", name,
+                f"inversion {hname}<->{name} — this thread holds "
+                f"{hname} and acquires {name}; thread "
+                f"{prior['thread']} previously held {name} while "
+                f"acquiring {hname} (both witness stacks attached)",
+                {
+                    "this": _capture(),
+                    "this_under": hstack,
+                    "prior": prior["stack"],
+                    "prior_under": prior["under"],
+                },
+            )
+
+
+def _push(name: str, obj) -> None:
+    _held().append((name, id(obj), _capture()))
+
+
+def _pop(name: str, obj) -> None:
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name and held[i][1] == id(obj):
+            del held[i]
+            return
+
+
+# -- proxies ---------------------------------------------------------------
+class WitnessLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper.  Disabled cost:
+    one module-global flag check per acquire/release."""
+
+    __slots__ = ("_lock", "_name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self._name = name
+
+    def acquire(self, *a, **k):
+        if _enabled:
+            _check_order(self._name, self._lock)
+        got = self._lock.acquire(*a, **k)
+        if got and _enabled:
+            _push(self._name, self._lock)
+        return got
+
+    def release(self):
+        _pop(self._name, self._lock)
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WitnessLock {self._name} of {self._lock!r}>"
+
+
+class WitnessCondition(WitnessLock):
+    """``threading.Condition`` wrapper: same ordering model on the
+    underlying (re-entrant) lock, plus the dynamic blocking check —
+    an untimed ``wait()`` while OTHER witnessed locks are held is the
+    drain-never-hangs hazard at runtime."""
+
+    __slots__ = ()
+
+    def wait(self, timeout=None):
+        if _enabled:
+            if timeout is None:
+                others = [
+                    e for e in _held() if e[1] != id(self._lock)
+                ]
+                if others:
+                    _emit(
+                        "blocking-under-lock", self._name,
+                        f"blocking-under-lock {self._name}.wait() — "
+                        "untimed Condition.wait while holding "
+                        + ", ".join(
+                            dict.fromkeys(e[0] for e in others)
+                        ),
+                        {
+                            "wait": _capture(),
+                            "held": [e[2] for e in others],
+                        },
+                    )
+            # wait releases the condition for its duration
+            _pop(self._name, self._lock)
+            try:
+                return self._lock.wait(timeout)
+            finally:
+                _push(self._name, self._lock)
+        return self._lock.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        # composed of timed waits internally; check only the untimed
+        # form, mirroring wait()
+        if _enabled and timeout is None:
+            others = [e for e in _held() if e[1] != id(self._lock)]
+            if others:
+                _emit(
+                    "blocking-under-lock", self._name,
+                    f"blocking-under-lock {self._name}.wait_for() — "
+                    "untimed Condition.wait_for while holding "
+                    + ", ".join(dict.fromkeys(e[0] for e in others)),
+                    {
+                        "wait": _capture(),
+                        "held": [e[2] for e in others],
+                    },
+                )
+        return self._lock.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        return self._lock.notify(n)
+
+    def notify_all(self):
+        return self._lock.notify_all()
+
+    def locked(self):  # Condition has no locked(); mirror its lock
+        return self._lock._lock.locked()
+
+
+def wrap(obj, name: str):
+    """Register a serve-stack lock with the witness.  Returns the raw
+    object when the witness is not installed (zero production cost);
+    a proxy when it is.  Semaphores/queues pass through untouched
+    (cross-thread handoff semantics — module docstring)."""
+    if not _installed:
+        return obj
+    if isinstance(obj, threading.Condition):
+        return WitnessCondition(obj, name)
+    if isinstance(obj, (
+        threading.Semaphore, threading.BoundedSemaphore
+    )):
+        return obj
+    return WitnessLock(obj, name)

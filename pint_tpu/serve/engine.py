@@ -88,6 +88,7 @@ import numpy as np
 from pint_tpu.exceptions import PintTpuError, RequestRejected
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import lockwitness
 from pint_tpu.runtime.guard import validate_finite
 from pint_tpu.serve import batcher as bmod
 from pint_tpu.serve import session as smod
@@ -145,7 +146,9 @@ class TimingEngine:
         # ObserveSessions; past the cap open_stream sheds typed
         self.max_streams = int(env("PINT_TPU_SERVE_STREAMS", "64"))
         self._streams: set = set()  # lint: guarded-by(_streams_lock)
-        self._streams_lock = threading.Lock()
+        self._streams_lock = lockwitness.wrap(
+            threading.Lock(), "TimingEngine._streams_lock"
+        )
         # streaming continuation executor (lazy): commit/fallback work
         # runs OFF the replica fence threads so a fallback refit can
         # never stall _finish_batch's serialized finisher
@@ -166,20 +169,28 @@ class TimingEngine:
         self.slo_margin_s = None if slo_ms <= 0 else slo_ms / 1e3
         self.sessions = smod.SessionCache(max_sessions)
         self._queue: collections.deque = collections.deque()  # lint: guarded-by(_cond)
-        self._cond = threading.Condition()
+        self._cond = lockwitness.wrap(
+            threading.Condition(), "TimingEngine._cond"
+        )
         self._batcher = bmod.Batcher(
             self.max_batch, self.max_wait_s,
             slo_margin_s=self.slo_margin_s,
         )
-        self._quota_lock = threading.Lock()
+        self._quota_lock = lockwitness.wrap(
+            threading.Lock(), "TimingEngine._quota_lock"
+        )
         self._quota_inflight: dict = {}  # cid -> admitted unresolved; lint: guarded-by(_quota_lock)
         self._stop = False  # lint: guarded-by(_cond)
         self._latencies = collections.deque(maxlen=4096)  # lint: guarded-by(_lat_lock)
-        self._lat_lock = threading.Lock()
+        self._lat_lock = lockwitness.wrap(
+            threading.Lock(), "TimingEngine._lat_lock"
+        )
         # host response assembly (model parse, par text) is serialized
         # across replica fence threads — it is light next to the device
         # work and not audited for concurrent use
-        self._finish_lock = threading.Lock()
+        self._finish_lock = lockwitness.wrap(
+            threading.Lock(), "TimingEngine._finish_lock"
+        )
         # the multi-device fabric: one executor per serving device —
         # or per device SUBSET for gang executors (ISSUE 10) — plus
         # the size-classifying affinity router (serve/fabric/)

@@ -37,6 +37,7 @@ import threading
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.parallel.mesh import serving_devices
+from pint_tpu.runtime import lockwitness
 from pint_tpu.serve.fabric.gang import GangReplica
 from pint_tpu.serve.fabric.replica import (
     DEGRADED,
@@ -97,7 +98,9 @@ class ReplicaPool:
             Replica(base + j, d, tag=f"r{j}", **kw)
             for j, d in enumerate(devices)
         )
-        self._cond = threading.Condition()
+        self._cond = lockwitness.wrap(
+            threading.Condition(), "ReplicaPool._cond"
+        )
         self._stop = False  # lint: guarded-by(_cond)
         self._prober = threading.Thread(
             target=self._probe_loop, daemon=True,

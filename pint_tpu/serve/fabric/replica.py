@@ -95,6 +95,7 @@ from pint_tpu.exceptions import (
 )
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import lockwitness
 from pint_tpu.runtime.guard import (
     dispatch_guard,
     fence_owned,
@@ -296,7 +297,9 @@ class Replica:
         self._requeue = requeue
         self._finisher = finisher
         self._validator = validator
-        self._cond = threading.Condition()
+        self._cond = lockwitness.wrap(
+            threading.Condition(), "Replica._cond"
+        )
         self._queue: collections.deque = collections.deque()  # lint: guarded-by(_cond)
         self._fence_q: queue.Queue = queue.Queue()
         self._sem = threading.BoundedSemaphore(self.inflight)
@@ -322,7 +325,9 @@ class Replica:
         # through _set_state under _state_lock (the locks rule checks
         # the declared discipline — tools/lint/rules/locks.py)
         self._state = LIVE  # lint: guarded-by(_state_lock)
-        self._state_lock = threading.Lock()
+        self._state_lock = lockwitness.wrap(
+            threading.Lock(), "Replica._state_lock"
+        )
         self._consecutive = 0  # lint: guarded-by(_state_lock)
         self.batches_done = 0  # fencer-thread only
         self.failures = 0  # lint: guarded-by(_state_lock)

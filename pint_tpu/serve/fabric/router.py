@@ -62,6 +62,7 @@ import threading
 
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import lockwitness
 from pint_tpu.serve.fabric.gang import gang_threshold
 from pint_tpu.serve.fabric.replica import DEGRADED, LIVE
 
@@ -103,7 +104,7 @@ class Router:
         )
         self._placements: dict = {}  # group key -> [rid, ...]; lint: guarded-by(_lock)
         self._rotor: dict = {}  # round-robin counters; lint: guarded-by(_lock)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.wrap(threading.Lock(), "Router._lock")
         self._m_routes = obs_metrics.counter("serve.fabric.routes")
         self._m_spills = obs_metrics.counter("serve.fabric.spills")
 

@@ -63,6 +63,7 @@ from pint_tpu.models.timing_model import (
     split_ref_runtime,
 )
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import lockwitness
 from pint_tpu.runtime.guard import dispatch_guard
 from pint_tpu.timebase.hostdd import HostDD
 from pint_tpu.utils import compute_hash
@@ -361,8 +362,12 @@ class Session:
         # serializes kernel TRACES across fabric replicas: the trace
         # runs _with_swapped, which mutates this shared prototype for
         # the trace's duration (warm dispatches never execute the
-        # Python body and stay lock-free) — serve/fabric/replica.py
-        self.trace_lock = threading.Lock()
+        # Python body and stay lock-free) — serve/fabric/replica.py.
+        # Reached as work.session.trace_lock from replicas/streams, so
+        # the concurrency rules key it by alias, not by class field
+        self.trace_lock = lockwitness.wrap(
+            threading.Lock(), "Session.trace_lock"
+        )  # lint: lock-alias(trace_lock)
 
     @classmethod
     def from_prototype(cls, record: ParRecord, cm, bucket: int,
@@ -390,7 +395,9 @@ class Session:
         s.cm = cm
         s.mode = default_accel_mode(cm)
         s.static_ref = record.static_ref
-        s.trace_lock = threading.Lock()
+        s.trace_lock = lockwitness.wrap(
+            threading.Lock(), "Session.trace_lock"
+        )  # lint: lock-alias(trace_lock)
         return s
 
 
@@ -775,7 +782,9 @@ class SessionCache:
             )
         self.max_sessions = max(1, int(max_sessions))
         self.max_pars = max(1, int(max_pars))
-        self._lock = threading.Lock()
+        self._lock = lockwitness.wrap(
+            threading.Lock(), "SessionCache._lock"
+        )
         self._sessions: OrderedDict = OrderedDict()  # lint: guarded-by(_lock)
         self._records: OrderedDict = OrderedDict()  # lint: guarded-by(_lock)
         m = _obs.metrics

@@ -65,6 +65,7 @@ import numpy as np
 from pint_tpu.exceptions import PintTpuError, RequestRejected
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
+from pint_tpu.runtime import lockwitness
 from pint_tpu.runtime.guard import validate_finite
 from pint_tpu.serve import batcher as bmod
 from pint_tpu.serve import session as smod
@@ -114,7 +115,9 @@ class ObserveSession:
         self._alert_p = (
             DEFAULT_ALERT_P if alert_p is None else float(alert_p)
         )
-        self._lock = threading.Lock()
+        self._lock = lockwitness.wrap(
+            threading.Lock(), "ObserveSession._lock"
+        )
         self._pending: deque = deque()  # lint: guarded-by(_lock)
         self._busy = False  # lint: guarded-by(_lock)
         self._closed = False  # lint: guarded-by(_lock)

@@ -58,7 +58,7 @@ import numpy as np
 from pint_tpu import obs as _obs
 from pint_tpu.exceptions import PintTpuError
 from pint_tpu.obs.trace import TRACER
-from pint_tpu.runtime import compile_cache
+from pint_tpu.runtime import compile_cache, lockwitness
 
 #: bump when the entry/sidecar schema changes — a mismatched version
 #: ledger is IGNORED (clean cold boot), never migrated in place
@@ -110,7 +110,9 @@ class WarmLedger:
 
     def __init__(self, path: str):
         self.path = str(path)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.wrap(
+            threading.Lock(), "WarmLedger._lock"
+        )
         self._entries: OrderedDict | None = None  # lint: guarded-by(_lock)
 
     # -- read side ---------------------------------------------------------
@@ -239,7 +241,7 @@ class WarmLedger:
 
 
 # -- write-through registration (serve/session.py::traced_jit calls in) --
-_alock = threading.Lock()
+_alock = lockwitness.wrap(threading.Lock(), "warm_ledger._alock")
 _active: list = []  # lint: guarded-by(_alock)
 
 

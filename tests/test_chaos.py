@@ -127,4 +127,5 @@ def test_time_budget_reports_skipped_legs_explicitly(monkeypatch):
                      "stream": "append-faults"}
     for leg in report["legs"]:
         assert leg == {"tag": leg["tag"], "kind": leg["kind"],
-                       "skipped": True, "ok": True}
+                       "skipped": True, "ok": True,
+                       "lock_violations": 0}

@@ -5,8 +5,10 @@ must stay finding-for-finding identical to the pre-framework linters,
 and each NEW rule family must demonstrably catch its incident class —
 the r4 tiny-product flush, the r5 eigh solve, the r5 closure-captured
 device array (HTTP 413), and the PR 5 off-lock fabric mutation —
-while passing the fixed/suppressed form.  Pure AST work: CPU mesh, no
-device dispatch.
+while passing the fixed/suppressed form.  ISSUE 15 adds the
+whole-program concurrency batteries (lockorder cycles, blocking-under
+-lock, verified caller-holds) over the tools/lint/callgraph.py index.
+Pure AST work: CPU mesh, no device dispatch.
 """
 
 import json
@@ -60,9 +62,9 @@ def test_whole_suite_is_clean_over_pint_tpu():
 
 
 def test_cli_exit_codes_and_json_stability(tmp_path, capsys):
-    """--json output is deterministic (sorted, path-relative) so the
-    driver can diff finding counts across PRs; exit 0/1 tracks
-    unbaselined findings."""
+    """--json emits ONE finding per line + a summary line (the driver
+    greps/diffs it across PRs), deterministic (sorted, path-relative);
+    exit 0/1 tracks unbaselined findings."""
     bad = tmp_path / "pint_tpu"
     bad.mkdir()
     (bad / "a.py").write_text(
@@ -76,9 +78,12 @@ def test_cli_exit_codes_and_json_stability(tmp_path, capsys):
     assert main(argv + ["--json"]) == 1
     out2 = capsys.readouterr().out
     assert out1 == out2  # stable across runs
-    payload = json.loads(out1)
-    assert payload["count"] == len(payload["findings"]) == 1
-    f = payload["findings"][0]
+    lines = [json.loads(ln) for ln in out1.splitlines()]
+    summary = lines[-1]
+    assert summary["summary"] is True
+    assert summary["count"] == len(lines) - 1 == 1
+    assert summary["baselined"] == 0
+    f = lines[0]
     assert f["rule"] == "f64-emu" and f["line"] == 3
     assert f["path"].endswith("pint_tpu/a.py")
     # repo-tree findings render repo-relative (the cross-PR diff
@@ -158,30 +163,32 @@ SCALAR_FIXTURE = (
 )
 
 
-def test_shims_delegate_to_framework_rules():
-    """The old entry points are thin shims: same module, same finding
-    objects, same (path, lineno) sets as the framework rules — the
-    regression pin for 'finding-for-finding identical'."""
-    import lint_obs
-    import lint_scalarmath
+def test_migrated_rule_surfaces_stay_finding_for_finding():
+    """The pre-framework linters' behaviours live on as framework
+    rules, finding-for-finding (same module, same Finding objects,
+    same linenos).  The old tools/lint_obs.py / lint_scalarmath.py
+    files are RETIRED deprecation forwarders onto the CLI — pinned in
+    tests/test_lint_obs.py and tests/test_lint_scalarmath.py."""
+    from lint.rules import obs as obs_mod
+    from lint.rules import scalarmath as sc_mod
 
-    obs_old = lint_obs.lint_source(OBS_FIXTURE, "pint_tpu/new.py")
+    obs_old = obs_mod.lint_source(OBS_FIXTURE, "pint_tpu/new.py")
     by_name = rules_by_name()
     obs_new = findings_for(by_name["obs1"], OBS_FIXTURE, "pint_tpu/new.py")
     assert [(f.lineno) for f in obs_old] == [f.lineno for f in obs_new]
     assert [f.lineno for f in obs_old] == [5, 8]
     assert all(isinstance(f, Finding) for f in obs_old)
 
-    sc_old = lint_scalarmath.lint_source(SCALAR_FIXTURE, "k.py")
+    sc_old = sc_mod.lint_source(SCALAR_FIXTURE, "k.py")
     assert {(f.lineno, f.func) for f in sc_old} == {
         (3, "power"), (5, "sin"),
     }
     assert all(isinstance(f, Finding) for f in sc_old)
 
     # chokepoint surface still importable and clean on the real tree
-    assert lint_obs.check_chokepoints(REPO / "pint_tpu") == []
-    assert lint_obs.lint_paths([REPO / "pint_tpu"]) == []
-    assert lint_scalarmath.lint_paths([REPO / "pint_tpu"]) == []
+    assert obs_mod.check_chokepoints(REPO / "pint_tpu") == []
+    assert obs_mod.lint_paths([REPO / "pint_tpu"]) == []
+    assert sc_mod.lint_paths([REPO / "pint_tpu"]) == []
 
 
 # -- f64-emu: the r5 eigh / r4 flush incident classes ---------------------
@@ -805,3 +812,334 @@ def test_perf1_project_checks_flag_stripped_donation_contract(tmp_path):
     assert "snapshot_donated(" in msgs
     assert "donate_argnums" in msgs
     assert perf1.check_project(REPO / "pint_tpu") == []
+
+
+# -- ISSUE 15: whole-program concurrency analyses -------------------------
+def _pkg(tmp_path, **files):
+    """A throwaway package for the project-wide concurrency rules
+    (keys are module paths with '.' as the separator)."""
+    pkg = tmp_path / "pint_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    for name, src in files.items():
+        p = pkg / (name.replace(".", "/") + ".py")
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return pkg
+
+
+def test_lockorder_flags_direct_nesting_cycle(tmp_path):
+    """The classic ABBA: two methods nest the same two locks in
+    opposite orders — one finding carrying BOTH witness paths."""
+    lockorder = rules_by_name()["lockorder"]
+    pkg = _pkg(tmp_path, engine=(
+        "import threading\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def forward(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def backward(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    ))
+    out = lockorder.check_project(pkg)
+    assert len(out) == 1
+    msg = out[0].message
+    assert "potential deadlock" in msg
+    assert "Engine._a -> Engine._b" in msg
+    assert "Engine._b -> Engine._a" in msg
+    assert "Engine.forward" in msg and "Engine.backward" in msg
+
+
+def test_lockorder_follows_calls_one_deep(tmp_path):
+    """Nesting reached THROUGH a call contributes the same edge: hold
+    _p, call a method that takes _q.  The witness names the chain."""
+    lockorder = rules_by_name()["lockorder"]
+    pkg = _pkg(tmp_path, pool=(
+        "import threading\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._p = threading.Lock()\n"
+        "        self._q = threading.Lock()\n"
+        "    def _take_q(self):\n"
+        "        with self._q:\n"
+        "            pass\n"
+        "    def big(self):\n"
+        "        with self._p:\n"
+        "            self._take_q()\n"
+        "    def other(self):\n"
+        "        with self._q:\n"
+        "            with self._p:\n"
+        "                pass\n"
+    ))
+    out = lockorder.check_project(pkg)
+    assert len(out) == 1
+    msg = out[0].message
+    assert "Pool._p -> Pool._q" in msg
+    assert "via" in msg and "_take_q" in msg
+
+
+def test_lockorder_unifies_aliased_cross_class_locks(tmp_path):
+    """# lint: lock-alias(...) makes a lock shared across classes ONE
+    identity (the Session.trace_lock pattern), so a cross-class
+    inversion closes the cycle."""
+    lockorder = rules_by_name()["lockorder"]
+    pkg = _pkg(tmp_path, serve=(
+        "import threading\n"
+        "class Session:\n"
+        "    def __init__(self):\n"
+        "        self.trace_lock = (\n"
+        "            threading.Lock()\n"
+        "        )  # lint: lock-alias(trace_lock)\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self.trace_lock = (\n"
+        "            threading.Lock()\n"
+        "        )  # lint: lock-alias(trace_lock)\n"
+        "        self._lock = threading.Lock()\n"
+        "    def stash(self, s):\n"
+        "        with self._lock:\n"
+        "            with s.trace_lock:\n"
+        "                pass\n"
+        "    def trace(self, s):\n"
+        "        with s.trace_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    ))
+    out = lockorder.check_project(pkg)
+    assert len(out) == 1
+    msg = out[0].message
+    assert "Cache._lock -> trace_lock" in msg
+    assert "trace_lock -> Cache._lock" in msg
+
+
+def test_lockorder_honors_try_finally_release(tmp_path):
+    """acquire/try/finally-release is SEQUENTIAL, not nested: the lock
+    is gone by the next statement, so no edge and no cycle."""
+    lockorder = rules_by_name()["lockorder"]
+    pkg = _pkg(tmp_path, ledger=(
+        "import threading\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._x = threading.Lock()\n"
+        "        self._y = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        self._x.acquire()\n"
+        "        try:\n"
+        "            pass\n"
+        "        finally:\n"
+        "            self._x.release()\n"
+        "        with self._y:\n"
+        "            pass\n"
+        "    def rev(self):\n"
+        "        with self._y:\n"
+        "            with self._x:\n"
+        "                pass\n"
+    ))
+    assert lockorder.check_project(pkg) == []
+
+
+def test_lockorder_flags_same_identity_two_instance_nesting(tmp_path):
+    """Two INSTANCES under one identity locked in arbitrary order is
+    an ABBA on one name (the fused cross-key trace_lock class); the
+    id-ordered protocol suppresses with a justified pragma."""
+    lockorder = rules_by_name()["lockorder"]
+    pkg = _pkg(tmp_path, gang=(
+        "import threading\n"
+        "class Gang:\n"
+        "    def __init__(self):\n"
+        "        self._m = threading.Lock()\n"
+        "    def pair(self, other):\n"
+        "        with self._m:\n"
+        "            with other._m:\n"
+        "                pass\n"
+    ))
+    out = lockorder.check_project(pkg)
+    assert len(out) == 1
+    assert "nested acquisition of Gang._m" in out[0].message
+    assert "sort by id()" in out[0].message
+    ok = _pkg(tmp_path / "ok", gang=(
+        "import threading\n"
+        "class Gang:\n"
+        "    def __init__(self):\n"
+        "        self._m = threading.Lock()\n"
+        "    def pair(self, other):\n"
+        "        first, second = sorted([self, other], key=id)\n"
+        "        with first._m:\n"
+        "            # deterministic ascending-id order: deadlock-free\n"
+        "            with second._m:  # lint: ok(lockorder)\n"
+        "                pass\n"
+    ))
+    assert lockorder.check_project(ok) == []
+
+
+def test_blocking_flags_each_op_class_with_timeout_negatives(tmp_path):
+    """Every blocked-op class fires under a held lock and stays quiet
+    with a timeout / block=False / off-lock."""
+    blocking = rules_by_name()["blocking"]
+    pkg = _pkg(tmp_path, replica=(
+        "import queue\n"
+        "import threading\n"
+        "import time\n"
+        "from pint_tpu.runtime import guard\n"
+        "class Replica:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "        self._ev = threading.Event()\n"
+        "    def bad_result(self, fut):\n"
+        "        with self._lock:\n"
+        "            return fut.result()\n"
+        "    def ok_result(self, fut):\n"
+        "        with self._lock:\n"
+        "            return fut.result(timeout=1.0)\n"
+        "    def bad_get(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get()\n"
+        "    def ok_get(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get(timeout=0.5)\n"
+        "    def bad_wait(self):\n"
+        "        with self._lock:\n"
+        "            self._ev.wait()\n"
+        "    def ok_wait(self):\n"
+        "        with self._lock:\n"
+        "            self._ev.wait(0.2)\n"
+        "    def bad_sleep(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n"
+        "    def ok_sleep(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.01)\n"
+        "    def bad_fence(self, out):\n"
+        "        with self._lock:\n"
+        "            return guard.fence_owned(out)\n"
+        "    def off_lock(self, fut):\n"
+        "        return fut.result()\n"
+    ))
+    out = blocking.check_project(pkg)
+    flagged = sorted({f.lineno for f in out})
+    src = (pkg / "replica.py").read_text().splitlines()
+    bad_linenos = sorted(  # the op line, two below each bad_* def
+        i + 3 for i, ln in enumerate(src) if "def bad_" in ln
+    )
+    assert flagged == bad_linenos, "\n".join(str(f) for f in out)
+    assert all("while holding Replica._lock" in f.message for f in out)
+
+
+def test_blocking_follows_calls_one_deep(tmp_path):
+    """Holding a lock and CALLING a function whose closure reaches a
+    blocking op is the same hazard one hop away; the finding lands on
+    the call site and names the reached op."""
+    blocking = rules_by_name()["blocking"]
+    pkg = _pkg(tmp_path, fab=(
+        "import threading\n"
+        "from pint_tpu.runtime import guard\n"
+        "class Fab:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _fence_all(self, outs):\n"
+        "        return [guard.fence_owned(o) for o in outs]\n"
+        "    def harvest(self, outs):\n"
+        "        with self._lock:\n"
+        "            return self._fence_all(outs)\n"
+        "    def clean(self, outs):\n"
+        "        return self._fence_all(outs)\n"
+    ))
+    out = blocking.check_project(pkg)
+    assert len(out) == 1
+    msg = out[0].message
+    assert "may block" in msg and "_fence_all" in msg
+    assert "fence_owned" in msg
+    # pragma on the CALL site suppresses the interprocedural finding
+    sup = _pkg(tmp_path / "sup", fab=(
+        "import threading\n"
+        "from pint_tpu.runtime import guard\n"
+        "class Fab:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _fence_all(self, outs):\n"
+        "        return [guard.fence_owned(o) for o in outs]\n"
+        "    def harvest(self, outs):\n"
+        "        with self._lock:\n"
+        "            # bounded: pool-width outs, faults re-route\n"
+        "            return self._fence_all(outs)  # lint: ok(blocking)\n"
+    ))
+    assert blocking.check_project(sup) == []
+
+
+def test_locks_verifies_caller_holds_contracts(tmp_path):
+    """*_locked / # lint: holds(...) are VERIFIED through the call
+    graph, not trusted: an off-lock call site of a caller-holds
+    method is a finding."""
+    locks = rules_by_name()["locks"]
+    pkg = _pkg(tmp_path, cache=(
+        "import threading\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # lint: guarded-by(_lock)\n"
+        "    def _bump_locked(self):\n"
+        "        self._n += 1\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "    def bad(self):\n"
+        "        self._bump_locked()\n"
+        "    def chained(self):  # lint: holds(_lock)\n"
+        "        self._bump_locked()\n"
+        "    def uses_chained(self):\n"
+        "        with self._lock:\n"
+        "            self.chained()\n"
+    ))
+    out = locks.check_project(pkg)
+    assert len(out) == 1
+    assert "Cache._bump_locked" in out[0].message
+    assert "without holding Cache._lock" in out[0].message
+    assert "caller-holds" in out[0].message
+
+
+def test_concurrency_rules_pass_the_real_tree():
+    """The serving stack's lock-order graph is verified ACYCLIC (the
+    documented order: Replica._state_lock -> Replica._cond;
+    TimingEngine._finish_lock -> {_lat_lock, faults._lock}), with no
+    blocking-under-lock and every caller-holds contract satisfied —
+    docs/static_analysis.md 'concurrency analyses'."""
+    by_name = rules_by_name()
+    for rule in ("lockorder", "blocking", "locks"):
+        out = by_name[rule].check_project(REPO / "pint_tpu")
+        assert out == [], "\n".join(str(f) for f in out)
+
+
+def test_changed_mode_lints_only_diffed_files(tmp_path, capsys):
+    """--changed restricts the run to files differing from the git
+    merge base (the lightweight pre-test tier): a hazard in a fixture
+    OUTSIDE the repo diff is invisible to it, while the full lint
+    still flags it."""
+    from lint.engine import changed_files
+
+    bad = tmp_path / "pint_tpu"
+    bad.mkdir()
+    (bad / "a.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def solve(A):\n"
+        "    return jnp.linalg.eigh(A)\n"
+    )
+    argv = [str(bad), "--baseline", str(tmp_path / "nope.json")]
+    assert main(argv) == 1
+    capsys.readouterr()
+    assert main(argv + ["--changed", "--json"]) == 0
+    lines = [
+        json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+    ]
+    assert lines[-1]["summary"] is True and lines[-1]["count"] == 0
+    # the selector returns repo .py files under the target (or None
+    # when git can't answer — the CLI then falls back to a full lint)
+    sel = changed_files([REPO / "pint_tpu"])
+    assert sel is None or all(
+        str(p).endswith(".py") for p in sel
+    )

@@ -1,21 +1,36 @@
-"""Tier-1 wiring for tools/lint_obs.py: no dispatch path may bypass
-the flight recorder (a bare jax.jit host dispatch is invisible to
-spans, the recompile gate, AND the watchdog — and nothing at runtime
-can notice the absence), and the instrumented chokepoints themselves
-must stay instrumented.  Sibling of tests/test_lint_scalarmath.py.
+"""Tier-1 wiring for the obs rules (tools/lint/rules/obs.py): no
+dispatch path may bypass the flight recorder (a bare jax.jit host
+dispatch is invisible to spans, the recompile gate, AND the watchdog —
+and nothing at runtime can notice the absence), and the instrumented
+chokepoints themselves must stay instrumented.  Sibling of
+tests/test_lint_scalarmath.py.  The old ``tools/lint_obs.py`` entry
+point is a retired deprecation forwarder (pinned below).
 """
 
+import subprocess
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
-from lint_obs import (  # noqa: E402
+from lint.rules.obs import (  # noqa: E402
     check_chokepoints,
     lint_paths,
     lint_source,
 )
+
+
+def test_retired_forwarder_points_at_framework():
+    """`python tools/lint_obs.py` still exits clean but prints the
+    deprecation pointer and delegates to the framework CLI."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_obs.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "retired" in proc.stderr
+    assert "python -m tools.lint" in proc.stderr
 
 
 def test_codebase_is_clean():

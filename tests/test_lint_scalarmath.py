@@ -1,17 +1,31 @@
-"""Tier-1 wiring for tools/lint_scalarmath.py: the codebase must stay
-free of direct jnp transcendentals on scalar model parameters (the
-axon 0-d f32-accuracy hazard, ops/scalarmath.py / docs/precision.md —
-invisible on the CPU mesh, so a static check is the only tier-1
-guard), and the linter itself must keep catching the known patterns.
+"""Tier-1 wiring for the scalarmath rule (tools/lint/rules/
+scalarmath.py): the codebase must stay free of direct jnp
+transcendentals on scalar model parameters (the axon 0-d f32-accuracy
+hazard, ops/scalarmath.py / docs/precision.md — invisible on the CPU
+mesh, so a static check is the only tier-1 guard), and the linter
+itself must keep catching the known patterns.  The old
+``tools/lint_scalarmath.py`` entry point is a retired deprecation
+forwarder (pinned below).
 """
 
+import subprocess
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
-from lint_scalarmath import lint_paths, lint_source  # noqa: E402
+from lint.rules.scalarmath import lint_paths, lint_source  # noqa: E402
+
+
+def test_retired_forwarder_points_at_framework():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_scalarmath.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "retired" in proc.stderr
+    assert "python -m tools.lint" in proc.stderr
 
 
 def test_codebase_is_clean():

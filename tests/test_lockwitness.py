@@ -1,0 +1,210 @@
+"""Tier-1 wiring for the runtime lock-witness sanitizer
+(pint_tpu/runtime/lockwitness.py; ISSUE 15): the dynamic half of the
+concurrency analyses.  The static ``lockorder`` rule proves the
+program *structure* acyclic; the witness catches what statics can't —
+callbacks run inline under a lock, the id-sorted multi-``trace_lock``
+protocol, anything composed at runtime.  Two REAL threads invert an
+order here and the witness must report it with both stacks; the
+negatives (ascending order, timed waits, disabled flag) must stay
+silent, and ``wrap()`` must be a no-op passthrough when the witness
+is not installed (the zero-production-cost contract CLAUDE.md
+documents for ``PINT_TPU_LOCK_WITNESS``).  Pure host threading: CPU
+mesh, no device dispatch.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pint_tpu.runtime import lockwitness
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """Install + enable the witness for one test; monkeypatch restores
+    the module flags and we clear the global graph both ways."""
+    monkeypatch.setattr(lockwitness, "_installed", True)
+    monkeypatch.setattr(lockwitness, "_enabled", True)
+    lockwitness.reset()
+    yield lockwitness
+    lockwitness.reset()
+
+
+def test_wrap_is_raw_passthrough_when_not_installed(monkeypatch):
+    monkeypatch.setattr(lockwitness, "_installed", False)
+    lk = threading.Lock()
+    cv = threading.Condition()
+    assert lockwitness.wrap(lk, "x") is lk
+    assert lockwitness.wrap(cv, "y") is cv
+
+
+def test_semaphores_pass_through_even_when_installed(witness):
+    """Cross-thread handoff semantics (Replica._sem acquires on the
+    dispatcher, releases on the fencer): never witnessed."""
+    sem = threading.Semaphore(2)
+    assert lockwitness.wrap(sem, "Replica._sem") is sem
+
+
+def test_two_threads_inverting_order_is_one_violation(witness):
+    a = lockwitness.wrap(threading.Lock(), "W.a")
+    b = lockwitness.wrap(threading.Lock(), "W.b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward, name="fwd")
+    t1.start()
+    t1.join(5)
+    t2 = threading.Thread(target=backward, name="bwd")
+    t2.start()
+    t2.join(5)
+    vs = lockwitness.violations()
+    assert len(vs) == 1, vs
+    v = vs[0]
+    assert v["kind"] == "inversion"
+    assert "W.a" in v["detail"] and "W.b" in v["detail"]
+    # both witness paths attached: this thread's and the prior one's
+    assert v["stacks"]["this"] and v["stacks"]["prior"]
+    assert v["thread"] == "bwd"
+    # dedup: re-running the inverted pattern does not re-report
+    t3 = threading.Thread(target=backward)
+    t3.start()
+    t3.join(5)
+    assert lockwitness.violation_count() == 1
+
+
+def test_consistent_order_across_threads_is_clean(witness):
+    a = lockwitness.wrap(threading.Lock(), "W.a")
+    b = lockwitness.wrap(threading.Lock(), "W.b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    for _ in range(3):
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join(5)
+    assert lockwitness.violation_count() == 0
+
+
+def test_untimed_condition_wait_under_other_lock_is_flagged(witness):
+    outer = lockwitness.wrap(threading.Lock(), "W.outer")
+    cond = lockwitness.wrap(threading.Condition(), "W.cond")
+
+    def waiter():
+        with outer:
+            with cond:
+                cond.wait()  # untimed while holding W.outer
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # the violation is emitted at wait() ENTRY (before blocking)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if any(
+            v["kind"] == "blocking-under-lock"
+            for v in lockwitness.violations()
+        ):
+            break
+        time.sleep(0.01)
+    vs = [
+        v for v in lockwitness.violations()
+        if v["kind"] == "blocking-under-lock"
+    ]
+    assert len(vs) == 1
+    assert "W.outer" in vs[0]["detail"]
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+
+
+def test_timed_wait_and_bare_wait_are_clean(witness):
+    outer = lockwitness.wrap(threading.Lock(), "W.outer")
+    cond = lockwitness.wrap(threading.Condition(), "W.cond")
+
+    def timed():
+        with outer:
+            with cond:
+                cond.wait(0.01)  # bounded: not a blocking hazard
+
+    def bare():
+        with cond:
+            cond.wait(0.01)
+
+    for target in (timed, bare):
+        t = threading.Thread(target=target)
+        t.start()
+        t.join(5)
+    assert [
+        v for v in lockwitness.violations()
+        if v["kind"] == "blocking-under-lock"
+    ] == []
+
+
+def test_same_identity_descending_id_is_flagged(witness):
+    l1, l2 = threading.Lock(), threading.Lock()
+    w1 = lockwitness.wrap(l1, "Session.trace_lock")
+    w2 = lockwitness.wrap(l2, "Session.trace_lock")
+    hi, lo = (w1, w2) if id(l1) > id(l2) else (w2, w1)
+    with hi:
+        with lo:  # descending id(): violates the fused protocol
+            pass
+    vs = lockwitness.violations()
+    assert [v["kind"] for v in vs] == ["same-identity-order"]
+    lockwitness.reset()
+    with lo:
+        with hi:  # ascending: the deadlock-free protocol order
+            pass
+    assert lockwitness.violation_count() == 0
+
+
+def test_reentrant_same_instance_is_clean(witness):
+    r = lockwitness.wrap(threading.RLock(), "W.r")
+    with r:
+        with r:
+            pass
+    assert lockwitness.violation_count() == 0
+
+
+def test_disabled_flag_silences_recording(witness, monkeypatch):
+    a = lockwitness.wrap(threading.Lock(), "W.a")
+    b = lockwitness.wrap(threading.Lock(), "W.b")
+    monkeypatch.setattr(lockwitness, "_enabled", False)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert lockwitness.violation_count() == 0
+
+
+def test_armed_restores_prior_state_and_reset_clears(monkeypatch):
+    monkeypatch.setattr(lockwitness, "_installed", False)
+    monkeypatch.setattr(lockwitness, "_enabled", False)
+    with lockwitness.armed():
+        assert lockwitness.enabled() and lockwitness.installed()
+        a = lockwitness.wrap(threading.Lock(), "W.a")
+        b = lockwitness.wrap(threading.Lock(), "W.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert lockwitness.violation_count() == 1
+    assert not lockwitness.enabled()
+    lockwitness.reset()
+    assert lockwitness.violation_count() == 0
+    assert lockwitness.violations() == []

@@ -1,6 +1,10 @@
 """Repo tooling (linters, profilers, citation regen).
 
 ``tools.lint`` is the unified hazard-analysis framework
-(docs/static_analysis.md); ``tools/lint_obs.py`` and
-``tools/lint_scalarmath.py`` are thin back-compat shims over it.
+(docs/static_analysis.md) — per-file rules plus the whole-program
+concurrency analyses (lockorder/blocking/locks over the
+tools/lint/callgraph.py index).  ``tools/lint_obs.py`` and
+``tools/lint_scalarmath.py`` are retired deprecation forwarders onto
+it.  ``tools/chaos.py`` runs the deterministic fault sweep with the
+runtime lock witness armed (PINT_TPU_LOCK_WITNESS).
 """

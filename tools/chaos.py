@@ -36,7 +36,14 @@ Determinism: the harness is driven exclusively by the deterministic
 ``PINT_TPU_FAULTS`` engine, armed programmatically per leg) — it
 imports no randomness source and fixes every simulation seed, so a
 failing leg replays bit-identically (pintlint rule obs8 machine
--checks this).  Legs target executors DIRECTLY — each targeted batch
+-checks this).  Cross-key fusion is pinned OFF for the sweep
+(``PINT_TPU_SERVE_XKEY_FUSE=0``): fusion legally compiles one fresh
+kernel per first-seen key COMBO (replica.py::_fuse), and whether two
+distinct keys first co-reside inside a leg's steady window depends on
+collector/re-route timing — an opportunistic optimisation is
+inherently at odds with the zero-steady-trace assertion, so the
+harness removes it rather than flaking on it (the xkey path has its
+own deterministic gate: the bench ``serve`` block's ``xkey`` probe).  Legs target executors DIRECTLY — each targeted batch
 is assembled by the engine's own stacking chokepoint and force
 -submitted to the tagged replica — so coverage of every tag is by
 construction, not by hoping the sticky router happens to place a key
@@ -51,6 +58,7 @@ profiling/chaos_sweep.py wrap it).  Workflow: docs/robustness.md
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import tempfile
@@ -467,6 +475,41 @@ def restart_leg(small, ledger_path: str, *, engine_kw: dict,
 
 
 # -- the sweep --------------------------------------------------------------
+@contextlib.contextmanager
+def _xkey_fusion_off():
+    """Pin cross-key fusion off for the sweep's engines (replicas read
+    the env at construction).  Fusion's first-seen-combo compile is
+    legal by design but timing-dependent — with it on, a leg's
+    ``steady_traces == 0`` assertion flakes whenever two distinct keys
+    first colocate (e.g. background traffic re-routed onto the healthy
+    replica during a quarantine) inside the leg window."""
+    prior = os.environ.get("PINT_TPU_SERVE_XKEY_FUSE")
+    os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = "0"
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop("PINT_TPU_SERVE_XKEY_FUSE", None)
+        else:
+            os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = prior
+
+
+def _witness_leg(leg: dict, vbase: int) -> dict:
+    """Fold the lock-witness delta into one finished leg: any order
+    inversion / blocking-under-lock recorded while the leg ran fails
+    it (docs/robustness.md "fleet operability")."""
+    from pint_tpu.runtime import lockwitness
+
+    new = lockwitness.violations()[vbase:]
+    leg["lock_violations"] = len(new)
+    if new:
+        leg["ok"] = False
+        leg["lock_violation_kinds"] = sorted(
+            {v["kind"] for v in new}
+        )
+    return leg
+
+
 def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
               replicas: int | None = None, gangs: int | None = None,
               gang_size: int | None = None,
@@ -486,62 +529,81 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
     what was NOT exercised, never a silent cap — and the restart leg
     always runs."""
     from pint_tpu.obs.export import flight_report
+    from pint_tpu.runtime import lockwitness
     from pint_tpu.serve import TimingEngine
 
-    small = build_fleet(npsr)
-    big = build_big()
-    engine = TimingEngine(
-        max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
-        replicas=replicas, gangs=gangs, gang_size=gang_size,
-        gang_threshold=512 if gangs else None,
-        quarantine_n=2, probe_ms=50, warm_ledger=False,
-    )
-    legs = []
-    t_start = time.monotonic()
-    try:
-        sites = executor_sites(engine)
-        warm_executors(engine, small, big, timeout=max(timeout, 600.0))
-        for site in sites:
-            for kind in kinds:
-                if (time_budget_s is not None
-                        and time.monotonic() - t_start > time_budget_s):
-                    legs.append({
-                        "tag": site["tag"], "kind": kind,
-                        "skipped": True, "ok": True,
-                    })
-                    continue
-                legs.append(run_leg(
-                    engine, site["tag"], kind, small=small, big=big,
-                    hang_seconds=hang_seconds, timeout=timeout,
-                ))
-        report_text = flight_report()
-    finally:
-        engine.close()
-    if stream:
-        if (time_budget_s is not None
-                and time.monotonic() - t_start > time_budget_s):
-            legs.append({
-                "tag": "stream", "kind": "append-faults",
-                "skipped": True, "ok": True,
-            })
-        else:
-            legs.append(stream_leg(
-                kinds=kinds, hang_seconds=hang_seconds,
-                timeout=timeout,
-            ))
-    if restart:
-        lp = os.path.join(
-            ledger_dir or tempfile.mkdtemp(prefix="pint-tpu-chaos-"),
-            "chaos-warm-ledger.json",
+    # the lock-witness sanitizer (ISSUE 15) is armed for the WHOLE
+    # sweep — engines built below get witnessed serve-stack locks, and
+    # every leg (fault legs, stream leg, kill-and-restart leg)
+    # additionally asserts zero ordering/blocking violations.  Cross
+    # -key fusion is pinned off (see _xkey_fusion_off) so the legal
+    # first-seen-combo compile can't leak into a leg's steady window.
+    with _xkey_fusion_off(), lockwitness.armed():
+        small = build_fleet(npsr)
+        big = build_big()
+        engine = TimingEngine(
+            max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
+            replicas=replicas, gangs=gangs, gang_size=gang_size,
+            gang_threshold=512 if gangs else None,
+            quarantine_n=2, probe_ms=50, warm_ledger=False,
         )
-        legs.append(restart_leg(
-            small, lp,
-            engine_kw=dict(
-                max_batch=2, max_wait_ms=2.0, inflight=1,
-                replicas=replicas, prewarm=True,
-            ),
-            timeout=max(timeout, 600.0),
-        ))
+        legs = []
+        t_start = time.monotonic()
+        try:
+            sites = executor_sites(engine)
+            warm_executors(
+                engine, small, big, timeout=max(timeout, 600.0)
+            )
+            for site in sites:
+                for kind in kinds:
+                    if (time_budget_s is not None
+                            and time.monotonic() - t_start
+                            > time_budget_s):
+                        legs.append({
+                            "tag": site["tag"], "kind": kind,
+                            "skipped": True, "ok": True,
+                            "lock_violations": 0,
+                        })
+                        continue
+                    vbase = lockwitness.violation_count()
+                    legs.append(_witness_leg(run_leg(
+                        engine, site["tag"], kind, small=small,
+                        big=big, hang_seconds=hang_seconds,
+                        timeout=timeout,
+                    ), vbase))
+            report_text = flight_report()
+        finally:
+            engine.close()
+        if stream:
+            if (time_budget_s is not None
+                    and time.monotonic() - t_start > time_budget_s):
+                legs.append({
+                    "tag": "stream", "kind": "append-faults",
+                    "skipped": True, "ok": True,
+                    "lock_violations": 0,
+                })
+            else:
+                vbase = lockwitness.violation_count()
+                legs.append(_witness_leg(stream_leg(
+                    kinds=kinds, hang_seconds=hang_seconds,
+                    timeout=timeout,
+                ), vbase))
+        if restart:
+            lp = os.path.join(
+                ledger_dir
+                or tempfile.mkdtemp(prefix="pint-tpu-chaos-"),
+                "chaos-warm-ledger.json",
+            )
+            vbase = lockwitness.violation_count()
+            legs.append(_witness_leg(restart_leg(
+                small, lp,
+                engine_kw=dict(
+                    max_batch=2, max_wait_ms=2.0, inflight=1,
+                    replicas=replicas, prewarm=True,
+                ),
+                timeout=max(timeout, 600.0),
+            ), vbase))
+        total_violations = lockwitness.violation_count()
     return {
         "executors": [s["tag"] for s in sites],
         "legs": legs,
@@ -549,6 +611,7 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
         "ok": all(leg["ok"] for leg in legs),
         "flight_has_quarantine": "quarantines" in report_text,
         "flight_has_readmit": "readmits" in report_text,
+        "lock_violations": total_violations,
     }
 
 
@@ -584,6 +647,7 @@ def main(argv=None) -> int:
         "executors": report["executors"], "ok": report["ok"],
         "flight_has_quarantine": report["flight_has_quarantine"],
         "flight_has_readmit": report["flight_has_readmit"],
+        "lock_violations": report["lock_violations"],
     }))
     return 0 if report["ok"] else 1
 

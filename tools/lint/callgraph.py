@@ -1,0 +1,861 @@
+"""Whole-program index for the concurrency rules (ISSUE 15).
+
+The per-file rules see one AST at a time; the concurrency hazards the
+serving fabric can actually deadlock on are *inter*procedural — method
+A holds L1 and calls method B which takes L2.  This module builds the
+project-wide view the ``lockorder`` / ``blocking`` rules and the
+call-graph-verified ``locks`` rule share:
+
+- a module index over ``pkg_root`` (relative dotted names, import
+  resolution for ``import pint_tpu.x``, ``from .y import z`` forms);
+- a class index with base-class resolution (``GangReplica`` sees
+  ``Replica``'s lock fields and methods) and a subclass map (a
+  ``self.m()`` call may dispatch to an override);
+- a lock-declaration harvest: every ``self.F = threading.Lock()`` /
+  ``RLock`` / ``Condition`` (module-level ``NAME = threading.Lock()``
+  too), classified by kind; ``queue.Queue`` / ``Semaphore`` / ``Event``
+  fields are harvested for the blocking rule but excluded from the
+  held-set model (their ownership is handed across threads — the
+  ``Replica._sem`` acquire-on-dispatcher / release-on-fencer protocol
+  is legitimate and would poison a per-thread stack).  A creation
+  wrapped by the runtime witness (``lockwitness.wrap(threading.Lock(),
+  ...)``) is seen through.
+- lock *identities*: ``Class.field`` (resolved through the MRO) or
+  ``module.name``; ``# lint: lock-alias(<name>)`` on the declaring
+  line renames the identity so a lock shared across classes (the
+  ``Session.trace_lock`` prototype-serialization lock, reached as
+  ``work.session.trace_lock`` from replicas and streams) unifies.  A
+  non-``self`` attribute reference falls back to the alias table, then
+  to a unique-field-name match across all declarations.
+- per-function summaries from a sequential held-set walk: ``with``
+  items, bare ``.acquire()``/``.release()`` pairs (the try/finally
+  idiom releases correctly because ``finally`` bodies run in sequence),
+  ``stack.enter_context(lock)``; nested ``def``s are walked as separate
+  functions with the enclosing local-variable lock bindings (a closure
+  body does not execute at its ``def`` site — its acquisitions must
+  not inherit the outer held set);
+- call sites with the held set at the call (``self.m()``, module
+  functions, cross-module via imports, constructors, ``super().m()``,
+  and unique-name attribute calls), and blocking-operation sites;
+- fixpoint closures: ``may_acquire`` (lock identities a call may take,
+  transitively) and ``may_block`` (blocking operations a call may
+  reach) — these turn one-call-deep nesting into lock-order edges and
+  blocking-under-lock findings with witness chains.
+
+The index is cached on a (path, mtime, size) signature so the three
+rules sharing it parse the package once per lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .engine import Module
+
+ALIAS_RE = re.compile(r"lint:\s*lock-alias\((\w+)\)")
+
+#: constructor name -> kind.  "lock"/"rlock"/"condition" join the
+#: held-set model; "semaphore"/"event"/"queue" only feed the blocking
+#: rule (cross-thread handoff semantics — see module docstring).
+LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+    "Event": "event",
+    "Queue": "queue",
+    "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "SimpleQueue": "queue",
+}
+
+#: kinds that participate in the per-thread held-set / ordering model
+HELD_KINDS = {"lock", "rlock", "condition"}
+
+#: same-identity nested acquisition is re-entrant for these kinds
+REENTRANT_KINDS = {"rlock", "condition"}
+
+#: time.sleep at/above this many seconds is a blocking operation
+SLEEP_THRESHOLD_S = 0.1
+
+#: device-fence callables (the "drain never hangs" surface)
+FENCE_NAMES = {"fence_owned", "fence_pytree", "block_until_ready"}
+
+
+class LockDecl:
+    __slots__ = ("identity", "kind", "cls", "field", "modname", "lineno")
+
+    def __init__(self, identity, kind, cls, field, modname, lineno):
+        self.identity = identity
+        self.kind = kind
+        self.cls = cls
+        self.field = field
+        self.modname = modname
+        self.lineno = lineno
+
+
+class ClassInfo:
+    __slots__ = ("name", "modname", "node", "bases", "methods", "subs")
+
+    def __init__(self, name, modname, node):
+        self.name = name
+        self.modname = modname
+        self.node = node
+        self.bases: list = []      # resolved project base class names
+        self.methods: dict = {}    # own methods: name -> FuncInfo
+        self.subs: set = set()     # direct project subclasses (names)
+
+
+class FuncInfo:
+    """One function/method + its concurrency summary."""
+
+    __slots__ = (
+        "key", "name", "node", "mod", "modname", "cls",
+        "acquires", "edges", "self_edges", "calls", "blocking",
+    )
+
+    def __init__(self, key, name, node, mod, modname, cls):
+        self.key = key
+        self.name = name
+        self.node = node
+        self.mod = mod            # engine.Module (for pragma checks)
+        self.modname = modname
+        self.cls = cls            # ClassInfo or None
+        self.acquires: dict = {}  # identity -> first direct lineno
+        self.edges: list = []     # (held_id, acq_id, lineno) direct nesting
+        self.self_edges: list = []  # (identity, lineno) non-reentrant
+        self.calls: list = []     # (spec, held_tuple, lineno)
+        self.blocking: list = []  # (desc, held_tuple, lineno)
+
+    def qual(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.name}"
+        return self.name
+
+
+def _unwrap_witness(node):
+    """See through ``lockwitness.wrap(<ctor>, name)`` creation sites."""
+    while isinstance(node, ast.Call):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname == "wrap" and node.args:
+            node = node.args[0]
+            continue
+        break
+    return node
+
+
+def _ctor_kind(value) -> str | None:
+    """Lock-ish constructor kind of an assignment RHS, else None."""
+    value = _unwrap_witness(value)
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return LOCK_CTORS.get(name)
+
+
+def _modname_of(pkg_root: Path, py: Path) -> tuple[str, bool]:
+    rel = py.relative_to(pkg_root).with_suffix("")
+    parts = list(rel.parts)
+    is_init = parts[-1] == "__init__"
+    if is_init:
+        parts = parts[:-1]
+    return ".".join(parts), is_init
+
+
+class ProjectIndex:
+    def __init__(self, pkg_root: Path):
+        self.pkg_root = Path(pkg_root).resolve()
+        self.pkg_name = self.pkg_root.name
+        self.modules: dict = {}        # modname -> Module
+        self.mod_is_init: dict = {}
+        self.imports: dict = {}        # modname -> (mods, names)
+        self.classes: dict = {}        # class name -> ClassInfo
+        self.functions: dict = {}      # key -> FuncInfo
+        self.modfuncs: dict = {}       # (modname, name) -> FuncInfo
+        self.methods_by_name: dict = {}  # name -> [FuncInfo]
+        self.lock_decls: dict = {}     # identity -> LockDecl
+        self.class_fields: dict = {}   # (clsname, field) -> identity
+        self.module_locks: dict = {}   # (modname, name) -> identity
+        self.alias_fields: dict = {}   # field -> identity (alias-declared)
+        self.field_owners: dict = {}   # field -> set of class names
+        self._may_acquire = None
+        self._may_block = None
+        self._mro_cache: dict = {}
+
+    # -- construction ------------------------------------------------------
+    def build(self):
+        for py in sorted(self.pkg_root.rglob("*.py")):
+            try:
+                mod = Module(py, py.read_text())
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+            modname, is_init = _modname_of(self.pkg_root, py)
+            self.modules[modname] = mod
+            self.mod_is_init[modname] = is_init
+        for modname, mod in self.modules.items():
+            self.imports[modname] = self._build_imports(modname, mod)
+            self._index_defs(modname, mod)
+        self._link_bases()
+        self._harvest_locks()
+        for fi in list(self.functions.values()):
+            _FuncWalker(self, fi, {}).run()
+        return self
+
+    def _build_imports(self, modname, mod):
+        """-> (alias -> project modname, name -> (modname, origname))."""
+        mods, names = {}, {}
+        pkg = self.pkg_name
+        if self.mod_is_init.get(modname):
+            base_parts = modname.split(".") if modname else []
+        else:
+            base_parts = modname.split(".")[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    tgt = a.name
+                    if tgt == pkg or tgt.startswith(pkg + "."):
+                        rel = tgt[len(pkg):].lstrip(".")
+                        if rel in self.modules:
+                            mods[a.asname or tgt.split(".")[0]] = rel
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    parts = base_parts[: len(base_parts) - (node.level - 1)]
+                    if node.level - 1 > len(base_parts):
+                        continue
+                    src = ".".join(
+                        parts + (node.module.split(".") if node.module else [])
+                    )
+                elif node.module and (
+                    node.module == pkg or node.module.startswith(pkg + ".")
+                ):
+                    src = node.module[len(pkg):].lstrip(".")
+                else:
+                    continue
+                for a in node.names:
+                    local = a.asname or a.name
+                    sub = f"{src}.{a.name}" if src else a.name
+                    if sub in self.modules:
+                        mods[local] = sub
+                    elif src in self.modules or src == "":
+                        names[local] = (src, a.name)
+        return mods, names
+
+    def _index_defs(self, modname, mod):
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node.name, modname, node)
+                self.classes.setdefault(node.name, ci)
+                ci = self.classes[node.name]
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        key = f"{modname}::{node.name}.{sub.name}"
+                        fi = FuncInfo(key, sub.name, sub, mod, modname, ci)
+                        ci.methods[sub.name] = fi
+                        self.functions[key] = fi
+                        self.methods_by_name.setdefault(
+                            sub.name, []
+                        ).append(fi)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{modname}::{node.name}"
+                fi = FuncInfo(key, node.name, node, mod, modname, None)
+                self.functions[key] = fi
+                self.modfuncs[(modname, node.name)] = fi
+
+    def _link_bases(self):
+        for ci in self.classes.values():
+            mods, names = self.imports[ci.modname]
+            for b in ci.node.bases:
+                bname = None
+                if isinstance(b, ast.Name):
+                    bname = b.id
+                    if bname in names:
+                        bname = names[bname][1]
+                elif isinstance(b, ast.Attribute):
+                    bname = b.attr
+                if bname in self.classes and bname != ci.name:
+                    ci.bases.append(bname)
+                    self.classes[bname].subs.add(ci.name)
+
+    def mro(self, clsname) -> list:
+        """Depth-first project-class linearization, cycle-safe."""
+        if clsname in self._mro_cache:
+            return self._mro_cache[clsname]
+        out, stack, seen = [], [clsname], set()
+        while stack:
+            c = stack.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            out.append(self.classes[c])
+            stack = self.classes[c].bases + stack
+        self._mro_cache[clsname] = out
+        return out
+
+    def _harvest_locks(self):
+        for modname, mod in self.modules.items():
+            # module-level locks
+            for node in mod.tree.body:
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    kind = _ctor_kind(
+                        node.value if node.value is not None else None
+                    )
+                    if kind is None:
+                        continue
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self._declare(
+                                kind, None, t.id, modname, node.lineno,
+                                mod, node.end_lineno,
+                            )
+            # self.<field> = <ctor> anywhere in a class body
+            for cls in ast.walk(mod.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                for node in ast.walk(cls):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    kind = _ctor_kind(
+                        node.value if node.value is not None else None
+                    )
+                    if kind is None:
+                        continue
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            self._declare(
+                                kind, cls.name, t.attr, modname,
+                                node.lineno, mod, node.end_lineno,
+                            )
+
+    def _declare(self, kind, cls, field, modname, lineno, mod,
+                 end_lineno=None):
+        m = None
+        for ln in range(lineno, (end_lineno or lineno) + 1):
+            m = ALIAS_RE.search(mod.line(ln))
+            if m:
+                break
+        if m:
+            identity = m.group(1)
+            self.alias_fields[field] = identity
+        elif cls is not None:
+            identity = f"{cls}.{field}"
+        else:
+            identity = f"{modname}.{field}"
+        if identity not in self.lock_decls:
+            self.lock_decls[identity] = LockDecl(
+                identity, kind, cls, field, modname, lineno
+            )
+        if cls is not None:
+            self.class_fields[(cls, field)] = identity
+            self.field_owners.setdefault(field, set()).add(cls)
+        else:
+            self.module_locks[(modname, field)] = identity
+
+    # -- lock reference resolution ----------------------------------------
+    def resolve_lock(self, expr, modname, clsname, env) -> str | None:
+        """Lock identity of an expression, or None.
+
+        Resolution order: local binding (``env``), ``self.F`` through
+        the MRO, module-level lock, alias-declared field, then a
+        unique-field-name match across all class declarations.
+        """
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                return env[expr.id]
+            return self.module_locks.get((modname, expr.id))
+        if isinstance(expr, ast.Attribute):
+            field = expr.attr
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and clsname
+            ):
+                for ci in self.mro(clsname):
+                    ident = self.class_fields.get((ci.name, field))
+                    if ident:
+                        return ident
+            if field in self.alias_fields:
+                return self.alias_fields[field]
+            owners = self.field_owners.get(field)
+            if owners and len(owners) == 1:
+                return self.class_fields[(next(iter(owners)), field)]
+            # module attribute: <imported module>.NAME
+            if isinstance(expr.value, ast.Name):
+                mods, _ = self.imports.get(modname, ({}, {}))
+                tgt = mods.get(expr.value.id)
+                if tgt:
+                    return self.module_locks.get((tgt, field))
+        return None
+
+    def kind_of(self, identity) -> str:
+        d = self.lock_decls.get(identity)
+        return d.kind if d else "lock"
+
+    # -- call resolution ---------------------------------------------------
+    def resolve_call(self, spec) -> list:
+        """FuncInfo targets of a recorded call spec (may be empty)."""
+        tag = spec[0]
+        if tag == "method":
+            _, cls, m, exact = spec
+            out = []
+            for ci in self.mro(cls):
+                if m in ci.methods:
+                    out.append(ci.methods[m])
+                    break
+            if not exact:
+                # dynamic dispatch: a subclass override may run instead
+                stack = [cls]
+                seen = set()
+                while stack:
+                    c = stack.pop()
+                    if c in seen or c not in self.classes:
+                        continue
+                    seen.add(c)
+                    ci = self.classes[c]
+                    if c != cls and m in ci.methods:
+                        out.append(ci.methods[m])
+                    stack.extend(ci.subs)
+            return out
+        if tag == "func":
+            _, modname, name = spec
+            fi = self.modfuncs.get((modname, name))
+            if fi is not None:
+                return [fi]
+            ci = self.classes.get(name)
+            if ci is not None and ci.modname == modname:
+                return self.resolve_call(("method", name, "__init__", False))
+            return []
+        if tag == "ctor":
+            return self.resolve_call(("method", spec[1], "__init__", False))
+        if tag == "any":
+            # unique-name resolution is restricted to private methods:
+            # public names (append/get/put/span/...) collide with
+            # stdlib container calls on unresolvable receivers, which
+            # is exactly the false-cycle space
+            name = spec[1]
+            if not name.startswith("_") or name.startswith("__"):
+                return []
+            cands = self.methods_by_name.get(name, [])
+            return list(cands) if len(cands) == 1 else []
+        return []
+
+    # -- fixpoints ---------------------------------------------------------
+    def may_acquire(self) -> dict:
+        """key -> {identity: (modname, lineno)} transitively acquirable."""
+        if self._may_acquire is not None:
+            return self._may_acquire
+        ma = {
+            k: {i: (fi.modname, ln) for i, ln in fi.acquires.items()}
+            for k, fi in self.functions.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, fi in self.functions.items():
+                mine = ma[k]
+                for spec, _held, _ln in fi.calls:
+                    for t in self.resolve_call(spec):
+                        for ident, site in ma[t.key].items():
+                            if ident not in mine:
+                                mine[ident] = site
+                                changed = True
+        self._may_acquire = ma
+        return ma
+
+    def may_block(self) -> dict:
+        """key -> {desc: (modname, lineno)} transitively reachable
+        blocking operations."""
+        if self._may_block is not None:
+            return self._may_block
+        mb = {
+            k: {d: (fi.modname, ln) for d, _h, ln in fi.blocking}
+            for k, fi in self.functions.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, fi in self.functions.items():
+                mine = mb[k]
+                for spec, _held, _ln in fi.calls:
+                    for t in self.resolve_call(spec):
+                        for desc, site in mb[t.key].items():
+                            if desc not in mine:
+                                mine[desc] = site
+                                changed = True
+        self._may_block = mb
+        return mb
+
+    def acquire_chain(self, start: "FuncInfo", ident, limit=8) -> list:
+        """Sample call chain from ``start`` to a direct acquisition of
+        ``ident``: ['Qual (mod:line)', ...] ending at the acquire."""
+        ma = self.may_acquire()
+        chain, fi, seen = [], start, set()
+        for _ in range(limit):
+            if fi.key in seen:
+                break
+            seen.add(fi.key)
+            if ident in fi.acquires:
+                chain.append(
+                    f"{fi.qual()} ({fi.modname}:{fi.acquires[ident]})"
+                )
+                return chain
+            nxt = None
+            for spec, _held, ln in fi.calls:
+                for t in self.resolve_call(spec):
+                    if ident in ma.get(t.key, {}):
+                        chain.append(f"{fi.qual()} ({fi.modname}:{ln})")
+                        nxt = t
+                        break
+                if nxt:
+                    break
+            if nxt is None:
+                break
+            fi = nxt
+        return chain
+
+
+class _FuncWalker:
+    """Sequential held-set walk of one function body."""
+
+    def __init__(self, index: ProjectIndex, fi: FuncInfo, env: dict):
+        self.index = index
+        self.fi = fi
+        self.env = dict(env)   # local name -> lock identity
+        self.held: list = []   # [(identity, lineno)] acquisition order
+        self.nested: list = []
+
+    def run(self):
+        self._stmts(self.fi.node.body)
+        for node, env in self.nested:
+            # a closure runs later, from an empty held set, but with
+            # the enclosing function's local lock bindings captured
+            sub = FuncInfo(
+                f"{self.fi.key}.<{node.name}>", node.name, node,
+                self.fi.mod, self.fi.modname, self.fi.cls,
+            )
+            self.index.functions[sub.key] = sub
+            _FuncWalker(self.index, sub, env).run()
+
+    # -- statements --------------------------------------------------------
+    def _stmts(self, body):
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested.append((st, dict(self.env)))
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            n_acq = 0
+            for item in st.items:
+                ident = self._resolve(item.context_expr)
+                if ident is not None and self.index.kind_of(
+                    ident
+                ) in HELD_KINDS:
+                    if self._acquire(ident, item.context_expr.lineno):
+                        n_acq += 1
+                else:
+                    self._scan(item.context_expr)
+            self._stmts(st.body)
+            for _ in range(n_acq):
+                self.held.pop()
+            return
+        if isinstance(st, ast.Try):
+            # sequential: a finally-release correctly clears the held
+            # set for statements after the try
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+            return
+        if isinstance(st, ast.If):
+            self._scan(st.test)
+            self._branch(st.body)
+            self._branch(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            self._scan(st.test)
+            self._branch(st.body)
+            self._branch(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan(st.iter)
+            self._branch(st.body)
+            self._branch(st.orelse)
+            return
+        if isinstance(st, ast.Assign):
+            if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+                ident = self.index.resolve_lock(
+                    st.value, self.fi.modname, self._clsname(), self.env
+                )
+                if ident is not None:
+                    self.env[st.targets[0].id] = ident
+            self._scan(st.value)
+            return
+        # every other statement: scan its expressions
+        self._scan(st)
+
+    def _branch(self, body):
+        """Walk a conditional body; held/env changes don't leak out
+        (an acquire inside one branch is not held after the If)."""
+        held, env = list(self.held), dict(self.env)
+        self._stmts(body)
+        self.held, self.env = held, env
+
+    def _clsname(self):
+        return self.fi.cls.name if self.fi.cls is not None else None
+
+    def _resolve(self, expr):
+        return self.index.resolve_lock(
+            expr, self.fi.modname, self._clsname(), self.env
+        )
+
+    # -- acquisition bookkeeping -------------------------------------------
+    def _acquire(self, ident, lineno) -> bool:
+        kind = self.index.kind_of(ident)
+        if kind not in HELD_KINDS:
+            return False
+        for h, _hl in self.held:
+            if h == ident:
+                if kind not in REENTRANT_KINDS:
+                    self.fi.self_edges.append((ident, lineno))
+            else:
+                self.fi.edges.append((h, ident, lineno))
+        self.fi.acquires.setdefault(ident, lineno)
+        self.held.append((ident, lineno))
+        return True
+
+    def _release(self, ident):
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i][0] == ident:
+                del self.held[i]
+                return
+
+    def _held_tuple(self):
+        return tuple(h for h, _ in self.held)
+
+    # -- expression scan ---------------------------------------------------
+    def _scan(self, node):
+        """Find calls in an expression tree; lambda bodies (deferred
+        execution) are skipped."""
+        if node is None:
+            return
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                continue
+            if isinstance(n, ast.Call):
+                self._call(n)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _call(self, call):
+        f = call.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+
+        # acquire()/release()/enter_context() on a resolvable lock
+        if attr in ("acquire", "release") and isinstance(f, ast.Attribute):
+            ident = self._resolve(f.value)
+            if ident is not None:
+                kind = self.index.kind_of(ident)
+                if kind in HELD_KINDS:
+                    if attr == "acquire":
+                        self._acquire(ident, call.lineno)
+                    else:
+                        self._release(ident)
+                    return
+                if (
+                    kind == "semaphore"
+                    and attr == "acquire"
+                    and not self._has_timeout(call)
+                ):
+                    self._block(
+                        f"semaphore {ident}.acquire() without timeout",
+                        call.lineno,
+                    )
+                return
+        if attr == "enter_context" and call.args:
+            ident = self._resolve(call.args[0])
+            if ident is not None:
+                # ExitStack acquisition: held to end of function scope
+                # (a sound over-approximation of the With's extent)
+                self._acquire(ident, call.lineno)
+                return
+
+        desc = self._blocking_desc(call, attr)
+        if desc is not None:
+            self._block(desc, call.lineno)
+
+        spec = self._callee_spec(call)
+        if spec is not None:
+            self.fi.calls.append((spec, self._held_tuple(), call.lineno))
+
+    def _block(self, desc, lineno):
+        self.fi.blocking.append((desc, self._held_tuple(), lineno))
+
+    @staticmethod
+    def _has_timeout(call) -> bool:
+        return any(k.arg == "timeout" for k in call.keywords)
+
+    @staticmethod
+    def _kw_false(call, name) -> bool:
+        for k in call.keywords:
+            if k.arg == name:
+                return (
+                    isinstance(k.value, ast.Constant)
+                    and k.value.value is False
+                )
+        return False
+
+    def _blocking_desc(self, call, attr) -> str | None:
+        f = call.func
+        fname = f.id if isinstance(f, ast.Name) else attr
+        # device fences: the "drain never hangs" surface
+        if fname in FENCE_NAMES:
+            return f"device fence {fname}()"
+        if attr == "result" and not call.args and not self._has_timeout(
+            call
+        ):
+            return "Future.result() without timeout"
+        if attr in ("get", "put") and isinstance(f, ast.Attribute):
+            ident = self._resolve(f.value)
+            if ident is not None and self.index.kind_of(ident) == "queue":
+                if self._has_timeout(call) or self._kw_false(call, "block"):
+                    return None
+                # positional block=False: get(False) / put(item, False)
+                pos = 0 if attr == "get" else 1
+                if len(call.args) > pos and isinstance(
+                    call.args[pos], ast.Constant
+                ) and call.args[pos].value is False:
+                    return None
+                return f"queue {ident}.{attr}() without timeout"
+        if attr == "wait" and isinstance(f, ast.Attribute):
+            ident = self._resolve(f.value)
+            if ident is not None and self.index.kind_of(ident) in (
+                "condition", "event"
+            ):
+                if call.args or self._has_timeout(call):
+                    return None
+                kind = self.index.kind_of(ident)
+                return f"{kind} {ident}.wait() without timeout"
+        if fname == "sleep" and (
+            isinstance(f, ast.Name)
+            or (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            )
+        ):
+            if call.args and isinstance(call.args[0], ast.Constant):
+                try:
+                    if float(call.args[0].value) < SLEEP_THRESHOLD_S:
+                        return None
+                except (TypeError, ValueError):
+                    pass
+            return "time.sleep() at/above the 0.1 s threshold"
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "subprocess"
+        ):
+            return f"subprocess.{attr}()"
+        if attr == "communicate":
+            return "Popen.communicate()"
+        return None
+
+    def _callee_spec(self, call):
+        f = call.func
+        modname = self.fi.modname
+        mods, names = self.index.imports.get(modname, ({}, {}))
+        if isinstance(f, ast.Name):
+            n = f.id
+            if n in names:
+                src, orig = names[n]
+                if (src, orig) in self.index.modfuncs:
+                    return ("func", src, orig)
+                if orig in self.index.classes:
+                    return ("ctor", orig)
+                return None
+            if (modname, n) in self.index.modfuncs:
+                return ("func", modname, n)
+            ci = self.index.classes.get(n)
+            if ci is not None and ci.modname == modname:
+                return ("ctor", n)
+            return None
+        if isinstance(f, ast.Attribute):
+            cls = self._clsname()
+            if isinstance(f.value, ast.Name):
+                if f.value.id == "self" and cls:
+                    return ("method", cls, f.attr, False)
+                tgt = mods.get(f.value.id)
+                if tgt is not None:
+                    if (tgt, f.attr) in self.index.modfuncs:
+                        return ("func", tgt, f.attr)
+                    ci = self.index.classes.get(f.attr)
+                    if ci is not None and ci.modname == tgt:
+                        return ("ctor", f.attr)
+                    return None
+            if (
+                isinstance(f.value, ast.Call)
+                and isinstance(f.value.func, ast.Name)
+                and f.value.func.id == "super"
+                and cls
+                and self.index.classes.get(cls, ClassInfo("", "", None)).bases
+            ):
+                return (
+                    "method", self.index.classes[cls].bases[0], f.attr, True
+                )
+            return ("any", f.attr)
+        return None
+
+
+# -- cached entry point ----------------------------------------------------
+_CACHE: dict = {}
+
+
+def project_index(pkg_root) -> ProjectIndex:
+    """Build (or reuse) the project index for ``pkg_root``.  Cached on
+    a (path, mtime, size) signature so the three concurrency rules
+    sharing it parse the package once per lint run."""
+    root = Path(pkg_root).resolve()
+    try:
+        sig = tuple(
+            (str(p), p.stat().st_mtime_ns, p.stat().st_size)
+            for p in sorted(root.rglob("*.py"))
+        )
+    except OSError:
+        sig = None
+    cached = _CACHE.get(root)
+    if cached is not None and sig is not None and cached[0] == sig:
+        return cached[1]
+    idx = ProjectIndex(root).build()
+    if sig is not None:
+        _CACHE[root] = (sig, idx)
+        if len(_CACHE) > 8:
+            _CACHE.pop(next(iter(_CACHE)))
+    return idx

@@ -27,9 +27,15 @@ Vocabulary:
   their rules (``Rule.legacy_pragma``).
 
 CLI: ``python -m tools.lint [paths...]`` (default: pint_tpu/), with
-``--json`` (stable: sorted, path-relative), ``--rules``,
-``--baseline`` (default tools/lint/baseline.json), ``--list-rules``.
-Exit status 1 when unbaselined findings exist.  Wired into tier-1 as
+``--json`` (machine-readable: ONE finding per line — rule, path,
+line, message — then a summary line; sorted and path-relative so
+cross-run diffs are stable), ``--rules`` (comma subset, e.g.
+``--rules lockorder,blocking`` for a fast concurrency-only pass),
+``--changed`` (lint only files differing from ``git merge-base HEAD
+main`` — the lightweight pre-test tier; whole-package project checks
+need a package root and are skipped by construction), ``--baseline``
+(default tools/lint/baseline.json), ``--list-rules``.  Exit status 1
+when unbaselined findings exist.  Wired into tier-1 as
 tests/test_lint_framework.py.
 """
 
@@ -205,6 +211,42 @@ def run(paths, rules, project_checks: bool = True) -> list:
     return findings
 
 
+def changed_files(paths, base_ref: str = "main"):
+    """Repo ``.py`` files differing from ``git merge-base HEAD
+    <base_ref>`` (committed or working-tree), filtered to ``paths``.
+    Returns None when git can't answer (no repo, no merge-base) —
+    the caller falls back to a full lint rather than silently
+    linting nothing."""
+    import subprocess
+
+    def _git(*argv):
+        return subprocess.run(
+            ["git", "-C", str(REPO_ROOT), *argv],
+            capture_output=True, text=True, timeout=30,
+        )
+
+    try:
+        mb = _git("merge-base", "HEAD", base_ref)
+        if mb.returncode != 0:
+            return None
+        diff = _git("diff", "--name-only", mb.stdout.strip())
+        if diff.returncode != 0:
+            return None
+    except Exception:
+        return None
+    roots = [Path(p).resolve() for p in paths]
+    out = []
+    for rel in diff.stdout.splitlines():
+        if not rel.endswith(".py"):
+            continue
+        p = (REPO_ROOT / rel).resolve()
+        if not p.is_file():  # deleted since the merge base
+            continue
+        if any(p == r or r in p.parents for r in roots):
+            out.append(p)
+    return out
+
+
 # -- baseline -------------------------------------------------------------
 def load_baseline(path) -> list:
     """Baseline entries: [{"rule", "path", "message"}, ...].  Absent
@@ -249,7 +291,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument("paths", nargs="*", help="files/dirs (default: pint_tpu/)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="stable JSON output (sorted, path-relative)")
+                    help="machine-readable output: one finding per "
+                         "line (rule, path, line, message) + a "
+                         "summary line; sorted, path-relative")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files differing from "
+                         "'git merge-base HEAD main' (the "
+                         "lightweight pre-test tier)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset (default: all)")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
@@ -277,6 +325,13 @@ def main(argv=None) -> int:
         rules = [by_name[n] for n in names]
 
     paths = args.paths or [REPO_ROOT / "pint_tpu"]
+    if args.changed:
+        sel = changed_files(paths)
+        if sel is None:
+            print("--changed: git unavailable, linting full paths",
+                  file=sys.stderr)
+        else:
+            paths = sel
     findings = run(paths, rules,
                    project_checks=not args.no_project_checks)
     new, baselined = apply_baseline(
@@ -284,12 +339,14 @@ def main(argv=None) -> int:
     )
 
     if args.as_json:
+        for f in new:
+            print(json.dumps(f.as_json(), sort_keys=True))
         print(json.dumps({
+            "summary": True,
             "rules": [r.name for r in rules],
             "count": len(new),
             "baselined": len(baselined),
-            "findings": [f.as_json() for f in new],
-        }, indent=2, sort_keys=True))
+        }, sort_keys=True))
     else:
         for f in new:
             print(f)

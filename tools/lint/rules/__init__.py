@@ -13,9 +13,12 @@ from .transport import RULE as TRANSPORT
 from .retrace import RULE as RETRACE
 from .locks import RULE as LOCKS
 from .perf1 import RULE as PERF1
+from .lockorder import RULE as LOCKORDER
+from .blocking import RULE as BLOCKING
 
 ALL_RULES = (
-    SCALARMATH, *OBS_RULES, F64EMU, TRANSPORT, RETRACE, LOCKS, PERF1
+    SCALARMATH, *OBS_RULES, F64EMU, TRANSPORT, RETRACE, LOCKS, PERF1,
+    LOCKORDER, BLOCKING,
 )
 
 

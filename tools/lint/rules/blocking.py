@@ -1,0 +1,101 @@
+"""Rule ``blocking``: blocking operations executed while a lock is held.
+
+The fabric's "drain never hangs" invariant (docs/robustness.md) is
+load-bearing: a replica that blocks indefinitely while holding a lock
+stalls every thread that needs it — the collector can't close groups,
+the prober can't quarantine, ``close()`` never returns.  The historical
+design reviews enforce the pattern by hand (``note_warm`` snapshots
+under ``_alock`` then records outside it; ``_fence_loop`` fences with
+no lock held; ``close()`` shuts the stream executor down after
+dropping ``_streams_lock``).  This rule machine-checks it.
+
+Blocked-operation classes (each with its timeout-present negative):
+
+- ``Future.result()`` without a timeout;
+- ``Queue.get()`` / ``Queue.put()`` on a harvested queue field without
+  ``timeout=`` / ``block=False``;
+- ``Condition.wait()`` / ``Event.wait()`` without a timeout;
+- ``Semaphore.acquire()`` without a timeout;
+- ``time.sleep`` at/above the 0.1 s threshold (non-constant args are
+  assumed above it) and ``subprocess.*`` / ``Popen.communicate()``;
+- device fences: ``guard.fence_owned`` / ``fence_pytree`` /
+  ``block_until_ready`` — an axon tunnel fence is an ~85 ms floor and
+  unbounded under faults, which is exactly when the health machine
+  must be able to take the lock.
+
+An operation is reported only while a *declared* lock identity is held
+(see :mod:`tools.lint.callgraph`): lexically, or interprocedurally —
+holding L and calling a function whose transitive closure reaches a
+blocking operation is the same hazard one hop removed, and the finding
+at the call site names the reached operation and its location.
+
+Suppress a deliberate site (e.g. the warm ledger's synchronous
+cold-warm sidecar write) with ``# lint: ok(blocking)`` plus a
+justifying comment on the operation line (direct) or the call line
+(interprocedural).
+"""
+
+from __future__ import annotations
+
+from ..callgraph import project_index
+from ..engine import Finding, Rule, suppressed
+
+
+class BlockingRule(Rule):
+    """Blocking operation while holding a declared lock ("drain never
+    hangs" made checkable)."""
+
+    name = "blocking"
+
+    def check_project(self, pkg_root) -> list:
+        idx = project_index(pkg_root)
+        mb = idx.may_block()
+        findings = []
+        seen = set()
+        for fi in idx.functions.values():
+            for desc, held, lineno in fi.blocking:
+                if not held or suppressed(self, fi.mod, lineno):
+                    continue
+                key = (fi.key, lineno, desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    self.name, fi.mod.path, lineno,
+                    f"{desc} while holding {self._held(held)} in "
+                    f"{fi.qual()} — a blocked holder stalls every "
+                    "thread needing the lock (the drain-never-hangs "
+                    "invariant); move the operation outside the lock "
+                    "or bound it with a timeout "
+                    "(docs/static_analysis.md)",
+                ))
+            for spec, held, lineno in fi.calls:
+                if not held or suppressed(self, fi.mod, lineno):
+                    continue
+                for target in idx.resolve_call(spec):
+                    for desc, (smod, sline) in mb.get(
+                        target.key, {}
+                    ).items():
+                        key = (fi.key, lineno, desc)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        findings.append(Finding(
+                            self.name, fi.mod.path, lineno,
+                            f"call to {target.qual()}() may block "
+                            f"({desc} at {smod}:{sline}) while "
+                            f"holding {self._held(held)} in "
+                            f"{fi.qual()} — same drain-never-hangs "
+                            "hazard one call away; move the call "
+                            "outside the lock or bound the operation "
+                            "(docs/static_analysis.md)",
+                        ))
+        findings.sort(key=lambda f: (f.path, f.lineno, f.message))
+        return findings
+
+    @staticmethod
+    def _held(held) -> str:
+        return ", ".join(dict.fromkeys(held))
+
+
+RULE = BlockingRule()

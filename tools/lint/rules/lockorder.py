@@ -1,0 +1,171 @@
+"""Rule ``lockorder``: global lock-order cycles = potential deadlocks.
+
+The serving stack is a dozen threaded modules (engine -> batcher ->
+replica/gang pool -> router -> warm ledger -> streams) and nothing on
+the CPU mesh reproduces a deadlock interleaving: two threads that take
+the same two locks in opposite orders run for months before they
+interleave badly, and then the process simply stops — no traceback,
+no test failure, a hung drain.  The PR 5 ``Session.trace_lock`` race
+class was caught by hand; ordering hazards never would be.
+
+This rule builds the whole-program lock-order graph on the
+:mod:`tools.lint.callgraph` index:
+
+- every ``with self.<lock>:`` / ``.acquire()`` nesting contributes a
+  directed edge ``outer -> inner``, *including* nesting reached
+  through calls (method A holds L1 and calls method B which takes L2
+  — resolved through ``self.``-methods, module functions, imports,
+  constructors, subclass overrides, and unique-name attribute calls);
+- identities are ``Class.field`` (MRO-resolved) or ``module.name``;
+  ``# lint: lock-alias(<name>)`` on a declaring line unifies a lock
+  shared across classes (``Session.trace_lock``);
+- any cycle in the graph is reported ONCE, with the witness path for
+  every edge in the cycle (file:line of the inner acquisition, the
+  holding function, and the call chain when the nesting is
+  interprocedural) — both orders a deadlock needs, so the report is
+  actionable without re-deriving the graph by hand;
+- a same-identity nested acquisition of a non-reentrant kind is
+  reported as a self-deadlock candidate (two *instances* of the same
+  class locked in arbitrary order are the classic ABBA on one
+  identity; a deliberate id-ordered protocol gets a justified
+  ``# lint: ok(lockorder)``).
+
+Acyclic edges are the healthy case and are not reported — the rule's
+output is empty on a well-ordered tree.  Suppression: the pragma on
+the line of the *inner* acquisition (direct edges) or the call site
+(interprocedural edges) drops that edge from the graph.
+"""
+
+from __future__ import annotations
+
+from ..callgraph import project_index
+from ..engine import Finding, Rule, suppressed
+
+
+class LockOrderRule(Rule):
+    """Lock-order cycle (potential deadlock) across the project."""
+
+    name = "lockorder"
+
+    def check_project(self, pkg_root) -> list:
+        idx = project_index(pkg_root)
+        ma = idx.may_acquire()
+        # (outer, inner) -> witness dict; first witness wins (stable:
+        # functions iterate in file order)
+        edges: dict = {}
+        findings = []
+        for fi in idx.functions.values():
+            for outer, inner, lineno in fi.edges:
+                if suppressed(self, fi.mod, lineno):
+                    continue
+                edges.setdefault((outer, inner), {
+                    "mod": fi.modname, "line": lineno,
+                    "func": fi.qual(), "chain": None,
+                })
+            for ident, lineno in fi.self_edges:
+                if suppressed(self, fi.mod, lineno):
+                    continue
+                findings.append(Finding(
+                    self.name, fi.mod.path, lineno,
+                    f"nested acquisition of {ident} while already "
+                    f"held in {fi.qual()} — same-identity locks on "
+                    "two instances deadlock when two threads meet in "
+                    "opposite order; impose a deterministic order "
+                    "(e.g. sort by id()) and justify with "
+                    "'# lint: ok(lockorder)', or restructure "
+                    "(docs/static_analysis.md)",
+                ))
+            for spec, held, lineno in fi.calls:
+                if not held or suppressed(self, fi.mod, lineno):
+                    continue
+                for target in idx.resolve_call(spec):
+                    for inner in ma.get(target.key, {}):
+                        for outer in held:
+                            if outer == inner:
+                                continue
+                            if (outer, inner) in edges:
+                                continue
+                            chain = idx.acquire_chain(target, inner)
+                            edges[(outer, inner)] = {
+                                "mod": fi.modname, "line": lineno,
+                                "func": fi.qual(),
+                                "chain": chain or None,
+                            }
+        findings.extend(self._cycles(idx, edges))
+        findings.sort(key=lambda f: (f.path, f.lineno, f.message))
+        return findings
+
+    # -- cycle detection ---------------------------------------------------
+    def _cycles(self, idx, edges) -> list:
+        adj: dict = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles = set()
+        findings = []
+        for start in sorted(adj):
+            cyc = self._find_cycle(adj, start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            # normalize rotation for a stable report
+            i = cyc.index(min(cyc))
+            cyc = cyc[i:] + cyc[:i]
+            legs = []
+            first = None
+            for j, a in enumerate(cyc):
+                b = cyc[(j + 1) % len(cyc)]
+                w = edges[(a, b)]
+                leg = (
+                    f"{a} -> {b} at {w['mod']}:{w['line']} "
+                    f"in {w['func']}"
+                )
+                if w["chain"]:
+                    leg += " via " + " -> ".join(w["chain"])
+                legs.append(leg)
+                if first is None:
+                    first = w
+            mod = idx.modules.get(first["mod"])
+            path = mod.path if mod is not None else first["mod"]
+            findings.append(Finding(
+                self.name, path, first["line"],
+                "potential deadlock: lock-order cycle "
+                + " -> ".join(cyc + [cyc[0]])
+                + " — witness paths: " + "; ".join(legs)
+                + " (two threads traversing different legs "
+                "concurrently deadlock; pick one global order, see "
+                "docs/static_analysis.md)",
+            ))
+        return findings
+
+    @staticmethod
+    def _find_cycle(adj, start):
+        """DFS from ``start``; returns node list of a cycle through
+        ``start`` or None."""
+        stack = [(start, iter(sorted(adj.get(start, ()))))]
+        path = [start]
+        on_path = {start}
+        visited = set()
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt == start:
+                    return list(path)
+                if nxt in on_path or nxt in visited:
+                    continue
+                stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                path.append(nxt)
+                on_path.add(nxt)
+                advanced = True
+                break
+            if not advanced:
+                stack.pop()
+                visited.add(path.pop())
+                on_path.discard(node)
+        return None
+
+
+RULE = LockOrderRule()

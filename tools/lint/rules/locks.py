@@ -22,11 +22,23 @@ is declared and machine-checked instead:
 - reads are NOT checked — the codebase deliberately does lock-free
   GIL-atomic reads of health/depth fields (serve/fabric/replica.py).
 
-This is a syntactic race detector: it cannot see locks taken by a
-caller at runtime, so the two annotations above are the escape for
-intentional designs — and a mutation with neither annotation nor a
-``with`` is exactly the PR 5 bug class.  Suppress a single site with
-``# lint: ok(locks)`` plus a justifying comment.
+The per-module half is a syntactic race detector: it cannot see locks
+taken by a caller at runtime, so the two annotations above are the
+escape for intentional designs — and a mutation with neither
+annotation nor a ``with`` is exactly the PR 5 bug class.  Suppress a
+single site with ``# lint: ok(locks)`` plus a justifying comment.
+
+Since ISSUE 15 the annotations are *verified*, not trusted: the
+project-wide half (``check_project``, on the
+:mod:`tools.lint.callgraph` index) checks every resolvable call site
+of a ``*_locked`` / ``# lint: holds(...)`` method and reports any
+caller that does not actually hold the declared locks — lexically,
+through its own caller-holds contract (``_route_locked`` calling
+``_usable_locked`` chains), or through the MRO (a ``GangReplica``
+method holding ``Replica._state_lock``).  ``__init__`` callers are
+exempt (no concurrent readers during construction), and call sites
+whose receiver cannot be resolved (a non-``self`` attribute call with
+a non-unique method name) are skipped rather than guessed.
 """
 
 from __future__ import annotations
@@ -34,7 +46,8 @@ from __future__ import annotations
 import ast
 import re
 
-from ..engine import Finding, Module, Rule
+from ..callgraph import project_index
+from ..engine import Finding, Module, Rule, suppressed
 
 GUARD_RE = re.compile(r"lint:\s*guarded-by\((\w+)\)")
 HOLDS_RE = re.compile(r"lint:\s*holds\((\w+(?:\s*,\s*\w+)*)\)")
@@ -184,6 +197,83 @@ class LocksRule(Rule):
                         "(docs/static_analysis.md)",
                     ))
         return findings
+
+    # -- caller-holds verification (ISSUE 15) ------------------------------
+    def check_project(self, pkg_root) -> list:
+        """Verify every resolvable call site of a caller-holds method
+        actually holds the declared locks."""
+        idx = project_index(pkg_root)
+        required = self._required_map(idx)
+        findings = []
+        seen = set()
+        for fi in idx.functions.values():
+            if fi.name == "__init__":
+                continue  # no concurrent readers during construction
+            granted = required.get(fi.key, frozenset())
+            for spec, held, lineno in fi.calls:
+                for target in idx.resolve_call(spec):
+                    need = required.get(target.key)
+                    if not need:
+                        continue
+                    missing = need - set(held) - granted
+                    if not missing:
+                        continue
+                    key = (fi.key, lineno, target.key)
+                    if key in seen or suppressed(self, fi.mod, lineno):
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        self.name, fi.mod.path, lineno,
+                        f"call to {target.qual()}() without holding "
+                        f"{', '.join(sorted(missing))} — the method "
+                        "declares a caller-holds contract (*_locked "
+                        "suffix / # lint: holds(...)) and this call "
+                        "site does not satisfy it; wrap the call in "
+                        "'with self.<lock>:' or propagate the "
+                        "contract to the caller "
+                        "(docs/static_analysis.md)",
+                    ))
+        findings.sort(key=lambda f: (f.path, f.lineno, f.message))
+        return findings
+
+    def _required_map(self, idx) -> dict:
+        """FuncInfo.key -> frozenset of required lock identities, for
+        every class method carrying a caller-holds contract."""
+        out = {}
+        for fi in idx.functions.values():
+            if fi.cls is None:
+                continue
+            names: set = set()
+            m = HOLDS_RE.search(fi.mod.line(fi.node.lineno))
+            if m:
+                names = {s.strip() for s in m.group(1).split(",")}
+            elif fi.name.endswith("_locked"):
+                guarded = self._declared_mro(idx, fi)
+                names = set(guarded.values())
+            if not names:
+                continue
+            idents = set()
+            for name in names:
+                for ci in idx.mro(fi.cls.name):
+                    ident = idx.class_fields.get((ci.name, name))
+                    if ident:
+                        idents.add(ident)
+                        break
+            if idents:
+                out[fi.key] = frozenset(idents)
+        return out
+
+    def _declared_mro(self, idx, fi) -> dict:
+        """guarded-by declarations visible to ``fi`` through the MRO
+        (a GangReplica ``*_locked`` method holds Replica's locks)."""
+        guarded: dict = {}
+        for ci in idx.mro(fi.cls.name):
+            mod = idx.modules.get(ci.modname)
+            if mod is None or ci.node is None:
+                continue
+            for field, lock in self._declared(mod, ci.node).items():
+                guarded.setdefault(field, lock)
+        return guarded
 
 
 RULE = LocksRule()

@@ -1,38 +1,19 @@
-"""Back-compat shim: the obs linter now lives in the unified
-framework as rules ``obs1``-``obs5`` (tools/lint/rules/obs.py;
-docs/static_analysis.md).  This entry point keeps the historical CLI
-and the ``lint_source``/``lint_paths``/``check_chokepoints`` API,
-finding-for-finding."""
-
-from __future__ import annotations
+"""Retired entry point (ISSUE 15) — the obs rules live in the pintlint
+framework; run ``python -m tools.lint --rules obs1,...,obs9`` or just
+``python -m tools.lint`` (docs/static_analysis.md).  The old
+``lint_source``/``lint_paths``/``check_chokepoints`` API moved to
+``tools/lint/rules/obs.py``.  This file is a deprecation forwarder."""
 
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from lint.rules.obs import (  # noqa: E402,F401
-    check_chokepoints,
-    lint_paths,
-    lint_source,
-)
-
-SUPPRESS_PRAGMA = "lint: obs-ok"
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    pkg = Path(__file__).resolve().parent.parent / "pint_tpu"
-    findings = lint_paths(argv or [pkg])
-    if not argv:
-        findings += check_chokepoints(pkg)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} obs-bypass finding(s)")
-        return 1
-    return 0
-
+OBS_RULES = "obs1,obs2,obs3,obs4,obs5,obs6,obs7,obs8,obs9"
 
 if __name__ == "__main__":
-    sys.exit(main())
+    print(f"tools/lint_obs.py is retired; use `python -m tools.lint "
+          f"--rules {OBS_RULES}` (or plain `python -m tools.lint`)",
+          file=sys.stderr)
+    from lint.engine import main
+    sys.exit(main([*sys.argv[1:], "--rules", OBS_RULES]))

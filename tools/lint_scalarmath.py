@@ -1,36 +1,18 @@
-"""Back-compat shim: the scalar-transcendental linter now lives in
-the unified framework as rule ``scalarmath`` (tools/lint/rules/
-scalarmath.py; docs/static_analysis.md).  This entry point keeps the
-historical CLI and the ``lint_source``/``lint_paths`` API,
-finding-for-finding."""
-
-from __future__ import annotations
+"""Retired entry point (ISSUE 15) — the scalar-transcendental rule
+lives in the pintlint framework; run ``python -m tools.lint --rules
+scalarmath`` or just ``python -m tools.lint`` (docs/static_analysis
+.md).  The old ``lint_source``/``lint_paths`` API moved to
+``tools/lint/rules/scalarmath.py``.  This file is a deprecation
+forwarder."""
 
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from lint.rules.scalarmath import (  # noqa: E402,F401
-    HAZARD_FUNCS,
-    lint_paths,
-    lint_source,
-)
-
-SUPPRESS_PRAGMA = "lint: scalar-ok"
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    paths = argv or [Path(__file__).resolve().parent.parent / "pint_tpu"]
-    findings = lint_paths(paths)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} scalar-transcendental finding(s)")
-        return 1
-    return 0
-
-
 if __name__ == "__main__":
-    sys.exit(main())
+    print("tools/lint_scalarmath.py is retired; use `python -m "
+          "tools.lint --rules scalarmath` (or plain `python -m "
+          "tools.lint`)", file=sys.stderr)
+    from lint.engine import main
+    sys.exit(main([*sys.argv[1:], "--rules", "scalarmath"]))
