@@ -1016,6 +1016,161 @@ def _serve_block():
             ),
         }
 
+    # elastic probe (ISSUE 16): online gang/single repartition on a
+    # LIVE engine.  Each flip runs ReplicaPool.repartition with a wave
+    # of requests in flight: the incoming partition pre-warms from the
+    # warm ledger, the outgoing one retires through the DRAINING fence
+    # (queued work re-routes, nothing drops), and steady traffic on
+    # the new partition must run trace-free.  Gated: zero lost
+    # futures, zero steady traces after each flip, zero fresh
+    # persistent-cache executables across the measured cycle.
+    def _elastic_probe():
+        import os as _os
+        import tempfile
+
+        from pint_tpu.parallel.mesh import serving_devices
+        from pint_tpu.runtime import compile_cache
+        from pint_tpu.serve import ResidualsRequest
+
+        ndev = len(serving_devices())
+        if ndev < 3:  # a gang of 2 + at least one single
+            return {"skipped": f"needs >= 3 devices, have {ndev}"}
+
+        bm, btoas = make_test_pulsar(
+            "PSR EBIG\nF0 312.5 1\nF1 -2.1e-15 1\nPEPOCH 55000\n"
+            "DM 17.3 1\n", ntoa=600,  # 1024 bucket: gang-classified
+            start_mjd=53000.0, end_mjd=57000.0, seed=61,
+            iterations=1,
+        )
+        bpar = bm.as_parfile()
+        spar, stoas = pulsars[0]
+        lpath = _os.path.join(
+            tempfile.mkdtemp(prefix="pint-tpu-bench-elastic-"),
+            "warm-ledger.json",
+        )
+
+        def smalls(n):
+            return [ResidualsRequest(par=spar, toas=stoas)
+                    for _ in range(n)]
+
+        def bigs(n):
+            return [ResidualsRequest(par=bpar, toas=btoas)
+                    for _ in range(n)]
+
+        offered = completed = 0
+
+        def resolve(futs):
+            nonlocal offered, completed
+            offered += len(futs)
+            for f in futs:
+                try:
+                    f.result(timeout=3600)
+                    completed += 1
+                except Exception:
+                    pass
+
+        tr = obs_metrics.counter("compile.traces")
+        # max_batch=1 pins every kernel at capacity 1 and the steady
+        # windows submit one key class at a time: no batching or
+        # fusion freedom — the probe measures reshape mechanics only
+        eng = TimingEngine(
+            max_batch=1, max_wait_ms=1.0, inflight=1, max_queue=256,
+            replicas=min(4, ndev), gangs=1, gang_size=2,
+            gang_threshold=512, warm_ledger=lpath,
+        )
+        # deterministic persistent-cache writes: with the default
+        # 0.2 s floor, whether a borderline compile is WRITTEN is
+        # timing-dependent, and the measured cycle's zero-new-entries
+        # gate needs the warm flips' writes to be complete
+        min_s_prior = jax.config.jax_persistent_cache_min_compile_time_secs
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+        try:
+            for _ in range(2):  # warm both classes via the router
+                resolve([*map(eng.submit, smalls(2) + bigs(2))])
+            # warm FLIP cycle: the persistent cache keys per
+            # (program, device assignment) — the first time a
+            # partition shape exists, its executors' ledger prewarm
+            # legitimately compiles first-ever pairs.  One full
+            # dissolve+reform populates every pair BOTH shapes use;
+            # the measured cycle repeats identical pairs, all hits.
+            eng.pool.repartition(gangs=0)
+            resolve([*map(eng.submit, smalls(2) + bigs(1))])
+            eng.pool.repartition(gangs=1, gang_size=2)
+            resolve([*map(eng.submit, smalls(2) + bigs(1))])
+            if offered != completed:
+                raise PintTpuError(
+                    f"{offered - completed} request(s) lost during "
+                    "the elastic warm-up flips — a reshape dropped "
+                    "in-flight work (serve/fabric/pool.py::"
+                    "repartition; docs/robustness.md)"
+                )
+
+            xla0 = compile_cache.entry_count()
+            # dissolve with a small-key wave in flight
+            futs = [*map(eng.submit, smalls(4))]
+            dissolve_s = eng.pool.repartition(gangs=0)
+            resolve(futs)
+            t0 = tr.value
+            resolve([*map(eng.submit, smalls(2))])
+            resolve([*map(eng.submit, bigs(1))])
+            dissolve_traces = tr.value - t0
+            # re-form with a big-key wave in flight
+            futs = [*map(eng.submit, bigs(2))]
+            reform_s = eng.pool.repartition(gangs=1, gang_size=2)
+            resolve(futs)
+            t0 = tr.value
+            resolve([*map(eng.submit, bigs(1))])
+            resolve([*map(eng.submit, smalls(2))])
+            reform_traces = tr.value - t0
+            xla1 = compile_cache.entry_count()
+            est = eng.stats()["elastic"]
+        finally:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                min_s_prior,
+            )
+            eng.close()
+        lost = offered - completed
+        if lost:
+            raise PintTpuError(
+                f"{lost} request(s) lost across the elastic reshape "
+                "cycle — every future in flight during a repartition "
+                "must resolve (serve/fabric/pool.py::repartition; "
+                "docs/robustness.md)"
+            )
+        if dissolve_traces or reform_traces:
+            raise PintTpuError(
+                f"{dissolve_traces} (post-dissolve) + {reform_traces} "
+                "(post-reform) steady trace(s) — a reshape must hand "
+                "traffic to a fully pre-warmed partition "
+                "(warm-ledger replay in pool.repartition; "
+                "docs/robustness.md 'elastic fleet')"
+            )
+        xla_new = (
+            None if xla0 is None or xla1 is None else xla1 - xla0
+        )
+        if xla_new not in (None, 0):
+            raise PintTpuError(
+                f"{xla_new} fresh persistent-cache executable(s) "
+                "written during the measured elastic cycle — after "
+                "one warm flip cycle every (program, device "
+                "assignment) pair must be a compile-cache HIT "
+                "(runtime/compile_cache.py; docs/robustness.md)"
+            )
+        return {
+            "devices": ndev,
+            "dissolve_s": round(dissolve_s, 3),
+            "reform_s": round(reform_s, 3),
+            "reshape_s": round(max(dissolve_s, reform_s), 3),
+            "lost": lost,
+            "steady_traces": dissolve_traces + reform_traces,
+            "xla_new_entries": xla_new,
+            "reshapes": est["reshapes"],
+            "partition": est["partition"],
+        }
+
     # SLO probe (ISSUE 11): deadline-aware batch close + the per
     # -composition admission quota.  Leg 1: a near-deadline request in
     # an otherwise-idle engine with a LONG max-wait must be flushed at
@@ -1299,6 +1454,7 @@ def _serve_block():
     restart = _restart_probe()
     slo = _slo_probe()
     xkey = _xkey_probe()
+    elastic = _elastic_probe()
 
     r1_rps, r1_rec, _r1_occ, _ = _replica_rung(1)
     r4_rps, r4_rec, r4_occ, r4_fab = _replica_rung(4)
@@ -1353,6 +1509,7 @@ def _serve_block():
         "restart": restart,
         "slo": slo,
         "xkey": xkey,
+        "elastic": elastic,
         "replicas": st["fabric"]["replicas"],
         "replica_occupancy": {
             tag: rs["batches"]

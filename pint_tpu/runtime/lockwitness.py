@@ -48,7 +48,7 @@ from contextlib import contextmanager
 
 __all__ = [
     "wrap", "enable", "disable", "armed", "enabled", "installed",
-    "violations", "violation_count", "reset",
+    "violations", "violation_count", "reset", "lock_id",
 ]
 
 _env_on = os.environ.get("PINT_TPU_LOCK_WITNESS", "") not in ("", "0")
@@ -60,6 +60,20 @@ _graph_lock = threading.Lock()
 _edges: dict = {}        # (outer, inner) -> first-witness record
 _violations: list = []
 _reported: set = set()   # dedupe key per violation class/pair
+
+
+def lock_id(obj) -> int:
+    """Canonical identity of a possibly-witnessed lock: the RAW lock's
+    id().  The witness records and compares ``id(self._lock)`` — the
+    underlying lock — so any ascending-id acquisition protocol over
+    same-identity locks (Replica._fused_kernel_for) MUST sort by this,
+    not ``id(obj)``: when wrap() returned proxies, proxy-id order and
+    raw-id order disagree nondeterministically and an id(obj) sort
+    intermittently acquires in what the witness sees as DESCENDING
+    order."""
+    if isinstance(obj, WitnessLock):
+        return id(obj._lock)
+    return id(obj)
 
 
 def installed() -> bool:

@@ -120,7 +120,8 @@ class TimingEngine:
                  max_sessions=None, replicas=None, affinity=None,
                  quarantine_n=None, probe_ms=None, gangs=None,
                  gang_size=None, gang_threshold=None, quota=None,
-                 slo_close_ms=None, warm_ledger=None, prewarm=True):
+                 slo_close_ms=None, warm_ledger=None, prewarm=True,
+                 elastic=None):
         from pint_tpu.serve import warm_ledger as wlmod
 
         env = os.environ.get
@@ -195,6 +196,14 @@ class TimingEngine:
         # or per device SUBSET for gang executors (ISSUE 10) — plus
         # the size-classifying affinity router (serve/fabric/)
         gang_threshold = gang_threshold_fn(gang_threshold)
+        # warm-restart ledger (ISSUE 11): created BEFORE the pool so
+        # the pool's reshape-time replayer closure (ISSUE 16) resolves
+        # jobs from it when a repartition builds fresh executors
+        self._ledger = None
+        path = wlmod.ledger_path(warm_ledger)
+        if path is not None:
+            self._ledger = wlmod.WarmLedger(path)
+            wlmod.register(self._ledger)
         self.pool = ReplicaPool(
             replicas=replicas,
             inflight=max(1, self.inflight),
@@ -208,6 +217,7 @@ class TimingEngine:
             requeue=self._requeue,
             finisher=self._finish_batch,
             validator=self._validate_batch,
+            replayer=self._replay_jobs,
         )
         if affinity is None:
             affinity = int(env("PINT_TPU_SERVE_AFFINITY", "0"))
@@ -215,6 +225,9 @@ class TimingEngine:
             self.pool, affinity=affinity or None,
             gang_threshold_toas=gang_threshold,
         )
+        # the pool purges the router's sticky placements after each
+        # repartition swap (serve/fabric/pool.py::repartition)
+        self.pool.router = self.router
         m = obs_metrics
         self._m_requests = m.counter("serve.requests")
         self._m_completed = m.counter("serve.completed")
@@ -229,23 +242,30 @@ class TimingEngine:
         self._m_depth = m.gauge("serve.queue_depth")
         self._m_quota = m.counter("serve.quota_rejected")
         self._m_slo_close = m.counter("serve.slo.early_close")
-        # warm-restart ledger (ISSUE 11): register for write-through
-        # and REPLAY it before the collector exists — prewarm_kernel's
-        # boot-thread safety contract (serve/fabric/replica.py)
-        self._ledger = None
-        path = wlmod.ledger_path(warm_ledger)
-        if path is not None:
-            self._ledger = wlmod.WarmLedger(path)
-            wlmod.register(self._ledger)
-            if prewarm:
-                with TRACER.span(
-                    "serve:warm-replay", "serve", path=path,
-                ):
-                    jobs = wlmod.replay_jobs(
-                        self._ledger, self.sessions, self.max_batch
-                    )
-                    if jobs:
-                        self.pool.prewarm(jobs)
+        # warm-ledger boot REPLAY (ISSUE 11) before the collector
+        # exists — prewarm_kernel's boot-thread safety contract
+        # (serve/fabric/replica.py)
+        if self._ledger is not None and prewarm:
+            with TRACER.span(
+                "serve:warm-replay", "serve", path=path,
+            ):
+                jobs = self._replay_jobs()
+                if jobs:
+                    self.pool.prewarm(jobs)
+        # elastic repartitioner (ISSUE 16): load-driven online
+        # gang/single reshaping — off unless opted in (env
+        # PINT_TPU_SERVE_ELASTIC or the `elastic` kwarg; a dict passes
+        # tuning straight to the Repartitioner)
+        self._elastic = None
+        if elastic is None:
+            elastic = env("PINT_TPU_SERVE_ELASTIC", "0") != "0"
+        if elastic:
+            from pint_tpu.serve.fabric.elastic import Repartitioner
+
+            ekw = dict(elastic) if isinstance(elastic, dict) else {}
+            self._elastic = Repartitioner(
+                self.pool, self.router, **ekw
+            )
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True,
             name="pint-tpu-serve collector",
@@ -834,6 +854,18 @@ class TimingEngine:
         with self._lat_lock:
             self._latencies.append(lat_ms)
 
+    def _replay_jobs(self) -> list:
+        """Resolve the warm ledger into pre-warm jobs — the boot
+        replay and the pool's reshape-time prewarm both draw from
+        here ([] when no ledger is configured)."""
+        from pint_tpu.serve import warm_ledger as wlmod
+
+        if self._ledger is None:
+            return []
+        return wlmod.replay_jobs(
+            self._ledger, self.sessions, self.max_batch
+        )
+
     # -- stats / lifecycle -------------------------------------------------
     def stats(self) -> dict:
         """One-look serving telemetry (bench.py's serve block and the
@@ -908,6 +940,19 @@ class TimingEngine:
                 "failed": mc("serve.warm.failed").value,
                 "stale": mc("serve.warm.stale").value,
             },
+            # elastic fabric (ISSUE 16): online repartition accounting
+            "elastic": {
+                "enabled": self._elastic is not None,
+                "reshapes": self.pool.reshapes,
+                "formed": mc("serve.elastic.formed").value,
+                "dissolved": mc("serve.elastic.dissolved").value,
+                "failed": mc("serve.elastic.failed").value,
+                "epoch": self.router.epoch,
+                "partition": {
+                    "gangs": len(self.pool.gangs),
+                    "singles": len(self.pool.singles),
+                },
+            },
             # O(append) streaming (ISSUE 14): which fallback rung
             # served each absorbed tail (docs/serving.md)
             "stream": {
@@ -938,6 +983,11 @@ class TimingEngine:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
+        # the elastic watcher stops FIRST so no reshape starts while
+        # the pool drains (an in-flight one serializes with drain on
+        # the pool's _reshape_lock)
+        if self._elastic is not None:
+            self._elastic.stop()
         self._collector.join(timeout)
         self.pool.drain(timeout)
         with self._streams_lock:

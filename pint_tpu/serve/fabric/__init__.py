@@ -31,6 +31,13 @@ not one global dispatch loop):
   singles; the router classifies groups by TOA bucket against the
   gang threshold.
 
+- :mod:`pint_tpu.serve.fabric.elastic` — the online repartitioner
+  (ISSUE 16): watches the router's per-window demand signals and
+  reshapes the gang/single partition through
+  ``ReplicaPool.repartition`` — a drain-fenced (DRAINING state),
+  warm-ledger-prewarmed executor swap with zero lost requests and
+  zero fresh XLA compiles.
+
 Env knobs: ``PINT_TPU_SERVE_REPLICAS`` (pool width; 0 = all local
 devices), ``PINT_TPU_SERVE_AFFINITY`` (max replicas per session
 group; 0 = pool width), ``PINT_TPU_SERVE_QUARANTINE_N`` (consecutive
@@ -53,6 +60,7 @@ from pint_tpu.serve.fabric.pool import ReplicaPool
 from pint_tpu.serve.fabric.replica import (
     DEGRADED,
     DRAINED,
+    DRAINING,
     LIVE,
     QUARANTINED,
     BatchWork,
@@ -67,6 +75,7 @@ __all__ = [
     "BatchWork",
     "DEGRADED",
     "DRAINED",
+    "DRAINING",
     "FusedBatch",
     "GangReplica",
     "LIVE",

@@ -1,0 +1,204 @@
+"""Elastic repartitioner: load-driven online gang/single reshaping.
+
+Reference parity: none — TPU-service infrastructure (ISSUE 16).  The
+gang/single partition (fabric/gang.py, ISSUE 10) is sized for ONE
+load shape; a flip — a wave of big-bucket full-span fits arriving at
+an all-singles pool, or small-key floods hammering singles while a
+gang sits idle — either strands capacity or saturates one class while
+the other idles.  The :class:`Repartitioner` watches the Router's
+capacity-weighted demand signals (``Router.take_demand()``: per
+-window big/small routing counts plus how much work was served OUT of
+its preferred size class) and reshapes the pool through
+``ReplicaPool.repartition`` — the drain-fenced, warm-ledger-prewarmed
+swap that costs zero fresh XLA compiles and zero lost requests
+(serve/fabric/pool.py module docstring has the sequence).
+
+Decision rules, evaluated once per ``window_ms`` tick:
+
+- **form a gang** when big-class work routed out of class
+  (``big_on_single > 0`` — no usable gang held it) or every gang is
+  saturated under big pressure, AND the device budget allows one more
+  gang while keeping ``min_singles`` singles;
+- **dissolve a gang** when small-class pressure is the only traffic
+  (``small > 0`` and ``big == 0``) and every gang is IDLE
+  (outstanding 0) — the gang's devices serve the small flood better
+  as singles;
+- **hysteresis**: a desire must persist for ``hysteresis``
+  CONSECUTIVE windows before acting, and the streak resets after
+  every reshape — the pool converges instead of thrashing between
+  shapes on a noisy boundary load.
+
+A reshape failure (e.g. the pool drained mid-tick during shutdown) is
+counted and swallowed — the watcher thread must outlive any single
+reshape, and the engine's ``close()`` stops it deterministically.
+
+Env knobs (``TimingEngine`` kwargs override):
+
+- ``PINT_TPU_SERVE_ELASTIC`` — enable the watcher (default off; the
+  manual ``pool.repartition(gangs=...)`` API works either way);
+- ``PINT_TPU_SERVE_ELASTIC_WINDOW_MS`` — tick cadence (default 100);
+- ``PINT_TPU_SERVE_ELASTIC_HYSTERESIS`` — consecutive same-desire
+  windows before a reshape (default 3);
+- ``PINT_TPU_SERVE_ELASTIC_MIN_SINGLES`` — singles floor a formed
+  gang must not break (default 1; 0 allows an all-gang pool);
+- ``PINT_TPU_SERVE_ELASTIC_GANG_SIZE`` — width of a formed gang
+  (default 2, the smallest real gang).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.trace import TRACER
+from pint_tpu.serve.fabric.router import _saturated
+
+
+class Repartitioner:
+    """Background load watcher driving ``ReplicaPool.repartition``."""
+
+    def __init__(self, pool, router, *, window_ms: float | None = None,
+                 hysteresis: int | None = None,
+                 min_singles: int | None = None,
+                 gang_size: int | None = None):
+        env = os.environ.get
+        if window_ms is None:
+            window_ms = float(
+                env("PINT_TPU_SERVE_ELASTIC_WINDOW_MS", "100")
+            )
+        if hysteresis is None:
+            hysteresis = int(
+                env("PINT_TPU_SERVE_ELASTIC_HYSTERESIS", "3")
+            )
+        if min_singles is None:
+            min_singles = int(
+                env("PINT_TPU_SERVE_ELASTIC_MIN_SINGLES", "1")
+            )
+        if gang_size is None:
+            gang_size = int(
+                env("PINT_TPU_SERVE_ELASTIC_GANG_SIZE", "2")
+            )
+        self.pool = pool
+        self.router = router
+        self.window_s = max(0.005, float(window_ms) / 1e3)
+        self.hysteresis = max(1, int(hysteresis))
+        self.min_singles = max(0, int(min_singles))
+        self.gang_size = max(2, int(gang_size))
+        # watcher-thread-only decision state
+        self._desire = None
+        self._streak = 0
+        self._stop_ev = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch_loop, daemon=True,
+            name="pint-tpu-elastic repartitioner",
+        )
+        self._thread.start()
+
+    # -- decision ----------------------------------------------------------
+    def _classes(self) -> tuple:
+        """The non-draining gang/single split (draining executors are
+        mid-retirement — counting them would double the capacity a
+        reshape is already replacing)."""
+        reps = [r for r in self.pool.replicas if not r.draining]
+        gangs = [r for r in reps if r.width > 1]
+        singles = [r for r in reps if r.width == 1]
+        return gangs, singles
+
+    def _can_form(self, ngang: int) -> bool:
+        """One more gang of ``gang_size`` must fit the device budget
+        while keeping the singles floor."""
+        ndev = len(self.pool._devices)
+        need = (ngang + 1) * self.gang_size
+        return ndev - need >= self.min_singles
+
+    def _desired(self, demand: dict) -> str | None:
+        gangs, _singles = self._classes()
+        big_pressure = (
+            demand["big_on_single"] > 0
+            or (demand["big"] > 0 and gangs
+                and all(_saturated(g) for g in gangs))
+        )
+        if big_pressure and self._can_form(len(gangs)):
+            return "form"
+        if (demand["small"] > 0 and demand["big"] == 0 and gangs
+                and all(g.outstanding == 0 for g in gangs)):
+            return "dissolve"
+        return None
+
+    def _tick(self):
+        demand = self.router.take_demand()
+        desire = self._desired(demand)
+        if desire is None or desire != self._desire:
+            self._desire = desire
+            self._streak = 1 if desire else 0
+            return
+        self._streak += 1
+        if self._streak < self.hysteresis:
+            return
+        self._desire, self._streak = None, 0
+        self._reshape(desire)
+
+    # -- acting ------------------------------------------------------------
+    def _reshape(self, desire: str):
+        """Execute one load-driven reshape (pintlint rule obs10 pins
+        this chokepoint: span + per-direction counters around the
+        repartition entry)."""
+        gangs, _ = self._classes()
+        ngang = len(gangs) + (1 if desire == "form" else -1)
+        if ngang < 0:
+            return
+        with TRACER.span(
+            "elastic:reshape", "fabric", desire=desire, gangs=ngang,
+            gang_size=self.gang_size,
+        ):
+            try:
+                dt = self.pool.repartition(
+                    gangs=ngang, gang_size=self.gang_size,
+                )
+            except BaseException as e:
+                obs_metrics.counter("serve.elastic.failed").inc()
+                TRACER.event(
+                    "elastic-failed", "fabric", desire=desire,
+                    error=repr(e),
+                )
+                return
+        obs_metrics.counter(
+            "serve.elastic.formed" if desire == "form"
+            else "serve.elastic.dissolved"
+        ).inc()
+        TRACER.event(
+            "elastic", "fabric", desire=desire, gangs=ngang,
+            ms=round(dt * 1e3, 1),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def _watch_loop(self):
+        while not self._stop_ev.wait(self.window_s):
+            try:
+                self._tick()
+            except BaseException as e:
+                obs_metrics.counter("serve.elastic.failed").inc()
+                TRACER.event(
+                    "elastic-failed", "fabric", error=repr(e)
+                )
+
+    def stop(self, timeout: float = 10.0):
+        self._stop_ev.set()
+        self._thread.join(timeout)
+
+    def stats(self) -> dict:
+        return {
+            "window_ms": round(self.window_s * 1e3, 1),
+            "hysteresis": self.hysteresis,
+            "min_singles": self.min_singles,
+            "gang_size": self.gang_size,
+            "reshapes": self.pool.reshapes,
+            "formed": obs_metrics.counter(
+                "serve.elastic.formed"
+            ).value,
+            "dissolved": obs_metrics.counter(
+                "serve.elastic.dissolved"
+            ).value,
+            "epoch": getattr(self.router, "epoch", 0),
+        }

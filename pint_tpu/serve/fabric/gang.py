@@ -101,9 +101,12 @@ class GangReplica(Replica):
             raise ValueError(
                 f"GangReplica needs >= 2 devices, got {len(members)}"
             )
-        # gang membership is fixed at construction and read by the
-        # dispatcher/fencer/prober threads; any future membership
-        # mutation (resize, member eviction) must hold the health lock
+        # gang membership is fixed for the executor's LIFETIME and
+        # read by the dispatcher/fencer/prober threads: elastic
+        # reshaping (ISSUE 16, pool.repartition) swaps whole
+        # executors — it never mutates a live gang's member set; any
+        # future in-place mutation (resize, member eviction) must
+        # hold the health lock
         self._members = members  # lint: guarded-by(_state_lock)
         self.mesh = gang_mesh(members)  # lint: guarded-by(_state_lock)
         # (row, replicated) NamedShardings, built lazily at the
@@ -162,6 +165,16 @@ class GangReplica(Replica):
             bucket=bucket, shards=self.width, cap=work.cap,
         ):
             return tree_util.tree_map(place, work.ops)
+
+    def _fusible(self, work: BatchWork) -> bool:
+        """Sharded dispatches never cross-key fuse: a shard-mode
+        member's operand leaves commit with a mesh ``NamedSharding``
+        over the whole gang while solo members commit whole to the
+        lead device, and one fused jit cannot take argument trees
+        committed to different device sets (XLA rejects the dispatch
+        with an incompatible-devices error).  Solo-mode work fuses
+        exactly like a width-1 replica."""
+        return (not self._wants_shard(work)) and super()._fusible(work)
 
     def _kernel_cache_key(self, work: BatchWork) -> tuple:
         """Per-gang kernel cache key: (group key, capacity, gang

@@ -10,6 +10,37 @@ drain-on-shutdown contract: in-flight batches fence, queued requests
 complete or shed as typed ``RequestRejected(reason='shutdown')`` —
 never hang.
 
+Online repartition (ISSUE 16, serve/fabric/elastic.py drives it): the
+gang/single partition is no longer frozen at boot.
+:meth:`ReplicaPool.repartition` reshapes the pool under live traffic
+as a fault-safe sequence that reuses the existing fencing:
+
+1. build the NEW executors over the full device set (fresh monotonic
+   rids + tags, so stale ``excluded``/placement state can never alias
+   a new executor);
+2. bring them up HOT by replaying their placement class from the warm
+   ledger (``replayer`` -> :meth:`prewarm` targeted at the new set) —
+   every post-reshape kernel lands as a persistent-XLA-cache hit;
+3. atomically publish the COMBINED old+new pool (plain list store,
+   GIL-atomic; the router keeps routing the whole time — there is
+   never a window with zero usable executors, so zero requests are
+   lost to ``no-replica`` sheds);
+4. fence the old executors with ``begin_drain`` (DRAINING: the router
+   stops placing, outstanding work resolves or re-routes bounded by
+   pool width — in-flight futures are NEVER dropped), poll them idle,
+   retire them with ``drain``;
+5. atomically publish the new partition alone and purge the router's
+   sticky placements of retired rids (``Router.purge`` bumps the
+   routing epoch so stale placements re-resolve).
+
+The whole sequence holds ``_reshape_lock`` — one reshape at a time,
+and :meth:`drain` (engine shutdown) serializes behind an in-flight
+reshape instead of racing it.  The lock is leaf-ordered: it is only
+ever taken first (reshape/drain entry points), never while holding
+another fabric lock, so the verified lock-order graph stays acyclic
+(``ReplicaPool._reshape_lock -> Replica._state_lock -> Replica._cond``
+etc.; tools/lint/rules/lockorder.py).
+
 Env knobs (constructor kwargs override):
 
 - ``PINT_TPU_SERVE_REPLICAS`` — pool width (0/unset = every local
@@ -33,7 +64,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
+from pint_tpu.exceptions import PintTpuError
 from pint_tpu.obs import metrics as obs_metrics
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.parallel.mesh import serving_devices
@@ -55,7 +88,8 @@ class ReplicaPool:
                  probe_interval_s: float | None = None,
                  gangs: int | None = None, gang_size: int | None = None,
                  gang_threshold: int | None = None,
-                 requeue=None, finisher=None, validator=None):
+                 requeue=None, finisher=None, validator=None,
+                 replayer=None):
         env = os.environ.get
         if replicas is None:
             replicas = int(env("PINT_TPU_SERVE_REPLICAS", "0"))
@@ -70,43 +104,77 @@ class ReplicaPool:
         if gang_size is None:
             gang_size = int(env("PINT_TPU_SERVE_GANG_SIZE", "0"))
         self.probe_interval_s = max(0.01, float(probe_interval_s))
-        devices = serving_devices(replicas or None)
-        kw = dict(
+        self._devices = tuple(serving_devices(replicas or None))
+        self._gang_threshold = gang_threshold
+        self._kw = dict(
             inflight=inflight, quarantine_n=quarantine_n,
             requeue=requeue, finisher=finisher, validator=validator,
         )
-        # mixed-pool partition (ISSUE 10): the FIRST gangs*gang_size
-        # devices form gang executors, the remainder stay singles
-        self.replicas = []
-        ngang = max(0, int(gangs))
-        if ngang:
-            if gang_size <= 0:
-                gang_size = max(2, len(devices) // ngang)
-            take = 0
-            for g in range(ngang):
-                members = devices[take:take + gang_size]
-                if len(members) < 2:
-                    break  # too few devices left for a real gang
-                self.replicas.append(GangReplica(
-                    len(self.replicas), members, tag=f"g{g}",
-                    shard_threshold=gang_threshold, **kw,
-                ))
-                take += len(members)
-            devices = devices[take:]
-        base = len(self.replicas)
-        self.replicas.extend(
-            Replica(base + j, d, tag=f"r{j}", **kw)
-            for j, d in enumerate(devices)
-        )
+        # warm-ledger job source for reshape-time prewarm (the engine
+        # wires its replay closure here; None = reshapes come up cold)
+        self._replayer = replayer
+        # the engine's Router registers itself here so repartition can
+        # purge retired rids from the sticky placements (duck-typed:
+        # anything with .purge(live_rids))
+        self.router = None
+        # monotonic id/tag allocators: a retired executor's rid or tag
+        # is never reused within one pool lifetime (stale excluded
+        # sets, placements, and per-tag telemetry can't alias a new
+        # executor).  The INITIAL partition starts both at zero, so
+        # the boot pool keeps the historical g0../r0.. tags with
+        # rid == list index.
+        self._next_rid = 0
+        self._gtag = 0
+        self._rtag = 0
+        self.reshapes = 0  # completed repartitions (stats)
+        self.replicas = self._build_partition(gangs, gang_size)
         self._cond = lockwitness.wrap(
             threading.Condition(), "ReplicaPool._cond"
         )
         self._stop = False  # lint: guarded-by(_cond)
+        self._reshape_lock = lockwitness.wrap(
+            threading.Lock(), "ReplicaPool._reshape_lock"
+        )
+        self._drained = False  # lint: guarded-by(_reshape_lock)
         self._prober = threading.Thread(
             target=self._probe_loop, daemon=True,
             name="pint-tpu-fabric prober",
         )
         self._prober.start()
+
+    def _build_partition(self, gangs: int, gang_size: int) -> list:
+        """Construct one gang/single partition over the pool's device
+        set with freshly allocated rids and tags (mixed-pool split,
+        ISSUE 10): the first ``gangs x gang_size`` devices form gang
+        executors, the remainder stay singles.  Used by the
+        constructor and by :meth:`repartition` — executors themselves
+        are immutable; reshaping swaps whole executors."""
+        devices = list(self._devices)
+        out = []
+        ngang = max(0, int(gangs))
+        if ngang:
+            if gang_size <= 0:
+                gang_size = max(2, len(devices) // ngang)
+            take = 0
+            for _ in range(ngang):
+                members = devices[take:take + gang_size]
+                if len(members) < 2:
+                    break  # too few devices left for a real gang
+                out.append(GangReplica(
+                    self._next_rid, members, tag=f"g{self._gtag}",
+                    shard_threshold=self._gang_threshold, **self._kw,
+                ))
+                self._next_rid += 1
+                self._gtag += 1
+                take += len(members)
+            devices = devices[take:]
+        for d in devices:
+            out.append(Replica(
+                self._next_rid, d, tag=f"r{self._rtag}", **self._kw,
+            ))
+            self._next_rid += 1
+            self._rtag += 1
+        return out
 
     @property
     def size(self) -> int:
@@ -131,7 +199,77 @@ class ReplicaPool:
         ]
 
     def replica(self, rid: int) -> Replica:
-        return self.replicas[rid]
+        """Lookup by rid.  Rids are monotonic across repartitions, so
+        this is a scan, not an index (the boot partition still has
+        rid == position; a reshaped pool does not)."""
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no executor with rid {rid} in the pool")
+
+    # -- online repartition (ISSUE 16) --------------------------------------
+    def repartition(self, *, gangs: int, gang_size: int | None = None,
+                    timeout: float = 120.0) -> float:
+        """Reshape the gang/single partition under live traffic
+        (module docstring has the five-step sequence; pintlint rule
+        obs10 pins this chokepoint).  Blocks until the old executors
+        are retired; returns the reshape wall-clock seconds.  Manual
+        operator/test API — serve/fabric/elastic.py::Repartitioner
+        calls it from the load signals."""
+        if gang_size is None:
+            gang_size = 0
+        t0 = time.perf_counter()
+        with self._reshape_lock:
+            if self._drained:
+                raise PintTpuError(
+                    "repartition on a drained pool — the engine is "
+                    "shutting down"
+                )
+            old = list(self.replicas)
+            with TRACER.span(
+                "pool:repartition", "fabric", gangs=int(gangs),
+                gang_size=int(gang_size), olds=len(old),
+            ):
+                new = self._build_partition(gangs, gang_size)
+                # bring the new executors up hot BEFORE any traffic
+                # can reach them: replay their placement classes from
+                # the warm ledger so every post-reshape kernel is a
+                # persistent-XLA-cache hit (prewarm_kernel's
+                # never-routed-yet safety contract holds — the new
+                # executors are unpublished)
+                jobs = self._replayer() if self._replayer else []
+                if jobs:
+                    self.prewarm(jobs, replicas=new)
+                # publish the COMBINED pool first, THEN fence the old
+                # executors: routing always sees a usable executor, so
+                # the reshape can never shed a request as no-replica
+                self.replicas = old + new
+                for r in old:
+                    r.begin_drain()
+                deadline = time.monotonic() + timeout
+                for r in old:
+                    # outstanding work resolves or re-routes (the
+                    # DRAINING fence + note_failure's flush); bounded
+                    # sub-0.1s poll ticks (tools/lint/rules/blocking.py)
+                    while (r.outstanding
+                           and time.monotonic() < deadline):
+                        time.sleep(0.02)
+                for r in old:
+                    r.drain(timeout)
+                self.replicas = new
+                if self.router is not None:
+                    self.router.purge({r.rid for r in new})
+            self.reshapes += 1
+        dt = time.perf_counter() - t0
+        obs_metrics.counter("serve.elastic.reshapes").inc()
+        obs_metrics.histogram("serve.elastic.reshape_ms").observe(
+            dt * 1e3
+        )
+        TRACER.event(
+            "repartition", "fabric", gangs=int(gangs),
+            new=[r.tag for r in self.replicas], ms=round(dt * 1e3, 1),
+        )
+        return dt
 
     # -- the canary prober -------------------------------------------------
     def _probe_loop(self):
@@ -171,27 +309,36 @@ class ReplicaPool:
                         r.note_failure("probe")
 
     # -- warm-restart replay (ISSUE 11) ------------------------------------
-    def prewarm(self, jobs: list) -> int:
+    def prewarm(self, jobs: list, replicas: list | None = None) -> int:
         """Boot-time warm-ledger replay chokepoint (pintlint rule
         obs8): dispatch each resolved pre-warm job — a synthetic
         zero-member BatchWork plus its recorded placement classes —
         through EVERY executor of each class (``gang``/``single``;
-        whole-pool fallback when a recorded class has no executor in
-        the restarted topology), so the kernel caches every replica
+        whole-set fallback when a recorded class has no executor in
+        the target topology), so the kernel caches every replica
         would have built under the prior traffic mix are re-populated
-        from the persistent XLA compile cache before the collector
-        starts.  MUST be called from the engine constructor, before
-        the collector thread exists — Replica.prewarm_kernel's
-        boot-thread safety contract.  Per-(job, replica) failures are
-        counted (``serve.warm.failed``) and skipped: replay is
-        best-effort, a bad entry costs warmth, never a boot."""
+        from the persistent XLA compile cache before traffic arrives.
+        ``replicas`` narrows the target set (the repartition path
+        warms ONLY the freshly built, not-yet-published executors);
+        the default whole-pool form MUST be called from the engine
+        constructor, before the collector thread exists —
+        Replica.prewarm_kernel's never-routed-yet safety contract.
+        Per-(job, replica) failures are counted (``serve.warm.failed``)
+        and skipped: replay is best-effort, a bad entry costs warmth,
+        never a boot."""
+        pool_set = (
+            list(self.replicas) if replicas is None else list(replicas)
+        )
         warmed = 0
         for work, placements in jobs:
             targets, seen = [], set()
             for placement in placements:
-                cls = self.gangs if placement == "gang" else self.singles
+                cls = [
+                    r for r in pool_set
+                    if (r.width > 1) == (placement == "gang")
+                ]
                 if not cls:
-                    cls = self.replicas
+                    cls = pool_set
                 for r in cls:
                     if r.rid not in seen:
                         seen.add(r.rid)
@@ -231,10 +378,14 @@ class ReplicaPool:
 
     def drain(self, timeout: float = 120.0):
         """Stop the prober, then drain every replica (queued work
-        completes or sheds typed; threads join)."""
+        completes or sheds typed; threads join).  Serializes behind an
+        in-flight repartition — a shutdown mid-reshape waits for the
+        reshape's bounded completion instead of racing its swaps."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
         self._prober.join(5.0)
-        for r in self.replicas:
-            r.drain(timeout)
+        with self._reshape_lock:
+            self._drained = True
+            for r in self.replicas:
+                r.drain(timeout)

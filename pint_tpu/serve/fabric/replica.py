@@ -23,6 +23,14 @@ health state machine driven by the runtime/guard.py outcomes:
     pool's background canary probe (a small guarded dispatch on the
     same device, so injected/real faults keep failing it) re-admits
     it once the device answers sanely again;
+``→ DRAINING``
+    reshape fencing state (ISSUE 16, serve/fabric/elastic.py): the
+    router stops placing NEW work here, the dispatcher keeps running
+    until the queue empties (outstanding work resolves, or re-routes
+    on failure bounded by pool width — in-flight futures are never
+    dropped), and the pool's repartition machinery then retires the
+    executor with :meth:`drain`.  Entered via :meth:`begin_drain`;
+    never transitions back to serving states.
 ``→ DRAINED``
     terminal shutdown state: in-flight batches fence, queued work
     completes (or sheds as typed RequestRejected) — never hangs.
@@ -106,6 +114,7 @@ from pint_tpu.runtime.guard import (
 LIVE = "LIVE"
 DEGRADED = "DEGRADED"
 QUARANTINED = "QUARANTINED"
+DRAINING = "DRAINING"
 DRAINED = "DRAINED"
 
 
@@ -654,6 +663,41 @@ class Replica:
             out = kernel(*ops)  # compiles (disk-cache hit) + runs
             tree_util.tree_map(np.asarray, out)  # fence
 
+    def prewarm_fused(self, works: list) -> bool:
+        """Pre-warm ONE cross-key fused combo wrapper off the member
+        batches' already-traced solo programs (ISSUE 16 satellite: the
+        chaos sweep warms every fusible combo during the warmup
+        window, so the legal first-seen-combo compile can never leak
+        into a steady measurement).  Computes the sorted-identity
+        combo exactly as :meth:`_fuse` would and dispatches one fused
+        call through ``_fused_kernel_for`` + ``_place_flat``.  Returns
+        False (no-op) when fusion is disabled or fewer than two
+        members were given.  Caller contract: the executor must be
+        QUIESCENT (``outstanding == 0`` — the dispatcher parked in its
+        cond-wait), the same reasoning that makes ``prewarm_kernel``'s
+        boot-thread writes to the dispatcher-owned ``_kernels`` dict
+        safe."""
+        if not self._xkey_on or len(works) < 2:
+            return False
+        if not all(self._fusible(w) for w in works):
+            # mirror _fuse's eligibility exactly — on a gang this
+            # refuses shard-mode members (GangReplica._fusible), whose
+            # mesh-committed operands cannot share a jit with lead
+            # -device solo members
+            return False
+        ident = self._kernel_cache_key
+        order = sorted(works, key=lambda w: repr(ident(w)))
+        combo = ("xkey",) + tuple(ident(w) for w in order)
+        with TRACER.span(
+            "replica:prewarm", "fabric", replica=self.tag,
+            op="xkey", members=len(order),
+        ):
+            kernel = self._fused_kernel_for(combo, order)
+            flat = self._place_flat(order)
+            out = kernel(*flat)  # compiles (disk-cache hit) + runs
+            tree_util.tree_map(np.asarray, out)  # fence
+        return True
+
     def _run(self, work: BatchWork):
         work = self._shed_late(work)
         if work is None:
@@ -718,9 +762,13 @@ class Replica:
             inner = smod.build_fused_kernel(
                 [(w.session, w.key) for w in members], site
             )
+            # Sort by the RAW lock's identity (lockwitness.lock_id),
+            # not id() of the possibly-witness-wrapped proxy: the
+            # witness compares raw ids, and proxy-id order disagrees
+            # with raw-id order nondeterministically.
             locks = sorted(
-                {id(w.session.trace_lock): w.session.trace_lock
-                 for w in members}.items()
+                {lockwitness.lock_id(w.session.trace_lock):
+                 w.session.trace_lock for w in members}.items()
             )
             traced = [False]
 
@@ -942,21 +990,32 @@ class Replica:
     def note_failure(self, kind: str, err: BaseException = None):
         """One guard-class batch failure: LIVE degrades immediately;
         ``quarantine_n`` consecutive failures quarantine (queued work
-        is handed back to the router)."""
+        is handed back to the router).  A DRAINING executor keeps its
+        state (the reshape fence owns the lifecycle — no transitions
+        back to serving states, none forward to QUARANTINED either)
+        but flushes its queue back to the router immediately, so a
+        fault mid-drain hands work to the new partition instead of
+        serializing one failing dispatch per queued batch."""
         flush = []
         with self._state_lock:
             if self._state == DRAINED:
                 return
-            self._consecutive += 1
-            if self._state == LIVE:
-                self._set_state(DEGRADED, kind=kind)
-            if (self._consecutive >= self.quarantine_n
-                    and self._state != QUARANTINED):
-                self._set_state(QUARANTINED, kind=kind)
+            if self._state == DRAINING:
                 with self._cond:
                     while self._queue:
                         flush.append(self._queue.popleft())
                     self._cond.notify_all()
+            else:
+                self._consecutive += 1
+                if self._state == LIVE:
+                    self._set_state(DEGRADED, kind=kind)
+                if (self._consecutive >= self.quarantine_n
+                        and self._state != QUARANTINED):
+                    self._set_state(QUARANTINED, kind=kind)
+                    with self._cond:
+                        while self._queue:
+                            flush.append(self._queue.popleft())
+                        self._cond.notify_all()
         for w in flush:
             self._batch_leaves(w)
             self._requeue(w, self)
@@ -1011,6 +1070,24 @@ class Replica:
             return False
 
     # -- lifecycle ---------------------------------------------------------
+    def begin_drain(self):
+        """Enter the DRAINING fence (ISSUE 16): the router stops
+        placing here (``_usable_locked`` skips draining executors),
+        ``submit`` refuses new work, and the dispatcher keeps running
+        until the queue empties — outstanding futures resolve normally
+        or re-route on failure, never drop.  Non-blocking: the caller
+        (``ReplicaPool.repartition``) polls ``outstanding`` and then
+        calls :meth:`drain` to retire the executor.  Idempotent; the
+        _state_lock -> _cond nesting matches the verified
+        ``note_failure`` edge."""
+        with self._state_lock:
+            if self._state in (DRAINING, DRAINED):
+                return
+            self._set_state(DRAINING, kind="reshape")
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+
     def drain(self, timeout: float = 60.0):
         """Stop accepting, finish (or re-route/shed) queued work,
         fence in-flight batches, join both threads."""

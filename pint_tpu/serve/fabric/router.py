@@ -53,6 +53,15 @@ and co-resident different-key batches become fusible instead of
 scattering one-per-device.  Spill under saturation is unchanged, so
 the heuristic trades nothing under load; big groups and the
 fusion-off hatch keep the pure least-loaded placement.
+
+Elastic signals (ISSUE 16): every routing decision also accumulates a
+per-window demand record — big vs small class, and whether the work
+was served OUT of its preferred class (big on a single, small on a
+gang).  ``take_demand()`` drains it; serve/fabric/elastic.py's
+repartitioner turns sustained out-of-class pressure into a pool
+reshape.  After a reshape, ``purge(live_rids)`` drops retired rids
+from the sticky placements and bumps the routing ``epoch`` so stale
+placements re-resolve against the new partition.
 """
 
 from __future__ import annotations
@@ -105,6 +114,21 @@ class Router:
         self._placements: dict = {}  # group key -> [rid, ...]; lint: guarded-by(_lock)
         self._rotor: dict = {}  # round-robin counters; lint: guarded-by(_lock)
         self._lock = lockwitness.wrap(threading.Lock(), "Router._lock")
+        # routing epoch: bumped by purge() after a repartition swaps
+        # the pool, so observers can tell stale placements re-resolved
+        # against the new executor set (ISSUE 16).  Reads are bare
+        # (GIL-atomic int) for stats.
+        self.epoch = 0  # lint: guarded-by(_lock)
+        # per-window demand signals for the elastic repartitioner
+        # (serve/fabric/elastic.py): how much big/small-class work
+        # routed, and how much of it was served OUT of its preferred
+        # size class (big work on a single = a gang is missing or
+        # unusable; small work on a gang = singles are missing) —
+        # drained atomically by take_demand()
+        self._demand = {
+            "big": 0, "small": 0, "big_on_single": 0,
+            "small_on_gang": 0,
+        }  # lint: guarded-by(_lock)
         self._m_routes = obs_metrics.counter("serve.fabric.routes")
         self._m_spills = obs_metrics.counter("serve.fabric.spills")
 
@@ -123,6 +147,7 @@ class Router:
         ):
             with self._lock:
                 rep = self._route_locked(work.key, set(exclude))
+                self._note_demand_locked(work.key, rep)
             self._m_routes.inc()
             if rep is not None:
                 TRACER.annotate(replica=rep.tag)
@@ -220,6 +245,53 @@ class Router:
         self._rotor[key] = i + 1
         return tied[i % len(tied)]
 
+    def _note_demand_locked(self, key, rep) -> None:
+        """Accumulate the elastic load signals for one routing
+        decision (lint: holds(_lock) — called from route())."""
+        big = self._is_big(key)
+        self._demand["big" if big else "small"] += 1
+        if rep is None:
+            return
+        on_gang = _width(rep) > 1
+        if big and not on_gang:
+            self._demand["big_on_single"] += 1
+        elif not big and on_gang:
+            self._demand["small_on_gang"] += 1
+
+    def take_demand(self) -> dict:
+        """Drain the per-window demand counters (the repartitioner's
+        tick reads-and-resets, so each window's signal is
+        independent)."""
+        with self._lock:
+            d = dict(self._demand)
+            for k in self._demand:
+                self._demand[k] = 0
+        return d
+
+    def purge(self, live_rids: set) -> None:
+        """Post-repartition placement purge (ISSUE 16, pintlint rule
+        obs10): drop retired executors' rids from every sticky
+        placement (groups left empty re-place cold on the new
+        partition — their kernels are already prewarmed there, so the
+        re-placement costs routing only) and bump the routing
+        epoch."""
+        live_rids = set(live_rids)
+        with self._lock:
+            dead = []
+            for k, rids in self._placements.items():
+                rids[:] = [rid for rid in rids if rid in live_rids]
+                if not rids:
+                    dead.append(k)
+            for k in dead:
+                del self._placements[k]
+                self._rotor.pop(k, None)
+            self.epoch += 1
+            epoch = self.epoch
+        TRACER.event(
+            "router-purge", "fabric", epoch=epoch,
+            groups_dropped=len(dead),
+        )
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -228,4 +300,5 @@ class Router:
                     len(v) for v in self._placements.values()
                 ),
                 "gang_threshold": self.gang_threshold,
+                "epoch": self.epoch,
             }

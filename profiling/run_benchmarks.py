@@ -437,7 +437,8 @@ def main():
                     default=["1", "2", "3", "3b", "4", "4b", "5", "5b",
                              "6", "7", "7b", "serve",
                              "serve_replicas", "serve_population",
-                             "serve_gang", "dispatch_floor", "chaos",
+                             "serve_gang", "serve_elastic",
+                             "dispatch_floor", "chaos",
                              "mfu", "streaming"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
@@ -477,6 +478,21 @@ def main():
                 "serve_gang": gang_sweep,
             }[str(c)]()
             for row in rows:
+                print(json.dumps(row))
+            continue
+        if str(c) == "serve_elastic":
+            # online repartition ladder: dissolve+reform a live mixed
+            # pool with 0/4/16 requests in flight -> reshape seconds,
+            # zero lost futures, zero steady traces, zero fresh XLA
+            # entries, plus the demand-driven Repartitioner row
+            # (ISSUE 16; profiling/serve_elastic.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from serve_elastic import elastic_rows
+
+            for row in elastic_rows():
                 print(json.dumps(row))
             continue
         if str(c) == "chaos":

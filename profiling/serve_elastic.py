@@ -1,0 +1,199 @@
+"""Elastic repartition ladder (ISSUE 16).
+
+Sweeps the in-flight load a live gang/single repartition has to carry
+through the drain fence: per rung, a warmed mixed pool (one 2-wide
+gang + singles) dissolves to all-singles and re-forms with ``wave``
+requests in flight, reporting the reshape latency of each direction
+(``ReplicaPool.repartition`` wall seconds — ledger prewarm of the
+incoming partition + drain-fenced retirement of the outgoing one),
+lost futures (must be 0 — the DRAINING fence re-routes queued work),
+the steady-state trace count right after each flip (must be 0 — the
+new executors come up warm from the ledger replay), and fresh
+persistent-cache executables across the measured cycle (0 once the
+warm flip cycle has populated every (program, device assignment)
+pair both partition shapes use).
+
+A final ``demand`` row exercises the load-DRIVEN path: an all-singles
+pool with the :class:`~pint_tpu.serve.fabric.elastic.Repartitioner`
+watching router demand absorbs sustained gang-class traffic and the
+row reports the time until the watcher forms the gang on its own.
+
+The pool topology needs >= 3 serving devices (a 2-wide gang + one
+single); below that every row is the explicit ``skipped`` shape.
+``max_batch=1`` pins every kernel at capacity 1 so batching/fusion
+freedom cannot blur the reshape signal (the bench.py ``elastic``
+probe gates the same invariants; this ladder sweeps the load axis).
+
+Usage: ``python profiling/serve_elastic.py`` (one JSON line per
+rung), or via ``python profiling/run_benchmarks.py --configs
+serve_elastic``.  Workflow: docs/robustness.md "elastic fleet".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+SMALL_PAR = (
+    "PSR ELAS\nF0 131.25 1\nF1 -2e-15 1\nPEPOCH 55000\n"
+    "DM 6.10 1\n"
+)
+BIG_PAR = (
+    "PSR ELAB\nF0 293.5 1\nF1 -2.4e-15 1\nPEPOCH 55000\n"
+    "DM 19.8 1\n"
+)
+
+
+def elastic_rows(waves=(0, 4, 16), timeout: float = 600.0):
+    """Yield one result row per in-flight wave rung + the demand row."""
+    import jax
+
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.parallel.mesh import serving_devices
+    from pint_tpu.runtime import compile_cache
+    from pint_tpu.serve import ResidualsRequest, TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    backend = jax.default_backend()
+    ndev = len(serving_devices(None))
+    if ndev < 3:
+        yield {
+            "bench": "serve_elastic", "backend": backend,
+            "skipped": f"needs >= 3 serving devices, have {ndev}",
+        }
+        return
+
+    sm, stoas = make_test_pulsar(
+        SMALL_PAR, ntoa=160, start_mjd=54000.0, end_mjd=56000.0,
+        seed=71, iterations=1,
+    )
+    bm, btoas = make_test_pulsar(
+        BIG_PAR, ntoa=600,  # 1024 bucket: gang-classified at 512
+        start_mjd=53000.0, end_mjd=57000.0, seed=72, iterations=1,
+    )
+    spar, bpar = sm.as_parfile(), bm.as_parfile()
+
+    def smalls(eng, n):
+        return [eng.submit(ResidualsRequest(par=spar, toas=stoas))
+                for _ in range(n)]
+
+    def bigs(eng, n):
+        return [eng.submit(ResidualsRequest(par=bpar, toas=btoas))
+                for _ in range(n)]
+
+    def resolve(futs):
+        lost = 0
+        for f in futs:
+            try:
+                f.result(timeout=timeout)
+            except Exception:
+                lost += 1
+        return lost
+
+    tr = obs_metrics.counter("compile.traces")
+    lpath = os.path.join(
+        tempfile.mkdtemp(prefix="pint-tpu-serve-elastic-"),
+        "warm-ledger.json",
+    )
+    eng = TimingEngine(
+        max_batch=1, max_wait_ms=1.0, inflight=1, max_queue=256,
+        replicas=min(4, ndev), gangs=1, gang_size=2,
+        gang_threshold=512, warm_ledger=lpath,
+    )
+    # deterministic persistent-cache writes: the default 0.2 s floor
+    # makes WRITING a borderline compile timing-dependent, and the
+    # zero-new-entries column needs the warm flips' writes complete
+    min_s_prior = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        for _ in range(2):  # warm both classes through the router
+            lost = resolve(smalls(eng, 2) + bigs(eng, 2))
+            assert lost == 0, "warm-up traffic failed"
+        # warm FLIP cycle: first-ever (program, device assignment)
+        # pairs compile legitimately; one dissolve+reform populates
+        # every pair both partition shapes use
+        eng.pool.repartition(gangs=0)
+        resolve(smalls(eng, 2) + bigs(eng, 1))
+        eng.pool.repartition(gangs=1, gang_size=2)
+        resolve(smalls(eng, 2) + bigs(eng, 1))
+
+        for wave in waves:
+            xla0 = compile_cache.entry_count()
+            futs = smalls(eng, wave)
+            dissolve_s = eng.pool.repartition(gangs=0)
+            lost = resolve(futs)
+            t0 = tr.value
+            lost += resolve(smalls(eng, 2))
+            lost += resolve(bigs(eng, 1))
+            dis_traces = tr.value - t0
+            futs = bigs(eng, min(wave, 4)) + smalls(
+                eng, max(0, wave - 4))
+            reform_s = eng.pool.repartition(gangs=1, gang_size=2)
+            lost += resolve(futs)
+            t0 = tr.value
+            lost += resolve(bigs(eng, 1))
+            lost += resolve(smalls(eng, 2))
+            ref_traces = tr.value - t0
+            xla1 = compile_cache.entry_count()
+            yield {
+                "bench": "serve_elastic", "backend": backend,
+                "devices": ndev, "wave": wave,
+                "dissolve_s": round(dissolve_s, 3),
+                "reform_s": round(reform_s, 3),
+                "lost": lost,
+                "steady_traces": dis_traces + ref_traces,
+                "xla_new_entries": (
+                    None if xla0 is None or xla1 is None
+                    else xla1 - xla0
+                ),
+                "reshapes": eng.pool.reshapes,
+                "ok": bool(
+                    lost == 0 and dis_traces + ref_traces == 0
+                ),
+            }
+    finally:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_s_prior,
+        )
+        eng.close()
+
+    # demand-driven row: all-singles pool + the Repartitioner watching
+    # router demand; sustained gang-class load must form the gang
+    # without any manual repartition call
+    deng = TimingEngine(
+        max_batch=1, max_wait_ms=1.0, inflight=1, max_queue=256,
+        replicas=min(4, ndev), gangs=0, gang_threshold=512,
+        warm_ledger=lpath,
+        elastic=dict(window_ms=40, hysteresis=2, gang_size=2),
+    )
+    try:
+        resolve(smalls(deng, 2) + bigs(deng, 2))  # warm
+        t0 = time.perf_counter()
+        adapt_s = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and adapt_s is None:
+            resolve(bigs(deng, 4))
+            if deng.pool.reshapes >= 1:
+                adapt_s = time.perf_counter() - t0
+        est = deng.stats()["elastic"]
+        yield {
+            "bench": "serve_elastic", "backend": backend,
+            "devices": ndev, "demand": True,
+            "adapt_s": None if adapt_s is None else round(adapt_s, 3),
+            "reshapes": deng.pool.reshapes,
+            "partition": est["partition"],
+            "ok": adapt_s is not None,
+        }
+    finally:
+        deng.close()
+
+
+def main():
+    for row in elastic_rows():
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,12 @@ kill-and-restart warm-ledger leg:
   dispatch sites of a live ObserveSession — every append resolves
   typed through the fallback ladder, and the stream recovers the
   incremental path once the fault clears;
+- the repartition legs (ISSUE 16) flip the gang/single partition
+  while each fault kind fires on the executor being retired — the
+  reshape completes bounded, futures stay typed, and steady traffic
+  on the new partition runs trace-free — plus kill-mid-reshape:
+  engine ``close()`` racing ``pool.repartition`` serializes on the
+  reshape lock and the next generation replays to warmth;
 - the restart leg kills an engine mid-wave (orphans typed), then
   replays the ledger with zero fresh XLA compiles;
 - :func:`tools.chaos.classify` buckets outcomes strictly by TYPE —
@@ -60,6 +66,8 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
     legs = {(leg["tag"], leg["kind"]): leg for leg in report["legs"]}
     assert set(legs) == {
         ("r0", "nan"), ("r0", "413"), ("r1", "nan"), ("r1", "413"),
+        ("reshape", "nan"), ("reshape", "413"),
+        ("reshape", "kill-mid-reshape"),
         ("stream", "append-faults"), ("restart", "kill-restart"),
     }
     for leg in report["legs"]:
@@ -86,6 +94,20 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
         assert rnd["faulted"]["typed"] and rnd["after"]["typed"]
         assert rnd["clean_traces"] == 0
         assert rnd["recovered_incremental"]
+    # the repartition legs (ISSUE 16): fault-mid-drain reshapes
+    # complete bounded with typed futures and a trace-free steady
+    # window on the new partition; each leg flips the partition, so
+    # the two fault legs alternate singles -> gang -> singles
+    for kind in ("nan", "413"):
+        rl = legs[("reshape", kind)]
+        assert rl["fired"] > 0 and rl["reshapes"] == 1
+        assert rl["outcomes"]["typed"] and rl["steady"]["typed"]
+        assert rl["steady"]["completed"] == rl["steady"]["offered"]
+        assert rl["steady_traces"] == 0
+        assert rl["steady_retraces"] == 0
+    mid = legs[("reshape", "kill-mid-reshape")]
+    assert mid["reshape_done"] and mid["killed_typed"]
+    assert mid["replayed"] >= 1 and mid["fresh_traces"] == 0
     restart = legs[("restart", "kill-restart")]
     assert restart["killed_typed"] and restart["replayed"] >= 1
     assert restart["fresh_traces"] == 0
@@ -121,9 +143,10 @@ def test_time_budget_reports_skipped_legs_explicitly(monkeypatch):
         kinds=("413",), npsr=2, replicas=2, gangs=0, restart=False,
         time_budget_s=0.0, timeout=60.0,
     )
-    assert report["skipped"] == 3  # 2 fault legs + the stream leg
+    # 2 fault legs + the repartition leg + the stream leg
+    assert report["skipped"] == 4
     kinds = {leg["tag"]: leg["kind"] for leg in report["legs"]}
-    assert kinds == {"r0": "413", "r1": "413",
+    assert kinds == {"r0": "413", "r1": "413", "reshape": "413",
                      "stream": "append-faults"}
     for leg in report["legs"]:
         assert leg == {"tag": leg["tag"], "kind": leg["kind"],

@@ -169,6 +169,25 @@ def test_same_identity_descending_id_is_flagged(witness):
     assert lockwitness.violation_count() == 0
 
 
+def test_lock_id_is_the_raw_lock_identity(witness):
+    # The ascending-id protocol must be sorted by lock_id (the RAW
+    # lock the witness compares), never id(proxy): proxy-id order and
+    # raw-id order disagree nondeterministically, which made the
+    # fused-dispatch first trace intermittently acquire in what the
+    # witness saw as descending order (r18 chaos flake).
+    l1, l2 = threading.Lock(), threading.Lock()
+    w1 = lockwitness.wrap(l1, "Session.trace_lock")
+    w2 = lockwitness.wrap(l2, "Session.trace_lock")
+    assert lockwitness.lock_id(w1) == id(l1)
+    assert lockwitness.lock_id(w2) == id(l2)
+    assert lockwitness.lock_id(l1) == id(l1)  # raw passthrough
+    ordered = sorted([w1, w2], key=lockwitness.lock_id)
+    with ordered[0]:
+        with ordered[1]:
+            pass
+    assert lockwitness.violation_count() == 0
+
+
 def test_reentrant_same_instance_is_clean(witness):
     r = lockwitness.wrap(threading.RLock(), "W.r")
     with r:

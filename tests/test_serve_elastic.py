@@ -1,0 +1,414 @@
+"""Elastic fabric suite (ISSUE 16) on the virtual 8-device CPU mesh.
+
+Covers the online gang/single repartition surface:
+
+- the DRAINING fence: ``begin_drain`` stops admission and routing,
+  keeps outstanding futures resolving, survives mid-drain failures
+  without state regressions, and retires idempotently;
+- ``ReplicaPool.repartition``: fresh monotonic rids/tags per
+  partition, warm-ledger prewarm of the unpublished executors, the
+  combined-pool publish window (zero lost requests under concurrent
+  traffic), drained-pool refusal;
+- router reshape hooks: ``purge`` (sticky-placement scrub + epoch
+  bump), the elastic demand signals, and the cross-class
+  ``_usable_locked`` fallback while one class is mid-dissolve
+  (work re-routes or queues — never raises, never drops);
+- the :class:`~pint_tpu.serve.fabric.elastic.Repartitioner` decision
+  units (hysteresis streaks, the device-budget/singles floor) and the
+  scripted load-shape flip: small-key flood dissolves the gang,
+  a big-bucket wave re-forms one, with zero steady-state traces,
+  zero fresh persistent-XLA entries after the initial warm flip, and
+  the lock witness armed for the whole run.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.runtime import compile_cache, lockwitness
+from pint_tpu.serve import ResidualsRequest, TimingEngine
+from pint_tpu.serve.fabric import (
+    DRAINED,
+    DRAINING,
+    LIVE,
+    QUARANTINED,
+    ReplicaPool,
+    Router,
+)
+from pint_tpu.serve.fabric.elastic import Repartitioner
+from tools import chaos
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Two small same-composition pulsars (64-TOA bucket) + one big
+    one (512 bucket — at the tests' gang threshold)."""
+    return chaos.build_fleet(2), chaos.build_big(300)
+
+
+def _join_guard_threads():
+    for th in threading.enumerate():
+        if th.name.startswith("pint-tpu-guard"):
+            th.join(timeout=10)
+
+
+# -- router units (reshape-time candidate selection) ------------------------
+class FakeReplica:
+    def __init__(self, rid, state=LIVE, outstanding=0, inflight=1,
+                 width=1):
+        self.rid = rid
+        self.width = width
+        self.tag = f"g{rid}" if width > 1 else f"r{rid}"
+        self.state = state
+        self.outstanding = outstanding
+        self.inflight = inflight
+        self.draining = False
+
+
+class FakePool:
+    def __init__(self, reps):
+        self.replicas = reps
+
+    @property
+    def size(self):
+        return len(self.replicas)
+
+
+def _work(bucket):
+    return types.SimpleNamespace(
+        key=("fit", "comp", bucket), live=[1]
+    )
+
+
+def test_router_falls_back_across_classes_mid_reshape():
+    """ISSUE 16 satellite: all singles quarantined while the gang is
+    mid-dissolve (DRAINING) must degrade gracefully — work falls back
+    to whatever class is usable, or routes to None (the caller queues
+    or sheds typed); it never raises and never lands on a draining or
+    quarantined executor."""
+    gang = FakeReplica(0, width=2)
+    singles = [FakeReplica(1, state=QUARANTINED),
+               FakeReplica(2, state=QUARANTINED)]
+    router = Router(FakePool([gang] + singles),
+                    gang_threshold_toas=512)
+    # small work with every single quarantined: serves on the gang
+    assert router.route(_work(64)) is gang
+    # gang mid-dissolve too: NO candidate — None, not an exception
+    gang.draining = True
+    assert router.route(_work(64)) is None
+    assert router.route(_work(1024)) is None
+    # singles readmitted while the gang still drains: big work falls
+    # back onto a single rather than the draining gang
+    for s in singles:
+        s.state = LIVE
+    big = router.route(_work(1024))
+    assert big is not None and big.width == 1
+    # ... and the out-of-class routing is what the elastic watcher
+    # sees as "form a gang" pressure
+    demand = router.take_demand()
+    assert demand["big"] >= 1 and demand["big_on_single"] >= 1
+    # take_demand drains: a second read is all-zero
+    assert all(v == 0 for v in router.take_demand().values())
+
+
+def test_router_purge_scrubs_retired_rids_and_bumps_epoch():
+    reps = [FakeReplica(0), FakeReplica(1)]
+    router = Router(FakePool(reps))
+    w = _work(64)
+    assert router.route(w) is not None
+    assert router.placement(w.key)
+    assert router.epoch == 0
+    router.purge({99})  # nothing the placements reference survives
+    assert router.placement(w.key) == ()
+    assert router.epoch == 1
+    assert router.stats()["epoch"] == 1
+    # groups re-place cleanly against whatever pool is published
+    assert router.route(w) is not None
+
+
+# -- repartitioner decision units -------------------------------------------
+class _FakeRouter:
+    def __init__(self):
+        self._d = {"big": 0, "small": 0, "big_on_single": 0,
+                   "small_on_gang": 0}
+        self.epoch = 0
+
+    def take_demand(self):
+        d = dict(self._d)
+        for k in self._d:
+            self._d[k] = 0
+        return d
+
+
+class _FakeElasticPool:
+    def __init__(self, ndev, reps):
+        self._devices = tuple(range(ndev))
+        self.replicas = list(reps)
+        self.reshapes = 0
+        self.calls = []
+
+    def repartition(self, *, gangs, gang_size=None, timeout=120.0):
+        self.calls.append((gangs, gang_size))
+        self.reshapes += 1
+        return 0.01
+
+
+def _repartitioner(pool, router, **kw):
+    # a 1-hour window parks the watcher thread; every tick below is
+    # driven by hand so the decision units are deterministic
+    kw.setdefault("window_ms", 3_600_000)
+    return Repartitioner(pool, router, **kw)
+
+
+def test_repartitioner_forms_on_out_of_class_pressure():
+    pool = _FakeElasticPool(4, [FakeReplica(i) for i in range(4)])
+    router = _FakeRouter()
+    rp = _repartitioner(pool, router, hysteresis=2, min_singles=1,
+                        gang_size=2)
+    try:
+        router._d.update(big=3, big_on_single=3)
+        rp._tick()  # streak 1 of 2: no reshape yet
+        assert pool.calls == []
+        router._d.update(big=3, big_on_single=3)
+        rp._tick()  # sustained: form one gang
+        assert pool.calls == [(1, 2)]
+    finally:
+        rp.stop()
+
+
+def test_repartitioner_dissolves_idle_gang_under_small_flood():
+    pool = _FakeElasticPool(
+        4, [FakeReplica(0, width=2), FakeReplica(1), FakeReplica(2)]
+    )
+    router = _FakeRouter()
+    rp = _repartitioner(pool, router, hysteresis=2, min_singles=1,
+                        gang_size=2)
+    try:
+        # a desire must be CONSECUTIVE: small, quiet, small, small
+        router._d.update(small=5)
+        rp._tick()
+        rp._tick()  # quiet window resets the streak
+        router._d.update(small=5)
+        rp._tick()
+        assert pool.calls == []
+        router._d.update(small=5)
+        rp._tick()
+        assert pool.calls == [(0, 2)]
+        # a BUSY gang is never dissolved, whatever the small pressure
+        pool.calls.clear()
+        pool.replicas[0].outstanding = 1
+        for _ in range(3):
+            router._d.update(small=5)
+            rp._tick()
+        assert pool.calls == []
+    finally:
+        rp.stop()
+
+
+def test_repartitioner_respects_device_budget_and_singles_floor():
+    pool = _FakeElasticPool(4, [FakeReplica(i) for i in range(4)])
+    router = _FakeRouter()
+    rp = _repartitioner(pool, router, hysteresis=1, min_singles=3,
+                        gang_size=2)
+    try:
+        # 4 devices - one 2-wide gang = 2 singles < the floor of 3
+        for _ in range(3):
+            router._d.update(big=3, big_on_single=3)
+            rp._tick()
+        assert pool.calls == []
+    finally:
+        rp.stop()
+
+
+# -- bare-pool repartition mechanics ----------------------------------------
+def test_pool_repartition_monotonic_tags_and_drained_refusal():
+    """Rids/tags are NEVER reused across partitions (stale excluded
+    sets and placements cannot alias a new executor), and a drained
+    pool refuses to reshape."""
+    pool = ReplicaPool(replicas=4, inflight=1, gangs=1, gang_size=2,
+                       gang_threshold=512)
+    try:
+        assert [r.tag for r in pool.replicas] == ["g0", "r0", "r1"]
+        rids = {r.rid for r in pool.replicas}
+        assert pool.repartition(gangs=0) >= 0.0
+        assert [r.tag for r in pool.replicas] == ["r2", "r3", "r4",
+                                                  "r5"]
+        rids |= {r.rid for r in pool.replicas}
+        assert pool.repartition(gangs=1, gang_size=2) >= 0.0
+        assert [r.tag for r in pool.replicas] == ["g1", "r6", "r7"]
+        rids |= {r.rid for r in pool.replicas}
+        assert len(rids) == 3 + 4 + 3  # every rid freshly allocated
+        assert pool.reshapes == 2
+    finally:
+        pool.drain(timeout=60)
+    with pytest.raises(PintTpuError):
+        pool.repartition(gangs=0)
+
+
+# -- the DRAINING fence ------------------------------------------------------
+def test_draining_fence_holds_state_and_refuses_work(fleet):
+    small, _big = fleet
+    eng = TimingEngine(max_batch=1, max_wait_ms=0.0, inflight=1,
+                       replicas=2, warm_ledger=False)
+    try:
+        r0, r1 = eng.pool.replicas
+        work, futs = chaos._targeted_work(eng, [small[0]])
+        r0.begin_drain()
+        assert r0.state == DRAINING and r0.draining
+        # the fence refuses admission even on the force path
+        assert not r0.submit(work, block=False, force=True)
+        # a mid-drain failure neither degrades nor quarantines — the
+        # reshape fence owns the lifecycle
+        r0.note_failure("nan")
+        assert r0.state == DRAINING
+        r0.begin_drain()  # idempotent
+        assert r0.state == DRAINING
+        # the router serves around the fence: the batch lands on r1
+        eng._dispatch(work)
+        res = chaos.classify(futs, 300.0)
+        assert res["completed"] == res["offered"]
+        assert eng.router.route(
+            types.SimpleNamespace(key=work.key, live=work.live)
+        ) is r1
+        r0.drain(timeout=60)
+        assert r0.state == DRAINED
+        r0.begin_drain()  # no resurrection after retirement
+        assert r0.state == DRAINED
+    finally:
+        eng.close(timeout=120)
+        _join_guard_threads()
+
+
+# -- the full reshape cycle --------------------------------------------------
+def test_reshape_cycle_zero_loss_zero_compile(fleet, tmp_path):
+    """The ISSUE 16 acceptance cycle on the CPU mesh, lock witness
+    armed end to end:
+
+    1. warm every executor + the warm ledger (both traffic classes);
+    2. manual ``pool.repartition`` flips gang->singles->gang under a
+       live small-key pump: every future resolves exactly once
+       (completed — no shed, no drop), the ledger replay prewarms
+       each new partition;
+    3. with every (program, device) pair now in the persistent XLA
+       cache, a scripted load-shape flip drives the Repartitioner:
+       a small-key flood dissolves the gang, a big-bucket wave
+       re-forms one — zero steady-state traces, zero recompiles, and
+       zero fresh persistent-XLA entries across the elastic cycle.
+    """
+    small, big = fleet
+    vbase = lockwitness.violation_count()
+    with lockwitness.armed():
+        eng = TimingEngine(
+            max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
+            replicas=4, gangs=1, gang_size=2, gang_threshold=512,
+            quarantine_n=2, probe_ms=50,
+            warm_ledger=str(tmp_path / "elastic-ledger.json"),
+            # kwarg-enabled watcher, parked (1 h window): the manual
+            # flip below must not race a load-driven reshape
+            elastic=dict(window_ms=3_600_000),
+        )
+        try:
+            assert eng.stats()["elastic"]["enabled"]
+            chaos.warm_executors(eng, small, big, timeout=600.0)
+
+            # -- manual flip under live traffic: zero loss ----------
+            replayed = obs_metrics.counter("serve.warm.replayed")
+            rep0 = replayed.value
+            stop = threading.Event()
+            pumped = []
+
+            def pump():
+                while not stop.is_set():
+                    f = eng.submit(ResidualsRequest(
+                        par=small[0][0], toas=small[0][1]
+                    ))
+                    pumped.append(f)
+                    f.result(timeout=300)
+
+            th = threading.Thread(target=pump)
+            th.start()
+            try:
+                assert eng.pool.repartition(gangs=0) >= 0.0
+                assert eng.pool.repartition(
+                    gangs=1, gang_size=2
+                ) >= 0.0
+            finally:
+                stop.set()
+                th.join(300)
+            assert not th.is_alive()
+            res = chaos.classify(pumped, 300.0)
+            assert res["typed"], res
+            assert res["completed"] == res["offered"] > 0, res
+            assert eng.pool.reshapes == 2
+            assert eng.router.epoch == 2
+            # each reshape replayed the ledger into the new partition
+            assert replayed.value - rep0 > 0
+            # big work still serves on the re-formed partition
+            bres = chaos.classify(
+                [eng.submit(ResidualsRequest(par=big[0],
+                                             toas=big[1]))], 300.0
+            )
+            assert bres["completed"] == 1
+
+            # -- scripted load flip drives the watcher --------------
+            xla0 = compile_cache.entry_count()
+            tr = obs_metrics.counter("compile.traces")
+            rec = obs_metrics.counter("compile.recompiles")
+            rec0 = rec.value
+            rp = Repartitioner(
+                eng.pool, eng.router, window_ms=40, hysteresis=1,
+                min_singles=1, gang_size=2,
+            )
+            try:
+                def round_(reqs):
+                    futs = [eng.submit(r) for r in reqs]
+                    out = chaos.classify(futs, 300.0)
+                    assert out["completed"] == out["offered"], out
+
+                small_reqs = [
+                    ResidualsRequest(par=p, toas=t) for p, t in small
+                ]
+                big_reqs = [
+                    ResidualsRequest(par=big[0], toas=big[1])
+                ]
+                deadline = time.monotonic() + 120
+                while (eng.pool.gangs
+                       and time.monotonic() < deadline):
+                    round_(small_reqs)
+                assert not eng.pool.gangs, \
+                    "small-key flood never dissolved the idle gang"
+                t0 = tr.value
+                round_(small_reqs)
+                round_(small_reqs)
+                assert tr.value - t0 == 0  # steady post-dissolve
+                deadline = time.monotonic() + 120
+                while (not eng.pool.gangs
+                       and time.monotonic() < deadline):
+                    round_(big_reqs)
+                assert eng.pool.gangs, \
+                    "big-bucket wave never re-formed a gang"
+                t1 = tr.value
+                round_(big_reqs)
+                round_(big_reqs)
+                assert tr.value - t1 == 0  # steady post-re-form
+            finally:
+                rp.stop()
+            assert rec.value - rec0 == 0
+            xla1 = compile_cache.entry_count()
+            if xla0 is not None and xla1 is not None:
+                assert xla1 - xla0 == 0, (
+                    "elastic reshape compiled fresh XLA past the "
+                    "warm flip"
+                )
+            st = eng.stats()["elastic"]
+            assert st["reshapes"] == eng.pool.reshapes >= 4
+            assert st["dissolved"] >= 1 and st["formed"] >= 1
+            assert eng.router.epoch >= 4
+        finally:
+            eng.close(timeout=300)
+            _join_guard_threads()
+    assert lockwitness.violation_count() - vbase == 0

@@ -184,6 +184,64 @@ def test_bad_sidecar_skips_entry_never_crashes(tmp_path, pulsars):
         eng2.close(timeout=60)
 
 
+# -- the corruption battery (ISSUE 16 satellite) ---------------------------
+@pytest.mark.parametrize("corrupt", [
+    "truncated-index", "sidecar-version", "sidecar-unpicklable",
+])
+def test_corruption_battery_cold_boots_clean(tmp_path, pulsars,
+                                             corrupt):
+    """Each corruption mode the fleet can hit on disk — a TRUNCATED
+    JSON index (crash mid-write of a non-atomic editor/copy), a
+    version-mismatched sidecar (rollback across a LEDGER_VERSION
+    bump), an unpicklable prototype (sidecar referencing a module the
+    new build no longer ships) — degrades to a clean cold boot:
+    ``serve.warm.stale`` / ``serve.warm.failed`` count it, zero
+    entries replay, nothing crashes, traffic still serves."""
+    import pickle
+
+    lp = str(tmp_path / "warm-ledger.json")
+    eng = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        _drive(eng, pulsars)
+    finally:
+        eng.close(timeout=60)
+    with open(lp) as f:
+        (entry,) = json.load(f)["entries"].values()
+
+    if corrupt == "truncated-index":
+        with open(lp) as f:
+            raw = f.read()
+        with open(lp, "w") as f:
+            f.write(raw[: int(len(raw) * 0.6)])  # mid-entry cut
+        counter = "serve.warm.stale"
+    elif corrupt == "sidecar-version":
+        side = tmp_path / entry["sidecar"]
+        with open(side, "rb") as f:
+            payload = pickle.load(f)
+        payload["version"] = wlmod.LEDGER_VERSION + 99
+        with open(side, "wb") as f:
+            pickle.dump(payload, f)
+        counter = "serve.warm.failed"
+    else:  # a valid pickle stream naming a module that doesn't exist
+        with open(tmp_path / entry["sidecar"], "wb") as f:
+            f.write(b"cnot_a_real_module_xyz\nBogus\n.")
+        counter = "serve.warm.failed"
+
+    c0 = _counter(counter)
+    rep0 = _counter("serve.warm.replayed")
+    eng2 = TimingEngine(warm_ledger=lp, **ENGINE_KW)
+    try:
+        assert _counter(counter) - c0 >= 1
+        assert _counter("serve.warm.replayed") - rep0 == 0
+        par, toas = pulsars[0]
+        res = eng2.submit(
+            ResidualsRequest(par=par, toas=toas)
+        ).result(timeout=600)
+        assert res.ntoa == toas.ntoas
+    finally:
+        eng2.close(timeout=60)
+
+
 # -- enablement ------------------------------------------------------------
 def test_ledger_path_resolution(monkeypatch, tmp_path):
     monkeypatch.delenv("PINT_TPU_SERVE_WARM_LEDGER", raising=False)

@@ -31,23 +31,33 @@ ledger, and the replayed pre-warm must absorb the prior traffic mix
 with ZERO fresh XLA compiles (persistent-compile-cache hits only) and
 zero live traces under post-restart traffic.
 
+**Repartition legs** (ISSUE 16) exercise the elastic fabric's reshape
+path under the same contract: a fault pinned to one executor while
+the pool repartitions mid-drain (the DRAINING fence must hand queued
+work back to the router, the reshape completes bounded, and steady
+traffic on the NEW partition runs trace-free off the warm-ledger
+prewarm), plus a kill-mid-reshape leg (engine ``close()`` racing an
+in-flight ``repartition`` serializes on the reshape lock, every
+orphan resolves typed, and the next generation replays to warmth).
+
 Determinism: the harness is driven exclusively by the deterministic
 :func:`pint_tpu.runtime.faults.inject` spec grammar (the same
 ``PINT_TPU_FAULTS`` engine, armed programmatically per leg) — it
 imports no randomness source and fixes every simulation seed, so a
 failing leg replays bit-identically (pintlint rule obs8 machine
--checks this).  Cross-key fusion is pinned OFF for the sweep
-(``PINT_TPU_SERVE_XKEY_FUSE=0``): fusion legally compiles one fresh
-kernel per first-seen key COMBO (replica.py::_fuse), and whether two
-distinct keys first co-reside inside a leg's steady window depends on
-collector/re-route timing — an opportunistic optimisation is
-inherently at odds with the zero-steady-trace assertion, so the
-harness removes it rather than flaking on it (the xkey path has its
-own deterministic gate: the bench ``serve`` block's ``xkey`` probe).  Legs target executors DIRECTLY — each targeted batch
-is assembled by the engine's own stacking chokepoint and force
--submitted to the tagged replica — so coverage of every tag is by
-construction, not by hoping the sticky router happens to place a key
-there.
+-checks this).  Cross-key fusion stays ON for the sweep: fusion
+legally compiles one fresh kernel per first-seen key COMBO
+(replica.py::_fuse), and whether two distinct keys first co-reside
+inside a leg's steady window depends on collector/re-route timing —
+the r17 harness pinned the optimisation off rather than flake on it;
+since ISSUE 16 the warm-up window pre-traces EVERY fusible member
+combo on every executor (:func:`_prewarm_combos` ->
+``Replica.prewarm_fused``), so the warmed-combo gate always hits and
+the steady windows are deterministic with fusion armed.  Legs target
+executors DIRECTLY — each targeted batch is assembled by the
+engine's own stacking chokepoint and force-submitted to the tagged
+replica — so coverage of every tag is by construction, not by hoping
+the sticky router happens to place a key there.
 
 Entry points: :func:`run_sweep` (the full matrix, returns a report
 dict), ``python -m tools.chaos`` (one JSON line per leg; the
@@ -225,7 +235,56 @@ def warm_executors(engine, small, big, timeout: float = 600.0):
     res = classify(futs, timeout)
     if res["completed"] != res["offered"]:
         raise RuntimeError(f"executor warm-up failed: {res}")
+    # the r17 flake, fixed at the root (ISSUE 16): with the solos warm,
+    # replica.py::_fuse would legally COMPILE the first-seen combo of
+    # any two distinct keys that happen to colocate mid-leg — pre-trace
+    # every fusible member combo now so steady windows only ever hit
+    # the combo cache
+    _prewarm_combos(
+        engine,
+        _combo_works(engine, ([small[0]], small[:2], [big])),
+        timeout=timeout,
+    )
     return res
+
+
+def _combo_works(engine, groups):
+    """Zero-member clones of the targeted traffic classes: the combo
+    -prewarm currency (the stacked operands keep their padded shapes;
+    no member futures ride along — same template shape the warm
+    ledger's ``replay_jobs`` uses)."""
+    from pint_tpu.serve.fabric.replica import BatchWork
+
+    out = []
+    for group in groups:
+        w, _futs = _targeted_work(engine, group)
+        out.append(BatchWork(w.key, [], w.ops, w.session, w.cap))
+    return out
+
+
+def _prewarm_combos(engine, works, replicas=None,
+                    timeout: float = 120.0) -> int:
+    """Trace every fusible cross-key combo wrapper on every executor
+    (``Replica.prewarm_fused``): each member subset of ``works`` is
+    one potential first-seen combo the dispatcher could otherwise
+    legally compile mid-leg.  Waits for each executor to go quiescent
+    first (prewarm_fused's caller contract); a fusion-disabled replica
+    reports False and costs nothing.  Returns the number of combo
+    wrappers warmed."""
+    import itertools
+
+    pool = engine.pool.replicas if replicas is None else replicas
+    warmed = 0
+    for rep in pool:
+        if not _wait_for(lambda: rep.outstanding == 0, timeout):
+            raise RuntimeError(
+                f"{rep.tag} never went quiescent for combo prewarm"
+            )
+        for k in range(2, len(works) + 1):
+            for subset in itertools.combinations(works, k):
+                if rep.prewarm_fused(list(subset)):
+                    warmed += 1
+    return warmed
 
 
 # -- the fault legs ---------------------------------------------------------
@@ -429,6 +488,15 @@ def restart_leg(small, ledger_path: str, *, engine_kw: dict,
         eng._dispatch(work)
         wfuts.extend(futs)
     warm = classify(wfuts, timeout)
+    # combo wrappers are warm-ledger EXCLUDED but their compiled
+    # programs DO land in the persistent XLA cache: trace the cap-1 x
+    # cap-2 combo now so generation 2's re-trace is a disk hit, not a
+    # fresh compile (the wave mixes both caps, and _fuse may legally
+    # fuse the co-resident pair — a first-seen combo otherwise)
+    _prewarm_combos(
+        eng, _combo_works(eng, ([small[0]], small[:2])),
+        timeout=timeout,
+    )
     inflight = _wave(eng, wave)
     eng.close(timeout=timeout)
     killed = classify(inflight, timeout=30.0)
@@ -447,6 +515,14 @@ def restart_leg(small, ledger_path: str, *, engine_kw: dict,
     replay_traces = obs_metrics.counter("compile.traces").value - t0
     replayed = (
         obs_metrics.counter("serve.warm.replayed").value - rep0
+    )
+    # ledger replay restored every solo (key, cap); the combo
+    # wrappers it excludes must be re-traced explicitly (generation 1
+    # compiled them, so these traces are persistent-cache hits)
+    # before the measured trace-free window
+    _prewarm_combos(
+        eng2, _combo_works(eng2, ([small[0]], small[:2])),
+        timeout=timeout,
     )
     t1 = obs_metrics.counter("compile.traces").value
     steady = classify(_wave(eng2, 1) + _wave(eng2, 2) + _wave(eng2, wave),
@@ -474,24 +550,199 @@ def restart_leg(small, ledger_path: str, *, engine_kw: dict,
     return leg
 
 
+# -- the repartition legs (ISSUE 16) ----------------------------------------
+def repartition_leg(engine, kind: str, *, small, big,
+                    hang_seconds: float = 1.5,
+                    timeout: float = 120.0) -> dict:
+    """Fault mid-drain: pin ``kind`` to one current executor, queue
+    targeted batches on it, then flip the gang/single partition WHILE
+    the fault fires.  Contract: the DRAINING fence hands queued work
+    back to the router (replica.py::note_failure's flush — no state
+    thrash, no loss), the reshape completes bounded, every future
+    resolves typed, and — the faulted executor having retired with the
+    old partition — steady mixed traffic on the NEW partition runs
+    trace-free off the warm-ledger prewarm + combo prewarm."""
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import faults, guard
+    from pint_tpu.serve import ResidualsRequest
+
+    target = engine.pool.replicas[0]
+    to_gangs = 0 if engine.pool.gangs else 1
+    reshapes0 = engine.pool.reshapes
+    traffic = [big] if target.width > 1 else small[:2]
+    gkw = {"max_retries": 0}
+    if kind == "hang":
+        gkw.update(compile_timeout=20.0, dispatch_timeout=0.4)
+    futs = []
+    with guard.configured(**gkw):
+        with faults.inject(
+            f"{kind}:inf@@{target.tag}", hang_seconds=hang_seconds,
+        ) as plan:
+            for _ in range(3):
+                futs.extend(_submit_targeted(engine, target, traffic))
+            dt = engine.pool.repartition(gangs=to_gangs, gang_size=2)
+            outcomes = classify(futs, timeout)
+            fired = len(plan.fired)
+    # the reshape's ledger prewarm covered every solo kernel on the
+    # new executors; combo wrappers are ledger-EXCLUDED, so warm them
+    # explicitly before the measured steady window
+    _prewarm_combos(
+        engine,
+        _combo_works(engine, ([small[0]], small[:2], [big])),
+        timeout=timeout,
+    )
+    t0 = obs_metrics.counter("compile.traces").value
+    r0 = obs_metrics.counter("compile.recompiles").value
+    steady = classify(
+        [engine.submit(ResidualsRequest(par=p, toas=t))
+         for p, t in small + [big]],
+        timeout,
+    )
+    leg = {
+        "tag": "reshape", "kind": kind, "fired": fired,
+        "target": target.tag, "to_gangs": to_gangs,
+        "reshape_s": round(dt, 3),
+        "outcomes": outcomes, "steady": steady,
+        "reshapes": engine.pool.reshapes - reshapes0,
+        "partition": [r.tag for r in engine.pool.replicas],
+        "steady_traces": (
+            obs_metrics.counter("compile.traces").value - t0
+        ),
+        "steady_retraces": (
+            obs_metrics.counter("compile.recompiles").value - r0
+        ),
+    }
+    leg["ok"] = bool(
+        outcomes["typed"] and fired > 0
+        and leg["reshapes"] == 1
+        and steady["typed"]
+        and steady["completed"] == steady["offered"]
+        and leg["steady_traces"] == 0
+        and leg["steady_retraces"] == 0
+    )
+    return leg
+
+
+def reshape_restart_leg(small, big, ledger_path: str, *,
+                        engine_kw: dict, wave: int = 6,
+                        timeout: float = 600.0) -> dict:
+    """Kill-and-restart MID-RESHAPE: generation 1 starts a
+    repartition on a background thread and is closed while it runs —
+    ``ReplicaPool.drain`` serializes behind the in-flight reshape on
+    the reshape lock, so shutdown waits out the bounded swap instead
+    of racing it, and every orphaned future resolves typed.
+    Generation 2 boots from the same warm ledger and must replay to
+    warmth: zero live traces under the steady mix."""
+    import threading
+
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import compile_cache
+    from pint_tpu.serve import ResidualsRequest, TimingEngine
+
+    def _wave(eng, n):
+        return [
+            eng.submit(ResidualsRequest(
+                par=small[i % len(small)][0],
+                toas=small[i % len(small)][1],
+            ))
+            for i in range(n)
+        ]
+
+    groups = ([small[0]], small[:2], [big])
+    eng = TimingEngine(warm_ledger=ledger_path, **engine_kw)
+    wfuts = []
+    for group in groups:
+        work, futs = _targeted_work(eng, group)
+        eng._dispatch(work)
+        wfuts.extend(futs)
+    warm = classify(wfuts, timeout)
+    _prewarm_combos(eng, _combo_works(eng, groups), timeout=timeout)
+
+    to_gangs = 0 if eng.pool.gangs else 1
+    reshape_out = {}
+
+    def _reshape():
+        try:
+            reshape_out["s"] = eng.pool.repartition(
+                gangs=to_gangs, gang_size=2,
+            )
+        except BaseException as e:
+            reshape_out["error"] = type(e).__name__
+
+    th = threading.Thread(target=_reshape, name="chaos-reshape")
+    th.start()
+    time.sleep(0.2)  # land the kill mid-reshape (prewarm/drain phase)
+    inflight = _wave(eng, wave)
+    eng.close(timeout=timeout)
+    th.join(timeout)
+    killed = classify(inflight, timeout=30.0)
+    killed_typed = bool(
+        killed["typed"] and not killed["failed"]
+        and set(killed["rejected"]) <= {"shutdown"}
+    )
+
+    xla0 = compile_cache.entry_count()
+    rep0 = obs_metrics.counter("serve.warm.replayed").value
+    eng2 = TimingEngine(warm_ledger=ledger_path, **engine_kw)
+    replayed = (
+        obs_metrics.counter("serve.warm.replayed").value - rep0
+    )
+    _prewarm_combos(eng2, _combo_works(eng2, groups), timeout=timeout)
+    t1 = obs_metrics.counter("compile.traces").value
+    steady = classify(
+        _wave(eng2, 1) + _wave(eng2, 2)
+        + [eng2.submit(ResidualsRequest(par=big[0], toas=big[1]))],
+        timeout,
+    )
+    fresh_traces = obs_metrics.counter("compile.traces").value - t1
+    xla1 = compile_cache.entry_count()
+    eng2.close(timeout=timeout)
+    leg = {
+        "tag": "reshape", "kind": "kill-mid-reshape",
+        "warm": warm, "reshape": reshape_out,
+        "reshape_done": not th.is_alive(),
+        "killed": killed, "killed_typed": killed_typed,
+        "replayed": replayed, "steady": steady,
+        "fresh_traces": fresh_traces,
+        "xla_new_entries": (
+            None if xla0 is None or xla1 is None else xla1 - xla0
+        ),
+    }
+    leg["ok"] = bool(
+        warm["completed"] == warm["offered"]
+        and leg["reshape_done"]
+        and ("s" in reshape_out or "error" in reshape_out)
+        and killed_typed
+        and replayed >= 1
+        and steady["completed"] == steady["offered"]
+        and fresh_traces == 0
+        and (leg["xla_new_entries"] in (None, 0))
+    )
+    return leg
+
+
 # -- the sweep --------------------------------------------------------------
 @contextlib.contextmanager
-def _xkey_fusion_off():
-    """Pin cross-key fusion off for the sweep's engines (replicas read
-    the env at construction).  Fusion's first-seen-combo compile is
-    legal by design but timing-dependent — with it on, a leg's
-    ``steady_traces == 0`` assertion flakes whenever two distinct keys
-    first colocate (e.g. background traffic re-routed onto the healthy
-    replica during a quarantine) inside the leg window."""
-    prior = os.environ.get("PINT_TPU_SERVE_XKEY_FUSE")
-    os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = "0"
+def _deterministic_cache_writes():
+    """Pin the persistent-XLA-cache write threshold to zero for the
+    restart legs.  With the default 0.2 s floor, whether a borderline
+    kernel's compile gets WRITTEN is timing-dependent — generation 1
+    can skip a write that generation 2 then performs, flaking the
+    ``xla_new_entries == 0`` gate even though no extra compile WORK
+    happened.  A zero floor makes it deterministic: every gen-1
+    compile writes, every gen-2 compile hits."""
+    import jax
+
+    prior = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", 0.0
+    )
     try:
         yield
     finally:
-        if prior is None:
-            os.environ.pop("PINT_TPU_SERVE_XKEY_FUSE", None)
-        else:
-            os.environ["PINT_TPU_SERVE_XKEY_FUSE"] = prior
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prior
+        )
 
 
 def _witness_leg(leg: dict, vbase: int) -> dict:
@@ -514,14 +765,15 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
               replicas: int | None = None, gangs: int | None = None,
               gang_size: int | None = None,
               hang_seconds: float = 1.5, restart: bool = True,
-              stream: bool = True,
+              stream: bool = True, reshape: bool = True,
               ledger_dir: str | None = None,
               time_budget_s: float | None = None,
               timeout: float = 120.0) -> dict:
     """The full chaos matrix: one leg per (executor tag, fault kind)
-    over a mixed single/gang fabric, plus the streaming append-fault
-    leg (ISSUE 14) and the kill-and-restart leg.
-    Returns the report dict ``python -m tools.chaos`` prints.
+    over a mixed single/gang fabric, the repartition legs (ISSUE 16:
+    one fault-mid-drain leg per kind plus kill-mid-reshape), the
+    streaming append-fault leg (ISSUE 14), and the kill-and-restart
+    leg.  Returns the report dict ``python -m tools.chaos`` prints.
 
     ``time_budget_s`` bounds the FAULT-leg portion (the profiling
     ``chaos`` config's ~60 s envelope): legs past the budget are
@@ -534,18 +786,28 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
 
     # the lock-witness sanitizer (ISSUE 15) is armed for the WHOLE
     # sweep — engines built below get witnessed serve-stack locks, and
-    # every leg (fault legs, stream leg, kill-and-restart leg)
-    # additionally asserts zero ordering/blocking violations.  Cross
-    # -key fusion is pinned off (see _xkey_fusion_off) so the legal
+    # every leg (fault legs, repartition legs, stream leg, kill-and
+    # -restart legs) additionally asserts zero ordering/blocking
+    # violations.  Cross-key fusion stays ON: warm_executors pre
+    # -traces every fusible combo (_prewarm_combos), so the legal
     # first-seen-combo compile can't leak into a leg's steady window.
-    with _xkey_fusion_off(), lockwitness.armed():
+    with lockwitness.armed():
         small = build_fleet(npsr)
         big = build_big()
+        # the sweep engine records a warm ledger: the repartition legs
+        # prewarm each NEW partition from it (pool.repartition replays
+        # the ledger onto the incoming executors before any drain)
+        lp_dir = (
+            ledger_dir or tempfile.mkdtemp(prefix="pint-tpu-chaos-")
+        )
         engine = TimingEngine(
             max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
             replicas=replicas, gangs=gangs, gang_size=gang_size,
             gang_threshold=512 if gangs else None,
-            quarantine_n=2, probe_ms=50, warm_ledger=False,
+            quarantine_n=2, probe_ms=50,
+            warm_ledger=os.path.join(
+                lp_dir, "chaos-sweep-ledger.json"
+            ),
         )
         legs = []
         t_start = time.monotonic()
@@ -571,6 +833,25 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
                         big=big, hang_seconds=hang_seconds,
                         timeout=timeout,
                     ), vbase))
+            if reshape:
+                # fault-mid-drain: each kind fires on the executor
+                # being retired while the partition flips (the flip
+                # direction alternates with each leg's reshape)
+                for kind in kinds:
+                    if (time_budget_s is not None
+                            and time.monotonic() - t_start
+                            > time_budget_s):
+                        legs.append({
+                            "tag": "reshape", "kind": kind,
+                            "skipped": True, "ok": True,
+                            "lock_violations": 0,
+                        })
+                        continue
+                    vbase = lockwitness.violation_count()
+                    legs.append(_witness_leg(repartition_leg(
+                        engine, kind, small=small, big=big,
+                        hang_seconds=hang_seconds, timeout=timeout,
+                    ), vbase))
             report_text = flight_report()
         finally:
             engine.close()
@@ -589,20 +870,34 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
                     timeout=timeout,
                 ), vbase))
         if restart:
-            lp = os.path.join(
-                ledger_dir
-                or tempfile.mkdtemp(prefix="pint-tpu-chaos-"),
-                "chaos-warm-ledger.json",
-            )
+            lp = os.path.join(lp_dir, "chaos-warm-ledger.json")
             vbase = lockwitness.violation_count()
-            legs.append(_witness_leg(restart_leg(
-                small, lp,
-                engine_kw=dict(
-                    max_batch=2, max_wait_ms=2.0, inflight=1,
-                    replicas=replicas, prewarm=True,
-                ),
-                timeout=max(timeout, 600.0),
-            ), vbase))
+            with _deterministic_cache_writes():
+                legs.append(_witness_leg(restart_leg(
+                    small, lp,
+                    engine_kw=dict(
+                        max_batch=2, max_wait_ms=2.0, inflight=1,
+                        replicas=replicas, prewarm=True,
+                    ),
+                    timeout=max(timeout, 600.0),
+                ), vbase))
+            if reshape:
+                lp2 = os.path.join(
+                    lp_dir, "chaos-reshape-ledger.json"
+                )
+                vbase = lockwitness.violation_count()
+                with _deterministic_cache_writes():
+                    legs.append(_witness_leg(reshape_restart_leg(
+                        small, big, lp2,
+                        engine_kw=dict(
+                            max_batch=2, max_wait_ms=2.0, inflight=1,
+                            replicas=replicas, gangs=gangs,
+                            gang_size=gang_size,
+                            gang_threshold=512 if gangs else None,
+                            quarantine_n=2, probe_ms=50, prewarm=True,
+                        ),
+                        timeout=max(timeout, 600.0),
+                    ), vbase))
         total_violations = lockwitness.violation_count()
     return {
         "executors": [s["tag"] for s in sites],
@@ -628,13 +923,14 @@ def main(argv=None) -> int:
     ap.add_argument("--gang-size", type=int, default=None)
     ap.add_argument("--no-restart", action="store_true")
     ap.add_argument("--no-stream", action="store_true")
+    ap.add_argument("--no-reshape", action="store_true")
     ap.add_argument("--timeout", type=float, default=120.0)
     args = ap.parse_args(argv)
     report = run_sweep(
         kinds=tuple(k for k in args.kinds.split(",") if k),
         replicas=args.replicas, gangs=args.gangs,
         gang_size=args.gang_size, restart=not args.no_restart,
-        stream=not args.no_stream,
+        stream=not args.no_stream, reshape=not args.no_reshape,
         timeout=args.timeout,
     )
     for leg in report["legs"]:

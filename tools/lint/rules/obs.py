@@ -356,6 +356,32 @@ _STREAM_SOLVER_CHECKS = (
 )
 
 
+_ELASTIC_CHECKS = (
+    ("serve/fabric/elastic.py", "Repartitioner._reshape",
+     ("TRACER.span", "repartition("),
+     "the elastic reshape entry must stay span-instrumented and "
+     "route through ReplicaPool.repartition — the one drain-fenced, "
+     "warm-prewarmed swap path (ad-hoc partition surgery dodges the "
+     "zero-loss/zero-compile contract)"),
+    ("serve/fabric/pool.py", "ReplicaPool.repartition",
+     ("TRACER.span", "_reshape_lock", "begin_drain("),
+     "the partition swap must stay span-instrumented, serialized on "
+     "the reshape lock (one reshape at a time; drain serializes "
+     "behind it), and retire old executors through the DRAINING "
+     "fence — never a hard stop with work queued"),
+    ("serve/fabric/replica.py", "Replica.begin_drain",
+     ("_set_state(",),
+     "the DRAINING transition must ride the instrumented state "
+     "machine (_set_state emits the health event the flight "
+     "recorder and chaos legs key on)"),
+    ("serve/fabric/router.py", "Router.purge",
+     ("TRACER.event",),
+     "retiring a partition from the router must stay event "
+     "-instrumented (epoch bump + scrubbed placements are the "
+     "post-reshape debugging anchors)"),
+)
+
+
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
     if not subdir.is_dir():
         return []
@@ -566,6 +592,26 @@ class Obs9Rule(Rule):
         return findings
 
 
+class Obs10Rule(Rule):
+    """Elastic-fabric chokepoints (ISSUE 16): reshape entry points
+    span-instrumented and funneled through the drain-fenced
+    ``ReplicaPool.repartition``, the DRAINING transition on the
+    instrumented state machine, router retirement event-counted."""
+
+    name = "obs10"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the elastic module itself: fixture packages that
+        # predate the subsystem skip (obs7/obs8/obs9 convention)
+        if not (pkg_root / "serve" / "fabric" / "elastic.py").is_file():
+            return []
+        return _run_checks(
+            self.name, pkg_root, _ELASTIC_CHECKS,
+            pkg_root / "serve" / "fabric",
+        )
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
@@ -575,7 +621,8 @@ OBS6 = Obs6Rule()
 OBS7 = Obs7Rule()
 OBS8 = Obs8Rule()
 OBS9 = Obs9Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9)
+OBS10 = Obs10Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9, OBS10)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
