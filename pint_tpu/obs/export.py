@@ -35,14 +35,34 @@ def to_chrome_trace(spans=None, events=None, tracer=None) -> dict:
     Spans become complete ('X') events with microsecond timestamps on
     the perf_counter timebase; instant events become 'i' markers; the
     full metrics snapshot rides in ``otherData`` so one file carries
-    both signals."""
+    both signals.
+
+    Flow stitching (ISSUE 17): spans carrying a ``flow`` id
+    additionally emit Chrome-trace flow events — 's' (start) on the
+    earliest span of the flow, 't' (step) on each middle span, 'f'
+    (end, ``bp:"e"``) on the last — all sharing the flow id, each
+    timestamped INSIDE its enclosing 'X' slice (midpoint) so
+    Perfetto binds it to that slice and renders one request as a
+    connected arc across thread tracks.  Threads named via
+    ``Tracer.name_thread`` emit 'M' thread_name metadata so the
+    tracks read ``serve-collector`` / ``replica r0 fence`` instead of
+    bare tids."""
     tracer = tracer or _trace.TRACER
     spans = tracer.spans() if spans is None else spans
     events = tracer.events() if events is None else events
     pid = os.getpid()
     out = []
+    flows: dict = {}
     for sp in spans:
         t1 = sp.t1 if sp.t1 is not None else sp.t0
+        args = {
+            "span_id": sp.span_id,
+            "parent_id": sp.parent_id,
+            **sp.attrs,
+        }
+        if sp.flow is not None:
+            args["flow"] = sp.flow
+            flows.setdefault(sp.flow, []).append(sp)
         out.append({
             "ph": "X",
             "name": sp.name,
@@ -51,13 +71,12 @@ def to_chrome_trace(spans=None, events=None, tracer=None) -> dict:
             "dur": (t1 - sp.t0) * 1e6,
             "pid": pid,
             "tid": sp.thread,
-            "args": {
-                "span_id": sp.span_id,
-                "parent_id": sp.parent_id,
-                **sp.attrs,
-            },
+            "args": args,
         })
     for ev in events:
+        args = {"parent_id": ev.parent_id, **ev.attrs}
+        if ev.flow is not None:
+            args["flow"] = ev.flow
         out.append({
             "ph": "i",
             "s": "t",  # thread-scoped instant
@@ -66,7 +85,35 @@ def to_chrome_trace(spans=None, events=None, tracer=None) -> dict:
             "ts": ev.t * 1e6,
             "pid": pid,
             "tid": ev.thread,
-            "args": {"parent_id": ev.parent_id, **ev.attrs},
+            "args": args,
+        })
+    # derived flow arcs: NOT round-tripped by load_chrome_trace (the
+    # span 'flow' arg is the source of truth; these exist for the
+    # Perfetto renderer)
+    for fid, group in flows.items():
+        group.sort(key=lambda sp: sp.t0)
+        for i, sp in enumerate(group):
+            t1 = sp.t1 if sp.t1 is not None else sp.t0
+            rec = {
+                "ph": "s" if i == 0 else
+                      "f" if i == len(group) - 1 else "t",
+                "id": fid,
+                "name": f"flow:{fid}",
+                "cat": "flow",
+                "ts": (sp.t0 + t1) / 2 * 1e6,
+                "pid": pid,
+                "tid": sp.thread,
+            }
+            if rec["ph"] == "f":
+                rec["bp"] = "e"  # bind to the enclosing slice
+            out.append(rec)
+    for tid, tname in tracer.thread_names().items():
+        out.append({
+            "ph": "M",
+            "name": "thread_name",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
         })
     return {
         "traceEvents": out,
@@ -109,6 +156,7 @@ def load_chrome_trace(source) -> tuple[list, list]:
                 span_id=args.pop("span_id", None),
                 parent_id=args.pop("parent_id", None),
                 thread=rec.get("tid", 0),
+                flow=args.pop("flow", None),
                 attrs=args,
             ))
         elif rec.get("ph") == "i":
@@ -118,8 +166,12 @@ def load_chrome_trace(source) -> tuple[list, list]:
                 t=rec["ts"] / 1e6,
                 parent_id=args.pop("parent_id", None),
                 thread=rec.get("tid", 0),
+                flow=args.pop("flow", None),
                 attrs=args,
             ))
+        # 's'/'t'/'f' flow arcs and 'M' metadata are DERIVED from the
+        # span/event records above — skipped on load (the 'flow' arg
+        # restores Span.flow/Event.flow losslessly)
     return spans, events
 
 
@@ -225,6 +277,45 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
                 f"{k}={v}" for k, v in sorted(fabric_bits.items())
             )
         )
+    # streaming sessions (ISSUE 17 satellite): append ladder counts,
+    # drift rollbacks (drift_fallback IS the rollback signal), alerts
+    stream_bits = {
+        k.split(".", 2)[2]: v
+        for k, v in snap.items()
+        if k.startswith("serve.stream.") and v not in (0, None)
+    }
+    if stream_bits:
+        lines.append(
+            "stream: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(stream_bits.items())
+            )
+        )
+    # elastic fleet: reshape count + last-reshape duration + mid-drain
+    # queue flushes (serve.fabric.drain_flushes reported above)
+    elastic_bits = {
+        k.split(".", 2)[2]: v
+        for k, v in snap.items()
+        if k.startswith("serve.elastic.")
+        and not isinstance(v, dict) and v not in (0, None)
+    }
+    if elastic_bits:
+        lines.append(
+            "elastic: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(elastic_bits.items())
+            )
+        )
+    # slow-request exemplars: the window's worst-k flights with their
+    # last completed stage (full stage vectors in engine stats())
+    exemplars = snap.get("serve.latency.exemplars") or []
+    if exemplars:
+        lines.append("slowest requests (window):")
+        for ex in exemplars[:top]:
+            stages = ex.get("stages") or {}
+            last = _metrics.last_stage(stages)
+            lines.append(
+                f"  {ex.get('lat_ms', 0.0):>9.2f} ms  "
+                f"flow={ex.get('flow', '?')}  last={last}"
+            )
     # per-composition population breakdown (ISSUE 6): pars joined,
     # batches dispatched, XLA compiles — per composition id
     comp_bits = sorted(

@@ -93,13 +93,26 @@ serve.fabric.no_replica         counter  typed sheds with no live
 serve.replica.N.batches         counter  batches served by replica N
 serve.replica.N.outstanding     gauge    queued+inflight batches
 serve.replica.N.state           gauge    health-state string
+serve.latency.total             whisto   end-to-end submit->finish ms
+                                         (sliding window; feeds
+                                         stats()['p50_ms'/'p99_ms'])
+serve.latency.stage.S           whisto   per-stage dwell ms, S one of
+                                         :data:`STAGES` (ISSUE 17 —
+                                         consecutive-stamp deltas)
+serve.latency.exemplars         worst-k  slow-request reservoir: full
+                                         stage vectors + flow ids
+serve.shed_stage.R.S            counter  sheds of reason R whose LAST
+                                         stamped stage was S (the
+                                         shed-reason x stage table)
 ==============================  =======  ==============================
 """
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
+import time
 
 
 class Counter:
@@ -208,6 +221,170 @@ class Histogram:
             }
 
 
+#: canonical serving-pipeline stage order (ISSUE 17).  Every stamp a
+#: request or batch record carries is keyed by one of these; the order
+#: IS the monotonicity contract (tools/chaos.py asserts it per leg).
+#: ``submit``..``close`` live on the engine's per-request ``_Pending``,
+#: ``route``..``fence`` on the fabric's ``BatchWork``, ``finish`` is
+#: stamped at response resolution.  Host-only ops (predict) legally
+#: skip the fabric stages — completeness is per-path, monotonicity is
+#: universal.
+STAGES = (
+    "submit", "admit", "close", "route", "queue",
+    "place", "dispatch", "fence", "finish",
+)
+
+
+def last_stage(stages: dict | None) -> str:
+    """The latest canonical stage a record reached (its last stamp in
+    :data:`STAGES` order); ``"none"`` for an empty/missing vector."""
+    out = "none"
+    if stages:
+        for s in STAGES:
+            if s in stages:
+                out = s
+    return out
+
+
+def note_shed_stage(reason: str, stages: dict | None):
+    """Bump the shed-reason x stage cell — called at every typed-shed
+    site (queue-full, quota, deadline, deadline-late, no-replica,
+    shutdown, streams) so ``stats()['latency']['shed_stages']`` shows
+    WHERE in the pipeline each rejection class strikes."""
+    REGISTRY.counter(
+        f"serve.shed_stage.{reason}.{last_stage(stages)}"
+    ).inc()
+
+
+class WindowHistogram:
+    """Sliding-window percentile estimator with bounded memory.
+
+    Replaces the flat 4096-deque in ``TimingEngine.stats()`` (ISSUE
+    17): that deque conflated warmup and steady state across long
+    runs — a sample observed hours ago weighed the same as one from
+    the last second.  This keeps ``(monotonic_t, value)`` pairs in a
+    deque bounded BOTH ways: ``maxlen`` caps memory, ``window_s``
+    expires old samples at observe/read time.  ``percentile`` uses the
+    same sorted-index formula the deque-era ``stats()`` used
+    (``sorted[min(n-1, int(q*n))]``), so offered-load sweeps that
+    pinned those semantics read identical numbers over a fresh window;
+    ``reset()`` empties the window exactly like clearing the deque
+    (``TimingEngine.reset_stats()`` reaches it through the registry's
+    ``serve.`` prefix reset)."""
+
+    def __init__(self, name: str, unit: str = "", help: str = "", *,
+                 window_s: float = 300.0, maxlen: int = 4096):
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._samples: collections.deque = collections.deque(
+            maxlen=maxlen
+        )
+
+    def _prune(self, now: float):
+        # lock held by caller
+        horizon = now - self.window_s
+        q = self._samples
+        while q and q[0][0] < horizon:
+            q.popleft()
+
+    def observe(self, v: float, now: float | None = None):
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune(t)
+            self._samples.append((t, float(v)))
+
+    def reset(self):
+        with self._lock:
+            self._samples.clear()
+
+    def _window(self) -> list:
+        with self._lock:
+            self._prune(time.monotonic())
+            return [v for _, v in self._samples]
+
+    def percentile(self, q: float):
+        """The deque-era quantile: sorted in-window samples indexed at
+        ``min(n-1, int(q*n))``; ``None`` on an empty window."""
+        vals = sorted(self._window())
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    @property
+    def count(self) -> int:
+        return len(self._window())
+
+    @property
+    def value(self) -> dict:
+        vals = sorted(self._window())
+        n = len(vals)
+        return {
+            "count": n,
+            "p50": vals[min(n - 1, int(0.50 * n))] if n else None,
+            "p99": vals[min(n - 1, int(0.99 * n))] if n else None,
+            "max": vals[-1] if n else None,
+        }
+
+
+class ExemplarReservoir:
+    """Bounded worst-k slow-request reservoir (ISSUE 17).
+
+    Keeps the ``k`` slowest requests of the sliding window, each with
+    its full stage vector and flow id, so "why was p99 slow" has named
+    exemplars (flight_report prints them) instead of one anonymous
+    percentile.  ``offer`` is O(k) under one lock — k is small (8) and
+    the call sits on the finish path next to the existing histogram
+    observes, inside the <2% attribution budget bench.py gates."""
+
+    def __init__(self, name: str, unit: str = "", help: str = "", *,
+                 k: int = 8, window_s: float = 300.0):
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self.k = int(k)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._worst: list[dict] = []  # sorted ascending by latency
+
+    def offer(self, lat_ms: float, flow: str,
+              stages: dict | None = None,
+              now: float | None = None):
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            horizon = t - self.window_s
+            keep = [e for e in self._worst if e["t"] >= horizon]
+            if len(keep) >= self.k and lat_ms <= keep[0]["lat_ms"]:
+                self._worst = keep
+                return
+            keep.append({
+                "t": t, "lat_ms": float(lat_ms), "flow": flow,
+                "stages": dict(stages) if stages else {},
+            })
+            keep.sort(key=lambda e: e["lat_ms"])
+            self._worst = keep[-self.k:]
+
+    def reset(self):
+        with self._lock:
+            self._worst.clear()
+
+    @property
+    def value(self) -> list[dict]:
+        """Worst-first exemplars still inside the window (each without
+        the internal ``t`` key — latency, flow id, stage vector)."""
+        with self._lock:
+            horizon = time.monotonic() - self.window_s
+            self._worst = [
+                e for e in self._worst if e["t"] >= horizon
+            ]
+            return [
+                {k: v for k, v in e.items() if k != "t"}
+                for e in reversed(self._worst)
+            ]
+
+
 class MetricsRegistry:
     """Get-or-create registry; one flat namespace of dotted names."""
 
@@ -236,6 +413,14 @@ class MetricsRegistry:
     def histogram(self, name: str, unit: str = "",
                   help: str = "") -> Histogram:
         return self._get(Histogram, name, unit, help)
+
+    def window_histogram(self, name: str, unit: str = "",
+                         help: str = "") -> WindowHistogram:
+        return self._get(WindowHistogram, name, unit, help)
+
+    def exemplars(self, name: str, unit: str = "",
+                  help: str = "") -> ExemplarReservoir:
+        return self._get(ExemplarReservoir, name, unit, help)
 
     def snapshot(self) -> dict:
         """All metric values keyed by canonical name — the telemetry
@@ -269,6 +454,16 @@ def gauge(name: str, unit: str = "", help: str = "") -> Gauge:
 
 def histogram(name: str, unit: str = "", help: str = "") -> Histogram:
     return REGISTRY.histogram(name, unit, help)
+
+
+def window_histogram(name: str, unit: str = "",
+                     help: str = "") -> WindowHistogram:
+    return REGISTRY.window_histogram(name, unit, help)
+
+
+def exemplars(name: str, unit: str = "",
+              help: str = "") -> ExemplarReservoir:
+    return REGISTRY.exemplars(name, unit, help)
 
 
 def snapshot() -> dict:

@@ -44,6 +44,17 @@ Span taxonomy (category strings; full table in docs/observability.md):
 (profiler.py::PhaseTimer) and instant events ``recompile``, ``retry``,
 ``watchdog-timeout``, ``transport-rejection``, ``fallback``,
 ``numerics-error``, ``near-413``.
+
+Flow stitching (ISSUE 17): spans and events carry an optional
+``flow`` id — the serving ``request_id`` or a batch id — that survives
+cross-thread handoffs.  A span opened without an explicit ``flow=``
+INHERITS the enclosing span's flow (including a worker-thread span
+re-parented via :meth:`Tracer.under`), so one request's path submit ->
+collector -> router -> replica dispatcher -> fencer -> done-callback
+is one connected arc.  obs/export.py turns same-flow spans into
+Chrome-trace flow events (``s``/``t``/``f``) that Perfetto renders as
+arrows across thread tracks; :meth:`Tracer.name_thread` labels the
+tracks (``M`` metadata records).
 """
 
 from __future__ import annotations
@@ -68,6 +79,7 @@ class Span:
     thread: int
     attrs: dict = field(default_factory=dict)
     t1: float | None = None
+    flow: str | None = None  # request/batch id stitching thread handoffs
 
     @property
     def dur_s(self) -> float:
@@ -84,6 +96,7 @@ class Event:
     parent_id: int | None
     thread: int
     attrs: dict = field(default_factory=dict)
+    flow: str | None = None
 
 
 def nbytes_of(value) -> int:
@@ -189,6 +202,7 @@ class Tracer:
         self._events: list[Event] = []
         self._ids = itertools.count(1)
         self._tls = threading.local()
+        self._thread_names: dict[int, str] = {}
 
     # -- span stack (thread-local) ---------------------------------------
     def _stack(self) -> list:
@@ -238,37 +252,57 @@ class Tracer:
             else:
                 self.dropped += 1
 
-    def span(self, name: str, cat: str = "host", **attrs):
+    def name_thread(self, name: str):
+        """Label the CALLING thread's track in exports (one write per
+        thread ident; Perfetto ``M``/thread_name metadata).  Safe to
+        call unconditionally — a dict store, no lock."""
+        self._thread_names[threading.get_ident()] = name
+
+    def thread_names(self) -> dict[int, str]:
+        return dict(self._thread_names)
+
+    def span(self, name: str, cat: str = "host",
+             flow: str | None = None, **attrs):
         """Open a span; use as a context manager.  The disabled path is
-        ONE attribute check returning a shared no-op handle."""
+        ONE attribute check returning a shared no-op handle.  ``flow``
+        stitches the span into a cross-thread request arc; omitted, it
+        inherits the enclosing span's flow (so :meth:`under` carries
+        the id onto worker threads)."""
         if not self.enabled:
             return _NOOP
         stack = self._stack()
+        parent = stack[-1] if stack else None
         sp = Span(
             name=name,
             cat=cat,
             t0=time.perf_counter(),
             span_id=next(self._ids),
-            parent_id=stack[-1].span_id if stack else None,
+            parent_id=parent.span_id if parent else None,
             thread=threading.get_ident(),
             attrs=dict(attrs),
+            flow=flow if flow is not None
+            else (parent.flow if parent else None),
         )
         stack.append(sp)
         return _SpanHandle(self, sp)
 
-    def event(self, name: str, cat: str = "event", **attrs):
+    def event(self, name: str, cat: str = "event",
+              flow: str | None = None, **attrs):
         """Record an instant event under the current span (no-op when
         disabled — counters for always-on accounting live in
         pint_tpu.obs.metrics, not here)."""
         if not self.enabled:
             return
+        sp = self.current_span()
         ev = Event(
             name=name,
             cat=cat,
             t=time.perf_counter(),
-            parent_id=self.current_span_id(),
+            parent_id=None if sp is None else sp.span_id,
             thread=threading.get_ident(),
             attrs=dict(attrs),
+            flow=flow if flow is not None
+            else (sp.flow if sp is not None else None),
         )
         with self._lock:
             if len(self._events) < self.capacity:

@@ -196,6 +196,12 @@ class AppendRequest(Request):
 
 
 # -- responses -----------------------------------------------------------
+# Every response exposes ``stages``: the request's monotonic stage
+# vector (ISSUE 17) — absolute time.monotonic() stamps keyed by
+# pint_tpu.obs.metrics.STAGES names, recorded at each pipeline
+# boundary (submit/admit/close on the engine's per-request record,
+# route/queue/place/dispatch/fence on the serving batch, finish at
+# resolution).  Host-only ops (predict) carry only the host stages.
 @dataclass
 class ResidualsResponse:
     request_id: str
@@ -206,6 +212,7 @@ class ResidualsResponse:
     batch_size: int  # live requests stacked in the serving batch
     wall_ms: float  # submit -> result wall time
     replica: str = ""  # fabric executor tag ('r3', or 'g0' for a gang)
+    stages: dict = field(default_factory=dict)  # monotonic stage stamps
 
 
 @dataclass
@@ -224,6 +231,7 @@ class FitResponse:
     batch_size: int
     wall_ms: float
     replica: str = ""  # fabric executor tag ('rN' single, 'gN' gang)
+    stages: dict = field(default_factory=dict)  # monotonic stage stamps
 
 
 @dataclass
@@ -249,6 +257,7 @@ class AppendResponse:
     batch_size: int
     wall_ms: float
     replica: str = ""
+    stages: dict = field(default_factory=dict)  # monotonic stage stamps
     #: advanced solver state (engine-internal; ObserveSession commits
     #: it and strips it before handing the response to the caller)
     state: object = None
@@ -262,3 +271,4 @@ class PredictResponse:
     spin_freq_hz: np.ndarray
     cached: bool  # True when the polyco span was already generated
     wall_ms: float
+    stages: dict = field(default_factory=dict)  # monotonic stage stamps

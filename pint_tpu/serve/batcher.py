@@ -86,7 +86,7 @@ class MicroBatch:
     """One flushable group of same-composition pending requests."""
 
     __slots__ = ("key", "items", "t_oldest", "priority", "deadline",
-                 "slo_closed")
+                 "slo_closed", "t_closed")
 
     def __init__(self, key):
         self.key = key
@@ -100,6 +100,11 @@ class MicroBatch:
         # max-wait timer) closed the group — the engine's
         # serve.slo.early_close accounting reads it
         self.slo_closed: bool = False
+        # monotonic stamp of the CLOSE decision (full pop / due timer /
+        # SLO trigger) — each member's 'close' stage stamp (ISSUE 17);
+        # stamped at the pop site so flush-queue delay is attributed
+        # to the route stage, not batching
+        self.t_closed: float | None = None
 
     def add(self, item, now: float, priority: int,
             deadline: float | None = None):
@@ -161,6 +166,7 @@ class Batcher:
             g = self._groups[key] = MicroBatch(key)
         g.add(item, now, priority, deadline)
         if len(g) >= self.max_batch:
+            g.t_closed = now
             return self._groups.pop(key)
         return None
 
@@ -178,6 +184,7 @@ class Batcher:
                 not take_all
                 and now - g.t_oldest < self.max_wait_s
             )
+            g.t_closed = now
             out.append(g)
         return out
 

@@ -101,7 +101,7 @@ class _Pending:
     """One admitted request flowing through the pipeline."""
 
     __slots__ = ("req", "future", "t_submit", "session", "record",
-                 "bundle")
+                 "bundle", "stages")
 
     def __init__(self, req, future, t_submit):
         self.req = req
@@ -110,6 +110,12 @@ class _Pending:
         self.session = None  # composition Session (compiled layer)
         self.record = None  # per-par ParRecord (lightweight layer)
         self.bundle = None  # padded host-numpy TOABundle
+        # per-request stage clock (ISSUE 17): monotonic stamps keyed
+        # by obs.metrics.STAGES names.  Host stages (submit/admit/
+        # close) live here; batch stages ride BatchWork.stamps and the
+        # two merge at finish.  Handoff-sequential — exactly one
+        # thread owns the record at each boundary, so no lock.
+        self.stages = {"submit": t_submit}
 
 
 class TimingEngine:
@@ -182,10 +188,6 @@ class TimingEngine:
         )
         self._quota_inflight: dict = {}  # cid -> admitted unresolved; lint: guarded-by(_quota_lock)
         self._stop = False  # lint: guarded-by(_cond)
-        self._latencies = collections.deque(maxlen=4096)  # lint: guarded-by(_lat_lock)
-        self._lat_lock = lockwitness.wrap(
-            threading.Lock(), "TimingEngine._lat_lock"
-        )
         # host response assembly (model parse, par text) is serialized
         # across replica fence threads — it is light next to the device
         # work and not audited for concurrent use
@@ -239,6 +241,20 @@ class TimingEngine:
         # the population-serving figure next to raw batch occupancy
         self._m_stack_pars = m.histogram("serve.stack.distinct_pars")
         self._m_latency = m.histogram("serve.latency_ms", unit="ms")
+        # per-stage latency attribution (ISSUE 17): sliding-window
+        # histograms replacing the flat 4096-deque — total end-to-end
+        # plus one per pipeline stage (dwell = consecutive-stamp
+        # delta), and the worst-k slow-request exemplar reservoir.
+        # All registered under serve.* so reset_stats()'s prefix reset
+        # clears them exactly like the deque it replaces.
+        self._m_lat_total = m.window_histogram(
+            "serve.latency.total", unit="ms"
+        )
+        self._m_lat_stage = {
+            s: m.window_histogram(f"serve.latency.stage.{s}", unit="ms")
+            for s in obs_metrics.STAGES[1:]
+        }
+        self._m_exemplars = m.exemplars("serve.latency.exemplars")
         self._m_depth = m.gauge("serve.queue_depth")
         self._m_quota = m.counter("serve.quota_rejected")
         self._m_slo_close = m.counter("serve.slo.early_close")
@@ -281,9 +297,11 @@ class TimingEngine:
         errors on exhausted dispatch supervision)."""
         fut: Future = Future()
         self._m_requests.inc()
+        # flow = request_id stitches this caller-thread span to the
+        # collector/fencer spans of the same request (ISSUE 17)
         with TRACER.span(
             "serve:submit", "serve", op=request.op,
-            request_id=request.request_id,
+            request_id=request.request_id, flow=request.request_id,
         ):
             with self._cond:
                 if self._stop:
@@ -296,6 +314,9 @@ class TimingEngine:
                     TRACER.event(
                         "shed", "serve", reason="queue-full",
                         op=request.op,
+                    )
+                    obs_metrics.note_shed_stage(
+                        "queue-full", {"submit": time.monotonic()}
                     )
                     fut.set_exception(RequestRejected(
                         "queue-full",
@@ -366,6 +387,7 @@ class TimingEngine:
 
     # -- stage 2: collector ------------------------------------------------
     def _collect_loop(self):
+        TRACER.name_thread("serve-collector")
         while True:
             with self._cond:
                 if not self._queue and not self._stop:
@@ -403,12 +425,19 @@ class TimingEngine:
 
     def _admit(self, p: _Pending):
         """Resolve session + bucket for one drained request; returns a
-        full group ready to flush, or None."""
+        full group ready to flush, or None.  Stamps the request's
+        ``admit`` stage and opens the collector-thread node of its
+        flow arc (ISSUE 17; pintlint rule obs11)."""
         req = p.req
+        p.stages["admit"] = time.monotonic()
         try:
             req.validate()
             if req.op == "predict":
-                self._predict(p)
+                with TRACER.span(
+                    "serve:admit", "serve", op=req.op,
+                    flow=req.request_id,
+                ):
+                    self._predict(p)
                 return None
             from pint_tpu.toas.bundle import make_bundle
             from pint_tpu.toas.ingest import ingest_for_model
@@ -478,9 +507,14 @@ class TimingEngine:
                 None if req.deadline_s is None
                 else p.t_submit + float(req.deadline_s)
             )
-            return self._batcher.add(
-                key, p, time.monotonic(), req.priority, deadline
-            )
+            # the collector-thread node of the request's flow arc
+            with TRACER.span(
+                "serve:admit", "serve", op=req.op,
+                flow=req.request_id, bucket=sess.bucket,
+            ):
+                return self._batcher.add(
+                    key, p, time.monotonic(), req.priority, deadline
+                )
         except BaseException as e:  # per-request failure, not fatal
             if not p.future.done():
                 p.future.set_exception(
@@ -512,6 +546,7 @@ class TimingEngine:
                     "shed", "serve", reason="quota", op=p.req.op,
                     composition=cid, inflight=n,
                 )
+                obs_metrics.note_shed_stage("quota", p.stages)
                 raise RequestRejected(
                     "quota",
                     f"composition {cid}: {n} in flight >= "
@@ -546,13 +581,18 @@ class TimingEngine:
             mjds = np.atleast_1d(np.asarray(req.mjds, dtype=np.float64))
             ints, fracs = pc.eval_abs_phase(mjds)
             freq = pc.eval_spin_freq(mjds)
+        t_done = time.monotonic()
+        # host-only op: the stage vector legally skips the fabric
+        # stages (submit -> admit -> finish)
+        stages = dict(p.stages)
+        stages["finish"] = t_done
         p.future.set_result(PredictResponse(
             request_id=req.request_id, phase_int=ints,
             phase_frac=fracs, spin_freq_hz=freq, cached=cached,
-            wall_ms=(time.monotonic() - p.t_submit) * 1e3,
+            wall_ms=(t_done - p.t_submit) * 1e3, stages=stages,
         ))
         self._m_completed.inc()
-        self._note_latency(p)
+        self._note_latency(p, t_done, stages)
 
     def _expired(self, p: _Pending) -> bool:
         dl = p.req.deadline_s
@@ -566,6 +606,7 @@ class TimingEngine:
             "shed", "serve", reason="deadline", op=p.req.op,
             waited_s=round(waited, 4),
         )
+        obs_metrics.note_shed_stage("deadline", p.stages)
         p.future.set_exception(RequestRejected(
             "deadline",
             f"waited {waited:.3f}s >= deadline {dl}s",
@@ -583,6 +624,19 @@ class TimingEngine:
                 "slo-close", "serve", op=batch.key[0],
                 n=len(batch.items),
             )
+        # batch-close stamp + cause: 'slo' = deadline-margin trigger,
+        # 'full' = capacity trigger (popped in Batcher.add), 'due' =
+        # the max-wait timer.  t_closed is stamped by the batcher at
+        # the actual close decision, upstream of this flush.
+        t_close = getattr(batch, "t_closed", None) or time.monotonic()
+        cause = (
+            "slo" if getattr(batch, "slo_closed", False)
+            else "full" if len(batch.items) >= self.max_batch
+            else "due"
+        )
+        for p in batch.items:
+            p.stages["close"] = t_close
+            p.stages["close_cause"] = cause
         live = [p for p in batch.items if not self._expired(p)]
         if not live:
             return
@@ -750,28 +804,39 @@ class TimingEngine:
             )
 
     def _finish_batch(self, work: BatchWork, mats, replica):
-        """Resolve every member future of a fenced, validated batch."""
+        """Resolve every member future of a fenced, validated batch.
+        Each member's stage vector closes here: the request's host
+        stamps merge with the batch's fabric stamps plus ``finish``,
+        and the fencer-thread node of its flow arc is recorded."""
         t_done = time.monotonic()
         with self._finish_lock:
             for i, p in enumerate(work.live):
+                stages = {**p.stages, **work.stamps,
+                          "finish": t_done}
                 try:
-                    resp = self._response(
-                        work.key, p, i, mats, len(work.live), t_done,
-                        replica.tag,
-                    )
-                    p.future.set_result(resp)
+                    with TRACER.span(
+                        "serve:finish", "serve", op=work.key[0],
+                        flow=p.req.request_id, replica=replica.tag,
+                    ):
+                        resp = self._response(
+                            work.key, p, i, mats, len(work.live),
+                            t_done, replica.tag, stages,
+                        )
+                        p.future.set_result(resp)
                     self._m_completed.inc()
-                    self._note_latency(p, t_done)
+                    self._note_latency(p, t_done, stages)
                 except Exception as e:
                     if not p.future.done():
                         p.future.set_exception(e)
 
-    def _response(self, key, p, i, mats, nlive, t_done, rtag=""):
+    def _response(self, key, p, i, mats, nlive, t_done, rtag="",
+                  stages=None):
         from pint_tpu.serve.api import FitResponse, ResidualsResponse
 
         req, sess = p.req, p.session
         ntoa = len(req.toas)
         wall_ms = (t_done - p.t_submit) * 1e3
+        stages = stages if stages is not None else dict(p.stages)
         site = f"serve:{key[0]}"
         if key[0] == "residuals":
             resid, chi2 = mats
@@ -783,7 +848,7 @@ class TimingEngine:
                 request_id=req.request_id, ntoa=ntoa,
                 residuals_s=resid[i][:ntoa], chi2=float(chi2[i]),
                 bucket=sess.bucket, batch_size=nlive, wall_ms=wall_ms,
-                replica=rtag,
+                replica=rtag, stages=stages,
             )
         if key[0] == "append":
             from pint_tpu.serve.api import AppendResponse
@@ -813,7 +878,8 @@ class TimingEngine:
                 chi2=float(chi2[i]), converged=True,
                 refit="incremental", alerts=(),
                 bucket=sess.bucket, batch_size=nlive,
-                wall_ms=wall_ms, replica=rtag, state=state_i,
+                wall_ms=wall_ms, replica=rtag, stages=stages,
+                state=state_i,
             )
         # fit: the make_scan_fit_loop result tuple, batched
         x, chi2, (covn, nrm), conv, _nbads, bads = mats
@@ -845,14 +911,30 @@ class TimingEngine:
             chi2=float(chi2[i]), converged=bool(conv[i]),
             method="gls", mode=key[3], fitted_par=fitted.as_parfile(),
             ntoa=ntoa, bucket=sess.bucket, batch_size=nlive,
-            wall_ms=wall_ms, replica=rtag,
+            wall_ms=wall_ms, replica=rtag, stages=stages,
         )
 
-    def _note_latency(self, p, t_done=None):
-        lat_ms = ((t_done or time.monotonic()) - p.t_submit) * 1e3
+    def _note_latency(self, p, t_done=None, stages=None):
+        """Latency attribution chokepoint (pintlint rule obs11): the
+        end-to-end figure feeds the sliding-window total histogram,
+        each consecutive-stamp delta feeds its per-stage
+        WindowHistogram, and the worst-k exemplar reservoir keeps the
+        full stage vector + flow id of slow requests."""
+        t = t_done or time.monotonic()
+        lat_ms = (t - p.t_submit) * 1e3
         self._m_latency.observe(lat_ms)
-        with self._lat_lock:
-            self._latencies.append(lat_ms)
+        self._m_lat_total.observe(lat_ms, now=t)
+        if stages:
+            prev = stages.get("submit", p.t_submit)
+            for s in obs_metrics.STAGES[1:]:
+                ts = stages.get(s)
+                if ts is None:
+                    continue
+                self._m_lat_stage[s].observe((ts - prev) * 1e3, now=t)
+                prev = ts
+            self._m_exemplars.offer(
+                lat_ms, p.req.request_id, stages, now=t
+            )
 
     def _replay_jobs(self) -> list:
         """Resolve the warm ledger into pre-warm jobs — the boot
@@ -869,14 +951,14 @@ class TimingEngine:
     # -- stats / lifecycle -------------------------------------------------
     def stats(self) -> dict:
         """One-look serving telemetry (bench.py's serve block and the
-        offered-load ladder publish this)."""
-        with self._lat_lock:
-            lats = sorted(self._latencies)
-
+        offered-load ladder publish this).  ``p50_ms``/``p99_ms`` read
+        the sliding-window total-latency histogram (ISSUE 17 — same
+        sorted-index quantile the old 4096-deque used, over a fresh
+        window instead of the whole run); ``latency`` breaks the same
+        window down per stage plus the shed-reason x stage table."""
         def pct(q):
-            if not lats:
-                return None
-            return round(lats[min(len(lats) - 1, int(q * len(lats)))], 3)
+            v = self._m_lat_total.percentile(q)
+            return None if v is None else round(v, 3)
 
         occ = self._m_occupancy.value
         stack = self._m_stack_pars.value
@@ -894,6 +976,32 @@ class TimingEngine:
             ),
             "p50_ms": pct(0.50),
             "p99_ms": pct(0.99),
+            # per-stage attribution (ISSUE 17): where submit->finish
+            # time goes, stage by stage, over the same sliding window
+            "latency": {
+                "window_s": self._m_lat_total.window_s,
+                "count": self._m_lat_total.count,
+                "stages": {
+                    s: {
+                        "p50_ms": (
+                            None if (v := h.percentile(0.50)) is None
+                            else round(v, 3)
+                        ),
+                        "p99_ms": (
+                            None if (v := h.percentile(0.99)) is None
+                            else round(v, 3)
+                        ),
+                    }
+                    for s, h in self._m_lat_stage.items()
+                    if h.count
+                },
+                "shed_stages": {
+                    name[len("serve.shed_stage."):]: v
+                    for name, v in obs_metrics.snapshot().items()
+                    if name.startswith("serve.shed_stage.") and v
+                },
+                "exemplars": self._m_exemplars.value,
+            },
             "sessions": len(self.sessions),
             "kernels": sum(
                 r["kernels"] for r in per_replica.values()
@@ -947,6 +1055,10 @@ class TimingEngine:
                 "formed": mc("serve.elastic.formed").value,
                 "dissolved": mc("serve.elastic.dissolved").value,
                 "failed": mc("serve.elastic.failed").value,
+                "last_reshape_ms": obs_metrics.gauge(
+                    "serve.elastic.last_reshape_ms"
+                ).value,
+                "drain_flushes": mc("serve.fabric.drain_flushes").value,
                 "epoch": self.router.epoch,
                 "partition": {
                     "gangs": len(self.pool.gangs),
@@ -963,16 +1075,23 @@ class TimingEngine:
                 "cold_refits": mc("serve.stream.cold_refit").value,
                 "refreshes": mc("serve.stream.refresh").value,
                 "alerts": mc("serve.stream.alerts").value,
+                "drift_fallbacks": mc(
+                    "serve.stream.drift_fallback"
+                ).value,
+                "cold_fallbacks": mc(
+                    "serve.stream.cold_fallback"
+                ).value,
             },
         }
 
     def reset_stats(self):
         """Scope stats() to a fresh measurement window (bench rungs /
-        offered-load sweeps): clears the latency reservoir and zeroes
-        the serve.* metric namespace.  Compiled kernels and sessions
-        are untouched — this resets observation, not state."""
-        with self._lat_lock:
-            self._latencies.clear()
+        offered-load sweeps): zeroes the serve.* metric namespace —
+        which includes the sliding-window latency histograms and the
+        exemplar reservoir (they register under serve.latency.*), so
+        the semantics match the old deque clear exactly (pinned in
+        tests/test_obs_flow.py).  Compiled kernels and sessions are
+        untouched — this resets observation, not state."""
         obs_metrics.reset("serve.")
 
     def close(self, timeout: float = 120.0):

@@ -160,9 +160,14 @@ class GangReplica(Replica):
                 return jax.device_put(arr, row_place)
             return jax.device_put(arr, rep_place)
 
+        # NOTE: the 'place' stage stamp lives in Replica._run around
+        # this call — gangs inherit the stage clock unmodified; the
+        # flow id on the span stitches the sharded commit into the
+        # batch's Perfetto arc (ISSUE 17)
         with TRACER.span(
             "gang:place", "fabric", gang=self.tag, op=work.key[0],
             bucket=bucket, shards=self.width, cap=work.cap,
+            flow=work.flow,
         ):
             return tree_util.tree_map(place, work.ops)
 

@@ -265,6 +265,9 @@ class ReplicaPool:
         obs_metrics.histogram("serve.elastic.reshape_ms").observe(
             dt * 1e3
         )
+        obs_metrics.gauge("serve.elastic.last_reshape_ms").set(
+            round(dt * 1e3, 3)
+        )
         TRACER.event(
             "repartition", "fabric", gangs=int(gangs),
             new=[r.tag for r in self.replicas], ms=round(dt * 1e3, 1),

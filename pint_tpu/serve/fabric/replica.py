@@ -83,6 +83,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import os
 import queue
 import threading
@@ -132,13 +133,26 @@ def health_kind(e: BaseException) -> str | None:
     return None
 
 
+#: batch flow ids — one per assembled BatchWork, so the fabric-side
+#: spans of one dispatch stitch into a Perfetto flow arc distinct
+#: from (but joined by the finish span to) the member request flows
+_BATCH_IDS = itertools.count()
+
+
 class BatchWork:
     """One assembled micro-batch flowing through the fabric: the
     flush-time stacked host-numpy operands plus the routing state
-    (replicas that already failed it, the last typed error)."""
+    (replicas that already failed it, the last typed error).
+
+    ``stamps`` is the batch half of the request stage clock (ISSUE
+    17): monotonic stamps at route/queue/place/dispatch/fence, merged
+    into each member's ``_Pending.stages`` at resolution.  Stamps are
+    bare dict writes on the thread that owns the batch at that stage
+    (router -> dispatcher -> fencer handoffs are sequential), so the
+    hot path takes no locks for attribution."""
 
     __slots__ = ("key", "live", "ops", "session", "cap", "excluded",
-                 "last_error", "no_fuse")
+                 "last_error", "no_fuse", "stamps", "flow")
 
     def __init__(self, key, live, ops, session, cap):
         self.key = key
@@ -151,6 +165,25 @@ class BatchWork:
         # set after a fused-dispatch failure: the retry must take the
         # solo path (the fault ladder's degrade-to-unfused rung)
         self.no_fuse = False
+        self.stamps: dict = {}  # stage name -> time.monotonic()
+        self.flow = f"batch-{next(_BATCH_IDS)}"
+
+    def stamp(self, name: str):
+        """Record one stage boundary.  Re-routes re-stamp earlier
+        stages (route/queue/place fire again on the next replica) —
+        the overwrite keeps the vector monotonic because every later
+        stage re-fires after it."""
+        self.stamps[name] = time.monotonic()
+
+    def flush_stages(self):
+        """Fold the batch stamps into each member's own stage dict —
+        called wherever the batch object is about to be REPLACED
+        (coalesce merge, shed-late survivor surgery) so no member
+        loses already-recorded boundaries."""
+        for p in self.live:
+            stages = getattr(p, "stages", None)
+            if stages is not None:
+                stages.update(self.stamps)
 
     @property
     def op(self) -> str:
@@ -203,10 +236,14 @@ class BatchWork:
             obs_metrics.counter("serve.fabric.no_replica").inc()
         TRACER.event(
             "shed", "fabric", reason=reason, op=self.key[0],
-            n=len(self.live),
+            n=len(self.live), flow=self.flow,
         )
         for p in self.live:
             if not p.future.done():
+                obs_metrics.note_shed_stage(
+                    reason,
+                    {**getattr(p, "stages", {}), **self.stamps},
+                )
                 p.future.set_exception(RequestRejected(reason, detail))
 
 
@@ -256,9 +293,16 @@ def merge_batch_works(works: list[BatchWork], cap: int) -> BatchWork:
         return rows
 
     ops = tree_util.tree_map(merge, *[w.ops for w in works])
+    # the merged batch REPLACES the sources: flush each source's stage
+    # stamps onto its own members first (per-member truth — the works
+    # were routed/queued at different times), then re-stamp the merged
+    # dispatch's later stages on the new object
+    for w in works:
+        w.flush_stages()
     merged = BatchWork(works[0].key, live, ops, works[0].session, cap)
     merged.excluded = set().union(*(w.excluded for w in works))
     merged.no_fuse = any(w.no_fuse for w in works)
+    merged.flow = works[0].flow
     return merged
 
 
@@ -388,7 +432,7 @@ class Replica:
         never block a peer replica's pipeline thread on this one)."""
         with TRACER.span(
             "replica:submit", "fabric", replica=self.tag,
-            op=work.key[0], n=len(work.live),
+            op=work.key[0], n=len(work.live), flow=work.flow,
         ):
             with self._cond:
                 while True:
@@ -399,6 +443,7 @@ class Replica:
                     if not block:
                         return False
                     self._cond.wait(0.05)
+                work.stamp("queue")  # stage clock: accepted here
                 self._queue.append(work)
                 self._outstanding += 1
                 self._g_out.set(self._outstanding)
@@ -441,6 +486,7 @@ class Replica:
         return k
 
     def _dispatch_loop(self):
+        TRACER.name_thread(f"replica {self.tag} dispatch")
         while True:
             with self._cond:
                 while not self._queue and not self._draining:
@@ -606,10 +652,14 @@ class Replica:
         obs_metrics.counter("serve.shed").inc(len(expired))
         TRACER.event(
             "shed", "fabric", reason="deadline-late", op=work.key[0],
-            replica=self.tag, n=len(expired),
+            replica=self.tag, n=len(expired), flow=work.flow,
         )
         for p in expired:
             if not p.future.done():
+                obs_metrics.note_shed_stage(
+                    "deadline-late",
+                    {**getattr(p, "stages", {}), **work.stamps},
+                )
                 waited = now - p.t_submit
                 p.future.set_exception(RequestRejected(
                     "deadline",
@@ -640,6 +690,11 @@ class Replica:
         kept.excluded = set(work.excluded)
         kept.last_error = work.last_error
         kept.no_fuse = work.no_fuse
+        # survivors keep every boundary already recorded on the shed
+        # batch (route/queue) — the replacement object must not drop
+        # stamps (chaos asserts complete vectors on survivors)
+        kept.stamps = dict(work.stamps)
+        kept.flow = work.flow
         return kept
 
     def prewarm_kernel(self, work: BatchWork) -> None:
@@ -718,9 +773,10 @@ class Replica:
             try:
                 with TRACER.span(
                     "replica:place", "fabric", replica=self.tag,
-                    op=work.key[0], cap=work.cap,
+                    op=work.key[0], cap=work.cap, flow=work.flow,
                 ):
                     ops = self._place_ops(work)
+                work.stamp("place")
                 obs_metrics.counter("serve.fabric.overlapped").inc()
             except BaseException as e:
                 self._batch_error(work, e)
@@ -732,10 +788,13 @@ class Replica:
             with TRACER.span(
                 "replica:dispatch", "fabric", replica=self.tag,
                 op=work.key[0], n=len(work.live), cap=work.cap,
+                flow=work.flow,
             ):
                 if ops is None:
                     ops = self._place_ops(work)
+                    work.stamp("place")
                 out = kernel(*ops)  # async guarded device dispatch
+            work.stamp("dispatch")
         except BaseException as e:
             self._sem.release()
             self._batch_error(work, e)
@@ -817,8 +876,11 @@ class Replica:
                 with TRACER.span(
                     "replica:place", "fabric", replica=self.tag,
                     op="xkey", members=len(fused.members),
+                    flow=fused.members[0].flow,
                 ):
                     flat = self._place_flat(fused.members)
+                for w in fused.members:
+                    w.stamp("place")
                 obs_metrics.counter("serve.fabric.overlapped").inc()
             except BaseException as e:
                 self._fused_error([(w, e) for w in fused.members])
@@ -829,10 +891,15 @@ class Replica:
                 "replica:dispatch", "fabric", replica=self.tag,
                 op="xkey", members=len(fused.members),
                 n=sum(len(w.live) for w in fused.members),
+                flow=fused.members[0].flow,
             ):
                 if flat is None:
                     flat = self._place_flat(fused.members)
+                    for w in fused.members:
+                        w.stamp("place")
                 out = kernel(*flat)
+            for w in fused.members:
+                w.stamp("dispatch")
         except BaseException as e:
             self._sem.release()
             self._fused_error([(w, e) for w in fused.members])
@@ -848,6 +915,7 @@ class Replica:
         return jax.device_put(work.ops, self.device)
 
     def _fence_loop(self):
+        TRACER.name_thread(f"replica {self.tag} fence")
         while True:
             item = self._fence_q.get()
             if item is None:
@@ -859,12 +927,13 @@ class Replica:
             try:
                 with TRACER.span(
                     "replica:fence", "fabric", replica=self.tag,
-                    op=work.key[0], n=len(work.live),
+                    op=work.key[0], n=len(work.live), flow=work.flow,
                 ):
                     # serve kernels donate: responses must own their
                     # bytes (guard.fence_owned), never view buffers
                     # the allocator may recycle
                     mats = fence_owned(out)
+                work.stamp("fence")
                 self._validator(work, mats, self.tag)
             except BaseException as e:
                 self._sem.release()
@@ -894,9 +963,10 @@ class Replica:
                 with TRACER.span(
                     "replica:fence", "fabric", replica=self.tag,
                     op=w.key[0], n=len(w.live),
-                    fused=len(fused.members),
+                    fused=len(fused.members), flow=w.flow,
                 ):
                     mats = fence_owned(member_out)
+                w.stamp("fence")
                 self._validator(w, mats, self.tag)
             except BaseException as e:
                 failed.append((w, e))
@@ -1005,6 +1075,12 @@ class Replica:
                     while self._queue:
                         flush.append(self._queue.popleft())
                     self._cond.notify_all()
+                if flush:
+                    # mid-drain fault handed queued batches back to the
+                    # router (flight_report's elastic.drain_flushes)
+                    obs_metrics.counter(
+                        "serve.fabric.drain_flushes"
+                    ).inc(len(flush))
             else:
                 self._consecutive += 1
                 if self._state == LIVE:

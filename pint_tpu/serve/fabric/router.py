@@ -143,7 +143,7 @@ class Router:
         Every decision is span-instrumented (pintlint rule obs4)."""
         with TRACER.span(
             "router:route", "fabric", op=work.key[0],
-            n=len(work.live),
+            n=len(work.live), flow=getattr(work, "flow", None),
         ):
             with self._lock:
                 rep = self._route_locked(work.key, set(exclude))
@@ -151,6 +151,8 @@ class Router:
             self._m_routes.inc()
             if rep is not None:
                 TRACER.annotate(replica=rep.tag)
+                if hasattr(work, "stamp"):
+                    work.stamp("route")  # stage clock (ISSUE 17)
             return rep
 
     def _is_big(self, key) -> bool:

@@ -345,6 +345,7 @@ class ObserveSession:
                 converged=resp.converged, refit=rung, alerts=alerts,
                 bucket=resp.bucket, batch_size=resp.batch_size,
                 wall_ms=resp.wall_ms, replica=resp.replica,
+                stages=resp.stages,  # the serving fit's stage vector
             ))
         except Exception as e:
             if not outer.done():

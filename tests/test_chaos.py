@@ -33,8 +33,12 @@ from pint_tpu.exceptions import PintTpuError, RequestRejected
 def test_classify_buckets_outcomes_by_type():
     from tools.chaos import classify
 
+    class _Resp:  # host-path response shape: submit+finish suffice
+        def __init__(self, stages):
+            self.stages = stages
+
     ok, rej, typed, untyped, pending = (Future() for _ in range(5))
-    ok.set_result(42)
+    ok.set_result(_Resp({"submit": 1.0, "finish": 2.0}))
     rej.set_exception(RequestRejected("quota", "over"))
     typed.set_exception(PintTpuError("diagnosed"))
     untyped.set_exception(ValueError("contract violation"))
@@ -46,8 +50,36 @@ def test_classify_buckets_outcomes_by_type():
     assert out["untyped"] == {"ValueError": 1}
     assert out["unresolved"] == 1
     assert out["typed"] is False
-    pending.set_result(0)
+    pending.set_result(_Resp({"submit": 1.0, "finish": 2.0}))
     assert classify([ok, rej, typed, pending], 0.01)["typed"] is True
+
+
+def test_classify_enforces_the_stage_vector_contract():
+    """ISSUE 17: a RESOLVED result without a complete monotonic stage
+    vector fails the leg even when every future is typed."""
+    from tools.chaos import classify
+
+    class _Resp:
+        def __init__(self, stages, replica=None):
+            self.stages = stages
+            if replica is not None:
+                self.replica = replica
+
+    bare, backwards, partial = (Future() for _ in range(3))
+    bare.set_result(42)  # no stage vector at all
+    backwards.set_result(_Resp({"submit": 2.0, "finish": 1.0}))
+    # a fabric response (replica-tagged) must carry the fabric set
+    partial.set_result(
+        _Resp({"submit": 1.0, "finish": 2.0}, replica="r0")
+    )
+    out = classify([bare, backwards, partial], timeout=0.01)
+    assert out["completed"] == 3 and not out["untyped"]
+    assert out["stage_bad"] == 3
+    assert out["typed"] is False
+    msgs = "\n".join(out["stage_violations"])
+    assert "no stage vector" in msgs
+    assert "non-monotonic" in msgs
+    assert "missing stages" in msgs
 
 
 def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):

@@ -714,6 +714,74 @@ def test_obs9_flags_stripped_stream_guards(tmp_path):
     assert obs9.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs11: the ISSUE 17 request-flow chokepoints -------------------------
+def test_obs11_flags_stripped_flow_chokepoints(tmp_path):
+    """obs11 catches a stage-clock boundary, the latency-attribution
+    chokepoint, or the flow-arc exporter losing its wiring; skips
+    packages that predate the stage-clock vocabulary; passes the
+    real tree."""
+    obs11 = rules_by_name()["obs11"]
+    # obs/metrics.py without the STAGES vocabulary -> the flow
+    # subsystem predates this package, fixture skips even with a
+    # bare serve/ present
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "obs").mkdir(parents=True)
+    (bare / "obs" / "metrics.py").write_text(
+        "def counter(name):\n    return None\n"
+    )
+    (bare / "serve").mkdir()
+    (bare / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def _admit(self, p):\n"
+        "        pass\n"
+    )
+    assert obs11.check_project(bare) == []
+    # stripped chokepoints are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "serve" / "fabric").mkdir(parents=True)
+    (pkg / "obs").mkdir()
+    (pkg / "obs" / "metrics.py").write_text(
+        'STAGES = ("submit", "finish")\n'
+    )
+    (pkg / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n"
+        "    def _admit(self, p):\n"
+        "        pass\n"
+        "    def _finish_batch(self, work, out):\n"
+        "        pass\n"
+        "    def _note_latency(self, req, stages):\n"
+        "        pass\n"
+    )
+    (pkg / "serve" / "fabric" / "router.py").write_text(
+        "class Router:\n"
+        "    def route(self, work):\n"
+        "        return None\n"
+    )
+    (pkg / "serve" / "fabric" / "replica.py").write_text(
+        "class Replica:\n"
+        "    def submit(self, work):\n"
+        "        return True\n"
+        "    def _fence_loop(self):\n"
+        "        pass\n"
+    )
+    (pkg / "obs" / "export.py").write_text(
+        "def to_chrome_trace(tracer):\n"
+        "    return {}\n"
+    )
+    msgs = "\n".join(f.message for f in obs11.check_project(pkg))
+    assert 'stages["admit"]' in msgs    # admit stamp gone
+    assert "work.stamps" in msgs        # resolution merge gone
+    assert "_m_lat_stage" in msgs       # per-stage histograms unfed
+    assert "_m_exemplars" in msgs       # exemplar reservoir unfed
+    assert 'stamp("route")' in msgs     # router boundary unstamped
+    assert 'stamp("queue")' in msgs     # replica admission unstamped
+    assert 'stamp("fence")' in msgs     # fencer unstamped
+    assert "fence_owned" in msgs        # fence stamp off-chokepoint
+    assert "thread_names" in msgs       # exporter lost its arcs
+    # the real tree carries every chokepoint
+    assert obs11.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

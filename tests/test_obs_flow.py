@@ -1,0 +1,465 @@
+"""Request-flow tracing suite (ISSUE 17) on the virtual 8-device CPU
+mesh (conftest).  Covers the stitched-flight-path surface end to end:
+
+- flow-id inheritance on the tracer: a span opened without ``flow=``
+  inherits the enclosing span's id, including across a thread handoff
+  re-parented via ``Tracer.under`` (the guard-worker idiom);
+- the stage clock through the REAL engine: every fabric-path response
+  carries the complete monotonic stage vector (all of
+  ``obs.metrics.STAGES``) plus the close-cause tag, predict carries
+  the host-only vector, and ``tools.chaos._stage_violation`` — the
+  assertion every chaos leg arms — accepts both;
+- one request rendered as a connected arc across >= 3 thread tracks
+  (submit on the caller, admit on the collector, finish on the
+  fencer), round-tripped through the Chrome-trace exporter: derived
+  's'/'t'/'f' flow records + 'M' thread-name metadata are present in
+  the export, bound inside their enclosing slices, and SKIPPED on
+  load (the span 'flow' arg is the source of truth);
+- ``WindowHistogram`` semantics: deque-era percentile formula,
+  two-sided bounding (maxlen + window expiry), reset;
+- ``ExemplarReservoir``: worst-k bound, worst-first ordering, offers
+  below the floor rejected, window expiry;
+- ``TimingEngine.reset_stats()`` clears the sliding-window latency
+  surface (p50/p99 None, stage table empty, exemplars gone) exactly
+  like the deque era;
+- the shed-reason x stage table (``note_shed_stage``/``last_stage``);
+- ``flight_report`` stream/elastic/exemplar sections and the
+  ``tools/fleetview.py`` timeline + merged-Perfetto export.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.obs import export, trace
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.obs.metrics import ExemplarReservoir, WindowHistogram
+from pint_tpu.obs.trace import Tracer
+from pint_tpu.serve import (
+    FitRequest,
+    PredictRequest,
+    ResidualsRequest,
+    TimingEngine,
+)
+from pint_tpu.simulation import make_test_pulsar
+from tools.chaos import _stage_violation
+
+PAR = """
+PSR              J0000+00{i:02d}
+F0               {f0}  1
+F1               -1.1e-15           1
+PEPOCH           55000
+DM               {dm}             1
+"""
+
+
+@pytest.fixture(scope="module")
+def pulsars():
+    out = []
+    for i, (f0, dm, n, seed) in enumerate(
+        [(107.3, 11.0, 40, 21), (203.7, 19.0, 50, 22)]
+    ):
+        m, t = make_test_pulsar(
+            PAR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+            iterations=1,
+        )
+        out.append((m.as_parfile(), t))
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(pulsars):
+    eng = TimingEngine(max_batch=4, max_wait_ms=2.0, inflight=2)
+    # warm the residuals + fit paths so later legs are steady state
+    for f in eng.submit_many(
+        [ResidualsRequest(par=p, toas=t) for p, t in pulsars]
+        + [FitRequest(par=pulsars[0][0], toas=pulsars[0][1],
+                      maxiter=2)]
+    ):
+        f.result(timeout=600)
+    yield eng
+    eng.close(timeout=60)
+
+
+# -- tracer: flow-id inheritance -----------------------------------------
+def test_span_flow_inherits_from_enclosing_span():
+    tr = Tracer()
+    tr.enabled = True
+    with tr.span("outer", "serve", flow="req-a"):
+        with tr.span("mid", "serve") as m:
+            assert m.sp.flow == "req-a"  # inherited
+            with tr.span("leaf", "serve", flow="req-b") as leaf:
+                assert leaf.sp.flow == "req-b"  # explicit wins
+    with tr.span("orphan", "serve") as o:
+        assert o.sp.flow is None  # no parent, no flow
+
+
+def test_event_inherits_flow_from_current_span():
+    tr = Tracer()
+    tr.enabled = True
+    with tr.span("outer", "serve", flow="req-e"):
+        tr.event("marker", "serve")
+    (ev,) = tr.events()
+    assert ev.flow == "req-e"
+
+
+def test_under_carries_flow_onto_worker_thread():
+    """The guard-worker idiom: a span opened on a worker thread under
+    ``Tracer.under(caller_span)`` inherits the caller's flow id AND
+    parents beneath it — the cross-thread half of flow stitching."""
+    tr = Tracer()
+    tr.enabled = True
+    seen = {}
+    with tr.span("attempt", "guard", flow="req-w") as h:
+
+        def work():
+            with tr.under(h):
+                with tr.span("inner", "dispatch") as ih:
+                    seen["flow"] = ih.sp.flow
+                    seen["thread"] = ih.sp.thread
+
+        th = threading.Thread(target=work)
+        th.start()
+        th.join()
+    assert seen["flow"] == "req-w"
+    assert seen["thread"] != threading.get_ident()
+    inner = next(s for s in tr.spans() if s.name == "inner")
+    assert inner.parent_id == h.sp.span_id
+
+
+# -- Chrome-trace flow round-trip ----------------------------------------
+def _three_thread_flow_tracer():
+    """One flow recorded across three real threads, tracks named."""
+    tr = Tracer()
+    tr.enabled = True
+    tr.name_thread("caller")
+    with tr.span("serve:submit", "serve", flow="req-9"):
+        pass
+
+    # both workers alive at once (barrier) so their thread idents are
+    # guaranteed distinct -- a joined thread's ident can be recycled
+    gate = threading.Barrier(2)
+
+    def collector():
+        tr.name_thread("collector")
+        with tr.span("serve:admit", "serve", flow="req-9"):
+            gate.wait(timeout=10)
+
+    def fencer():
+        tr.name_thread("fencer")
+        with tr.span("serve:finish", "serve", flow="req-9"):
+            with tr.span("validate", "serve"):  # inherits the flow
+                gate.wait(timeout=10)
+
+    threads = [threading.Thread(target=fn) for fn in (collector, fencer)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return tr
+
+
+def test_chrome_trace_emits_flow_arcs_and_thread_metadata(tmp_path):
+    tr = _three_thread_flow_tracer()
+    doc = export.to_chrome_trace(tracer=tr)
+    json.dumps(doc)  # Perfetto-loadable = JSON-serializable
+
+    flow_recs = [
+        r for r in doc["traceEvents"] if r.get("cat") == "flow"
+    ]
+    # 4 spans carry the flow -> 4 arc nodes: one start, one end
+    # (bound to the enclosing slice), steps between
+    assert len(flow_recs) == 4
+    assert [r["ph"] for r in flow_recs].count("s") == 1
+    ends = [r for r in flow_recs if r["ph"] == "f"]
+    assert len(ends) == 1 and ends[0]["bp"] == "e"
+    assert all(r["id"] == "req-9" for r in flow_recs)
+    # every arc node is timestamped INSIDE a slice of the same flow
+    # on the same track (how Perfetto binds arrows to slices)
+    xs = [
+        r for r in doc["traceEvents"]
+        if r.get("ph") == "X" and r["args"].get("flow") == "req-9"
+    ]
+    for rec in flow_recs:
+        assert any(
+            x["tid"] == rec["tid"]
+            and x["ts"] <= rec["ts"] <= x["ts"] + x["dur"]
+            for x in xs
+        )
+    # named thread tracks
+    m_names = {
+        r["args"]["name"]
+        for r in doc["traceEvents"]
+        if r.get("ph") == "M" and r.get("name") == "thread_name"
+    }
+    assert {"caller", "collector", "fencer"} <= m_names
+
+
+def test_chrome_trace_flow_round_trip_skips_derived_records(tmp_path):
+    tr = _three_thread_flow_tracer()
+    path = tmp_path / "trace.json"
+    export.write_chrome_trace(str(path), tracer=tr)
+    spans, events = export.load_chrome_trace(str(path))
+    # only the X records load -- the s/t/f arcs and M metadata are
+    # derived, not duplicated back into spans/events
+    assert len(spans) == len(tr.spans())
+    assert len(events) == len(tr.events())
+    flow_spans = [s for s in spans if s.flow == "req-9"]
+    assert len(flow_spans) == 4  # Span.flow restored losslessly
+    assert len({s.thread for s in flow_spans}) >= 3
+
+
+# -- the stage clock through the real engine -----------------------------
+def test_fabric_responses_carry_complete_monotonic_stage_vectors(
+    engine, pulsars
+):
+    par, toas = pulsars[0]
+    resps = [
+        f.result(timeout=600)
+        for f in engine.submit_many([
+            ResidualsRequest(par=par, toas=toas),
+            FitRequest(par=par, toas=toas, maxiter=2),
+        ])
+    ]
+    for resp in resps:
+        # the exact assertion every chaos leg arms
+        assert _stage_violation(resp) is None
+        # fabric path: the FULL canonical vector, in order
+        assert set(obs_metrics.STAGES) <= set(resp.stages)
+        ts = [resp.stages[s] for s in obs_metrics.STAGES]
+        assert ts == sorted(ts)
+        assert resp.stages["close_cause"] in ("slo", "full", "due")
+        assert obs_metrics.last_stage(resp.stages) == "finish"
+
+
+def test_predict_carries_host_only_stage_vector(engine, pulsars):
+    par, _ = pulsars[0]
+    resp = engine.submit(
+        PredictRequest(par=par, mjds=np.array([55000.0, 55000.01]))
+    ).result(timeout=600)
+    assert _stage_violation(resp) is None
+    assert {"submit", "finish"} <= set(resp.stages)
+    # host-only: never touched the fabric, so no batch stamps
+    assert "route" not in resp.stages
+    assert "fence" not in resp.stages
+
+
+def test_engine_request_flow_spans_three_thread_tracks(
+    engine, pulsars
+):
+    """The acceptance arc: one live request's spans land on >= 3
+    distinct threads (caller submit, collector admit, fencer
+    finish+validate), all stitched by the request id."""
+    par, toas = pulsars[1]
+    req = ResidualsRequest(par=par, toas=toas)
+    with trace.tracing(clear=True):
+        engine.submit(req).result(timeout=600)
+        # serve:finish closes around future resolution on the fencer
+        # thread; give its record a beat to land
+        sps, deadline = [], time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sps = [
+                s for s in trace.TRACER.spans()
+                if s.flow == req.request_id
+            ]
+            if len({s.thread for s in sps}) >= 3:
+                break
+            time.sleep(0.02)
+    names = {s.name for s in sps}
+    assert {"serve:submit", "serve:admit", "serve:finish"} <= names
+    assert len({s.thread for s in sps}) >= 3
+    # and the export of that live capture renders the arc
+    doc = export.to_chrome_trace(spans=sps, events=[])
+    arcs = [
+        r for r in doc["traceEvents"] if r.get("cat") == "flow"
+    ]
+    assert len(arcs) == len(sps) >= 3
+    assert len({r["tid"] for r in arcs}) >= 3
+
+
+def test_engine_latency_surface_and_reset_stats(engine, pulsars):
+    """stats()['latency'] breaks the window down per stage with
+    exemplars; reset_stats() clears the whole surface exactly like
+    the deque era (percentiles back to None)."""
+    par, toas = pulsars[0]
+    engine.submit(
+        ResidualsRequest(par=par, toas=toas)
+    ).result(timeout=600)
+    st = engine.stats()
+    assert st["p50_ms"] is not None and st["p99_ms"] is not None
+    lat = st["latency"]
+    assert lat["count"] >= 1 and lat["window_s"] > 0
+    # every stage histogram surfaces p50/p99; dispatched stages have
+    # real observations
+    assert set(lat["stages"]) == set(obs_metrics.STAGES[1:])
+    assert lat["stages"]["dispatch"]["p50_ms"] is not None
+    exemplars = lat["exemplars"]
+    assert exemplars and all(
+        {"lat_ms", "flow", "stages"} <= set(e) for e in exemplars
+    )
+    # worst-first ordering
+    lats = [e["lat_ms"] for e in exemplars]
+    assert lats == sorted(lats, reverse=True)
+
+    engine.reset_stats()
+    st = engine.stats()
+    assert st["p50_ms"] is None and st["p99_ms"] is None
+    lat = st["latency"]
+    assert lat["count"] == 0
+    assert all(
+        v["p50_ms"] is None for v in lat["stages"].values()
+    )
+    assert lat["exemplars"] == []
+
+
+# -- WindowHistogram semantics -------------------------------------------
+def test_window_histogram_matches_deque_era_percentile():
+    wh = WindowHistogram("t.wh")
+    t0 = time.monotonic()
+    for v in range(1, 11):  # 1..10
+        wh.observe(float(v), now=t0)
+    # sorted[min(n-1, int(q*n))] -- the deque-era formula exactly
+    assert wh.percentile(0.50) == 6.0
+    assert wh.percentile(0.99) == 10.0
+    assert wh.value == {
+        "count": 10, "p50": 6.0, "p99": 10.0, "max": 10.0,
+    }
+    assert wh.percentile(0.0) == 1.0
+
+
+def test_window_histogram_is_bounded_both_ways():
+    # maxlen caps memory
+    wh = WindowHistogram("t.wh2", maxlen=4)
+    t0 = time.monotonic()
+    for v in range(10):
+        wh.observe(float(v), now=t0)
+    assert wh.count == 4
+    # window expires old samples at read time
+    wh = WindowHistogram("t.wh3", window_s=300.0)
+    wh.observe(1.0, now=t0 - 1000.0)  # ancient
+    wh.observe(2.0, now=t0)
+    assert wh._window() == [2.0]
+    # reset empties the window (the reset_stats() contract)
+    wh.reset()
+    assert wh.count == 0 and wh.percentile(0.5) is None
+
+
+def test_exemplar_reservoir_worst_k_order_and_window():
+    r = ExemplarReservoir("t.ex", k=4, window_s=300.0)
+    t0 = time.monotonic()
+    for i in range(12):
+        r.offer(float(i), f"q{i}", {"submit": 0.0}, now=t0)
+    vals = r.value
+    assert [e["lat_ms"] for e in vals] == [11.0, 10.0, 9.0, 8.0]
+    assert [e["flow"] for e in vals] == ["q11", "q10", "q9", "q8"]
+    assert all(e["stages"] == {"submit": 0.0} for e in vals)
+    # below the floor when full: rejected without churn
+    r.offer(0.5, "meh", now=t0)
+    assert [e["flow"] for e in r.value] == ["q11", "q10", "q9", "q8"]
+    # an entry outside the window never surfaces at read time (it may
+    # evict the floor at offer time -- the reservoir stays bounded and
+    # worst-first either way)
+    r.offer(99.0, "old", now=t0 - 1000.0)
+    flows = [e["flow"] for e in r.value]
+    assert "old" not in flows
+    assert flows == ["q11", "q10", "q9"]
+
+
+def test_shed_stage_table_and_last_stage():
+    assert obs_metrics.last_stage(None) == "none"
+    assert obs_metrics.last_stage({}) == "none"
+    # canonical order wins over insertion order
+    assert obs_metrics.last_stage(
+        {"queue": 2.0, "submit": 1.0}
+    ) == "queue"
+    before = obs_metrics.REGISTRY.counter(
+        "serve.shed_stage.test-reason.queue"
+    ).value
+    obs_metrics.note_shed_stage(
+        "test-reason", {"submit": 1.0, "queue": 2.0}
+    )
+    assert obs_metrics.REGISTRY.counter(
+        "serve.shed_stage.test-reason.queue"
+    ).value == before + 1
+
+
+# -- flight_report + fleetview -------------------------------------------
+def test_flight_report_stream_elastic_and_exemplar_sections():
+    obs_metrics.counter("serve.stream.appends").inc(3)
+    obs_metrics.counter("serve.stream.drift_fallback").inc()
+    obs_metrics.counter("serve.elastic.reshapes").inc(2)
+    obs_metrics.gauge("serve.elastic.last_reshape_ms").set(12.5)
+    obs_metrics.exemplars("serve.latency.exemplars").offer(
+        42.0, "req-slow", {"submit": 1.0, "finish": 2.0}
+    )
+    try:
+        rep = export.flight_report(tracer=Tracer())
+        assert "stream:" in rep and "appends=3" in rep
+        assert "drift_fallback=1" in rep
+        assert "elastic:" in rep and "reshapes=2" in rep
+        assert "last_reshape_ms=12.5" in rep
+        assert "slowest requests (window):" in rep
+        assert "flow=req-slow" in rep and "last=finish" in rep
+    finally:
+        obs_metrics.reset("serve.stream.")
+        obs_metrics.reset("serve.elastic.")
+        obs_metrics.reset("serve.latency.exemplars")
+
+
+def test_fleetview_timeline_and_merged_perfetto(tmp_path):
+    """The fleet timeline renders lifecycle events per executor track
+    aligned with the request flows recorded in the same file, and the
+    merged Perfetto export grows synthetic named fleet tracks."""
+    from tools import fleetview
+
+    tr = _three_thread_flow_tracer()
+    with tr.span("ctx", "serve"):
+        tr.event(
+            "replica-state", "fabric",
+            replica="r0", frm="LIVE", to="DEGRADED", kind="timeout",
+        )
+        tr.event(
+            "gang-state", "fabric",
+            gang="g0", frm="LIVE", to="QUARANTINED", kind="numerics",
+        )
+        tr.event("repartition", "fabric", gangs=1, singles=2)
+    path = tmp_path / "trace.json"
+    export.write_chrome_trace(str(path), tracer=tr)
+
+    txt = fleetview.timeline(str(path))
+    assert "[r0]" in txt and "LIVE -> DEGRADED (timeout)" in txt
+    assert "[g0]" in txt and "LIVE -> QUARANTINED (numerics)" in txt
+    assert "[pool]" in txt and "repartition" in txt
+    assert "request flows" in txt and "req-9" in txt
+    assert "serve:submit -> " in txt  # the span chain digest
+
+    out = tmp_path / "fleet.json"
+    fleetview.write_perfetto(str(path), str(out))
+    with open(out) as f:
+        doc = json.load(f)
+    recs = doc["traceEvents"]
+    fleet_tracks = {
+        r["args"]["name"] for r in recs
+        if r.get("ph") == "M" and r.get("name") == "thread_name"
+        and str(r["args"].get("name", "")).startswith("fleet:")
+    }
+    assert {"fleet:r0", "fleet:g0", "fleet:pool"} <= fleet_tracks
+    fleet_events = [r for r in recs if r.get("cat") == "fleet"]
+    assert len(fleet_events) == 3
+    # synthetic tracks never collide with real thread idents
+    real_tids = {
+        r["tid"] for r in recs
+        if r.get("ph") == "X" and isinstance(r.get("tid"), int)
+    }
+    assert all(
+        r["tid"] not in real_tids for r in fleet_events
+    )
+    # the original request spans + flow arcs survive the merge
+    assert any(
+        r.get("ph") == "X" and r["args"].get("flow") == "req-9"
+        for r in recs
+    )
+    assert any(r.get("cat") == "flow" for r in recs)

@@ -130,20 +130,71 @@ def _wait_for(cond, timeout: float = 60.0, tick: float = 0.02) -> bool:
     return cond()
 
 
+#: stages every FABRIC-served response must carry (ISSUE 17 satellite:
+#: re-routes, _shed_late survivors, fallback rungs, and mid-drain
+#: flushes must not drop stamps).  'admit'/'close'/'route' are NOT
+#: required — targeted legs build _Pending directly and force-submit
+#: past the collector and the router, which legally skips those three.
+_FABRIC_STAGES = frozenset(
+    ("submit", "queue", "place", "dispatch", "fence", "finish")
+)
+#: host-only ops (predict) never touch the fabric
+_HOST_STAGES = frozenset(("submit", "finish"))
+
+
+def _stage_violation(resp) -> str | None:
+    """Check one resolved response's stage vector: complete for its
+    path and monotonic over every canonical stage present.  Returns a
+    description of the violation, or None."""
+    from pint_tpu.obs import metrics as obs_metrics
+
+    stages = getattr(resp, "stages", None)
+    if not isinstance(stages, dict):
+        return f"{type(resp).__name__} has no stage vector"
+    required = (
+        _FABRIC_STAGES if hasattr(resp, "replica") else _HOST_STAGES
+    )
+    missing = required - set(stages)
+    if missing:
+        return (
+            f"{type(resp).__name__} missing stages "
+            f"{sorted(missing)} (has {sorted(stages)})"
+        )
+    prev_s, prev_t = None, None
+    for s in obs_metrics.STAGES:
+        if s not in stages:
+            continue
+        t = stages[s]
+        if prev_t is not None and t < prev_t:
+            return (
+                f"{type(resp).__name__} non-monotonic: "
+                f"{s}={t} < {prev_s}={prev_t}"
+            )
+        prev_s, prev_t = s, t
+    return None
+
+
 def classify(futures, timeout: float = 120.0) -> dict:
     """Resolve every future and bucket its outcome by TYPE.  The
-    operability contract is ``unresolved == 0 and untyped == {}`` —
-    anything else is a chaos-sweep failure."""
+    operability contract is ``unresolved == 0 and untyped == {}`` AND
+    every completed response carries a complete monotonic stage vector
+    (``stage_bad == 0``) — anything else is a chaos-sweep failure."""
     from pint_tpu.exceptions import PintTpuError, RequestRejected
 
     out = {
         "offered": len(futures), "completed": 0, "rejected": {},
         "failed": {}, "untyped": {}, "unresolved": 0,
+        "stage_bad": 0, "stage_violations": [],
     }
     for f in futures:
         try:
-            f.result(timeout=timeout)
+            resp = f.result(timeout=timeout)
             out["completed"] += 1
+            bad = _stage_violation(resp)
+            if bad is not None:
+                out["stage_bad"] += 1
+                if len(out["stage_violations"]) < 8:
+                    out["stage_violations"].append(bad)
         except RequestRejected as e:
             out["rejected"][e.reason] = out["rejected"].get(
                 e.reason, 0) + 1
@@ -155,7 +206,10 @@ def classify(futures, timeout: float = 120.0) -> dict:
         except BaseException as e:  # the contract violation bucket
             name = type(e).__name__
             out["untyped"][name] = out["untyped"].get(name, 0) + 1
-    out["typed"] = out["unresolved"] == 0 and not out["untyped"]
+    out["typed"] = (
+        out["unresolved"] == 0 and not out["untyped"]
+        and out["stage_bad"] == 0
+    )
     return out
 
 

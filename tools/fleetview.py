@@ -1,0 +1,196 @@
+"""Fleet timeline: per-replica/gang lifecycle tracks from a trace.
+
+Renders the events the serving fabric ALREADY emits — ``replica-state``
+/ ``gang-state`` transitions (``Replica._set_state``), ``router-purge``
+epochs, ``repartition`` steps, warm-ledger ``prewarm-failed`` entries,
+``readmit`` probes, ``spill``s, and ``shed``s — as one per-executor
+timeline aligned with the request flows recorded in the same file
+(ISSUE 17).  Two outputs:
+
+- the default TEXT timeline: one track per executor tag, events in
+  time order, plus a request-flow digest (slowest flows with their
+  span chains);
+- ``--perfetto OUT.json``: the SAME trace re-written with synthetic
+  fleet tracks — every executor gets its own named thread track
+  carrying its lifecycle events, so Perfetto shows replica health
+  directly above the request-flow arcs it explains.
+
+Run::
+
+    python tools/fleetview.py trace.json [--top 10]
+    python tools/fleetview.py trace.json --perfetto fleet.json
+
+Capture with ``$PINT_TPU_TRACE=1`` and
+``pint_tpu.obs.export.write_chrome_trace`` (docs/observability.md has
+the workflow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+# importable both as a repo script and with tools/ on sys.path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pint_tpu.obs.export import load_chrome_trace  # noqa: E402
+
+#: fleet lifecycle event names -> how to find the executor tag
+_FLEET_EVENTS = {
+    "replica-state": "replica",
+    "gang-state": "gang",
+    "readmit": "replica",
+    "prewarm-failed": "replica",
+    "spill": "replica",
+    "shed": "replica",
+    "repartition": None,  # pool-wide
+    "router-purge": None,
+}
+
+
+def _fleet_tag(ev) -> str | None:
+    """The executor track an event belongs on; 'pool' for pool-wide
+    events (repartition/purge), None for non-fleet events."""
+    if ev.name not in _FLEET_EVENTS:
+        return None
+    key = _FLEET_EVENTS[ev.name]
+    if key is None:
+        return "pool"
+    return str(ev.attrs.get(key, "pool"))
+
+
+def _describe(ev) -> str:
+    if ev.name in ("replica-state", "gang-state"):
+        kind = ev.attrs.get("kind")
+        return (
+            f"{ev.attrs.get('frm')} -> {ev.attrs.get('to')}"
+            + (f" ({kind})" if kind else "")
+        )
+    attrs = " ".join(
+        f"{k}={v}" for k, v in ev.attrs.items()
+        if k not in ("replica", "gang")
+    )
+    return f"{ev.name} {attrs}".rstrip()
+
+
+def timeline(path: str, top: int = 10) -> str:
+    with open(path) as f:
+        doc = json.load(f)
+    spans, events = load_chrome_trace(doc)
+    t_zero = min(
+        [sp.t0 for sp in spans] + [ev.t for ev in events],
+        default=0.0,
+    )
+
+    tracks: dict[str, list] = defaultdict(list)
+    for ev in events:
+        tag = _fleet_tag(ev)
+        if tag is not None:
+            tracks[tag].append(ev)
+
+    lines = [f"== fleet timeline: {path} =="]
+    if not tracks:
+        lines.append(
+            "no fleet events recorded — capture with PINT_TPU_TRACE=1 "
+            "while the serving fabric runs"
+        )
+    for tag in sorted(tracks):
+        lines.append(f"[{tag}]")
+        for ev in sorted(tracks[tag], key=lambda e: e.t):
+            lines.append(
+                f"  {(ev.t - t_zero) * 1e3:>10.1f} ms  {_describe(ev)}"
+            )
+
+    # request-flow digest: slowest flows with their span chains, so
+    # the lifecycle tracks above line up with the requests they hurt
+    flows: dict[str, list] = defaultdict(list)
+    for sp in spans:
+        if sp.flow is not None:
+            flows[sp.flow].append(sp)
+    if flows:
+        ranked = sorted(
+            flows.items(),
+            key=lambda kv: (
+                max(sp.t1 for sp in kv[1]) - min(sp.t0 for sp in kv[1])
+            ),
+            reverse=True,
+        )
+        lines.append(f"{len(flows)} request flows; slowest:")
+        for fid, group in ranked[:top]:
+            group.sort(key=lambda sp: sp.t0)
+            t0 = group[0].t0
+            t1 = max(sp.t1 for sp in group)
+            chain = " -> ".join(sp.name for sp in group)
+            lines.append(
+                f"  {fid}  {(t1 - t0) * 1e3:.2f} ms  "
+                f"@{(t0 - t_zero) * 1e3:.1f} ms  {chain}"
+            )
+    return "\n".join(lines)
+
+
+def write_perfetto(path: str, out: str) -> str:
+    """Merge synthetic fleet tracks into the original export: every
+    executor tag becomes a named thread track carrying its lifecycle
+    events, alongside (same pid, aligned timestamps) the original
+    request spans and flow arcs."""
+    with open(path) as f:
+        doc = json.load(f)
+    _, events = load_chrome_trace(doc)
+    records = list(doc.get("traceEvents", []))
+    pids = [r.get("pid") for r in records if r.get("pid") is not None]
+    pid = pids[0] if pids else 0
+
+    tags = sorted({
+        t for t in (_fleet_tag(ev) for ev in events) if t is not None
+    })
+    # synthetic tids far above any real thread ident
+    base = 1 + max(
+        [r.get("tid", 0) for r in records if isinstance(r.get("tid"), int)]
+        + [1 << 20],
+    )
+    tid_for = {tag: base + i for i, tag in enumerate(tags)}
+    for tag in tags:
+        records.append({
+            "ph": "M", "name": "thread_name", "pid": pid,
+            "tid": tid_for[tag], "args": {"name": f"fleet:{tag}"},
+        })
+    for ev in events:
+        tag = _fleet_tag(ev)
+        if tag is None:
+            continue
+        records.append({
+            "ph": "i", "s": "t", "name": _describe(ev),
+            "cat": "fleet", "ts": ev.t * 1e6, "pid": pid,
+            "tid": tid_for[tag],
+            "args": dict(ev.attrs),
+        })
+    doc["traceEvents"] = records
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render the serving fleet's lifecycle timeline "
+        "from a pint_tpu flight-recorder trace."
+    )
+    ap.add_argument("trace", help="path to the trace JSON")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the slowest-flows digest")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="also write a merged Perfetto export with "
+                    "synthetic fleet tracks")
+    args = ap.parse_args(argv)
+    print(timeline(args.trace, top=args.top))
+    if args.perfetto:
+        out = write_perfetto(args.trace, args.perfetto)
+        print(f"wrote merged Perfetto export: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

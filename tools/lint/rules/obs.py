@@ -382,6 +382,51 @@ _ELASTIC_CHECKS = (
 )
 
 
+_FLOW_ENGINE_CHECKS = (
+    ("serve/engine.py", "TimingEngine._admit",
+     ('stages["admit"]', "flow="),
+     "the admission boundary must stamp the 'admit' stage and open "
+     "its span with the request's flow id — the first cross-thread "
+     "hop of the stitched flight path (docs/observability.md "
+     "'request flows')"),
+    ("serve/engine.py", "TimingEngine._finish_batch",
+     ("work.stamps", '"finish"'),
+     "resolution must merge the batch's fabric stamps into each "
+     "member's stage vector and stamp 'finish' — dropping either "
+     "breaks the complete-monotonic-vector contract chaos asserts"),
+    ("serve/engine.py", "TimingEngine._note_latency",
+     ("_m_lat_stage", "_m_exemplars"),
+     "the latency chokepoint must feed the per-stage window "
+     "histograms and the slow-request exemplar reservoir — the "
+     "attribution surface stats()['latency'] serves"),
+)
+
+_FLOW_FABRIC_CHECKS = (
+    ("serve/fabric/router.py", "Router.route",
+     ('stamp("route")',),
+     "a successful routing decision must stamp the 'route' stage on "
+     "the batch — the router->replica boundary of the stage clock"),
+    ("serve/fabric/replica.py", "Replica.submit",
+     ('stamp("queue")',),
+     "replica admission must stamp the 'queue' stage — re-routes "
+     "re-stamp it, so queue dwell is always attributed to the "
+     "replica that actually dispatched"),
+    ("serve/fabric/replica.py", "Replica._fence_loop",
+     ('stamp("fence")', "fence_owned"),
+     "the fencer must stamp the 'fence' stage after fence_owned — "
+     "device dwell vs host materialization is the breakdown the "
+     "dispatch-floor work keys on"),
+)
+
+_FLOW_EXPORT_CHECKS = (
+    ("obs/export.py", "to_chrome_trace",
+     ("flows", "thread_names"),
+     "the Chrome-trace exporter must emit the flow arcs (s/t/f "
+     "records) and named-thread metadata — without them Perfetto "
+     "renders disconnected slices, not a request's flight path"),
+)
+
+
 def _run_checks(rule, pkg_root: Path, checks, subdir: Path) -> list:
     if not subdir.is_dir():
         return []
@@ -612,6 +657,37 @@ class Obs10Rule(Rule):
         )
 
 
+class Obs11Rule(Rule):
+    """Request-flow chokepoints (ISSUE 17): stage stamps at the
+    admit/route/queue/fence boundaries, the latency-attribution
+    chokepoint feeding window histograms + exemplars, resolution
+    merging the fabric stamps, flow arcs in the exporter."""
+
+    name = "obs11"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the stage-clock vocabulary itself: fixture packages
+        # that predate the flow subsystem skip (obs7..obs10
+        # convention)
+        metrics = pkg_root / "obs" / "metrics.py"
+        if not metrics.is_file() or "STAGES" not in metrics.read_text():
+            return []
+        findings = _run_checks(
+            self.name, pkg_root, _FLOW_ENGINE_CHECKS,
+            pkg_root / "serve",
+        )
+        findings += _run_checks(
+            self.name, pkg_root, _FLOW_FABRIC_CHECKS,
+            pkg_root / "serve" / "fabric",
+        )
+        findings += _run_checks(
+            self.name, pkg_root, _FLOW_EXPORT_CHECKS,
+            pkg_root / "obs",
+        )
+        return findings
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
@@ -622,7 +698,9 @@ OBS7 = Obs7Rule()
 OBS8 = Obs8Rule()
 OBS9 = Obs9Rule()
 OBS10 = Obs10Rule()
-RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9, OBS10)
+OBS11 = Obs11Rule()
+RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9, OBS10,
+         OBS11)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
