@@ -579,6 +579,163 @@ def _mfu_block(cm):
     }
 
 
+def _fused_interior_block(cm, mode, t_dev):
+    """ISSUE 18 `fused_interior` block: the VMEM-resident joint-Gram
+    pipeline (ops/pallas_fit.py, routed from fitting/gls.py behind
+    ops/solve_policy.py) scored against the PINT_TPU_FUSED_INTERIOR=0
+    hatch on the SAME north-star step.
+
+    perf gate (accelerators) — the fused interior is the production
+    default there, so the main `dev_step_ms` already measures it; this
+    block re-times the identical chained program under the hatch
+    (fresh trace: the env is read at TRACE time) and GATES the ratio
+    >= 1.3x — the HBM-round-trip toll the fusion banks.  On CPU the
+    fused default is OFF and `force` runs the Pallas interpreter (a
+    correctness probe, not a perf number — profiling/dispatch_floor.py
+    carries the forced ladder), so the timing legs are skipped.
+
+    parity gate (ALL backends) — one mixed GLS step FORCED vs hatched
+    must agree within the _woodbury_mixed_tail contract (dx 2e-3 of
+    the largest component, chi2 1e-3 relative, normalized covariance
+    5e-3), with inverted comparisons so a NaN fails the gate.
+
+    retrace gate — extra warmed executions of the forced step leave
+    its pjit cache at ONE entry (zero steady retraces; the
+    serve-bucket-ladder version is pinned in
+    tests/test_fused_interior.py)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.exceptions import PintTpuError
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+    from pint_tpu.ops.pallas_fit import fused_block_table
+
+    accel = jax.default_backend() != "cpu"
+    x = cm.x0()
+    T, _phi = cm.noise_basis_or_empty(x)
+    n, k = int(T.shape[0]), int(T.shape[1])
+    p1 = int(design_with_offset(cm, x).shape[1]) + 1  # + residual col
+    tab = fused_block_table(n, k, p1)
+
+    def _env_under(setting):
+        saved = os.environ.get("PINT_TPU_FUSED_INTERIOR")
+        if setting is None:
+            os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        else:
+            os.environ["PINT_TPU_FUSED_INTERIOR"] = setting
+        return saved
+
+    def _env_restore(saved):
+        if saved is None:
+            os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        else:
+            os.environ["PINT_TPU_FUSED_INTERIOR"] = saved
+
+    # timing legs (accelerators): fused is the default — t_dev IS the
+    # fused number.  Hatch leg re-runs the exact _time_step recipe
+    # (chain=256, cm.jit threads the bundle as a runtime argument).
+    t_hatch = None
+    speedup = None
+    if accel and tab is not None:
+        saved = _env_under("0")
+        try:
+            step_hatch = _fit_step_fn(cm, mode=mode)
+            t_hatch = _time_step(step_hatch, x, chain=256,
+                                 jit_wrap=cm.jit)
+        finally:
+            _env_restore(saved)
+        speedup = t_hatch / t_dev
+        if speedup < 1.3:
+            raise PintTpuError(
+                f"fused_interior gate: the fused interior is only "
+                f"{speedup:.2f}x over the PINT_TPU_FUSED_INTERIOR=0 "
+                "hatch on the north-star step (gate >= 1.3x on "
+                "accelerators) — the VMEM-resident Gram pipeline lost "
+                "its HBM-traffic advantage (ISSUE 18)"
+            )
+
+    # parity gate (all backends): forced vs hatched, fresh jit per
+    # setting — pjit's global cache keys on function identity, so one
+    # reused wrapper would silently replay the first setting's trace
+    def _step_under(setting, extra_calls=0):
+        saved = _env_under(setting)
+
+        @jax.jit
+        def stepfn(xx):
+            rr = cm.time_residuals(xx, subtract_mean=False)
+            MM = design_with_offset(cm, xx)
+            Nd = jnp.square(cm.scaled_sigma(xx))
+            TT, pp = cm.noise_basis_or_empty(xx)
+            return gls_step_woodbury_mixed(
+                rr, MM, Nd, TT, pp, normalized_cov=True
+            )
+
+        try:
+            dx, (covn, nm), chi2, _ = stepfn(x)
+            out = (np.asarray(dx), np.asarray(covn), float(chi2))
+            for _ in range(extra_calls):
+                stepfn(x)
+            return out, int(stepfn._cache_size())
+        finally:
+            _env_restore(saved)
+
+    (dx_off, cov_off, chi_off), _ = _step_under("0")
+    (dx_on, cov_on, chi_on), cache_n = _step_under("force",
+                                                   extra_calls=3)
+    dx_rel = float(np.max(np.abs(dx_on - dx_off))
+                   / np.max(np.abs(dx_off)))
+    chi_rel = abs(chi_on - chi_off) / abs(chi_off)
+    cov_rel = float(np.max(np.abs(cov_on - cov_off))
+                    / np.max(np.abs(cov_off)))
+    # inverted comparisons: a NaN (poisoned IR solve, or a fused Gram
+    # that overflowed) must FAIL the gate, and `nan > tol` is False
+    if not (dx_rel <= 2e-3 and chi_rel <= 1e-3 and cov_rel <= 5e-3):
+        raise PintTpuError(
+            "fused_interior gate: the fused-interior mixed step "
+            f"diverged from the hatched step (dx_rel={dx_rel:.2e} "
+            f"gate 2e-3, chi2_rel={chi_rel:.2e} gate 1e-3, cov_rel="
+            f"{cov_rel:.2e} gate 5e-3; nan = poisoned solve) — "
+            "ops/pallas_fit.py broke the _woodbury_mixed_tail "
+            "contract (ISSUE 18)"
+        )
+    if cache_n != 1:
+        raise PintTpuError(
+            f"fused_interior gate: {cache_n} executables for one "
+            "warmed fused step (gate: exactly 1) — the fused interior "
+            "retraced at steady state (ISSUE 18)"
+        )
+
+    return {
+        "active_default": bool(accel and tab is not None),
+        "block_table": (
+            None if tab is None
+            else {"block_n": tab[0], "k_pad": tab[1], "p1_pad": tab[2]}
+        ),
+        "n": n, "k": k, "p1": p1,
+        "fused_step_ms": (
+            round(t_dev * 1e3, 4) if speedup is not None else None
+        ),
+        "hatch_step_ms": (
+            round(t_hatch * 1e3, 4) if t_hatch is not None else None
+        ),
+        "speedup_x": (
+            round(speedup, 3) if speedup is not None else None
+        ),
+        "speedup_gate": ">=1.3x on accelerators (CPU: interpret-mode"
+                        " correctness probe only)",
+        "steady_executables": cache_n,
+        "parity": {
+            "dx_rel": round(dx_rel, 9),
+            "chi2_rel": round(chi_rel, 9),
+            "cov_rel": round(cov_rel, 9),
+            "gates": "dx<=2e-3 chi2<=1e-3 cov<=5e-3 (all backends)",
+        },
+    }
+
+
 def _serve_block():
     """Serving telemetry for BENCH_*.json (ISSUE 4 — pint_tpu/serve):
     a mixed-size fleet of same-composition pulsars served as fits,
@@ -1728,6 +1885,7 @@ def main():
     obs_block = _obs_block(serve_rps=serve_block["requests_per_s"])
     stream_block = _stream_block()
     mfu_block = _mfu_block(cm)
+    fused_block = _fused_interior_block(cm, mode, t_dev)
 
     # CPU baseline: the all-f64 reference-class computation on host
     # (dispatch-free, so a short chain measures the same steady state).
@@ -1797,6 +1955,7 @@ def main():
                 "serve": serve_block,
                 "stream": stream_block,
                 "mfu": mfu_block,
+                "fused_interior": fused_block,
                 "cold": {
                     **cold_block,
                     # executables persisted by THIS run: >0 on a cold

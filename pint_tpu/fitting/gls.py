@@ -261,16 +261,47 @@ def gls_step_woodbury_mixed(r, M, Ndiag, T, phi, normalized_cov=False):
     (ops/ffgram.py); the shared mixed-precision assembly
     (_woodbury_mixed_tail, which documents the precision contract)
     finishes the solve.
-    """
-    from pint_tpu.ops.ffgram import gram32_joint
 
+    Interior fusion (ISSUE 18): under solve_policy.fused_interior_active
+    the joint Gram runs as ONE VMEM-resident Pallas grid pass
+    (ops/pallas_fit.py::fused_gram_joint) instead of the chunked XLA
+    pipeline — same |max|-prescale, weights, and chunk-128 f32
+    accumulation class, with the per-chunk partials never leaving
+    VMEM.  Shapes outside the VMEM block table, and traces under
+    solve_policy.fused_interior_bypass (gang shard mode), keep the
+    unfused gram32_joint; PINT_TPU_FUSED_INTERIOR=0 restores it
+    bitwise everywhere.  The route is decided at TRACE time from
+    static shapes — steady serve traffic never retraces on it.
+    """
     Ninv = 1.0 / Ndiag
     norm = _column_norms(M)
     Mn = M / norm[None, :]
     X = jnp.concatenate([Mn, r[:, None]], axis=1)
-    sig_tt, twx, G_XX = gram32_joint(T.astype(jnp.float32), X, Ninv)
+    sig_tt, twx, G_XX = _joint_gram(T, X, Ninv)
     return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,
                                 normalized_cov)
+
+
+def _joint_gram(T, X, Ninv):
+    """The fused-or-unfused joint Gram dispatch for the mixed Woodbury
+    interior — the ONE chokepoint solve_policy gates (pintlint obs12
+    pins it): fused Pallas pass when the policy is active and the
+    static shape fits the VMEM block table, the chunked XLA
+    gram32_joint otherwise (bitwise the pre-fusion path)."""
+    from pint_tpu.ops import solve_policy
+    from pint_tpu.ops.ffgram import gram32_joint
+
+    n, p1 = X.shape
+    k = T.shape[-1]
+    if solve_policy.fused_interior_active():
+        from pint_tpu.ops.pallas_fit import (
+            fused_block_table,
+            fused_gram_joint,
+        )
+
+        if fused_block_table(n, k, p1) is not None:
+            return fused_gram_joint(T.astype(jnp.float32), X, Ninv)
+    return gram32_joint(T.astype(jnp.float32), X, Ninv)
 
 
 def default_accel_mode(cm) -> str:

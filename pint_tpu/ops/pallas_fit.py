@@ -1,0 +1,234 @@
+"""Fused VMEM-resident fit-step interior (ISSUE 18).
+
+The mixed accelerator GLS step (fitting/gls.py::gls_step_woodbury_mixed)
+feeds the Woodbury solve from a chain of separate XLA ops: row-scale the
+jacfwd design columns by sqrt(N^-1), concatenate [T | Mn | r], pad,
+reshape to 128-row chunks, batched f32 Gram, f64 chunk reduction — each
+op a full HBM round-trip of the (n, k+p+1) working set, and on the
+emulated-f64 backend the elementwise prep runs as multi-op
+double-double sequences.  :func:`fused_gram_joint` collapses the whole
+interior into ONE Pallas grid pass: per TOA block the |max|-prescaled
+(``_column_norms``, applied by the caller exactly as the unfused path
+does) weighted columns stay VMEM-resident while the MXU accumulates the
+M^T N^-1 M Gram, M^T N^-1 r gradient, r^T N^-1 r, and the
+T^T N^-1 M / T^T N^-1 r noise-basis products in the same pass — the
+small k x k / p x p results then feed ops/ffgram.py::chol_solve_ir
+unchanged.  HBM traffic drops from ~5 round-trips of the working set to
+one read; the Gram partials (the (n/128, q, q) f32 tensor the unfused
+path writes and re-reads — ~200 MB/step at bench scale before its f64
+reduction) never exist.
+
+Precision contract (the r15 ladder, carried over):
+
+- in-kernel contractions take the explicit ``precision``
+  ('highest'|'high'|'default') bf16 multi-pass ladder; 'high' (bf16x3,
+  preconditioner-grade) is legal here only because this module is
+  ``ir-refined`` — every consumer refines through chol_solve_ir.
+- accumulation: 128-row sub-chunk f32 dots (the gram32 chunking, so
+  in-chunk error matches ops/ffgram.py::_chunked_gram_f32), plain f32
+  within one grid block (<= block/128 partials), and Neumaier
+  -compensated f32 ACROSS grid blocks (sum + compensation output refs,
+  combined in f64 outside the kernel) — cross-block accumulation error
+  is one rounding of each block partial, the f64-reduction class, not
+  O(n/128) f32 roundings.  Measured against the f64 reference this
+  lands in the same ~1e-7 class as gram32_joint (tests/
+  test_fused_interior.py), orders under the _woodbury_mixed_tail
+  contract tolerances.
+- the |max|-prescale happens BEFORE any square/sum (the caller passes
+  Mn = M / _column_norms(M), and padded TOAs carry weight 0), so no
+  squared intermediate leaves the f32 exponent range the emulated-f64
+  backend inherits; the raw-column f32 cast keeps the r5
+  weighted-design ceiling (|column| < ~3.4e38) unchanged.
+- traced under ``enable_x64(False)`` (Mosaic cannot legalize int64
+  grid indices); all f32 casts happen BEFORE entering the context and
+  the f64 combine after leaving it.
+
+Block table: :func:`fused_block_table` sizes the TOA block to the
+~16 MB/core VMEM limit as a pure function of the PADDED shapes —
+serve traffic arrives in power-of-two TOA buckets, so equal bucket
+shapes always resolve to the same block and a warmed kernel can never
+retrace on the table.  Shapes whose accumulators alone would blow the
+budget return None and the caller falls back to the unfused path at
+trace time (ops/solve_policy.py::fused_interior_active gates the
+route; PINT_TPU_FUSED_INTERIOR=0 restores the unfused path bitwise).
+
+On CPU the kernel runs in interpret mode (parity tests force the
+route with PINT_TPU_FUSED_INTERIOR=force).
+
+Reference parity: none directly — a TPU-native fusion of the
+src/pint/fitter.py::GLSFitter.fit_toas normal-equation assembly this
+framework already reproduces through ops/ffgram.py::gram32_joint.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from pint_tpu.ops.pallas_kernels import (
+    _PRECISIONS,
+    _block_size,
+    _enable_x64,
+    _on_cpu,
+    _pad_to,
+)
+
+# lint: module(matmul-highest) — every in-kernel dot_general carries an
+# explicit precision from the bf16 pass ladder (rule f64-emu)
+# lint: module(ir-refined) — the 'high' rung is preconditioner-grade by
+# the ops/solve_policy.py contract (rule f64-emu check 5)
+
+#: in-kernel sub-chunk: f32 accumulation depth per dot matches
+#: ops/ffgram.py::_chunked_gram_f32's chunk=128 error class
+_SUB = 128
+
+#: VMEM working-set budget per grid step (bytes): ~16 MB/core on the
+#: bench hardware, minus headroom for Mosaic's own double-buffering of
+#: the streamed input blocks and the fixed accumulators
+_VMEM_BUDGET = 10 * 2**20
+
+
+def fused_block_table(n: int, k: int, p1: int):
+    """TOA block size for a fused joint Gram over T (n, k) and
+    X (n, p1), or None when the shape cannot fit the VMEM budget.
+
+    Pure function of the (padded) static shapes — the shape-bucketed
+    block table: serve buckets are powers of two, so every request in
+    a bucket resolves to the identical block and the warmed kernel
+    never retraces.  Returns (bn, k_pad, p1_pad).
+
+    Budget model (f32 bytes per grid step): the streamed T/X input
+    blocks plus the in-VMEM concatenated weighted block, ~3 copies of
+    bn * q rows (Mosaic double-buffers the inputs), and the fixed
+    sum/compensation accumulators plus one live sub-chunk partial,
+    3 * q^2."""
+    k_pad = _pad_to(max(k, 1), 128)
+    p1_pad = _pad_to(max(p1, 1), 128)
+    q = k_pad + p1_pad
+    fixed = 3 * q * q * 4
+    if fixed > _VMEM_BUDGET // 2:
+        return None
+    bn = (_VMEM_BUDGET - fixed) // (3 * q * 4)
+    bn = min(8192, (bn // _SUB) * _SUB)
+    if bn < _SUB:
+        return None
+    return _block_size(_pad_to(max(n, 1), _SUB), bn), k_pad, p1_pad
+
+
+def _joint_gram_kernel(prec, nsub, s_ref, t_ref, x_ref, sum_ref,
+                       comp_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        comp_ref[:] = jnp.zeros_like(comp_ref)
+
+    s = s_ref[0, :]  # (BN,) sqrt(N^-1); 0 on padded TOAs
+    # the whole weighted, |max|-prescaled working block lives here in
+    # VMEM — never written back to HBM
+    y = jnp.concatenate([t_ref[:], x_ref[:]], axis=1) * s[:, None]
+    g = None
+    for j in range(nsub):  # static unroll: 128-row f32 sub-chunks
+        yj = y[j * _SUB:(j + 1) * _SUB, :]
+        gj = jax.lax.dot_general(
+            yj, yj, (((0,), (0,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32,
+        )
+        g = gj if g is None else g + gj
+    # Neumaier-compensated cross-block accumulation: the f64 combine
+    # of (sum + comp) outside the kernel recovers each block partial
+    # to one rounding, the error class of the unfused f64 reduction
+    acc = sum_ref[:]
+    new = acc + g
+    comp_ref[:] += jnp.where(
+        jnp.abs(acc) >= jnp.abs(g), (acc - new) + g, (g - new) + acc
+    )
+    sum_ref[:] = new
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "precision")
+)
+def fused_gram_joint(T32, A, w, block=None, precision: str = "highest"):
+    """Joint Gram of [T | A] under diag(w) as ONE fused Pallas pass —
+    the drop-in sibling of ops/ffgram.py::gram32_joint: T32 (n, k) f32
+    basis columns, A (n, p1) f64 |max|-prescaled design + residual
+    columns, w (n,) non-negative weights.
+
+    Returns (G_TT (k, k), G_TA (k, p1), G_AA (p1, p1)) f64 with
+    G_XY = X^T diag(w) Y.  ``block`` overrides the VMEM block table
+    (tests); ``precision`` selects the MXU pass ladder for the
+    in-kernel contractions (module docstring).  Raises ValueError when
+    the shape is outside the block table — callers gate on
+    fused_block_table first (fitting/gls.py does)."""
+    n, k = T32.shape
+    p1 = A.shape[1]
+    tab = fused_block_table(n, k, p1)
+    if tab is None:
+        raise ValueError(
+            f"fused_gram_joint: (n={n}, k={k}, p1={p1}) exceeds the "
+            "VMEM block table — route through ops/ffgram.py::"
+            "gram32_joint instead (fused_block_table returned None)"
+        )
+    bn, k_pad, p1_pad = tab
+    if block is not None:
+        bn = _block_size(_pad_to(n, _SUB), _pad_to(block, _SUB))
+    # sqrt in f64 then ONE cast — the gram32_joint weight recipe
+    s = jnp.sqrt(w)
+    # casts BEFORE the x64-off context (pallas_kernels.py: inside it
+    # some jax versions elide the f64->f32 convert)
+    s32 = s.astype(jnp.float32)
+    A32 = A.astype(jnp.float32)
+    T32 = T32.astype(jnp.float32)
+    with _enable_x64(False):
+        Gs, Gc = _fused_gram_32(
+            T32, A32, s32, bn, k_pad, p1_pad, _PRECISIONS[precision]
+        )
+    # f64 combine OUTSIDE enable_x64(False) (inside it the f64 convert
+    # would canonicalize back to f32)
+    G = Gs.astype(jnp.float64) + Gc.astype(jnp.float64)
+    # int32 gather indices: the int64 default would fail stablehlo
+    # verification on some jax versions (see pallas_kernels.py)
+    ti = np.arange(k, dtype=np.int32)
+    xi = np.int32(k_pad) + np.arange(p1, dtype=np.int32)
+    return G[np.ix_(ti, ti)], G[np.ix_(ti, xi)], G[np.ix_(xi, xi)]
+
+
+def _fused_gram_32(T32, A32, s32, bn, k_pad, p1_pad, prec):
+    n = T32.shape[0]
+    k = T32.shape[1]
+    p1 = A32.shape[1]
+    n_pad = _pad_to(n, bn)
+    q = k_pad + p1_pad
+
+    s_p = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(s32)
+    t_p = jnp.zeros((n_pad, k_pad), jnp.float32).at[:n, :k].set(T32)
+    x_p = jnp.zeros((n_pad, p1_pad), jnp.float32).at[:n, :p1].set(A32)
+
+    grid = (n_pad // bn,)
+    Gs, Gc = pl.pallas_call(
+        functools.partial(_joint_gram_kernel, prec, bn // _SUB),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((bn, k_pad), lambda i: (i, 0)),
+            pl.BlockSpec((bn, p1_pad), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((q, q), lambda i: (0, 0)),
+            pl.BlockSpec((q, q), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q, q), jnp.float32),
+            jax.ShapeDtypeStruct((q, q), jnp.float32),
+        ],
+        interpret=_on_cpu(),
+    )(s_p, t_p, x_p)
+    return Gs, Gc

@@ -48,7 +48,9 @@ retrace on it.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 import jax
 
@@ -135,6 +137,56 @@ def stream_drift_rtol() -> float:
             "PINT_TPU_STREAM_DRIFT_RTOL", str(DEFAULT_STREAM_RTOL)
         )
     )
+
+
+def fused_interior_setting() -> str:
+    return os.environ.get(
+        "PINT_TPU_FUSED_INTERIOR", "1"
+    ).strip().lower()
+
+
+#: thread-local trace context for :func:`fused_interior_bypass` —
+#: shard-mode gang kernels trace under the bypass (a Mosaic custom
+#: call under GSPMD auto-partitioning is a composition hazard the
+#: unfused XLA Gram does not have); solo-mode programs stay fused
+_fused_bypass = threading.local()
+
+
+@contextlib.contextmanager
+def fused_interior_bypass():
+    """Trace-time context that pins the unfused Gram regardless of
+    PINT_TPU_FUSED_INTERIOR.  serve/fabric/gang.py wraps shard-mode
+    kernel TRACES in it (GangReplica._kernel_for): the GSPMD
+    partitioner shards the unmodified XLA program, which must not
+    contain the Pallas custom call.  Per-thread and re-entrant; the
+    steady-state cost after the first trace is one context enter on
+    the dispatch thread."""
+    prev = getattr(_fused_bypass, "on", 0)
+    _fused_bypass.on = prev + 1
+    try:
+        yield
+    finally:
+        _fused_bypass.on = prev
+
+
+def fused_interior_active() -> bool:
+    """Whether the mixed GLS step routes its Gram interior through the
+    fused Pallas pipeline (ops/pallas_fit.py::fused_gram_joint).
+
+    Same shape as :func:`ir_active`: accelerator-only by default,
+    ``PINT_TPU_FUSED_INTERIOR=0`` restores the unfused
+    ops/ffgram.py::gram32_joint path BITWISE on every backend,
+    ``=force`` enables it on CPU (interpret-mode parity tests).  Read
+    at TRACE time — static per compiled kernel, zero steady retraces.
+    The :func:`fused_interior_bypass` context wins over everything."""
+    if getattr(_fused_bypass, "on", 0):
+        return False
+    s = fused_interior_setting()
+    if s in ("0", "off", "false", ""):
+        return False
+    if s == "force":  # tests: the Pallas route on the CPU mesh
+        return True
+    return jax.default_backend() != "cpu"
 
 
 def dense_lookahead() -> bool:

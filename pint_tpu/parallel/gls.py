@@ -102,6 +102,12 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     same precision contract as the single-device mixed path
     (_woodbury_mixed_tail; chunk-level f64 accumulation happens within
     each shard, and the cross-shard psum is f64).
+
+    Under solve_policy.fused_interior_active each shard's local Gram
+    runs the fused Pallas pass instead (shard_map is MANUAL
+    partitioning — the kernel sees a per-device static shape, so the
+    GSPMD auto-partitioning hazard that makes gang shard mode bypass
+    the fusion does not apply here); the psum pattern is unchanged.
     """
     try:
         from jax import shard_map
@@ -110,7 +116,22 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
 
     from pint_tpu.fitting.gls import _column_norms
     from pint_tpu.fitting.gls import _woodbury_mixed_tail
+    from pint_tpu.ops import solve_policy
     from pint_tpu.ops.ffgram import gram32_joint
+
+    # fused-interior decision OUTSIDE shard_map, on the PER-SHARD
+    # static shape (shard_map splits the TOA axis evenly): the fused
+    # branch needs check_rep=False (pallas_call has no replication
+    # rule), so the choice of gram and the shard_map flags must agree
+    use_fused = False
+    if solve_policy.fused_interior_active():
+        from pint_tpu.ops.pallas_fit import fused_block_table
+
+        n_s = -(-r.shape[0] // mesh.size)
+        use_fused = (
+            fused_block_table(n_s, T.shape[-1], M.shape[-1] + 1)
+            is not None
+        )
 
     norm = _column_norms(M)
     Mn = M / norm[None, :]
@@ -118,9 +139,16 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
     def local_grams(r_s, Mn_s, Nd_s, T_s):
         Ninv = 1.0 / Nd_s
         X = jnp.concatenate([Mn_s, r_s[:, None]], axis=1)
-        sig_tt, twx, G_XX = gram32_joint(
-            T_s.astype(jnp.float32), X, Ninv
-        )
+        if use_fused:
+            from pint_tpu.ops.pallas_fit import fused_gram_joint
+
+            sig_tt, twx, G_XX = fused_gram_joint(
+                T_s.astype(jnp.float32), X, Ninv
+            )
+        else:
+            sig_tt, twx, G_XX = gram32_joint(
+                T_s.astype(jnp.float32), X, Ninv
+            )
         return jax.tree_util.tree_map(
             lambda b: jax.lax.psum(b, axis), (sig_tt, twx, G_XX)
         )
@@ -130,6 +158,9 @@ def sharded_gls_step_mixed(mesh, r, M, Ndiag, T, phi, axis: str = "toa",
         mesh=mesh,
         in_specs=(P(axis), P(axis, None), P(axis), P(axis, None)),
         out_specs=(P(), P(), P()),
+        # the unfused path keeps replication checking exactly as
+        # before (check_rep=True is bitwise the pre-fusion program)
+        check_rep=not use_fused,
     )
     sig_tt, twx, G_XX = sm(r, Mn, Ndiag, T)
     return _woodbury_mixed_tail(G_XX, sig_tt, twx, phi, norm,

@@ -60,6 +60,7 @@ from jax import tree_util
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.ops import solve_policy
 from pint_tpu.obs.trace import TRACER
 from pint_tpu.parallel.mesh import gang_mesh
 from pint_tpu.runtime.guard import dispatch_guard, validate_finite
@@ -171,6 +172,28 @@ class GangReplica(Replica):
         ):
             return tree_util.tree_map(place, work.ops)
 
+    def _donates(self, work: BatchWork) -> bool:
+        """Shard-mode kernels must NOT take the serving donation
+        contract.  A width-1 replica's donation is per-device sound:
+        every operand buffer and every aliased output live on the one
+        device.  A GSPMD-partitioned gang program is different — the
+        replicated leaves (the x0 stack, sub-bucket refs) commit one
+        buffer per member device, and donating them lets XLA recycle a
+        device's input buffer into output/scratch while the collective
+        schedule still has peer shards reading the logically-same
+        operand.  On the multi-device CPU mesh (one address space,
+        zero-copy host buffers) this is an intermittent, scheduling
+        -timing-dependent corruption of the fit interior: the sharded
+        downhill fit would sporadically return ``converged=False``
+        with a shifted chi2 and garbage noise-floor deltas — bitwise
+        -stable within a process, flipping run-to-run with compile
+        -cache state (which only changes TIMING).  Root-caused via
+        ``PINT_TPU_DONATE=0`` bisection (flake vanishes).  Solo-mode
+        work donates exactly like a width-1 replica; re-enabling
+        shard-mode donation requires proving per-device buffer
+        disjointness end-to-end on every backend first."""
+        return not self._wants_shard(work)
+
     def _fusible(self, work: BatchWork) -> bool:
         """Sharded dispatches never cross-key fuse: a shard-mode
         member's operand leaves commit with a mesh ``NamedSharding``
@@ -190,6 +213,28 @@ class GangReplica(Replica):
         retrace in traced_jit)."""
         mode = "shard" if self._wants_shard(work) else "solo"
         return (work.key, work.cap, (self.width,), mode)
+
+    def _kernel_for(self, work: BatchWork):
+        """Shard-mode kernels trace under
+        solve_policy.fused_interior_bypass: the gang path GSPMD
+        -partitions the UNMODIFIED traced program from the committed
+        input shardings, and a Mosaic custom call (the ISSUE-18 fused
+        Gram) inside an auto-partitioned program is a composition
+        hazard the chunked XLA Gram does not have — so sharded
+        programs keep the unfused interior.  Solo-mode kernels pass
+        through untouched: bitwise parity with a width-1 replica
+        (which runs the fused interior when active) is preserved.
+        The bypass is a trace-time knob; warm dispatches pay one
+        thread-local context enter."""
+        k = super()._kernel_for(work)
+        if not self._wants_shard(work):
+            return k
+
+        def bypassed(*args):
+            with solve_policy.fused_interior_bypass():
+                return k(*args)
+
+        return bypassed
 
     def _warmed(self, key, cap: int) -> bool:
         mode = "shard" if self._shards_key(key) else "solo"

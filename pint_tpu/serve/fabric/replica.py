@@ -192,13 +192,17 @@ class BatchWork:
     def kernel_key(self) -> tuple:
         return (self.key, self.cap)
 
-    def make_kernel(self, tag: str):
+    def make_kernel(self, tag: str, donate: bool = True):
         """Build this batch's kernel for one replica (the site carries
         the replica tag so spans/faults are per-replica pinnable).
         ``warm`` threads the warm-restart ledger write-through down to
         traced_jit: the kernel's first trace records (session, key,
         capacity, tag) so a restarted process can replay exactly this
-        warm surface (serve/warm_ledger.py, ISSUE 11)."""
+        warm surface (serve/warm_ledger.py, ISSUE 11).  ``donate``
+        threads the executor's donation verdict
+        (:meth:`Replica._donates`) down to the builders — gang
+        shard-mode kernels must trace WITHOUT the serving donation
+        contract (GangReplica._donates documents the race)."""
         from pint_tpu.serve import session as smod
 
         site = (
@@ -209,14 +213,18 @@ class BatchWork:
         if self.key[0] == "fit":
             _, _, _, mode, maxiter, tol = self.key
             return smod.build_fit_kernel(
-                self.session, mode, maxiter, tol, site, warm=warm
+                self.session, mode, maxiter, tol, site, warm=warm,
+                donate=donate,
             )
         if self.key[0] == "append":
             # warm ledger excluded: replay cannot synthesize a
             # solver-state stack (build_append_kernel documents)
-            return smod.build_append_kernel(self.session, site)
+            return smod.build_append_kernel(
+                self.session, site, donate=donate
+            )
         return smod.build_residuals_kernel(
-            self.session, self.key[3], site, warm=warm
+            self.session, self.key[3], site, warm=warm,
+            donate=donate,
         )
 
     def fail(self, e: BaseException):
@@ -462,11 +470,20 @@ class Replica:
         Dispatcher-thread only."""
         return (key, cap) in self._kernels
 
+    def _donates(self, work: BatchWork) -> bool:
+        """Whether this executor's kernel for ``work`` may take the
+        serving donation contract (session.py::serve_donate_argnums).
+        The width-1 replica always may: its operands commit whole to
+        one device and donation aliases each input buffer into that
+        same device's outputs.  GangReplica overrides this for
+        shard-mode work (see its docstring for the race)."""
+        return True
+
     def _kernel_for(self, work: BatchWork):
         kkey = self._kernel_cache_key(work)
         k = self._kernels.get(kkey)
         if k is None:
-            inner = work.make_kernel(self.tag)
+            inner = work.make_kernel(self.tag, donate=self._donates(work))
             traced = [False]
             lock = work.session.trace_lock
 

@@ -678,30 +678,36 @@ def _run_for_key(session: Session, key: tuple):
 
 
 def build_residuals_kernel(session: Session, subtract_mean: bool,
-                           site: str, warm=None):
+                           site: str, warm=None, donate: bool = True):
     """Batched residuals kernel (see :func:`_residuals_run`), jitted
     through the traced_jit chokepoint with the serving donation
-    contract on the stacked operands."""
+    contract on the stacked operands.  ``donate=False`` builds the
+    same program without the contract — required for GSPMD-sharded
+    gang placements (GangReplica._donates)."""
     return traced_jit(
         _residuals_run(session, subtract_mean), site,
         cid=session.cid, warm=warm,
-        donate_argnums=serve_donate_argnums(),
+        donate_argnums=serve_donate_argnums() if donate else None,
     )
 
 
 def build_fit_kernel(session: Session, mode: str, maxiter: int,
-                     tol_chi2: float, site: str, warm=None):
+                     tol_chi2: float, site: str, warm=None,
+                     donate: bool = True):
     """Batched fit kernel (see :func:`_fit_run`), jitted through the
     traced_jit chokepoint with the serving donation contract on the
-    stacked operands."""
+    stacked operands.  ``donate=False`` builds the same program
+    without the contract — required for GSPMD-sharded gang placements
+    (GangReplica._donates)."""
     return traced_jit(
         _fit_run(session, mode, maxiter, tol_chi2), site,
         cid=session.cid, warm=warm,
-        donate_argnums=serve_donate_argnums(),
+        donate_argnums=serve_donate_argnums() if donate else None,
     )
 
 
-def build_append_kernel(session: Session, site: str, warm=None):
+def build_append_kernel(session: Session, site: str, warm=None,
+                        donate: bool = True):
     """Batched O(append) kernel (see :func:`_append_run`), jitted
     through the traced_jit chokepoint with the serving donation
     contract — the stacked solver states are per-dispatch
@@ -716,7 +722,7 @@ def build_append_kernel(session: Session, site: str, warm=None):
     return traced_jit(
         _append_run(session), site,
         cid=session.cid,
-        donate_argnums=serve_donate_argnums(),
+        donate_argnums=serve_donate_argnums() if donate else None,
     )
 
 

@@ -282,6 +282,72 @@ def _serve_burst_row(kind, nburst, nrep, env_knob):
     return row
 
 
+def _fused_interior_row(nrep):
+    """ISSUE 18: the mixed Woodbury step's interior fused into one
+    VMEM-resident Pallas pass (default on accelerators) vs the
+    PINT_TPU_FUSED_INTERIOR=0 hatch (the chunked-XLA pre-fusion
+    program, bitwise).  Same step, same operands, chained >=16 deep —
+    the delta is the HBM round-trips the fusion removes.  On the CPU
+    mesh the fused leg runs the Pallas interpreter, so only the
+    on-chip figure is a perf claim (the row still lands so the ladder
+    is backend-invariant, mirroring profiling/mfu.py)."""
+    import jax
+
+    from pint_tpu.fitting.base import design_with_offset
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+    from pint_tpu.simulation import make_test_pulsar
+
+    accel = jax.default_backend() != "cpu"
+    ntoa = 100_000 if accel else 20_000
+    par = (
+        "PSR FI\nF0 218.81 1\nF1 -4.08e-16 1\nPEPOCH 55000\n"
+        "DM 15.99 1\nEFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n"
+        "TNREDAMP -13.8\nTNREDGAM 4.3\nTNREDC 30\n"
+    )
+    m, toas = make_test_pulsar(
+        par, ntoa=ntoa, start_mjd=53000, end_mjd=57500, iterations=1
+    )
+    cm = m.compile(toas)
+    import jax.numpy as jnp
+
+    x = cm.x0()
+    r = cm.time_residuals(x, subtract_mean=False)
+    M = design_with_offset(cm, x)
+    Nd = jnp.square(cm.scaled_sigma(x))
+    T, phi = cm.noise_basis_or_empty(x)
+
+    from mfu import _time_scalar_chain
+
+    row = {
+        "config": "dispatch_floor fused_interior mixed step",
+        "ntoa": ntoa, "k": int(T.shape[1]),
+    }
+    for mode, setting in (("fused", "force" if not accel else None),
+                          ("unfused", "0")):
+        saved = os.environ.get("PINT_TPU_FUSED_INTERIOR")
+        try:
+            if setting is None:
+                os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+            else:
+                os.environ["PINT_TPU_FUSED_INTERIOR"] = setting
+            t = _time_scalar_chain(
+                lambda rr: gls_step_woodbury_mixed(
+                    rr, M, Nd, T, phi
+                )[2],
+                r, nrep=nrep,
+            )
+            row[f"{mode}_step_ms"] = round(t * 1e3, 3)
+        finally:
+            if saved is None:
+                os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+            else:
+                os.environ["PINT_TPU_FUSED_INTERIOR"] = saved
+    row["fused_speedup_x"] = round(
+        row["unfused_step_ms"] / max(row["fused_step_ms"], 1e-9), 2
+    )
+    return row
+
+
 def floor_rows(configs=("1", "3", "5")):
     """All ladder rows (run_benchmarks config ``dispatch_floor``)."""
     import run_benchmarks as rb
@@ -312,6 +378,7 @@ def floor_rows(configs=("1", "3", "5")):
         "DM 224.1 1\n",
         62, DownhillWLSFitter, nrep=3,
     ))
+    rows.append(_fused_interior_row(nrep=3))
     rows.append(_serve_burst_row("xkey", nburst=12, nrep=2,
                                  env_knob="PINT_TPU_SERVE_XKEY_FUSE"))
     rows.append(_serve_burst_row("overlap", nburst=12, nrep=2,

@@ -143,6 +143,43 @@ def _woodbury_rows(backend, accel):
     return rows, (n, k, p, cm, X, Ninv)
 
 
+def _fused_interior_rows(backend, wood_ctx):
+    """ISSUE 18: fused VMEM-resident joint Gram (ops/pallas_fit.py)
+    vs the unfused chunked-XLA gram32_joint on the SAME operands —
+    identical model FLOPs, so the GF/s delta is pure HBM-traffic/
+    fusion gain.  On CPU the fused rung runs the interpreter (a
+    correctness probe, not a perf number — the row is still emitted
+    so the ladder shape is backend-invariant)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.ops.ffgram import gram32_joint
+    from pint_tpu.ops.pallas_fit import fused_block_table, fused_gram_joint
+
+    n, k, p, cm, X, Ninv = wood_ctx
+    if fused_block_table(n, k, p) is None:
+        return []
+    T32 = cm.noise_basis_or_empty(cm.x0())[0].astype(jnp.float32)
+    gram_flops = 2 * n * (k * (k + p) + p * p)
+    rows = []
+    t = _time_scalar_chain(
+        lambda w: gram32_joint(T32, X, w)[0][0, 0], Ninv
+    )
+    rows.append(_row("fused-interior", "unfused_gram32_joint",
+                     gram_flops, t, backend, n=n, k=k, p=p))
+    for precision in ("highest", "high"):
+        t = _time_scalar_chain(
+            lambda w, precision=precision: fused_gram_joint(
+                T32, X, w, precision=precision
+            )[0][0, 0],
+            Ninv,
+        )
+        rows.append(_row(
+            "fused-interior", f"pallas_fused_{precision}", gram_flops,
+            t, backend, n=n, k=k, p=p,
+        ))
+    return rows
+
+
 def _fourier_rows(backend, wood_ctx):
     from pint_tpu.ops.pallas_kernels import fourier_gram
 
@@ -176,6 +213,7 @@ def mfu_rows():
     rows = _dense_rows(backend, accel)
     wood, ctx = _woodbury_rows(backend, accel)
     rows += wood
+    rows += _fused_interior_rows(backend, ctx)
     rows += _fourier_rows(backend, ctx)
     return rows
 

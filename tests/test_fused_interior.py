@@ -1,0 +1,394 @@
+"""Fused VMEM-resident fit-step interior (ISSUE 18, ops/pallas_fit.py).
+
+On the CPU test mesh the kernel runs in interpret mode — the same
+kernel code Mosaic compiles on the TPU, executed by the Pallas
+interpreter — and the route is forced with
+``PINT_TPU_FUSED_INTERIOR=force`` (the policy is accelerator-only by
+default).  Covers:
+
+- VMEM block-table unit behavior (determinism per serve bucket,
+  128-alignment, budget refusal);
+- kernel parity vs the unfused ops/ffgram.py::gram32_joint AND the
+  exact f64 Gram (the ~1e-7 chunked-f32 class);
+- routed gls_step_woodbury_mixed parity at the _woodbury_mixed_tail
+  contract tolerances, BITWISE with the hatch off (the default on
+  CPU);
+- composition: vmap (serve stacking), lax.scan (the r11 fused
+  downhill trajectory via GLSFitter(fused='mixed')), shard_map
+  (parallel/gls.py::sharded_gls_step_mixed);
+- zero steady retraces across the serve bucket ladder with the fused
+  route forced (the exact compile.traces counter).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.ops.ffgram import gram32_joint
+from pint_tpu.ops.pallas_fit import (
+    _SUB,
+    fused_block_table,
+    fused_gram_joint,
+)
+
+
+def _problem(seed, n, k, p):
+    rng = np.random.default_rng(seed)
+    T = jnp.asarray(rng.standard_normal((n, k)))
+    # wide dynamic range columns: the |max|-prescale contract surface
+    M = jnp.asarray(rng.standard_normal((n, p)) * np.logspace(0, 10, p))
+    r = jnp.asarray(rng.standard_normal(n) * 1e-6)
+    Nd = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    phi = jnp.asarray(rng.uniform(0.1, 10.0, k))
+    return r, M, Nd, T, phi
+
+
+def _under(setting, fn):
+    """Run fn with PINT_TPU_FUSED_INTERIOR set (None = unset), under a
+    FRESH jit wrapper — pjit caches on function identity, so reusing
+    one wrapper across settings would silently reuse the first
+    trace."""
+    prev = os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+    if setting is not None:
+        os.environ["PINT_TPU_FUSED_INTERIOR"] = setting
+    try:
+        return jax.jit(fn)()
+    finally:
+        os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        if prev is not None:
+            os.environ["PINT_TPU_FUSED_INTERIOR"] = prev
+
+
+# -- block table -----------------------------------------------------------
+def test_block_table_alignment_and_determinism():
+    tab = fused_block_table(100_000, 40, 9)
+    assert tab is not None
+    bn, k_pad, p1_pad = tab
+    assert bn % _SUB == 0 and bn >= _SUB
+    assert k_pad % 128 == 0 and p1_pad % 128 == 0
+    # pure function of the padded static shapes: every request in a
+    # serve bucket resolves to the identical block (no retrace lever)
+    assert fused_block_table(100_000, 40, 9) == tab
+    # k=40 and k=100 pad to the same 128 column tile
+    assert fused_block_table(100_000, 100, 9) == tab
+
+
+def test_block_table_budget_refusal():
+    # absurd column counts blow the q^2 accumulator budget -> None,
+    # and the caller falls back to gram32_joint
+    assert fused_block_table(4096, 40_000, 9) is None
+
+
+def test_block_table_small_n_bounded_padding():
+    bn, _, _ = fused_block_table(300, 4, 3)
+    # _block_size keeps padding bounded: a 300-row problem must not
+    # get a multi-thousand-row block
+    assert bn <= 384
+
+
+def test_fused_gram_rejects_over_budget_shape():
+    T = jnp.zeros((256, 40_000), jnp.float32)
+    A = jnp.zeros((256, 3))
+    w = jnp.ones(256)
+    with pytest.raises(ValueError, match="VMEM block table"):
+        fused_gram_joint(T, A, w)
+
+
+# -- kernel parity ---------------------------------------------------------
+@pytest.mark.parametrize(
+    "n,k,p1", [(500, 5, 3), (3000, 40, 9), (128, 1, 1), (4097, 129, 2)]
+)
+def test_fused_gram_matches_unfused_and_exact(n, k, p1):
+    rng = np.random.default_rng(n + k)
+    T = rng.standard_normal((n, k))
+    A = rng.standard_normal((n, p1))
+    w = rng.uniform(0.5, 2.0, n)
+    ref = gram32_joint(
+        jnp.asarray(T, jnp.float32), jnp.asarray(A), jnp.asarray(w)
+    )
+    fus = fused_gram_joint(
+        jnp.asarray(T, jnp.float32), jnp.asarray(A), jnp.asarray(w)
+    )
+    # exact f64 reference
+    Y = np.concatenate([T, A], axis=1) * np.sqrt(w)[:, None]
+    G = Y.T @ Y
+    exact = (G[:k, :k], G[:k, k:], G[k:, k:])
+    for name, f, u, e in zip(("sig_tt", "twx", "G_XX"), fus, ref, exact):
+        f, u = np.asarray(f), np.asarray(u)
+        scale = max(np.max(np.abs(e)), 1e-300)
+        # both paths sit in the chunk-128 f32 accumulation class
+        assert np.max(np.abs(f - e)) / scale < 3e-6, name
+        assert np.max(np.abs(f - u)) / scale < 3e-6, name
+
+
+def test_fused_gram_zero_weight_padding():
+    """Zero-weight TOAs contribute nothing (serve bucket padding and
+    the in-kernel block padding ride on this)."""
+    rng = np.random.default_rng(7)
+    n, k, p1 = 700, 7, 3
+    T = rng.standard_normal((n, k))
+    A = rng.standard_normal((n, p1))
+    w = rng.uniform(0.5, 2.0, n)
+    w[500:] = 0.0
+    full = fused_gram_joint(
+        jnp.asarray(T, jnp.float32), jnp.asarray(A), jnp.asarray(w)
+    )
+    cut = fused_gram_joint(
+        jnp.asarray(T[:500], jnp.float32), jnp.asarray(A[:500]),
+        jnp.asarray(w[:500]),
+    )
+    for f, c in zip(full, cut):
+        np.testing.assert_allclose(
+            np.asarray(f), np.asarray(c), rtol=0, atol=1e-4
+        )
+
+
+def test_fused_gram_precision_high_rung():
+    """The bf16x3 'high' rung (preconditioner-grade, ir-refined
+    contract) stays within its documented ~1e-4 relative class."""
+    rng = np.random.default_rng(8)
+    n, k, p1 = 2048, 16, 4
+    T = rng.standard_normal((n, k))
+    A = rng.standard_normal((n, p1))
+    w = rng.uniform(0.5, 2.0, n)
+    hi = fused_gram_joint(
+        jnp.asarray(T, jnp.float32), jnp.asarray(A), jnp.asarray(w),
+        precision="high",
+    )
+    ref = fused_gram_joint(
+        jnp.asarray(T, jnp.float32), jnp.asarray(A), jnp.asarray(w)
+    )
+    for h, r_ in zip(hi, ref):
+        h, r_ = np.asarray(h), np.asarray(r_)
+        assert np.isfinite(h).all()
+        assert (
+            np.max(np.abs(h - r_)) / max(np.max(np.abs(r_)), 1e-300)
+            < 1e-3
+        )
+
+
+# -- routed GLS step -------------------------------------------------------
+def test_routed_step_parity_and_bitwise_hatch():
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+
+    r, M, Nd, T, phi = _problem(1, 2048, 30, 8)
+
+    def run():
+        return gls_step_woodbury_mixed(r, M, Nd, T, phi)
+
+    base = jax.tree_util.tree_leaves(_under("0", run))
+    fused = jax.tree_util.tree_leaves(_under("force", run))
+    dflt = jax.tree_util.tree_leaves(_under(None, run))
+    assert jax.default_backend() == "cpu"
+    dx_b, dx_f = np.asarray(base[0]), np.asarray(fused[0])
+    cov_b, cov_f = np.asarray(base[1]), np.asarray(fused[1])
+    chi_b, chi_f = float(base[2]), float(fused[2])
+    # the _woodbury_mixed_tail contract tolerances
+    assert np.max(np.abs(dx_f - dx_b)) < 2e-3 * np.max(np.abs(dx_b))
+    assert abs(chi_f - chi_b) < 1e-3 * abs(chi_b)
+    np.testing.assert_allclose(
+        np.sqrt(np.diag(cov_f)), np.sqrt(np.diag(cov_b)), rtol=5e-3
+    )
+    # hatch off (= the CPU default) is BITWISE the unfused program
+    for b, d in zip(base, dflt):
+        assert np.array_equal(
+            np.asarray(b), np.asarray(d), equal_nan=True
+        )
+
+
+def test_routed_step_vmap_composition():
+    """Serve stacks distinct pars with vmap over the step — the Pallas
+    batching rule must hold (interpret mode on CPU)."""
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+
+    r, M, Nd, T, phi = _problem(2, 1024, 12, 5)
+    rs = jnp.stack([r, r * 1.25, -r])
+
+    def run():
+        return jax.vmap(
+            lambda rr: gls_step_woodbury_mixed(rr, M, Nd, T, phi)
+        )(rs)
+
+    out = _under("force", run)
+    solo = _under(
+        "force", lambda: gls_step_woodbury_mixed(r, M, Nd, T, phi)
+    )
+    leaves = jax.tree_util.tree_leaves(out)
+    assert leaves[0].shape[0] == 3
+    for l in leaves:
+        assert np.isfinite(np.asarray(l)).all()
+    np.testing.assert_allclose(
+        np.asarray(leaves[0][0]),
+        np.asarray(jax.tree_util.tree_leaves(solo)[0]),
+        rtol=1e-8,
+    )
+
+
+def test_fitter_scan_composition_force_vs_hatch():
+    """GLSFitter(fused='mixed') runs the whole trajectory through the
+    r11 fused lax.scan loop — the fused Pallas interior must compose
+    with it and land on the hatch-off fit within the contract."""
+    from pint_tpu.fitting import GLSFitter
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_test_pulsar
+
+    par = (
+        "PSR I\nF0 245.42 1\nF1 -5e-16 1\nPEPOCH 55000\nDM 3.14 1\n"
+        "EFAC -f L-wide 1.2\nTNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 8\n"
+    )
+    _, toas = make_test_pulsar(par, ntoa=220, seed=5)
+
+    def fit(setting):
+        prev = os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        os.environ["PINT_TPU_FUSED_INTERIOR"] = setting
+        try:
+            m = get_model(par)
+            f = GLSFitter(toas, m, fused="mixed")
+            chi2 = f.fit_toas(maxiter=3)
+            return chi2, m, f
+        finally:
+            os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+            if prev is not None:
+                os.environ["PINT_TPU_FUSED_INTERIOR"] = prev
+
+    chi_off, m_off, f_off = fit("0")
+    chi_on, m_on, _ = fit("force")
+    assert chi_on == pytest.approx(chi_off, rel=1e-3)
+    for n in ("F0", "F1", "DM"):
+        a, b = m_off.params[n].value, m_on.params[n].value
+        fa = float(a.to_float()) if hasattr(a, "to_float") else float(a)
+        fb = float(b.to_float()) if hasattr(b, "to_float") else float(b)
+        s = m_off.params[n].uncertainty
+        assert abs(fa - fb) < 2e-2 * s, n
+        assert m_on.params[n].uncertainty == pytest.approx(s, rel=1e-2)
+
+
+def test_sharded_step_parity_and_bitwise_hatch():
+    """parallel/gls.py::sharded_gls_step_mixed routes each shard's
+    local Gram through the fused kernel (manual partitioning — no
+    GSPMD hazard); hatch off stays bitwise the pre-fusion program
+    (including check_rep)."""
+    from jax.sharding import Mesh
+
+    from pint_tpu.parallel.gls import sharded_gls_step_mixed
+
+    r, M, Nd, T, phi = _problem(3, 4096, 24, 6)
+    mesh = Mesh(np.array(jax.devices()), ("toa",))
+
+    def run():
+        return sharded_gls_step_mixed(mesh, r, M, Nd, T, phi)
+
+    base = jax.tree_util.tree_leaves(_under("0", run))
+    fused = jax.tree_util.tree_leaves(_under("force", run))
+    dflt = jax.tree_util.tree_leaves(_under(None, run))
+    dx_b, dx_f = np.asarray(base[0]), np.asarray(fused[0])
+    assert np.max(np.abs(dx_f - dx_b)) < 2e-3 * np.max(np.abs(dx_b))
+    assert float(fused[2]) == pytest.approx(float(base[2]), rel=1e-3)
+    for b, d in zip(base, dflt):
+        assert np.array_equal(
+            np.asarray(b), np.asarray(d), equal_nan=True
+        )
+
+
+def test_bypass_context_pins_unfused():
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+    from pint_tpu.ops import solve_policy
+
+    r, M, Nd, T, phi = _problem(4, 1024, 8, 4)
+
+    def run():
+        return gls_step_woodbury_mixed(r, M, Nd, T, phi)
+
+    base = jax.tree_util.tree_leaves(_under("0", run))
+
+    def bypassed():
+        with solve_policy.fused_interior_bypass():
+            assert not solve_policy.fused_interior_active()
+            return jax.jit(run)()
+
+    prev = os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+    os.environ["PINT_TPU_FUSED_INTERIOR"] = "force"
+    try:
+        out = jax.tree_util.tree_leaves(bypassed())
+        # re-entrant: active again once the context exits
+        assert solve_policy.fused_interior_active()
+    finally:
+        os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        if prev is not None:
+            os.environ["PINT_TPU_FUSED_INTERIOR"] = prev
+    # the bypassed trace IS the unfused program
+    for b, o in zip(base, out):
+        assert np.array_equal(
+            np.asarray(b), np.asarray(o), equal_nan=True
+        )
+
+
+# -- serve: zero steady retraces ------------------------------------------
+PAR_CORR = """
+PSR              J0001+00{i:02d}
+F0               {f0}  1
+F1               -1.1e-15           1
+PEPOCH           55000
+DM               {dm}             1
+EFAC -f L-wide 1.2
+TNREDAMP -13.0
+TNREDGAM 3.5
+TNREDC 6
+"""
+
+
+def test_serve_zero_steady_retraces_across_buckets(monkeypatch):
+    """With the fused interior forced and the mixed mode active, warmed
+    serve fit traffic across the bucket ladder causes ZERO XLA
+    retraces — the block table is a pure function of the bucket shape,
+    so it can never become a retrace lever."""
+    import pint_tpu.serve.session as serve_session
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import FitRequest, TimingEngine
+    from pint_tpu.simulation import make_test_pulsar
+
+    # the CPU test mesh defaults to mode 'f64' — pin the accelerator
+    # ('mixed') mode so the fused interior is actually on the path
+    monkeypatch.setattr(
+        serve_session, "default_accel_mode",
+        lambda cm: "mixed" if cm.has_correlated_errors else "f64",
+    )
+    monkeypatch.setenv("PINT_TPU_FUSED_INTERIOR", "force")
+
+    def pulsar(i, f0, dm, n, seed):
+        m, t = make_test_pulsar(
+            PAR_CORR.format(i=i, f0=f0, dm=dm), ntoa=n, seed=seed,
+            iterations=1,
+        )
+        return m.as_parfile(), t
+
+    # two buckets: 64 (40/50 TOAs) and 128 (100 TOAs)
+    warm = [
+        pulsar(0, 101.1, 10.0, 40, 1),
+        pulsar(1, 215.9, 22.0, 50, 2),
+        pulsar(2, 88.3, 5.5, 100, 3),
+    ]
+    steady = [
+        pulsar(3, 77.7, 3.3, 45, 4),    # new size, 64 bucket
+        pulsar(4, 133.3, 8.8, 110, 5),  # new size, 128 bucket
+    ]
+    with TimingEngine(max_batch=2, max_wait_ms=1.0) as eng:
+        for wave in (1, 2):
+            futs = [
+                eng.submit(FitRequest(par=p, toas=t, maxiter=2))
+                for p, t in warm[:wave] + warm[2:]
+            ]
+            [f.result(timeout=600) for f in futs]
+        traces0 = obs_metrics.counter("compile.traces").value
+        futs = [
+            eng.submit(FitRequest(par=p, toas=t, maxiter=2))
+            for p, t in steady
+        ]
+        for f in futs:
+            resp = f.result(timeout=600)
+            assert np.isfinite(resp.chi2)
+        assert obs_metrics.counter("compile.traces").value == traces0

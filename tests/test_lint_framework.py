@@ -322,6 +322,32 @@ def test_f64emu_flags_high_precision_outside_ir_refined_module():
     assert findings_for(F64EMU, sup) == []
 
 
+def test_f64emu_flags_pallas_kernel_without_prescale():
+    """ISSUE 18: the sum-of-squares check reaches inside Pallas kernel
+    bodies too — a VMEM-resident Gram kernel that squares raw ref
+    reads without the |max|-prescale is the same r5 overflow class
+    (the fused-interior contract is that the CALLER prescales, so the
+    kernel never spells an unscaled square)."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def _gram_kernel(x_ref, out_ref):\n"
+        "    y = x_ref[:]\n"
+        "    out_ref[:] = jnp.sum(jnp.square(y), axis=0)\n"
+    )
+    out = findings_for(F64EMU, src)
+    assert [f.lineno for f in out] == [4]
+    assert "prescale" in out[0].message
+    # the prescale idiom inside the kernel body: the squared operand
+    # is a division, same as the _column_norms recipe
+    ok = (
+        "import jax.numpy as jnp\n"
+        "def _gram_kernel(x_ref, n_ref, out_ref):\n"
+        "    y = x_ref[:]\n"
+        "    out_ref[:] = jnp.sum(jnp.square(y / n_ref[:]), axis=0)\n"
+    )
+    assert findings_for(F64EMU, ok) == []
+
+
 def test_f64emu_flags_tiny_literal_product():
     """The r4 incident class: a sub-flush-threshold factor multiplied
     on device flushes the whole product to zero."""
@@ -782,6 +808,56 @@ def test_obs11_flags_stripped_flow_chokepoints(tmp_path):
     assert obs11.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs12: the ISSUE 18 fused-interior chokepoints -----------------------
+def test_obs12_flags_stripped_fused_interior_guards(tmp_path):
+    """obs12 catches the fused-interior route losing its solve_policy
+    gate, the gang shard-mode bypass, or the shard_map check_rep
+    agreement; skips packages that predate ops/pallas_fit.py; passes
+    the real tree."""
+    obs12 = rules_by_name()["obs12"]
+    # no ops/pallas_fit.py -> the subsystem predates this package
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "fitting").mkdir(parents=True)
+    (bare / "fitting" / "gls.py").write_text(
+        "def _joint_gram(T, X, Ninv):\n    return None\n"
+    )
+    assert obs12.check_project(bare) == []
+    # stripped chokepoints are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    for d in ("ops", "fitting", "parallel", "serve/fabric"):
+        (pkg / d).mkdir(parents=True)
+    (pkg / "ops" / "pallas_fit.py").write_text(
+        "def fused_gram_joint(T, A, w):\n    return None\n"
+    )
+    (pkg / "fitting" / "gls.py").write_text(
+        "def _joint_gram(T, X, Ninv):\n"
+        "    from pint_tpu.ops.pallas_fit import fused_gram_joint\n"
+        "    return fused_gram_joint(T, X, Ninv)\n"  # gate stripped
+    )
+    (pkg / "ops" / "solve_policy.py").write_text(
+        "def fused_interior_active():\n"
+        "    return True\n"  # bypass + force hatch stripped
+    )
+    (pkg / "serve" / "fabric" / "gang.py").write_text(
+        "class GangReplica:\n"
+        "    def _kernel_for(self, work):\n"
+        "        return super()._kernel_for(work)\n"  # bypass gone
+    )
+    (pkg / "parallel" / "gls.py").write_text(
+        "def sharded_gls_step_mixed(mesh, r, M, Nd, T, phi):\n"
+        "    return None\n"
+    )
+    msgs = "\n".join(f.message for f in obs12.check_project(pkg))
+    assert "fused_interior_active" in msgs   # policy gate gone
+    assert "fused_block_table" in msgs       # applicability gone
+    assert "gram32_joint" in msgs            # fallback/hatch gone
+    assert "_fused_bypass" in msgs           # thread-local gone
+    assert "fused_interior_bypass" in msgs   # gang bypass gone
+    assert "check_rep" in msgs               # shard_map agreement gone
+    # the real tree carries every chokepoint
+    assert obs12.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the
@@ -799,6 +875,13 @@ def test_real_tree_declares_the_incident_guards():
         REPO / "pint_tpu" / "ops" / "pallas_kernels.py"
     ).read_text()
     assert "lint: module(ir-refined)" in pallas
+    # ISSUE 18: the fused-interior kernel carries BOTH precision
+    # contracts (explicit pass ladder + refinement consumer)
+    pallas_fit = (
+        REPO / "pint_tpu" / "ops" / "pallas_fit.py"
+    ).read_text()
+    assert "lint: module(matmul-highest)" in pallas_fit
+    assert "lint: module(ir-refined)" in pallas_fit
     replica = (
         REPO / "pint_tpu" / "serve" / "fabric" / "replica.py"
     ).read_text()

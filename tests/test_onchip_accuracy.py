@@ -603,3 +603,63 @@ def test_onchip_mixed_fit_with_ir_policy_matches_cpu():
         v0 = float(getattr(f_cpu.model, name).value)
         u0 = float(getattr(f_cpu.model, name).uncertainty)
         assert abs(v - v0) < 0.2 * u0 + 1e-15, name
+
+
+def test_onchip_fused_interior_matches_unfused():
+    """ISSUE 18 spot check: the Mosaic-compiled fused Gram pipeline
+    (ops/pallas_fit.py — interpret-mode-tested everywhere else) agrees
+    with the unfused gram32_joint ON CHIP at the chunk-f32 class, and
+    the routed mixed step lands within the contract of the
+    PINT_TPU_FUSED_INTERIOR=0 hatch."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.gls import gls_step_woodbury_mixed
+    from pint_tpu.ops.ffgram import gram32_joint
+    from pint_tpu.ops.pallas_fit import fused_gram_joint
+
+    rng = np.random.default_rng(18)
+    n, k, p = 4096, 24, 6
+    T = jnp.asarray(rng.standard_normal((n, k)))
+    M = jnp.asarray(
+        rng.standard_normal((n, p)) * np.logspace(0, 10, p)
+    )
+    r = jnp.asarray(rng.standard_normal(n) * 1e-6)
+    Nd = jnp.asarray(rng.uniform(0.5, 2.0, n))
+    phi = jnp.asarray(rng.uniform(0.1, 10.0, k))
+
+    # raw kernel: real Mosaic compile vs the chunked XLA Gram
+    fus = fused_gram_joint(T.astype(jnp.float32), M, Nd)
+    ref = gram32_joint(T.astype(jnp.float32), M, Nd)
+    for name, f, u in zip(("sig_tt", "twx", "G_XX"), fus, ref):
+        f, u = np.asarray(f), np.asarray(u)
+        assert np.isfinite(f).all(), name
+        scale = max(np.max(np.abs(u)), 1e-300)
+        assert np.max(np.abs(f - u)) / scale < 1e-5, name
+
+    # routed step: fused (the on-chip default) vs the bitwise hatch
+    def under(setting):
+        prev = os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+        if setting is not None:
+            os.environ["PINT_TPU_FUSED_INTERIOR"] = setting
+        try:
+            return jax.tree_util.tree_leaves(
+                jax.jit(
+                    lambda: gls_step_woodbury_mixed(r, M, Nd, T, phi)
+                )()
+            )
+        finally:
+            os.environ.pop("PINT_TPU_FUSED_INTERIOR", None)
+            if prev is not None:
+                os.environ["PINT_TPU_FUSED_INTERIOR"] = prev
+
+    off = under("0")
+    on = under(None)  # accelerator default = fused
+    dx_off, dx_on = np.asarray(off[0]), np.asarray(on[0])
+    assert np.isfinite(dx_on).all()
+    assert np.max(np.abs(dx_on - dx_off)) < 2e-3 * np.max(
+        np.abs(dx_off)
+    )
+    assert float(on[2]) == pytest.approx(float(off[2]), rel=1e-3)

@@ -56,7 +56,7 @@ from fuzz_ingest import (  # noqa: E402
 
 #: one seed per build round (append, never edit — regression history;
 #: r4 ran two sessions and contributed two)
-FUZZ_SEEDS = [2604, 3107, 4181, 5923, 6841, 7459, 8317, 9203]
+FUZZ_SEEDS = [2604, 3107, 4181, 5923, 6841, 7459, 8317, 9203, 10267]
 
 CASES_PER_ROUND = 5
 

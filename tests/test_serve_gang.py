@@ -431,3 +431,53 @@ def test_total_outage_drain_resolves_everything_typed(pulsars):
             wall = time.monotonic() - t0
     assert wall < 45.0
     _join_guard_threads()
+
+
+# -- shard-mode donation exclusion ----------------------------------------
+def test_shard_mode_kernels_never_donate(monkeypatch):
+    """Shard-mode gang kernels must build WITHOUT the serving donation
+    contract (GangReplica._donates): donating the replicated leaves of
+    a GSPMD-partitioned program lets XLA recycle a member device's
+    input buffer while peer shards still read the logically-same
+    operand — on the shared-address-space CPU mesh this was an
+    intermittent, scheduling-timing-dependent corruption of the
+    sharded fit (sporadic converged=False with shifted chi2, flipping
+    run-to-run with compile-cache state).  Solo-mode work keeps the
+    width-1 donation contract bitwise-unchanged."""
+    import types
+
+    import pint_tpu.serve.session as smod
+    from pint_tpu.serve.fabric.gang import GangReplica
+    from pint_tpu.serve.fabric.replica import BatchWork, Replica
+
+    g = GangReplica.__new__(GangReplica)
+    g.shard_threshold = 512
+    g.width = 4
+
+    class W:
+        def __init__(self, bucket):
+            self.key = ("fit", "cid", bucket, "woodbury", 2, 0.01)
+
+    # the placement-mode verdict drives the donation verdict
+    assert g._donates(W(256)) is True
+    assert g._donates(W(1024)) is False
+    # base executor contract unchanged: width-1 always donates
+    assert Replica._donates(g, W(1024)) is True
+
+    # the verdict threads through make_kernel into the session builder
+    seen = {}
+
+    def spy(session, mode, maxiter, tol, site, warm=None, donate=True):
+        seen["donate"] = donate
+        return lambda *a: None
+
+    monkeypatch.setattr(smod, "build_fit_kernel", spy)
+    w = types.SimpleNamespace(
+        key=("fit", "c", 1024, "woodbury", 2, 0.01),
+        session=types.SimpleNamespace(bucket=1024),
+        cap=1,
+    )
+    BatchWork.make_kernel(w, "g0", donate=False)
+    assert seen["donate"] is False
+    BatchWork.make_kernel(w, "g0")
+    assert seen["donate"] is True

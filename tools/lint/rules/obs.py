@@ -637,6 +637,57 @@ class Obs9Rule(Rule):
         return findings
 
 
+_FUSED_INTERIOR_CHECKS = (
+    ("fitting/gls.py", "_joint_gram",
+     ("fused_interior_active", "fused_block_table",
+      "fused_gram_joint", "gram32_joint"),
+     "the mixed Woodbury interior must route fused-vs-unfused "
+     "through the ONE solve_policy-gated chokepoint: policy check, "
+     "VMEM block-table applicability, and the gram32_joint fallback "
+     "(PINT_TPU_FUSED_INTERIOR=0 bitwise hatch) all live here — an "
+     "ad-hoc fused call elsewhere dodges the hatch, the gang bypass, "
+     "and the retrace-free block-table contract"),
+    ("ops/solve_policy.py", "fused_interior_active",
+     ("_fused_bypass", "force"),
+     "the fused-interior policy must honor the thread-local bypass "
+     "(gang shard mode — GSPMD cannot auto-partition the Mosaic "
+     "call) ahead of the env knob, and keep the =force CPU hatch "
+     "the interpret-mode parity tests force the route with"),
+    ("serve/fabric/gang.py", "GangReplica._kernel_for",
+     ("fused_interior_bypass", "_wants_shard"),
+     "shard-mode gang kernels must TRACE under solve_policy."
+     "fused_interior_bypass (the GSPMD-partitioned program keeps "
+     "the unfused XLA Gram) while solo-mode kernels pass through "
+     "untouched — bitwise parity with width-1 replicas"),
+    ("parallel/gls.py", "sharded_gls_step_mixed",
+     ("fused_interior_active", "check_rep"),
+     "the sharded mixed step must decide fused-vs-unfused OUTSIDE "
+     "shard_map on the per-shard static shape and keep check_rep "
+     "consistent with it (pallas_call has no replication rule; the "
+     "unfused path keeps check_rep=True bitwise)"),
+)
+
+
+class Obs12Rule(Rule):
+    """Fused-interior chokepoints (ISSUE 18): the VMEM-resident
+    Pallas Gram must stay routed through the solve_policy gate with
+    its bitwise hatch, the gang shard-mode bypass, and the
+    shard_map check_rep agreement."""
+
+    name = "obs12"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the fused-interior module itself: fixture packages
+        # that predate the subsystem skip (obs7..obs11 convention)
+        if not (pkg_root / "ops" / "pallas_fit.py").is_file():
+            return []
+        return _run_checks(
+            self.name, pkg_root, _FUSED_INTERIOR_CHECKS,
+            pkg_root / "ops",
+        )
+
+
 class Obs10Rule(Rule):
     """Elastic-fabric chokepoints (ISSUE 16): reshape entry points
     span-instrumented and funneled through the drain-fenced
@@ -699,8 +750,9 @@ OBS8 = Obs8Rule()
 OBS9 = Obs9Rule()
 OBS10 = Obs10Rule()
 OBS11 = Obs11Rule()
+OBS12 = Obs12Rule()
 RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9, OBS10,
-         OBS11)
+         OBS11, OBS12)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
