@@ -1840,6 +1840,171 @@ def _stream_block():
     }
 
 
+def _jobs_block():
+    """Background compute class (ISSUE 20 — serve/jobs/): grid and
+    MCMC jobs end-to-end through ``TimingEngine.submit`` as the second
+    traffic class, on the same fleet as interactive serving.
+
+    Gates (all backends unless noted): ZERO XLA traces across a
+    steady repeat of a warmed job (power-of-two quanta on warmed
+    per-executor kernels — the serve convention); the deterministic
+    preempt/resume round-trip — a deadline shed (the r13 pressure
+    signal) must preempt a long in-flight grid job and the resumed
+    surface must be BITWISE the unpressured run's; and on
+    accelerators interactive p99 must hold (< 3x the idle p99) while
+    a background job owns the spare capacity."""
+    import jax
+    import numpy as np
+
+    from pint_tpu.exceptions import PintTpuError
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import ResidualsRequest, TimingEngine
+    from pint_tpu.serve.api import JobRequest
+    from pint_tpu.simulation import make_test_pulsar
+
+    accel = jax.default_backend() != "cpu"
+    mc = obs_metrics.counter
+    model, toas = make_test_pulsar(
+        "PSR BJOB\nF0 211.44 1\nF1 -1.9e-15 1\nPEPOCH 55000\n"
+        "DM 9.3 1\n",
+        ntoa=256, start_mjd=54000.0, end_mjd=56500.0, seed=20,
+        iterations=1,
+    )
+    par = model.as_parfile()
+
+    def axis(center, half, n):
+        return list(center + half * np.linspace(-1.0, 1.0, n))
+
+    small = {
+        "F0": axis(211.44, 2e-9, 16), "F1": axis(-1.9e-15, 2e-17, 16),
+    }
+    big = {
+        "F0": axis(211.44, 2e-9, 64), "F1": axis(-1.9e-15, 2e-17, 64),
+    }
+
+    def grid_job(engine, grid):
+        return engine.submit(JobRequest(
+            kind="grid_chisq", par=par, toas=toas, grid=grid,
+        ))
+
+    def timed_wave(engine, n=12):
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            engine.submit(ResidualsRequest(
+                par=par, toas=toas,
+            )).result(timeout=3600)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat
+
+    engine = TimingEngine(max_batch=4, max_wait_ms=1.0, inflight=2)
+    try:
+        # interactive baseline (idle fleet, warmed kernel)
+        engine.submit(ResidualsRequest(
+            par=par, toas=toas,
+        )).result(timeout=3600)
+        idle_lat = timed_wave(engine)
+
+        # grid end-to-end: warm run, then the steady repeat gate
+        npts = 16 * 16
+        t0 = time.perf_counter()
+        ref = grid_job(engine, small).result(timeout=3600)
+        grid_s = time.perf_counter() - t0
+        traces0 = mc("compile.traces").value
+        again = grid_job(engine, small).result(timeout=3600)
+        steady_s = time.perf_counter() - t0 - grid_s
+        steady_traces = mc("compile.traces").value - traces0
+        steady_bitwise = bool(np.array_equal(
+            ref.result["chi2"], again.result["chi2"]
+        ))
+
+        # MCMC end-to-end (fixed-quantum lax.scan interior)
+        nsteps, nwalkers = 256, 16
+        t0 = time.perf_counter()
+        engine.submit(JobRequest(
+            kind="mcmc", par=par, toas=toas, nsteps=nsteps,
+            nwalkers=nwalkers, seed=20,
+        )).result(timeout=3600)
+        mcmc_s = time.perf_counter() - t0
+
+        # the unpressured long-grid surface (same (key, cap) as the
+        # pressured run below — no fresh kernel)
+        big_ref = grid_job(engine, big).result(timeout=3600)
+
+        # preempt/resume round-trip + interactive latency under a
+        # live background job: a deliberately-expired deadline is the
+        # deterministic r13 shed signal (engine._expired), the timed
+        # wave rides the fleet while the job yields and resumes
+        p0 = mc("serve.jobs.preempted").value
+        r0 = mc("serve.jobs.resumed").value
+        q0 = mc("serve.jobs.quanta").value
+        jfut = grid_job(engine, big)
+        deadline = time.monotonic() + 60.0
+        while (mc("serve.jobs.quanta").value == q0
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        try:
+            engine.submit(ResidualsRequest(
+                par=par, toas=toas, deadline_s=1e-4,
+            )).result(timeout=3600)
+        except Exception:
+            pass  # the deadline shed IS the probe
+        jobs_lat = timed_wave(engine)
+        pressured = jfut.result(timeout=3600)
+        preempted = mc("serve.jobs.preempted").value - p0
+        resumed = mc("serve.jobs.resumed").value - r0
+        preempt_bitwise = bool(np.array_equal(
+            big_ref.result["chi2"], pressured.result["chi2"]
+        ))
+        jobs_stats = engine.stats()["jobs"]
+    finally:
+        engine.close()
+
+    def p99(lat):
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3
+
+    p99_idle = p99(idle_lat)
+    p99_jobs = p99(jobs_lat)
+    ratio = p99_jobs / max(p99_idle, 1e-9)
+    if steady_traces:
+        raise PintTpuError(
+            f"{steady_traces} XLA trace(s) across a steady job repeat "
+            "— quanta must ride warmed per-executor kernels after the "
+            "first run (the serve zero-steady-retrace convention; "
+            "docs/serving.md 'background jobs')"
+        )
+    if not (preempted >= 1 and resumed >= 1 and preempt_bitwise):
+        raise PintTpuError(
+            f"preempt/resume round-trip failed: preempted={preempted} "
+            f"resumed={resumed} bitwise={preempt_bitwise} — a deadline "
+            "shed must yield the fleet within one quantum and the "
+            "resumed job must continue from its exact carry "
+            "(docs/robustness.md 'preemption ladder')"
+        )
+    if accel and ratio > 3.0:
+        raise PintTpuError(
+            f"interactive p99 degraded {ratio:.1f}x while a background "
+            "job ran (>= 3x: jobs must yield on pressure and stay off "
+            "busy executors; docs/serving.md 'background jobs')"
+        )
+    return {
+        "grid_pts_per_s": round(npts / grid_s, 1),
+        "grid_steady_pts_per_s": round(npts / steady_s, 1),
+        "mcmc_samples_per_s": round(nsteps * nwalkers / mcmc_s, 1),
+        "steady_traces": steady_traces,
+        "steady_bitwise": steady_bitwise,
+        "preempted": preempted,
+        "resumed": resumed,
+        "preempt_bitwise": preempt_bitwise,
+        "interactive_p99_idle_ms": round(p99_idle, 3),
+        "interactive_p99_jobs_ms": round(p99_jobs, 3),
+        "p99_ratio": round(ratio, 2),
+        "p99_gate": "< 3x on accelerators",
+        "quantum_p50_ms": jobs_stats["quantum_p50_ms"],
+    }
+
+
 def main():
     import jax
 
@@ -1884,6 +2049,7 @@ def main():
     serve_block = _serve_block()
     obs_block = _obs_block(serve_rps=serve_block["requests_per_s"])
     stream_block = _stream_block()
+    jobs_block = _jobs_block()
     mfu_block = _mfu_block(cm)
     fused_block = _fused_interior_block(cm, mode, t_dev)
 
@@ -1954,6 +2120,7 @@ def main():
                 "fit_traj": fit_traj_block,
                 "serve": serve_block,
                 "stream": stream_block,
+                "jobs": jobs_block,
                 "mfu": mfu_block,
                 "fused_interior": fused_block,
                 "cold": {

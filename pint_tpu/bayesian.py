@@ -36,6 +36,83 @@ from pint_tpu.models.priors import (
 )
 
 
+def lnlikelihood_cm(cm, x):
+    """Gaussian likelihood of the timing residuals for one compiled
+    model (jit/vmap-safe; the BayesianTiming.lnlikelihood interior,
+    factored out so the background-job kernels — serve/jobs/kernels.py
+    — evaluate the IDENTICAL expression over a serve-session cm with
+    the bundle swapped in as a runtime argument).
+
+    White noise: diagonal.  Correlated noise: Woodbury-marginalized —
+    rCr = r N^-1 r - z^T z with z the k-vector whitened through the
+    Cholesky of Sigma = phi^-1 + T^T N^-1 T, and ln det C = ln det N +
+    ln det phi + ln det Sigma (matrix determinant lemma).  Sigma comes
+    from the fitters' shared assembly (fitting/gls.py::woodbury_sigma)
+    so sampler and fitter can never disagree on the marginalization.
+    """
+    from pint_tpu.fitting.gls import woodbury_sigma
+
+    r = cm.time_residuals(x)
+    sig = cm.scaled_sigma(x)
+    n = r.shape[-1]
+    if not cm.has_correlated_errors:
+        return (
+            -0.5 * jnp.sum(jnp.square(r / sig))
+            - jnp.sum(jnp.log(sig))
+            - 0.5 * n * jnp.log(2.0 * jnp.pi)
+        )
+    T, phi = cm.noise_basis_or_empty(x)
+    Ninv, _TN, Sigma = woodbury_sigma(jnp.square(sig), T, phi)
+    Ninv_r = r * Ninv
+    L = jnp.linalg.cholesky(Sigma)
+    z = jax.scipy.linalg.solve_triangular(
+        L, T.T @ Ninv_r, lower=True
+    )
+    rCr = jnp.dot(r, Ninv_r) - jnp.dot(z, z)
+    logdet_C = (
+        2.0 * jnp.sum(jnp.log(sig))
+        + jnp.sum(jnp.log(phi))
+        + 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
+    )
+    return -0.5 * (rCr + logdet_C + n * jnp.log(2.0 * jnp.pi))
+
+
+def make_lnprior(priors: dict, param_names):
+    """-> lnprior(x): sum of per-parameter log-priors over the x-space
+    deltas, jax-traceable for the analytic prior types (uniform bounds
+    / normal; improper uniform contributes 0).  Shared by
+    BayesianTiming and the job kernels — the prior constants bake into
+    the traced program, which is why job kernel identity includes a
+    par/prior tag (serve/jobs/kernels.py)."""
+    names = list(param_names)
+
+    def lnprior(x):
+        out = 0.0
+        for i, n in enumerate(names):
+            p = priors[n]
+            xi = x[..., i]
+            if isinstance(p, NormalRV):
+                z = (xi - p.mean) / p.sigma
+                out = out - 0.5 * z * z - jnp.log(
+                    p.sigma * jnp.sqrt(2.0 * jnp.pi)
+                )
+            elif isinstance(p, UniformBoundedRV):
+                out = out + jnp.where(
+                    (xi >= p.lower) & (xi <= p.upper), p._logw, -jnp.inf
+                )
+            # improper uniform contributes 0
+        return out
+
+    return lnprior
+
+
+def default_priors_for(model, param_names) -> dict:
+    """name -> default_prior(param) for every free name; the shared
+    default the engine's job admission uses so kernel prior tags match
+    between BayesianTiming and serve/jobs."""
+    return {n: default_prior(model.params[n]) for n in param_names}
+
+
 class BayesianTiming:
     def __init__(self, model, toas, priors: Optional[dict] = None):
         """priors: param-name -> Prior over the x-space delta; defaults
@@ -54,60 +131,15 @@ class BayesianTiming:
 
     # -- pieces -----------------------------------------------------------
     def lnlikelihood(self, x):
-        """Gaussian likelihood of the timing residuals (jit/vmap-safe).
-
-        White noise: diagonal.  Correlated noise: Woodbury-
-        marginalized — rCr = r N^-1 r - z^T z with z the k-vector
-        whitened through the Cholesky of Sigma = phi^-1 + T^T N^-1 T,
-        and ln det C = ln det N + ln det phi + ln det Sigma (matrix
-        determinant lemma).  Sigma comes from the fitters' shared
-        assembly (fitting/gls.py::woodbury_sigma) so sampler and
-        fitter can never disagree on the marginalization.
-        """
-        from pint_tpu.fitting.gls import woodbury_sigma
-
-        r = self.cm.time_residuals(x)
-        sig = self.cm.scaled_sigma(x)
-        n = r.shape[-1]
-        if not self.cm.has_correlated_errors:
-            return (
-                -0.5 * jnp.sum(jnp.square(r / sig))
-                - jnp.sum(jnp.log(sig))
-                - 0.5 * n * jnp.log(2.0 * jnp.pi)
-            )
-        T, phi = self.cm.noise_basis_or_empty(x)
-        Ninv, _TN, Sigma = woodbury_sigma(jnp.square(sig), T, phi)
-        Ninv_r = r * Ninv
-        L = jnp.linalg.cholesky(Sigma)
-        z = jax.scipy.linalg.solve_triangular(
-            L, T.T @ Ninv_r, lower=True
-        )
-        rCr = jnp.dot(r, Ninv_r) - jnp.dot(z, z)
-        logdet_C = (
-            2.0 * jnp.sum(jnp.log(sig))
-            + jnp.sum(jnp.log(phi))
-            + 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
-        )
-        return -0.5 * (rCr + logdet_C + n * jnp.log(2.0 * jnp.pi))
+        """Gaussian likelihood of the timing residuals (jit/vmap-safe);
+        delegates to the module-level lnlikelihood_cm — one expression
+        shared with the background-job kernels."""
+        return lnlikelihood_cm(self.cm, x)
 
     def lnprior(self, x):
         """Sum of per-parameter log-priors; jax-traceable for the
         analytic prior types (uniform bounds / normal)."""
-        out = 0.0
-        for i, n in enumerate(self.param_names):
-            p = self.priors[n]
-            xi = x[..., i]
-            if isinstance(p, NormalRV):
-                z = (xi - p.mean) / p.sigma
-                out = out - 0.5 * z * z - jnp.log(
-                    p.sigma * jnp.sqrt(2.0 * jnp.pi)
-                )
-            elif isinstance(p, UniformBoundedRV):
-                out = out + jnp.where(
-                    (xi >= p.lower) & (xi <= p.upper), p._logw, -jnp.inf
-                )
-            # improper uniform contributes 0
-        return out
+        return make_lnprior(self.priors, self.param_names)(x)
 
     def lnposterior(self, x):
         return self.lnprior(x) + self.lnlikelihood(x)

@@ -5,14 +5,27 @@ story is (a) the TOA pickle cache (ours: toas/cache.py), (b) parfile
 round-trip as the model checkpoint (ours: TimingModel.as_parfile), and
 (c) nothing for long runs.  The TPU framework adds (c): an
 orbax-style-but-dependency-free .npz checkpoint of fitter state
-(parameters, covariance, chi2) and MCMC sampler state (chain tail, rng
-seed), so PTA-scale batch fits and long samplers resume across
-preemptions.
+(parameters, covariance, chi2), MCMC sampler state (chain tail, RNG
+seed + schedule cursor), and background-job state (serve/jobs/), so
+PTA-scale batch fits and long samplers resume across preemptions.
+
+Durability contract (ISSUE 20 satellite): every write is ATOMIC — the
+payload lands in a same-directory temp file and os.replace()s into
+place, so a kill mid-checkpoint leaves the previous checkpoint intact,
+never a torn npz.  Every load is EAGER and TYPED — a truncated,
+corrupt, wrong-kind, or newer-version file raises
+exceptions.CheckpointError (never a bare zipfile/KeyError crash), which
+is what lets the background-job resume ladder degrade to a cold start
+explicitly instead of resuming from garbage.
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+from pint_tpu.exceptions import CheckpointError
 
 _VERSION = 1
 
@@ -24,12 +37,64 @@ def _npz_path(path) -> str:
     return s if s.endswith(".npz") else s + ".npz"
 
 
+def _atomic_savez(path, **payload) -> str:
+    """Write an npz atomically: temp file in the TARGET directory (a
+    cross-filesystem tmp would make os.replace non-atomic), fsync'd,
+    then os.replace into place.  A kill at any point leaves either the
+    old checkpoint or the new one — never a torn file."""
+    p = _npz_path(path)
+    tmp = f"{p}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return p
+
+
+def _load_checkpoint(path, kind=None, allow_pickle=False) -> dict:
+    """Eager-load an npz checkpoint into a plain dict.  Eager matters:
+    np.load is lazy and a truncated member would otherwise only blow up
+    at first access, deep in caller code — here every failure mode
+    (missing zip directory, truncated member, bad header) surfaces as
+    one typed CheckpointError at the load site."""
+    p = _npz_path(path)
+    try:
+        with np.load(p, allow_pickle=allow_pickle) as z:
+            data = {k: np.asarray(z[k]) for k in z.files}
+    except FileNotFoundError:
+        raise
+    except Exception as exc:
+        raise CheckpointError(
+            f"unreadable checkpoint {p!r} (truncated or corrupt): {exc}"
+        ) from exc
+    if "version" not in data or "kind" not in data:
+        raise CheckpointError(f"{p!r} is not a pint_tpu checkpoint")
+    if int(data["version"]) > _VERSION:
+        raise CheckpointError(
+            f"checkpoint version {int(data['version'])} is newer than "
+            f"this build ({_VERSION})"
+        )
+    if kind is not None and str(data["kind"]) != kind:
+        raise CheckpointError(
+            f"{p!r} is a {str(data['kind'])!r} checkpoint, not {kind!r}"
+        )
+    return data
+
+
 def save_fit(path, fitter):
     """Checkpoint a fitted fitter: par snapshot + covariance + chi2."""
     if fitter.parameter_covariance_matrix is None:
         raise ValueError("fit before checkpointing")
-    np.savez_compressed(
-        _npz_path(path),
+    _atomic_savez(
+        path,
         version=_VERSION,
         kind="fit",
         parfile=np.array(fitter.model.as_parfile()),
@@ -46,12 +111,7 @@ def load_fit(path):
     serialization)."""
     from pint_tpu.models.builder import get_model
 
-    z = np.load(_npz_path(path), allow_pickle=False)
-    if int(z["version"]) > _VERSION:
-        raise ValueError(
-            f"checkpoint version {int(z['version'])} is newer than "
-            f"this build ({_VERSION})"
-        )
+    z = _load_checkpoint(path, kind="fit")
     return {
         "model": get_model(str(z["parfile"])),
         "free_names": [str(n) for n in z["free_names"]],
@@ -63,12 +123,15 @@ def load_fit(path):
 
 def save_mcmc(path, mcmc_fitter, keep_last: int = 200):
     """Checkpoint an MCMCFitter: par snapshot + the chain tail (enough
-    to re-seed walkers) + diagnostics."""
+    to re-seed walkers) + diagnostics + the RNG-cursor record (seed,
+    steps done, planned schedule length, exact final walkers and their
+    log-posteriors) that makes resume_mcmc continue the chain on the
+    planned key schedule (sampler.ensemble_keys contract: in-plan
+    segments bitwise, past-plan extension deterministic)."""
     if mcmc_fitter.chain is None:
         raise ValueError("sample before checkpointing")
     tail = mcmc_fitter.chain[-keep_last:]
-    np.savez_compressed(
-        _npz_path(path),
+    payload = dict(
         version=_VERSION,
         kind="mcmc",
         parfile=np.array(mcmc_fitter.model.as_parfile()),
@@ -77,25 +140,79 @@ def save_mcmc(path, mcmc_fitter, keep_last: int = 200):
         lnp_tail=mcmc_fitter.lnp[-keep_last:],
         acceptance=np.float64(mcmc_fitter.acceptance),
     )
+    meta = getattr(mcmc_fitter, "run_meta", None)
+    if meta:
+        payload.update(
+            seed=np.int64(meta["seed"]),
+            nsteps_done=np.int64(meta["nsteps_done"]),
+            nsteps_total=np.int64(meta["nsteps_total"]),
+            walkers=np.asarray(mcmc_fitter.chain[-1]),
+            lp_last=np.asarray(mcmc_fitter.lnp[-1]),
+        )
+    _atomic_savez(path, **payload)
 
 
 def resume_mcmc(path, toas, nsteps: int = 1000, seed: int = 1):
     """Rebuild the model from a checkpoint and continue sampling from
-    the saved walker positions.  Returns the resumed MCMCFitter."""
+    the saved walker positions.  Returns the resumed MCMCFitter.
+
+    Checkpoints carrying the RNG-cursor record (save_mcmc of this
+    build) continue on the SAVED seed's key schedule — in-plan
+    segments are bitwise-identical to the uninterrupted run, and runs
+    continued past their plan extend it deterministically; the
+    ``seed`` argument applies only to legacy cursor-less files."""
     from pint_tpu.models.builder import get_model
     from pint_tpu.sampler import MCMCFitter, run_ensemble
 
-    z = np.load(_npz_path(path), allow_pickle=False)
-    if str(z["kind"]) != "mcmc":
-        raise ValueError("not an MCMC checkpoint")
+    z = _load_checkpoint(path, kind="mcmc")
     model = get_model(str(z["parfile"]))
     mf = MCMCFitter(toas, model)
     last = z["chain_tail"][-1]  # (nwalkers, ndim)
     # TRUE resume: the equilibrated ensemble continues from its exact
     # positions (multimodality preserved) — no re-initialization ball
-    chain, lnp, acc = run_ensemble(
-        mf.bt.lnposterior, last.mean(axis=0),
-        nsteps=nsteps, seed=seed, init_walkers=last,
-    )
+    if "seed" in z:
+        done = int(z["nsteps_done"])
+        total = max(int(z["nsteps_total"]), done + nsteps)
+        chain, lnp, acc = run_ensemble(
+            mf.bt.lnposterior, np.asarray(last).mean(axis=0),
+            nsteps=nsteps, seed=int(z["seed"]),
+            init_walkers=z["walkers"], init_lp=z["lp_last"],
+            nsteps_total=total, start=done,
+        )
+        mf.run_meta = dict(
+            seed=int(z["seed"]), nsteps_done=done + nsteps,
+            nsteps_total=total,
+        )
+    else:
+        chain, lnp, acc = run_ensemble(
+            mf.bt.lnposterior, last.mean(axis=0),
+            nsteps=nsteps, seed=seed, init_walkers=last,
+        )
     mf.chain, mf.lnp, mf.acceptance = chain, lnp, acc
     return mf
+
+
+def save_job(path, payload: dict) -> str:
+    """Atomic background-job checkpoint (serve/jobs/scheduler.py):
+    state arrays, RNG key material, and the cursor.  Non-array values
+    (the nested sampler's host Generator state dict) ride as 0-d
+    object arrays — load_job unwraps them."""
+    arrays = {}
+    for k, v in payload.items():
+        if k in ("version", "kind"):
+            raise ValueError(f"reserved checkpoint field {k!r}")
+        arrays[k] = np.asarray(v)
+    return _atomic_savez(path, version=_VERSION, kind="job", **arrays)
+
+
+def load_job(path) -> dict:
+    """-> the save_job payload (typed CheckpointError on any damage;
+    the job resume ladder catches it and reports, never resumes from
+    a torn file)."""
+    data = _load_checkpoint(path, kind="job", allow_pickle=True)
+    out = {}
+    for k, v in data.items():
+        if k in ("version", "kind"):
+            continue
+        out[k] = v.item() if (v.dtype == object and v.ndim == 0) else v
+    return out

@@ -173,6 +173,16 @@ class CorrelatedErrors(PintTpuError):
         )
 
 
+class CheckpointError(PintTpuError):
+    """A checkpoint file (pint_tpu/checkpoint.py) could not be read:
+    truncated (a pre-atomic-write torn file, or disk-full), corrupt,
+    the wrong kind, or written by a newer build.  Always raised
+    TYPED at load time — a torn checkpoint degrades to an explicit
+    error the caller (or the background-job resume ladder,
+    serve/jobs/) can act on, never a bare zipfile/KeyError crash and
+    never a silently-partial resume."""
+
+
 class RequestRejected(PintTpuError):
     """Typed load-shed rejection from the serving engine
     (serve/engine.py).  The backpressure contract of docs/serving.md:
@@ -182,10 +192,13 @@ class RequestRejected(PintTpuError):
     of ``'queue-full'``, ``'deadline'``, ``'quota'`` (the request's
     composition is at its per-composition in-flight quota —
     ``PINT_TPU_SERVE_QUOTA``; admission fairness, ISSUE 11),
-    ``'shutdown'``, or ``'no-replica'`` (the serving fabric had no
+    ``'shutdown'``, ``'no-replica'`` (the serving fabric had no
     live replica left to take the batch — every candidate quarantined
-    or drained).  The full reason table clients can switch on lives in
-    docs/serving.md and is pinned by tests/test_serve_slo.py."""
+    or drained), ``'jobs-disabled'`` (background class off:
+    ``PINT_TPU_SERVE_JOBS=0``), or ``'jobs-queue-full'`` (the bounded
+    background-job queue is at ``PINT_TPU_SERVE_JOBS_QUEUE``).  The
+    full reason table clients can switch on lives in docs/serving.md
+    and is pinned by tests/test_serve_slo.py."""
 
     def __init__(self, reason: str, detail: str = ""):
         self.reason = reason

@@ -35,6 +35,71 @@ def _internal_value(param, value):
     return float(iv.to_float()) if hasattr(iv, "to_float") else float(iv)
 
 
+def grid_axes(model, grid: dict, free_names, ref):
+    """-> (names, axes): the internal-unit DELTA axis for each gridded
+    parameter (par-file-unit values minus the model's reference,
+    converted through the Parameter).  Factored out of grid_chisq so
+    the background-job grid runner (serve/jobs/runner.py) builds the
+    exact same point cloud from a serve-session record."""
+    names = list(grid)
+    for n in names:
+        if n not in free_names:
+            raise ValueError(
+                f"grid parameter {n} must be free in the model"
+            )
+    refv = {
+        n: (
+            float(ref[n].to_float())
+            if hasattr(ref[n], "to_float") else float(ref[n])
+        )
+        for n in names
+    }
+    axes = [
+        np.asarray(
+            [_internal_value(model.params[n], v) - refv[n] for v in vals],
+            dtype=np.float64,
+        )
+        for n, vals in grid.items()
+    ]
+    return names, axes
+
+
+def grid_mesh_points(axes):
+    """Outer-product the delta axes into the (npts, k) point array."""
+    mesh = np.meshgrid(*axes, indexing="ij")
+    return np.stack([m.ravel() for m in mesh], axis=-1)
+
+
+def make_chi2_at(cm, gidx, refit: bool = True, n_refit_iter: int = 2):
+    """-> chi2_at(deltas (k,)) -> chi2: hold the gridded parameters at
+    the given internal deltas, masked-Gauss-Newton-refit the rest.
+    The single source of the per-point math — grid_chisq vmaps it
+    directly and the job quantum kernel vmaps it over a swapped serve
+    session (serve/jobs/kernels.py), so the two paths cannot drift."""
+    gidx = jnp.asarray(gidx)
+    free_mask = np.ones(cm.nfree)
+    free_mask[np.asarray(gidx)] = 0.0
+    free_mask_j = jnp.asarray(free_mask)
+    no = noffset(cm)
+
+    def chi2_at(deltas):
+        # static k-int index vector — bakes as a ~k-int literal,
+        # intended (way below any transport/413 threshold)
+        x = cm.x0().at[gidx].set(deltas)  # lint: ok(transport)
+        if refit:
+            for _ in range(n_refit_iter):
+                r = cm.time_residuals(x, subtract_mean=False)
+                M = design_with_offset(cm, x)
+                w = 1.0 / jnp.square(cm.scaled_sigma(x))
+                dx, _, _ = _wls_step(r, M, w)
+                # O(nfree) static mask — bakes as a ~p-float literal,
+                # intended (way below any transport/413 threshold)
+                x = x + free_mask_j * dx[no:]  # lint: ok(transport)
+        return cm.chi2(x)
+
+    return chi2_at
+
+
 def grid_chisq(
     toas,
     model,
@@ -50,29 +115,9 @@ def grid_chisq(
     Returns (chi2 ndarray with one axis per grid param, in dict order).
     """
     cm = model.compile(toas)
-    names = list(grid)
-    for n in names:
-        if n not in cm.free_names:
-            raise ValueError(
-                f"grid parameter {n} must be free in the model"
-            )
+    names, axes = grid_axes(model, grid, cm.free_names, cm.ref)
     gidx = jnp.asarray([cm._index[n] for n in names])
-    ref = {
-        n: (
-            float(cm.ref[n].to_float())
-            if hasattr(cm.ref[n], "to_float") else float(cm.ref[n])
-        )
-        for n in names
-    }
-    axes = [
-        np.asarray(
-            [_internal_value(model.params[n], v) - ref[n] for v in vals],
-            dtype=np.float64,
-        )
-        for n, vals in grid.items()
-    ]
-    mesh = np.meshgrid(*axes, indexing="ij")
-    pts = np.stack([m.ravel() for m in mesh], axis=-1)  # (npts, k)
+    pts = grid_mesh_points(axes)  # (npts, k)
     chi2 = _chi2_points(cm, gidx, pts, refit, n_refit_iter)
     return chi2.reshape([len(a) for a in axes])
 
@@ -80,24 +125,7 @@ def grid_chisq(
 def _chi2_points(cm, gidx, pts, refit, n_refit_iter):
     """One vmapped dispatch: chi2 at each (npts, k) delta point, with
     masked Gauss-Newton refits of the non-gridded free parameters."""
-    free_mask = np.ones(cm.nfree)
-    free_mask[np.asarray(gidx)] = 0.0
-    free_mask_j = jnp.asarray(free_mask)
-    no = noffset(cm)
-
-    def chi2_at(deltas):
-        x = cm.x0().at[gidx].set(deltas)
-        if refit:
-            for _ in range(n_refit_iter):
-                r = cm.time_residuals(x, subtract_mean=False)
-                M = design_with_offset(cm, x)
-                w = 1.0 / jnp.square(cm.scaled_sigma(x))
-                dx, _, _ = _wls_step(r, M, w)
-                # O(nfree) static mask — bakes as a ~p-float literal,
-                # intended (way below any transport/413 threshold)
-                x = x + free_mask_j * dx[no:]  # lint: ok(transport)
-        return cm.chi2(x)
-
+    chi2_at = make_chi2_at(cm, gidx, refit, n_refit_iter)
     return np.asarray(cm.jit(jax.vmap(chi2_at))(jnp.asarray(pts)))
 
 

@@ -130,7 +130,7 @@ def _sample_multi(rng, ells, m):
     return out[keep]
 
 
-def nested_sample(
+def nested_init(
     loglike_batch,
     prior_transform,
     ndim: int,
@@ -141,22 +141,16 @@ def nested_sample(
     enlarge: float = 1.25,
     seed: int = 0,
     method: str = "multi",
-):
-    """Run ellipsoid-rejection nested sampling.
+) -> dict:
+    """Draw the initial live set and return the full sampler state.
 
-    loglike_batch: (m, ndim) parameter array -> (m,) log-likelihoods
-      (wrap a jitted vmapped likelihood; called with full parameter
-      vectors from prior_transform).
-    prior_transform: unit-cube vector -> parameter vector (the
-      BayesianTiming.prior_transform contract).
-    method: 'multi' (default; recursive 2-means ellipsoid
-      decomposition, handles separated multimodal posteriors) or
-      'single' (one bounding ellipsoid — nestle's 'single').
-
-    Returns a dict with logz, logzerr, niter, ncall, samples
-    (equal-weight posterior), samples_raw, logwt, logl, and nells
-    (max simultaneous ellipsoid count seen — 1 for unimodal runs).
-    """
+    The state dict is everything nested_iterate/nested_result need —
+    live points, dead lists, evidence accumulators, the candidate
+    pool, the host RNG, and the run configuration — so a run can be
+    segmented at iteration granularity (the background-job quantum,
+    serve/jobs/runner.py) and checkpointed between segments
+    (nested_checkpoint_state / nested_restore_state) without changing
+    a single RNG draw relative to the uninterrupted nested_sample."""
     if method not in ("multi", "single"):
         raise ValueError(f"unknown method {method!r}")
     rng = np.random.default_rng(seed)
@@ -167,18 +161,32 @@ def nested_sample(
     # are treated as impossible, exactly like -inf; they then die
     # first and carry zero weight (see the logwt guard below)
     logl[np.isnan(logl)] = -np.inf
-    ncall = nlive
-
-    logz = -np.inf
-    h = 0.0
-    nells_max = 0
-    dead_x, dead_logl, dead_logwt = [], [], []
-    pool_c, pool_x, pool_l = (
-        np.empty((0, ndim)), np.empty((0, ndim)), np.empty(0)
+    return dict(
+        rng=rng, cubes=cubes, X=X, logl=logl, ncall=nlive,
+        logz=-np.inf, h=0.0, nells_max=0,
+        dead_x=[], dead_logl=[], dead_logwt=[],
+        pool_c=np.empty((0, ndim)), pool_x=np.empty((0, ndim)),
+        pool_l=np.empty(0), it=0, done=False,
+        ndim=ndim, nlive=nlive, batch=batch, dlogz=dlogz,
+        max_iter=max_iter, enlarge=enlarge, method=method,
     )
 
-    it = 0
-    while it < max_iter:
+
+def nested_iterate(st: dict, loglike_batch, prior_transform,
+                   n_iter: int) -> bool:
+    """Advance the sampler by up to ``n_iter`` dead points (in place).
+    Returns True when the run has terminated (dlogz criterion or
+    max_iter) — call nested_result exactly once after that.  The loop
+    body is the former nested_sample interior verbatim, so a chunked
+    run is draw-for-draw identical to the monolithic one."""
+    rng, nlive, batch = st["rng"], st["nlive"], st["batch"]
+    dlogz, enlarge, method = st["dlogz"], st["enlarge"], st["method"]
+    ndim = st["ndim"]
+    cubes, X, logl = st["cubes"], st["X"], st["logl"]
+    pool_c, pool_x, pool_l = st["pool_c"], st["pool_x"], st["pool_l"]
+    logz, h, it = st["logz"], st["h"], st["it"]
+    end = it + max(0, int(n_iter))
+    while it < end and it < st["max_iter"]:
         # termination BEFORE recording the worst point: the remaining
         # evidence is bounded by the max live logl over the current
         # volume; checking here keeps the dead and live sets disjoint
@@ -189,6 +197,7 @@ def nested_sample(
             np.isfinite(logz)
             and np.logaddexp(logz, logz_remain) - logz < dlogz
         ):
+            st["done"] = True
             break
         i_min = int(np.argmin(logl))
         l_min = float(logl[i_min])
@@ -206,9 +215,9 @@ def nested_sample(
             logz = logz_new
         # else: an impossible point (l_min = -inf) carries zero
         # weight — updating H through it would make logzerr NaN
-        dead_x.append(X[i_min].copy())
-        dead_logl.append(l_min)
-        dead_logwt.append(logwt)
+        st["dead_x"].append(X[i_min].copy())
+        st["dead_logl"].append(l_min)
+        st["dead_logwt"].append(logwt)
 
         # replacement: pool first (threshold only rises), else propose
         keep = pool_l > l_min
@@ -234,10 +243,10 @@ def nested_sample(
                     ell = _build_ellipsoids(
                         cubes, enlarge, min_pts=max(2 * ndim, 5)
                     )
-                    nells_max = max(nells_max, len(ell))
+                    st["nells_max"] = max(st["nells_max"], len(ell))
                 else:
                     ell = [_bounding_ellipsoid(cubes, enlarge)]
-                    nells_max = max(nells_max, 1)
+                    st["nells_max"] = max(st["nells_max"], 1)
             cand = (
                 _sample_multi(rng, ell, batch) if len(ell) > 1
                 else _sample_ellipsoid(rng, *ell[0], batch)
@@ -258,7 +267,7 @@ def nested_sample(
             cl = np.asarray(
                 loglike_batch(cx_pad), dtype=np.float64
             )[: len(cx)]
-            ncall += len(cx_pad)  # padded rows are evaluated too
+            st["ncall"] += len(cx_pad)  # padded rows are evaluated too
             good = cl > l_min
             pool_c, pool_x, pool_l = cand[good], cx[good], cl[good]
         cubes[i_min] = pool_c[0]
@@ -266,7 +275,24 @@ def nested_sample(
         logl[i_min] = pool_l[0]
         pool_c, pool_x, pool_l = pool_c[1:], pool_x[1:], pool_l[1:]
         it += 1
+    else:
+        if it >= st["max_iter"]:
+            st["done"] = True
+    st["pool_c"], st["pool_x"], st["pool_l"] = pool_c, pool_x, pool_l
+    st["logz"], st["h"], st["it"] = logz, h, it
+    return st["done"]
 
+
+def nested_result(st: dict) -> dict:
+    """Flush the final live points into the dead set and build the
+    result dict (the former nested_sample epilogue; consumes the state
+    RNG for the equal-weight resampling — call once)."""
+    nlive, it = st["nlive"], st["it"]
+    X, logl = st["X"], st["logl"]
+    logz, h = st["logz"], st["h"]
+    dead_x = list(st["dead_x"])
+    dead_logl = list(st["dead_logl"])
+    dead_logwt = list(st["dead_logwt"])
     # final live points: each carries 1/nlive of the remaining volume
     logdvol = -it / nlive - np.log(nlive)
     for j in range(nlive):
@@ -292,9 +318,108 @@ def nested_sample(
     p = np.exp(dead_logwt - dead_logwt.max())
     p /= p.sum()
     neff = int(1.0 / np.sum(p * p))
-    idx = rng.choice(len(p), size=max(neff, 1), p=p)
+    idx = st["rng"].choice(len(p), size=max(neff, 1), p=p)
     return dict(
         logz=float(logz), logzerr=logzerr, h=float(h), niter=it,
-        ncall=int(ncall), samples=dead_x[idx], samples_raw=dead_x,
-        logwt=dead_logwt, logl=dead_logl, nells=max(nells_max, 1),
+        ncall=int(st["ncall"]), samples=dead_x[idx], samples_raw=dead_x,
+        logwt=dead_logwt, logl=dead_logl,
+        nells=max(st["nells_max"], 1),
     )
+
+
+_NESTED_SCALARS = (
+    "ncall", "logz", "h", "nells_max", "it", "done", "ndim", "nlive",
+    "batch", "dlogz", "max_iter", "enlarge", "method",
+)
+_NESTED_ARRAYS = ("cubes", "X", "logl", "pool_c", "pool_x", "pool_l")
+_NESTED_LISTS = ("dead_logl", "dead_logwt")
+
+
+def nested_checkpoint_state(st: dict) -> dict:
+    """State -> a flat npz-able payload (checkpoint.save_job).  The
+    host Generator serializes via its bit_generator state dict (rides
+    as a pickled object array); dead_x keeps its per-point list
+    structure as a stacked array + count."""
+    out = {"rng_state": st["rng"].bit_generator.state}
+    for k in _NESTED_SCALARS:
+        out[k] = st[k]
+    for k in _NESTED_ARRAYS:
+        out[k] = np.asarray(st[k])
+    for k in _NESTED_LISTS:
+        out[k] = np.asarray(st[k], dtype=np.float64)
+    out["n_dead"] = len(st["dead_x"])
+    out["dead_x"] = (
+        np.stack(st["dead_x"]) if st["dead_x"]
+        else np.empty((0, st["ndim"]))
+    )
+    return out
+
+
+def nested_restore_state(payload: dict) -> dict:
+    """Inverse of nested_checkpoint_state — the restored state resumes
+    draw-for-draw where the checkpoint left off."""
+    st = {}
+    for k in _NESTED_SCALARS:
+        v = payload[k]
+        v = v.item() if hasattr(v, "item") else v
+        st[k] = str(v) if k == "method" else v
+    st["it"] = int(st["it"])
+    st["done"] = bool(st["done"])
+    for k in ("ndim", "nlive", "batch", "max_iter", "ncall",
+              "nells_max"):
+        st[k] = int(st[k])
+    for k in _NESTED_ARRAYS:
+        st[k] = np.array(payload[k], dtype=np.float64)
+    for k in _NESTED_LISTS:
+        st[k] = [float(v) for v in np.asarray(payload[k])]
+    st["dead_x"] = [
+        row.copy() for row in np.asarray(payload["dead_x"],
+                                         dtype=np.float64)
+    ]
+    rng = np.random.default_rng(0)
+    rng.bit_generator.state = payload["rng_state"]
+    st["rng"] = rng
+    return st
+
+
+def nested_sample(
+    loglike_batch,
+    prior_transform,
+    ndim: int,
+    nlive: int = 200,
+    batch: int = 128,
+    dlogz: float = 0.1,
+    max_iter: int = 200000,
+    enlarge: float = 1.25,
+    seed: int = 0,
+    method: str = "multi",
+):
+    """Run ellipsoid-rejection nested sampling.
+
+    loglike_batch: (m, ndim) parameter array -> (m,) log-likelihoods
+      (wrap a jitted vmapped likelihood; called with full parameter
+      vectors from prior_transform).
+    prior_transform: unit-cube vector -> parameter vector (the
+      BayesianTiming.prior_transform contract).
+    method: 'multi' (default; recursive 2-means ellipsoid
+      decomposition, handles separated multimodal posteriors) or
+      'single' (one bounding ellipsoid — nestle's 'single').
+
+    Composed of nested_init / nested_iterate / nested_result so the
+    background-job runner can execute the identical computation in
+    preemptible segments; this monolithic driver is draw-for-draw the
+    same run.
+
+    Returns a dict with logz, logzerr, niter, ncall, samples
+    (equal-weight posterior), samples_raw, logwt, logl, and nells
+    (max simultaneous ellipsoid count seen — 1 for unimodal runs).
+    """
+    st = nested_init(
+        loglike_batch, prior_transform, ndim, nlive=nlive, batch=batch,
+        dlogz=dlogz, max_iter=max_iter, enlarge=enlarge, seed=seed,
+        method=method,
+    )
+    while not nested_iterate(st, loglike_batch, prior_transform,
+                             max_iter):
+        pass
+    return nested_result(st)

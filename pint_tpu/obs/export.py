@@ -304,6 +304,21 @@ def flight_report(tracer=None, guard_report=None, top: int = 12) -> str:
                 f"{k}={v}" for k, v in sorted(elastic_bits.items())
             )
         )
+    # background jobs (ISSUE 20): the preemptible class's lifecycle
+    # counters — quanta served, yields to interactive pressure,
+    # checkpoint/restore traffic, quantum faults
+    job_bits = {
+        k.split(".", 2)[2]: v
+        for k, v in snap.items()
+        if k.startswith("serve.jobs.")
+        and not isinstance(v, dict) and v not in (0, None)
+    }
+    if job_bits:
+        lines.append(
+            "background jobs: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(job_bits.items())
+            )
+        )
     # slow-request exemplars: the window's worst-k flights with their
     # last completed stage (full stage vectors in engine stats())
     exemplars = snap.get("serve.latency.exemplars") or []

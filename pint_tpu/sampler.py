@@ -18,32 +18,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def run_ensemble(
-    lnpost: Callable,
-    x0: np.ndarray,
+def ensemble_init(
+    x0,
     nwalkers: int = 64,
-    nsteps: int = 1000,
-    a: float = 2.0,
     seed: int = 0,
     init_scale=1e-8,
     init_cov=None,
     init_walkers=None,
 ):
-    """Sample lnpost with stretch moves.
-
-    x0 (ndim,): starting point.  Walkers start at init_walkers
-    (nwalkers, ndim) when given — the exact-resume path used by
-    checkpoint.resume_mcmc — else in a ball shaped by init_cov
-    (ndim, ndim), else isotropic init_scale (scalar or per-dim vector).
-    Stretch moves are affine-invariant, but a well-shaped initial
-    ensemble is what makes them mix immediately when parameter scales
-    span many decades.  Returns (chain (nsteps, nwalkers, ndim),
-    lnp (nsteps, nwalkers), acceptance_fraction).
-    """
+    """Initial ensemble + the post-init RNG key, factored out of
+    run_ensemble so the background-job runner (serve/jobs/runner.py)
+    starts from the EXACT same walker positions and key state as an
+    uninterrupted run (walker-count rules and RNG call order are part
+    of the bitwise-resume contract).  Returns (walkers (nwalkers,
+    ndim), key)."""
     ndim = int(np.asarray(x0).shape[-1])
     if init_walkers is not None:
         walkers = jnp.asarray(init_walkers)
-        nwalkers = walkers.shape[0]
+        nwalkers = int(walkers.shape[0])
         if nwalkers % 2:
             raise ValueError("init_walkers needs an even walker count")
     else:
@@ -52,6 +44,9 @@ def run_ensemble(
         if nwalkers % 2:
             nwalkers += 1
     key = jax.random.PRNGKey(seed)
+    # k0 is consumed even on the init_walkers path so the step-key
+    # schedule below is a function of (seed, nsteps_total) only —
+    # never of HOW the ensemble was initialized
     key, k0 = jax.random.split(key)
     if init_walkers is None:
         ball = jax.random.normal(k0, (nwalkers, ndim))
@@ -64,8 +59,40 @@ def run_ensemble(
         else:
             offs = ball * jnp.asarray(init_scale)
         walkers = jnp.asarray(x0) + offs
-    lnpost_v = jax.vmap(lnpost)
-    lp = lnpost_v(walkers)
+    return walkers, key
+
+
+def ensemble_keys(key, nsteps: int, nsteps_total=None, start: int = 0):
+    """Per-step key slice [start, start+nsteps) of a PLANNED schedule.
+
+    jax.random.split(key, n) yields different keys for different n, so
+    a resumable run must fix the schedule length up front: the full
+    plan is split(key, nsteps_total) and every segment slices it.  A
+    run segmented this way is bitwise-identical to the uninterrupted
+    split(key, nsteps_total) run — the contract the preemption path
+    (serve/jobs/) and checkpoint.resume_mcmc rely on.  With no plan
+    (nsteps_total None) and start > 0, the plan defaults to
+    start + nsteps (deterministic continuation past a completed run).
+    """
+    if nsteps_total is None and start == 0:
+        return jax.random.split(key, nsteps)
+    total = int(nsteps_total) if nsteps_total is not None else start + nsteps
+    if start + nsteps > total:
+        raise ValueError(
+            f"segment [{start}, {start + nsteps}) exceeds the planned "
+            f"schedule of {total} steps"
+        )
+    return jax.random.split(key, total)[start:start + nsteps]
+
+
+def make_stretch_step(lnpost_v: Callable, ndim: int, nwalkers: int,
+                      a: float = 2.0):
+    """One Goodman-Weare ensemble step as a lax.scan body:
+    (walkers, lp), key -> ((walkers, lp), (walkers, lp, n_accepted)).
+    Shared verbatim between run_ensemble and the background-job
+    quantum kernel (serve/jobs/kernels.py) — one source of truth for
+    the proposal math is what makes job-path chains bitwise-comparable
+    to host-path chains."""
     half = nwalkers // 2
 
     def half_step(carry, keys, first_half: bool):
@@ -106,7 +133,52 @@ def run_ensemble(
         (walkers, lp) = carry
         return carry, (walkers, lp, acc1 + acc2)
 
-    keys = jax.random.split(key, nsteps)
+    return step
+
+
+def run_ensemble(
+    lnpost: Callable,
+    x0: np.ndarray,
+    nwalkers: int = 64,
+    nsteps: int = 1000,
+    a: float = 2.0,
+    seed: int = 0,
+    init_scale=1e-8,
+    init_cov=None,
+    init_walkers=None,
+    init_lp=None,
+    nsteps_total=None,
+    start: int = 0,
+):
+    """Sample lnpost with stretch moves.
+
+    x0 (ndim,): starting point.  Walkers start at init_walkers
+    (nwalkers, ndim) when given — the exact-resume path used by
+    checkpoint.resume_mcmc — else in a ball shaped by init_cov
+    (ndim, ndim), else isotropic init_scale (scalar or per-dim vector).
+    Stretch moves are affine-invariant, but a well-shaped initial
+    ensemble is what makes them mix immediately when parameter scales
+    span many decades.
+
+    Resume contract (see ensemble_keys): a run planned as
+    nsteps_total steps may execute as segments — pass start (steps
+    already done), init_walkers and init_lp (the carried ensemble and
+    its log-posteriors; passing init_lp skips the re-evaluation so the
+    continuation is bitwise, not merely numerically, identical) — and
+    the concatenated segments equal the uninterrupted run exactly.
+
+    Returns (chain (nsteps, nwalkers, ndim), lnp (nsteps, nwalkers),
+    acceptance_fraction).
+    """
+    walkers, key = ensemble_init(
+        x0, nwalkers=nwalkers, seed=seed, init_scale=init_scale,
+        init_cov=init_cov, init_walkers=init_walkers,
+    )
+    nwalkers, ndim = int(walkers.shape[0]), int(walkers.shape[1])
+    lnpost_v = jax.vmap(lnpost)
+    lp = lnpost_v(walkers) if init_lp is None else jnp.asarray(init_lp)
+    step = make_stretch_step(lnpost_v, ndim, nwalkers, a)
+    keys = ensemble_keys(key, nsteps, nsteps_total, start)
     (_, _), (chain, lnp, acc) = jax.lax.scan(step, (walkers, lp), keys)
     return (
         np.asarray(chain),
@@ -226,6 +298,12 @@ class MCMCFitter:
             init_cov=self._init_cov(),
         )
         self.chain, self.lnp, self.acceptance = chain, lnp, acc
+        # RNG-cursor record for checkpoint.save_mcmc: where in the
+        # planned key schedule this chain ends (the resume contract —
+        # sampler.ensemble_keys)
+        self.run_meta = dict(
+            seed=seed, nsteps_done=nsteps, nsteps_total=nsteps,
+        )
         nburn = int(burn * len(chain))
         flat = chain[nburn:].reshape(-1, self.bt.nparams)
         med = np.median(flat, axis=0)

@@ -161,6 +161,89 @@ class PredictRequest(Request):
 
 
 @dataclass(frozen=True)
+class JobRequest(Request):
+    """A background-class compute job (ISSUE 20): long-running,
+    preemptible, checkpointed — the second traffic class next to the
+    interactive ops above.  Kinds:
+
+    - ``grid_chisq``: the chi2 surface over the outer product of
+      ``grid`` (param name -> par-file-unit values; the
+      pint_tpu.gridutils contract), refitting the non-gridded free
+      parameters per point when ``refit``.
+    - ``mcmc``: ``nsteps`` of the Goodman-Weare ensemble sampler over
+      the timing posterior (pint_tpu.sampler semantics; ``priors``
+      override the per-parameter defaults).
+    - ``nested``: nested sampling of the evidence (pint_tpu.nested;
+      every prior must be proper).
+
+    Jobs run ONLY on executors the router reports idle, yield to
+    interactive SLO pressure, and — when ``checkpoint_path`` is set —
+    checkpoint atomically every quantum and RESUME from that file on
+    resubmission (bitwise for mcmc, draw-for-draw for nested,
+    cursor-exact for grids).  Admission/scheduling:
+    serve/jobs/scheduler.py; docs/serving.md "background jobs"."""
+
+    kind: str = "grid_chisq"
+    #: grid_chisq: param name -> par-file-unit values (dict order =
+    #: output axis order)
+    grid: object = None
+    refit: bool = True
+    n_refit_iter: int = 2
+    #: mcmc / nested
+    nsteps: int = 1000
+    nwalkers: int = 64
+    a: float = 2.0
+    seed: int = 0
+    init_scale: object = 1e-8
+    init_cov: object = None
+    init_walkers: object = None
+    priors: object = None  # param name -> models.priors Prior
+    #: nested
+    nlive: int = 200
+    batch: int = 128
+    dlogz: float = 0.1
+    max_iter: int = 200000
+    enlarge: float = 1.25
+    method: str = "multi"
+    #: resume anchor: checkpointed every quantum (atomic npz via
+    #: pint_tpu.checkpoint.save_job) and restored at admission when
+    #: the file exists
+    checkpoint_path: Optional[str] = None
+
+    op: ClassVar[str] = "job"
+
+    def validate(self):
+        super().validate()
+        if self.kind not in ("grid_chisq", "mcmc", "nested"):
+            raise PintTpuError(
+                f"unknown job kind {self.kind!r}: expected "
+                "'grid_chisq', 'mcmc', or 'nested'"
+            )
+        if self.kind == "grid_chisq":
+            if not isinstance(self.grid, dict) or not self.grid:
+                raise PintTpuError(
+                    "grid_chisq job needs a non-empty grid dict "
+                    "(param name -> values)"
+                )
+            if self.n_refit_iter < 0:
+                raise PintTpuError("n_refit_iter must be >= 0")
+        if self.kind == "mcmc":
+            if self.nsteps < 1:
+                raise PintTpuError("mcmc job needs nsteps >= 1")
+            if self.nwalkers < 2:
+                raise PintTpuError("mcmc job needs nwalkers >= 2")
+        if self.kind == "nested":
+            if self.nlive < 2 or self.batch < 1:
+                raise PintTpuError(
+                    "nested job needs nlive >= 2 and batch >= 1"
+                )
+            if self.method not in ("multi", "single"):
+                raise PintTpuError(
+                    f"unknown nested method {self.method!r}"
+                )
+
+
+@dataclass(frozen=True)
 class AppendRequest(Request):
     """Absorb a TAIL of newly-observed TOAs into a long-lived
     streaming session (serve/stream.py::ObserveSession) — the
@@ -261,6 +344,29 @@ class AppendResponse:
     #: advanced solver state (engine-internal; ObserveSession commits
     #: it and strips it before handing the response to the caller)
     state: object = None
+
+
+@dataclass
+class JobResponse:
+    """Result of one background job.  ``result`` is the kind-specific
+    payload: grid_chisq -> {chi2 (grid-shaped), names, shape, npts};
+    mcmc -> {chain (nsteps, nwalkers, ndim), lnp, acceptance};
+    nested -> the pint_tpu.nested result dict (logz, samples, ...).
+    ``quanta``/``preemptions``/``resumed`` are the job's flight
+    provenance (how many device-time slices it took, how often it
+    yielded to interactive pressure, whether it continued from an
+    on-disk checkpoint)."""
+
+    request_id: str
+    kind: str
+    result: dict
+    quanta: int
+    preemptions: int
+    resumed: bool
+    ntoa: int
+    bucket: int
+    wall_ms: float
+    stages: dict = field(default_factory=dict)  # monotonic stage stamps
 
 
 @dataclass

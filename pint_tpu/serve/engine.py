@@ -258,6 +258,12 @@ class TimingEngine:
         self._m_depth = m.gauge("serve.queue_depth")
         self._m_quota = m.counter("serve.quota_rejected")
         self._m_slo_close = m.counter("serve.slo.early_close")
+        # background compute class (ISSUE 20): preemptible jobs on
+        # spare capacity — built before the warm replay so ledgered
+        # job kernels prewarm through the scheduler's own cache
+        from pint_tpu.serve.jobs import JobScheduler
+
+        self._jobs = JobScheduler(self)
         # warm-ledger boot REPLAY (ISSUE 11) before the collector
         # exists — prewarm_kernel's boot-thread safety contract
         # (serve/fabric/replica.py)
@@ -265,9 +271,17 @@ class TimingEngine:
             with TRACER.span(
                 "serve:warm-replay", "serve", path=path,
             ):
-                jobs = self._replay_jobs()
-                if jobs:
-                    self.pool.prewarm(jobs)
+                works = self._replay_jobs(include_jobs=True)
+                interactive = [
+                    w for w in works if w[0].key[0] != "job"
+                ]
+                background = [
+                    w for w in works if w[0].key[0] == "job"
+                ]
+                if interactive:
+                    self.pool.prewarm(interactive)
+                if background:
+                    self._jobs.prewarm(background)
         # elastic repartitioner (ISSUE 16): load-driven online
         # gang/single reshaping — off unless opted in (env
         # PINT_TPU_SERVE_ELASTIC or the `elastic` kwarg; a dict passes
@@ -303,6 +317,11 @@ class TimingEngine:
             "serve:submit", "serve", op=request.op,
             request_id=request.request_id, flow=request.request_id,
         ):
+            if request.op == "job":
+                # background compute class (ISSUE 20): jobs bypass
+                # the interactive queue/batcher into the preemptible
+                # JobScheduler (serve/jobs/scheduler.py)
+                return self._jobs.submit(request, fut)
             with self._cond:
                 if self._stop:
                     fut.set_exception(RequestRejected(
@@ -439,22 +458,7 @@ class TimingEngine:
                 ):
                     self._predict(p)
                 return None
-            from pint_tpu.toas.bundle import make_bundle
-            from pint_tpu.toas.ingest import ingest_for_model
-
-            # per-par layer first (host parse at worst), then the
-            # request's own host-numpy bundle — built exactly once: it
-            # keys the composition AND becomes the stacked operand
-            rec = self.sessions.record_for(req.par)
-            if req.toas.t_tdb is None:
-                ingest_for_model(req.toas, rec.model)
-            nb = make_bundle(
-                req.toas, rec.model._build_masks(req.toas),
-                as_numpy=True,
-            )
-            sess = self.sessions.session_for(
-                rec, req.toas, nb, self.min_bucket
-            )
+            rec, sess, padded = self._session_for_request(req)
             p.session = sess
             p.record = rec
             self._check_quota(p, sess.cid)
@@ -502,7 +506,7 @@ class TimingEngine:
                 )
             else:
                 raise PintTpuError(f"unknown serve op {req.op!r}")
-            p.bundle = bmod.pad_bundle_np(nb, sess.bucket)
+            p.bundle = padded
             deadline = (
                 None if req.deadline_s is None
                 else p.t_submit + float(req.deadline_s)
@@ -522,6 +526,29 @@ class TimingEngine:
                     else PintTpuError(f"admit failed: {e!r}")
                 )
             return None
+
+    def _session_for_request(self, req):
+        """Per-par record + composition session + PADDED bundle for
+        one request — the shared admission interior (the collector's
+        _admit for interactive ops; JobScheduler._admit for the
+        background class).  The per-par layer resolves first (a host
+        parse at worst), then the request's host-numpy bundle keys
+        the composition AND becomes the dispatch operand — a known
+        composition admits with ZERO compiles."""
+        from pint_tpu.toas.bundle import make_bundle
+        from pint_tpu.toas.ingest import ingest_for_model
+
+        rec = self.sessions.record_for(req.par)
+        if req.toas.t_tdb is None:
+            ingest_for_model(req.toas, rec.model)
+        nb = make_bundle(
+            req.toas, rec.model._build_masks(req.toas),
+            as_numpy=True,
+        )
+        sess = self.sessions.session_for(
+            rec, req.toas, nb, self.min_bucket
+        )
+        return rec, sess, bmod.pad_bundle_np(nb, sess.bucket)
 
     def _check_quota(self, p: _Pending, cid: str):
         """Per-composition admission quota + fairness chokepoint
@@ -936,17 +963,25 @@ class TimingEngine:
                 lat_ms, p.req.request_id, stages, now=t
             )
 
-    def _replay_jobs(self) -> list:
+    def _replay_jobs(self, include_jobs: bool = False) -> list:
         """Resolve the warm ledger into pre-warm jobs — the boot
         replay and the pool's reshape-time prewarm both draw from
-        here ([] when no ledger is configured)."""
+        here ([] when no ledger is configured).  Background-job
+        kernels (key[0] == 'job') are excluded by default: the pool's
+        replica prewarm path cannot serve them (the JobScheduler owns
+        its own kernel cache) — boot passes ``include_jobs=True`` and
+        routes them to ``JobScheduler.prewarm``; after a repartition
+        they rebuild on demand as persistent-XLA-cache hits."""
         from pint_tpu.serve import warm_ledger as wlmod
 
         if self._ledger is None:
             return []
-        return wlmod.replay_jobs(
+        works = wlmod.replay_jobs(
             self._ledger, self.sessions, self.max_batch
         )
+        if not include_jobs:
+            works = [w for w in works if w[0].key[0] != "job"]
+        return works
 
     # -- stats / lifecycle -------------------------------------------------
     def stats(self) -> dict:
@@ -1082,6 +1117,9 @@ class TimingEngine:
                     "serve.stream.cold_fallback"
                 ).value,
             },
+            # background compute class (ISSUE 20): job lifecycle
+            # counters + quantum latency (docs/serving.md)
+            "jobs": self._jobs.stats(),
         }
 
     def reset_stats(self):
@@ -1108,6 +1146,10 @@ class TimingEngine:
         if self._elastic is not None:
             self._elastic.stop()
         self._collector.join(timeout)
+        # the job scheduler stops BEFORE the pool drains: running
+        # jobs checkpoint and shed typed, so no background quantum is
+        # in flight while replicas drain
+        self._jobs.stop()
         self.pool.drain(timeout)
         with self._streams_lock:
             exc, self._stream_exec = self._stream_exec, None

@@ -390,6 +390,12 @@ class Replica:
             threading.Lock(), "Replica._state_lock"
         )
         self._consecutive = 0  # lint: guarded-by(_state_lock)
+        # background-quantum occupancy (ISSUE 20): written by the job
+        # scheduler around each quantum via note_background; the
+        # router folds it into capacity-weighted load so interactive
+        # placement avoids busy-with-background executors.  Reads are
+        # bare attribute loads (GIL-atomic), like _state.
+        self.background = 0  # lint: guarded-by(_state_lock)
         self.batches_done = 0  # fencer-thread only
         self.failures = 0  # lint: guarded-by(_state_lock)
         self._outstanding = 0  # batches queued + in flight; lint: guarded-by(_cond)
@@ -1120,6 +1126,14 @@ class Replica:
             self._consecutive = 0
             if self._state == DEGRADED:
                 self._set_state(LIVE, kind="recovered")
+
+    def note_background(self, delta: int):
+        """Background-quantum occupancy change (ISSUE 20): the job
+        scheduler brackets each dispatched quantum with +1/-1 so the
+        router's capacity-weighted load sees the executor as busy for
+        exactly the quantum's (bounded) duration."""
+        with self._state_lock:
+            self.background = max(0, self.background + int(delta))
 
     def readmit(self):
         """Probe-driven re-admission (pool's canary loop)."""

@@ -85,8 +85,12 @@ def _width(r) -> int:
 def _load(r) -> float:
     """Capacity-weighted load: outstanding batches per device — the
     comparable quantity across executors of different widths (the
-    raw-outstanding tie-break starved mixed pools, ISSUE 10)."""
-    return r.outstanding / _width(r)
+    raw-outstanding tie-break starved mixed pools, ISSUE 10).  The
+    ``background`` term is the job scheduler's in-flight quantum
+    count (ISSUE 20): interactive placement steers AWAY from an
+    executor while a background quantum occupies it, without ever
+    refusing it — jobs are bounded and preemptible, never blocking."""
+    return (r.outstanding + getattr(r, "background", 0)) / _width(r)
 
 
 def _saturated(r) -> bool:

@@ -1,0 +1,26 @@
+"""Background compute class (ISSUE 20): preemptible sampling and
+blind-search grid jobs on spare fleet capacity.
+
+A second traffic class next to interactive serving: long-running jobs
+(`grid_chisq` chi2 surfaces, `mcmc` ensemble sampling, `nested`
+evidence runs) enter through the same ``TimingEngine.submit`` surface
+as a :class:`~pint_tpu.serve.api.JobRequest`, are sliced into bounded
+device-time *quanta* by the :class:`~pint_tpu.serve.jobs.scheduler.
+JobScheduler`, and run ONLY on executors the router reports idle.  On
+SLO pressure the scheduler yields — the in-flight quantum finishes
+(quanta are bounded by construction), the job checkpoints
+(pint_tpu.checkpoint.save_job), and it resumes bitwise where it left
+off when pressure clears, across pool repartitions and process
+restarts (the warm ledger replays job kernels too).
+
+docs/serving.md "background jobs" is the narrative; pintlint rule
+obs13 pins the chokepoints.
+"""
+
+from pint_tpu.serve.jobs.api import (  # noqa: F401
+    PREEMPTED,
+    QUEUED,
+    RUNNING,
+    Job,
+)
+from pint_tpu.serve.jobs.scheduler import JobScheduler  # noqa: F401

@@ -1,0 +1,57 @@
+"""The scheduler-side job record.
+
+One :class:`Job` per admitted :class:`~pint_tpu.serve.api.JobRequest`:
+the resolved session/record, the padded single-par operands (the
+bundle and numeric reference every quantum rides in on), the runner
+(kind-specific progress state — serve/jobs/runner.py), and the
+lifecycle bookkeeping the scheduler and stats()/fleetview read
+(state, quanta, preemptions, the sticky executor home, stage stamps).
+
+States: ``QUEUED`` (admitted, waiting for idle capacity) ->
+``RUNNING`` (quanta dispatching) <-> ``PREEMPTED`` (yielded to
+interactive pressure; checkpointed) -> resolved (future done).
+"""
+
+from __future__ import annotations
+
+import time
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+PREEMPTED = "PREEMPTED"
+
+
+class Job:
+    """One background job in flight (scheduler-thread owned after
+    admission; ``future``/``stages`` writes follow the engine's
+    _Pending conventions so responses carry the same monotonic stage
+    vector interactive requests do)."""
+
+    def __init__(self, req, future, t_submit=None):
+        self.req = req
+        self.future = future
+        self.t_submit = time.monotonic() if t_submit is None else t_submit
+        self.stages = {"submit": self.t_submit}
+        self.flow = req.request_id  # serve:submit seeded the flow id
+        self.state = QUEUED
+        # admission fills these (scheduler._admit)
+        self.session = None
+        self.record = None
+        self.bundle = None  # padded single-par bundle (host numpy)
+        self.refnum = None
+        self.runner = None
+        self.priors = None
+        self.prior_tag = ""
+        self.ledgerable = False
+        # progress / lifecycle bookkeeping
+        self.quanta = 0
+        self.preemptions = 0
+        self.resumed = False  # restored from an on-disk checkpoint
+        self.fault_count = 0
+        self.excluded: set = set()  # executor tags that failed a quantum
+        self.home = None  # sticky executor tag (avoids re-traces)
+        self.checkpoint_payload = None  # last in-memory checkpoint
+
+    @property
+    def kind(self) -> str:
+        return self.req.kind

@@ -1,0 +1,170 @@
+"""Warmed serve-style device kernels for background-job quanta.
+
+Reference parity: none directly — the host-path sources these kernels
+batch are pint_tpu.gridutils (reference src/pint/gridutils.py, where
+every grid point is a subprocess refit) and pint_tpu.sampler /
+pint_tpu.bayesian (reference src/pint/sampler.py + bayesian.py, one
+emcee likelihood call per walker per step).  Here each job kind's
+device interior is ONE jitted program per (composition, bucket, kind,
+quantum) built through the serve dispatch chokepoint
+(serve/session.py::traced_jit), with the job's padded bundle + numeric
+reference riding as runtime arguments exactly like interactive serve
+kernels — a new par of a known composition compiles NOTHING.
+
+Quanta are power-of-two sized and shape-stable:
+
+- ``grid``: a vmapped chi2-with-refit over a (quantum, k) chunk of
+  grid points (the gridutils.make_chi2_at body verbatim, so job-path
+  surfaces cannot drift from the host path); short final chunks pad by
+  repeating a row and the runner slices the pad off on the host.
+- ``mcmc``: a fixed-quantum lax.scan of the Goodman-Weare stretch step
+  (sampler.make_stretch_step verbatim) whose carry (walkers, lp) is a
+  runtime argument; ``nlive`` masks dead trailing steps with
+  jnp.where, so a partial final quantum reuses the SAME traced program
+  — and a full quantum's select(True, new, old) is bitwise the
+  unmasked step, which is what makes preempt/resume chains
+  bitwise-identical to uninterrupted runs.
+- ``mcmc0``: the one-off vmapped log-posterior of the initial ensemble
+  (the ``lp`` seed run_ensemble computes before its scan).
+- ``nested``: the vmapped marginalized log-likelihood batch the nested
+  sampler's rejection loop scores candidates with.
+
+Job kernels NEVER donate: quanta are small, carry state is re-fed next
+quantum, and the serving donation contract's fence-owned discipline
+(CLAUDE.md r14) buys nothing for background throughput.
+
+Kernel identity is the job group key (see scheduler._job_keys):
+``("job", composition, bucket, kind, *kind-params)`` — MCMC keys fold
+the prior tag because prior constants bake into the trace
+(bayesian.make_lnprior).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.bayesian import lnlikelihood_cm, make_lnprior
+from pint_tpu.exceptions import PintTpuError
+from pint_tpu.gridutils import make_chi2_at
+from pint_tpu.sampler import make_stretch_step
+from pint_tpu.serve.session import _with_swapped, traced_jit
+
+
+def job_site(key: tuple, cap: int, tag: str) -> str:
+    """The per-executor dispatch site of one job kernel — the
+    ``serve:job:*`` span/fault namespace (pintlint rule obs13 pins the
+    prefix; PINT_TPU_FAULTS targets quanta per executor through it)."""
+    return f"serve:job:{key[3]}:b{int(key[2])}x{int(cap)}@{tag}"
+
+
+def build_job_kernel(session, key: tuple, cap: int, tag: str,
+                     priors: dict | None = None, warm=None):
+    """One traced job-quantum kernel for ``key`` on executor ``tag``.
+
+    Dispatches on the kind slot ``key[3]``; ``warm`` threads the
+    warm-restart ledger write-through (serve/warm_ledger.py) exactly
+    like interactive kernels — pass None for non-ledgerable identities
+    (caller-supplied priors / non-founder MCMC pars, whose baked
+    constants a replay could not reconstruct)."""
+    kind = key[3]
+    site = job_site(key, cap, tag)
+    if kind == "grid":
+        return _build_grid(session, key, site, warm)
+    if kind == "mcmc":
+        return _build_mcmc(session, key, site, priors, warm)
+    if kind == "mcmc0":
+        return _build_mcmc0(session, key, site, priors, warm)
+    if kind == "nested":
+        return _build_nested(session, key, site, warm)
+    raise PintTpuError(f"unknown job kernel kind {kind!r}")
+
+
+def _build_grid(session, key, site, warm):
+    """(bundle, refnum, pts (q, k)) -> chi2 (q,): the vmapped
+    grid_chisq interior over the swapped-in request par."""
+    proto = session.cm
+    names, refit, iters = key[4], bool(key[5]), int(key[6])
+    gidx = [proto._index[n] for n in names]
+    chi2_at = make_chi2_at(proto, gidx, refit, iters)
+    call = _with_swapped(
+        proto, session.static_ref,
+        lambda cm, pts: jax.vmap(chi2_at)(pts),
+    )
+    return traced_jit(call, site, cid=session.cid, warm=warm)
+
+
+def _lnpost_fns(proto, priors):
+    """(lnpost, lnlike) closures over the (swap-mutated) prototype."""
+    lnprior = (
+        make_lnprior(priors, list(proto.free_names))
+        if priors else None
+    )
+
+    def lnpost(x):
+        lp = lnlikelihood_cm(proto, x)
+        return lp if lnprior is None else lp + lnprior(x)
+
+    return lnpost
+
+
+def _build_mcmc(session, key, site, priors, warm):
+    """(bundle, refnum, walkers, lp, keys (q, 2), nlive) ->
+    (walkers', lp', chain (q, nw, ndim), lnp (q, nw), n_accept).
+
+    The scan body is sampler.make_stretch_step over the vmapped
+    posterior; steps past ``nlive`` are masked no-ops so the final
+    short quantum of a run never retraces.  For fully-live quanta the
+    mask is select(True, stepped, carried) = the stepped value
+    bitwise, preserving the resume contract."""
+    proto = session.cm
+    nwalkers, a = int(key[4]), float(key[5])
+    ndim = proto.nfree
+
+    def body(cm, walkers, lp, keys, nlive):
+        lnpost_v = jax.vmap(_lnpost_fns(cm, priors))
+        step = make_stretch_step(lnpost_v, ndim, nwalkers, a)
+
+        def masked(carry, key_i):
+            k, i = key_i
+            w0, l0 = carry
+            (w1, l1), (_, _, acc) = step(carry, k)
+            live = i < nlive
+            w2 = jnp.where(live, w1, w0)
+            l2 = jnp.where(live, l1, l0)
+            return (w2, l2), (w2, l2, jnp.where(live, acc, 0))
+
+        q = keys.shape[0]
+        (wf, lf), (chain, lnp, acc) = jax.lax.scan(
+            masked, (walkers, lp), (keys, jnp.arange(q))
+        )
+        return wf, lf, chain, lnp, jnp.sum(acc)
+
+    call = _with_swapped(proto, session.static_ref, body)
+    return traced_jit(call, site, cid=session.cid, warm=warm)
+
+
+def _build_mcmc0(session, key, site, priors, warm):
+    """(bundle, refnum, walkers (nw, ndim)) -> lp (nw,): the initial
+    ensemble's log-posteriors — the exact expression run_ensemble
+    seeds its scan with."""
+    proto = session.cm
+
+    def body(cm, walkers):
+        return jax.vmap(_lnpost_fns(cm, priors))(walkers)
+
+    call = _with_swapped(proto, session.static_ref, body)
+    return traced_jit(call, site, cid=session.cid, warm=warm)
+
+
+def _build_nested(session, key, site, warm):
+    """(bundle, refnum, X (q, ndim)) -> logl (q,): the vmapped
+    marginalized likelihood batch (bayesian.lnlikelihood_cm) the
+    nested sampler's host loop scores candidates with."""
+    proto = session.cm
+
+    def body(cm, X):
+        return jax.vmap(lambda x: lnlikelihood_cm(cm, x))(X)
+
+    call = _with_swapped(proto, session.static_ref, body)
+    return traced_jit(call, site, cid=session.cid, warm=warm)

@@ -181,6 +181,32 @@ class WarmLedger:
             }
         elif op == "residuals":
             params = {"subtract_mean": bool(key[3])}
+        elif op == "job":
+            # background-class quantum kernels (ISSUE 20): the kind
+            # slot key[3] decides the param schema.  MCMC entries are
+            # only ever recorded for founder-par default-prior
+            # kernels (JobScheduler marks those ledgerable), so
+            # replay can rebuild the baked prior constants.
+            kind = str(key[3])
+            if kind == "grid":
+                params = {
+                    "kind": kind, "names": list(key[4]),
+                    "refit": bool(key[5]), "iters": int(key[6]),
+                }
+            elif kind == "mcmc":
+                params = {
+                    "kind": kind, "nwalkers": int(key[4]),
+                    "a": float(key[5]), "prior": str(key[6]),
+                }
+            elif kind == "mcmc0":
+                params = {
+                    "kind": kind, "nwalkers": int(key[4]),
+                    "prior": str(key[5]),
+                }
+            elif kind == "nested":
+                params = {"kind": kind}
+            else:
+                return
         else:
             return
         placement = "gang" if str(tag).startswith("g") else "single"
@@ -313,6 +339,54 @@ def replay_jobs(ledger: WarmLedger, sessions, max_batch=None) -> list:
                 rec, cm, int(e["bucket"]), comp
             ))
             params = e["params"]
+            placements = tuple(e.get("placements") or ("single",))
+            if e["op"] == "job":
+                # background-class quantum kernels (ISSUE 20): jobs
+                # dispatch UNSTACKED operands — one (bundle, refnum)
+                # pair plus the kind's quantum-shaped extras — through
+                # JobScheduler.prewarm, not the pool's stacked path.
+                kind = str(params["kind"])
+                if kind == "grid":
+                    key = (
+                        "job", sess.composition, sess.bucket, "grid",
+                        tuple(params["names"]), bool(params["refit"]),
+                        int(params["iters"]),
+                    )
+                elif kind == "mcmc":
+                    key = (
+                        "job", sess.composition, sess.bucket, "mcmc",
+                        int(params["nwalkers"]), float(params["a"]),
+                        str(params["prior"]),
+                    )
+                elif kind == "mcmc0":
+                    key = (
+                        "job", sess.composition, sess.bucket, "mcmc0",
+                        int(params["nwalkers"]), str(params["prior"]),
+                    )
+                else:
+                    key = ("job", sess.composition, sess.bucket,
+                           "nested")
+                ndim = sess.cm.nfree
+                for cap in e["caps"]:
+                    cap = int(cap)
+                    if kind == "grid":
+                        extras = (np.zeros((cap, len(key[4]))),)
+                    elif kind == "mcmc":
+                        nw = int(params["nwalkers"])
+                        extras = (
+                            np.zeros((nw, ndim)),
+                            np.full(nw, -1.0),
+                            np.zeros((cap, 2), np.uint32),
+                            np.int32(0),
+                        )
+                    else:  # mcmc0 / nested: one (cap, ndim) block
+                        extras = (np.zeros((cap, ndim)),)
+                    ops = (sess.cm.bundle, rec.refnum) + extras
+                    jobs.append((
+                        BatchWork(key, [], ops, sess, cap),
+                        placements,
+                    ))
+                continue
             if e["op"] == "fit":
                 key = (
                     "fit", sess.composition, sess.bucket, sess.mode,
@@ -323,7 +397,6 @@ def replay_jobs(ledger: WarmLedger, sessions, max_batch=None) -> list:
                     "residuals", sess.composition, sess.bucket,
                     bool(params["subtract_mean"]),
                 )
-            placements = tuple(e.get("placements") or ("single",))
             for cap in e["caps"]:
                 cap = int(cap)
                 if cap_ceiling is not None and cap > cap_ceiling:

@@ -2,7 +2,9 @@
 
 Wraps :func:`tools.chaos.run_sweep` — the deterministic whole-fabric
 fault matrix (every replica/gang-tagged guard site x every fault kind
-the injector knows, plus the kill-and-restart warm-ledger leg) — in
+the injector knows, the background-job legs (ISSUE 20: quantum
+faults, preempt-under-flood, kill-mid-job resume), plus the
+kill-and-restart warm-ledger leg) — in
 the ~60 s envelope the driver-run profiling ladder expects: a small
 mixed pool (one gang + singles when the host has >= 4 serving
 devices, all singles otherwise), a fault-leg time budget that reports

@@ -1,0 +1,189 @@
+"""Background-job ladder (ISSUE 20 — serve/jobs/).
+
+Measures the preemptible compute class on a live serving fleet:
+
+- **grid ladder**: one ``grid_chisq`` job per rung (256 / 1024 / 4096
+  points) — cold wall (first run pays the kernel trace), steady wall
+  (warmed per-executor kernels, zero fresh traces), points/s, and the
+  quanta each rung sliced into (power-of-two quantum buckets);
+- **mcmc row**: the fixed-quantum ``lax.scan`` ensemble interior —
+  samples/s end-to-end through ``TimingEngine.submit`` plus the
+  device quantum p50/p99 from the stage clock;
+- **concurrency row**: ``PINT_TPU_SERVE_JOBS_MAX`` jobs in flight at
+  once — aggregate points/s vs the single-job rung (round-robin
+  quanta over idle executors);
+- **interference row**: interactive p50/p99 idle vs under a live
+  background job, plus the deterministic preempt/resume round-trip
+  (a deliberately-expired deadline fires the r13 shed signal —
+  ``serve.jobs.preempted``/``resumed`` must both move and the
+  resumed surface must be bitwise the unpressured run's).
+
+Usage: ``python profiling/jobs_ladder.py`` or ``python
+profiling/run_benchmarks.py --configs jobs``.  Workflow:
+docs/serving.md "background jobs".
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _pulsar():
+    from pint_tpu.simulation import make_test_pulsar
+
+    m, toas = make_test_pulsar(
+        "PSR PJOB\nF0 188.19 1\nF1 -1.6e-15 1\nPEPOCH 55000\n"
+        "DM 11.1 1\n",
+        ntoa=256, start_mjd=54000.0, end_mjd=56500.0, seed=21,
+        iterations=1,
+    )
+    return m.as_parfile(), toas
+
+
+def _grid(n):
+    """An n-point F0 x F1 grid (sqrt(n) per axis) around the par
+    values — fixed spacing, deterministic."""
+    import numpy as np
+
+    per = int(round(n ** 0.5))
+
+    def axis(center, half):
+        return list(center + half * np.linspace(-1.0, 1.0, per))
+
+    return {
+        "F0": axis(188.19, 2e-9), "F1": axis(-1.6e-15, 2e-17),
+    }, per * per
+
+
+def jobs_rows():
+    """Yield one JSON-able row per rung."""
+    import jax
+    import numpy as np
+
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.serve import ResidualsRequest, TimingEngine
+    from pint_tpu.serve.api import JobRequest
+
+    backend = jax.default_backend()
+    mc = obs_metrics.counter
+    par, toas = _pulsar()
+    engine = TimingEngine(max_batch=4, max_wait_ms=1.0, inflight=2)
+    try:
+        def grid_req(grid):
+            return JobRequest(
+                kind="grid_chisq", par=par, toas=toas, grid=grid,
+            )
+
+        # grid ladder: cold (first trace) vs steady per rung
+        for npts_req in (256, 1024, 4096):
+            grid, npts = _grid(npts_req)
+            q0 = mc("serve.jobs.quanta").value
+            t0 = time.perf_counter()
+            engine.submit(grid_req(grid)).result(timeout=3600)
+            cold_s = time.perf_counter() - t0
+            tr0 = mc("compile.traces").value
+            t0 = time.perf_counter()
+            engine.submit(grid_req(grid)).result(timeout=3600)
+            steady_s = time.perf_counter() - t0
+            yield {
+                "bench": "jobs", "backend": backend, "rung": "grid",
+                "npts": npts,
+                "cold_s": round(cold_s, 3),
+                "steady_s": round(steady_s, 3),
+                "steady_pts_per_s": round(npts / steady_s, 1),
+                "steady_traces": mc("compile.traces").value - tr0,
+                "quanta": (
+                    mc("serve.jobs.quanta").value - q0
+                ) // 2,
+            }
+
+        # mcmc rung: the scan interior end-to-end
+        nsteps, nwalkers = 512, 16
+        t0 = time.perf_counter()
+        engine.submit(JobRequest(
+            kind="mcmc", par=par, toas=toas, nsteps=nsteps,
+            nwalkers=nwalkers, seed=21,
+        )).result(timeout=3600)
+        mcmc_s = time.perf_counter() - t0
+        st = engine.stats()["jobs"]
+        yield {
+            "bench": "jobs", "backend": backend, "rung": "mcmc",
+            "nsteps": nsteps, "nwalkers": nwalkers,
+            "wall_s": round(mcmc_s, 3),
+            "samples_per_s": round(nsteps * nwalkers / mcmc_s, 1),
+            "quantum_p50_ms": st["quantum_p50_ms"],
+            "quantum_p99_ms": st["quantum_p99_ms"],
+        }
+
+        # concurrency rung: max_jobs jobs sharing the idle fleet
+        grid, npts = _grid(1024)
+        t0 = time.perf_counter()
+        futs = [engine.submit(grid_req(grid)) for _ in range(2)]
+        for f in futs:
+            f.result(timeout=3600)
+        pair_s = time.perf_counter() - t0
+        yield {
+            "bench": "jobs", "backend": backend,
+            "rung": "concurrent", "jobs": 2, "npts_each": npts,
+            "wall_s": round(pair_s, 3),
+            "aggregate_pts_per_s": round(2 * npts / pair_s, 1),
+        }
+
+        # interference rung: interactive latency idle vs under-job +
+        # the deterministic preempt/resume round-trip
+        def wave(n=12):
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                engine.submit(ResidualsRequest(
+                    par=par, toas=toas,
+                )).result(timeout=3600)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+            return lat
+
+        engine.submit(ResidualsRequest(
+            par=par, toas=toas,
+        )).result(timeout=3600)
+        idle = wave()
+        grid, npts = _grid(4096)
+        ref = engine.submit(grid_req(grid)).result(timeout=3600)
+        p0 = mc("serve.jobs.preempted").value
+        r0 = mc("serve.jobs.resumed").value
+        q0 = mc("serve.jobs.quanta").value
+        jfut = engine.submit(grid_req(grid))
+        deadline = time.monotonic() + 60.0
+        while (mc("serve.jobs.quanta").value == q0
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        try:
+            engine.submit(ResidualsRequest(
+                par=par, toas=toas, deadline_s=1e-4,
+            )).result(timeout=3600)
+        except Exception:
+            pass  # the deadline shed IS the pressure probe
+        under = wave()
+        pressured = jfut.result(timeout=3600)
+        yield {
+            "bench": "jobs", "backend": backend,
+            "rung": "interference", "npts": npts,
+            "interactive_p50_idle_ms": round(idle[len(idle) // 2], 3),
+            "interactive_p99_idle_ms": round(idle[-1], 3),
+            "interactive_p50_jobs_ms": round(
+                under[len(under) // 2], 3
+            ),
+            "interactive_p99_jobs_ms": round(under[-1], 3),
+            "preempted": mc("serve.jobs.preempted").value - p0,
+            "resumed": mc("serve.jobs.resumed").value - r0,
+            "preempt_bitwise": bool(np.array_equal(
+                ref.result["chi2"], pressured.result["chi2"]
+            )),
+        }
+    finally:
+        engine.close()
+
+
+if __name__ == "__main__":
+    for row in jobs_rows():
+        print(json.dumps(row))

@@ -439,7 +439,7 @@ def main():
                              "serve_replicas", "serve_population",
                              "serve_gang", "serve_elastic",
                              "dispatch_floor", "chaos",
-                             "mfu", "streaming"])
+                             "mfu", "streaming", "jobs"])
     args = ap.parse_args()
     builders = {"1": config_1, "2": config_2, "3": config_3,
                 "3b": config_3b, "4": config_4, "4b": config_4b,
@@ -534,6 +534,21 @@ def main():
             from streaming_append import streaming_rows
 
             for row in streaming_rows():
+                print(json.dumps(row))
+            continue
+        if str(c) == "jobs":
+            # background-job ladder: grid rungs cold/steady +
+            # zero-steady-trace accounting, the mcmc scan interior,
+            # concurrent jobs, and interactive-interference +
+            # preempt/resume round-trip (ISSUE 20;
+            # profiling/jobs_ladder.py)
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from jobs_ladder import jobs_rows
+
+            for row in jobs_rows():
                 print(json.dumps(row))
             continue
         if str(c) == "dispatch_floor":

@@ -21,6 +21,11 @@ kill-and-restart warm-ledger leg:
   reshape lock and the next generation replays to warmth;
 - the restart leg kills an engine mid-wave (orphans typed), then
   replays the ledger with zero fresh XLA compiles;
+- the background-job legs (ISSUE 20): quantum faults resolve typed
+  with the job surviving bitwise, SLO pressure preempts and resumes
+  a running job losslessly, and kill-mid-job → restart → resume
+  completes the chain bit-for-bit with zero fresh compiles in the
+  resume window;
 - :func:`tools.chaos.classify` buckets outcomes strictly by TYPE —
   the operability contract's measurement instrument.
 """
@@ -101,6 +106,7 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
         ("reshape", "nan"), ("reshape", "413"),
         ("reshape", "kill-mid-reshape"),
         ("stream", "append-faults"), ("restart", "kill-restart"),
+        ("jobs", "quantum-faults"), ("jobs", "kill-restart-resume"),
     }
     for leg in report["legs"]:
         assert leg["ok"], leg
@@ -143,6 +149,27 @@ def test_bounded_sweep_all_legs_ok(monkeypatch, tmp_path):
     restart = legs[("restart", "kill-restart")]
     assert restart["killed_typed"] and restart["replayed"] >= 1
     assert restart["fresh_traces"] == 0
+    # the background-job legs (ISSUE 20): every quantum-fault round
+    # green (steady bitwise/0-trace, transient survival, NaN poison
+    # typed, preempt/resume bitwise) ...
+    jl = legs[("jobs", "quantum-faults")]
+    assert set(jl["rounds"]) == {
+        "steady", "transient", "poison", "preempt",
+    }
+    for name, rnd in jl["rounds"].items():
+        assert rnd["ok"], (name, rnd)
+    assert jl["rounds"]["steady"]["traces"] == 0
+    assert jl["rounds"]["transient"]["fired"] == 2
+    assert jl["rounds"]["poison"]["fired"] > 0
+    assert jl["rounds"]["preempt"]["bitwise"]
+    # ... and kill-mid-job resumes through the warm ledger with the
+    # chain completed bit-for-bit and nothing compiled fresh
+    jr = legs[("jobs", "kill-restart-resume")]
+    assert jr["killed_reason"] == "shutdown"
+    assert jr["checkpoint_on_disk"]
+    assert jr["replayed"] >= 1 and jr["resume_traces"] == 0
+    assert jr["xla_new_entries"] in (None, 0)
+    assert jr["bitwise"] and jr["resumed_flag"]
     assert report["skipped"] == 0
     assert report["ok"] is True
     assert report["flight_has_quarantine"]
@@ -175,11 +202,13 @@ def test_time_budget_reports_skipped_legs_explicitly(monkeypatch):
         kinds=("413",), npsr=2, replicas=2, gangs=0, restart=False,
         time_budget_s=0.0, timeout=60.0,
     )
-    # 2 fault legs + the repartition leg + the stream leg
-    assert report["skipped"] == 4
+    # 2 fault legs + the repartition leg + the stream leg + the
+    # background-job leg
+    assert report["skipped"] == 5
     kinds = {leg["tag"]: leg["kind"] for leg in report["legs"]}
     assert kinds == {"r0": "413", "r1": "413", "reshape": "413",
-                     "stream": "append-faults"}
+                     "stream": "append-faults",
+                     "jobs": "quantum-faults"}
     for leg in report["legs"]:
         assert leg == {"tag": leg["tag"], "kind": leg["kind"],
                        "skipped": True, "ok": True,

@@ -858,6 +858,66 @@ def test_obs12_flags_stripped_fused_interior_guards(tmp_path):
     assert obs12.check_project(REPO / "pint_tpu") == []
 
 
+# -- obs13: the ISSUE 20 background-job chokepoints -----------------------
+def test_obs13_flags_stripped_job_chokepoints(tmp_path):
+    """obs13 catches the background-job scheduler losing its typed
+    admission sheds, admit/quantum spans, checkpoint-on-preempt,
+    trace-locked kernel builds, or atomic checkpoint writes; skips
+    packages that predate serve/jobs/; passes the real tree."""
+    obs13 = rules_by_name()["obs13"]
+    # no serve/jobs/scheduler.py -> the subsystem predates this package
+    bare = tmp_path / "bare" / "pint_tpu"
+    (bare / "serve").mkdir(parents=True)
+    (bare / "serve" / "engine.py").write_text(
+        "class TimingEngine:\n    pass\n"
+    )
+    assert obs13.check_project(bare) == []
+    # stripped chokepoints are flagged, per needle
+    pkg = tmp_path / "pkg" / "pint_tpu"
+    (pkg / "serve" / "jobs").mkdir(parents=True)
+    (pkg / "serve" / "jobs" / "scheduler.py").write_text(
+        "class JobScheduler:\n"
+        "    def submit(self, req, fut):\n"
+        "        self._pending.append((req, fut))\n"  # sheds gone
+        "    def _admit(self, req, fut):\n"
+        "        pass\n"  # span + session + restore ladder gone
+        "    def _run_quantum(self, job, r):\n"
+        "        job.runner.run_quantum(None)\n"  # span + bg term gone
+        "    def _preempt_all(self):\n"
+        "        pass\n"  # checkpoint + event gone
+        "    def _kernel_for(self, session, key, cap, r):\n"
+        "        return lambda *a: None\n"  # builder + lock gone
+    )
+    (pkg / "serve" / "jobs" / "kernels.py").write_text(
+        "def build_job_kernel(session, key, cap, tag):\n"
+        "    return lambda *a: None\n"  # site namespace gone
+        "def _build_grid(session, key, site, warm):\n"
+        "    return lambda *a: None\n"  # traced_jit route gone
+        "def _build_mcmc(session, key, site, priors, warm):\n"
+        "    return lambda *a: None\n"
+    )
+    (pkg / "checkpoint.py").write_text(
+        "def save_job(path, payload):\n"
+        "    import numpy as np\n"
+        "    np.savez(path, **payload)\n"  # torn-write hazard
+    )
+    msgs = "\n".join(f.message for f in obs13.check_project(pkg))
+    assert "jobs-queue-full" in msgs      # typed shed gone
+    assert "jobs:admit" in msgs           # admission span gone
+    assert "_try_restore" in msgs         # restore ladder gone
+    assert "jobs:quantum" in msgs         # quantum span gone
+    assert "note_background" in msgs      # router load term gone
+    assert "job-preempt" in msgs          # yield event gone
+    assert "_checkpoint" in msgs          # checkpoint-on-preempt gone
+    assert "trace_lock" in msgs           # trace discipline gone
+    assert "job_site" in msgs             # site namespace gone
+    assert "make_chi2_at" in msgs         # host-path sourcing gone
+    assert "make_stretch_step" in msgs
+    assert "_atomic_savez" in msgs        # atomic write gone
+    # the real tree carries every chokepoint
+    assert obs13.check_project(REPO / "pint_tpu") == []
+
+
 # -- incident-class acceptance: the real modules carry the guards ---------
 def test_real_tree_declares_the_incident_guards():
     """The acceptance wiring is live in the production tree: the

@@ -1,0 +1,392 @@
+"""Background compute class suite (ISSUE 20) on the virtual 8-device
+CPU mesh (conftest).  Covers the preemptible-job surface end to end:
+
+- grid_chisq and mcmc jobs through ``TimingEngine.submit`` — the grid
+  surface matches the host ``gridutils.grid_chisq`` path (roundoff:
+  the quantum kernel batches points the host path folds one at a
+  time) and the mcmc chain is BITWISE the host ``run_ensemble`` with
+  the same init arguments (shared ``make_stretch_step`` +
+  ``ensemble_keys`` plan);
+- steady-state repeats run on warmed per-executor kernels: zero fresh
+  traces, bitwise-identical surfaces;
+- SLO pressure (a deliberately-expired interactive deadline firing
+  the r13 shed signal) preempts the running job and resumes it when
+  the hold window clears — the finished surface is bitwise the
+  unpressured run's;
+- typed admission sheds: ``jobs-disabled`` (PINT_TPU_SERVE_JOBS=0)
+  and ``jobs-queue-full`` (bounded scheduler queue);
+- kill-and-restart: an engine closed mid-job checkpoints atomically
+  (``RequestRejected('shutdown')`` names the file), a new engine
+  resumes from it, and the resumed chain is bitwise an uninterrupted
+  job's;
+- the r19 stage clock stamps job responses with a monotonic vector
+  and ``stats()["jobs"]`` reports the scheduler block;
+- checkpoint satellites: save_job/load_job roundtrip (0-d object
+  payloads included), atomic writes leave the previous file intact
+  when the replace fails, truncated files raise typed
+  ``CheckpointError``, reserved fields are refused, and
+  ``resume_mcmc`` honors the ``sampler.ensemble_keys`` plan contract
+  (in-plan segments bitwise, resumes deterministic).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.checkpoint import load_job, resume_mcmc, save_job, save_mcmc
+from pint_tpu.exceptions import CheckpointError, RequestRejected
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.serve import ResidualsRequest, TimingEngine
+from pint_tpu.serve.api import JobRequest
+from pint_tpu.simulation import make_test_pulsar
+
+PAR = """
+PSR              J1744-1134
+F0               245.4261196898081  1
+F1               -5.38e-16          1
+PEPOCH           55000
+DM               3.1380             1
+"""
+
+F0, F1 = 245.4261196898081, -5.38e-16
+
+
+def _mc(name):
+    return obs_metrics.counter(name).value
+
+
+def _wait_for(cond, timeout=60.0, tick=0.002):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(tick)
+    return cond()
+
+
+def _grid(per, three=False):
+    """A per**2 (or per**3) grid around the par values — fixed
+    spacing, deterministic."""
+    axes = {
+        "F0": list(F0 + 2e-9 * np.linspace(-1.0, 1.0, per)),
+        "F1": list(F1 + 5e-18 * np.linspace(-1.0, 1.0, per)),
+    }
+    if three:
+        axes["DM"] = list(3.1380 + 1e-5 * np.linspace(-1.0, 1.0, per))
+    return axes
+
+
+@pytest.fixture(scope="module")
+def pulsar():
+    """ntoa=64 — exactly the min bucket, so host and job paths see
+    identical (pad-free) TOA arrays."""
+    m, t = make_test_pulsar(
+        PAR, ntoa=64, start_mjd=54000.0, end_mjd=56500.0, seed=33,
+        iterations=1,
+    )
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def engine(pulsar):
+    """Module engine with a 64-wide job quantum (read from env at
+    JobScheduler build, so it must be set BEFORE construction)."""
+    m, toas = pulsar
+    os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"] = "64"
+    try:
+        eng = TimingEngine(max_batch=2, max_wait_ms=2.0, inflight=1)
+    finally:
+        del os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"]
+    # warm the interactive residuals path once (the preempt leg's
+    # pressure probe rides it)
+    eng.submit(
+        ResidualsRequest(par=m.as_parfile(), toas=toas)
+    ).result(timeout=600)
+    yield eng
+    eng.close(timeout=60)
+
+
+def _job(m, toas, **kw):
+    return JobRequest(par=m.as_parfile(), toas=toas, **kw)
+
+
+# -- end-to-end parity ------------------------------------------------------
+def test_grid_job_matches_host_grid_chisq(engine, pulsar):
+    from pint_tpu.gridutils import grid_chisq
+
+    m, toas = pulsar
+    grid = _grid(5)
+    host = np.asarray(grid_chisq(toas, m, grid))
+    resp = engine.submit(
+        _job(m, toas, kind="grid_chisq", grid=grid)
+    ).result(timeout=600)
+    assert resp.kind == "grid_chisq"
+    assert resp.result["names"] == ("F0", "F1")
+    assert resp.result["chi2"].shape == host.shape == (5, 5)
+    # roundoff-level parity: the quantum kernel evaluates a batch of
+    # points per dispatch where the host path folds them one at a time
+    assert np.allclose(resp.result["chi2"], host, rtol=1e-10, atol=0.0)
+    assert resp.quanta >= 1 and resp.ntoa == 64 and resp.bucket == 64
+
+
+def test_mcmc_job_bitwise_matches_host_run_ensemble(engine, pulsar):
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.sampler import run_ensemble
+
+    m, toas = pulsar
+    resp = engine.submit(
+        _job(m, toas, kind="mcmc", nsteps=128, nwalkers=8, seed=9)
+    ).result(timeout=600)
+    bt = BayesianTiming(m, toas)
+    chain, lnp, acc = run_ensemble(
+        bt.lnposterior, np.zeros(bt.nparams), nwalkers=8, nsteps=128,
+        seed=9,
+    )
+    # one source of truth for the proposal math (make_stretch_step)
+    # and the key plan (ensemble_keys): the sliced quantum path is
+    # bitwise the monolithic host scan
+    assert np.array_equal(resp.result["chain"], chain)
+    assert np.array_equal(resp.result["lnp"], lnp)
+    assert resp.result["acceptance"] == pytest.approx(acc)
+    assert resp.quanta >= 2  # mcmc0 seed quantum + >=1 scan quantum
+
+
+def test_steady_repeat_zero_traces_bitwise(engine, pulsar):
+    m, toas = pulsar
+    grid = _grid(6)
+    req = lambda: _job(m, toas, kind="grid_chisq", grid=grid)  # noqa: E731
+    ref = engine.submit(req()).result(timeout=600)
+    tr0 = _mc("compile.traces")
+    again = engine.submit(req()).result(timeout=600)
+    assert _mc("compile.traces") - tr0 == 0
+    assert np.array_equal(ref.result["chi2"], again.result["chi2"])
+
+
+# -- preemption -------------------------------------------------------------
+def test_preempt_resume_on_slo_pressure(engine, pulsar):
+    m, toas = pulsar
+    grid = _grid(16, three=True)  # 4096 points = 64 quanta at q=64
+    ref = engine.submit(
+        _job(m, toas, kind="grid_chisq", grid=grid)
+    ).result(timeout=600)
+    p0, r0 = _mc("serve.jobs.preempted"), _mc("serve.jobs.resumed")
+    q0 = _mc("serve.jobs.quanta")
+    fut = engine.submit(_job(m, toas, kind="grid_chisq", grid=grid))
+    assert _wait_for(lambda: _mc("serve.jobs.quanta") > q0)
+    # a deliberately-expired interactive deadline fires the r13 shed
+    # signal the scheduler watches — deterministic pressure
+    with pytest.raises(RequestRejected) as ei:
+        engine.submit(ResidualsRequest(
+            par=m.as_parfile(), toas=toas, deadline_s=1e-4,
+        )).result(timeout=600)
+    assert ei.value.reason == "deadline"
+    resp = fut.result(timeout=600)
+    assert _mc("serve.jobs.preempted") - p0 >= 1
+    assert _mc("serve.jobs.resumed") - r0 >= 1
+    assert resp.preemptions >= 1
+    # the preempted-then-resumed surface is bitwise the unpressured one
+    assert np.array_equal(ref.result["chi2"], resp.result["chi2"])
+
+
+# -- typed admission sheds --------------------------------------------------
+def test_jobs_disabled_typed_rejection(pulsar):
+    m, toas = pulsar
+    os.environ["PINT_TPU_SERVE_JOBS"] = "0"
+    try:
+        eng = TimingEngine(max_batch=2, max_wait_ms=2.0, inflight=1)
+    finally:
+        del os.environ["PINT_TPU_SERVE_JOBS"]
+    try:
+        with pytest.raises(RequestRejected) as ei:
+            eng.submit(
+                _job(m, toas, kind="grid_chisq", grid=_grid(3))
+            ).result(timeout=60)
+        assert ei.value.reason == "jobs-disabled"
+    finally:
+        eng.close(timeout=60)
+
+
+def test_jobs_queue_full_typed_rejection(pulsar):
+    m, toas = pulsar
+    eng = TimingEngine(max_batch=2, max_wait_ms=2.0, inflight=1)
+    try:
+        # park the scheduler: a finished thread keeps _loop from
+        # starting, so pending accumulates deterministically
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        eng._jobs._thread = dead
+        eng._jobs.max_queue = 1
+        held = eng.submit(
+            _job(m, toas, kind="grid_chisq", grid=_grid(3))
+        )
+        with pytest.raises(RequestRejected) as ei:
+            eng.submit(
+                _job(m, toas, kind="grid_chisq", grid=_grid(3))
+            ).result(timeout=60)
+        assert ei.value.reason == "jobs-queue-full"
+        assert not held.done()
+    finally:
+        eng.close(timeout=60)
+    # close() sheds the parked job typed, never silently drops it
+    with pytest.raises(RequestRejected) as ei:
+        held.result(timeout=1.0)
+    assert ei.value.reason == "shutdown"
+
+
+# -- kill-and-restart resume ------------------------------------------------
+def test_kill_mid_job_checkpoint_resume_bitwise(pulsar, tmp_path):
+    m, toas = pulsar
+    cp = str(tmp_path / "mcmc-job.npz")
+
+    def job_req(checkpoint=True):
+        return _job(
+            m, toas, kind="mcmc", nsteps=4096, nwalkers=8, seed=77,
+            checkpoint_path=cp if checkpoint else None,
+        )
+
+    os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"] = "64"
+    try:
+        eng = TimingEngine(max_batch=2, max_wait_ms=2.0, inflight=1)
+        q0 = _mc("serve.jobs.quanta")
+        fut = eng.submit(job_req())
+        # 64 quanta of runway: close() always lands mid-chain
+        assert _wait_for(lambda: _mc("serve.jobs.quanta") - q0 >= 2)
+        eng.close(timeout=60)
+        with pytest.raises(RequestRejected) as ei:
+            fut.result(timeout=1.0)
+        assert ei.value.reason == "shutdown"
+        assert cp in str(ei.value)  # the shed names the checkpoint
+        assert os.path.exists(cp)
+
+        eng2 = TimingEngine(max_batch=2, max_wait_ms=2.0, inflight=1)
+        try:
+            resumed = eng2.submit(job_req()).result(timeout=600)
+            ref = eng2.submit(job_req(checkpoint=False)).result(
+                timeout=600
+            )
+        finally:
+            eng2.close(timeout=60)
+    finally:
+        del os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"]
+    assert resumed.resumed and not ref.resumed
+    assert resumed.result["chain"].shape[0] == 4096
+    # resume loses nothing: bitwise the uninterrupted run
+    assert np.array_equal(resumed.result["chain"], ref.result["chain"])
+    assert np.array_equal(resumed.result["lnp"], ref.result["lnp"])
+
+
+# -- observability ----------------------------------------------------------
+def test_job_stage_vector_monotonic(engine, pulsar):
+    from pint_tpu.obs.metrics import STAGES
+
+    m, toas = pulsar
+    resp = engine.submit(
+        _job(m, toas, kind="grid_chisq", grid=_grid(3))
+    ).result(timeout=600)
+    assert "submit" in resp.stages and "finish" in resp.stages
+    seen = [resp.stages[s] for s in STAGES if s in resp.stages]
+    assert len(seen) >= 3
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+
+
+def test_stats_jobs_block(engine):
+    st = engine.stats()["jobs"]
+    for k in (
+        "enabled", "running", "queued", "submitted", "completed",
+        "rejected", "quanta", "preemptions", "resumes", "checkpoints",
+        "restores", "faults", "kernels", "quantum_p50_ms",
+        "quantum_p99_ms",
+    ):
+        assert k in st, k
+    assert st["enabled"] and st["submitted"] >= 1
+    assert st["completed"] >= 1 and st["quanta"] >= 1
+
+
+# -- checkpoint satellites --------------------------------------------------
+def test_save_job_roundtrip_including_object_payload(tmp_path):
+    p = str(tmp_path / "job.npz")
+    state = {"cursor": 7, "chi2": np.arange(9.0),
+             "rng": {"bits": [1, 2, 3], "pos": 4}}
+    save_job(p, state)
+    out = load_job(p)
+    assert int(out["cursor"]) == 7
+    assert np.array_equal(out["chi2"], np.arange(9.0))
+    assert out["rng"] == {"bits": [1, 2, 3], "pos": 4}
+
+
+def test_save_job_refuses_reserved_fields(tmp_path):
+    with pytest.raises(ValueError, match="reserved"):
+        save_job(str(tmp_path / "job.npz"), {"version": 2})
+    with pytest.raises(ValueError, match="reserved"):
+        save_job(str(tmp_path / "job.npz"), {"kind": "grid"})
+
+
+def test_atomic_write_keeps_old_file_on_failure(tmp_path, monkeypatch):
+    p = str(tmp_path / "job.npz")
+    save_job(p, {"cursor": 1})
+
+    def boom(*a, **kw):
+        raise OSError("disk pulled")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        save_job(p, {"cursor": 2})
+    monkeypatch.undo()
+    # the torn write never reached the live file, and no tmp litter
+    assert int(load_job(p)["cursor"]) == 1
+    assert os.listdir(str(tmp_path)) == ["job.npz"]
+
+
+def test_truncated_checkpoint_is_typed_error(tmp_path):
+    p = str(tmp_path / "job.npz")
+    save_job(p, {"cursor": 3, "chi2": np.arange(64.0)})
+    raw = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointError, match="truncated or corrupt"):
+        load_job(p)
+
+
+def test_ensemble_plan_segments_bitwise(pulsar):
+    """The sampler.ensemble_keys contract the job runner and
+    checkpoint.resume_mcmc both ride: segments of one planned
+    schedule concatenate bitwise-equal to the uninterrupted run."""
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.sampler import run_ensemble
+
+    m, toas = pulsar
+    bt = BayesianTiming(m, toas)
+    x0 = np.zeros(bt.nparams)
+    full_c, full_l, _ = run_ensemble(
+        bt.lnposterior, x0, nwalkers=8, nsteps=120, seed=5,
+    )
+    p1_c, p1_l, _ = run_ensemble(
+        bt.lnposterior, x0, nwalkers=8, nsteps=60, seed=5,
+        nsteps_total=120,
+    )
+    p2_c, p2_l, _ = run_ensemble(
+        bt.lnposterior, x0, nwalkers=8, nsteps=60, seed=5,
+        nsteps_total=120, start=60, init_walkers=p1_c[-1],
+        init_lp=p1_l[-1],
+    )
+    assert np.array_equal(np.concatenate([p1_c, p2_c]), full_c)
+    assert np.array_equal(np.concatenate([p1_l, p2_l]), full_l)
+
+
+def test_resume_mcmc_bitwise_deterministic(pulsar, tmp_path):
+    from pint_tpu.sampler import MCMCFitter
+
+    m, toas = pulsar
+    f = MCMCFitter(toas, m)
+    f.fit_toas(nsteps=60, nwalkers=8, seed=5)
+    p = str(tmp_path / "mc.npz")
+    save_mcmc(p, f, keep_last=60)
+    r1 = resume_mcmc(p, toas, nsteps=40)
+    r2 = resume_mcmc(p, toas, nsteps=40)
+    # past-plan extension is deterministic: two resumes of the same
+    # cursor are bitwise-identical (and carry the extended plan)
+    assert np.array_equal(r1.chain, r2.chain)
+    assert np.array_equal(r1.lnp, r2.lnp)
+    assert r1.run_meta == dict(seed=5, nsteps_done=100, nsteps_total=100)

@@ -31,6 +31,19 @@ ledger, and the replayed pre-warm must absorb the prior traffic mix
 with ZERO fresh XLA compiles (persistent-compile-cache hits only) and
 zero live traces under post-restart traffic.
 
+**Background-job legs** (ISSUE 20) exercise the preemptible compute
+class: a grid job repeated bitwise with zero steady traces; injected
+quantum faults at the ``serve:job`` guard sites (finite faults
+re-route and survive bitwise off the pre-quantum carry, unbounded
+NaN exhausts the retry budget TYPED); a long grid job preempted by a
+deterministic deadline shed (the r13 pressure signal) that resumes
+to the bitwise-unpressured surface while interactive futures keep
+complete monotonic stage vectors; and a kill-mid-job leg — the
+engine closes with an MCMC chain mid-flight (checkpointed, shed
+``RequestRejected('shutdown')``), restarts against the same warm
+ledger, and resumes from the checkpoint with zero fresh traces to a
+chain BITWISE an uninterrupted run's.
+
 **Repartition legs** (ISSUE 16) exercise the elastic fabric's reshape
 path under the same contract: a fault pinned to one executor while
 the pool repartitions mid-drain (the DRAINING fence must hand queued
@@ -510,6 +523,297 @@ def stream_leg(*, kinds=ALL_KINDS, hang_seconds: float = 1.5,
     }
 
 
+# -- the background-job legs (ISSUE 20) -------------------------------------
+def _job_pulsar():
+    """One fixed-seed exact-bucket pulsar for the job legs (64 TOAs =
+    the 64 bucket, so padded and unpadded operands coincide)."""
+    from pint_tpu.simulation import make_test_pulsar
+
+    m, toas = make_test_pulsar(
+        "PSR CJOB\nF0 173.75 1\nF1 -1.4e-15 1\nPEPOCH 55000\n"
+        "DM 7.7 1\n",
+        ntoa=64, start_mjd=54000.0, end_mjd=56000.0, seed=654,
+        iterations=1,
+    )
+    return m.as_parfile(), toas
+
+
+def _axis(center, half, n):
+    """n absolute grid values centered on the par value — host-side
+    numpy only, fixed spacing (the sweep stays deterministic)."""
+    import numpy as np
+
+    return list(center + half * np.linspace(-1.0, 1.0, n))
+
+
+@contextlib.contextmanager
+def _job_engine(quantum: int = 64, **kw):
+    """A jobs-leg engine with a pinned quantum size (the scheduler
+    reads PINT_TPU_SERVE_JOBS_QUANTUM at build)."""
+    from pint_tpu.serve import TimingEngine
+
+    prior = os.environ.get("PINT_TPU_SERVE_JOBS_QUANTUM")
+    os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"] = str(quantum)
+    kw.setdefault("warm_ledger", False)
+    try:
+        engine = TimingEngine(
+            max_batch=2, max_wait_ms=2.0, inflight=1, max_queue=256,
+            **kw,
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("PINT_TPU_SERVE_JOBS_QUANTUM", None)
+        else:
+            os.environ["PINT_TPU_SERVE_JOBS_QUANTUM"] = prior
+    try:
+        yield engine
+    finally:
+        engine.close()
+
+
+def jobs_leg(*, hang_seconds: float = 1.5,
+             timeout: float = 120.0) -> dict:
+    """ISSUE 20: the preemptible background class under faults and
+    interactive SLO pressure.  Rounds:
+
+    - **warm/steady**: the same grid job twice — the repeat must be
+      bitwise-identical with ZERO fresh traces (power-of-two quanta on
+      per-executor warmed kernels);
+    - **transient survival**: two injected quantum faults at the
+      ``serve:job`` sites — the runner only advances on success, so
+      the job re-routes, completes, and the surface stays bitwise;
+    - **poison**: an unbounded NaN fault exhausts the retry budget —
+      the future must resolve TYPED, never hang;
+    - **preempt-under-flood**: a long grid job yields to a deadline
+      shed (the r13 pressure signal), interactive futures keep
+      complete monotonic stage vectors, and the resumed job's surface
+      is bitwise the unpressured run's."""
+    import numpy as np
+
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import faults, guard
+    from pint_tpu.serve import ResidualsRequest
+    from pint_tpu.serve.api import JobRequest
+
+    par, toas = _job_pulsar()
+    small_grid = {
+        "F0": _axis(173.75, 2e-9, 3), "F1": _axis(-1.4e-15, 2e-17, 3),
+    }
+    big_grid = {
+        "F0": _axis(173.75, 2e-9, 16),
+        "F1": _axis(-1.4e-15, 2e-17, 16),
+        "DM": _axis(7.7, 1e-4, 16),
+    }
+
+    def submit_grid(engine, grid):
+        return engine.submit(JobRequest(
+            kind="grid_chisq", par=par, toas=toas, grid=grid,
+        ))
+
+    mc = obs_metrics.counter
+    rounds = {}
+    with _job_engine(quantum=64) as engine:
+        # warm + steady: bitwise repeat, zero fresh traces
+        ref = submit_grid(engine, small_grid).result(timeout=timeout)
+        t0 = mc("compile.traces").value
+        again = submit_grid(engine, small_grid).result(timeout=timeout)
+        rounds["steady"] = {
+            "traces": mc("compile.traces").value - t0,
+            "bitwise": bool(np.array_equal(
+                ref.result["chi2"], again.result["chi2"]
+            )),
+        }
+        rounds["steady"]["ok"] = (
+            rounds["steady"]["traces"] == 0
+            and rounds["steady"]["bitwise"]
+        )
+
+        # transient survival: two faulted quanta re-route; no loss
+        f0 = mc("serve.jobs.faults").value
+        with guard.configured(max_retries=0):
+            with faults.inject("transient:2@serve:job") as plan:
+                tfut = submit_grid(engine, small_grid)
+                survived = classify([tfut], timeout)
+                fired = len(plan.fired)
+        rounds["transient"] = {
+            "fired": fired, "outcomes": survived,
+            "faults": mc("serve.jobs.faults").value - f0,
+            "bitwise": bool(
+                survived["completed"] == 1
+                and np.array_equal(
+                    ref.result["chi2"],
+                    tfut.result(timeout=1.0).result["chi2"],
+                )
+            ),
+            "ok": bool(
+                survived["typed"]
+                and survived["completed"] == 1
+                and fired == 2
+                and mc("serve.jobs.faults").value - f0 == 2
+            ),
+        }
+        rounds["transient"]["ok"] = (
+            rounds["transient"]["ok"] and rounds["transient"]["bitwise"]
+        )
+
+        # poison: unbounded NaN past the retry budget -> typed failure
+        with guard.configured(max_retries=0):
+            with faults.inject("nan:inf@serve:job") as plan:
+                poisoned = classify(
+                    [submit_grid(engine, small_grid)], timeout
+                )
+                nan_fired = len(plan.fired)
+        rounds["poison"] = {
+            "fired": nan_fired, "outcomes": poisoned,
+            "ok": bool(
+                poisoned["typed"]
+                and sum(poisoned["failed"].values()) == 1
+                and nan_fired > 0
+            ),
+        }
+
+        # preempt-under-flood: the unpressured big surface first, then
+        # the same job racing a deadline shed + interactive wave
+        big_ref = submit_grid(engine, big_grid).result(timeout=timeout)
+        p0 = mc("serve.jobs.preempted").value
+        r0 = mc("serve.jobs.resumed").value
+        q0 = mc("serve.jobs.quanta").value
+        jfut = submit_grid(engine, big_grid)
+        if not _wait_for(
+            lambda: mc("serve.jobs.quanta").value > q0, timeout
+        ):
+            raise RuntimeError("flood job never started a quantum")
+        doomed = engine.submit(ResidualsRequest(
+            par=par, toas=toas, deadline_s=1e-4,
+        ))
+        wave = [
+            engine.submit(ResidualsRequest(par=par, toas=toas))
+            for _ in range(4)
+        ]
+        interactive = classify([doomed] + wave, timeout)
+        flooded = classify([jfut], timeout)
+        preempted = mc("serve.jobs.preempted").value - p0
+        resumed = mc("serve.jobs.resumed").value - r0
+        rounds["preempt"] = {
+            "interactive": interactive, "job": flooded,
+            "preempted": preempted, "resumed": resumed,
+            "bitwise": bool(
+                flooded["completed"] == 1
+                and np.array_equal(
+                    big_ref.result["chi2"],
+                    jfut.result(timeout=1.0).result["chi2"],
+                )
+            ),
+            "ok": bool(
+                interactive["typed"]
+                and interactive["rejected"].get("deadline", 0) == 1
+                and interactive["completed"] == len(wave)
+                and flooded["typed"] and flooded["completed"] == 1
+                and preempted >= 1 and resumed >= 1
+            ),
+        }
+        rounds["preempt"]["ok"] = (
+            rounds["preempt"]["ok"] and rounds["preempt"]["bitwise"]
+        )
+        jobs_stats = engine.stats()["jobs"]
+    return {
+        "tag": "jobs", "kind": "quantum-faults",
+        "rounds": rounds, "jobs": jobs_stats,
+        "ok": all(r["ok"] for r in rounds.values()),
+    }
+
+
+def job_restart_leg(ledger_path: str, *,
+                    timeout: float = 600.0) -> dict:
+    """Kill-mid-job, restart, resume (ISSUE 20): generation 1 is
+    closed with an MCMC job mid-flight — the job checkpoints at
+    shutdown and its future resolves ``RequestRejected('shutdown')``.
+    Generation 2 boots from the same warm ledger (job kernels replay
+    through ``JobScheduler.prewarm``), resumes the job from its
+    checkpoint with ZERO fresh traces in the resume window, and the
+    stitched chain is BITWISE an uninterrupted run's — no sample lost
+    or repeated."""
+    import numpy as np
+
+    from pint_tpu.exceptions import RequestRejected
+    from pint_tpu.obs import metrics as obs_metrics
+    from pint_tpu.runtime import compile_cache
+    from pint_tpu.serve.api import JobRequest
+
+    mc = obs_metrics.counter
+    par, toas = _job_pulsar()
+    cp = os.path.join(os.path.dirname(ledger_path), "chaos-job.npz")
+
+    # 4096 steps at the 64-step quantum = 64 quanta: enough runway
+    # that the kill always lands with the chain incomplete
+    nsteps = 4096
+
+    def job_req(checkpoint=True):
+        return JobRequest(
+            kind="mcmc", par=par, toas=toas, nsteps=nsteps, nwalkers=8,
+            seed=77, checkpoint_path=cp if checkpoint else None,
+        )
+
+    # generation 1: die mid-job (>= 1 main quantum done)
+    q0 = mc("serve.jobs.quanta").value
+    with _job_engine(quantum=64, warm_ledger=ledger_path) as eng:
+        fut = eng.submit(job_req())
+        if not _wait_for(
+            lambda: mc("serve.jobs.quanta").value - q0 >= 2, timeout
+        ):
+            raise RuntimeError("gen-1 job never progressed")
+    try:
+        fut.result(timeout=1.0)
+        killed_reason = "completed"
+    except RequestRejected as e:
+        killed_reason = e.reason
+    except BaseException as e:
+        killed_reason = type(e).__name__
+    ckpt_on_disk = os.path.exists(cp)
+
+    # generation 2: boot replays the ledger, the resumed job runs
+    # trace-free and completes the chain bit-for-bit
+    rep0 = mc("serve.warm.replayed").value
+    with _job_engine(quantum=64, warm_ledger=ledger_path) as eng2:
+        replayed = mc("serve.warm.replayed").value - rep0
+        t0 = mc("compile.traces").value
+        xla0 = compile_cache.entry_count()
+        resumed = eng2.submit(job_req()).result(timeout=timeout)
+        resume_traces = mc("compile.traces").value - t0
+        xla1 = compile_cache.entry_count()
+        # the uninterrupted reference (same seed, no checkpoint)
+        ref = eng2.submit(job_req(checkpoint=False)).result(
+            timeout=timeout
+        )
+    leg = {
+        "tag": "jobs", "kind": "kill-restart-resume",
+        "killed_reason": killed_reason,
+        "checkpoint_on_disk": ckpt_on_disk,
+        "replayed": replayed,
+        "resumed_flag": bool(resumed.resumed),
+        "resume_traces": resume_traces,
+        "xla_new_entries": (
+            None if xla0 is None or xla1 is None else xla1 - xla0
+        ),
+        "chain_len": int(ref.result["chain"].shape[0]),
+        "bitwise": bool(
+            np.array_equal(resumed.result["chain"], ref.result["chain"])
+            and np.array_equal(resumed.result["lnp"], ref.result["lnp"])
+        ),
+    }
+    leg["ok"] = bool(
+        killed_reason == "shutdown"
+        and ckpt_on_disk
+        and replayed >= 1
+        and leg["resumed_flag"]
+        and resume_traces == 0
+        and (leg["xla_new_entries"] in (None, 0))
+        and leg["chain_len"] == nsteps
+        and leg["bitwise"]
+    )
+    return leg
+
+
 # -- the kill-and-restart leg ----------------------------------------------
 def restart_leg(small, ledger_path: str, *, engine_kw: dict,
                 wave: int = 6, timeout: float = 600.0) -> dict:
@@ -820,13 +1124,16 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
               gang_size: int | None = None,
               hang_seconds: float = 1.5, restart: bool = True,
               stream: bool = True, reshape: bool = True,
+              jobs: bool = True,
               ledger_dir: str | None = None,
               time_budget_s: float | None = None,
               timeout: float = 120.0) -> dict:
     """The full chaos matrix: one leg per (executor tag, fault kind)
     over a mixed single/gang fabric, the repartition legs (ISSUE 16:
     one fault-mid-drain leg per kind plus kill-mid-reshape), the
-    streaming append-fault leg (ISSUE 14), and the kill-and-restart
+    streaming append-fault leg (ISSUE 14), the background-job legs
+    (ISSUE 20: quantum faults + preempt-under-flood, and kill-mid-job
+    -> restart -> checkpoint/ledger resume), and the kill-and-restart
     leg.  Returns the report dict ``python -m tools.chaos`` prints.
 
     ``time_budget_s`` bounds the FAULT-leg portion (the profiling
@@ -923,6 +1230,19 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
                     kinds=kinds, hang_seconds=hang_seconds,
                     timeout=timeout,
                 ), vbase))
+        if jobs:
+            if (time_budget_s is not None
+                    and time.monotonic() - t_start > time_budget_s):
+                legs.append({
+                    "tag": "jobs", "kind": "quantum-faults",
+                    "skipped": True, "ok": True,
+                    "lock_violations": 0,
+                })
+            else:
+                vbase = lockwitness.violation_count()
+                legs.append(_witness_leg(jobs_leg(
+                    hang_seconds=hang_seconds, timeout=timeout,
+                ), vbase))
         if restart:
             lp = os.path.join(lp_dir, "chaos-warm-ledger.json")
             vbase = lockwitness.violation_count()
@@ -952,6 +1272,13 @@ def run_sweep(*, kinds=ALL_KINDS, npsr: int = 3,
                         ),
                         timeout=max(timeout, 600.0),
                     ), vbase))
+            if jobs:
+                lpj = os.path.join(lp_dir, "chaos-jobs-ledger.json")
+                vbase = lockwitness.violation_count()
+                with _deterministic_cache_writes():
+                    legs.append(_witness_leg(job_restart_leg(
+                        lpj, timeout=max(timeout, 600.0),
+                    ), vbase))
         total_violations = lockwitness.violation_count()
     return {
         "executors": [s["tag"] for s in sites],
@@ -978,6 +1305,7 @@ def main(argv=None) -> int:
     ap.add_argument("--no-restart", action="store_true")
     ap.add_argument("--no-stream", action="store_true")
     ap.add_argument("--no-reshape", action="store_true")
+    ap.add_argument("--no-jobs", action="store_true")
     ap.add_argument("--timeout", type=float, default=120.0)
     args = ap.parse_args(argv)
     report = run_sweep(
@@ -985,6 +1313,7 @@ def main(argv=None) -> int:
         replicas=args.replicas, gangs=args.gangs,
         gang_size=args.gang_size, restart=not args.no_restart,
         stream=not args.no_stream, reshape=not args.no_reshape,
+        jobs=not args.no_jobs,
         timeout=args.timeout,
     )
     for leg in report["legs"]:

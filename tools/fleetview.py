@@ -3,9 +3,11 @@
 Renders the events the serving fabric ALREADY emits — ``replica-state``
 / ``gang-state`` transitions (``Replica._set_state``), ``router-purge``
 epochs, ``repartition`` steps, warm-ledger ``prewarm-failed`` entries,
-``readmit`` probes, ``spill``s, and ``shed``s — as one per-executor
-timeline aligned with the request flows recorded in the same file
-(ISSUE 17).  Two outputs:
+``readmit`` probes, ``spill``s, ``shed``s, and the background-job
+lifecycle (ISSUE 20: ``job-state``/``job-preempt``/``job-resume`` on a
+synthetic ``jobs`` track, ``job-fault`` on the executor it faulted) —
+as one per-executor timeline aligned with the request flows recorded
+in the same file (ISSUE 17).  Two outputs:
 
 - the default TEXT timeline: one track per executor tag, events in
   time order, plus a request-flow digest (slowest flows with their
@@ -48,18 +50,32 @@ _FLEET_EVENTS = {
     "shed": "replica",
     "repartition": None,  # pool-wide
     "router-purge": None,
+    # background-job lifecycle (ISSUE 20): scheduler-wide events land
+    # on the synthetic "jobs" track; a quantum fault carries the
+    # executor tag and lands on that executor's track instead
+    "job-state": ("replica", "jobs"),
+    "job-preempt": ("replica", "jobs"),
+    "job-resume": ("replica", "jobs"),
+    "job-checkpoint": ("replica", "jobs"),
+    "job-checkpoint-failed": ("replica", "jobs"),
+    "job-fault": ("replica", "jobs"),
 }
 
 
 def _fleet_tag(ev) -> str | None:
     """The executor track an event belongs on; 'pool' for pool-wide
-    events (repartition/purge), None for non-fleet events."""
+    events (repartition/purge), None for non-fleet events.  A tuple
+    value is (attr key, fallback track) — background-job events fall
+    back to the 'jobs' track when no executor is attributed."""
     if ev.name not in _FLEET_EVENTS:
         return None
     key = _FLEET_EVENTS[ev.name]
     if key is None:
         return "pool"
-    return str(ev.attrs.get(key, "pool"))
+    default = "pool"
+    if isinstance(key, tuple):
+        key, default = key
+    return str(ev.attrs.get(key, default))
 
 
 def _describe(ev) -> str:

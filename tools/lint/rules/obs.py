@@ -739,6 +739,84 @@ class Obs11Rule(Rule):
         return findings
 
 
+_JOBS_CHECKS = (
+    ("serve/jobs/scheduler.py", "JobScheduler.submit",
+     ("jobs-disabled", "jobs-queue-full", "RequestRejected"),
+     "background-job admission must shed typed at the bounded edge "
+     "(the docs/serving.md reason table) — a silent drop or an "
+     "unbounded pending list breaks the backpressure contract"),
+    ("serve/jobs/scheduler.py", "JobScheduler._admit",
+     ("jobs:admit", "_session_for_request", "_try_restore"),
+     "job admission must span the chokepoint, resolve its session "
+     "through the engine's shared helper (a known composition admits "
+     "with zero compiles), and run the typed checkpoint-restore "
+     "ladder before the first quantum"),
+    ("serve/jobs/scheduler.py", "JobScheduler._run_quantum",
+     ("jobs:quantum", "note_background"),
+     "the quantum-dispatch chokepoint must span every slice and "
+     "bracket it with the executor's background load term — without "
+     "it the router keeps steering interactive work onto a busy "
+     "device and attribution loses the background class"),
+    ("serve/jobs/scheduler.py", "JobScheduler._preempt_all",
+     ("job-preempt", "_checkpoint"),
+     "yield-on-pressure must checkpoint every running job and emit "
+     "the job-preempt event — an uncheckpointed yield turns the next "
+     "fault into lost samples, an unlogged one blinds fleetview"),
+    ("serve/jobs/scheduler.py", "JobScheduler._kernel_for",
+     ("build_job_kernel", "trace_lock"),
+     "job kernels must build through the one builder and take their "
+     "first trace under the session trace lock (_with_swapped "
+     "mutates the shared prototype for the trace's duration — the "
+     "replica._kernel_for discipline)"),
+    ("serve/jobs/kernels.py", "build_job_kernel",
+     ("job_site",),
+     "every job kernel identity must resolve its dispatch site "
+     "through job_site (the serve:job:* namespace PINT_TPU_FAULTS "
+     "and the obs13 fixtures pin per executor)"),
+    ("serve/jobs/kernels.py", "_build_grid",
+     ("traced_jit", "_with_swapped", "make_chi2_at"),
+     "the grid quantum kernel must route through traced_jit over the "
+     "swapped prototype and source its per-point math from "
+     "gridutils.make_chi2_at — an ad-hoc interior drifts from the "
+     "host-path chi2 surface and dodges the fault ladder"),
+    ("serve/jobs/kernels.py", "_build_mcmc",
+     ("traced_jit", "_with_swapped", "make_stretch_step"),
+     "the mcmc quantum kernel must scan sampler.make_stretch_step "
+     "through traced_jit over the swapped prototype — the bitwise "
+     "preempt/resume contract hangs on sharing the host path's step "
+     "and key schedule"),
+    ("checkpoint.py", "save_job",
+     ("_atomic_savez",),
+     "job checkpoints must write atomically (tmp + os.replace) — a "
+     "kill mid-write must leave the previous checkpoint intact, "
+     "never a torn file the resume ladder then reports as corrupt"),
+)
+
+
+class Obs13Rule(Rule):
+    """Background-job chokepoints (ISSUE 20): typed admission sheds,
+    the admit/quantum spans, checkpoint-on-preempt, trace-locked
+    kernel builds, guarded quantum dispatch, atomic checkpoints."""
+
+    name = "obs13"
+
+    def check_project(self, pkg_root: Path) -> list:
+        pkg_root = Path(pkg_root)
+        # gate on the jobs package itself: fixture packages that
+        # predate the subsystem skip (obs7..obs12 convention)
+        if not (pkg_root / "serve" / "jobs" / "scheduler.py").is_file():
+            return []
+        findings = _run_checks(
+            self.name, pkg_root, _JOBS_CHECKS[:-1],
+            pkg_root / "serve" / "jobs",
+        )
+        findings += _check_needles(
+            self.name, pkg_root / "checkpoint.py",
+            *_JOBS_CHECKS[-1][1:],
+        )
+        return findings
+
+
 OBS1 = Obs1Rule()
 OBS2 = Obs2Rule()
 OBS3 = Obs3Rule()
@@ -751,8 +829,9 @@ OBS9 = Obs9Rule()
 OBS10 = Obs10Rule()
 OBS11 = Obs11Rule()
 OBS12 = Obs12Rule()
+OBS13 = Obs13Rule()
 RULES = (OBS1, OBS2, OBS3, OBS4, OBS5, OBS6, OBS7, OBS8, OBS9, OBS10,
-         OBS11, OBS12)
+         OBS11, OBS12, OBS13)
 
 
 # -- back-compat surface (tools/lint_obs.py shim) -------------------------
